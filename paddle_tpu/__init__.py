@@ -1,0 +1,114 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas idioms (see /root/repo/SURVEY.md).
+
+The public namespace mirrors ``paddle``:
+
+    import paddle_tpu as paddle
+    x = paddle.to_tensor([[1., 2.], [3., 4.]], stop_gradient=False)
+    y = paddle.matmul(x, x)
+    y.sum().backward()
+    print(x.grad)
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Paddle's default integer dtype is int64 and float64 ops are part of the
+# API surface; enable x64 before any array is created.  Compute-path dtypes
+# (bf16/f32) are always set explicitly, so this does not slow the TPU path.
+import jax as _jax
+_jax.config.update("jax_enable_x64", True)
+
+# flags must exist before anything reads them
+from .flags import get_flags, set_flags, flags  # noqa: F401
+
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (  # noqa: F401
+    dtype, bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, iinfo, finfo,
+    get_default_dtype, set_default_dtype)
+bool = bool_  # paddle.bool
+from .framework.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, CustomPlace, CUDAPinnedPlace,
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_tpu, is_compiled_with_rocm,
+    is_compiled_with_cinn, is_compiled_with_distribute)
+from .framework.random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, get_cuda_rng_state,
+    set_cuda_rng_state)
+
+from .tensor.tensor import Tensor, to_tensor, is_tensor  # noqa: F401
+from .tensor import creation as _creation  # ensure patching runs
+from . import tensor  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+
+from . import autograd  # noqa: F401
+from .autograd import (  # noqa: F401
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad)
+
+# grad-mode helpers paddle exposes at top level
+from .autograd import backward as _autograd_backward  # noqa: F401
+
+# Submodules that mirror paddle.* package structure. Imported lazily where
+# heavy; the common ones eagerly for `paddle.nn.Linear(...)` ergonomics.
+# BOOTSTRAP GUARD: modules still being built are skipped; removed once the
+# package is complete.
+try:
+    from . import nn  # noqa: F401,E402
+    from . import optimizer  # noqa: F401,E402
+    from . import io  # noqa: F401,E402
+    from . import amp  # noqa: F401,E402
+    from . import metric  # noqa: F401,E402
+    from . import device  # noqa: F401,E402
+    from . import jit  # noqa: F401,E402
+    from . import static  # noqa: F401,E402
+    from . import vision  # noqa: F401,E402
+    from . import distributed  # noqa: F401,E402
+    from . import distribution  # noqa: F401,E402
+    from . import incubate  # noqa: F401,E402
+    from . import sparse  # noqa: F401,E402
+    from . import hapi as _hapi  # noqa: F401,E402
+    from .hapi import Model, summary  # noqa: F401,E402
+    from .framework.io import save, load  # noqa: F401,E402
+    from .nn.layer.layers import (  # noqa: F401,E402
+        disable_static, enable_static, in_dynamic_mode)
+except ImportError:  # pragma: no cover - bootstrap only
+    pass
+
+
+def DataParallel(layers, *args, **kwargs):
+    """Mirror of ``paddle.DataParallel`` (reference: parallel.py:202)."""
+    from .distributed.parallel import DataParallel as _DP
+    return _DP(layers, *args, **kwargs)
+
+
+def ParamAttr(name=None, initializer=None, learning_rate=1.0,
+              regularizer=None, trainable=True, do_model_average=True,
+              need_clip=True):
+    from .framework.param import ParamAttr as _PA
+    return _PA(name=name, initializer=initializer,
+               learning_rate=learning_rate, regularizer=regularizer,
+               trainable=trainable, need_clip=need_clip)
+
+
+try:
+    from .framework.param import Parameter  # noqa: F401,E402
+except ImportError:  # pragma: no cover - bootstrap only
+    pass
+
+# paddle.version shim
+class _Version:
+    full_version = __version__
+    major, minor, patch = (int(p) for p in __version__.split("."))
+
+    @staticmethod
+    def show():
+        print(f"paddle_tpu {__version__} (jax backend)")
+
+    @staticmethod
+    def cuda():
+        return "False"
+
+
+version = _Version()

@@ -1,0 +1,406 @@
+"""paddle_tpu.autograd — public autograd API.
+
+Mirrors ``paddle.autograd``: no_grad/enable_grad/set_grad_enabled
+(reference: python/paddle/base/dygraph/base.py), ``paddle.grad``
+(base/dygraph/base.py:595), PyLayer (python/paddle/autograd/py_layer.py:29),
+and functional jacobian/hessian (python/paddle/autograd/autograd.py:450,:544)
+which map directly onto jax.jacrev/jacfwd."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from . import tape
+from .tape import (no_grad_guard as no_grad, enable_grad_guard as
+                   enable_grad, run_backward, grad_enabled,
+                   functional_trace_guard)
+
+__all__ = ["no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+           "grad", "backward", "PyLayer", "PyLayerContext", "jacobian",
+           "hessian", "vjp", "jvp"]
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool) -> None:
+        self._mode = bool(mode)
+        self._prev = tape._state.enabled
+        tape._state.enabled = self._mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        tape._state.enabled = self._prev
+        return False
+
+
+def is_grad_enabled() -> bool:
+    return tape._state.enabled
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False) -> None:
+    """Mirror of ``paddle.autograd.backward``."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors,
+                                                   (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """Mirror of ``paddle.grad`` (base/dygraph/base.py:595).
+
+    Computes grads of ``outputs`` w.r.t. ``inputs`` without polluting other
+    leaves' ``.grad``.  ``create_graph`` (double grad) re-derives each grad
+    node from its recorded pure forward fn so the grad-of-grad chain is
+    itself recorded — see tape.GradNode.fwd_fn.
+    """
+    from ..tensor.tensor import Tensor
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs,
+                                                   (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  retain_graph, allow_unused)
+
+    # stash all reachable leaf grads, run backward, harvest, restore
+    stash = {}
+
+    def collect(t):
+        if id(t) not in stash:
+            stash[id(t)] = (t, t._grad)
+            t._grad = None
+
+    seen_nodes = set()
+    stack = [t._grad_node for t in outputs if t._grad_node is not None]
+    for t in outputs:
+        collect(t)
+    for t in inputs:  # clear stale grads of requested inputs too
+        collect(t)
+    while stack:
+        node = stack.pop()
+        if node is None or node in seen_nodes:
+            continue
+        seen_nodes.add(node)
+        for ref in node.inputs:
+            collect(ref.tensor)
+            if ref.node is not None and ref.node not in seen_nodes:
+                stack.append(ref.node)
+
+    no_grad_set = {id(v) for v in (no_grad_vars or [])}
+    flipped = []
+    for t in inputs:
+        if t.stop_gradient:
+            t.stop_gradient = False
+            flipped.append(t)
+    capture = {id(t) for t in inputs if t._grad_node is not None}
+    try:
+        run_backward(list(outputs), grad_outputs, retain_graph=retain_graph,
+                     capture=capture)
+        results = []
+        for t in inputs:
+            if id(t) in no_grad_set:
+                results.append(None)
+                continue
+            g = t._grad
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"input tensor {t.name} is unreachable from outputs "
+                        "(set allow_unused=True to return None)")
+                results.append(None)
+            else:
+                results.append(t._wrap_like(g))
+        return results
+    finally:
+        for t in flipped:
+            t.stop_gradient = True
+        for tid, (t, old) in stash.items():
+            t._grad = old
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
+                       allow_unused):
+    """Double-grad path: replay each node's pure fwd_fn through the op layer
+    so grad computation is itself recorded on the tape (reference analog:
+    ``GeneralGrad`` + GradNode::Copy, backward.cc:103)."""
+    from ..ops.dispatch import apply
+    from ..tensor.tensor import Tensor, wrap_array
+    from collections import deque
+
+    # Discover reachable graph from outputs.
+    node_out_grads = {}
+    pending = {}
+    visited = set()
+    roots = []
+    for i, t in enumerate(outputs):
+        if t._grad_node is None:
+            continue
+        g = (grad_outputs[i] if grad_outputs and grad_outputs[i] is not None
+             else wrap_array(jnp.ones_like(t._data)))
+        slots = node_out_grads.setdefault(
+            t._grad_node, [None] * len(t._grad_node.out_avals))
+        cur = slots[t._out_idx]
+        slots[t._out_idx] = g if cur is None else cur + g
+        roots.append(t._grad_node)
+    stack = list(node_out_grads)
+    while stack:
+        node = stack.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        pending.setdefault(node, 0)
+        if node.released or node.fwd_fn is None:
+            raise RuntimeError(
+                "create_graph=True requires the graph to be intact; "
+                "first backward must use retain_graph=True")
+        for ref in node.inputs:
+            if ref.node is not None:
+                pending[ref.node] = pending.get(ref.node, 0) + 1
+                if ref.node not in visited:
+                    stack.append(ref.node)
+
+    input_grads = {}  # id(tensor) -> Tensor grad
+    queue = deque(n for n in node_out_grads if pending.get(n, 0) == 0)
+    done = set()
+    while queue:
+        node = queue.popleft()
+        if node in done:
+            continue
+        done.add(node)
+        slots = node_out_grads.pop(node, [None] * len(node.out_avals))
+        cts = [s if s is not None else
+               wrap_array(jnp.zeros(av.shape, av.dtype))
+               for s, av in zip(slots, node.out_avals)]
+        n_in = len(node.inputs)
+        single_out = len(node.out_avals) == 1
+        fwd = node.fwd_fn
+
+        def grad_fn(*args):
+            prim, ct_arrs = args[:n_in], args[n_in:]
+            _, vjp_fn = jax.vjp(fwd, *prim)
+            return vjp_fn(ct_arrs[0] if single_out else tuple(ct_arrs))
+
+        in_tensors = [ref.tensor for ref in node.inputs]
+        grads = apply(f"grad_{node.name}", grad_fn, *in_tensors, *cts,
+                      n_outputs=n_in)
+        if n_in == 1 and not isinstance(grads, tuple):
+            grads = (grads,)
+        for ref, g in zip(node.inputs, grads):
+            if g is None or g._data.dtype == jax.dtypes.float0:
+                if ref.node is not None and ref.node in pending:
+                    pending[ref.node] -= 1
+                    if pending[ref.node] == 0 and ref.node not in done:
+                        queue.append(ref.node)
+                continue
+            tid = id(ref.tensor)
+            if ref.node is None:
+                if not ref.tensor.stop_gradient or any(
+                        ref.tensor is it for it in inputs):
+                    cur = input_grads.get(tid)
+                    input_grads[tid] = g if cur is None else cur + g
+            else:
+                slots_p = node_out_grads.setdefault(
+                    ref.node, [None] * len(ref.node.out_avals))
+                cur = slots_p[ref.idx]
+                slots_p[ref.idx] = g if cur is None else cur + g
+            if ref.node is not None and ref.node in pending:
+                pending[ref.node] -= 1
+                if pending[ref.node] == 0 and ref.node not in done:
+                    queue.append(ref.node)
+        if not retain_graph:
+            pass  # keep graph: create_graph implies reuse
+
+    results = []
+    for t in inputs:
+        g = input_grads.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                f"input tensor {t.name} unreachable from outputs "
+                "(allow_unused=False)")
+        results.append(g)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# PyLayer (reference: python/paddle/autograd/py_layer.py:29)
+# ---------------------------------------------------------------------------
+class PyLayerContext:
+    def __init__(self) -> None:
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors) -> None:
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args) -> None:
+        self.not_inplace_tensors = args
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op: subclass with static forward/backward.
+
+    Equivalent to jax.custom_vjp expressed in Paddle's idiom; the backward
+    runs eagerly at tape-unwind time (it may use any paddle_tpu ops and is
+    itself differentiable when those ops are recorded)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor.tensor import Tensor, wrap_array
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)]
+        with tape.no_grad_guard():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+        need_grad = tape.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if need_grad:
+            out_tensors = tuple(
+                wrap_array(o._data, stop_gradient=True) for o in outs)
+
+            def vjp_fn(cts):
+                if single or not isinstance(cts, tuple):
+                    cts = (cts,)
+                ct_tensors = [wrap_array(c) for c in cts]
+                with tape.no_grad_guard():
+                    gin = cls.backward(ctx, *ct_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                arrs = []
+                gi = iter(gin)
+                for t in tensor_inputs:
+                    g = next(gi, None)
+                    arrs.append(None if g is None else g._data)
+                return tuple(arrs)
+
+            tape.record(cls.__name__, vjp_fn, tensor_inputs, out_tensors)
+            return out_tensors[0] if single else out_tensors
+        return outputs
+
+
+# ---------------------------------------------------------------------------
+# Functional transforms (reference: python/paddle/autograd/autograd.py)
+# ---------------------------------------------------------------------------
+def _functionalize(func):
+    from ..tensor.tensor import Tensor, wrap_array
+
+    def pure(*arrays):
+        with functional_trace_guard():
+            ins = [wrap_array(a) for a in arrays]
+            out = func(*ins)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return pure
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Functional jacobian: accepts (func, xs) like modern paddle when ys is
+    callable, else computes J of ys w.r.t xs via the tape (one backward per
+    output element is avoided by using jax.jacrev on a replayed graph when
+    possible)."""
+    from ..tensor.tensor import Tensor, wrap_array
+
+    if callable(ys):
+        func = ys
+        pure = _functionalize(func)
+        single = not isinstance(xs, (list, tuple))
+        xs_list = [xs] if single else list(xs)
+        jac = jax.jacrev(pure, argnums=tuple(range(len(xs_list))))(
+            *[x._data for x in xs_list])
+        if single:
+            return wrap_array(jac[0])
+        return [wrap_array(j) for j in jac]
+    raise NotImplementedError(
+        "tensor-mode jacobian: pass a callable (paddle.incubate.autograd "
+        "style); tape-mode Jacobian arrives with the static engine")
+
+
+def hessian(func, xs, batch_axis=None):
+    from ..tensor.tensor import wrap_array
+
+    pure = _functionalize(func)
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    hes = jax.hessian(pure, argnums=tuple(range(len(xs_list))))(
+        *[x._data for x in xs_list])
+    if single:
+        return wrap_array(hes[0][0] if isinstance(hes, tuple) else hes)
+    return jax.tree_util.tree_map(wrap_array, hes)
+
+
+def vjp(func, xs, v=None):
+    from ..tensor.tensor import wrap_array
+
+    pure = _functionalize(func)
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    out, vjp_fn = jax.vjp(pure, *[x._data for x in xs_list])
+    if v is None:
+        seed = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        seed = v._data if not isinstance(v, (list, tuple)) else tuple(
+            t._data for t in v)
+    grads = vjp_fn(seed)
+    outs = wrap_array(out) if not isinstance(out, tuple) else [
+        wrap_array(o) for o in out]
+    gs = [wrap_array(g) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    from ..tensor.tensor import wrap_array
+
+    pure = _functionalize(func)
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    primals = [x._data for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(p) for p in primals]
+    else:
+        v_list = [v] if not isinstance(v, (list, tuple)) else list(v)
+        tangents = [t._data for t in v_list]
+    out, tangent_out = jax.jvp(pure, tuple(primals), tuple(tangents))
+    outs = wrap_array(out) if not isinstance(out, tuple) else [
+        wrap_array(o) for o in out]
+    touts = wrap_array(tangent_out) if not isinstance(
+        tangent_out, tuple) else [wrap_array(t) for t in tangent_out]
+    return outs, touts

@@ -1,0 +1,284 @@
+"""Eager reverse-mode autograd engine.
+
+TPU-native equivalent of the reference's eager autograd
+(/root/reference/paddle/fluid/eager/ — ``GradNodeBase`` grad_node_info.h:197,
+``egr::RunBackward`` backward.cc:105, ``TensorWrapper`` tensor_wrapper.h,
+``GradTensorHolder`` accumulation).
+
+Design (functional substrate, forward-once):
+  * Every differentiable op application calls ``jax.vjp(fn, *arrays)`` at
+    forward time.  The returned pullback closure — which owns the residuals,
+    living as device buffers — is stored on a :class:`GradNode`.  Nothing is
+    recomputed at backward time (the reference saves inputs in TensorWrapper
+    and re-dispatches a grad kernel; here XLA already built the pullback).
+  * ``backward()`` mirrors ``RunBackward``: discover the reachable subgraph,
+    count pending consumer contributions per node, then run a ready-queue,
+    calling each node's pullback with accumulated output cotangents and
+    routing input cotangents either to leaf ``.grad`` accumulators or to
+    producer nodes.
+  * Tensor hooks (``Tensor.register_hook``) run on the cotangent as it flows
+    into the tensor, like egr's GradNode hooks.
+  * ``retain_graph`` keeps pullbacks alive (jax vjp closures are re-callable);
+    the default drops them after use to free residual buffers.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "GradNode", "record", "run_backward", "grad_enabled", "no_grad_guard",
+    "enable_grad_guard", "functional_trace_guard", "in_functional_trace",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self) -> None:
+        self.enabled = True          # dygraph grad recording on/off
+        self.functional_trace = 0    # >0: inside to_static/jit capture
+
+
+_state = _GradState()
+
+
+def grad_enabled() -> bool:
+    return _state.enabled and _state.functional_trace == 0
+
+
+def in_functional_trace() -> bool:
+    return _state.functional_trace > 0
+
+
+class no_grad_guard:
+    """Context manager / decorator mirroring ``paddle.no_grad``."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn: Callable) -> Callable:
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad_guard():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad_guard(no_grad_guard):
+    """Mirror of ``paddle.enable_grad``."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+
+class functional_trace_guard:
+    """While active, ops execute without recording tape nodes regardless of
+    ``stop_gradient`` — used when a Layer's forward is being captured into a
+    pure function for whole-graph ``jax.jit``/``jax.grad``."""
+
+    def __enter__(self):
+        _state.functional_trace += 1
+        return self
+
+    def __exit__(self, *exc):
+        _state.functional_trace -= 1
+        return False
+
+
+class _InputRef:
+    """Edge captured at record time (reference: ``Edge`` grad_node_info.h:53).
+
+    Snapshotting ``(producer node, out idx)`` here — instead of reading them
+    off the live tensor at backward time — is what makes in-place ops
+    (``setitem``, ``add_``...) safe: mutating a tensor rebinds its
+    ``_grad_node``, but edges recorded before the mutation keep pointing at
+    the producer of the value they actually consumed.
+    """
+
+    __slots__ = ("tensor", "node", "idx")
+
+    def __init__(self, tensor) -> None:
+        self.tensor = tensor                  # strong ref (= TensorWrapper)
+        self.node = getattr(tensor, "_grad_node", None)
+        self.idx = getattr(tensor, "_out_idx", 0)
+
+
+class GradNode:
+    """One recorded op application (reference: GradNodeBase)."""
+
+    __slots__ = ("name", "vjp_fn", "fwd_fn", "inputs", "out_avals",
+                 "released", "_id", "__weakref__")
+
+    _counter = [0]
+
+    def __init__(self, name: str, vjp_fn: Callable,
+                 inputs: Tuple[_InputRef, ...],
+                 out_avals: List[jax.ShapeDtypeStruct],
+                 fwd_fn: Optional[Callable] = None) -> None:
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.fwd_fn = fwd_fn  # pure fn; enables double-grad re-derivation
+        self.inputs = inputs
+        self.out_avals = out_avals
+        self.released = False
+        GradNode._counter[0] += 1
+        self._id = GradNode._counter[0]
+
+    def release(self) -> None:
+        self.vjp_fn = None
+        self.fwd_fn = None
+        self.inputs = ()
+        self.released = True
+
+    def __repr__(self) -> str:
+        return f"<GradNode {self.name}#{self._id}>"
+
+
+def record(name: str, vjp_fn: Callable, inputs: Sequence[Any],
+           outputs: Sequence[Any], fwd_fn: Optional[Callable] = None) -> None:
+    """Attach a GradNode to ``outputs`` (Tensors)."""
+    node = GradNode(
+        name, vjp_fn, tuple(_InputRef(t) for t in inputs),
+        [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+         for o in outputs], fwd_fn)
+    for i, o in enumerate(outputs):
+        o._grad_node = node
+        o._out_idx = i
+        o.stop_gradient = False
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def run_backward(tensors: Sequence[Any],
+                 grad_tensors: Optional[Sequence[Any]] = None,
+                 retain_graph: bool = False,
+                 capture: Optional[set] = None) -> None:
+    """Reference: ``egr::RunBackward`` (backward.cc:105).
+
+    ``capture``: ids of non-leaf tensors whose flowing cotangent should also
+    be accumulated into ``._grad`` (used by ``paddle.grad`` on intermediate
+    tensors — reference: ``GeneralGrad`` backward.cc:103).
+    """
+    capture = capture or set()
+    tensors = [t for t in tensors if t is not None]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors length mismatch")
+
+    # Seed cotangents.
+    node_out_grads: Dict[GradNode, List[Any]] = {}
+    roots: List[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._data.shape)}")
+            g_arr = jnp.ones_like(t._data)
+        else:
+            g_arr = g._data if hasattr(g, "_data") else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            # Leaf: accumulate directly.
+            if not t.stop_gradient:
+                t._accumulate_grad(g_arr)
+            continue
+        if id(t) in capture:
+            t._accumulate_grad(g_arr)
+        slots = node_out_grads.setdefault(node, [None] * len(node.out_avals))
+        slots[t._out_idx] = _accumulate(slots[t._out_idx], g_arr)
+        roots.append(node)
+
+    if not node_out_grads:
+        return
+
+    # Phase 1: discover reachable subgraph, count consumer contributions.
+    pending: Dict[GradNode, int] = {}
+    visited = set()
+    stack = list(node_out_grads.keys())
+    while stack:
+        node = stack.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        pending.setdefault(node, 0)
+        if node.released:
+            raise RuntimeError(
+                f"trying to backward through {node.name} a second time; "
+                "call backward(retain_graph=True) the first time")
+        for ref in node.inputs:
+            p = ref.node
+            if p is not None:
+                pending[p] = pending.get(p, 0) + 1
+                if p not in visited:
+                    stack.append(p)
+
+    # Phase 2: ready-queue traversal.
+    queue = deque(n for n in node_out_grads if pending.get(n, 0) == 0)
+    done = set()
+    while queue:
+        node = queue.popleft()
+        if node in done:
+            continue
+        done.add(node)
+        slots = node_out_grads.pop(node, None)
+        if slots is None:
+            slots = [None] * len(node.out_avals)
+        cts_out = [
+            s if s is not None else jnp.zeros(av.shape, av.dtype)
+            for s, av in zip(slots, node.out_avals)
+        ]
+        if len(node.out_avals) == 1:
+            in_cts = node.vjp_fn(cts_out[0])
+        else:
+            in_cts = node.vjp_fn(tuple(cts_out))
+        if not isinstance(in_cts, tuple):
+            in_cts = (in_cts,)
+        for ref, ct in zip(node.inputs, in_cts):
+            inp = ref.tensor
+            if ct is not None and not _is_float0(ct):
+                for hook in inp._grad_hooks:
+                    out = hook(inp._wrap_like(ct))
+                    if out is not None:
+                        ct = out._data if hasattr(out, "_data") else out
+                if ref.node is None:
+                    if not inp.stop_gradient:
+                        inp._accumulate_grad(ct)
+                else:
+                    if id(inp) in capture:
+                        inp._accumulate_grad(ct)
+                    slots_p = node_out_grads.setdefault(
+                        ref.node, [None] * len(ref.node.out_avals))
+                    slots_p[ref.idx] = _accumulate(slots_p[ref.idx], ct)
+            # Consumer processed: decrement producer pending count.
+            if ref.node is not None and ref.node in pending:
+                pending[ref.node] -= 1
+                if pending[ref.node] == 0 and ref.node not in done:
+                    queue.append(ref.node)
+        if not retain_graph:
+            node.release()

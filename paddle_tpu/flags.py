@@ -1,0 +1,161 @@
+"""Runtime flag registry.
+
+TPU-native equivalent of the reference's gflags-style registry
+(/root/reference/paddle/common/flags.cc — ``PHI_DEFINE_EXPORTED_*``) and its
+Python surface ``paddle.set_flags/get_flags``
+(/root/reference/python/paddle/base/framework.py:105,:130).
+
+Flags are typed, documented, initialisable from the environment
+(``FLAGS_check_nan_inf=1 python train.py``), and queried by subsystems at
+runtime.  Unlike the reference there is no C++ global state: a single Python
+registry feeds every layer, and XLA-level knobs are forwarded to jax.config.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Union
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flags"]
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in _TRUE:
+        return True
+    if s in _FALSE:
+        return False
+    raise ValueError(f"cannot parse {v!r} as bool")
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    dtype: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+    value: Any = None
+
+    def set(self, v: Any) -> None:
+        if self.dtype is bool:
+            v = _parse_bool(v)
+        else:
+            v = self.dtype(v)
+        self.value = v
+        if self.on_change is not None:
+            self.on_change(v)
+
+
+class _FlagRegistry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name, default, help="", dtype=None,
+               on_change=None) -> None:
+        if dtype is None:
+            dtype = type(default)
+        with self._lock:
+            if name in self._flags:
+                return
+            f = _Flag(name, default, dtype, help, on_change, default)
+            self._flags[name] = f
+        env = os.environ.get(name)
+        if env is not None:
+            try:
+                f.set(env)
+            except (ValueError, TypeError):
+                pass
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].value
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in self._flags:
+            raise ValueError(f"unknown flag {name!r}")
+        self._flags[name].set(value)
+
+    def known(self) -> List[str]:
+        return sorted(self._flags)
+
+
+_registry = _FlagRegistry()
+
+
+def define_flag(name, default, help="", dtype=None, on_change=None):
+    _registry.define(name, default, help, dtype, on_change)
+
+
+def get_flags(flags: Union[str, List[str], None] = None) -> Dict[str, Any]:
+    """Mirror of ``paddle.get_flags``."""
+    if flags is None:
+        names = _registry.known()
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = list(flags)
+    return {n: _registry.get(n) for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Mirror of ``paddle.set_flags``."""
+    for k, v in flags.items():
+        _registry.set(k, v)
+
+
+class _FlagsView:
+    """Attribute access: ``flags.FLAGS_check_nan_inf``."""
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return _registry.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        _registry.set(name, value)
+
+
+flags = _FlagsView()
+
+# ---------------------------------------------------------------------------
+# Core flag definitions (subset of /root/reference/paddle/common/flags.cc
+# that is meaningful on TPU/XLA).
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_check_nan_inf", False,
+            "Sweep every op output for NaN/Inf in eager mode "
+            "(reference: flags.cc:72).")
+define_flag("FLAGS_check_nan_inf_level", 0,
+            "0: raise on NaN/Inf; >0: warn only.")
+define_flag("FLAGS_benchmark", False, "Block until op results are ready.")
+define_flag("FLAGS_eager_op_jit", True,
+            "Compile eager ops with jax.jit (cached) instead of op-by-op "
+            "dispatch.")
+define_flag("FLAGS_use_stride_kernel", True,
+            "Accept and normalise non-contiguous inputs (views are free on "
+            "XLA; flag kept for API parity).")
+define_flag("FLAGS_set_to_1d", False, "Return 1-D tensors for 0-D results "
+            "(legacy behaviour; default off like modern Paddle).")
+define_flag("FLAGS_comm_timeout_s", 600,
+            "Collective watchdog timeout in seconds "
+            "(reference: comm_task_manager.h:37).")
+define_flag("FLAGS_allocator_strategy", "xla",
+            "Kept for parity; allocation is delegated to PjRt/XLA.")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "Parity alias: XLA deterministic reductions.")
+define_flag("FLAGS_embedding_deterministic", 0, "Parity alias.")
+define_flag("FLAGS_low_precision_op_list", 0,
+            "Collect per-op AMP statistics (paddle.amp.debugging).")
+define_flag("FLAGS_pallas_flash_attention", True,
+            "Use the Pallas flash-attention kernel when applicable.")
+define_flag("FLAGS_pallas_interpret", False,
+            "Run Pallas kernels in interpret mode (CPU testing).")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity for paddle_tpu.")

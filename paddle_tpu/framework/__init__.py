@@ -1,0 +1,9 @@
+from . import dtype, place, random
+# NOTE: no `from .dtype import *` — it would shadow the `dtype` submodule
+# with the `dtype` class alias.
+from .dtype import (  # noqa: F401
+    DType, convert_dtype, to_jax_dtype, bool_, uint8, int8, int16, int32,
+    int64, float16, bfloat16, float32, float64, complex64, complex128,
+    get_default_dtype, set_default_dtype, iinfo, finfo)
+from .place import *  # noqa: F401,F403
+from .random import seed, get_rng_state, set_rng_state, Generator  # noqa: F401

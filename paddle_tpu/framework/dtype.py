@@ -1,0 +1,168 @@
+"""Dtype model.
+
+Mirrors the reference's ``phi::DataType``
+(/root/reference/paddle/phi/core/tensor_meta.h, common/data_type.h) as a thin
+veneer over numpy/jax dtypes.  ``paddle_tpu.float32`` etc. are singleton
+``DType`` objects accepted anywhere a dtype is; they compare equal to their
+string names and numpy/jnp equivalents so user code written for either
+convention works.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DType", "dtype", "convert_dtype", "to_jax_dtype",
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+    "complex64", "complex128",
+    "get_default_dtype", "set_default_dtype", "iinfo", "finfo",
+]
+
+
+class DType:
+    """A framework dtype: hashable, comparable to strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype) -> None:
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self) -> str:
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self) -> str:
+        return self.name
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or self.name == other.replace(
+                "paddle.", "").replace("paddle_tpu.", "")
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("bool", "uint8", "int8", "int16", "int32",
+                             "int64")
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+
+dtype = DType  # paddle.dtype alias
+
+
+def convert_dtype(d: Any) -> Optional[DType]:
+    """Normalise any dtype spec (DType, str, np/jnp dtype) to a DType."""
+    if d is None:
+        return None
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        key = d.replace("paddle.", "").replace("paddle_tpu.", "")
+        if key in _BY_NAME:
+            return _BY_NAME[key]
+    npd = np.dtype(d) if not hasattr(d, "dtype") else np.dtype(d.dtype)
+    name = npd.name
+    if name == "bool":
+        return bool_
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    # bfloat16 arrives as a void/custom numpy dtype from ml_dtypes
+    if "bfloat16" in str(npd):
+        return bfloat16
+    raise TypeError(f"unsupported dtype: {d!r}")
+
+
+def to_jax_dtype(d: Any):
+    dt = convert_dtype(d)
+    if dt is None:
+        return None
+    if dt is bfloat16:
+        return jnp.bfloat16
+    return dt.np_dtype
+
+
+_default_dtype = float32
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def set_default_dtype(d: Any) -> None:
+    global _default_dtype
+    dt = convert_dtype(d)
+    if not dt.is_floating_point:
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = dt
+
+
+def default_float_dtype() -> DType:
+    return _default_dtype
+
+
+class iinfo:
+    def __init__(self, d):
+        info = np.iinfo(convert_dtype(d).np_dtype)
+        self.min, self.max, self.bits = int(info.min), int(info.max), info.bits
+        self.dtype = str(convert_dtype(d))
+
+
+class finfo:
+    def __init__(self, d):
+        dt = convert_dtype(d)
+        info = jnp.finfo(to_jax_dtype(dt))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(getattr(info, "resolution", info.eps))
+        self.bits = info.bits
+        self.dtype = str(dt)

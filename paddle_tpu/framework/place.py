@@ -1,0 +1,210 @@
+"""Device / place model.
+
+TPU-native equivalent of the reference's ``Place`` hierarchy
+(/root/reference/paddle/phi/common/place.h — CPUPlace/GPUPlace/XPUPlace/
+CustomPlace) and ``paddle.device.set_device``
+(/root/reference/python/paddle/device/__init__.py:265).
+
+A ``Place`` names a jax device.  ``TPUPlace(i)`` is first-class (the
+north-star backend); ``CPUPlace`` maps to jax CPU devices; ``CustomPlace``
+covers any other jax platform (e.g. the 'axon' tunnel platform exposes TPU
+chips and is treated as TPU).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Union
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "XPUPlace", "CustomPlace",
+    "CUDAPinnedPlace", "set_device", "get_device", "get_all_devices",
+    "device_count", "is_compiled_with_cuda", "is_compiled_with_xpu",
+    "is_compiled_with_tpu", "is_compiled_with_rocm",
+    "is_compiled_with_cinn", "is_compiled_with_distribute",
+]
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+class Place:
+    """Base place: (device_type, device_id)."""
+
+    device_type = "undefined"
+
+    def __init__(self, device_id: int = 0) -> None:
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __repr__(self) -> str:
+        return f"Place({self.device_type}:{self._device_id})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self._device_id == other._device_id)
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self._device_id))
+
+    # -- jax mapping --------------------------------------------------------
+    def jax_device(self) -> Optional[jax.Device]:
+        devs = self._platform_devices()
+        if not devs:
+            return None
+        return devs[min(self._device_id, len(devs) - 1)]
+
+    def _platform_devices(self):
+        if self.device_type == "cpu":
+            try:
+                return jax.devices("cpu")
+            except RuntimeError:
+                return []
+        for plat in _TPU_PLATFORMS if self.device_type == "tpu" else (
+                self.device_type,):
+            try:
+                return jax.devices(plat)
+            except RuntimeError:
+                continue
+        return []
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self) -> None:
+        super().__init__(0)
+
+    def __repr__(self) -> str:
+        return "Place(cpu)"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CUDAPlace(Place):
+    """Accepted for API parity; resolves to the default accelerator."""
+    device_type = "gpu"
+
+    def jax_device(self):
+        for plat in ("gpu",) + _TPU_PLATFORMS:
+            try:
+                return jax.devices(plat)[self._device_id]
+            except (RuntimeError, IndexError):
+                continue
+        return None
+
+
+class XPUPlace(CUDAPlace):
+    device_type = "xpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str, device_id: int = 0) -> None:
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+_lock = threading.Lock()
+_current_place: Optional[Place] = None
+
+
+def _default_place() -> Place:
+    d = jax.devices()[0]
+    if d.platform in _TPU_PLATFORMS:
+        return TPUPlace(0)
+    if d.platform == "cpu":
+        return CPUPlace()
+    return CustomPlace(d.platform, 0)
+
+
+def _parse_device(device: Union[str, Place]) -> Place:
+    if isinstance(device, Place):
+        return device
+    s = str(device).lower()
+    idx = 0
+    if ":" in s:
+        s, i = s.split(":", 1)
+        idx = int(i)
+    if s == "cpu":
+        return CPUPlace()
+    if s in ("tpu",) + _TPU_PLATFORMS:
+        return TPUPlace(idx)
+    if s in ("gpu", "cuda"):
+        return CUDAPlace(idx)
+    if s == "xpu":
+        return XPUPlace(idx)
+    return CustomPlace(s, idx)
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """Mirror of ``paddle.device.set_device``."""
+    global _current_place
+    place = _parse_device(device)
+    with _lock:
+        _current_place = place
+    return place
+
+
+def get_device() -> str:
+    p = _get_current_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"{p.device_type}:{p.get_device_id()}"
+
+
+def _get_current_place() -> Place:
+    global _current_place
+    with _lock:
+        if _current_place is None:
+            _current_place = _default_place()
+        return _current_place
+
+
+def current_jax_device() -> Optional[jax.Device]:
+    return _get_current_place().jax_device()
+
+
+def get_all_devices():
+    return [f"{d.platform}:{i}" for i, d in enumerate(jax.devices())]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(d.platform in _TPU_PLATFORMS for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role and is always present.
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True

@@ -1,0 +1,78 @@
+"""Global RNG state.
+
+TPU-native equivalent of the reference's per-device ``Generator``
+(/root/reference/paddle/phi/core/generator.h) and ``paddle.seed``.  jax PRNG
+is functional, so the framework keeps one splittable key chain per named
+generator; every sampling op pulls a fresh subkey.  The fleet RNG tracker
+(reference: fleet/layers/mpu/random.py:34 ``RNGStatesTracker``) builds on
+these named states for tensor-parallel-consistent dropout.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key",
+           "Generator", "default_generator", "get_cuda_rng_state",
+           "set_cuda_rng_state"]
+
+
+class Generator:
+    """A splittable PRNG key chain."""
+
+    def __init__(self, seed_val: int = 0) -> None:
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(seed_val)
+        self._seed = seed_val
+
+    def manual_seed(self, seed_val: int) -> "Generator":
+        with self._lock:
+            self._key = jax.random.PRNGKey(int(seed_val))
+            self._seed = int(seed_val)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return np.asarray(self._key)
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._key = jax.numpy.asarray(np.asarray(state))
+
+
+default_generator = Generator(np.random.SeedSequence().entropy % (2 ** 31))
+
+
+def seed(value: int) -> Generator:
+    """Mirror of ``paddle.seed``: reseed the default generator."""
+    np.random.seed(int(value) % (2 ** 32))
+    return default_generator.manual_seed(int(value))
+
+
+def next_key():
+    return default_generator.next_key()
+
+
+def get_rng_state(device=None):
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state, device=None) -> None:
+    if isinstance(state, (list, tuple)):
+        state = state[0]
+    default_generator.set_state(state)
+
+
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state

@@ -1,0 +1,2 @@
+from .dispatch import (apply, as_tensor, unwrap, register_op_impl,
+                       get_op_impl)

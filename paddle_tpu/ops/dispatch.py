@@ -1,0 +1,120 @@
+"""Op application: the eager dispatch path.
+
+TPU-native equivalent of the reference's generated ad_func + PHI API chain
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:1863 → api_base.py:1300 → KernelFactory::SelectKernelOrThrowError
+kernel_factory.h:326).
+
+Where Paddle generates per-op C++ that (a) dispatches a kernel and (b)
+records a GradNode, here every op is a pure jax function and :func:`apply`
+does both jobs generically:
+
+  * no grad needed  → call the function (XLA eager dispatch, cached per
+    shape/dtype by jax itself);
+  * grad needed     → ``jax.vjp`` builds forward value + pullback in one
+    traced pass; the pullback is recorded on the tape.
+
+The "kernel registry" analog is :data:`_op_table`: ops may be re-bound to a
+faster implementation (e.g. a Pallas kernel) keyed by name — the moral
+equivalent of ``PD_REGISTER_KERNEL`` with backend selection left to us
+rather than to KernelKey matching, since XLA owns codegen.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape
+from ..flags import flags
+from ..framework import dtype as dtypes
+from ..tensor.tensor import Tensor, wrap_array
+
+__all__ = ["apply", "as_tensor", "unwrap", "register_op_impl", "get_op_impl",
+           "OpError"]
+
+
+class OpError(ValueError):
+    pass
+
+
+# -- op implementation table (Pallas/custom overrides) -----------------------
+_op_table: Dict[str, Callable] = {}
+
+
+def register_op_impl(name: str, fn: Callable) -> None:
+    _op_table[name] = fn
+
+
+def get_op_impl(name: str, default: Callable) -> Callable:
+    return _op_table.get(name, default)
+
+
+def as_tensor(x: Any, dtype=None) -> Tensor:
+    """Coerce op operand to Tensor (scalars become weak-typed arrays)."""
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, jax.Array):
+        return wrap_array(x)
+    if isinstance(x, (bool, int, float)):
+        if dtype is not None:
+            jdt = dtypes.to_jax_dtype(dtype)
+        elif isinstance(x, bool):
+            jdt = np.bool_
+        elif isinstance(x, int):
+            jdt = np.int64
+        else:
+            jdt = dtypes.to_jax_dtype(dtypes.default_float_dtype())
+        return wrap_array(jnp.asarray(x, dtype=jdt))
+    if isinstance(x, np.ndarray) and x.dtype == np.float64:
+        x = x.astype(np.float32)
+    return wrap_array(jnp.asarray(x))
+
+
+def unwrap(x: Any):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _check_nan_inf(name: str, arrays) -> None:
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            if not bool(jnp.isfinite(a).all()):
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if flags.FLAGS_check_nan_inf_level > 0:
+                    import warnings
+                    warnings.warn(msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+def apply(name: str, jfn: Callable, *inputs: Tensor,
+          n_outputs: int = 1) -> Union[Tensor, tuple]:
+    """Apply a pure jax function to Tensor inputs with autograd recording.
+
+    ``jfn`` takes raw jax arrays (same arity as ``inputs``) and returns one
+    array or a tuple of ``n_outputs`` arrays.  Static attributes must be
+    closed over by the caller.
+    """
+    arrays = tuple(t._data for t in inputs)
+    need_grad = tape.grad_enabled() and any(
+        not t.stop_gradient for t in inputs)
+    if need_grad:
+        outs, vjp_fn = jax.vjp(jfn, *arrays)
+    else:
+        outs = jfn(*arrays)
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+    if flags.FLAGS_check_nan_inf and not tape.in_functional_trace():
+        _check_nan_inf(name, outs_t)
+    out_tensors = tuple(wrap_array(o, stop_gradient=True) for o in outs_t)
+    if need_grad:
+        tape.record(name, vjp_fn, inputs, out_tensors, fwd_fn=jfn)
+    if flags.FLAGS_benchmark and not tape.in_functional_trace():
+        for o in outs_t:
+            if hasattr(o, "block_until_ready"):
+                o.block_until_ready()
+    return out_tensors[0] if single else out_tensors

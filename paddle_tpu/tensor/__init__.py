@@ -1,0 +1,109 @@
+"""paddle_tpu.tensor — op surface + Tensor method patching.
+
+Mirrors python/paddle/tensor/__init__.py, which attaches the op functions as
+Tensor methods (reference: tensor_patch_methods.py monkey-patching)."""
+
+from __future__ import annotations
+
+from .tensor import Tensor, to_tensor, is_tensor, wrap_array
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation
+from . import math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+
+def _patch_tensor_methods() -> None:
+    """Attach op functions + dunders to Tensor (reference:
+    python/paddle/base/dygraph/tensor_patch_methods.py)."""
+    mods = [math, manipulation, linalg, logic, search, stat, creation,
+            random]
+    skip = {"to_tensor", "wrap_array", "is_tensor", "meshgrid",
+            "broadcast_tensors", "add_n", "concat", "stack", "hstack",
+            "vstack", "dstack", "column_stack", "row_stack", "einsum",
+            "multi_dot", "pad_sequences", "zeros", "ones", "full", "empty",
+            "arange", "linspace", "logspace", "eye", "tril_indices",
+            "triu_indices", "rand", "randn", "randint", "randperm",
+            "uniform", "normal", "standard_normal", "create_parameter",
+            "assign", "scatter_nd", "broadcast_shape",
+            }
+    for mod in mods:
+        for name in getattr(mod, "__all__", []):
+            if name in skip:
+                continue
+            fn = getattr(mod, name, None)
+            if fn is None or not callable(fn):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # einsum-style and property-like extras
+    Tensor.astype = manipulation.astype
+    Tensor.cast = manipulation.cast
+    Tensor.reshape = manipulation.reshape
+    Tensor.clone = creation.clone
+    Tensor.tolist = manipulation.tolist
+    Tensor.fill_ = manipulation.fill_
+    Tensor.zero_ = manipulation.zero_
+    Tensor.uniform_ = random.uniform_
+    Tensor.normal_ = random.normal_
+    Tensor.exponential_ = random.exponential_
+    Tensor.bernoulli_ = random.bernoulli_
+
+    # dunders
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__hash__ = object.__hash__
+    Tensor.__invert__ = lambda s: math.logical_not(s) \
+        if s.dtype == "bool" else math.bitwise_not(s)
+    Tensor.__and__ = lambda s, o: (
+        math.logical_and(s, o) if s.dtype == "bool"
+        else math.bitwise_and(s, o))
+    Tensor.__or__ = lambda s, o: (
+        math.logical_or(s, o) if s.dtype == "bool"
+        else math.bitwise_or(s, o))
+    Tensor.__xor__ = lambda s, o: (
+        math.logical_xor(s, o) if s.dtype == "bool"
+        else math.bitwise_xor(s, o))
+    Tensor.__lshift__ = lambda s, o: math.left_shift(s, o)
+    Tensor.__rshift__ = lambda s, o: math.right_shift(s, o)
+    Tensor.__getitem__ = manipulation.getitem
+    Tensor.__setitem__ = manipulation.setitem
+    Tensor.T = property(lambda s: manipulation.transpose(s))
+    Tensor.mT = property(lambda s: manipulation.swapaxes(s, -1, -2))
+    Tensor.dim = lambda s: s.ndim
+    Tensor.ndimension = lambda s: s.ndim
+    Tensor.element_size = lambda s: s.dtype.itemsize
+    Tensor.nelement = lambda s: s.size
+    # "private" helpers paddle users lean on
+    Tensor._to = Tensor.to
+
+
+_patch_tensor_methods()

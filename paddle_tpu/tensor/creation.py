@@ -1,0 +1,256 @@
+"""Tensor creation ops (mirror of python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from ..framework import dtype as dtypes
+from ..framework import place as places
+from .tensor import Tensor, wrap_array, to_tensor  # noqa: F401 re-export
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "tril", "triu", "tril_indices",
+    "triu_indices", "meshgrid", "assign", "clone", "numel",
+    "complex", "polar", "as_tensor_", "diag_embed", "vander",
+    "create_parameter", "ones_like_", "cauchy_",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or dtypes.default_float_dtype()
+    return dtypes.to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return wrap_array(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return wrap_array(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = dtypes.default_float_dtype()  # paddle full defaults float
+        else:
+            dtype = dtypes.default_float_dtype()
+    return wrap_array(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return wrap_array(jnp.zeros_like(x._data, dtype=jdt))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return wrap_array(jnp.ones_like(x._data, dtype=jdt))
+
+
+ones_like_ = ones_like
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return wrap_array(jnp.full_like(x._data, fill_value, dtype=jdt))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = dtypes.default_float_dtype()
+        else:
+            dtype = dtypes.int64
+    return wrap_array(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item()) if isinstance(num, Tensor) else int(num)
+    return wrap_array(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item()) if isinstance(num, Tensor) else int(num)
+    return wrap_array(jnp.logspace(start, stop, num, base=base,
+                                   dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    ncols = int(num_columns) if num_columns is not None else None
+    return wrap_array(jnp.eye(int(num_rows), ncols, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = as_tensor(x)
+
+    def fn(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(a, k=offset)
+
+    return apply("diag", fn, x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset),
+                 as_tensor(x))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1) -> Tensor:
+    x = as_tensor(input)
+
+    def fn(a):
+        n = a.shape[-1]
+        m = n + (offset if offset > 0 else -offset)
+        out = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        idx = jnp.arange(n)
+        r = idx + (-offset if offset < 0 else 0)
+        c = idx + (offset if offset > 0 else 0)
+        out = out.at[..., r, c].set(a)
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+            out = jnp.moveaxis(out, (out.ndim - 2, out.ndim - 1), (d1, d2))
+        return out
+
+    return apply("diag_embed", fn, x)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), as_tensor(x))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), as_tensor(x))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return wrap_array(jnp.asarray(np.stack([r, c]),
+                                  dtype=dtypes.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return wrap_array(jnp.asarray(np.stack([r, c]),
+                                  dtype=dtypes.to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    ts = [as_tensor(a) for a in args]
+    outs = apply("meshgrid",
+                 lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")),
+                 *ts, n_outputs=len(ts))
+    return list(outs)
+
+
+def assign(x, output=None) -> Tensor:
+    src = as_tensor(x) if not isinstance(x, (np.ndarray, list, tuple, int,
+                                             float)) else as_tensor(
+        np.asarray(x))
+    out = apply("assign", jnp.asarray, src)
+    if output is not None:
+        output._inplace_assign(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return apply("clone", lambda a: a, as_tensor(x))
+
+
+def numel(x, name=None) -> Tensor:
+    return wrap_array(jnp.asarray(as_tensor(x)._data.size, dtype=jnp.int64))
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply("complex", jax.lax.complex, as_tensor(real), as_tensor(imag))
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    return apply("polar",
+                 lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                              r * jnp.sin(t)),
+                 as_tensor(abs), as_tensor(angle))
+
+
+def vander(x, n=None, increasing=False, name=None) -> Tensor:
+    return apply("vander",
+                 lambda a: jnp.vander(a, N=n, increasing=increasing),
+                 as_tensor(x))
+
+
+def as_tensor_(data, dtype=None, place=None):
+    return to_tensor(data, dtype=dtype, place=place)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    from . import random as rnd
+    u = rnd.uniform(x.shape, min=0.0, max=1.0, dtype=str(x.dtype))
+    vals = loc + scale * jnp.tan(np.pi * (u._data - 0.5))
+    x._data = vals.astype(x._data.dtype)
+    return x
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn.initializer import _apply_initializer
+    from ..framework.param import Parameter
+    data = _apply_initializer(default_initializer, shape, dtype,
+                              is_bias=is_bias)
+    return Parameter(data, dtype=dtype, name=name)

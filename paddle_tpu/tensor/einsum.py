@@ -1,0 +1,16 @@
+"""Einsum (mirror of python/paddle/tensor/einsum.py) — delegates to XLA's
+native einsum which maps contractions onto the MXU."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply, as_tensor
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    ts = [as_tensor(o) for o in operands]
+    eq = str(equation)
+    return apply("einsum", lambda *arrs: jnp.einsum(eq, *arrs), *ts)

@@ -1,0 +1,369 @@
+"""Linear algebra ops (mirror of python/paddle/tensor/linalg.py:177 matmul
+and the `paddle.linalg` namespace).  All lower onto XLA — matmuls hit the
+MXU directly; decompositions use jax.lax.linalg."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from .tensor import Tensor, wrap_array
+
+__all__ = [
+    "matmul", "dot", "bmm", "mv", "norm", "vector_norm", "matrix_norm",
+    "dist", "cross", "cholesky", "cholesky_solve", "inv", "inverse", "det",
+    "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh", "solve",
+    "triangular_solve", "lstsq", "pinv", "matrix_power", "matrix_rank",
+    "cond", "lu", "lu_unpack", "corrcoef", "cov", "householder_product",
+    "multi_dot", "svd_lowrank", "pca_lowrank",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Reference: python/paddle/tensor/linalg.py:177 → _C_ops.matmul.
+
+    On TPU this is the MXU hot path — keep operands batched and bf16 where
+    possible; XLA chooses the tiling.
+    """
+    tx, ty = bool(transpose_x), bool(transpose_y)
+
+    def fn(a, b):
+        if tx:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if ty:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", fn, as_tensor(x), as_tensor(y))
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply("dot", fn, as_tensor(x), as_tensor(y))
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, as_tensor(x), as_tensor(y))
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, as_tensor(x), as_tensor(vec))
+
+
+def multi_dot(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return apply("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *ts)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def fn(a):
+        if ax is None:
+            flat = a.reshape(-1)
+            if p == "fro" or p == 2:
+                r = jnp.linalg.norm(flat)
+            elif p == float("inf"):
+                r = jnp.max(jnp.abs(flat))
+            elif p == float("-inf"):
+                r = jnp.min(jnp.abs(flat))
+            elif p == 0:
+                r = jnp.sum(flat != 0).astype(a.dtype)
+            else:
+                r = jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+            if keepdim:
+                r = r.reshape((1,) * a.ndim)
+            return r
+        is_matrix = isinstance(ax, tuple) and len(ax) == 2
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax,
+                                    keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if is_matrix:
+            # induced matrix norms (jnp.linalg.norm semantics)
+            return jnp.linalg.norm(jnp.moveaxis(a, ax, (-2, -1)), ord=p,
+                                   axis=(-2, -1), keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0), axis=ax, keepdims=keepdim).astype(
+                a.dtype)
+        if p == 1:
+            return jnp.sum(jnp.abs(a), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (
+            1.0 / p)
+
+    return apply("norm", fn, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=list(axis), keepdim=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply("dist", fn, as_tensor(x), as_tensor(y))
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    ax = axis
+    if ax == 9:  # paddle default: first axis with dim 3
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return apply("cross", lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply("cholesky", fn, as_tensor(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply("cholesky_solve", fn, as_tensor(x), as_tensor(y))
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, as_tensor(x))
+
+
+inv = inverse
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, as_tensor(x))
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+    return apply("slogdet", fn, as_tensor(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) — VH is the conjugate transpose of V, matching the
+    reference contract (python/paddle/tensor/linalg.py:2504)."""
+    x = as_tensor(x)
+
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, vh
+
+    return apply("svd", fn, x, n_outputs=3)
+
+
+def qr(x, mode="reduced", name=None):
+    x = as_tensor(x)
+    if mode == "r":
+        return apply("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), x)
+
+    def fn(a):
+        q, r = jnp.linalg.qr(a, mode=mode)
+        return q, r
+
+    return apply("qr", fn, x, n_outputs=2)
+
+
+def eig(x, name=None):
+    # general eig: CPU-only in jax; host round-trip
+    arr = np.asarray(as_tensor(x)._data)
+    w, v = np.linalg.eig(arr)
+    return wrap_array(jnp.asarray(w)), wrap_array(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(as_tensor(x)._data)
+    return wrap_array(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        w, v = jnp.linalg.eigh(a, symmetrize_input=True)
+        return w, v
+
+    return apply("eigh", fn, x, n_outputs=2)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", jnp.linalg.eigvalsh, as_tensor(x))
+
+
+def solve(x, y, name=None):
+    def fn(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return apply("solve", fn, as_tensor(x), as_tensor(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply("triangular_solve", fn, as_tensor(x), as_tensor(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_.astype(jnp.int32), sv
+
+    return apply("lstsq", fn, x, y, n_outputs=4)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv",
+                 lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                           hermitian=hermitian),
+                 as_tensor(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power",
+                 lambda a: jnp.linalg.matrix_power(a, n), as_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def fn(a):
+        return jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64)
+    return apply("matrix_rank", fn, as_tensor(x))
+
+
+def cond(x, p=None, name=None):
+    def fn(a):
+        return jnp.linalg.cond(a, p=p)
+    return apply("cond", fn, as_tensor(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_t, piv_t = apply("lu", fn, x, n_outputs=2)
+    if get_infos:
+        info = wrap_array(jnp.zeros(x.shape[:-2] or (1,), jnp.int32))
+        return lu_t, piv_t, info
+    return lu_t, piv_t
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        piv0 = piv.astype(jnp.int32) - 1
+        perm = jnp.arange(m, dtype=jnp.int32)
+
+        def body(i, pm):
+            j = piv0[i]
+            pi, pj = pm[i], pm[j]
+            pm = pm.at[i].set(pj)
+            pm = pm.at[j].set(pi)
+            return pm
+
+        perm = jax.lax.fori_loop(0, piv0.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+        return P, L, U
+
+    return apply("lu_unpack", fn, x, y, n_outputs=3)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef",
+                 lambda a: jnp.corrcoef(a, rowvar=rowvar), as_tensor(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = as_tensor(x)
+    kw = dict(rowvar=rowvar, bias=not ddof)
+    if fweights is not None:
+        return apply("cov", lambda a, f: jnp.cov(a, fweights=f, **kw),
+                     x, as_tensor(fweights))
+    if aweights is not None:
+        return apply("cov", lambda a, w: jnp.cov(a, aweights=w, **kw),
+                     x, as_tensor(aweights))
+    return apply("cov", lambda a: jnp.cov(a, **kw), x)
+
+
+def householder_product(x, tau, name=None):
+    x, tau = as_tensor(x), as_tensor(tau)
+
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, 0.0))
+            col = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
+            v = v + col
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i][..., None, None] * (
+                v[..., :, None] * v[..., None, :])
+            return q @ h
+
+        q = jax.lax.fori_loop(0, n, body, q)
+        return q[..., :, :n]
+
+    return apply("householder_product", fn, x, tau)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, v = svd(x)
+    from .manipulation import getitem
+    import builtins
+    qq = builtins.min(q, s.shape[-1])
+    return (getitem(u, (Ellipsis, builtins.slice(None, qq))),
+            getitem(s, (Ellipsis, builtins.slice(None, qq))),
+            getitem(v, (Ellipsis, builtins.slice(None, qq))))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = as_tensor(x)
+    import builtins
+    if q is None:
+        q = builtins.min(6, *x.shape[-2:])
+    if center:
+        from .math import mean, subtract
+        x = subtract(x, mean(x, axis=-2, keepdim=True))
+    return svd_lowrank(x, q=q, niter=niter)

@@ -1,0 +1,90 @@
+"""Comparison / logic ops (mirror of python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from .tensor import Tensor, wrap_array
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "allclose", "isclose", "is_empty",
+    "where", "where_", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "is_tensor",
+]
+
+
+def _cmp(name, jfn):
+    def op(x, y, name=None):
+        if isinstance(x, Tensor) and isinstance(y, (bool, int, float)):
+            yv = y
+            return apply(op.__name__, lambda a: jfn(a, yv), x)
+        if isinstance(y, Tensor) and isinstance(x, (bool, int, float)):
+            xv = x
+            return apply(op.__name__, lambda b: jfn(xv, b), y)
+        return apply(op.__name__, jfn, as_tensor(x), as_tensor(y))
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all",
+                 lambda a, b: jnp.array_equal(a, b),
+                 as_tensor(x), as_tensor(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose",
+                 lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 as_tensor(x), as_tensor(y))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose",
+                 lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 as_tensor(x), as_tensor(y))
+
+
+def is_empty(x, name=None):
+    return wrap_array(jnp.asarray(as_tensor(x)._data.size == 0))
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = as_tensor(condition)
+    if x is None and y is None:
+        # paddle.where(cond) == paddle.nonzero(cond, as_tuple=True)
+        from .search import nonzero
+        return nonzero(cond, as_tuple=True)
+    if isinstance(x, (int, float)) and isinstance(y, Tensor):
+        xv = x
+        return apply("where", lambda c, b: jnp.where(c.astype(bool), xv, b),
+                     cond, y)
+    if isinstance(y, (int, float)) and isinstance(x, Tensor):
+        yv = y
+        return apply("where", lambda c, a: jnp.where(c.astype(bool), a, yv),
+                     cond, x)
+    return apply("where",
+                 lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                 cond, as_tensor(x), as_tensor(y))
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    return x._inplace_assign(out)
+
+
+# re-exported from math for paddle namespace parity
+from .math import logical_and, logical_or, logical_not, logical_xor  # noqa
+from .tensor import is_tensor  # noqa

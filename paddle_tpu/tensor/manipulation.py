@@ -1,0 +1,818 @@
+"""Shape/layout manipulation ops (mirror of python/paddle/tensor/
+manipulation.py).  Views are free on XLA; the reference's stride kernels
+(paddle/phi/kernels/stride/) have no TPU analog — every "view" is a lazy
+XLA reshape/slice that fuses away."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from ..framework import dtype as dtypes
+from .tensor import Tensor, wrap_array
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "flatten_", "transpose", "permute",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
+    "split", "tensor_split", "vsplit", "hsplit", "dsplit", "chunk",
+    "unstack", "unbind", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "broadcast_shape", "gather", "gather_nd",
+    "scatter", "scatter_", "scatter_nd", "scatter_nd_add", "index_select",
+    "index_sample", "index_add", "index_put", "masked_select", "masked_fill",
+    "masked_scatter", "roll", "flip", "rot90", "unique",
+    "unique_consecutive", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "slice", "strided_slice", "moveaxis", "swapaxes",
+    "as_real", "as_complex", "cast", "cast_", "astype", "crop",
+    "fill_diagonal_", "fill_", "zero_", "flip_", "t", "tolist",
+    "atleast_1d", "atleast_2d", "atleast_3d", "view", "view_as",
+    "as_strided", "tensordot", "rank", "shard_index", "getitem", "setitem",
+    "select_scatter", "slice_scatter", "column_stack", "row_stack",
+    "hstack", "vstack", "dstack", "pad_sequences",
+]
+
+
+def _axes(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a.item()) if isinstance(a, Tensor) else int(a)
+                     for a in axis)
+    return int(axis)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.tolist()]
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+def reshape(x, shape, name=None):
+    x = as_tensor(x)
+    sh = tuple(_shape_list(shape))
+    return apply("reshape", lambda a: jnp.reshape(a, sh), x)
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_assign(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return astype(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = as_tensor(x)
+    sh, st = tuple(shape), tuple(stride)
+
+    def fn(a):
+        flat = a.reshape(-1)
+        idx = np.zeros(sh, dtype=np.int64) + offset
+        for d, (s, k) in enumerate(zip(sh, st)):
+            ix = np.arange(s) * k
+            idx += ix.reshape([-1 if i == d else 1 for i in range(len(sh))])
+        return flat[jnp.asarray(idx)]
+
+    return apply("as_strided", fn, x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    sa = start_axis % nd
+    so = stop_axis % nd
+
+    def fn(a):
+        shape = a.shape[:sa] + (-1,) + a.shape[so + 1:]
+        return a.reshape(shape)
+
+    return apply("flatten", fn, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_assign(flatten(x, start_axis, stop_axis))
+
+
+def transpose(x, perm=None, name=None):
+    x = as_tensor(x)
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    p = tuple(_shape_list(perm))
+    return apply("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+permute = transpose
+
+
+def t(x, name=None):
+    x = as_tensor(x)
+    if x.ndim < 2:
+        return apply("t", lambda a: a, x)
+    if x.ndim != 2:
+        raise ValueError("paddle.t only supports 0/1/2-D tensors")
+    return apply("t", jnp.transpose, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    s, d = _axes(source), _axes(destination)
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, s, d), as_tensor(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply("swapaxes",
+                 lambda a: jnp.swapaxes(a, int(axis1), int(axis2)),
+                 as_tensor(x))
+
+
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+    if axis is None:
+        return apply("squeeze", jnp.squeeze, x)
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    ax = tuple(a for a in ax if x.shape[a] == 1)
+    if not ax:
+        return apply("squeeze", lambda a: a, x)
+    return apply("squeeze", lambda a: jnp.squeeze(a, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_assign(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return apply("unsqueeze", lambda a: jnp.expand_dims(a, ax), as_tensor(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_assign(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+    return apply("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    ax = int(axis)
+    return apply("stack", lambda *arrs: jnp.stack(arrs, axis=ax), *ts)
+
+
+def hstack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return apply("hstack", lambda *arrs: jnp.hstack(arrs), *ts)
+
+
+def vstack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return apply("vstack", lambda *arrs: jnp.vstack(arrs), *ts)
+
+
+def dstack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return apply("dstack", lambda *arrs: jnp.dstack(arrs), *ts)
+
+
+def column_stack(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    return apply("column_stack", lambda *arrs: jnp.column_stack(arrs), *ts)
+
+
+row_stack = vstack
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"paddle.split: axis size {dim} is not divisible by "
+                f"num={n} (reference requires even split)")
+        sizes = [dim // n] * n
+    else:
+        sizes = []
+        rem = dim
+        minus_one = None
+        vals = _shape_list(num_or_sections)
+        for i, s in enumerate(vals):
+            if s == -1:
+                minus_one = i
+                sizes.append(0)
+            else:
+                sizes.append(s)
+                rem -= s
+        if minus_one is not None:
+            sizes[minus_one] = rem
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax)
+                     for o, s in zip(offsets, sizes))
+
+    outs = apply("split", fn, x, n_outputs=len(sizes))
+    return list(outs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = as_tensor(x)
+    ax = int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+        return split(x, sizes, axis=ax)
+    idxs = _shape_list(num_or_indices)
+    bounds = [0] + idxs + [dim]
+    sizes = [max(0, bounds[i + 1] - bounds[i]) for i in range(len(bounds) - 1)]
+    return split(x, sizes, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = as_tensor(x)
+    ax = int(axis) % x.ndim
+    n = num or x.shape[ax]
+
+    def fn(a):
+        moved = jnp.moveaxis(a, ax, 0)
+        return tuple(moved[i] for i in range(n))
+
+    return list(apply("unstack", fn, x, n_outputs=n))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(_shape_list(repeat_times))
+    return apply("tile", lambda a: jnp.tile(a, reps), as_tensor(x))
+
+
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    sh = _shape_list(shape)
+    # -1 entries keep the original size (paddle semantics)
+    cur = ([1] * (len(sh) - x.ndim)) + x.shape
+    tgt = tuple(c if s == -1 else s for s, c in zip(sh, cur))
+    return apply("expand", lambda a: jnp.broadcast_to(a, tgt), x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None):
+    ts = [as_tensor(t) for t in input]
+    n = len(ts)
+    outs = apply("broadcast_tensors",
+                 lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)),
+                 *ts, n_outputs=n)
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cast(x, dtype):
+    x = as_tensor(x)
+    jdt = dtypes.to_jax_dtype(dtype)
+    if x._data.dtype == jdt:
+        return apply("cast", lambda a: a, x)
+    return apply("cast", lambda a: a.astype(jdt), x)
+
+
+def cast_(x, dtype):
+    return x._inplace_assign(cast(x, dtype))
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+def tolist(x):
+    return as_tensor(x).numpy().tolist()
+
+
+def rank(input):
+    return wrap_array(jnp.asarray(as_tensor(input).ndim, dtype=jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter family
+# ---------------------------------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+    return apply("gather",
+                 lambda a, i: jnp.take(a, i.reshape(-1).astype(jnp.int32),
+                                       axis=ax),
+                 as_tensor(x), as_tensor(index))
+
+
+def gather_nd(x, index, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def fn(a, i):
+        i = i.astype(jnp.int32)
+        k = i.shape[-1]
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply("gather_nd", fn, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def fn(a, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            # paddle semantics: later rows win; jax .set has that behaviour
+            # only with unique indices — emulate with a mask-zero + add of
+            # the last occurrence.  For typical unique-index use .set is it.
+            return a.at[i].set(u)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply("scatter", fn, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_assign(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def fn(a, i, u):
+        i = i.astype(jnp.int32)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+
+    return apply("scatter_nd_add", fn, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=as_tensor(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    ax = int(axis)
+    return apply("index_select",
+                 lambda a, i: jnp.take(a, i.reshape(-1).astype(jnp.int32),
+                                       axis=ax),
+                 as_tensor(x), as_tensor(index))
+
+
+def index_sample(x, index):
+    return apply("index_sample",
+                 lambda a, i: jnp.take_along_axis(
+                     a, i.astype(jnp.int32), axis=1),
+                 as_tensor(x), as_tensor(index))
+
+
+def index_add(x, index, axis, value, name=None):
+    ax = int(axis)
+
+    def fn(a, i, v):
+        i = i.reshape(-1).astype(jnp.int32)
+        moved = jnp.moveaxis(a, ax, 0)
+        vmoved = jnp.moveaxis(v, ax, 0)
+        out = moved.at[i].add(vmoved)
+        return jnp.moveaxis(out, 0, ax)
+
+    return apply("index_add", fn, as_tensor(x), as_tensor(index),
+                 as_tensor(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = as_tensor(x)
+    idx_ts = [as_tensor(i) for i in indices]
+    v = as_tensor(value)
+
+    def fn(a, vv, *idx):
+        ii = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer)
+                   else i for i in idx)
+        if accumulate:
+            return a.at[ii].add(vv)
+        return a.at[ii].set(vv)
+
+    return apply("index_put", fn, x, v, *idx_ts)
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: the mask is read on the host (eager-only, like
+    # any XLA dynamic-shape op), but the gather itself runs through the tape
+    # with static indices, so gradients flow (scatter-add backward).
+    x, mask = as_tensor(x), as_tensor(mask)
+    m = np.broadcast_to(np.asarray(mask._data).astype(bool), tuple(x.shape))
+    idx = tuple(jnp.asarray(i) for i in np.nonzero(m))
+    return apply("masked_select", lambda a: a[idx], x)
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value.item() if isinstance(value, Tensor) and value.size == 1 \
+        else value
+    if isinstance(val, Tensor):
+        return apply("masked_fill",
+                     lambda a, m, v: jnp.where(m.astype(bool), v, a),
+                     as_tensor(x), as_tensor(mask), as_tensor(val))
+    return apply("masked_fill",
+                 lambda a, m: jnp.where(m.astype(bool),
+                                        jnp.asarray(val, a.dtype), a),
+                 as_tensor(x), as_tensor(mask))
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = as_tensor(x), as_tensor(mask), as_tensor(value)
+    m = np.broadcast_to(np.asarray(mask._data).astype(bool), tuple(x.shape))
+    idx = tuple(jnp.asarray(i) for i in np.nonzero(m))
+    n = len(idx[0]) if idx else 0
+
+    def fn(a, v):
+        return a.at[idx].set(v.reshape(-1)[:n].astype(a.dtype))
+
+    return apply("masked_scatter", fn, x, value)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    ax = int(axis)
+    return apply("take_along_axis",
+                 lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32),
+                                                  axis=ax),
+                 as_tensor(arr), as_tensor(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    ax = int(axis)
+    if not isinstance(values, Tensor) and isinstance(values, (int, float)):
+        vt = as_tensor(values)
+        arr_t, idx_t = as_tensor(arr), as_tensor(indices)
+        values = apply("full_like_idx",
+                       lambda i, v: jnp.full(i.shape, v), idx_t, vt)
+
+    def fn(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=ax, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amin": "min", "amax": "max"}[reduce]
+        dnums = None
+        # express via .at on moved axis
+        moved = jnp.moveaxis(a, ax, -1)
+        im = jnp.moveaxis(i, ax, -1)
+        vm = jnp.moveaxis(v, ax, -1)
+        lead = np.indices(im.shape[:-1])
+        lead_idx = tuple(jnp.asarray(l)[..., None].repeat(im.shape[-1], -1)
+                         for l in lead)
+        full_idx = lead_idx + (im,)
+        atv = moved.at[full_idx]
+        out = {"add": atv.add, "multiply": atv.multiply,
+               "min": atv.min, "max": atv.max}[mode](vm)
+        return jnp.moveaxis(out, -1, ax)
+
+    return apply("put_along_axis", fn, as_tensor(arr), as_tensor(indices),
+                 as_tensor(values))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    ax = int(axis)
+    i = int(index)
+
+    def fn(a, v):
+        moved = jnp.moveaxis(a, ax, 0)
+        out = moved.at[i].set(v.astype(a.dtype))
+        return jnp.moveaxis(out, 0, ax)
+
+    return apply("select_scatter", fn, as_tensor(x), as_tensor(values))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x, value = as_tensor(x), as_tensor(value)
+    sl = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[int(ax)] = slice(int(st), int(en), int(sd))
+    sl = tuple(sl)
+
+    def fn(a, v):
+        return a.at[sl].set(v.astype(a.dtype))
+
+    return apply("slice_scatter", fn, x, value)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _axes(shifts) if not isinstance(shifts, int) else int(shifts)
+    ax = _axes(axis) if axis is not None else None
+    return apply("roll", lambda a: jnp.roll(a, sh, axis=ax), as_tensor(x))
+
+
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return apply("flip", lambda a: jnp.flip(a, axis=ax), as_tensor(x))
+
+
+def flip_(x, axis, name=None):
+    return x._inplace_assign(flip(x, axis))
+
+
+reverse = flip
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    ax = tuple(_shape_list(axes))
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=ax), as_tensor(x))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape → eager host op
+    x = as_tensor(x)
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    idt = dtypes.to_jax_dtype(dtype)
+    if not (return_index or return_inverse or return_counts):
+        return wrap_array(jnp.asarray(res))
+    outs = [wrap_array(jnp.asarray(res[0]))]
+    for r in res[1:]:
+        outs.append(wrap_array(jnp.asarray(r.astype(idt))))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis)
+    take = np.ones(arr.shape[ax], dtype=bool)
+    sliced = np.moveaxis(arr, ax, 0)
+    for i in range(1, sliced.shape[0]):
+        take[i] = not np.array_equal(sliced[i], sliced[i - 1])
+    keep_idx = np.nonzero(take)[0]
+    out = np.take(arr, keep_idx, axis=ax)
+    result = [wrap_array(jnp.asarray(out))]
+    idt = dtypes.to_jax_dtype(dtype)
+    if return_inverse:
+        inv = np.cumsum(take) - 1
+        result.append(wrap_array(jnp.asarray(inv.astype(idt))))
+    if return_counts:
+        counts = np.diff(np.append(keep_idx, sliced.shape[0]))
+        result.append(wrap_array(jnp.asarray(counts.astype(idt))))
+    return result[0] if len(result) == 1 else tuple(result)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        arr = np.asarray(x._data)
+        out = np.repeat(arr, reps, axis=axis)
+        return wrap_array(jnp.asarray(out))
+    r = int(repeats)
+    if axis is None:
+        return apply("repeat_interleave",
+                     lambda a: jnp.repeat(a.reshape(-1), r), x)
+    ax = int(axis)
+    return apply("repeat_interleave",
+                 lambda a: jnp.repeat(a, r, axis=ax), x)
+
+
+def slice(input, axes, starts, ends):
+    import builtins
+    input = as_tensor(input)
+    idx = [builtins.slice(None)] * input.ndim
+    for ax, st, en in zip(_shape_list(axes), _shape_list(starts),
+                          _shape_list(ends)):
+        idx[ax] = builtins.slice(st, en)
+    tup = tuple(idx)
+    return apply("slice", lambda a: a[tup], input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+    x = as_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(_shape_list(axes), _shape_list(starts),
+                              _shape_list(ends), _shape_list(strides)):
+        idx[ax] = builtins.slice(st, en, sd)
+    tup = tuple(idx)
+    return apply("strided_slice", lambda a: a[tup], x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = as_tensor(x)
+    sh = _shape_list(shape) if shape is not None else x.shape
+    off = _shape_list(offsets) if offsets is not None else [0] * x.ndim
+    sh = [xs if s == -1 else s for s, xs in zip(sh, x.shape)]
+    import builtins
+    tup = tuple(builtins.slice(o, o + s) for o, s in zip(off, sh))
+    return apply("crop", lambda a: a[tup], x)
+
+
+def as_real(x, name=None):
+    return apply("as_real",
+                 lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                 as_tensor(x))
+
+
+def as_complex(x, name=None):
+    return apply("as_complex",
+                 lambda a: jax.lax.complex(a[..., 0], a[..., 1]),
+                 as_tensor(x))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, as_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, as_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, as_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def fill_(x, value):
+    x._data = jnp.full_like(x._data, value)
+    return x
+
+
+def zero_(x):
+    x._data = jnp.zeros_like(x._data)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    arr = np.asarray(x._data).copy()
+    np.fill_diagonal(arr, value, wrap=wrap)
+    x._data = jnp.asarray(arr)
+    return x
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        ax = tuple(tuple(_shape_list(a)) if isinstance(a, (list, tuple))
+                   else int(a) for a in axes)
+    else:
+        ax = int(axes)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax),
+                 x, y)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    # reference formula: ceil division (manipulation.py:647)
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(i):
+        shard = i // size
+        local = i % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return apply("shard_index", fn, as_tensor(input))
+
+
+# ---------------------------------------------------------------------------
+# __getitem__ / __setitem__
+# ---------------------------------------------------------------------------
+def _normalize_index(item):
+    """Convert Tensor indices to jax arrays; keep python primitives."""
+    if isinstance(item, tuple):
+        return tuple(_normalize_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, (list, np.ndarray)):
+        return jnp.asarray(np.asarray(item))
+    import builtins
+    if isinstance(item, builtins.slice):
+        def conv(v):
+            if isinstance(v, Tensor):
+                return int(v.item())
+            return v
+        return builtins.slice(conv(item.start), conv(item.stop),
+                              conv(item.step))
+    return item
+
+
+def _has_bool_mask(idx):
+    if isinstance(idx, tuple):
+        return any(_has_bool_mask(i) for i in idx)
+    return (hasattr(idx, "dtype") and
+            np.dtype(idx.dtype) == np.bool_)
+
+
+def _expand_bool_masks(idx):
+    """Replace boolean-mask components with integer index arrays (numpy
+    advanced-indexing equivalence) so the op stays static-shaped and
+    differentiable; the mask values are read on the host."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    out = []
+    for i in idx:
+        if hasattr(i, "dtype") and np.dtype(i.dtype) == np.bool_:
+            for z in np.nonzero(np.asarray(i)):
+                out.append(jnp.asarray(z))
+        else:
+            out.append(i)
+    return tuple(out)
+
+
+def getitem(x, item):
+    x = as_tensor(x)
+    idx = _normalize_index(item)
+    if _has_bool_mask(idx):
+        idx = _expand_bool_masks(idx)
+
+    def fn(a):
+        return a[idx]
+
+    return apply("getitem", fn, x)
+
+
+def setitem(x, item, value):
+    idx = _normalize_index(item)
+    if _has_bool_mask(idx):
+        idx = _expand_bool_masks(idx)
+    if isinstance(value, Tensor):
+        out = apply("setitem",
+                    lambda a, v: a.at[idx].set(
+                        jnp.broadcast_to(
+                            v.astype(a.dtype), a[idx].shape)
+                        if v.shape != a[idx].shape else v.astype(a.dtype)),
+                    x, value)
+    else:
+        out = apply("setitem", lambda a: a.at[idx].set(value), x)
+    return x._inplace_assign(out)
+
+
+def pad_sequences(seqs, pad_value=0):
+    maxlen = max(len(s) for s in seqs)
+    out = np.full((len(seqs), maxlen), pad_value)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = np.asarray(s)
+    return wrap_array(jnp.asarray(out))

@@ -1,0 +1,492 @@
+"""Math ops (mirror of python/paddle/tensor/math.py in the reference).
+
+Every op is a thin closure over a pure jnp function dispatched through
+``ops.dispatch.apply`` (reference analog: python/paddle/tensor/math.py →
+``_C_ops.*`` → PHI kernels; here → XLA).  Statics (axis, keepdim, scalars)
+are closed over; tensor operands flow through the tape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor, unwrap
+from ..framework import dtype as dtypes
+from .tensor import Tensor, wrap_array
+
+__all__ = []  # populated below
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _normalize_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        vals = []
+        for a in axis:
+            vals.append(int(a.item()) if isinstance(a, Tensor) else int(a))
+        return tuple(vals)
+    return int(axis)
+
+
+def _scalar(v):
+    if isinstance(v, Tensor):
+        return v._data
+    return v
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+def _make_unary(name, jfn, doc=None):
+    def op(x, name=None):
+        return apply(op.__name__, jfn, as_tensor(x))
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} (TPU/XLA)."
+    __all__.append(name)
+    return op
+
+
+exp = _make_unary("exp", jnp.exp)
+expm1 = _make_unary("expm1", jnp.expm1)
+log = _make_unary("log", jnp.log)
+log2 = _make_unary("log2", jnp.log2)
+log10 = _make_unary("log10", jnp.log10)
+log1p = _make_unary("log1p", jnp.log1p)
+sqrt = _make_unary("sqrt", jnp.sqrt)
+rsqrt = _make_unary("rsqrt", jax.lax.rsqrt)
+square = _make_unary("square", jnp.square)
+abs = _make_unary("abs", jnp.abs)
+ceil = _make_unary("ceil", jnp.ceil)
+floor = _make_unary("floor", jnp.floor)
+round = _make_unary("round", jnp.round)
+trunc = _make_unary("trunc", jnp.trunc)
+sin = _make_unary("sin", jnp.sin)
+cos = _make_unary("cos", jnp.cos)
+tan = _make_unary("tan", jnp.tan)
+asin = _make_unary("asin", jnp.arcsin)
+acos = _make_unary("acos", jnp.arccos)
+atan = _make_unary("atan", jnp.arctan)
+sinh = _make_unary("sinh", jnp.sinh)
+cosh = _make_unary("cosh", jnp.cosh)
+tanh = _make_unary("tanh", jnp.tanh)
+asinh = _make_unary("asinh", jnp.arcsinh)
+acosh = _make_unary("acosh", jnp.arccosh)
+atanh = _make_unary("atanh", jnp.arctanh)
+erf = _make_unary("erf", jax.scipy.special.erf)
+erfinv = _make_unary("erfinv", jax.scipy.special.erfinv)
+reciprocal = _make_unary("reciprocal", lambda a: 1.0 / a)
+sign = _make_unary("sign", jnp.sign)
+sgn = _make_unary("sgn", jnp.sign)
+neg = _make_unary("neg", jnp.negative)
+negative = _make_unary("negative", jnp.negative)
+conj = _make_unary("conj", jnp.conj)
+angle = _make_unary("angle", jnp.angle)
+real = _make_unary("real", jnp.real)
+imag = _make_unary("imag", jnp.imag)
+deg2rad = _make_unary("deg2rad", jnp.deg2rad)
+rad2deg = _make_unary("rad2deg", jnp.rad2deg)
+frac = _make_unary("frac", lambda a: a - jnp.trunc(a))
+digamma = _make_unary("digamma", jax.scipy.special.digamma)
+lgamma = _make_unary("lgamma", jax.scipy.special.gammaln)
+gammaln = _make_unary("gammaln", jax.scipy.special.gammaln)
+sigmoid = _make_unary("sigmoid", jax.nn.sigmoid)
+logit = _make_unary("logit", jax.scipy.special.logit)
+i0 = _make_unary("i0", jax.scipy.special.i0)
+i0e = _make_unary("i0e", jax.scipy.special.i0e)
+i1 = _make_unary("i1", jax.scipy.special.i1)
+i1e = _make_unary("i1e", jax.scipy.special.i1e)
+isnan = _make_unary("isnan", jnp.isnan)
+isinf = _make_unary("isinf", jnp.isinf)
+isfinite = _make_unary("isfinite", jnp.isfinite)
+isneginf = _make_unary("isneginf", jnp.isneginf)
+isposinf = _make_unary("isposinf", jnp.isposinf)
+isreal = _make_unary("isreal", jnp.isreal)
+bitwise_not = _make_unary("bitwise_not", jnp.bitwise_not)
+logical_not = _make_unary("logical_not", jnp.logical_not)
+exponential_ = None  # defined in random.py
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (broadcasting; scalar operands closed over)
+# ---------------------------------------------------------------------------
+def _make_binary(name, jfn):
+    def op(x, y, name=None):
+        if not isinstance(y, Tensor) and not isinstance(x, Tensor):
+            x = as_tensor(x)
+        if isinstance(x, Tensor) and not isinstance(y, Tensor) and \
+                isinstance(y, (bool, int, float)):
+            yv = y
+            return apply(op.__name__, lambda a: jfn(a, yv), x)
+        if isinstance(y, Tensor) and not isinstance(x, Tensor) and \
+                isinstance(x, (bool, int, float)):
+            xv = x
+            return apply(op.__name__, lambda b: jfn(xv, b), y)
+        return apply(op.__name__, jfn, as_tensor(x), as_tensor(y))
+    op.__name__ = name
+    op.__qualname__ = name
+    __all__.append(name)
+    return op
+
+
+add = _make_binary("add", jnp.add)
+subtract = _make_binary("subtract", jnp.subtract)
+multiply = _make_binary("multiply", jnp.multiply)
+divide = _make_binary("divide", jnp.true_divide)
+floor_divide = _make_binary("floor_divide", jnp.floor_divide)
+mod = _make_binary("mod", jnp.mod)
+remainder = _make_binary("remainder", jnp.mod)
+floor_mod = _make_binary("floor_mod", jnp.mod)
+fmod = _make_binary("fmod", jnp.fmod)
+pow = _make_binary("pow", jnp.power)
+maximum = _make_binary("maximum", jnp.maximum)
+minimum = _make_binary("minimum", jnp.minimum)
+fmax = _make_binary("fmax", jnp.fmax)
+fmin = _make_binary("fmin", jnp.fmin)
+atan2 = _make_binary("atan2", jnp.arctan2)
+hypot = _make_binary("hypot", jnp.hypot)
+heaviside = _make_binary("heaviside", jnp.heaviside)
+gcd = _make_binary("gcd", jnp.gcd)
+lcm = _make_binary("lcm", jnp.lcm)
+copysign = _make_binary("copysign", jnp.copysign)
+nextafter = _make_binary("nextafter", jnp.nextafter)
+ldexp = _make_binary("ldexp", jnp.ldexp)
+logaddexp = _make_binary("logaddexp", jnp.logaddexp)
+bitwise_and = _make_binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _make_binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _make_binary("bitwise_xor", jnp.bitwise_xor)
+logical_and = _make_binary("logical_and", jnp.logical_and)
+logical_or = _make_binary("logical_or", jnp.logical_or)
+logical_xor = _make_binary("logical_xor", jnp.logical_xor)
+left_shift = _make_binary("left_shift", jnp.left_shift)
+right_shift = _make_binary("right_shift", jnp.right_shift)
+polygamma = None  # not in jax scipy; gate
+
+
+@_export
+def divide_no_nan(x, y, name=None):
+    return apply("divide_no_nan",
+                 lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1, b)),
+                 as_tensor(x), as_tensor(y))
+
+
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = as_tensor(x)
+    s, b = _scalar(scale), _scalar(bias)
+    if bias_after_scale:
+        fn = lambda a: a * s + b
+    else:
+        fn = lambda a: (a + b) * s
+    out = apply("scale", fn, x)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    x = as_tensor(x)
+    lo = _scalar(min) if min is not None else None
+    hi = _scalar(max) if max is not None else None
+    return apply("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+@_export
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        w = weight
+        return apply("lerp", lambda a, b: a + w * (b - a),
+                     as_tensor(x), as_tensor(y))
+    return apply("lerp", lambda a, b, w: a + w * (b - a),
+                 as_tensor(x), as_tensor(y), as_tensor(weight))
+
+
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a),
+                 as_tensor(x))
+
+
+@_export
+def multiply_(x, y, name=None):
+    return x._inplace_assign(multiply(x, y))
+
+
+@_export
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), as_tensor(x))
+
+
+@_export
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [as_tensor(t) for t in inputs]
+    return apply("add_n", lambda *arrs: functools.reduce(jnp.add, arrs), *ts)
+
+
+@_export
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _normalize_axis(axis)
+    return apply("count_nonzero",
+                 lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                 as_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _make_reduce(name, jfn, has_dtype=False):
+    if has_dtype:
+        def op(x, axis=None, dtype=None, keepdim=False, name=None):
+            ax = _normalize_axis(axis)
+            jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+            return apply(op.__name__,
+                         lambda a: jfn(a, axis=ax, dtype=jdt,
+                                       keepdims=keepdim), as_tensor(x))
+    else:
+        def op(x, axis=None, keepdim=False, name=None):
+            ax = _normalize_axis(axis)
+            return apply(op.__name__,
+                         lambda a: jfn(a, axis=ax, keepdims=keepdim),
+                         as_tensor(x))
+    op.__name__ = name
+    op.__qualname__ = name
+    __all__.append(name)
+    return op
+
+
+sum = _make_reduce("sum", jnp.sum, has_dtype=True)
+prod = _make_reduce("prod", jnp.prod, has_dtype=True)
+max = _make_reduce("max", jnp.max)
+min = _make_reduce("min", jnp.min)
+amax = _make_reduce("amax", jnp.max)
+amin = _make_reduce("amin", jnp.min)
+all = _make_reduce("all", jnp.all)
+any = _make_reduce("any", jnp.any)
+nansum = _make_reduce("nansum", jnp.nansum, has_dtype=True)
+nanmean = _make_reduce("nanmean", jnp.nanmean)
+
+
+@_export
+def mean(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = _normalize_axis(axis)
+    return apply("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+@_export
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _normalize_axis(axis)
+    return apply("logsumexp",
+                 lambda a: jax.scipy.special.logsumexp(a, axis=ax,
+                                                       keepdims=keepdim),
+                 as_tensor(x))
+
+
+@_export
+def log_normalize(x, axis=-1):  # helper used by distribution
+    ax = _normalize_axis(axis)
+    return apply("log_normalize",
+                 lambda a: a - jax.scipy.special.logsumexp(
+                     a, axis=ax, keepdims=True), as_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# cumulative
+# ---------------------------------------------------------------------------
+@_export
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    if axis is None:
+        return apply("cumsum",
+                     lambda a: jnp.cumsum(a.reshape(-1), dtype=jdt), x)
+    ax = int(axis)
+    return apply("cumsum", lambda a: jnp.cumsum(a, axis=ax, dtype=jdt), x)
+
+
+@_export
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    if dim is None:
+        return apply("cumprod",
+                     lambda a: jnp.cumprod(a.reshape(-1), dtype=jdt), x)
+    ax = int(dim)
+    return apply("cumprod", lambda a: jnp.cumprod(a, axis=ax, dtype=jdt), x)
+
+
+@_export
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = -1 if axis is None else int(axis)
+    xin = x if axis is not None else _flatten_for_cum(x)
+    vals = apply("cummax",
+                 lambda a: jax.lax.associative_scan(jnp.maximum, a, axis=ax),
+                 xin)
+    indices = _cum_arg(xin, ax, jnp.maximum, dtypes.to_jax_dtype(dtype))
+    return vals, indices
+
+
+@_export
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = -1 if axis is None else int(axis)
+    xin = x if axis is not None else _flatten_for_cum(x)
+    vals = apply("cummin",
+                 lambda a: jax.lax.associative_scan(jnp.minimum, a, axis=ax),
+                 xin)
+    indices = _cum_arg(xin, ax, jnp.minimum, dtypes.to_jax_dtype(dtype))
+    return vals, indices
+
+
+def _flatten_for_cum(x):
+    from .manipulation import reshape
+    return reshape(x, [-1])
+
+
+def _cum_arg(x, ax, op, idx_dt):
+    def fn(a):
+        n = a.shape[ax]
+        idx = jnp.arange(n, dtype=idx_dt)
+        shape = [1] * a.ndim
+        shape[ax] = n
+        idx = jnp.broadcast_to(idx.reshape(shape), a.shape)
+
+        def combine(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = op(v1, v2) == v2
+            # ties keep the earlier index for max/min like paddle
+            eq = v1 == v2
+            pick2 = jnp.where(eq, False, take2)
+            return jnp.where(pick2, v2, v1), jnp.where(pick2, i2, i1)
+
+        _, ids = jax.lax.associative_scan(combine, (a, idx), axis=ax)
+        return ids
+    return apply("cum_arg", fn, x)
+
+
+@_export
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    args = [x]
+    pre = as_tensor(prepend) if prepend is not None else None
+    app = as_tensor(append) if append is not None else None
+    if pre is not None and app is not None:
+        return apply("diff", lambda a, p, q: jnp.diff(
+            a, n=n, axis=axis, prepend=p, append=q), x, pre, app)
+    if pre is not None:
+        return apply("diff", lambda a, p: jnp.diff(a, n=n, axis=axis,
+                                                   prepend=p), x, pre)
+    if app is not None:
+        return apply("diff", lambda a, q: jnp.diff(a, n=n, axis=axis,
+                                                   append=q), x, app)
+    return apply("diff", lambda a: jnp.diff(a, n=n, axis=axis), x)
+
+
+# ---------------------------------------------------------------------------
+# matrix-ish math living in paddle.tensor.math
+# ---------------------------------------------------------------------------
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm",
+                 lambda i, a, b: beta * i + alpha * (a @ b),
+                 as_tensor(input), as_tensor(x), as_tensor(y))
+
+
+@_export
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, as_tensor(x), as_tensor(y))
+
+
+@_export
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)),
+                 as_tensor(x), as_tensor(y))
+
+
+@_export
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, as_tensor(x), as_tensor(y))
+
+
+@_export
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace",
+                 lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), as_tensor(x))
+
+
+@_export
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal",
+                 lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), as_tensor(x))
+
+
+@_export
+def multiplex(inputs, index, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    idx = as_tensor(index)
+
+    def fn(i, *arrs):
+        stacked = jnp.stack(arrs, axis=0)
+        sel = i.reshape(-1).astype(jnp.int32)
+        rows = jnp.arange(arrs[0].shape[0])
+        return stacked[sel, rows]
+
+    return apply("multiplex", fn, idx, *ts)
+
+
+# ---------------------------------------------------------------------------
+# in-place variants (reference: *_ ops in ops.yaml `inplace:` entries)
+# ---------------------------------------------------------------------------
+def _make_inplace(name, outofplace):
+    def op(x, *args, **kwargs):
+        return x._inplace_assign(outofplace(x, *args, **kwargs))
+    op.__name__ = name
+    op.__qualname__ = name
+    __all__.append(name)
+    return op
+
+
+add_ = _make_inplace("add_", add)
+subtract_ = _make_inplace("subtract_", subtract)
+clip_ = _make_inplace("clip_", clip)
+scale_ = _make_inplace("scale_", scale)
+exp_ = _make_inplace("exp_", exp)
+sqrt_ = _make_inplace("sqrt_", sqrt)
+rsqrt_ = _make_inplace("rsqrt_", rsqrt)
+reciprocal_ = _make_inplace("reciprocal_", reciprocal)
+floor_ = _make_inplace("floor_", floor)
+ceil_ = _make_inplace("ceil_", ceil)
+round_ = _make_inplace("round_", round)
+abs_ = _make_inplace("abs_", abs)
+sin_ = _make_inplace("sin_", sin)
+cos_ = _make_inplace("cos_", cos)
+tanh_ = _make_inplace("tanh_", tanh)
+sigmoid_ = _make_inplace("sigmoid_", sigmoid)
+neg_ = _make_inplace("neg_", neg)
+lerp_ = _make_inplace("lerp_", lerp)
+divide_ = _make_inplace("divide_", divide)
+remainder_ = _make_inplace("remainder_", remainder)
+mod_ = _make_inplace("mod_", mod)
+pow_ = _make_inplace("pow_", pow)

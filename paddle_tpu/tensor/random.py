@@ -1,0 +1,194 @@
+"""Random sampling ops (mirror of python/paddle/tensor/random.py).
+
+Each call draws a fresh subkey from the framework RNG (framework/random.py);
+sampling is an XLA op, differentiable where paddle's is (uniform/normal via
+reparameterisation when used through ``paddle.standard_normal`` etc. are
+leaves — gradients don't flow into RNG, matching the reference)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from ..framework import dtype as dtypes
+from ..framework import random as framework_random
+from .tensor import Tensor, wrap_array
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "uniform_", "normal", "normal_", "standard_normal", "standard_gamma",
+    "multinomial", "bernoulli", "bernoulli_", "poisson", "binomial",
+    "exponential_", "randn_like", "rand_like", "log_normal",
+]
+
+
+def _next_key():
+    return framework_random.next_key()
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _dt(dtype, default="float32"):
+    return dtypes.to_jax_dtype(dtype if dtype is not None else default)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return standard_normal(shape, dtype=dtype)
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    sh = _shape_list(shape)
+    return wrap_array(jax.random.normal(_next_key(), sh, _dt(dtype)))
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    return wrap_array(jax.random.gamma(_next_key(), x._data))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    sh = _shape_list(shape)
+    key = jax.random.PRNGKey(seed) if seed else _next_key()
+    return wrap_array(jax.random.uniform(
+        key, sh, _dt(dtype), minval=float(min), maxval=float(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, dtype=x.dtype, min=min, max=max, seed=seed)
+    x._data = out._data.astype(x._data.dtype)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean) if not isinstance(mean, Tensor) else mean
+        s = as_tensor(std) if not isinstance(std, Tensor) else std
+        sh = tuple(np.broadcast_shapes(tuple(m.shape), tuple(s.shape)))
+        key = _next_key()
+        return apply("normal",
+                     lambda mm, ss: mm + ss * jax.random.normal(
+                         key, sh, mm.dtype if jnp.issubdtype(
+                             mm.dtype, jnp.floating) else jnp.float32),
+                     m, s)
+    sh = _shape_list(shape if shape is not None else [1])
+    return wrap_array(float(mean) + float(std) * jax.random.normal(
+        _next_key(), sh, _dt(None)))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = normal(mean, std, shape=x.shape)
+    x._data = out._data.astype(x._data.dtype)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    base = normal(mean, std, shape)
+    from .math import exp
+    return exp(base)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    sh = _shape_list(shape)
+    return wrap_array(jax.random.randint(
+        _next_key(), sh, int(low), int(high), _dt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return randint(low, high, shape=x.shape,
+                   dtype=dtype if dtype is not None else x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return standard_normal(x.shape,
+                           dtype=dtype if dtype is not None else x.dtype)
+
+
+def rand_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return rand(x.shape, dtype=dtype if dtype is not None else x.dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return wrap_array(jax.random.permutation(
+        _next_key(), int(n)).astype(_dt(dtype, "int64")))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    key = _next_key()
+
+    def fn(probs):
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(num_samples,) + probs.shape[:-1]).T.astype(jnp.int64) \
+                if probs.ndim > 1 else jax.random.categorical(
+                    key, logits, shape=(num_samples,)).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, probs.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+
+    return apply("multinomial", fn, x)
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    key = _next_key()
+    return apply("bernoulli",
+                 lambda p: jax.random.bernoulli(key, p).astype(p.dtype), x)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(
+        _next_key(), p, tuple(x.shape)).astype(x._data.dtype)
+    return x
+
+
+def poisson(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    key = _next_key()
+    return apply("poisson",
+                 lambda lam: jax.random.poisson(key, lam).astype(lam.dtype),
+                 x)
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    count, prob = as_tensor(count), as_tensor(prob)
+    key = _next_key()
+    return apply("binomial",
+                 lambda n, p: jax.random.binomial(
+                     key, n.astype(jnp.float32),
+                     p.astype(jnp.float32)).astype(jnp.int64),
+                 count, prob)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(_next_key(), tuple(x.shape)) /
+               lam).astype(x._data.dtype)
+    return x
+
+
+def shuffle_(x, name=None):
+    x._data = jax.random.permutation(_next_key(), x._data, axis=0)
+    return x

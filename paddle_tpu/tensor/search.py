@@ -1,0 +1,182 @@
+"""Search/sort ops (mirror of python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from ..framework import dtype as dtypes
+from .tensor import Tensor, wrap_array
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "kthvalue",
+    "mode", "searchsorted", "bucketize", "index_select", "masked_select",
+    "top_p_sampling",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jdt = dtypes.to_jax_dtype(dtype)
+    if axis is None:
+        return apply("argmax",
+                     lambda a: jnp.argmax(a.reshape(-1)).astype(jdt),
+                     as_tensor(x))
+    ax = int(axis)
+    return apply("argmax",
+                 lambda a: jnp.argmax(a, axis=ax, keepdims=keepdim).astype(
+                     jdt), as_tensor(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jdt = dtypes.to_jax_dtype(dtype)
+    if axis is None:
+        return apply("argmin",
+                     lambda a: jnp.argmin(a.reshape(-1)).astype(jdt),
+                     as_tensor(x))
+    ax = int(axis)
+    return apply("argmin",
+                 lambda a: jnp.argmin(a, axis=ax, keepdims=keepdim).astype(
+                     jdt), as_tensor(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    ax = int(axis)
+
+    def fn(a):
+        idx = jnp.argsort(a, axis=ax, stable=True, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply("argsort", fn, as_tensor(x))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    ax = int(axis)
+
+    def fn(a):
+        s = jnp.sort(a, axis=ax, stable=True, descending=descending)
+        return s
+
+    return apply("sort", fn, as_tensor(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+
+    def fn(a):
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+
+    return apply("topk", fn, x, n_outputs=2)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: eager host path
+    arr = np.asarray(as_tensor(x)._data)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(wrap_array(jnp.asarray(i.astype(np.int64)))
+                     for i in idx)
+    return wrap_array(jnp.asarray(np.stack(idx, axis=-1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+
+    def fn(a):
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax, stable=True)
+        vals = jnp.take(s, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+
+    return apply("kthvalue", fn, as_tensor(x), n_outputs=2)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    arr = np.asarray(x._data)
+    ax = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # paddle returns the largest value among the modes
+        maxc = counts.max()
+        cand = uniq[counts == maxc]
+        v = cand.max()
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    shape = moved.shape[:-1]
+    vals = vals.reshape(shape)
+    idxs = idxs.reshape(shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return wrap_array(jnp.asarray(vals)), wrap_array(jnp.asarray(idxs))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def fn(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(
+            lambda s, q: jnp.searchsorted(s, q, side=side))(flat_seq, flat_v)
+        return out.reshape(v.shape).astype(dt)
+
+    return apply("searchsorted", fn, as_tensor(sorted_sequence),
+                 as_tensor(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return apply("bucketize",
+                 lambda a, seq: jnp.searchsorted(seq, a, side=side).astype(
+                     dt), as_tensor(x), as_tensor(sorted_sequence))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    from . import random as rnd
+    x, ps = as_tensor(x), as_tensor(ps)
+    key = rnd._next_key() if seed is None else jax.random.PRNGKey(seed)
+
+    def fn(logits, p):
+        probs = jax.nn.softmax(logits, axis=-1)
+        sorted_probs = jnp.sort(probs, axis=-1, descending=True)
+        sorted_idx = jnp.argsort(probs, axis=-1, descending=True)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        keep = cum - sorted_probs <= p[..., None]
+        filt = jnp.where(keep, sorted_probs, 0.0)
+        filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(filt + 1e-30), axis=-1)
+        ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+        scores = jnp.take_along_axis(filt, choice[..., None], axis=-1)
+        return scores, ids.astype(jnp.int64)
+
+    return apply("top_p_sampling", fn, x, ps, n_outputs=2)
+
+
+# re-export for namespace parity
+from .manipulation import index_select, masked_select  # noqa

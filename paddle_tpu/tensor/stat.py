@@ -1,0 +1,129 @@
+"""Statistics ops (mirror of python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from .tensor import Tensor, wrap_array
+from .math import _normalize_axis
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "histogram", "histogramdd", "bincount", "numel"]
+
+from .math import mean  # noqa: F401 (namespace parity)
+from .creation import numel  # noqa: F401
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _normalize_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply("std",
+                 lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 as_tensor(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _normalize_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply("var",
+                 lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 as_tensor(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _normalize_axis(axis)
+
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=ax, keepdims=keepdim)
+        # 'min' mode: lower of the two middles
+        if ax is None:
+            s = jnp.sort(a.reshape(-1))
+            v = s[(s.shape[0] - 1) // 2]
+            return v.reshape((1,) * a.ndim) if keepdim else v
+        s = jnp.sort(a, axis=ax)
+        n = a.shape[ax]
+        v = jnp.take(s, (n - 1) // 2, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+        return v
+
+    return apply("median", fn, as_tensor(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _normalize_axis(axis)
+    return apply("nanmedian",
+                 lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
+                 as_tensor(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    ax = _normalize_axis(axis)
+    qv = q.tolist() if isinstance(q, Tensor) else q
+
+    def fn(a):
+        return jnp.quantile(a, jnp.asarray(qv), axis=ax, keepdims=keepdim,
+                            method=interpolation)
+
+    return apply("quantile", fn, as_tensor(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    ax = _normalize_axis(axis)
+    qv = q.tolist() if isinstance(q, Tensor) else q
+    return apply("nanquantile",
+                 lambda a: jnp.nanquantile(a, jnp.asarray(qv), axis=ax,
+                                           keepdims=keepdim,
+                                           method=interpolation),
+                 as_tensor(x))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    input = as_tensor(input)
+    lo, hi = float(min), float(max)
+
+    def fn(a, *w):
+        a = a.reshape(-1)
+        mn, mx = (jnp.min(a), jnp.max(a)) if lo == 0 and hi == 0 else (
+            jnp.asarray(lo, a.dtype), jnp.asarray(hi, a.dtype))
+        hist, _ = jnp.histogram(
+            a, bins=bins, range=(mn, mx),
+            weights=w[0].reshape(-1) if w else None, density=density)
+        return hist if density else hist.astype(jnp.int64)
+
+    if weight is not None:
+        return apply("histogram", fn, input, as_tensor(weight))
+    return apply("histogram", fn, input)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    x = as_tensor(x)
+    arr = np.asarray(x._data)
+    w = np.asarray(as_tensor(weights)._data) if weights is not None else None
+    if isinstance(bins, (list, tuple)) and bins and isinstance(
+            bins[0], Tensor):
+        bins = [np.asarray(b._data) for b in bins]
+    r = None
+    if ranges is not None:
+        r = [(ranges[2 * i], ranges[2 * i + 1])
+             for i in range(len(ranges) // 2)]
+    hist, edges = np.histogramdd(arr, bins=bins, range=r, density=density,
+                                 weights=w)
+    return (wrap_array(jnp.asarray(hist)),
+            [wrap_array(jnp.asarray(e)) for e in edges])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    arr = np.asarray(x._data)
+    w = np.asarray(as_tensor(weights)._data) if weights is not None else None
+    out = np.bincount(arr, weights=w, minlength=minlength)
+    return wrap_array(jnp.asarray(out))

@@ -1,0 +1,28 @@
+"""Test configuration: force the CPU backend with an 8-device virtual mesh.
+
+The environment's sitecustomize registers the 'axon' TPU platform and forces
+`jax_platforms=axon,cpu` regardless of JAX_PLATFORMS; tests must run on CPU
+(fast compiles, 8 virtual devices for sharding tests), so we override the
+config *before* any backend is initialised.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield
