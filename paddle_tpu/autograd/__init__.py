@@ -187,7 +187,7 @@ def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
                wrap_array(jnp.zeros(av.shape, av.dtype))
                for s, av in zip(slots, node.out_avals)]
         n_in = len(node.inputs)
-        single_out = len(node.out_avals) == 1
+        single_out = not node.out_is_tuple
         fwd = node.fwd_fn
 
         def grad_fn(*args):

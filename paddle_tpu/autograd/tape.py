@@ -123,19 +123,21 @@ class GradNode:
     """One recorded op application (reference: GradNodeBase)."""
 
     __slots__ = ("name", "vjp_fn", "fwd_fn", "inputs", "out_avals",
-                 "released", "_id", "__weakref__")
+                 "out_is_tuple", "released", "_id", "__weakref__")
 
     _counter = [0]
 
     def __init__(self, name: str, vjp_fn: Callable,
                  inputs: Tuple[_InputRef, ...],
                  out_avals: List[jax.ShapeDtypeStruct],
-                 fwd_fn: Optional[Callable] = None) -> None:
+                 fwd_fn: Optional[Callable] = None,
+                 out_is_tuple: bool = False) -> None:
         self.name = name
         self.vjp_fn = vjp_fn
         self.fwd_fn = fwd_fn  # pure fn; enables double-grad re-derivation
         self.inputs = inputs
         self.out_avals = out_avals
+        self.out_is_tuple = out_is_tuple
         self.released = False
         GradNode._counter[0] += 1
         self._id = GradNode._counter[0]
@@ -151,12 +153,13 @@ class GradNode:
 
 
 def record(name: str, vjp_fn: Callable, inputs: Sequence[Any],
-           outputs: Sequence[Any], fwd_fn: Optional[Callable] = None) -> None:
+           outputs: Sequence[Any], fwd_fn: Optional[Callable] = None,
+           out_is_tuple: bool = False) -> None:
     """Attach a GradNode to ``outputs`` (Tensors)."""
     node = GradNode(
         name, vjp_fn, tuple(_InputRef(t) for t in inputs),
         [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
-         for o in outputs], fwd_fn)
+         for o in outputs], fwd_fn, out_is_tuple)
     for i, o in enumerate(outputs):
         o._grad_node = node
         o._out_idx = i
@@ -253,10 +256,10 @@ def run_backward(tensors: Sequence[Any],
             s if s is not None else jnp.zeros(av.shape, av.dtype)
             for s, av in zip(slots, node.out_avals)
         ]
-        if len(node.out_avals) == 1:
-            in_cts = node.vjp_fn(cts_out[0])
-        else:
+        if node.out_is_tuple:
             in_cts = node.vjp_fn(tuple(cts_out))
+        else:
+            in_cts = node.vjp_fn(cts_out[0])
         if not isinstance(in_cts, tuple):
             in_cts = (in_cts,)
         for ref, ct in zip(node.inputs, in_cts):

@@ -112,7 +112,8 @@ def apply(name: str, jfn: Callable, *inputs: Tensor,
         _check_nan_inf(name, outs_t)
     out_tensors = tuple(wrap_array(o, stop_gradient=True) for o in outs_t)
     if need_grad:
-        tape.record(name, vjp_fn, inputs, out_tensors, fwd_fn=jfn)
+        tape.record(name, vjp_fn, inputs, out_tensors, fwd_fn=jfn,
+                    out_is_tuple=not single)
     if flags.FLAGS_benchmark and not tape.in_functional_trace():
         for o in outs_t:
             if hasattr(o, "block_until_ready"):

@@ -1,0 +1,123 @@
+"""OpTest-lite: numerical parity + gradient-check harness.
+
+TPU-native equivalent of the reference's OpTest base
+(/root/reference/test/legacy_test/op_test.py:418): each op is checked
+against a NumPy reference per dtype with per-dtype tolerances
+(check_output :2762) and its analytic gradient is compared against central
+finite differences (check_grad :2964).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+DEFAULT_TOL: Dict[str, Dict[str, float]] = {
+    "float64": {"atol": 1e-10, "rtol": 1e-7},
+    "float32": {"atol": 1e-5, "rtol": 1e-5},
+    "float16": {"atol": 1e-2, "rtol": 1e-2},
+    "bfloat16": {"atol": 2e-2, "rtol": 2e-2},
+    "int64": {"atol": 0, "rtol": 0},
+    "int32": {"atol": 0, "rtol": 0},
+    "bool": {"atol": 0, "rtol": 0},
+}
+
+
+def _tol(dtype: str, atol=None, rtol=None):
+    base = DEFAULT_TOL.get(str(dtype), {"atol": 1e-5, "rtol": 1e-5})
+    return (atol if atol is not None else base["atol"],
+            rtol if rtol is not None else base["rtol"])
+
+
+def _to_np(x):
+    if isinstance(x, paddle.Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+def check_output(paddle_fn: Callable, numpy_fn: Callable,
+                 inputs: Sequence[np.ndarray], atol=None, rtol=None,
+                 kwargs: Optional[dict] = None) -> None:
+    """Compare paddle_fn(Tensors) against numpy_fn(arrays)."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    got = paddle_fn(*tensors, **kwargs)
+    want = numpy_fn(*inputs, **kwargs)
+    if not isinstance(got, (tuple, list)):
+        got, want = [got], [want]
+    assert len(got) == len(want), f"output arity {len(got)} vs {len(want)}"
+    for g, w in zip(got, want):
+        gn, wn = _to_np(g), np.asarray(w)
+        a, r = _tol(str(inputs[0].dtype) if inputs else "float32", atol,
+                    rtol)
+        np.testing.assert_allclose(gn.astype(np.float64)
+                                   if gn.dtype != np.bool_ else gn,
+                                   wn.astype(np.float64)
+                                   if wn.dtype != np.bool_ else wn,
+                                   atol=a, rtol=r,
+                                   err_msg=f"op output mismatch")
+
+
+def numeric_grad(f: Callable, arrays: Sequence[np.ndarray], idx: int,
+                 seed_ct: np.ndarray, eps: float = 1e-5) -> np.ndarray:
+    """Central finite differences of sum(f(x)*ct) w.r.t. arrays[idx]."""
+    x = arrays[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        args = [a.astype(np.float64) if j == idx else a
+                for j, a in enumerate(arrays)]
+        args[idx] = x.reshape(arrays[idx].shape)
+        fp = np.sum(np.asarray(f(*args)) * seed_ct)
+        flat[i] = orig - eps
+        fm = np.sum(np.asarray(f(*args)) * seed_ct)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * eps)
+    return grad
+
+
+def check_grad(paddle_fn: Callable, inputs: Sequence[np.ndarray],
+               numpy_fn: Optional[Callable] = None,
+               grad_inputs: Optional[Sequence[int]] = None,
+               atol: float = 5e-3, rtol: float = 5e-3,
+               kwargs: Optional[dict] = None) -> None:
+    """Analytic (tape) gradient vs central finite differences in float64."""
+    kwargs = kwargs or {}
+    arrays = [a.astype(np.float64) for a in inputs]
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+    out = paddle_fn(*tensors, **kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    rng = np.random.RandomState(7)
+    ct = rng.uniform(0.5, 1.5, size=tuple(out.shape)).astype(np.float64)
+    loss = (out * paddle.to_tensor(ct)).sum()
+    loss.backward()
+
+    if numpy_fn is None:
+        def numpy_fn_(*args):
+            ts = [paddle.to_tensor(a) for a in args]
+            o = paddle_fn(*ts, **kwargs)
+            if isinstance(o, (tuple, list)):
+                o = o[0]
+            return o.numpy()
+        ref_fn = numpy_fn_
+    else:
+        def ref_fn(*args):
+            o = numpy_fn(*args, **kwargs)
+            if isinstance(o, (tuple, list)):
+                o = o[0]
+            return o
+
+    for i in grad_inputs if grad_inputs is not None else range(len(arrays)):
+        analytic = tensors[i].grad
+        assert analytic is not None, f"no grad for input {i}"
+        numeric = numeric_grad(ref_fn, arrays, i, ct)
+        np.testing.assert_allclose(
+            analytic.numpy(), numeric, atol=atol, rtol=rtol,
+            err_msg=f"gradient mismatch for input {i}")

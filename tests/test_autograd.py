@@ -1,0 +1,164 @@
+"""Autograd engine tests (reference: test/legacy_test backward tests,
+test/autograd/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy())
+
+
+def test_chain_and_fanout():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3          # da/dx = 3
+    b = a * a          # db/dx = 2a*3 = 36
+    c = a + b          # dc/dx = 3 + 36 = 39
+    c.backward()
+    assert float(x.grad) == pytest.approx(39.0)
+
+
+def test_grad_accumulation_multiple_backward():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    assert float(x.grad) == pytest.approx(5.0)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    assert y.grad is None
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only via direct use
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert float(x.grad) == pytest.approx(8.0)
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_paddle_grad_basic():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2)
+    assert x.grad is None  # .grad not polluted
+
+
+def test_double_grad():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x ** 4
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    assert float(g1) == pytest.approx(4 * 27)
+    (g2,) = paddle.grad(g1, x, create_graph=True)
+    assert float(g2) == pytest.approx(12 * 9)
+    (g3,) = paddle.grad(g2, x)
+    assert float(g3) == pytest.approx(24 * 3)
+
+
+def test_grad_hook_modifies():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    handle = x.register_hook(lambda g: g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    handle.remove()
+    x.clear_grad()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_pylayer():
+    class Cube(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 3 * x * x
+
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = Cube.apply(x)
+    assert float(y) == pytest.approx(8.0)
+    y.backward()
+    assert float(x.grad) == pytest.approx(12.0)
+
+
+def test_functional_jacobian_hessian():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    jac = paddle.autograd.jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))
+    hes = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(hes.numpy(), 2 * np.eye(2))
+
+
+def test_vjp_jvp():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor([1.0, 3.0])
+    out, g = paddle.autograd.vjp(f, x, paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(g.numpy(), [2.0, 6.0])
+    out, t = paddle.autograd.jvp(f, x, paddle.to_tensor([1.0, 0.0]))
+    np.testing.assert_allclose(t.numpy(), [2.0, 0.0])
+
+
+def test_inplace_safety():
+    # consumer before mutation sees pre-mutation value in backward
+    p = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    s1 = (p * p).sum()
+    p[0] = 100.0
+    s1.backward()
+    np.testing.assert_allclose(p.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_setitem_grad_flows():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    v = paddle.to_tensor([10.0], stop_gradient=False)
+    x[1] = v[0] * 2
+    x.sum().backward()
+    np.testing.assert_allclose(v.grad.numpy(), [2.0])
+    # grad w.r.t. the original leaf: position 1 was overwritten -> 0
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])

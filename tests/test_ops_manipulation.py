@@ -1,0 +1,277 @@
+"""Manipulation/linalg/logic/search op tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+RNG = np.random.RandomState(7)
+
+
+def test_reshape_flatten_transpose():
+    x = RNG.rand(2, 3, 4).astype("float32")
+    check_output(lambda t: paddle.reshape(t, [4, 6]),
+                 lambda a: a.reshape(4, 6), [x])
+    check_output(lambda t: paddle.flatten(t, 1, 2),
+                 lambda a: a.reshape(2, 12), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_grad(lambda t: paddle.transpose(t, [1, 0, 2]),
+               [x.astype("float64")])
+
+
+def test_squeeze_unsqueeze():
+    x = RNG.rand(2, 1, 3).astype("float32")
+    assert paddle.squeeze(paddle.to_tensor(x), 1).shape == [2, 3]
+    assert paddle.unsqueeze(paddle.to_tensor(x), 0).shape == [1, 2, 1, 3]
+    assert paddle.squeeze(paddle.to_tensor(x)).shape == [2, 3]
+
+
+def test_concat_stack_split():
+    xs = [RNG.rand(2, 3).astype("float32") for _ in range(3)]
+    check_output(lambda *ts: paddle.concat(ts, axis=0),
+                 lambda *arrs: np.concatenate(arrs, 0), xs)
+    check_output(lambda *ts: paddle.stack(ts, axis=1),
+                 lambda *arrs: np.stack(arrs, 1), xs)
+    x = RNG.rand(6, 4).astype("float32")
+    outs = paddle.split(paddle.to_tensor(x), 3, axis=0)
+    for o, w in zip(outs, np.split(x, 3, axis=0)):
+        np.testing.assert_allclose(o.numpy(), w)
+    outs = paddle.split(paddle.to_tensor(x), [2, -1], axis=0)
+    np.testing.assert_allclose(outs[1].numpy(), x[2:])
+    # concat grad
+    check_grad(lambda *ts: paddle.concat(ts, axis=1),
+               [a.astype("float64") for a in xs])
+
+
+def test_tile_expand_broadcast():
+    x = RNG.rand(1, 3).astype("float32")
+    check_output(lambda t: paddle.tile(t, [2, 2]),
+                 lambda a: np.tile(a, (2, 2)), [x])
+    assert paddle.expand(paddle.to_tensor(x), [4, 3]).shape == [4, 3]
+    assert paddle.expand(paddle.to_tensor(x), [4, -1]).shape == [4, 3]
+    assert paddle.broadcast_to(paddle.to_tensor(x), [2, 3]).shape == [2, 3]
+
+
+def test_gather_scatter():
+    x = RNG.rand(5, 3).astype("float32")
+    idx = np.array([0, 2, 4])
+    check_output(lambda t, i: paddle.gather(t, i),
+                 lambda a, i: a[i], [x, idx])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+               [x.astype("float64")])
+    # gather_nd
+    nd_idx = np.array([[0, 1], [2, 2]])
+    got = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(nd_idx))
+    np.testing.assert_allclose(got.numpy(), x[[0, 2], [1, 2]])
+    # scatter
+    upd = RNG.rand(2, 3).astype("float32")
+    got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor([1, 3]),
+                         paddle.to_tensor(upd))
+    want = x.copy()
+    want[[1, 3]] = upd
+    np.testing.assert_allclose(got.numpy(), want)
+    got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor([1, 3]),
+                         paddle.to_tensor(upd), overwrite=False)
+    want = x.copy()
+    want[[1, 3]] = upd
+    np.testing.assert_allclose(got.numpy(), want)
+
+
+def test_index_ops():
+    x = RNG.rand(4, 5).astype("float32")
+    idx = np.array([3, 1])
+    got = paddle.index_select(paddle.to_tensor(x), paddle.to_tensor(idx),
+                              axis=1)
+    np.testing.assert_allclose(got.numpy(), x[:, idx])
+    sample_idx = np.array([[0, 1], [2, 3], [1, 1], [0, 4]])
+    got = paddle.index_sample(paddle.to_tensor(x),
+                              paddle.to_tensor(sample_idx))
+    np.testing.assert_allclose(
+        got.numpy(), np.take_along_axis(x, sample_idx, axis=1))
+    got = paddle.index_add(paddle.to_tensor(x), paddle.to_tensor([0, 2]),
+                           0, paddle.to_tensor(np.ones((2, 5), "float32")))
+    want = x.copy()
+    want[[0, 2]] += 1
+    np.testing.assert_allclose(got.numpy(), want)
+
+
+def test_take_put_along_axis():
+    x = RNG.rand(3, 4).astype("float32")
+    idx = RNG.randint(0, 4, (3, 2)).astype("int64")
+    got = paddle.take_along_axis(paddle.to_tensor(x),
+                                 paddle.to_tensor(idx), axis=1)
+    np.testing.assert_allclose(got.numpy(),
+                               np.take_along_axis(x, idx, axis=1))
+    v = np.ones((3, 2), "float32")
+    got = paddle.put_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx),
+                                paddle.to_tensor(v), axis=1, reduce="add")
+    want = x.copy()
+    np.add.at(want, (np.arange(3)[:, None], idx), v)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+
+
+def test_roll_flip_rot90():
+    x = RNG.rand(3, 4).astype("float32")
+    check_output(lambda t: paddle.roll(t, 1, axis=0),
+                 lambda a: np.roll(a, 1, axis=0), [x])
+    check_output(lambda t: paddle.flip(t, [1]),
+                 lambda a: np.flip(a, 1), [x])
+    check_output(lambda t: paddle.rot90(t),
+                 lambda a: np.rot90(a), [x])
+
+
+def test_unique_repeat():
+    x = np.array([2, 1, 2, 3, 1], dtype="int64")
+    u = paddle.unique(paddle.to_tensor(x))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    u, inv, counts = paddle.unique(paddle.to_tensor(x), return_inverse=True,
+                                   return_counts=True)
+    np.testing.assert_array_equal(counts.numpy(), [2, 2, 1])
+    r = paddle.repeat_interleave(paddle.to_tensor(x), 2)
+    np.testing.assert_array_equal(r.numpy(), np.repeat(x, 2))
+
+
+def test_getitem_setitem():
+    x = RNG.rand(4, 5, 6).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t[1].numpy(), x[1])
+    np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+    np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+    np.testing.assert_allclose(t[None, 0].numpy(), x[None, 0])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy(), x[[0, 2]])
+    # setitem
+    t2 = paddle.to_tensor(x.copy())
+    t2[0] = 0.0
+    assert float(t2[0].sum()) == 0.0
+    t2[1:3, 0] = paddle.to_tensor(np.ones(6, "float32"))
+    np.testing.assert_allclose(t2[1, 0].numpy(), np.ones(6))
+    # grad through getitem
+    g = paddle.to_tensor(x, stop_gradient=False)
+    g[0, 0].sum().backward()
+    want = np.zeros_like(x)
+    want[0, 0] = 1
+    np.testing.assert_allclose(g.grad.numpy(), want)
+
+
+def test_matmul_family():
+    a = RNG.rand(3, 4).astype("float32")
+    b = RNG.rand(4, 5).astype("float32")
+    check_output(paddle.matmul, np.matmul, [a, b])
+    check_output(lambda x, y: paddle.matmul(x, y, transpose_x=True),
+                 lambda x, y: x.T @ y, [RNG.rand(4, 3).astype("float32"), b])
+    check_grad(paddle.matmul, [a.astype("float64"), b.astype("float64")])
+    # batched
+    ab = RNG.rand(2, 3, 4).astype("float32")
+    bb = RNG.rand(2, 4, 5).astype("float32")
+    check_output(paddle.bmm, np.matmul, [ab, bb])
+    # dot
+    v1 = RNG.rand(5).astype("float32")
+    v2 = RNG.rand(5).astype("float32")
+    np.testing.assert_allclose(
+        paddle.dot(paddle.to_tensor(v1), paddle.to_tensor(v2)).numpy(),
+        np.dot(v1, v2), rtol=1e-6)
+    # einsum
+    got = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                        paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg_decompositions():
+    a = RNG.rand(4, 4).astype("float32")
+    spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+    chol = paddle.linalg.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose(chol.numpy() @ chol.numpy().T, spd,
+                               rtol=1e-4, atol=1e-4)
+    inv = paddle.linalg.inv(paddle.to_tensor(spd))
+    np.testing.assert_allclose(inv.numpy() @ spd, np.eye(4), atol=1e-4)
+    det = paddle.linalg.det(paddle.to_tensor(spd))
+    np.testing.assert_allclose(float(det), np.linalg.det(spd), rtol=1e-4)
+    q, r = paddle.linalg.qr(paddle.to_tensor(a))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+    w, v = paddle.linalg.eigh(paddle.to_tensor(spd))
+    np.testing.assert_allclose(
+        v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, spd, atol=1e-3)
+    sol = paddle.linalg.solve(paddle.to_tensor(spd),
+                              paddle.to_tensor(a))
+    np.testing.assert_allclose(spd @ sol.numpy(), a, atol=1e-4)
+
+
+def test_logic_ops():
+    x = np.array([1.0, 2.0, 3.0], "float32")
+    y = np.array([2.0, 2.0, 2.0], "float32")
+    t, u = paddle.to_tensor(x), paddle.to_tensor(y)
+    np.testing.assert_array_equal((t < u).numpy(), x < y)
+    np.testing.assert_array_equal((t == u).numpy(), x == y)
+    np.testing.assert_array_equal(
+        paddle.logical_and(t > 1, t < 3).numpy(), (x > 1) & (x < 3))
+    assert bool(paddle.allclose(t, t + 1e-9))
+    w = paddle.where(t > 2, t, u)
+    np.testing.assert_allclose(w.numpy(), np.where(x > 2, x, y))
+
+
+def test_search_ops():
+    x = RNG.rand(3, 5).astype("float32")
+    np.testing.assert_array_equal(
+        paddle.argmax(paddle.to_tensor(x), axis=1).numpy(),
+        np.argmax(x, axis=1))
+    np.testing.assert_array_equal(
+        paddle.argsort(paddle.to_tensor(x), axis=1).numpy(),
+        np.argsort(x, axis=1))
+    v, i = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(x, axis=1)[:, -2:][:, ::-1])
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+    ss = paddle.searchsorted(paddle.to_tensor(np.array([1., 3., 5.])),
+                             paddle.to_tensor(np.array([2., 4.])))
+    np.testing.assert_array_equal(ss.numpy(), [1, 2])
+
+
+def test_stat_ops():
+    x = RNG.rand(4, 6).astype("float32")
+    np.testing.assert_allclose(
+        paddle.std(paddle.to_tensor(x), axis=1).numpy(),
+        np.std(x, axis=1, ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.var(paddle.to_tensor(x)).numpy(),
+        np.var(x, ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.median(paddle.to_tensor(x), axis=1).numpy(),
+        np.median(x, axis=1), rtol=1e-6)
+    np.testing.assert_array_equal(
+        paddle.bincount(paddle.to_tensor(np.array([0, 1, 1, 3]))).numpy(),
+        [1, 2, 0, 1])
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert str(paddle.ones([2], dtype="int32").dtype) == "int32"
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert str(paddle.arange(5).dtype) == "int64"
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_array_equal(
+        paddle.eye(3).numpy(), np.eye(3, dtype="float32"))
+    x = RNG.rand(3, 3).astype("float32")
+    np.testing.assert_allclose(
+        paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+    np.testing.assert_allclose(
+        paddle.full([2, 2], 7.0).numpy(), np.full((2, 2), 7.0))
+    fl = paddle.full_like(paddle.to_tensor(x), 3)
+    np.testing.assert_allclose(fl.numpy(), np.full((3, 3), 3.0))
+
+
+def test_random_reproducibility():
+    paddle.seed(99)
+    a = paddle.rand([3, 3])
+    paddle.seed(99)
+    b = paddle.rand([3, 3])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = paddle.randperm(10)
+    np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))
+    u = paddle.uniform([1000], min=2.0, max=3.0)
+    assert 2.0 <= float(u.min()) and float(u.max()) < 3.0

@@ -1,0 +1,180 @@
+"""Math op parity + grad checks (OpTest-style, reference: test/legacy_test)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+RNG = np.random.RandomState(42)
+
+
+UNARY_CASES = [
+    ("exp", np.exp, (3, 4), (-1, 1)),
+    ("log", np.log, (3, 4), (0.1, 2)),
+    ("sqrt", np.sqrt, (3, 4), (0.1, 2)),
+    ("rsqrt", lambda a: 1 / np.sqrt(a), (3, 4), (0.5, 2)),
+    ("abs", np.abs, (3, 4), (0.3, 2)),
+    ("sin", np.sin, (3, 4), (-2, 2)),
+    ("cos", np.cos, (3, 4), (-2, 2)),
+    ("tanh", np.tanh, (3, 4), (-2, 2)),
+    ("sigmoid", lambda a: 1 / (1 + np.exp(-a)), (3, 4), (-2, 2)),
+    ("square", np.square, (3, 4), (-2, 2)),
+    ("floor", np.floor, (3, 4), (-2, 2)),
+    ("ceil", np.ceil, (3, 4), (-2, 2)),
+    ("reciprocal", lambda a: 1 / a, (3, 4), (0.5, 2)),
+    ("log1p", np.log1p, (3, 4), (0.0, 2)),
+    ("expm1", np.expm1, (3, 4), (-1, 1)),
+    ("sign", np.sign, (3, 4), (-2, 2)),
+    ("erf", None, (3, 4), (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,ref,shape,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_output(name, ref, shape, rng):
+    if ref is None:
+        pytest.importorskip("scipy")
+        import scipy.special
+        ref = scipy.special.erf
+    x = RNG.uniform(rng[0], rng[1], shape).astype("float32")
+    check_output(getattr(paddle, name), ref, [x])
+
+
+DIFF_UNARY = ["exp", "log", "sqrt", "tanh", "sigmoid", "square", "sin",
+              "cos", "reciprocal"]
+
+
+@pytest.mark.parametrize("name", DIFF_UNARY)
+def test_unary_grad(name):
+    x = RNG.uniform(0.2, 1.5, (3, 4)).astype("float64")
+    check_grad(getattr(paddle, name), [x])
+
+
+BINARY_CASES = [
+    ("add", np.add),
+    ("subtract", np.subtract),
+    ("multiply", np.multiply),
+    ("divide", np.true_divide),
+    ("maximum", np.maximum),
+    ("minimum", np.minimum),
+    ("pow", np.power),
+    ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_output(name, ref):
+    x = RNG.uniform(0.5, 2, (3, 4)).astype("float32")
+    y = RNG.uniform(0.5, 2, (3, 4)).astype("float32")
+    check_output(getattr(paddle, name), ref, [x, y])
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply", "divide"])
+def test_binary_grad(name):
+    x = RNG.uniform(0.5, 2, (3, 4)).astype("float64")
+    y = RNG.uniform(0.5, 2, (3, 4)).astype("float64")
+    check_grad(getattr(paddle, name), [x, y])
+
+
+def test_binary_broadcast():
+    x = RNG.rand(3, 4).astype("float32")
+    y = RNG.rand(4).astype("float32")
+    check_output(paddle.add, np.add, [x, y])
+    check_grad(paddle.multiply, [x.astype("float64"), y.astype("float64")])
+
+
+def test_scalar_operands():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = 2.5 * x + 1
+    assert y.dtype == "float32"
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.5, 2.5])
+
+
+REDUCE_CASES = [
+    ("sum", np.sum),
+    ("mean", np.mean),
+    ("max", np.max),
+    ("min", np.min),
+    ("prod", np.prod),
+]
+
+
+@pytest.mark.parametrize("name,ref", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                          (1, True), ((0, 1), False)])
+def test_reduce(name, ref, axis, keepdim):
+    x = RNG.uniform(0.5, 1.5, (3, 4)).astype("float32")
+    got = getattr(paddle, name)(paddle.to_tensor(x), axis=axis,
+                                keepdim=keepdim)
+    want = ref(x, axis=axis, keepdims=keepdim)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_reduce_grads():
+    x = RNG.uniform(0.5, 1.5, (3, 4)).astype("float64")
+    check_grad(lambda t: paddle.sum(t, axis=1), [x])
+    check_grad(lambda t: paddle.mean(t, axis=0), [x])
+    check_grad(lambda t: paddle.max(t, axis=1), [x])
+
+
+def test_cumsum_cumprod():
+    x = RNG.uniform(0.5, 1.5, (3, 4)).astype("float32")
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, axis=1), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=0),
+                 lambda a: np.cumprod(a, axis=0), [x])
+    check_grad(lambda t: paddle.cumsum(t, axis=1), [x.astype("float64")])
+
+
+def test_clip_scale_lerp():
+    x = RNG.uniform(-2, 2, (5,)).astype("float32")
+    check_output(lambda t: paddle.clip(t, -1, 1),
+                 lambda a: np.clip(a, -1, 1), [x])
+    check_output(lambda t: paddle.scale(t, 3.0, 1.0),
+                 lambda a: a * 3.0 + 1.0, [x])
+    y = RNG.uniform(-2, 2, (5,)).astype("float32")
+    check_output(lambda a, b: paddle.lerp(a, b, 0.3),
+                 lambda a, b: a + 0.3 * (b - a), [x, y])
+
+
+def test_logsumexp():
+    x = RNG.uniform(-2, 2, (3, 4)).astype("float32")
+    from scipy.special import logsumexp as sls
+    got = paddle.logsumexp(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(got.numpy(), sls(x, axis=1), rtol=1e-5)
+
+
+def test_add_n_addmm():
+    xs = [RNG.rand(2, 3).astype("float32") for _ in range(3)]
+    got = paddle.add_n([paddle.to_tensor(a) for a in xs])
+    np.testing.assert_allclose(got.numpy(), sum(xs), rtol=1e-6)
+    i = RNG.rand(2, 2).astype("float32")
+    a = RNG.rand(2, 3).astype("float32")
+    b = RNG.rand(3, 2).astype("float32")
+    got = paddle.addmm(paddle.to_tensor(i), paddle.to_tensor(a),
+                       paddle.to_tensor(b), beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(got.numpy(), 0.5 * i + 2.0 * (a @ b),
+                               rtol=1e-5)
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
+    x.clip_(0, 5)
+    np.testing.assert_allclose(x.numpy(), [4.0, 5.0])
+
+
+def test_isfinite_family():
+    x = np.array([1.0, np.inf, -np.inf, np.nan], dtype="float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.isnan(t).numpy(), np.isnan(x))
+    np.testing.assert_array_equal(paddle.isinf(t).numpy(), np.isinf(x))
+    np.testing.assert_array_equal(paddle.isfinite(t).numpy(),
+                                  np.isfinite(x))
