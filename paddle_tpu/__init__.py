@@ -50,31 +50,25 @@ from .autograd import (  # noqa: F401
 # grad-mode helpers paddle exposes at top level
 from .autograd import backward as _autograd_backward  # noqa: F401
 
-# Submodules that mirror paddle.* package structure. Imported lazily where
-# heavy; the common ones eagerly for `paddle.nn.Linear(...)` ergonomics.
-# BOOTSTRAP GUARD: modules still being built are skipped; removed once the
-# package is complete.
-try:
-    from . import nn  # noqa: F401,E402
-    from . import optimizer  # noqa: F401,E402
-    from . import io  # noqa: F401,E402
-    from . import amp  # noqa: F401,E402
-    from . import metric  # noqa: F401,E402
-    from . import device  # noqa: F401,E402
-    from . import jit  # noqa: F401,E402
-    from . import static  # noqa: F401,E402
-    from . import vision  # noqa: F401,E402
-    from . import distributed  # noqa: F401,E402
-    from . import distribution  # noqa: F401,E402
-    from . import incubate  # noqa: F401,E402
-    from . import sparse  # noqa: F401,E402
-    from . import hapi as _hapi  # noqa: F401,E402
-    from .hapi import Model, summary  # noqa: F401,E402
-    from .framework.io import save, load  # noqa: F401,E402
-    from .nn.layer.layers import (  # noqa: F401,E402
-        disable_static, enable_static, in_dynamic_mode)
-except ImportError:  # pragma: no cover - bootstrap only
-    pass
+# Submodules that mirror paddle.* package structure.
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import hapi as _hapi  # noqa: F401,E402
+from .hapi import Model, summary  # noqa: F401,E402
+from .framework.io import save, load  # noqa: F401,E402
+from .nn.layer.layers import (  # noqa: F401,E402
+    disable_static, enable_static, in_dynamic_mode)
 
 
 def DataParallel(layers, *args, **kwargs):
@@ -92,10 +86,7 @@ def ParamAttr(name=None, initializer=None, learning_rate=1.0,
                trainable=trainable, need_clip=need_clip)
 
 
-try:
-    from .framework.param import Parameter  # noqa: F401,E402
-except ImportError:  # pragma: no cover - bootstrap only
-    pass
+from .framework.param import Parameter  # noqa: F401,E402
 
 # paddle.version shim
 class _Version:

@@ -1,0 +1,115 @@
+"""paddle.amp.debugging (reference: python/paddle/amp/debugging.py:156,
+:455, :628) — tensor checking + per-op dtype statistics."""
+
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..flags import flags, set_flags
+from ..tensor.tensor import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection",
+           "collect_operator_stats", "compare_accuracy"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.
+                 CHECK_NAN_INF_AND_ABORT, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    set_flags({"FLAGS_check_nan_inf": config.enable,
+               "FLAGS_check_nan_inf_level":
+               0 if config.debug_mode ==
+               DebugMode.CHECK_NAN_INF_AND_ABORT else 1})
+
+
+def disable_tensor_checker() -> None:
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    arr = tensor._data
+    n_nan = int(jnp.sum(jnp.isnan(arr)))
+    n_inf = int(jnp.sum(jnp.isinf(arr)))
+    n_zero = int(jnp.sum(arr == 0))
+    if n_nan or n_inf:
+        msg = (f"[check_numerics] op={op_type} var={var_name}: "
+               f"{n_nan} nan, {n_inf} inf")
+        if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT):
+            raise FloatingPointError(msg)
+        print(msg)
+    from ..tensor.tensor import wrap_array
+    return (wrap_array(jnp.asarray(n_nan)), wrap_array(jnp.asarray(n_inf)),
+            wrap_array(jnp.asarray(n_zero)))
+
+
+_op_stats: Optional[dict] = None
+
+
+def enable_operator_stats_collection() -> None:
+    global _op_stats
+    _op_stats = {}
+    from ..ops import dispatch
+
+    def hook(name, arrays):
+        stats = _op_stats
+        if stats is not None:
+            for a in arrays:
+                key = (name, str(a.dtype))
+                stats[key] = stats.get(key, 0) + 1
+        return arrays
+
+    dispatch.set_stats_hook(hook)
+
+
+def disable_operator_stats_collection() -> None:
+    global _op_stats
+    from ..ops import dispatch
+    dispatch.set_stats_hook(None)
+    if _op_stats is not None:
+        print("<" + "-" * 40 + " op list " + "-" * 40 + ">")
+        by_op = {}
+        for (name, dtype), cnt in sorted(_op_stats.items()):
+            by_op.setdefault(name, []).append((dtype, cnt))
+        for name, items in sorted(by_op.items()):
+            calls = ", ".join(f"{d}: {c}" for d, c in items)
+            print(f"  {name:<30} {calls}")
+        print("<" + "-" * 89 + ">")
+    _op_stats = None
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "compare_accuracy requires dumped tensor files; use "
+        "check_numerics/collect_operator_stats for online checking")

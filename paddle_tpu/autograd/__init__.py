@@ -309,7 +309,8 @@ class PyLayer(metaclass=PyLayerMeta):
                     arrs.append(None if g is None else g._data)
                 return tuple(arrs)
 
-            tape.record(cls.__name__, vjp_fn, tensor_inputs, out_tensors)
+            tape.record(cls.__name__, vjp_fn, tensor_inputs, out_tensors,
+                        out_is_tuple=not single)
             return out_tensors[0] if single else out_tensors
         return outputs
 

@@ -1,0 +1,109 @@
+"""paddle_tpu.device — mirrors ``paddle.device`` (reference:
+python/paddle/device/__init__.py:265 set_device)."""
+
+from __future__ import annotations
+
+from ..framework.place import (  # noqa: F401
+    set_device, get_device, get_all_devices, device_count, CPUPlace,
+    TPUPlace, CUDAPlace, XPUPlace, CustomPlace, Place,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+    is_compiled_with_rocm, is_compiled_with_cinn)
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "cuda", "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_tpu", "is_compiled_with_rocm", "synchronize",
+           "get_available_device", "get_available_custom_device",
+           "get_all_custom_device_type"]
+
+
+def synchronize(device=None) -> None:
+    """Block until all device work completes (XLA: trivial sync point)."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_available_custom_device():
+    return []
+
+
+def get_all_custom_device_type():
+    return []
+
+
+class cuda:
+    """Compat shim: ``paddle.device.cuda`` — maps to the active accelerator
+    (memory stats come from PjRt)."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return cuda.max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return cuda.memory_allocated(device)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    class Event:
+        def __init__(self, **kw):
+            import time
+            self._t = None
+
+        def record(self, stream=None):
+            import time
+            synchronize()
+            self._t = time.perf_counter()
+
+        def elapsed_time(self, end):
+            return (end._t - self._t) * 1000.0
+
+        def synchronize(self):
+            pass
+
+    class Stream:
+        def __init__(self, **kw):
+            pass
+
+        def synchronize(self):
+            synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return cuda.Stream()
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+        return contextlib.nullcontext()

@@ -1,0 +1,28 @@
+"""paddle_tpu.distributed — mirrors ``paddle.distributed``.
+
+Two stacks, like the reference (SURVEY.md §1 L8):
+  * explicit collectives + fleet hybrid parallel (communication/, fleet/)
+  * semi-auto SPMD sharding (auto_parallel/) — native GSPMD.
+"""
+
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized,
+    ParallelEnv, is_available, destroy_process_group)
+from .collective import (  # noqa: F401
+    new_group, get_group, wait, barrier, Group)
+from .communication import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, all_to_all,
+    all_to_all_single, broadcast, broadcast_object_list, reduce,
+    reduce_scatter, scatter, scatter_object_list, gather, send, recv,
+    isend, irecv, P2POp, batch_isend_irecv, ReduceOp, stream)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, shard_tensor, dtensor_from_fn, reshard, shard_layer,
+    shard_op, Shard, Replicate, Partial, Placement)
+from . import checkpoint  # noqa: F401
+from .launch.main import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import utils  # noqa: F401
+from . import rpc  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -1,0 +1,306 @@
+"""Semi-auto parallel API (reference: python/paddle/distributed/
+auto_parallel/api.py — shard_tensor :131, reshard :579, shard_layer :678,
+shard_optimizer :1353; ProcessMesh process_mesh.py:72).
+
+This is where the TPU rebuild is *thinner* than the reference: GSPMD is
+native.  ``shard_tensor`` = device_put with a NamedSharding; ``reshard`` =
+device_put/with_sharding_constraint; per-op SPMD rules and the reshard
+function registry (r_to_s, s_to_r, ...) are XLA's sharding propagation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor, wrap_array
+from .. import mesh as _mesh
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "shard_op", "get_mesh", "set_mesh", "to_static", "Strategy",
+           "DistAttr", "dtensor_to_local"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard(d): tensor dim d split across the mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  XLA tracks partial sums internally;
+    materialising a Partial tensor eagerly performs the reduction."""
+
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """Reference: process_mesh.py:72 — an N-D array of ranks with named
+    dims; wraps a jax Mesh over the corresponding devices."""
+
+    def __init__(self, mesh, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        devices = np.asarray(jax.devices())
+        flat = arr.reshape(-1)
+        dev_arr = devices[flat % len(devices)].reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, name, index=None):
+        axis = self._dim_names.index(name)
+        moved = np.moveaxis(self._ids, axis, 0)
+        names = [name] + [n for n in self._dim_names if n != name]
+        if index is not None:
+            return ProcessMesh(moved[index], names[1:])
+        return ProcessMesh(moved, names)
+
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._shape == other._shape and
+                np.array_equal(self._ids, other._ids))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+_default_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _default_mesh
+
+
+def _placements_to_spec(placements: Sequence[Placement],
+                        mesh: ProcessMesh, ndim: int):
+    """Map per-mesh-dim placements to a PartitionSpec over tensor dims."""
+    entries: List[Any] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh,
+                 placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Reference: api.py:131."""
+    from ...tensor.tensor import to_tensor
+    t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    t._data = jax.device_put(t._data, sharding)
+    t.placements = list(placements)
+    t.process_mesh = mesh
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Reference: api.py:579.  One device_put = the whole reshard-function
+    registry (r_to_s, s_to_r, p_to_r ... reshard_function_registry.cc)."""
+    spec = _placements_to_spec(placements, mesh, dist_tensor.ndim)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    out = wrap_array(jax.device_put(dist_tensor._data, sharding),
+                     stop_gradient=dist_tensor.stop_gradient)
+    out._grad_node = dist_tensor._grad_node
+    out._out_idx = dist_tensor._out_idx
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def dtensor_to_local(dist_tensor, mesh=None, placements=None):
+    return dist_tensor
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None) -> Layer:
+    """Reference: api.py:678."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is not None:
+                    shard_tensor(p, mesh,
+                                 [Replicate()] * len(mesh.shape))
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_op(op_fn: Callable, mesh: ProcessMesh,
+             in_placements=None, out_placements=None):
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_placements:
+            return reshard(out, mesh, out_placements[0]
+                           if isinstance(out_placements[0], list)
+                           else out_placements)
+        return out
+    return wrapped
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference: api.py:1353 — ZeRO via sharded optimizer states.  States
+    are created lazily; we wrap state init so each moment is placed
+    sharded along the first mesh dim of its parameter's mesh."""
+    orig_init = optimizer._init_state
+
+    def sharded_init(p):
+        st = orig_init(p)
+        mesh = getattr(p, "process_mesh", None)
+        if mesh is not None:
+            sharding = getattr(p._data, "sharding", None)
+            if sharding is not None:
+                for k, v in st.items():
+                    if hasattr(v, "shape") and v.shape == p._data.shape:
+                        st[k] = jax.device_put(v, sharding)
+        return st
+
+    optimizer._init_state = sharded_init
+    return optimizer
+
+
+class Strategy:
+    """Reference: auto_parallel/api.py:1583 Strategy."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = _SubConfig(config.get("sharding", {}))
+        self.fused_passes = _SubConfig(config.get("fused_passes", {}))
+        self.gradient_merge = _SubConfig(config.get("gradient_merge", {}))
+        self.pipeline = _SubConfig(config.get("pipeline", {}))
+        self.amp = _SubConfig(config.get("amp", {}))
+
+
+class _SubConfig:
+    def __init__(self, d):
+        self.enable = d.get("enable", False)
+        for k, v in d.items():
+            setattr(self, k, v)
+
+
+class DistAttr:
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Reference: api.py:2345 — returns a DistModel-style wrapper; on TPU
+    the dynamic SPMD path is already static-quality (jit), so this wraps
+    jit around the layer."""
+    from ...jit import to_static as jit_to_static
+    return jit_to_static(layer)

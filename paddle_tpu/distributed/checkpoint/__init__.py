@@ -1,0 +1,142 @@
+"""Distributed checkpoint (reference: python/paddle/distributed/
+checkpoint/ — save_state_dict.py:104, load_state_dict.py, metadata.py).
+
+Sharded save: each host writes only the shards it owns (addressable
+shards of jax.Array) plus a metadata manifest mapping tensor → shard
+files; load reassembles and re-shards onto the current mesh (reshard-on-
+load across different meshes, like the reference's converter).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ...tensor.tensor import Tensor, wrap_array
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata"]
+
+
+@dataclass
+class LocalTensorMetadata:
+    """Reference: metadata.py — one shard's placement."""
+    global_offset: List[int]
+    local_shape: List[int]
+    dtype: str
+    file_name: str
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: Dict[str, List[Dict]] = field(default_factory=dict)
+    global_shapes: Dict[str, List[int]] = field(default_factory=dict)
+    flat_mapping: Dict[str, str] = field(default_factory=dict)
+
+
+def _iter_shards(arr: jax.Array):
+    """Yield (global_offset, numpy_shard) for addressable shards."""
+    try:
+        shards = arr.addressable_shards
+    except Exception:
+        yield (0,) * arr.ndim, np.asarray(arr)
+        return
+    seen = set()
+    for s in shards:
+        idx = s.index  # tuple of slices
+        offset = tuple((sl.start or 0) for sl in idx)
+        if offset in seen:
+            continue
+        seen.add(offset)
+        yield offset, np.asarray(s.data)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id=None, async_save=False) -> None:
+    """Reference: save_state_dict.py:104."""
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    meta = Metadata()
+    data_file = os.path.join(path, f"{rank}_0.distcp")
+    payload: Dict[str, np.ndarray] = {}
+    for name, t in state_dict.items():
+        if isinstance(t, Tensor):
+            arr = t._data
+        elif isinstance(t, (int, float)):
+            meta.flat_mapping[name] = repr(t)
+            continue
+        else:
+            arr = t
+        meta.global_shapes[name] = list(arr.shape)
+        shard_metas = []
+        for i, (offset, np_shard) in enumerate(_iter_shards(arr)):
+            key = f"{name}@{rank}@{i}"
+            payload[key] = np_shard
+            shard_metas.append(asdict(LocalTensorMetadata(
+                list(offset), list(np_shard.shape), str(np_shard.dtype),
+                f"{rank}_0.distcp")))
+            payload[key] = np_shard
+        meta.state_dict_metadata[name] = shard_metas
+    np.savez(data_file, **payload)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, f"{rank}.metadata"), "w") as f:
+            json.dump(asdict(meta), f)
+
+
+def load_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id=None, offload: bool = False) -> None:
+    """Reference: load_state_dict.py — reassembles the global value per
+    tensor, then reshards onto the destination tensor's current sharding
+    (mesh may differ from save time)."""
+    metas = [f for f in os.listdir(path) if f.endswith(".metadata")]
+    if not metas:
+        raise FileNotFoundError(f"no .metadata manifest in {path}")
+    with open(os.path.join(path, metas[0])) as f:
+        meta = json.load(f)
+    # load all shard payloads
+    payloads = {}
+    for fname in os.listdir(path):
+        if fname.endswith(".distcp.npz") or fname.endswith(".distcp"):
+            real = os.path.join(path, fname)
+            if not os.path.exists(real):
+                real = real + ".npz"
+            z = np.load(real if os.path.exists(real)
+                        else os.path.join(path, fname) + ".npz")
+            payloads[fname.replace(".npz", "")] = z
+    for name, t in state_dict.items():
+        if name not in meta["state_dict_metadata"]:
+            continue
+        gshape = meta["global_shapes"][name]
+        shard_metas = meta["state_dict_metadata"][name]
+        first_dtype = shard_metas[0]["dtype"] if shard_metas else "float32"
+        full = np.zeros(gshape, dtype=first_dtype)
+        for file_key, z in payloads.items():
+            for key in z.files:
+                tname, rank_s, i_s = key.rsplit("@", 2)
+                if tname != name:
+                    continue
+                arr = z[key]
+                sm = None
+                for cand in shard_metas:
+                    if cand["local_shape"] == list(arr.shape):
+                        sm = cand
+                if sm is None:
+                    continue
+                slices = tuple(
+                    slice(o, o + s) for o, s in zip(sm["global_offset"],
+                                                    arr.shape))
+                full[slices] = arr
+        if isinstance(t, Tensor):
+            import jax.numpy as jnp
+            sharding = getattr(t._data, "sharding", None)
+            new = jnp.asarray(full).astype(t._data.dtype)
+            if sharding is not None:
+                new = jax.device_put(new, sharding)  # reshard-on-load
+            t._data = new

@@ -1,0 +1,143 @@
+"""Communication groups.
+
+Reference: paddle.distributed.collective (new_group collective.py:186,
+``Group``).  A Group names an ordered set of logical ranks.  On TPU a group
+binds to a **mesh axis** of the global device mesh: collectives executed
+inside a ``shard_map`` region use ``jax.lax`` named-axis primitives on the
+group's axis; eager collectives on sharded arrays run one-op compiled XLA
+programs over that axis (the ProcessGroupXla design, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from . import env as _env
+from . import mesh as _mesh
+
+__all__ = ["Group", "new_group", "get_group", "wait", "barrier",
+           "is_main_process", "all_groups", "destroy_group"]
+
+_groups: Dict[int, "Group"] = {}
+_gid = [0]
+_lock = threading.Lock()
+
+
+class Group:
+    """Reference: collective.py Group."""
+
+    def __init__(self, rank_in_group: int, gid: int,
+                 ranks: List[int], axis_name: Optional[str] = None,
+                 pg=None, name: Optional[str] = None):
+        self.rank = rank_in_group
+        self.id = gid
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        # mesh axis this group rides (None = process-level/world group)
+        self.axis_name = axis_name
+        self.pg = pg
+        self._name = name or f"group_{gid}"
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self.pg
+
+    def is_member(self) -> bool:
+        return True
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self) -> str:
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name}, ranks={self.ranks})")
+
+
+def _register(g: Group) -> Group:
+    with _lock:
+        _groups[g.id] = g
+    return g
+
+
+def _next_gid() -> int:
+    with _lock:
+        _gid[0] += 1
+        return _gid[0]
+
+
+_world_group: Optional[Group] = None
+
+
+def _get_world_group() -> Group:
+    global _world_group
+    if _world_group is None:
+        mesh = _mesh.get_global_mesh()
+        n = mesh.devices.size if mesh is not None else \
+            jax.local_device_count()
+        axis = None
+        if mesh is not None and len(mesh.axis_names) == 1:
+            axis = mesh.axis_names[0]
+        _world_group = _register(
+            Group(_env.get_rank(), 0, list(range(n)), axis_name=axis,
+                  name="world"))
+    return _world_group
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None, axis_name: Optional[str] = None) -> Group:
+    """Mirror of ``paddle.distributed.new_group`` with a TPU extension:
+    ``axis_name`` binds the group to a global-mesh axis so collectives on
+    it compile to ICI traffic."""
+    if ranks is None:
+        mesh = _mesh.get_global_mesh()
+        n = mesh.devices.size if mesh is not None else \
+            jax.local_device_count()
+        ranks = list(range(n))
+    gid = _next_gid()
+    me = _env.get_rank()
+    rank_in_group = list(ranks).index(me) if me in ranks else 0
+    return _register(Group(rank_in_group, gid, list(ranks),
+                           axis_name=axis_name))
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_world_group()
+    return _groups[gid]
+
+
+def all_groups() -> List[Group]:
+    return list(_groups.values())
+
+
+def destroy_group(group: Group) -> None:
+    with _lock:
+        _groups.pop(group.id, None)
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream=True):
+    """XLA is async by default; wait = block on the buffer."""
+    if hasattr(tensor, "_data"):
+        tensor._data.block_until_ready()
+    return tensor
+
+
+def barrier(group: Optional[Group] = None) -> None:
+    """Device barrier: flush outstanding work.  (Cross-process barrier uses
+    the PjRt coordination service when multi-host.)"""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def is_main_process() -> bool:
+    return _env.get_rank() == 0

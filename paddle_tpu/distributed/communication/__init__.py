@@ -1,0 +1,453 @@
+"""Explicit collective API (reference: python/paddle/distributed/
+communication/ — all_reduce.py, all_gather.py, all_to_all.py, ...).
+
+Execution contexts:
+
+* **Inside a shard_map/pjit trace** bound to the group's mesh axis (the
+  normal case — mpu layers, pipeline schedules, user shard_map code):
+  every collective maps 1:1 onto a ``jax.lax`` named-axis primitive, which
+  XLA lowers to ICI collectives (psum → AllReduce, all_gather →
+  AllGather, psum_scatter → ReduceScatter, all_to_all → AllToAll,
+  ppermute → CollectivePermute).
+
+* **Eager, on an array sharded over the group's axis**: the call compiles
+  a one-op shard_map program over the global mesh (cached by XLA) — the
+  moral equivalent of ProcessGroupNCCL's eager collective on its comm
+  stream (SURVEY.md D1 → ProcessGroupXla).
+
+* **Eager, single-process, unsharded input**: the group has one logical
+  rank worth of data in this controller; collectives are identities
+  (matching world_size=1 semantics in the reference).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...ops.dispatch import apply, as_tensor
+from ...tensor.tensor import Tensor, wrap_array
+from .. import mesh as _mesh
+from ..collective import Group, get_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "all_to_all", "all_to_all_single", "broadcast",
+           "broadcast_object_list", "reduce", "reduce_scatter", "scatter",
+           "scatter_object_list", "gather", "send", "recv", "isend",
+           "irecv", "P2POp", "batch_isend_irecv", "stream"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _axis_of(group: Optional[Group]) -> Optional[str]:
+    g = group if group is not None else get_group(0)
+    return g.axis_name
+
+
+def _group(group: Optional[Group]) -> Group:
+    return group if group is not None else get_group(0)
+
+
+def _in_axis_scope(axis: Optional[str]) -> bool:
+    if axis is None:
+        return False
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+
+
+def _is_sharded_over(arr, axis: Optional[str]) -> bool:
+    if axis is None:
+        return False
+    sh = getattr(arr, "sharding", None)
+    if sh is None or not isinstance(sh, NamedSharding):
+        return False
+    return any(axis in (s if isinstance(s, tuple) else (s,))
+               for s in sh.spec if s is not None)
+
+
+def _eager_axis_program(axis: str, body, arr, in_spec, out_spec):
+    """Run one collective over the global mesh axis as a compiled program."""
+    mesh = _mesh.get_global_mesh()
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                              out_specs=out_spec, check_vma=False))
+    return f(arr)
+
+
+def _reduce_fn(op):
+    if op == ReduceOp.SUM or op == ReduceOp.AVG:
+        return jax.lax.psum
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin
+    if op == ReduceOp.PROD:
+        return lambda a, ax: jnp.exp(jax.lax.psum(jnp.log(a), ax))
+    raise ValueError(f"unsupported ReduceOp {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True, use_calc_stream: bool = False):
+    """Mirror of paddle.distributed.all_reduce (in-place)."""
+    t = as_tensor(tensor)
+    g = _group(group)
+    axis = g.axis_name
+    rfn = _reduce_fn(op)
+    if _in_axis_scope(axis):
+        def fn(a):
+            out = rfn(a, axis)
+            if op == ReduceOp.AVG:
+                out = out / g.nranks
+            return out
+        out = apply("all_reduce", fn, t)
+        tensor._inplace_assign(out)
+        return tensor
+    if axis is not None and _is_sharded_over(t._data, axis):
+        # eager compiled collective: keep the input layout, sum across axis
+        spec = t._data.sharding.spec
+
+        def body(a):
+            out = rfn(a, axis)
+            if op == ReduceOp.AVG:
+                out = out / g.nranks
+            return out
+
+        arr = _eager_axis_program(axis, body, t._data, (spec,), spec)
+        tensor._inplace_assign(wrap_array(arr))
+        return tensor
+    # single-logical-rank world: identity
+    return tensor
+
+
+def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
+               sync_op: bool = True, axis: int = 0):
+    """paddle.distributed.all_gather(tensor_list, tensor, group)."""
+    if tensor is None:  # all_gather(tensor) concat form
+        tensor, tensor_list = tensor_list, None
+    t = as_tensor(tensor)
+    g = _group(group)
+    ax_name = g.axis_name
+    if _in_axis_scope(ax_name):
+        out = apply("all_gather",
+                    lambda a: jax.lax.all_gather(a, ax_name, axis=0,
+                                                 tiled=False), t)
+        if tensor_list is not None:
+            from ...tensor.manipulation import unstack
+            parts = unstack(out, axis=0)
+            tensor_list.clear()
+            tensor_list.extend(parts)
+            return tensor_list
+        from ...tensor.manipulation import reshape
+        sh = list(t.shape)
+        sh[0] = sh[0] * g.nranks if sh else g.nranks
+        return reshape(out, [-1] + list(t.shape[1:]))
+    # eager: single logical rank → gathered list is [tensor]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend([t])
+        return tensor_list
+    return t
+
+
+def all_gather_object(object_list, obj, group: Optional[Group] = None):
+    object_list.clear()
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    src = tensor_or_tensor_list
+    if src is None:
+        src = tensor
+    if isinstance(src, (list, tuple)):
+        from ...tensor.manipulation import concat
+        src_t = concat(list(src), axis=0)
+    else:
+        src_t = as_tensor(src)
+    if _in_axis_scope(ax):
+        def fn(a):
+            out = jax.lax.psum_scatter(a, ax, scatter_dimension=0,
+                                       tiled=True)
+            if op == ReduceOp.AVG:
+                out = out / g.nranks
+            return out
+        out = apply("reduce_scatter", fn, src_t)
+        if tensor is not src:
+            tensor._inplace_assign(out)
+            return tensor
+        return out
+    return tensor if tensor is not src else src_t
+
+
+def all_to_all(out_tensor_list, in_tensor_list,
+               group: Optional[Group] = None, sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    if _in_axis_scope(ax):
+        from ...tensor.manipulation import stack, unstack
+        stacked = stack(list(in_tensor_list), axis=0)
+        out = apply("all_to_all",
+                    lambda a: jax.lax.all_to_all(a, ax, split_axis=0,
+                                                 concat_axis=0,
+                                                 tiled=False), stacked)
+        parts = unstack(out, axis=0)
+        out_tensor_list.clear()
+        out_tensor_list.extend(parts)
+        return out_tensor_list
+    out_tensor_list.clear()
+    out_tensor_list.extend(list(in_tensor_list))
+    return out_tensor_list
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group: Optional[Group] = None,
+                      sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    t = as_tensor(in_tensor)
+    if _in_axis_scope(ax):
+        out = apply("all_to_all_single",
+                    lambda a: jax.lax.all_to_all(a, ax, split_axis=0,
+                                                 concat_axis=0, tiled=True),
+                    t)
+        out_tensor._inplace_assign(out)
+        return out_tensor
+    out_tensor._inplace_assign(t)
+    return out_tensor
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    t = as_tensor(tensor)
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+    if _in_axis_scope(ax):
+        def fn(a):
+            gathered = jax.lax.all_gather(a, ax, axis=0, tiled=False)
+            return gathered[src_in_group]
+        out = apply("broadcast", fn, t)
+        tensor._inplace_assign(out)
+        return tensor
+    return tensor
+
+
+def broadcast_object_list(object_list, src: int = 0,
+                          group: Optional[Group] = None):
+    return object_list
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    # result is required on dst; producing it everywhere is semantically
+    # safe under SPMD and free on ICI (same AllReduce)
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    if _in_axis_scope(ax):
+        from ...tensor.manipulation import stack
+        stacked = stack(list(tensor_list), axis=0)
+
+        def fn(a):
+            idx = jax.lax.axis_index(ax)
+            return jax.lax.dynamic_index_in_dim(a, idx, axis=0,
+                                                keepdims=False)
+        out = apply("scatter", fn, stacked)
+        tensor._inplace_assign(out)
+        return tensor
+    if tensor_list:
+        tensor._inplace_assign(as_tensor(tensor_list[0]))
+    return tensor
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0,
+                        group: Optional[Group] = None):
+    out_object_list.clear()
+    out_object_list.extend(in_object_list[:1])
+    return out_object_list
+
+
+def gather(tensor, gather_list=None, dst: int = 0,
+           group: Optional[Group] = None, sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    t = as_tensor(tensor)
+    if _in_axis_scope(ax):
+        out = apply("gather",
+                    lambda a: jax.lax.all_gather(a, ax, axis=0,
+                                                 tiled=False), t)
+        if gather_list is not None:
+            from ...tensor.manipulation import unstack
+            gather_list.clear()
+            gather_list.extend(unstack(out, axis=0))
+            return gather_list
+        return out
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.append(t)
+        return gather_list
+    return t
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    """Point-to-point send.  Inside a named-axis trace this pairs with the
+    matching ``recv`` as a single collective_permute (the tensor 'sent'
+    replaces the receiver's buffer); use ``p2p_send_recv`` for the fused
+    form the pipeline engine uses."""
+    g = _group(group)
+    ax = g.axis_name
+    if _in_axis_scope(ax):
+        me_src = g.rank
+        perm = [(me_src, g.get_group_rank(dst))]
+        return apply("send",
+                     lambda a: jax.lax.ppermute(a, ax, perm), as_tensor(
+                         tensor))
+    raise RuntimeError(
+        "eager point-to-point send requires a multi-process launch; in "
+        "single-controller SPMD use shard_map (pipeline engine) instead")
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    g = _group(group)
+    ax = g.axis_name
+    if _in_axis_scope(ax):
+        perm = [(g.get_group_rank(src), g.rank)]
+        out = apply("recv",
+                    lambda a: jax.lax.ppermute(a, ax, perm),
+                    as_tensor(tensor))
+        tensor._inplace_assign(out)
+        return tensor
+    raise RuntimeError(
+        "eager point-to-point recv requires a multi-process launch; in "
+        "single-controller SPMD use shard_map (pipeline engine) instead")
+
+
+def p2p_send_recv(tensor, perm: Sequence, group: Optional[Group] = None):
+    """TPU-native fused p2p: one collective_permute moving every pair at
+    once (the pipeline's send_forward+recv_forward)."""
+    g = _group(group)
+    ax = g.axis_name
+    perm = [tuple(p) for p in perm]
+    return apply("ppermute",
+                 lambda a: jax.lax.ppermute(a, ax, perm),
+                 as_tensor(tensor))
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer: int,
+                 group: Optional[Group] = None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    """Reference: communication/batch_isend_irecv.py.  All pairs fuse into
+    one collective_permute inside a named-axis trace."""
+    if not p2p_op_list:
+        return []
+    g = _group(p2p_op_list[0].group)
+    ax = g.axis_name
+    if not _in_axis_scope(ax):
+        raise RuntimeError(
+            "batch_isend_irecv outside a mesh-axis trace requires "
+            "multi-process launch")
+    perm = []
+    send_tensor = None
+    recv_ops = []
+    for op in p2p_op_list:
+        if op.op in (send, isend):
+            perm.append((g.rank, g.get_group_rank(op.peer)))
+            send_tensor = op.tensor
+        else:
+            recv_ops.append(op)
+            perm.append((g.get_group_rank(op.peer), g.rank))
+    out = p2p_send_recv(send_tensor, perm, group=g)
+    for op in recv_ops:
+        op.tensor._inplace_assign(out)
+    return []
+
+
+def isend(tensor, dst: int = 0, group: Optional[Group] = None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src: int = 0, group: Optional[Group] = None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class _StreamNamespace:
+    """paddle.distributed.stream.* variants (use_calc_stream has no analog
+    on XLA — there is one compute stream; kept for API parity)."""
+
+    @staticmethod
+    def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return all_reduce(tensor, op, group, sync_op)
+
+    @staticmethod
+    def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return all_gather(tensor_or_tensor_list, tensor, group, sync_op)
+
+    @staticmethod
+    def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                       group=None, sync_op=True, use_calc_stream=False):
+        return reduce_scatter(tensor, tensor_or_tensor_list, op, group,
+                              sync_op)
+
+    @staticmethod
+    def all_to_all(out_tensor_list, in_tensor_list, group=None,
+                   sync_op=True, use_calc_stream=False):
+        return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+    @staticmethod
+    def broadcast(tensor, src=0, group=None, sync_op=True,
+                  use_calc_stream=False):
+        return broadcast(tensor, src, group, sync_op)
+
+    @staticmethod
+    def send(tensor, dst=0, group=None, sync_op=True,
+             use_calc_stream=False):
+        return send(tensor, dst, group, sync_op)
+
+    @staticmethod
+    def recv(tensor, src=0, group=None, sync_op=True,
+             use_calc_stream=False):
+        return recv(tensor, src, group, sync_op)
+
+    @staticmethod
+    def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+        return reduce(tensor, dst, op, group, sync_op)
+
+    @staticmethod
+    def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+                sync_op=True, use_calc_stream=False):
+        return scatter(tensor, tensor_or_tensor_list, src, group, sync_op)
+
+
+stream = _StreamNamespace()

@@ -1,0 +1,112 @@
+"""Distributed environment (single-controller SPMD core).
+
+Reference analog: paddle.distributed environment (parallel.py
+init_parallel_env :945, ParallelEnv) — but TPU-native: one Python
+controller drives all local devices via jax; multi-host uses
+``jax.distributed.initialize`` (PjRt coordination service = the TCPStore
+analog).  "rank"/"world_size" are process-level (multi-host), while
+device-level parallelism is expressed with jax.sharding.Mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "ParallelEnv", "parallel_device_count",
+           "is_available", "destroy_process_group"]
+
+_initialized = [False]
+
+
+def is_available() -> bool:
+    return True
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def init_parallel_env(*args, **kwargs):
+    """Mirror of ``paddle.distributed.init_parallel_env``.
+
+    Single-host: marks the SPMD environment live (all local devices).
+    Multi-host (PADDLE_TRAINERS_NUM / coordinator env set): bootstraps
+    jax.distributed — PjRt's coordination service plays TCPStore.
+    """
+    if _initialized[0]:
+        return ParallelEnv()
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    coord = os.environ.get("PADDLE_MASTER",
+                           os.environ.get("MASTER_ADDR"))
+    if n_procs > 1 and coord:
+        port = os.environ.get("MASTER_PORT", "8701")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=n_procs,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def destroy_process_group(group=None) -> None:
+    _initialized[0] = False
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    # Device-level world size: Paddle semantics count one rank per device.
+    return int(os.environ.get("PADDLE_TRAINERS_NUM",
+                              str(jax.process_count())))
+
+
+def parallel_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """Reference: parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", "0"))
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def dev_id(self) -> int:
+        return self.device_id
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]

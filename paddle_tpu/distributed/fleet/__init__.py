@@ -1,0 +1,17 @@
+"""paddle_tpu.distributed.fleet — mirrors ``paddle.distributed.fleet``."""
+
+from .fleet import (  # noqa: F401
+    init, fleet, Fleet, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, is_first_worker, worker_index,
+    worker_num)
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode)
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .meta_parallel.parallel_layers.random import (  # noqa: F401
+    get_rng_state_tracker)
+from .meta_optimizers.hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelOptimizer, HybridParallelGradScaler, DistributedScaler)
+
+distributed_scaler = DistributedScaler

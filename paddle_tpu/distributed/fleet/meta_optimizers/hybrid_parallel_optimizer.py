@@ -1,0 +1,69 @@
+"""HybridParallelOptimizer + DistributedScaler.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:255, fleet/scaler.py.
+
+In single-controller SPMD the gradients an optimizer sees are already
+global (XLA reduced them), so cross-axis grad-norm stitching
+(_obtain_optimizer_parameters_list + per-axis allreduce of squared norms)
+collapses to the plain global-norm clip; what remains is sharding-stage-1
+state placement and the pipeline-aware no-op hooks kept for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ....nn.clip import ClipGradByGlobalNorm
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelGradScaler",
+           "DistributedScaler"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._use_sharding = hcg.get_sharding_parallel_world_size() > 1
+        if self._use_sharding:
+            from ..sharding.group_sharded import ShardingOptimizerStage1
+            self._inner_opt = ShardingOptimizerStage1(optimizer, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **kw):
+        self._inner_opt.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, s):
+        return self._inner_opt.set_state_dict(s)
+
+
+class HybridParallelGradScaler:
+    """Reference: fleet/scaler.py distributed_scaler — under SPMD the
+    found-inf flag is already global."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)
+
+
+def DistributedScaler(scaler):
+    return HybridParallelGradScaler(scaler)

@@ -1,0 +1,13 @@
+from .parallel_layers.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy)
+from .parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed)
+from .pp_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    TensorParallel, ShardingParallel, SegmentParallel)
+from .sharding.group_sharded import (  # noqa: F401
+    group_sharded_parallel, GroupShardedStage2, GroupShardedStage3,
+    GroupShardedOptimizerStage2)

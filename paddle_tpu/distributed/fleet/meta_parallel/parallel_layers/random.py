@@ -1,0 +1,71 @@
+"""Per-axis RNG tracker (reference: fleet/layers/mpu/random.py:34
+``RNGStatesTracker``) — keeps named PRNG chains so dropout inside
+tensor-parallel regions can be local (different per mp shard) or global
+(identical across shards)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+from .....framework.random import Generator
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "LOCAL_SEED", "GLOBAL_SEED"]
+
+LOCAL_SEED = "local_seed"
+GLOBAL_SEED = "global_seed"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self.states_.setdefault(n, Generator(0)).set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=GLOBAL_SEED):
+        if name not in self.states_:
+            self.add(name, hash(name) % (2 ** 31))
+        from .....framework import random as frandom
+        prev = frandom.default_generator
+        frandom.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            frandom.default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 0) -> None:
+    import numpy as np
+    from ....fleet import fleet as fleet_mod
+    global _tracker
+    _tracker.reset()
+    local = seed + 1024
+    _tracker.add(GLOBAL_SEED, seed)
+    _tracker.add(LOCAL_SEED, local)

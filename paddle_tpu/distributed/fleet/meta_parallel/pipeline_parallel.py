@@ -1,0 +1,172 @@
+"""Pipeline-parallel execution.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — PipelineParallel
+(:149), forward_backward_pipeline (:459 — 1F1B), train_batch (:697),
+_forward_step (:801), _backward_step (:853), p2p_communication.py.
+
+TPU-native execution model: in single-controller SPMD there are no
+per-stage processes exchanging activations over NCCL p2p.  Two paths:
+
+* **Eager (this class)**: microbatched forward/backward with gradient
+  accumulation.  All stages live on this controller; XLA places each
+  stage's weights on its pp-axis devices, so stage boundaries are device
+  boundaries and activation handoff is a device-to-device copy — the 1F1B
+  *numerics* (microbatching, accumulation, loss averaging) match the
+  reference exactly, while XLA's async dispatch overlaps microbatches.
+
+* **Compiled (models/ + parallel/pipeline.py)**: a shard_map program over
+  the ``pp`` mesh axis with ``ppermute`` microbatch rotation — true
+  spatial 1F1B for the flagship benchmarks and ``dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+from ....tensor.tensor import Tensor, to_tensor
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class FakeMicroDataset:
+    """Reference: pipeline_parallel.py:63 — slices a batch into
+    microbatches."""
+
+    def __init__(self, data, is_first_stage, is_last_stage,
+                 acc_steps, micro_batch_size):
+        self._data = data
+        self._acc_steps = acc_steps
+        self._micro_batch_size = micro_batch_size
+
+    def __iter__(self):
+        for i in range(self._acc_steps):
+            yield self._load_micro_batch(i)
+
+    def _slice(self, t, i):
+        if t is None:
+            return None
+        begin = i * self._micro_batch_size
+        end = begin + self._micro_batch_size
+        return t[begin:end]
+
+    def _load_micro_batch(self, i):
+        inputs, labels = self._data
+        mb_in = tuple(self._slice(x, i) for x in inputs) \
+            if isinstance(inputs, (tuple, list)) else self._slice(inputs, i)
+        mb_lab = tuple(self._slice(x, i) for x in labels) \
+            if isinstance(labels, (tuple, list)) else self._slice(labels, i)
+        return mb_in, mb_lab
+
+
+class PipelineParallel(Layer):
+    """Reference: pipeline_parallel.py:149."""
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = strategy.pipeline_configs
+        self.micro_batch_size = pp_cfg.get("micro_batch_size", 1)
+        self.accumulate_steps = pp_cfg.get("accumulate_steps", 1)
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.total_loss = None
+        self.scaler = None
+        self.add_sublayer("_layers_holder", layers)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def is_pipeline_first_stage(self):
+        return self.stage_id == 0
+
+    def is_pipeline_last_stage(self):
+        return self.stage_id == self.num_stages - 1
+
+    def _forward_step(self, micro_input, micro_label):
+        """Reference: pipeline_parallel.py:801 — runs every stage in order;
+        stage boundaries are device boundaries under the pp mesh axis."""
+        x = micro_input
+        for s in range(self.num_stages):
+            x = self._layers.forward_stage(x, s)
+        if self._layers._loss_fn is not None and micro_label is not None:
+            if isinstance(micro_label, (tuple, list)):
+                return self._layers._loss_fn(x, *micro_label)
+            return self._layers._loss_fn(x, micro_label)
+        return x
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Reference: :459 — microbatch loop with grad accumulation (the
+        1F1B interleave is a scheduling optimisation; gradients/losses are
+        identical)."""
+        self.scaler = scaler
+        total_loss = None
+        micro_dataset = FakeMicroDataset(
+            data, self.is_pipeline_first_stage(),
+            self.is_pipeline_last_stage(), self.accumulate_steps,
+            self.micro_batch_size)
+        for mb_in, mb_lab in micro_dataset:
+            if isinstance(mb_in, (tuple, list)) and len(mb_in) == 1:
+                mb_in = mb_in[0]
+            loss = self._forward_step(mb_in, mb_lab)
+            scaled = loss / self.accumulate_steps
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            scaled.backward()
+            total_loss = loss if total_loss is None else \
+                total_loss + loss.detach()
+        self.total_loss = total_loss / self.accumulate_steps
+        return self.total_loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference: :697."""
+        self._layers.train()
+        self.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        from ....autograd import tape
+        self._layers.eval()
+        with tape.no_grad_guard():
+            total = None
+            micro_dataset = FakeMicroDataset(
+                data, True, True, self.accumulate_steps,
+                self.micro_batch_size)
+            outs = []
+            for mb_in, mb_lab in micro_dataset:
+                if isinstance(mb_in, (tuple, list)) and len(mb_in) == 1:
+                    mb_in = mb_in[0]
+                if compute_loss:
+                    loss = self._forward_step(mb_in, mb_lab)
+                    total = loss if total is None else total + loss
+                else:
+                    outs.append(self._forward_step(mb_in, None))
+            if compute_loss:
+                return total / self.accumulate_steps
+            from ....tensor.manipulation import concat
+            return concat(outs, axis=0)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)

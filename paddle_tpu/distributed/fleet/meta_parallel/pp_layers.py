@@ -1,0 +1,204 @@
+"""Pipeline layer partitioning.
+
+Reference: fleet/meta_parallel/pp_layers.py — LayerDesc (:56),
+SegmentLayers (:92), PipelineLayer (:257), SharedLayerDesc.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ....nn.layer.layers import Layer, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers",
+           "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference: pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer) and not callable(layer_func):
+            raise TypeError("layer_func must be a Layer class")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Parameters shared between stages (e.g. embedding/unembedding)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Reference: pp_layers.py:92 — partitions N layer descs into stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts, (
+            "layer number should be greater than number of segments")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment by layer class name: put equal counts of that layer
+            # per stage, attach the rest greedily (reference behaviour)
+            name = self.method.split(":", 1)[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                fn = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                if getattr(fn, "__name__", "") == name:
+                    weights[i] = 1
+            total = sum(weights)
+            assert total % self.num_parts == 0, (
+                f"number of {name} layers ({total}) must divide "
+                f"num_stages ({self.num_parts})")
+            per = total // self.num_parts
+            result = [0]
+            seen = 0
+            for i, w in enumerate(weights):
+                seen += w
+                if seen == per and len(result) < self.num_parts:
+                    result.append(i + 1)
+                    seen = 0
+            result.append(len(weights))
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (
+                1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """Reference: pp_layers.py:257.
+
+    Single-controller SPMD note: this controller materialises ALL stages
+    (the mesh executes them on their pp-axis devices); ``stage_layers(i)``
+    exposes per-stage slices for the schedule, and shared-weight descs
+    alias one Parameter object across stages.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._layers_desc = list(layers)
+        self._recompute_interval = recompute_interval
+
+        seg = SegmentLayers(self._layers_desc, self._num_stages,
+                            seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # build all layers; shared descs alias parameters by key
+        self._shared: dict = {}
+        self.run_function: List = []
+        self._stage_bounds = self.segment_parts
+        for i, desc in enumerate(self._layers_desc):
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared:
+                    base = self._shared[desc.layer_name]
+                    layer = desc.build_layer()
+                    setattr(layer, desc.shared_weight_attr,
+                            getattr(base, desc.shared_weight_attr))
+                else:
+                    layer = desc.build_layer()
+                    self._shared[desc.layer_name] = layer
+                if desc.forward_func is not None:
+                    fwd = desc.forward_func
+                    layer._pp_forward_override = fwd
+                self.add_sublayer(str(i), layer)
+                self.run_function.append(layer)
+            elif isinstance(desc, LayerDesc):
+                layer = desc.build_layer()
+                self.add_sublayer(str(i), layer)
+                self.run_function.append(layer)
+            elif isinstance(desc, Layer):
+                self.add_sublayer(str(i), desc)
+                self.run_function.append(desc)
+            elif callable(desc):
+                self.run_function.append(desc)
+            else:
+                raise TypeError(f"bad layer desc {desc!r}")
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_from_index(self, layer_idx) -> int:
+        for s in range(self._num_stages):
+            if self._stage_bounds[s] <= layer_idx < \
+                    self._stage_bounds[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def stage_layers(self, stage_id: int) -> List:
+        lo, hi = (self._stage_bounds[stage_id],
+                  self._stage_bounds[stage_id + 1])
+        return self.run_function[lo:hi]
+
+    def forward_stage(self, x, stage_id: int):
+        for fn in self.stage_layers(stage_id):
+            x = self._call_one(fn, x)
+        return x
+
+    def _call_one(self, fn, x):
+        override = getattr(fn, "_pp_forward_override", None)
+        if override is not None:
+            return override(fn, x) if not isinstance(x, tuple) else \
+                override(fn, *x)
+        if isinstance(x, tuple):
+            return fn(*x)
+        return fn(x)
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = self._call_one(fn, x)
+        return x
+
+    @property
+    def parameters_by_stage(self):
+        out = []
+        for s in range(self._num_stages):
+            ps = []
+            for fn in self.stage_layers(s):
+                if isinstance(fn, Layer):
+                    ps.extend(fn.parameters())
+            out.append(ps)
+        return out
+
+    def get_shared_params(self):
+        return {k: getattr(v, "weight", None)
+                for k, v in self._shared.items()}

@@ -1,0 +1,4 @@
+from .group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model, GroupShardedStage2,
+    GroupShardedStage3, GroupShardedOptimizerStage2,
+    ShardingOptimizerStage1)

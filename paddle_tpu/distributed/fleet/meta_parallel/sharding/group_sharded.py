@@ -1,0 +1,208 @@
+"""Group-sharded (ZeRO) data parallel — stages 1/2/3.
+
+Reference: fleet/meta_parallel/sharding/ —
+``GroupShardedOptimizerStage2`` (group_sharded_optimizer_stage2.py:53),
+``GroupShardedStage2`` (group_sharded_stage2.py:46), ``GroupShardedStage3``
+(group_sharded_stage3.py:85), unified API ``group_sharded_parallel``
+(group_sharded.py:40).
+
+TPU-native realisation (SURVEY.md §7): ZeRO is a *placement policy*, not a
+communication library.  With a ``sharding`` mesh axis:
+
+* stage 1 (os):     optimizer states carry NamedSharding(P('sharding'))
+                    on dim 0 → each shard holds 1/N of every moment.
+* stage 2 (os_g):   + gradients are re-laid-out onto the same sharding
+                    right after backward (reduce-scatter happens inside
+                    XLA when the jit train step is used).
+* stage 3 (p_g_os): + parameters themselves are sharded; forward use
+                    triggers XLA's gather-on-use (AllGather fused into
+                    consumers) — the reference's prefetch hooks
+                    (group_sharded_stage3.py:555) are the compiler's job.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....nn.layer.layers import Layer
+from .....optimizer.optimizer import Optimizer
+from .....tensor.tensor import Tensor
+from ....mesh import get_global_mesh
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "GroupShardedStage2", "GroupShardedStage3",
+           "GroupShardedOptimizerStage2", "ShardingOptimizerStage1"]
+
+
+def _sharding_axis(axis_candidates=("sharding", "dp")) -> Optional[str]:
+    mesh = get_global_mesh()
+    if mesh is None:
+        return None
+    for ax in axis_candidates:
+        if ax in mesh.axis_names and mesh.shape[ax] > 1:
+            return ax
+    return None
+
+
+def _shard0(arr, axis: str):
+    """Place an array sharded on dim 0 over ``axis`` (replicate if the dim
+    doesn't divide)."""
+    mesh = get_global_mesh()
+    n = mesh.shape[axis]
+    if arr.ndim >= 1 and arr.shape[0] % n == 0:
+        return jax.device_put(
+            arr, NamedSharding(mesh, P(*([axis] + [None] *
+                                         (arr.ndim - 1)))))
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+class _ShardedStateOptimizer:
+    """Mixin wrapping an optimizer so its states are sharded on creation
+    and gradients (stage≥2) are resharded before the update."""
+
+    def __init__(self, optimizer: Optimizer, axis: str, shard_grads: bool):
+        self._inner = optimizer
+        self._axis = axis
+        self._shard_grads = shard_grads
+        orig_init = optimizer._init_state
+
+        def sharded_init(p):
+            st = orig_init(p)
+            for k, v in st.items():
+                if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1:
+                    st[k] = _shard0(v, axis)
+            return st
+
+        optimizer._init_state = sharded_init
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        if self._shard_grads:
+            for p in self._inner._params():
+                if p._grad is not None and p._grad.ndim >= 1:
+                    p._grad = _shard0(p._grad, self._axis)
+        self._inner.step()
+
+    def clear_grad(self, *a, **kw):
+        self._inner.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, s):
+        return self._inner.set_state_dict(s)
+
+
+class ShardingOptimizerStage1(_ShardedStateOptimizer):
+    """Reference: dygraph_sharding_optimizer.py:44 (stage 1)."""
+
+    def __init__(self, optimizer, hcg=None):
+        axis = _sharding_axis() or "dp"
+        super().__init__(optimizer, axis, shard_grads=False)
+
+
+class GroupShardedOptimizerStage2(_ShardedStateOptimizer):
+    """Reference: group_sharded_optimizer_stage2.py:53."""
+
+    def __init__(self, params, optim, group=None, offload=False,
+                 device="tpu", **kw):
+        axis = _sharding_axis() or "dp"
+        super().__init__(optim, axis, shard_grads=True)
+
+
+class _ShardedModelWrapper(Layer):
+    def __init__(self, layer: Layer, axis: str, shard_params: bool):
+        super().__init__()
+        self._layers = layer
+        self._axis = axis
+        mesh = get_global_mesh()
+        if shard_params and mesh is not None:
+            for _, p in layer.named_parameters():
+                p._data = _shard0(p._data, axis)
+        self.add_sublayer("_layers_holder", layer)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers_holder"], name)
+
+
+class GroupShardedStage2(_ShardedModelWrapper):
+    """Reference: group_sharded_stage2.py:46 — params stay replicated."""
+
+    def __init__(self, layer, sharding_optimizer=None, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+        super().__init__(layer, _sharding_axis() or "dp",
+                         shard_params=False)
+
+
+class GroupShardedStage3(_ShardedModelWrapper):
+    """Reference: group_sharded_stage3.py:85 — params sharded; XLA
+    all-gathers on use and frees after (remat policies can trade more)."""
+
+    def __init__(self, layer, optimizer=None, group=None,
+                 sync_buffers=False, segment_size=2 ** 20, offload=False,
+                 **kw):
+        super().__init__(layer, _sharding_axis() or "dp",
+                         shard_params=True)
+
+    def get_all_parameters(self, convert2cpu=False):
+        mesh = get_global_mesh()
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            for p in self._layers.parameters():
+                p._data = jax.device_put(p._data, rep)
+        return self._layers.parameters()
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Reference: group_sharded.py:40 — unified stage-1/2/3 entry."""
+    assert level in ("os", "os_g", "p_g_os"), (
+        f"level must be os/os_g/p_g_os, got {level}")
+    axis = _sharding_axis() or "dp"
+    if level == "os":
+        opt = ShardingOptimizerStage1(optimizer)
+        wrapped = _ShardedModelWrapper(model, axis, shard_params=False)
+    elif level == "os_g":
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer)
+        wrapped = GroupShardedStage2(model, opt)
+    else:
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer)
+        wrapped = GroupShardedStage3(model, opt)
+    return wrapped, opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from .....framework.io import save as fsave
+    os.makedirs(output, exist_ok=True)
+    target = model
+    while hasattr(target, "_layers"):
+        target = target._layers
+    fsave(target.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        fsave(optimizer.state_dict(), os.path.join(output,
+                                                   "model.pdopt"))

@@ -1,0 +1,81 @@
+"""TensorParallel / ShardingParallel / SegmentParallel wrappers.
+
+Reference: fleet/meta_parallel/tensor_parallel.py:28,
+sharding_parallel.py, segment_parallel.py:26 — thin wrappers that
+broadcast/prepare parameters.  TPU-native: parameter placement happened at
+construction (mpu layers put NamedShardings on weights); these wrappers
+replicate everything not already sharded and shard the batch over dp.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn.layer.layers import Layer
+from ....tensor.tensor import Tensor
+from ...mesh import get_global_mesh
+
+__all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel"]
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+        self.add_sublayer("_layers_holder", layers)
+
+    def _prepare_for_model(self):
+        mesh = get_global_mesh()
+        if mesh is None:
+            return
+        replicated = NamedSharding(mesh, P())
+        for _, p in self._layers.named_parameters():
+            sh = getattr(p._data, "sharding", None)
+            if not isinstance(sh, NamedSharding) or all(
+                    s is None for s in sh.spec):
+                p._data = jax.device_put(p._data, replicated)
+        for _, b in self._layers.named_buffers():
+            b._data = jax.device_put(b._data, replicated)
+
+    def _shard_batch(self, t):
+        mesh = get_global_mesh()
+        if mesh is None or not isinstance(t, Tensor):
+            return t
+        if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 and \
+                t.ndim >= 1 and t.shape[0] % mesh.shape["dp"] == 0:
+            spec = P(*(["dp"] + [None] * (t.ndim - 1)))
+            t._data = jax.device_put(t._data, NamedSharding(mesh, spec))
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_batch(i) for i in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers_holder"], name)
+
+
+class TensorParallel(_MetaParallelBase):
+    """Reference: tensor_parallel.py:28."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """Reference: sharding_parallel.py."""
+
+
+class SegmentParallel(_MetaParallelBase):
+    """Reference: segment_parallel.py:26 — sep-axis wrapper; attention
+    all-to-all lives in model code over the sep group."""

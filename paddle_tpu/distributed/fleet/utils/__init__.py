@@ -1,0 +1,34 @@
+from . import sequence_parallel_utils  # noqa: F401
+from .sequence_parallel_utils import (  # noqa: F401
+    ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks)
+
+
+def recompute(function, *args, **kwargs):
+    """Reference: fleet/utils/__init__.py recompute -> jax.checkpoint.
+
+    Rematerialises the wrapped forward during backward to trade FLOPs for
+    activation memory (the TPU-native form of Paddle's recompute)."""
+    import jax
+    from ....ops.dispatch import apply, as_tensor
+    from ....tensor.tensor import Tensor
+    preserve = kwargs.pop("preserve_rng_state", True)  # parity arg
+    use_reentrant = kwargs.pop("use_reentrant", True)  # parity arg
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [None if isinstance(a, Tensor) else a for a in args]
+
+    def fn(*arrs):
+        from ....tensor.tensor import wrap_array
+        it = iter(arrs)
+        call = [wrap_array(next(it)) if o is None else o for o in other]
+        from ....autograd import tape
+        with tape.functional_trace_guard():
+            out = function(*call, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(t._data for t in out)
+        return out._data
+
+    ck = jax.checkpoint(fn)
+    return apply("recompute", ck, *tensor_args)

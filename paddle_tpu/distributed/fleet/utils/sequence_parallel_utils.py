@@ -1,0 +1,137 @@
+"""Megatron-style sequence parallelism utilities.
+
+Reference: fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-137),
+ColumnSequenceParallelLinear (:427), RowSequenceParallelLinear (:562),
+register_sequence_parallel_allreduce_hooks (:192).
+
+TPU-native: activations between TP blocks are sharded along the sequence
+dim on the ``mp`` axis by sharding *constraints*; XLA emits the same
+all-gather / reduce-scatter pairs the PyLayers implement by hand, and
+fuses them with the adjoining matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ....ops.dispatch import apply, as_tensor
+from ...mesh import get_global_mesh
+from ..meta_parallel.parallel_layers.mp_layers import (_mp_axis,
+                                                       _shard_param,
+                                                       _constrain)
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _seq_spec(ndim, ax):
+    # activations are [s, b, h] in the reference's SP regions
+    return P(*([ax] + [None] * (ndim - 1)))
+
+
+class ScatterOp:
+    """Split activation along seq dim across mp (reference :85)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax = _mp_axis()
+        if ax is None:
+            return x
+        return _constrain(x, _seq_spec(as_tensor(x).ndim, ax))
+
+
+class GatherOp:
+    """Gather seq-sharded activation to full (reference :107)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax = _mp_axis()
+        if ax is None:
+            return x
+        return _constrain(x, P())
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return GatherOp.apply(x)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return ScatterOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, fuse_allreduce=False):
+    """Under SPMD gradients of sequence-parallel params (LayerNorm etc.)
+    are reduced by XLA automatically — kept as a no-op for parity
+    (reference :192)."""
+    return
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Reference :427: input seq-sharded → all-gather → column matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        ax = _mp_axis()
+        if ax:
+            _shard_param(self.weight, P(None, ax))
+            if self.bias is not None:
+                _shard_param(self.bias, P(ax))
+
+    def forward(self, x):
+        ax = _mp_axis()
+        if ax:
+            x = _constrain(x, P())  # all-gather along seq
+        out = F.linear(x, self.weight, self.bias)
+        if ax:
+            out = _constrain(out, P(*([None] * (out.ndim - 1) + [ax])))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Reference :562: row matmul → reduce-scatter onto seq dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        ax = _mp_axis()
+        if ax:
+            _shard_param(self.weight, P(ax, None))
+
+    def forward(self, x):
+        ax = _mp_axis()
+        out = F.linear(x, self.weight, None)
+        if ax:
+            # reduce-scatter: output seq-sharded with partials summed
+            out = _constrain(out, _seq_spec(out.ndim, ax))
+        if self.bias is not None:
+            from ....tensor.math import add
+            out = add(out, self.bias)
+        return out

@@ -1,0 +1,77 @@
+"""Global device-mesh registry.
+
+TPU-native substrate for the reference's communicator machinery
+(NCCLCommContext / CommContextManager — SURVEY.md D1): instead of per-group
+NCCL communicators there is ONE global ``jax.sharding.Mesh`` whose named
+axes carry every parallelism dimension; a "communication group" is a mesh
+axis (or sub-axis tuple).  Collectives lower onto ICI via XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["build_global_mesh", "get_global_mesh", "set_global_mesh",
+           "default_mesh", "axis_size"]
+
+_global_mesh: Optional[Mesh] = None
+
+
+def build_global_mesh(axis_dims: Dict[str, int],
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """Create and install the global mesh.
+
+    ``axis_dims``: ordered {axis_name: size}; sizes of -1 are inferred.
+    Axis order follows the reference fleet topology convention
+    [dp, pp, sharding, sep, mp] (topology.py:65) — the *last* axis is
+    innermost (fastest-varying = physically closest devices), which puts
+    tensor-parallel traffic on the shortest ICI hops.
+    """
+    global _global_mesh
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axis_dims.keys())
+    dims = list(axis_dims.values())
+    n = len(devices)
+    unknown = [i for i, d in enumerate(dims) if d in (-1, None)]
+    known = int(np.prod([d for d in dims if d not in (-1, None)])) or 1
+    if unknown:
+        rem = n // known
+        for i in unknown[:-1]:
+            dims[i] = 1
+        dims[unknown[-1]] = rem
+    total = int(np.prod(dims))
+    if total != n:
+        raise ValueError(
+            f"mesh dims {dict(zip(names, dims))} need {total} devices, "
+            f"have {n}")
+    arr = np.array(devices).reshape(dims)
+    _global_mesh = Mesh(arr, axis_names=tuple(names))
+    return _global_mesh
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def default_mesh(axis_name: str = "dp") -> Mesh:
+    """The lazy default: all devices on one data-parallel axis."""
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = Mesh(np.array(jax.devices()), (axis_name,))
+    return _global_mesh
+
+
+def axis_size(name: str) -> int:
+    mesh = get_global_mesh()
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return mesh.shape[name]

@@ -1,0 +1,79 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:202).
+
+TPU-native: no EagerReducer / bucketed allreduce.  The wrapper replicates
+parameters across the mesh's data axis and shards each input batch over
+it; XLA then runs every op SPMD and inserts ONE fused gradient AllReduce
+per backward (the compiler already does the bucketing the reference's
+reducer.h:88 does by hand).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from . import mesh as _mesh
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, axis_name: str = "dp"):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        mesh = _mesh.get_global_mesh()
+        if mesh is None or axis_name not in mesh.axis_names:
+            mesh = _mesh.default_mesh(axis_name)
+        self._mesh = mesh
+        self._axis = axis_name
+        self._replicated = NamedSharding(mesh, P())
+        self._batch_sharded = NamedSharding(mesh, P(axis_name))
+        # replicate parameters and buffers across the data axis
+        for _, p in layers.named_parameters():
+            p._data = jax.device_put(p._data, self._replicated)
+        for _, b in layers.named_buffers():
+            b._data = jax.device_put(b._data, self._replicated)
+        self.add_sublayer("_layers_holder", layers)
+
+    def _shard_input(self, t):
+        if isinstance(t, Tensor):
+            n = self._mesh.shape[self._axis]
+            if t.ndim >= 1 and t.shape[0] % n == 0:
+                t._data = jax.device_put(t._data, self._batch_sharded)
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(i) for i in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    # delegation for parity
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers_holder"], name)

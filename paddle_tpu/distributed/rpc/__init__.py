@@ -1,0 +1,60 @@
+"""Minimal RPC (reference: python/paddle/distributed/rpc/rpc.py) —
+in-process executor for single-controller; cross-host RPC requires a
+multi-host launch (documented limitation)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "get_current_worker_info"]
+
+_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_name = "worker0"
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+def init_rpc(name: str, rank: int = 0, world_size: int = 1,
+             master_endpoint: Optional[str] = None) -> None:
+    global _pool, _name
+    _name = name
+    _pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+
+
+def rpc_sync(to: str, fn: Callable, args=None, kwargs=None,
+             timeout=-1) -> Any:
+    return fn(*(args or ()), **(kwargs or {}))
+
+
+def rpc_async(to: str, fn: Callable, args=None, kwargs=None, timeout=-1):
+    if _pool is None:
+        raise RuntimeError("call init_rpc first")
+    return _pool.submit(fn, *(args or ()), **(kwargs or {}))
+
+
+def shutdown() -> None:
+    global _pool
+    if _pool is not None:
+        _pool.shutdown()
+        _pool = None
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    return WorkerInfo(name or _name, 0)
+
+
+def get_all_worker_infos():
+    return [get_worker_info()]
+
+
+def get_current_worker_info():
+    return get_worker_info()

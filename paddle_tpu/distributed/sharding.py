@@ -1,0 +1,7 @@
+"""paddle.distributed.sharding namespace (reference: distributed/
+sharding/__init__.py re-exporting group_sharded_parallel)."""
+
+from .fleet.meta_parallel.sharding.group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

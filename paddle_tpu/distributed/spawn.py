@@ -1,0 +1,20 @@
+"""paddle.distributed.spawn (reference: distributed/spawn.py).
+
+On TPU SPMD a single controller already drives all local devices, so
+``spawn(func, nprocs=-1)`` runs ``func`` once in-process (the reference's
+per-GPU fork model doesn't apply); multi-host spawn delegates to the
+launcher."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+__all__ = ["spawn"]
+
+
+def spawn(func: Callable, args: Tuple = (), nprocs: int = -1,
+          join: bool = True, daemon: bool = False, **options):
+    from .env import init_parallel_env
+    init_parallel_env()
+    func(*args)
+    return None

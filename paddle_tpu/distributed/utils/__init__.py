@@ -1,0 +1,33 @@
+"""distributed.utils — helpers incl. MoE dispatch collectives
+(reference: distributed/utils/moe_utils.py:20 global_scatter, :153
+global_gather)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply, as_tensor
+from ..collective import Group
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def global_scatter(x, local_count, global_count, group: Optional[Group] =
+                   None):
+    """MoE all-to-all dispatch (reference: moe_utils.py:20).  Inside a
+    mesh-axis trace this is lax.all_to_all on the expert axis; counts are
+    static per step under jit."""
+    from ..communication import all_to_all_single
+    out = as_tensor(x)._wrap_like(as_tensor(x)._data)
+    return all_to_all_single(out, x, group=group)
+
+
+def global_gather(x, local_count, global_count, group: Optional[Group] =
+                  None):
+    """Inverse of global_scatter (reference: moe_utils.py:153)."""
+    from ..communication import all_to_all_single
+    out = as_tensor(x)._wrap_like(as_tensor(x)._data)
+    return all_to_all_single(out, x, group=group)

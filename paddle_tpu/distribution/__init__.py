@@ -1,0 +1,922 @@
+"""paddle_tpu.distribution — probability distributions + KL registry.
+
+Reference: python/paddle/distribution/ (27 distributions, kl.py registry,
+transform.py).  Sampling uses the framework PRNG; densities are jnp
+compositions (differentiable; rsample via reparameterisation where the
+reference provides it)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as frandom
+from ..ops.dispatch import apply, as_tensor
+from ..tensor.tensor import Tensor, wrap_array
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Beta", "Gamma", "Dirichlet", "Exponential", "Laplace", "LogNormal",
+    "Multinomial", "Poisson", "Geometric", "Cauchy", "Gumbel", "StudentT",
+    "Binomial", "ContinuousBernoulli", "Chi2", "ExponentialFamily",
+    "TransformedDistribution", "Independent", "MultivariateNormal",
+    "kl_divergence", "register_kl",
+]
+
+
+def _t(x):
+    return as_tensor(x) if not isinstance(x, Tensor) else x
+
+
+def _arr(x):
+    return _t(x)._data if x is not None else None
+
+
+def _key():
+    return frandom.next_key()
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    """Reference: distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..tensor.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        from ..tensor.math import square
+        return square(self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self._batch_shape)
+        key = _key()
+        return apply("normal_sample",
+                     lambda l, s: l + s * jax.random.normal(
+                         key, sh, jnp.float32),
+                     self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(
+            "normal_logprob",
+            lambda v, l, s: -((v - l) ** 2) / (2 * s ** 2) - jnp.log(s) -
+            0.5 * math.log(2 * math.pi),
+            _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply("normal_entropy",
+                     lambda s: 0.5 + 0.5 * math.log(2 * math.pi) +
+                     jnp.log(s) + jnp.zeros(self._batch_shape), self.scale)
+
+    def cdf(self, value):
+        return apply("normal_cdf",
+                     lambda v, l, s: jax.scipy.stats.norm.cdf(v, l, s),
+                     _t(value), self.loc, self.scale)
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        from ..tensor.math import exp
+        return exp(super().sample(shape))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return apply("lognormal_mean",
+                     lambda l, s: jnp.exp(l + s ** 2 / 2), self.loc,
+                     self.scale)
+
+    @property
+    def variance(self):
+        return apply("lognormal_var",
+                     lambda l, s: (jnp.exp(s ** 2) - 1) *
+                     jnp.exp(2 * l + s ** 2), self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply(
+            "lognormal_logprob",
+            lambda v, l, s: jax.scipy.stats.norm.logpdf(jnp.log(v), l, s) -
+            jnp.log(v), _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply("lognormal_entropy",
+                     lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi) +
+                     jnp.log(s) + l, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        shape = np.broadcast_shapes(tuple(self.low.shape),
+                                    tuple(self.high.shape))
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        from ..tensor.math import add, multiply
+        return multiply(add(self.low, self.high), 0.5)
+
+    @property
+    def variance(self):
+        return apply("uniform_var",
+                     lambda l, h: (h - l) ** 2 / 12, self.low, self.high)
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self._batch_shape)
+        key = _key()
+        return apply("uniform_sample",
+                     lambda l, h: l + (h - l) * jax.random.uniform(
+                         key, sh, jnp.float32), self.low, self.high)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(
+            "uniform_logprob",
+            lambda v, l, h: jnp.where((v >= l) & (v < h),
+                                      -jnp.log(h - l), -jnp.inf),
+            _t(value), self.low, self.high)
+
+    def entropy(self):
+        return apply("uniform_entropy", lambda l, h: jnp.log(h - l),
+                     self.low, self.high)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return apply("bern_var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self._batch_shape)
+        key = _key()
+        return apply("bern_sample",
+                     lambda p: jax.random.bernoulli(
+                         key, p, sh).astype(jnp.float32), self.probs)
+
+    def log_prob(self, value):
+        return apply(
+            "bern_logprob",
+            lambda v, p: v * jnp.log(jnp.clip(p, 1e-12)) +
+            (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12)),
+            _t(value), self.probs)
+
+    def entropy(self):
+        return apply(
+            "bern_entropy",
+            lambda p: -(p * jnp.log(jnp.clip(p, 1e-12)) +
+                        (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12))),
+            self.probs)
+
+
+class ContinuousBernoulli(Bernoulli):
+    def log_prob(self, value):
+        def fn(v, p):
+            base = v * jnp.log(jnp.clip(p, 1e-12)) + \
+                (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12))
+            # normalising constant C(p)
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            c = jnp.where(
+                jnp.abs(safe - 0.5) < 1e-3,
+                jnp.log(2.0) + jnp.zeros_like(safe),
+                jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)))
+            return base + c
+        return apply("cbern_logprob", fn, _t(value), self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    @property
+    def probs(self):
+        from ..nn.functional import softmax
+        return softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("cat_sample",
+                     lambda lg: jax.random.categorical(
+                         key, jnp.log(jax.nn.softmax(lg, -1) + 1e-30),
+                         shape=sh).astype(jnp.int64), self.logits)
+
+    def log_prob(self, value):
+        return apply(
+            "cat_logprob",
+            lambda v, lg: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, -1),
+                v.astype(jnp.int32)[..., None], axis=-1)[..., 0],
+            _t(value), self.logits)
+
+    def entropy(self):
+        return apply(
+            "cat_entropy",
+            lambda lg: -jnp.sum(jax.nn.softmax(lg, -1) *
+                                jax.nn.log_softmax(lg, -1), axis=-1),
+            self.logits)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         (self.probs.shape[-1],))
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return apply("multinom_mean", lambda p: n * p, self.probs)
+
+    def sample(self, shape=()):
+        key = _key()
+        n = self.total_count
+
+        def fn(p):
+            logits = jnp.log(jnp.clip(p, 1e-30))
+            draws = jax.random.categorical(
+                key, logits, shape=tuple(shape) + (n,) +
+                tuple(self._batch_shape))
+            k = p.shape[-1]
+            oh = jax.nn.one_hot(draws, k)
+            return jnp.sum(oh, axis=len(shape)).astype(jnp.float32)
+
+        return apply("multinom_sample", fn, self.probs)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            logp = jnp.log(jnp.clip(p, 1e-30))
+            return (jax.scipy.special.gammaln(jnp.sum(v, -1) + 1) -
+                    jnp.sum(jax.scipy.special.gammaln(v + 1), -1) +
+                    jnp.sum(v * logp, -1))
+        return apply("multinom_logprob", fn, _t(value), self.probs)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        shape = np.broadcast_shapes(tuple(self.alpha.shape),
+                                    tuple(self.beta.shape))
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return apply("beta_mean", lambda a, b: a / (a + b), self.alpha,
+                     self.beta)
+
+    @property
+    def variance(self):
+        return apply("beta_var",
+                     lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                     self.alpha, self.beta)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("beta_sample",
+                     lambda a, b: jax.random.beta(key, a, b, sh),
+                     self.alpha, self.beta)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply("beta_logprob",
+                     lambda v, a, b: jax.scipy.stats.beta.logpdf(v, a, b),
+                     _t(value), self.alpha, self.beta)
+
+    def entropy(self):
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            lb = (jax.scipy.special.gammaln(a) +
+                  jax.scipy.special.gammaln(b) -
+                  jax.scipy.special.gammaln(a + b))
+            return (lb - (a - 1) * dg(a) - (b - 1) * dg(b) +
+                    (a + b - 2) * dg(a + b))
+        return apply("beta_entropy", fn, self.alpha, self.beta)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        shape = np.broadcast_shapes(tuple(self.concentration.shape),
+                                    tuple(self.rate.shape))
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return apply("gamma_mean", lambda c, r: c / r,
+                     self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply("gamma_var", lambda c, r: c / r ** 2,
+                     self.concentration, self.rate)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("gamma_sample",
+                     lambda c, r: jax.random.gamma(key, c, sh) / r,
+                     self.concentration, self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(
+            "gamma_logprob",
+            lambda v, c, r: jax.scipy.stats.gamma.logpdf(v, c,
+                                                         scale=1.0 / r),
+            _t(value), self.concentration, self.rate)
+
+    def entropy(self):
+        def fn(c, r):
+            dg = jax.scipy.special.digamma
+            return (c - jnp.log(r) + jax.scipy.special.gammaln(c) +
+                    (1 - c) * dg(c))
+        return apply("gamma_entropy", fn, self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df_t = _t(df)
+        from ..tensor.math import multiply
+        half = apply("half", lambda d: d / 2.0, df_t)
+        ones_rate = apply("chi2_rate", lambda d: jnp.full_like(d, 0.5),
+                          df_t)
+        super().__init__(half, ones_rate)
+        self.df = df_t
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         (self.concentration.shape[-1],))
+
+    @property
+    def mean(self):
+        return apply("dirichlet_mean",
+                     lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = tuple(shape) + tuple(self._batch_shape)
+        return apply("dirichlet_sample",
+                     lambda c: jax.random.dirichlet(key, c, sh),
+                     self.concentration)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(
+            "dirichlet_logprob",
+            lambda v, c: jax.scipy.stats.dirichlet.logpdf(
+                jnp.moveaxis(v, -1, 0), c), _t(value),
+            self.concentration)
+
+    def entropy(self):
+        def fn(c):
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            dg = jax.scipy.special.digamma
+            lb = jnp.sum(jax.scipy.special.gammaln(c), -1) - \
+                jax.scipy.special.gammaln(c0)
+            return (lb + (c0 - k) * dg(c0) -
+                    jnp.sum((c - 1) * dg(c), -1))
+        return apply("dirichlet_entropy", fn, self.concentration)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return apply("exp_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply("exp_var", lambda r: 1.0 / r ** 2, self.rate)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("exp_sample",
+                     lambda r: jax.random.exponential(key, sh) / r,
+                     self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply("exp_logprob",
+                     lambda v, r: jnp.where(v >= 0, jnp.log(r) - r * v,
+                                            -jnp.inf),
+                     _t(value), self.rate)
+
+    def entropy(self):
+        return apply("exp_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply("laplace_var", lambda s: 2 * s ** 2, self.scale)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("laplace_sample",
+                     lambda l, s: l + s * jax.random.laplace(
+                         key, sh, jnp.float32), self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply("laplace_logprob",
+                     lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                     _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply("laplace_entropy",
+                     lambda s: 1 + jnp.log(2 * s), self.scale)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    variance = mean
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("poisson_sample",
+                     lambda r: jax.random.poisson(key, r, sh).astype(
+                         jnp.float32), self.rate)
+
+    def log_prob(self, value):
+        return apply("poisson_logprob",
+                     lambda v, r: jax.scipy.stats.poisson.logpmf(v, r),
+                     _t(value), self.rate)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return apply("geom_mean", lambda p: 1.0 / p, self.probs)
+
+    @property
+    def variance(self):
+        return apply("geom_var", lambda p: (1 - p) / p ** 2, self.probs)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("geom_sample",
+                     lambda p: jnp.floor(
+                         jnp.log1p(-jax.random.uniform(key, sh)) /
+                         jnp.log1p(-p)), self.probs)
+
+    def log_prob(self, value):
+        return apply("geom_logprob",
+                     lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                     _t(value), self.probs)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("cauchy_sample",
+                     lambda l, s: l + s * jax.random.cauchy(
+                         key, sh, jnp.float32), self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(
+            "cauchy_logprob",
+            lambda v, l, s: jax.scipy.stats.cauchy.logpdf(v, l, s),
+            _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply("cauchy_entropy",
+                     lambda s: jnp.log(4 * math.pi * s), self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return apply("gumbel_mean",
+                     lambda l, s: l + s * np.euler_gamma, self.loc,
+                     self.scale)
+
+    @property
+    def variance(self):
+        return apply("gumbel_var",
+                     lambda s: (math.pi ** 2 / 6) * s ** 2, self.scale)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("gumbel_sample",
+                     lambda l, s: l + s * jax.random.gumbel(
+                         key, sh, jnp.float32), self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply("gumbel_logprob", fn, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply("gumbel_entropy",
+                     lambda s: jnp.log(s) + 1 + np.euler_gamma,
+                     self.scale)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = np.broadcast_shapes(tuple(self.df.shape),
+                                    tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("studentt_sample",
+                     lambda d, l, s: l + s * jax.random.t(
+                         key, d, sh, jnp.float32),
+                     self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply(
+            "studentt_logprob",
+            lambda v, d, l, s: jax.scipy.stats.t.logpdf(v, d, l, s),
+            _t(value), self.df, self.loc, self.scale)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return apply("binom_mean", lambda n, p: n * p, self.total_count,
+                     self.probs)
+
+    @property
+    def variance(self):
+        return apply("binom_var", lambda n, p: n * p * (1 - p),
+                     self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = _shape(shape, self._batch_shape)
+        return apply("binom_sample",
+                     lambda n, p: jax.random.binomial(
+                         key, n.astype(jnp.float32), p, sh),
+                     self.total_count, self.probs)
+
+    def log_prob(self, value):
+        return apply(
+            "binom_logprob",
+            lambda v, n, p: jax.scipy.stats.binom.logpmf(v, n, p),
+            _t(value), self.total_count, self.probs)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        else:
+            cov = _t(covariance_matrix)
+            self.scale_tril = apply("chol", jnp.linalg.cholesky, cov)
+        super().__init__(tuple(self.loc.shape[:-1]),
+                         (self.loc.shape[-1],))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        key = _key()
+        sh = tuple(shape) + tuple(self._batch_shape) + \
+            tuple(self._event_shape)
+        return apply(
+            "mvn_sample",
+            lambda l, st: l + jnp.einsum(
+                "...ij,...j->...i", st,
+                jax.random.normal(key, sh, jnp.float32)),
+            self.loc, self.scale_tril)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, st):
+            d = v - l
+            sol = jax.scipy.linalg.solve_triangular(st, d[..., None],
+                                                    lower=True)[..., 0]
+            k = l.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(st, axis1=-2,
+                                                  axis2=-1)), -1)
+            return (-0.5 * jnp.sum(sol ** 2, -1) - logdet -
+                    0.5 * k * math.log(2 * math.pi))
+        return apply("mvn_logprob", fn, _t(value), self.loc,
+                     self.scale_tril)
+
+    def entropy(self):
+        def fn(st):
+            k = st.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(st, axis1=-2,
+                                                  axis2=-1)), -1)
+            return 0.5 * k * (1 + math.log(2 * math.pi)) + logdet
+        return apply("mvn_entropy", fn, self.scale_tril)
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(tuple(bs[:-reinterpreted_batch_rank]),
+                         tuple(bs[-reinterpreted_batch_rank:]))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        from ..tensor.math import sum as tsum
+        return tsum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        from ..tensor.math import sum as tsum
+        return tsum(ent, axis=tuple(range(-self.rank, 0)))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list,
+                                                                tuple)) \
+            else [transforms]
+        super().__init__(tuple(base.batch_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = None
+        x = value
+        for t in reversed(self.transforms):
+            y = x
+            x = t.inverse(y)
+            term = t.forward_log_det_jacobian(x)
+            lp = term if lp is None else lp + term
+        base_lp = self.base.log_prob(x)
+        from ..tensor.math import subtract
+        return subtract(base_lp, lp)
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# KL registry (reference: distribution/kl.py)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for {type(p).__name__} || {type(q).__name__}")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return apply(
+        "kl_normal",
+        lambda pl, ps, ql, qs: (jnp.log(qs / ps) +
+                                (ps ** 2 + (pl - ql) ** 2) /
+                                (2 * qs ** 2) - 0.5),
+        p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return apply(
+        "kl_uniform",
+        lambda pl, ph, ql, qh: jnp.where(
+            (ql <= pl) & (ph <= qh),
+            jnp.log((qh - ql) / (ph - pl)), jnp.inf),
+        p.low, p.high, q.low, q.high)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return apply(
+        "kl_cat",
+        lambda pl, ql: jnp.sum(
+            jax.nn.softmax(pl, -1) *
+            (jax.nn.log_softmax(pl, -1) - jax.nn.log_softmax(ql, -1)),
+            -1), p.logits, q.logits)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qp):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return pp * jnp.log(pp / qp) + (1 - pp) * jnp.log(
+            (1 - pp) / (1 - qp))
+    return apply("kl_bern", fn, p.probs, q.probs)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return (g(qa) + g(qb) - g(qa + qb) -
+                (g(pa) + g(pb) - g(pa + pb)) +
+                (pa - qa) * dg(pa) + (pb - qb) * dg(pb) +
+                (qa + qb - pa - pb) * dg(pa + pb))
+    return apply("kl_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return apply("kl_exp",
+                 lambda pr, qr: jnp.log(pr / qr) + qr / pr - 1,
+                 p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def fn(pc, pr, qc, qr):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return ((pc - qc) * dg(pc) - g(pc) + g(qc) +
+                qc * (jnp.log(pr) - jnp.log(qr)) + pc * (qr - pr) / pr)
+    return apply("kl_gamma", fn, p.concentration, p.rate,
+                 q.concentration, q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def fn(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs / ps) + d / qs +
+                ps / qs * jnp.exp(-d / ps) - 1)
+    return apply("kl_laplace", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(pc, qc):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        p0 = jnp.sum(pc, -1)
+        q0 = jnp.sum(qc, -1)
+        return (g(p0) - jnp.sum(g(pc), -1) - g(q0) +
+                jnp.sum(g(qc), -1) +
+                jnp.sum((pc - qc) * (dg(pc) - dg(p0)[..., None]), -1))
+    return apply("kl_dirichlet", fn, p.concentration, q.concentration)

@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:743,
+:985) — pickle-based serialization of state_dicts / nested structures with
+Tensors stored as numpy arrays."""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..tensor.tensor import Tensor, wrap_array
+
+__all__ = ["save", "load"]
+
+_PROTO_TAG = "paddle_tpu.Tensor"
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__type__": _PROTO_TAG, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient, "name": obj.name,
+                "dtype": str(obj.dtype)}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__type__") == _PROTO_TAG:
+            import jax.numpy as jnp
+            t = wrap_array(jnp.asarray(obj["data"]),
+                           stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path, protocol: int = 4, **configs) -> None:
+    """Mirror of ``paddle.save``."""
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs) -> Any:
+    """Mirror of ``paddle.load``."""
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path))
+    with open(str(path), "rb") as f:
+        return _unpack(pickle.load(f))

@@ -1,0 +1,71 @@
+"""Parameter and ParamAttr.
+
+Reference: ``paddle.base.framework.EagerParamBase`` / ``ParamAttr``
+(python/paddle/base/framework.py, python/paddle/base/param_attr.py).
+A Parameter is a trainable Tensor (stop_gradient=False, persistable).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Parameter", "ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr) -> Optional["ParamAttr"]:
+        if attr is None:
+            return ParamAttr()
+        if attr is False:
+            return None
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an initializer instance
+        return ParamAttr(initializer=attr)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: EagerParamBase framework.py)."""
+
+    def __init__(self, data: Any = None, dtype: Any = None,
+                 name: Optional[str] = None, trainable: bool = True,
+                 attr: Optional[ParamAttr] = None):
+        super().__init__(data, dtype=dtype,
+                         stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self._is_param = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate":
+                              attr.learning_rate if attr else 1.0}
+        self.regularizer = attr.regularizer if attr else None
+        self.need_clip = attr.need_clip if attr else True
+        self.is_distributed = False
+        self.is_firstly_shared = False
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool) -> None:
+        self.stop_gradient = not v
+
+    def __repr__(self) -> str:
+        base = super().__repr__()
+        return "Parameter containing:\n" + base

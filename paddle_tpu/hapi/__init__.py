@@ -1,0 +1,4 @@
+from .model import Model, summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, VisualDL)

@@ -1,0 +1,28 @@
+"""paddle_tpu.incubate — fused ops, MoE, autograd extensions.
+
+Reference: python/paddle/incubate/ — nn/functional fused kernels
+(fused_rms_norm, fused_rotary_position_embedding, swiglu,
+masked_multihead_attention ...), distributed/models/moe, asp sparsity,
+autograd.primapi.
+
+On TPU "fused" means "expressed so XLA/Pallas fuses it": these entry
+points route to the same jnp/Pallas implementations the core uses.
+"""
+
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
+from ..optimizer.optimizer import LBFGS  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..ops.dispatch import apply, as_tensor
+    import jax
+    import jax.numpy as jnp
+
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -jnp.inf), axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", fn, as_tensor(x))

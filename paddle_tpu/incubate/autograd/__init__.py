@@ -1,0 +1,24 @@
+"""incubate.autograd (reference: incubate/autograd/primapi.py) — forward
+and higher-order functional autograd, native on jax."""
+
+from ...autograd import jacobian, hessian, vjp, jvp  # noqa: F401
+
+
+def forward_grad(func, xs, v=None):
+    return jvp(func, xs, v)
+
+
+def grad(func, xs, v=None):
+    return vjp(func, xs, v)
+
+
+def enable_prim():
+    pass
+
+
+def disable_prim():
+    pass
+
+
+def prim_enabled():
+    return True

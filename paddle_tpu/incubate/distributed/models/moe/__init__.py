@@ -1,0 +1,147 @@
+"""Mixture-of-Experts (reference: incubate/distributed/models/moe/ —
+MoELayer moe_layer.py:263, gates gshard_gate.py:31 / switch_gate.py /
+naive_gate.py, dispatch via global_scatter/global_gather all-to-all).
+
+TPU-native: expert weights are stacked along the expert dim and sharded
+over the ``ep``/``mp`` mesh axis; token dispatch is dense one-hot combine
+(einsum — MXU-friendly) with capacity dropping.  Under a mesh the
+all-to-all is inserted by XLA when tokens reshard from the data axis to
+the expert axis — the role of the reference's global_scatter/global_gather
+CUDA kernels (moe_utils.py:20,:153).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer.layers import Layer, LayerList
+from .....nn import functional as F
+from .....ops.dispatch import apply, as_tensor
+from .....tensor.tensor import Tensor
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
+           "BaseGate"]
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_expert: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+
+
+class NaiveGate(BaseGate):
+    """Reference: gate/naive_gate.py — plain top-k softmax gate."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert)
+        from .....nn import Linear
+        self.gate = Linear(d_model, num_expert)
+        self.top_k = topk
+
+    def forward(self, x):
+        return self.gate(x)
+
+
+class GShardGate(NaiveGate):
+    """Reference: gate/gshard_gate.py:31 — top-2 with capacity + aux loss
+    (load balancing)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    """Reference: gate/switch_gate.py — top-1 switch routing."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, 1)
+        self.switch_eps = switch_eps
+
+
+class MoELayer(Layer):
+    """Reference: moe_layer.py:263.
+
+    ``experts``: list of expert Layers (same architecture).  Forward:
+    gate → top-k dispatch (one-hot combine with capacity) → experts →
+    weighted combine.  The auxiliary load-balancing loss is exposed as
+    ``self.l_aux`` after each forward (reference behaviour).
+    """
+
+    def __init__(self, d_model, experts=None, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=None, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(gate, dict):
+            gtype = gate.get("type", "gshard")
+            topk = gate.get("top_k", 2)
+            n_exp = len(experts)
+            gate = {"naive": NaiveGate, "gshard": GShardGate,
+                    "switch": SwitchGate}[gtype](d_model, n_exp, topk=topk)
+        self.gate = gate
+        self.experts = LayerList(experts)
+        self.num_expert = len(experts)
+        self.top_k = top_k or getattr(gate, "top_k", 2)
+        self.l_aux = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        from .....tensor.manipulation import reshape
+        h = self.d_model
+        xf = reshape(x, [-1, h])  # [tokens, h]
+        logits = self.gate.gate(xf) if hasattr(self.gate, "gate") else \
+            self.gate(xf)  # [tokens, E]
+        n_tok = xf.shape[0]
+        E = self.num_expert
+        k = self.top_k
+        capacity = int(math.ceil(2.0 * n_tok * k / E))
+
+        def route(lg):
+            probs = jax.nn.softmax(lg, axis=-1)
+            topv, topi = jax.lax.top_k(probs, k)          # [T, k]
+            # positions within expert capacity
+            oh = jax.nn.one_hot(topi, E)                  # [T, k, E]
+            flat = oh.reshape(-1, E)
+            pos = jnp.cumsum(flat, axis=0) - flat         # [T*k, E]
+            pos = (pos * flat).sum(-1).reshape(n_tok, k)  # [T, k]
+            keep = pos < capacity
+            weights = topv * keep
+            denom = jnp.maximum(weights.sum(-1, keepdims=True), 1e-9)
+            weights = weights / denom
+            # dispatch mask [T, k, E, C] (binary) + combine weights
+            pos_oh = jax.nn.one_hot(pos, capacity)
+            disp = (oh[..., None] * pos_oh[:, :, None, :] *
+                    keep[..., None, None])
+            combine = disp * weights[:, :, None, None]
+            # aux loss (GShard): mean prob * fraction routed
+            me = probs.mean(0)
+            ce = oh.sum((0, 1)) / jnp.maximum(oh.sum(), 1.0)
+            l_aux = (me * ce).sum() * E
+            return disp, combine, l_aux
+
+        disp, combine, l_aux = apply("moe_route", route, logits,
+                                     n_outputs=3)
+        self.l_aux = l_aux
+
+        # dispatch tokens: [E, C, h]
+        from .....tensor.einsum import einsum
+        disp_f = apply("moe_cast", lambda d: d.astype(xf._data.dtype),
+                       disp)
+        combine_f = apply("moe_cast2",
+                          lambda c: c.astype(xf._data.dtype), combine)
+        expert_in = einsum("tkec,th->ech", disp_f, xf)
+        # run experts (python loop over expert Layers; the flagship model
+        # uses the stacked/vmapped formulation for the ep axis)
+        from .....tensor.manipulation import unstack, stack
+        parts = unstack(expert_in, axis=0)
+        outs = [self.experts[i](parts[i]) for i in range(E)]
+        expert_out = stack(outs, axis=0)  # [E, C, h]
+        combined = einsum("tkec,ech->th", combine_f, expert_out)
+        return reshape(combined, orig_shape)

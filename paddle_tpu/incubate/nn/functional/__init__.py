@@ -1,0 +1,244 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/).
+
+Each is written as one fusable XLA expression (or a Pallas kernel via the
+op table) — the TPU analog of the reference's hand-written CUDA fusions.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.dispatch import apply, as_tensor, get_op_impl
+from ....tensor.tensor import Tensor
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "swiglu",
+           "fused_bias_act", "fused_linear",
+           "fused_linear_activation", "fused_dropout_add",
+           "fused_multi_head_attention", "masked_multihead_attention",
+           "fused_feedforward", "fused_matmul_bias"]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    from ....nn.functional import rms_norm
+    out = rms_norm(x, norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        from ....tensor.math import add
+        out = add(out, norm_bias)
+    return (out,)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=1, **kw):
+    from ....nn.functional import layer_norm
+    shape = list(x.shape[begin_norm_axis:])
+    return (layer_norm(x, shape, norm_weight, norm_bias, epsilon),)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000):
+    """Reference: incubate fused_rotary_position_embedding.py.
+    Layout [b, s, h, d]."""
+    q = as_tensor(q)
+
+    def make_sincos(s, d, dtype):
+        inv = 1.0 / (rotary_emb_base ** (
+            jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        return jnp.sin(emb).astype(dtype), jnp.cos(emb).astype(dtype)
+
+    def rope_one(x, sin_e, cos_e):
+        # x: [b, s, h, d]
+        d = x.shape[-1]
+        if use_neox_rotary_style:
+            x1, x2 = x[..., : d // 2], x[..., d // 2:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., ::2]
+            x2 = x[..., 1::2]
+            rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * cos_e[None, :, None, :] + rot * sin_e[None, :, None, :]
+
+    outs = []
+    tensors = [t for t in (q, k, v) if t is not None]
+
+    def fn(*arrs):
+        s, d = arrs[0].shape[1], arrs[0].shape[-1]
+        if sin is None:
+            sin_e, cos_e = make_sincos(s, d, arrs[0].dtype)
+        else:
+            sin_e = as_tensor(sin)._data.reshape(s, d)
+            cos_e = as_tensor(cos)._data.reshape(s, d)
+        return tuple(rope_one(a, sin_e, cos_e) for a in arrs)
+
+    ts = [as_tensor(t) for t in tensors]
+    outs = apply("fused_rope", fn, *ts, n_outputs=len(ts))
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    result = []
+    it = iter(outs)
+    for t in (q, k, v):
+        result.append(next(it) if t is not None else None)
+    return tuple(result)
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: incubate swiglu — silu(x) * y (or split last dim)."""
+    if y is None:
+        def fn(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return apply("swiglu", fn, as_tensor(x))
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b,
+                 as_tensor(x), as_tensor(y))
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    from ....nn import functional as F
+    if bias is not None:
+        from ....tensor.math import add
+        x = add(x, bias)
+    return getattr(F, act_method)(x)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def fn(a, w, *b):
+        if transpose_weight:
+            w = w.T
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return out
+    args = [as_tensor(x), as_tensor(weight)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+    return apply("fused_linear", fn, *args)
+
+
+fused_matmul_bias = fused_linear
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ....nn import functional as F
+    def fn(a, w, b):
+        if trans_x:
+            a = a.T
+        if trans_y:
+            w = w.T
+        return a @ w + b
+    out = apply("fused_linear_act", fn, as_tensor(x), as_tensor(y),
+                as_tensor(bias))
+    return getattr(F, activation)(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn import functional as F
+    from ....tensor.math import add
+    return add(F.dropout(x, p=p, training=training, mode=mode), y)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Composite MHA matching the reference's fused_attention semantics."""
+    from ....nn import functional as F
+    from ....tensor.manipulation import reshape, transpose as ttranspose
+    from ....tensor.math import add
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    b, s, h = x.shape
+    qkvw = as_tensor(qkv_weight)
+    if transpose_qkv_wb:
+        nh = num_heads
+        hd = h // nh
+    else:
+        # weight [3, n_heads, head_dim, h]
+        nh = qkv_weight.shape[1]
+        hd = qkv_weight.shape[2]
+
+    def qkv_fn(a, w, *bias):
+        if not transpose_qkv_wb:
+            wmat = jnp.transpose(w.reshape(3 * nh * hd, h) if False
+                                 else w.reshape(3, nh * hd, h),
+                                 (0, 2, 1)).reshape(h, 3 * nh * hd)
+        else:
+            wmat = w
+        out = a @ wmat
+        if bias:
+            out = out + bias[0].reshape(-1)
+        return out
+
+    args = [x, qkvw]
+    if qkv_bias is not None:
+        args.append(as_tensor(qkv_bias))
+    qkv = apply("fused_qkv", qkv_fn, *args)
+    qkv = reshape(qkv, [b, s, 3, nh, hd])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    ctx = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    ctx = reshape(ctx, [b, s, nh * hd])
+    out = F.linear(ctx, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = add(residual, out)
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, **kw):
+    raise NotImplementedError(
+        "masked_multihead_attention (decode-time MQA cache op) lands with "
+        "the inference engine; use scaled_dot_product_attention with a "
+        "cache for now")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=
+                      "upscale_in_train", ring_id=-1, name=None):
+    from ....nn import functional as F
+    from ....tensor.math import add
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias,
+                         ln1_epsilon)
+    out = F.linear(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, p=dropout1_rate, training=training, mode=mode)
+    out = F.linear(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, p=dropout2_rate, training=training, mode=mode)
+    out = add(residual, out)
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out

@@ -1,0 +1,272 @@
+"""paddle_tpu.jit — to_static / save / load.
+
+Reference: python/paddle/jit/api.py:171 (``to_static``), jit/sot (bytecode
+capture), jit/dy2static (AST transpile).
+
+TPU-native design: no bytecode tricks are needed — our ops are pure jax
+functions, so a Layer's forward IS a traceable program.  ``to_static``
+wraps the layer in ONE tape op whose body is a ``jax.jit``-compiled pure
+function of (params..., buffers..., inputs...).  Eager code keeps its
+``.backward()`` ergonomics while forward+backward each run as a single
+fused XLA executable — this is the role the reference's
+CINN+PIR+interpreter stack plays, delegated to XLA.
+
+Graph breaks: anything data-dependent (host reads, dynamic shapes) raises
+under trace; ``to_static(full_graph=False)`` falls back to eager for that
+call, mirroring SOT's fallback semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor, wrap_array
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "TranslatedLayer", "InputSpec", "enable_to_static"]
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool) -> None:
+    _to_static_enabled[0] = bool(flag)
+
+
+class InputSpec:
+    """Reference: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class StaticFunction:
+    """The compiled wrapper around a Layer or function."""
+
+    def __init__(self, obj, input_spec=None, build_strategy=None,
+                 full_graph=True, backend=None):
+        self._obj = obj
+        self._input_spec = input_spec
+        self._full_graph = full_graph
+        self._jitted: Dict[Any, Callable] = {}
+        self._out_tree = [None]
+        functools.update_wrapper(
+            self, obj.forward if isinstance(obj, Layer) else obj)
+
+    @property
+    def _layer(self) -> Optional[Layer]:
+        return self._obj if isinstance(self._obj, Layer) else None
+
+    def _cache_key(self, kwargs) -> Any:
+        layer = self._layer
+        static_kw = tuple(sorted(
+            (k, repr(v)) for k, v in kwargs.items()
+            if not isinstance(v, Tensor)))
+        return (layer.training if layer is not None else None, static_kw)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled[0]:
+            return self._obj(*args, **kwargs) if self._layer is not None \
+                else self._obj(*args, **kwargs)
+        layer = self._layer
+        tensor_args = []
+        arg_spec = []  # 'tensor' or raw value
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_spec.append(None)
+                tensor_args.append(a)
+            else:
+                arg_spec.append(a)
+        tensor_kwargs = {k: v for k, v in kwargs.items()
+                         if isinstance(v, Tensor)}
+        static_kwargs = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Tensor)}
+
+        if layer is not None:
+            param_items = list(layer.named_parameters()) + \
+                [(f"@buf@{n}", b) for n, b in layer.named_buffers()]
+        else:
+            param_items = []
+        p_names = [n for n, _ in param_items]
+        p_tensors = [t for _, t in param_items]
+        kw_names = sorted(tensor_kwargs)
+        out_tree = self._out_tree
+
+        key = self._cache_key(kwargs) + (tuple(arg_spec.count(None)
+                                               for _ in [0]),)
+
+        jfn = self._jitted.get(key)
+        if jfn is None:
+            obj = self._obj
+            n_p = len(p_names)
+            n_pos = len(tensor_args)
+
+            def pure(*arrs):
+                p_arrs = arrs[:n_p]
+                pos_arrs = arrs[n_p:n_p + n_pos]
+                kw_arrs = arrs[n_p + n_pos:]
+                pos_iter = iter(pos_arrs)
+                call_args = [wrap_array(next(pos_iter)) if s is None else s
+                             for s in arg_spec]
+                call_kwargs = dict(static_kwargs)
+                for kname, arr in zip(kw_names, kw_arrs):
+                    call_kwargs[kname] = wrap_array(arr)
+                if layer is not None:
+                    params = {}
+                    bufs = {}
+                    for nname, arr in zip(p_names, p_arrs):
+                        if nname.startswith("@buf@"):
+                            bufs[nname[5:]] = arr
+                        else:
+                            params[nname] = arr
+                    out = layer._functional_call(params, *call_args,
+                                                 buffers=bufs,
+                                                 **call_kwargs)
+                else:
+                    with tape.functional_trace_guard():
+                        out = obj(*call_args, **call_kwargs)
+                flat, treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_tree[0] = treedef
+                return tuple(t._data if isinstance(t, Tensor)
+                             else jnp.asarray(t) for t in flat)
+
+            jfn = jax.jit(pure)
+            self._jitted[key] = jfn
+
+        try:
+            outs = apply("to_static", jfn, *p_tensors, *tensor_args,
+                         *[tensor_kwargs[k] for k in kw_names],
+                         n_outputs=-1)
+        except Exception:
+            if not self._full_graph:
+                # graph break: eager fallback (SOT-style)
+                return self._obj(*args, **kwargs)
+            raise
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return jax.tree_util.tree_unflatten(out_tree[0], list(outs))
+
+    # parity helpers
+    def concrete_program(self):
+        return self
+
+    @property
+    def program_cache(self):
+        return self._jitted
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Mirror of ``paddle.jit.to_static`` (api.py:171)."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            wrapper = StaticFunction(obj, input_spec, build_strategy,
+                                     full_graph, backend)
+            obj.forward_static = wrapper
+            # replace __call__ path: return a proxy layer-like callable
+            return _StaticLayerProxy(obj, wrapper)
+        return StaticFunction(obj, input_spec, build_strategy, full_graph,
+                              backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class _StaticLayerProxy(Layer):
+    """Layer whose forward runs through the compiled wrapper but which
+    otherwise behaves as the original (parameters, state_dict, ...)."""
+
+    def __init__(self, inner: Layer, static_fn: StaticFunction):
+        super().__init__()
+        self.add_sublayer("_inner", inner)
+        object.__setattr__(self, "_static_fn", static_fn)
+
+    def forward(self, *args, **kwargs):
+        return self._static_fn(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._sub_layers["_inner"].state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._sub_layers["_inner"].set_state_dict(*a, **kw)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_inner"], name)
+
+
+def not_to_static(func):
+    func._not_to_static = True
+    return func
+
+
+def ignore_module(modules):
+    pass
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Mirror of ``paddle.jit.save``: persists the layer object (pickle) +
+    state_dict; ``paddle.jit.load`` restores a callable TranslatedLayer."""
+    import os
+    d = os.path.dirname(str(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    from ..framework.io import save as fsave
+    target = layer
+    if isinstance(layer, _StaticLayerProxy):
+        target = layer._sub_layers["_inner"]
+    state = target.state_dict() if isinstance(target, Layer) else {}
+    fsave(state, str(path) + ".pdiparams")
+    meta = {"class_module": type(target).__module__,
+            "class_name": type(target).__qualname__,
+            "input_spec": input_spec}
+    try:
+        with open(str(path) + ".pdmodel", "wb") as f:
+            pickle.dump({"meta": meta, "layer": target}, f)
+    except Exception:
+        with open(str(path) + ".pdmodel", "wb") as f:
+            pickle.dump({"meta": meta, "layer": None}, f)
+
+
+class TranslatedLayer(Layer):
+    def __init__(self, inner: Layer):
+        super().__init__()
+        self.add_sublayer("_inner", inner)
+
+    def forward(self, *args, **kwargs):
+        return self._sub_layers["_inner"](*args, **kwargs)
+
+
+def load(path, **configs):
+    """Mirror of ``paddle.jit.load``."""
+    from ..framework.io import load as fload
+    with open(str(path) + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    layer = blob.get("layer")
+    if layer is None:
+        raise RuntimeError(
+            f"{path}.pdmodel does not contain a loadable layer (the class "
+            "was not importable at save time)")
+    state = fload(str(path) + ".pdiparams")
+    layer.set_state_dict(state)
+    return TranslatedLayer(layer)

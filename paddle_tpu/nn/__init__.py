@@ -1,0 +1,21 @@
+"""paddle_tpu.nn — mirrors ``paddle.nn``."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import (  # noqa: F401
+    Layer, Sequential, LayerList, ParameterList, in_dynamic_mode,
+    enable_static, disable_static)
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
+from ..framework.param import Parameter, ParamAttr  # noqa: F401
+from . import clip  # noqa: F401
+from .layer import layers  # noqa: F401
+from . import layer  # noqa: F401

@@ -1,0 +1,121 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply, as_tensor
+from ..tensor.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply("clip_value",
+                                 lambda a: jnp.clip(a, self.min, self.max),
+                                 g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def fn(a):
+                nrm = jnp.linalg.norm(a.reshape(-1))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(
+                    nrm, 1e-12), 1.0)
+                return a * scale
+
+            out.append((p, apply("clip_norm", fn, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference: nn/clip.py ClipGradByGlobalNorm.  In hybrid-parallel
+    training the fleet optimizer sums the squared norms across parallel
+    groups before scaling (hybrid_parallel_optimizer.py)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        sq = [apply("sumsq", lambda a: jnp.sum(
+            jnp.square(a.astype(jnp.float32))), g) for g in grads]
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        global_norm = apply("sqrt", jnp.sqrt, total)
+        clip_t = as_tensor(self.clip_norm)
+        scale = apply("clip_scale",
+                      lambda n, c: c / jnp.maximum(n, c),
+                      global_norm, clip_t)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply("apply_scale",
+                                 lambda a, s: (a.astype(jnp.float32) * s
+                                               ).astype(a.dtype), g,
+                                 scale)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return as_tensor(0.0)
+    if norm_type == float("inf"):
+        norms = [float(jnp.max(jnp.abs(g._data))) for g in grads]
+        total = max(norms)
+    else:
+        total = float(sum(jnp.sum(jnp.abs(g._data) ** norm_type)
+                          for g in grads) ** (1.0 / norm_type))
+    clip_coef = max_norm / (total + 1e-6)
+    if clip_coef < 1:
+        for p in parameters:
+            if p._grad is not None:
+                p._grad = p._grad * clip_coef
+    return as_tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)

@@ -1,0 +1,2 @@
+from . import layers, common, conv, norm, activation, pooling, loss
+from . import transformer, rnn

@@ -1,0 +1,356 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+Time loops run as ``jax.lax.scan`` inside a single op application — one XLA
+while-loop per layer/direction, not a Python loop of ops, so the whole
+recurrence compiles (and fuses) as a unit."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Layer
+from .. import initializer as I
+from ...ops.dispatch import apply, as_tensor
+from ...tensor.tensor import Tensor
+from ...tensor.creation import zeros
+from ...tensor.manipulation import concat, stack
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return zeros([b, self.hidden_size], dtype=dtype or "float32")
+
+
+def _uniform_init(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        out = apply("simple_rnn_cell", fn, as_tensor(inputs),
+                    as_tensor(states), self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size]),
+                      zeros([b, self.hidden_size]))
+        h, c = states
+
+        def fn(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), \
+                jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply("lstm_cell", fn, as_tensor(inputs),
+                             as_tensor(h), as_tensor(c), self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh,
+                             n_outputs=2)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        out = apply("gru_cell", fn, as_tensor(inputs), as_tensor(states),
+                    self.weight_ih, self.weight_hh, self.bias_ih,
+                    self.bias_hh)
+        return out, out
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over time via lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return _run_cell_scan(self.cell, inputs, initial_states,
+                              self.is_reverse, self.time_major)
+
+
+def _cell_kind(cell):
+    if isinstance(cell, LSTMCell):
+        return "lstm"
+    if isinstance(cell, GRUCell):
+        return "gru"
+    return "simple"
+
+
+def _run_cell_scan(cell, inputs, initial_states, is_reverse, time_major):
+    inputs = as_tensor(inputs)
+    b = inputs.shape[0] if not time_major else inputs.shape[1]
+    kind = _cell_kind(cell)
+    hs = cell.hidden_size
+    if initial_states is None:
+        if kind == "lstm":
+            initial_states = (zeros([b, hs], dtype=inputs.dtype),
+                              zeros([b, hs], dtype=inputs.dtype))
+        else:
+            initial_states = zeros([b, hs], dtype=inputs.dtype)
+    states = initial_states if isinstance(initial_states, (tuple, list)) \
+        else (initial_states,)
+    act = getattr(cell, "activation", "tanh")
+
+    def fn(x, *args):
+        n_state = 2 if kind == "lstm" else 1
+        st = args[:n_state]
+        wi, wh, bi, bh = args[n_state:]
+        if not time_major:
+            x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+        if is_reverse:
+            x = jnp.flip(x, 0)
+
+        if kind == "lstm":
+            def step(carry, xt):
+                h, c = carry
+                gates = xt @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            carry, outs = jax.lax.scan(step, (st[0], st[1]), x)
+            final = carry
+        elif kind == "gru":
+            def step(h, xt):
+                gi = xt @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                h_new = (1 - z) * c + z * h
+                return h_new, h_new
+            h_fin, outs = jax.lax.scan(step, st[0], x)
+            final = (h_fin,)
+        else:
+            a_fn = jnp.tanh if act == "tanh" else jax.nn.relu
+
+            def step(h, xt):
+                h_new = a_fn(xt @ wi.T + bi + h @ wh.T + bh)
+                return h_new, h_new
+            h_fin, outs = jax.lax.scan(step, st[0], x)
+            final = (h_fin,)
+
+        if is_reverse:
+            outs = jnp.flip(outs, 0)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return (outs,) + tuple(final)
+
+    n_state = 2 if kind == "lstm" else 1
+    results = apply("rnn_scan", fn, inputs, *[as_tensor(s) for s in states],
+                    cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                    cell.bias_hh, n_outputs=1 + n_state)
+    outs = results[0]
+    final = results[1:] if n_state == 2 else results[1]
+    return outs, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            initial_states = (None, None)
+        out_f, st_f = _run_cell_scan(self.cell_fw, inputs,
+                                     initial_states[0], False,
+                                     self.time_major)
+        out_b, st_b = _run_cell_scan(self.cell_bw, inputs,
+                                     initial_states[1], True,
+                                     self.time_major)
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _MultiLayerRNN(Layer):
+    """num_layers x (optionally bidirectional) stacked recurrence."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self._activation = activation
+        num_dir = 2 if self.bidirectional else 1
+        self.cells = []
+        kwargs = {}
+        if self.CELL is SimpleRNNCell:
+            kwargs["activation"] = activation
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            fw = self.CELL(in_sz, hidden_size, **kwargs)
+            self.add_sublayer(f"cell_fw_{layer}", fw)
+            cells = [fw]
+            if self.bidirectional:
+                bw = self.CELL(in_sz, hidden_size, **kwargs)
+                self.add_sublayer(f"cell_bw_{layer}", bw)
+                cells.append(bw)
+            self.cells.append(cells)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        is_lstm = self.CELL is LSTMCell
+        out = inputs
+        last_h, last_c = [], []
+        for li, cells in enumerate(self.cells):
+            outs_dir = []
+            for di, cell in enumerate(cells):
+                init = None
+                if initial_states is not None:
+                    idx = li * len(cells) + di
+                    if is_lstm:
+                        init = (initial_states[0][idx],
+                                initial_states[1][idx])
+                    else:
+                        init = initial_states[idx]
+                o, st = _run_cell_scan(cell, out, init, di == 1,
+                                       self.time_major)
+                outs_dir.append(o)
+                if is_lstm:
+                    last_h.append(st[0])
+                    last_c.append(st[1])
+                else:
+                    last_h.append(st)
+            out = outs_dir[0] if len(outs_dir) == 1 else concat(
+                outs_dir, axis=-1)
+            if self.dropout > 0 and li < self.num_layers - 1:
+                out = F.dropout(out, p=self.dropout,
+                                training=self.training)
+        h = stack(last_h, axis=0)
+        if is_lstm:
+            c = stack(last_c, axis=0)
+            return out, (h, c)
+        return out, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

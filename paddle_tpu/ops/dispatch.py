@@ -45,6 +45,21 @@ class OpError(ValueError):
 # -- op implementation table (Pallas/custom overrides) -----------------------
 _op_table: Dict[str, Callable] = {}
 
+# -- cross-cutting hooks (AMP autocast, op statistics) -----------------------
+_amp_hook: Optional[Callable] = None
+_stats_hook: Optional[Callable] = None
+
+
+def set_amp_hook(hook: Optional[Callable]) -> None:
+    """Installed by paddle_tpu.amp.auto_cast: (op_name, arrays) -> arrays."""
+    global _amp_hook
+    _amp_hook = hook
+
+
+def set_stats_hook(hook: Optional[Callable]) -> None:
+    global _stats_hook
+    _stats_hook = hook
+
 
 def register_op_impl(name: str, fn: Callable) -> None:
     _op_table[name] = fn
@@ -100,6 +115,21 @@ def apply(name: str, jfn: Callable, *inputs: Tensor,
     closed over by the caller.
     """
     arrays = tuple(t._data for t in inputs)
+    if _amp_hook is not None:
+        # The cast must live INSIDE the differentiated function so the
+        # pullback returns cotangents in the caller's dtypes (the vjp of
+        # astype casts them back); casting the arrays up front would make
+        # backward crash at every precision boundary.
+        cast_arrays = _amp_hook(name, arrays)
+        if any(c is not a for c, a in zip(cast_arrays, arrays)):
+            targets = tuple(c.dtype for c in cast_arrays)
+            inner_jfn = jfn
+
+            def jfn(*arrs, _inner=inner_jfn, _targets=targets):
+                return _inner(*(a.astype(d) if a.dtype != d else a
+                                for a, d in zip(arrs, _targets)))
+    if _stats_hook is not None:
+        _stats_hook(name, arrays)
     need_grad = tape.grad_enabled() and any(
         not t.stop_gradient for t in inputs)
     if need_grad:

@@ -1,0 +1,611 @@
+"""Optimizers.
+
+Reference: python/paddle/optimizer/optimizer.py (base) + per-optimizer
+modules.  Each optimizer defines a **pure** per-parameter update rule
+``_update(param, grad, state, lr) -> (new_param, new_state)`` over raw jax
+arrays.  The eager ``step()`` walks parameters applying the rule; the jit
+training path (paddle_tpu.jit / hapi) reuses the *same rule* inside one
+compiled XLA program, and the fused-AdamW Pallas kernel slots in behind it.
+
+Master weights: when a parameter is bf16/fp16 and ``multi_precision`` is on,
+state carries a float32 master copy (reference: AMP-O2 master weights).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape
+from ..framework.param import Parameter
+from ..nn.clip import ClipGradBase
+from ..tensor.tensor import Tensor, wrap_array
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "NAdam", "RAdam",
+           "ASGD", "Rprop", "LBFGS"]
+
+
+class Optimizer:
+    """Reference: optimizer.py Optimizer."""
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if parameters is not None and isinstance(parameters, Tensor):
+            raise TypeError("parameters must be a list of Tensors")
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:  # L2Decay-like object
+            self._weight_decay = float(getattr(weight_decay,
+                                               "_coeff",
+                                               getattr(weight_decay,
+                                                       "coeff", 0.0)))
+        self._states: Dict[int, Dict[str, Any]] = {}
+        self._step_count = 0
+        self._param_groups = None
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler) -> None:
+        self._learning_rate = scheduler
+
+    # -- parameters --------------------------------------------------------
+    def _params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise RuntimeError(
+                "optimizer created without parameters; pass parameters= or "
+                "use it through a high-level API that provides them")
+        return self._parameter_list
+
+    # -- state -------------------------------------------------------------
+    def _get_state(self, p: Tensor) -> Dict[str, Any]:
+        st = self._states.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            if self._needs_master(p):
+                st["master"] = p._data.astype(jnp.float32)
+            self._states[id(p)] = st
+        return st
+
+    def _needs_master(self, p: Tensor) -> bool:
+        return self._multi_precision and p._data.dtype in (
+            jnp.float16, jnp.bfloat16)
+
+    def _init_state(self, p: Tensor) -> Dict[str, Any]:
+        return {}
+
+    # -- the pure rule (override) ------------------------------------------
+    def _update(self, param, grad, state: Dict[str, Any], lr):
+        raise NotImplementedError
+
+    # -- step --------------------------------------------------------------
+    @tape.no_grad_guard()
+    def step(self) -> None:
+        params = self._params()
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g_arr = g._data if isinstance(g, Tensor) else g
+            state = self._get_state(p)
+            if "master" in state:
+                compute_param = state["master"]
+                g_arr = g_arr.astype(jnp.float32)
+            else:
+                compute_param = p._data
+            new_param, new_state = self._update(compute_param, g_arr,
+                                                state, lr)
+            for k, v in new_state.items():
+                state[k] = v
+            if "master" in state:
+                state["master"] = new_param
+                p._data = new_param.astype(p._data.dtype)
+            else:
+                p._data = new_param
+
+    minimize_step = step
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        for p in self._params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params()]
+
+    # -- checkpointing ------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for p in self._params():
+            st = self._states.get(id(p))
+            if not st:
+                continue
+            for k, v in st.items():
+                if isinstance(v, (int, float)):
+                    out[f"{p.name}.{k}"] = v
+                else:
+                    out[f"{p.name}.{k}"] = wrap_array(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate,
+                                                  LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        for p in self._params():
+            prefix = p.name + "."
+            st = self._states.setdefault(id(p), self._init_state(p))
+            for k, v in state.items():
+                if k.startswith(prefix):
+                    key = k[len(prefix):]
+                    st[key] = v._data if isinstance(v, Tensor) else v
+
+    set_dict = set_state_dict
+
+    def _apply_decay(self, param, grad):
+        """L2 regularisation folded into the gradient (SGD-style decay)."""
+        if self._weight_decay:
+            return grad + self._weight_decay * param
+        return grad
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        return param - lr * grad, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(
+            p._data, dtype=jnp.float32 if self._needs_master(p)
+            else p._data.dtype)}
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._needs_master(p) else p._data.dtype
+        st = {"moment1": jnp.zeros_like(p._data, dtype=dt),
+              "moment2": jnp.zeros_like(p._data, dtype=dt),
+              "beta1_pow": 1.0, "beta2_pow": 1.0}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros_like(p._data, dtype=dt)
+        return st
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        if self._amsgrad:
+            v_max = jnp.maximum(state["moment2_max"], v)
+            v_hat = v_max / (1 - b2p)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": v_max,
+                         "beta1_pow": b1p, "beta2_pow": b2p}
+        else:
+            v_hat = v / (1 - b2p)
+            new_state = {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                         "beta2_pow": b2p}
+        new_p = param - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_p, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._coeff = float(weight_decay) if not hasattr(
+            weight_decay, "_coeff") else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._current_param = None
+
+    @tape.no_grad_guard()
+    def step(self):
+        # route through base step but remember which param is being updated
+        params = self._params()
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._current_param = p
+            g_arr = g._data if isinstance(g, Tensor) else g
+            state = self._get_state(p)
+            if "master" in state:
+                compute_param = state["master"]
+                g_arr = g_arr.astype(jnp.float32)
+            else:
+                compute_param = p._data
+            new_param, new_state = self._update(compute_param, g_arr,
+                                                state, lr)
+            for k, v in new_state.items():
+                state[k] = v
+            if "master" in state:
+                state["master"] = new_param
+                p._data = new_param.astype(p._data.dtype)
+            else:
+                p._data = new_param
+        self._current_param = None
+
+    def _update(self, param, grad, state, lr):
+        p = self._current_param
+        decay = self._coeff
+        if p is not None and self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        if p is not None and self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        # decoupled decay applied before the adam update
+        param = param * (1.0 - lr * decay)
+        return super()._update(param, grad, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p._data),
+                "inf_norm": jnp.zeros_like(p._data), "beta1_pow": 1.0}
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        b1p = state["beta1_pow"] * b1
+        new_p = param - lr / (1 - b1p) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc)}
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        acc = state["moment"] + grad * grad
+        new_p = param - lr * grad / (jnp.sqrt(acc) + self._epsilon)
+        return new_p, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._data),
+                "avg_squared_update": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * grad * grad
+        update = grad * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * \
+            update * update
+        return param - lr * update, {"avg_squared_grad": asg,
+                                     "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._data),
+              "momentum": jnp.zeros_like(p._data)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._data)
+        return st
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * grad * grad
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            new_state = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + eps)
+            new_state = {"mean_square": ms}
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        new_state["momentum"] = mom
+        return param - mom, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._current_param = None
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p._data),
+                "moment2": jnp.zeros_like(p._data),
+                "beta1_pow": 1.0, "beta2_pow": 1.0}
+
+    def _update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps)
+        decay = self._lamb_decay
+        if self._current_param is not None and self._exclude_fn is not None \
+                and self._exclude_fn(self._current_param):
+            decay = 0.0
+        update = r + decay * param
+        w_norm = jnp.linalg.norm(param.reshape(-1))
+        u_norm = jnp.linalg.norm(update.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return param - lr * trust * update, \
+            {"moment1": m, "moment2": v, "beta1_pow": b1p,
+             "beta2_pow": b2p}
+
+
+class NAdam(Adam):
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = b1 * m / (1 - b1p * b1) + (1 - b1) * grad / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        new_p = param - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class RAdam(Adam):
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        t = np.log(b2p) / np.log(b2) if b2p > 0 else 1
+        rho_inf = 2 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2p / (1 - b2p)
+        m_hat = m / (1 - b1p)
+        if rho_t > 5:
+            r = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                        ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            v_hat = jnp.sqrt(v / (1 - b2p))
+            new_p = param - lr * r * m_hat / (v_hat + eps)
+        else:
+            new_p = param - lr * m_hat
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+
+    def _update(self, param, grad, state, lr):
+        grad = self._apply_decay(param, grad)
+        return param - lr * grad, {}
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p._data),
+                "lrs": jnp.full_like(p._data, float(self._learning_rate)
+                                     if not isinstance(
+                                         self._learning_rate, LRScheduler)
+                                     else self._learning_rate())}
+
+    def _update(self, param, grad, state, lr):
+        eta_minus, eta_plus = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(grad * state["prev_grad"])
+        lrs = jnp.where(sign > 0, jnp.minimum(state["lrs"] * eta_plus, hi),
+                        jnp.where(sign < 0,
+                                  jnp.maximum(state["lrs"] * eta_minus, lo),
+                                  state["lrs"]))
+        grad_eff = jnp.where(sign < 0, 0.0, grad)
+        new_p = param - lrs * jnp.sign(grad_eff)
+        return new_p, {"prev_grad": grad_eff, "lrs": lrs}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure (reference: optimizer/lbfgs.py)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._s_hist: List = []
+        self._y_hist: List = []
+        self._prev_flat_grad = None
+
+    def _gather(self):
+        params = [p for p in self._params() if not p.stop_gradient]
+        flat = jnp.concatenate([p._data.reshape(-1) for p in params])
+        grads = jnp.concatenate(
+            [(p._grad if p._grad is not None else
+              jnp.zeros_like(p._data)).reshape(-1) for p in params])
+        return params, flat, grads
+
+    def _scatter(self, params, flat):
+        off = 0
+        for p in params:
+            n = p._data.size
+            p._data = flat[off:off + n].reshape(p._data.shape)
+            off += n
+
+    def step(self, closure: Callable):
+        with tape.enable_grad_guard():
+            loss = closure()
+        params, flat, grad = self._gather()
+        if float(jnp.max(jnp.abs(grad))) <= self._tol_grad:
+            return loss
+        # two-loop recursion
+        q = grad
+        alphas = []
+        for s, y in reversed(list(zip(self._s_hist, self._y_hist))):
+            rho = 1.0 / jnp.dot(y, s)
+            alpha = rho * jnp.dot(s, q)
+            q = q - alpha * y
+            alphas.append((alpha, rho, s, y))
+        if self._y_hist:
+            y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+            gamma = jnp.dot(s_last, y_last) / jnp.dot(y_last, y_last)
+            q = gamma * q
+        for alpha, rho, s, y in reversed(alphas):
+            beta = rho * jnp.dot(y, q)
+            q = q + (alpha - beta) * s
+        direction = -q
+        lr = self.get_lr()
+        new_flat = flat + lr * direction
+        self._scatter(params, new_flat)
+        for p in params:
+            p.clear_grad()
+        with tape.enable_grad_guard():
+            new_loss = closure()
+        _, _, new_grad = self._gather()
+        s = new_flat - flat
+        y = new_grad - grad
+        if float(jnp.dot(s, y)) > 1e-10:
+            self._s_hist.append(s)
+            self._y_hist.append(y)
+            if len(self._s_hist) > self._history_size:
+                self._s_hist.pop(0)
+                self._y_hist.pop(0)
+        return new_loss

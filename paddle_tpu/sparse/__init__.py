@@ -1,0 +1,135 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors + sparse nn.
+
+Reference: python/paddle/sparse/ backed by phi/kernels/sparse.
+TPU-native: wraps jax.experimental.sparse (BCOO/BCSR); dense fallbacks are
+used where XLA has no sparse lowering (XLA densifies most sparse compute
+on TPU anyway — the MXU wants dense tiles).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..tensor.tensor import Tensor, wrap_array
+from ..ops.dispatch import apply, as_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "multiply", "matmul", "masked_matmul",
+           "relu", "sqrt", "sin", "tanh", "nn"]
+
+
+class SparseCooTensor(Tensor):
+    """A Tensor whose payload is a BCOO; dense ops see it densified."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        super().__init__(bcoo.todense())
+        self._bcoo = bcoo
+
+    @property
+    def is_sparse_coo(self):
+        return True
+
+    def indices(self):
+        return wrap_array(jnp.asarray(self._bcoo.indices.T))
+
+    def values(self):
+        return wrap_array(self._bcoo.data)
+
+    def to_dense(self):
+        return wrap_array(self._bcoo.todense())
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = as_tensor(indices)._data.T  # paddle is [ndim, nnz]; BCOO wants
+    vals = as_tensor(values)._data
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    bcoo = jsparse.BCOO((vals, idx.astype(jnp.int32)),
+                        shape=tuple(shape) if shape else None)
+    t = SparseCooTensor(bcoo)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_a = np.asarray(as_tensor(crows)._data)
+    cols_a = np.asarray(as_tensor(cols)._data)
+    vals = np.asarray(as_tensor(values)._data)
+    # convert CSR to COO rows
+    rows = np.repeat(np.arange(len(crows_a) - 1),
+                     np.diff(crows_a).astype(int))
+    idx = np.stack([rows, cols_a])
+    return sparse_coo_tensor(idx, vals, shape, dtype, place, stop_gradient)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else as_tensor(x)
+
+
+def add(x, y, name=None):
+    from ..tensor.math import add as dadd
+    return dadd(_dense(x), _dense(y))
+
+
+def multiply(x, y, name=None):
+    from ..tensor.math import multiply as dmul
+    return dmul(_dense(x), _dense(y))
+
+
+def matmul(x, y, name=None):
+    from ..tensor.linalg import matmul as dmm
+    return dmm(_dense(x), _dense(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    from ..tensor.linalg import matmul as dmm
+    from ..tensor.math import multiply as dmul
+    out = dmm(_dense(x), _dense(y))
+    return dmul(out, _dense(mask))
+
+
+def relu(x, name=None):
+    from ..nn.functional import relu as drelu
+    return drelu(_dense(x))
+
+
+def sqrt(x, name=None):
+    from ..tensor.math import sqrt as dsqrt
+    return dsqrt(_dense(x))
+
+
+def sin(x, name=None):
+    from ..tensor.math import sin as dsin
+    return dsin(_dense(x))
+
+
+def tanh(x, name=None):
+    from ..tensor.math import tanh as dtanh
+    return dtanh(_dense(x))
+
+
+class nn:
+    """paddle.sparse.nn — dense-computed equivalents."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    @staticmethod
+    def functional_relu(x):
+        return relu(x)

@@ -1,0 +1,193 @@
+"""paddle_tpu.static — static-graph compatibility layer.
+
+Reference: python/paddle/static/ (Program/Executor, base/executor.py:1182).
+
+TPU-native stance: there is no separate static graph machine — ``jax.jit``
+(via paddle_tpu.jit) IS the static path, with XLA playing the role of
+PIR passes + CINN + the interpreter (SURVEY.md §7).  This module provides
+the Program/Executor/data API shapes so static-style user code ports:
+a ``Program`` records python callables appended under ``program_guard``;
+``Executor.run`` executes them with a feed dict and fetches results.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..framework.place import CPUPlace, Place
+from ..jit import InputSpec  # noqa: F401 (public alias paddle.static.InputSpec)
+from ..tensor.tensor import Tensor, to_tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "Executor", "data", "InputSpec",
+           "name_scope", "global_scope", "scope_guard", "cpu_places",
+           "device_guard", "save_inference_model", "load_inference_model",
+           "gradients", "append_backward", "nn"]
+
+
+class Variable(Tensor):
+    pass
+
+
+class Program:
+    """A deferred computation: list of (fn, input_names, output_names)."""
+
+    def __init__(self):
+        self.ops: List = []
+        self._feed_targets: Dict[str, Any] = {}
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+    def __repr__(self):
+        return f"<Program with {len(self.ops)} recorded ops>"
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev = (_main_program, _startup_program)
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder in the current program."""
+    prog = default_main_program()
+    spec = InputSpec([s if s is not None else -1 for s in shape], dtype,
+                     name)
+    prog._feed_targets[name] = spec
+    t = to_tensor(np.zeros([1 if (s is None or s < 0) else s
+                            for s in shape], dtype=str(dtype)))
+    t.name = name
+    return t
+
+
+class Executor:
+    """Reference: base/executor.py:1182.  In this framework programs are
+    python callables over jax — Run = call the jitted entry with feeds."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place or CPUPlace()
+        self._compiled = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        results = []
+        for target in fetch_list:
+            if callable(target):
+                out = target(**{k: to_tensor(v) for k, v in feed.items()})
+            elif isinstance(target, Tensor):
+                out = target
+            else:
+                raise TypeError(
+                    f"cannot fetch {target!r}: the TPU static shim "
+                    "fetches Tensors or callables")
+            if return_numpy and isinstance(out, Tensor):
+                out = out.numpy()
+            results.append(out)
+        return results
+
+    def close(self):
+        pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    from ..jit import save as jsave
+    raise NotImplementedError(
+        "static save_inference_model: use paddle_tpu.jit.save(layer, path) "
+        "— the jit path is the static path on TPU")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "static load_inference_model: use paddle_tpu.jit.load(path)")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad as agrad
+    return agrad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+class nn:
+    """paddle.static.nn shims (fc/conv map onto dynamic layers)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from ..nn import functional as F
+        from ..nn import Linear
+        lin = Linear(x.shape[-1], size)
+        out = lin(x)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out

@@ -1,0 +1,15 @@
+"""paddle_tpu.vision — mirrors ``paddle.vision``."""
+
+from . import models  # noqa: F401
+from . import datasets  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+from .datasets import MNIST, Cifar10, Cifar100  # noqa: F401
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "cv2"

@@ -1,0 +1,191 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) —
+numpy CHW float implementations (host-side preprocessing)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomRotation",
+           "to_tensor", "normalize", "resize", "hflip", "vflip"]
+
+
+def _chw(img) -> np.ndarray:
+    a = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+    if a.ndim == 2:
+        a = a[None]
+    elif a.ndim == 3 and a.shape[-1] in (1, 3, 4) and a.shape[0] not in (
+            1, 3, 4):
+        a = a.transpose(2, 0, 1)
+    return a.astype("float32")
+
+
+class Compose:
+    def __init__(self, transforms: List[Callable]):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = _chw(img)
+        if a.max() > 1.5:  # uint8-scale input
+            a = a / 255.0
+        return a
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False, keys=None):
+        self.mean = np.asarray(mean, dtype="float32").reshape(-1, 1, 1)
+        self.std = np.asarray(std, dtype="float32").reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        a = _chw(img)
+        return (a - self.mean) / self.std
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std)(img)
+
+
+def _resize_np(a: np.ndarray, size) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+    if isinstance(size, int):
+        size = (size, size)
+    out = jax.image.resize(jnp.asarray(a), (a.shape[0],) + tuple(size),
+                           method="linear")
+    return np.asarray(out)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize_np(_chw(img), self.size)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size)(img)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        a = _chw(img)
+        h, w = a.shape[-2:]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return a[:, i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        a = _chw(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, int):
+                p = (p, p, p, p)
+            a = np.pad(a, ((0, 0), (p[1], p[3]), (p[0], p[2])))
+        h, w = a.shape[-2:]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        return a[:, i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return _chw(img)[:, :, ::-1].copy()
+
+
+def vflip(img):
+    return _chw(img)[:, ::-1, :].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return _chw(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _chw(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        a = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        return a.transpose(self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        a = _chw(img)
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(a * factor, 0, 1)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        a = _chw(img)
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        return np.pad(a, ((0, 0), (p[1], p[3]), (p[0], p[2])),
+                      constant_values=self.fill)
+
+
+class RandomRotation:
+    def __init__(self, degrees, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else degrees
+
+    def __call__(self, img):
+        a = _chw(img)
+        k = np.random.randint(0, 4)
+        return np.rot90(a, k, axes=(-2, -1)).copy()
