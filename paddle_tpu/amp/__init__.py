@@ -219,14 +219,20 @@ class GradScaler:
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
-        found = False
+        finite_flags = []
         for p in optimizer._params():
             if p._grad is not None:
                 g = p._grad * inv
-                if not bool(jnp.isfinite(g).all()):
-                    found = True
+                finite_flags.append(jnp.isfinite(g).all())
                 p._grad = g
-        self._found_inf = found
+        # ONE fused reduction + ONE host transfer (not per-param syncs)
+        if finite_flags:
+            all_finite = finite_flags[0]
+            for f in finite_flags[1:]:
+                all_finite = jnp.logical_and(all_finite, f)
+            self._found_inf = not bool(all_finite)
+        else:
+            self._found_inf = False
         self._unscaled = True
 
     def step(self, optimizer) -> None:

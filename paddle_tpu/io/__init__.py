@@ -357,8 +357,9 @@ class DataLoader:
                     return
                 yield self.collate_fn(batch)
         elif self.batch_sampler is None:
+            # batch_size=None: auto-batching disabled; yield raw samples
             for i in range(len(self.dataset)):
-                yield self.collate_fn([self.dataset[i]])
+                yield self.dataset[i]
         else:
             for idx_batch in self.batch_sampler:
                 samples = [self.dataset[i] for i in idx_batch]

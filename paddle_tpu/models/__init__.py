@@ -1,0 +1,10 @@
+"""Flagship model families (reference analog: PaddleNLP model zoo built on
+the framework; here in-tree because they ARE the benchmark configs —
+BASELINE.md configs 3-5)."""
+
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer)
+from . import llama_pretrain  # noqa: F401
+from .llama_pretrain import (  # noqa: F401
+    LlamaPretrainConfig, make_train_step, init_params, init_adamw_state,
+    build_mesh)

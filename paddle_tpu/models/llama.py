@@ -1,0 +1,231 @@
+"""LLaMA as a paddle-style Layer (user API; TP-aware via mpu layers).
+
+The eager/dygraph counterpart of llama_pretrain.py — usable with the
+fleet wrappers, hapi, jit.to_static, and generate().  When a global mesh
+with an 'mp' axis exists, projections are built from the tensor-parallel
+mpu layers (reference analog: PaddleNLP's LLaMA on fleet mpu).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..nn import (Layer, LayerList, Linear, Embedding, RMSNorm, Silu)
+from ..nn import functional as F
+from ..tensor.manipulation import reshape, transpose, concat
+from ..tensor.tensor import Tensor
+from ..incubate.nn.functional import (fused_rotary_position_embedding,
+                                      swiglu)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaDecoderLayer", "LlamaAttention", "LlamaMLP"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    tensor_parallel: bool = True  # use mpu layers when a mesh exists
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _make_linear(cfg, in_f, out_f, kind):
+    """Column/Row-parallel when an mp mesh axis exists, else plain."""
+    if cfg.tensor_parallel:
+        from ..distributed.mesh import get_global_mesh
+        mesh = get_global_mesh()
+        if mesh is not None and "mp" in mesh.axis_names and \
+                mesh.shape["mp"] > 1:
+            from ..distributed.fleet.meta_parallel import (
+                ColumnParallelLinear, RowParallelLinear)
+            if kind == "col":
+                return ColumnParallelLinear(in_f, out_f, has_bias=False,
+                                            gather_output=False)
+            return RowParallelLinear(in_f, out_f, has_bias=False,
+                                     input_is_parallel=True)
+    return Linear(in_f, out_f, bias_attr=False)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        kvh = cfg.num_key_value_heads * cfg.head_dim
+        self.q_proj = _make_linear(cfg, h, h, "col")
+        self.k_proj = _make_linear(cfg, h, kvh, "col")
+        self.v_proj = _make_linear(cfg, h, kvh, "col")
+        self.o_proj = _make_linear(cfg, h, h, "row")
+
+    def forward(self, x, cache=None):
+        cfg = self.cfg
+        b, s, h = x.shape
+        q = reshape(self.q_proj(x), [b, s, cfg.num_attention_heads,
+                                     cfg.head_dim])
+        k = reshape(self.k_proj(x), [b, s, cfg.num_key_value_heads,
+                                     cfg.head_dim])
+        v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads,
+                                     cfg.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, rotary_emb_base=cfg.rope_theta)
+        if cache is not None:
+            pk, pv = cache
+            k = concat([pk, k], axis=1)
+            v = concat([pv, v], axis=1)
+            new_cache = (k, v)
+        if cfg.num_key_value_heads != cfg.num_attention_heads:
+            from ..tensor.manipulation import repeat_interleave
+            rep = cfg.num_attention_heads // cfg.num_key_value_heads
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v,
+                                             is_causal=cache is None)
+        out = reshape(out, [b, s, h])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, f = cfg.hidden_size, cfg.intermediate_size
+        self.gate_proj = _make_linear(cfg, h, f, "col")
+        self.up_proj = _make_linear(cfg, h, f, "col")
+        self.down_proj = _make_linear(cfg, f, h, "row")
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size,
+                                       epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cache=None):
+        res = x
+        y = self.input_layernorm(x)
+        if cache is not None:
+            attn, new_cache = self.self_attn(y, cache)
+        else:
+            attn = self.self_attn(y)
+        x = res + attn
+        res = x
+        x = res + self.mlp(self.post_attention_layernorm(x))
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = LayerList(
+            [LlamaDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, caches=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.llama = LlamaModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None, caches=None):
+        if caches is not None:
+            x, new_caches = self.llama(input_ids, caches)
+        else:
+            x = self.llama(input_ids)
+        if self.lm_head is None:
+            from ..tensor.linalg import matmul
+            logits = matmul(x, self.llama.embed_tokens.weight,
+                            transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        if labels is not None:
+            from ..tensor.manipulation import reshape as rs
+            loss = F.cross_entropy(
+                rs(logits, [-1, self.cfg.vocab_size]),
+                rs(labels, [-1]))
+            return loss
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_p=None):
+        """Greedy / top-p decode (eager, with kv cache)."""
+        from ..autograd import tape
+        from ..tensor.creation import zeros
+        from ..tensor.manipulation import concat as tconcat
+        cfg = self.cfg
+        with tape.no_grad_guard():
+            b = input_ids.shape[0]
+            caches = [(zeros([b, 0, cfg.num_key_value_heads,
+                              cfg.head_dim]),
+                       zeros([b, 0, cfg.num_key_value_heads,
+                              cfg.head_dim]))
+                      for _ in range(cfg.num_hidden_layers)]
+            logits, caches = self.forward(input_ids, caches=caches)
+            tokens = input_ids
+            for _ in range(max_new_tokens):
+                last = logits[:, -1]
+                if top_p is not None:
+                    from ..tensor.search import top_p_sampling
+                    from ..tensor.creation import full
+                    _, nxt = top_p_sampling(last / temperature,
+                                            full([b], top_p))
+                else:
+                    from ..tensor.search import argmax
+                    nxt = argmax(last, axis=-1, keepdim=True)
+                nxt = reshape(nxt, [b, 1])
+                tokens = tconcat([tokens, nxt], axis=1)
+                logits, caches = self.forward(nxt, caches=caches)
+            return tokens

@@ -221,6 +221,8 @@ class SpectralNorm(Layer):
 
     def forward(self, weight):
         from ...ops.dispatch import apply, as_tensor
+        from ...autograd import tape
+        import jax
         import jax.numpy as jnp
         w = as_tensor(weight)
         dim, iters, eps = self._dim, self._power_iters, self._epsilon
@@ -235,7 +237,13 @@ class SpectralNorm(Layer):
                 v = v / (jnp.linalg.norm(v) + eps)
                 u = mat @ v
                 u = u / (jnp.linalg.norm(u) + eps)
+            # power iterations accumulate across calls via the buffers
             sigma = u @ mat @ v
-            return wt / sigma
+            return wt / sigma, jax.lax.stop_gradient(u), \
+                jax.lax.stop_gradient(v)
 
-        return apply("spectral_norm", fn, w)
+        out, u_new, v_new = apply("spectral_norm", fn, w, n_outputs=3)
+        if not tape.in_functional_trace():
+            self.weight_u._data = u_new._data
+            self.weight_v._data = v_new._data
+        return out

@@ -170,7 +170,8 @@ class RNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         return _run_cell_scan(self.cell, inputs, initial_states,
-                              self.is_reverse, self.time_major)
+                              self.is_reverse, self.time_major,
+                              sequence_length)
 
 
 def _cell_kind(cell):
@@ -181,7 +182,8 @@ def _cell_kind(cell):
     return "simple"
 
 
-def _run_cell_scan(cell, inputs, initial_states, is_reverse, time_major):
+def _run_cell_scan(cell, inputs, initial_states, is_reverse, time_major,
+                   sequence_length=None):
     inputs = as_tensor(inputs)
     b = inputs.shape[0] if not time_major else inputs.shape[1]
     kind = _cell_kind(cell)
@@ -195,31 +197,53 @@ def _run_cell_scan(cell, inputs, initial_states, is_reverse, time_major):
     states = initial_states if isinstance(initial_states, (tuple, list)) \
         else (initial_states,)
     act = getattr(cell, "activation", "tanh")
+    has_len = sequence_length is not None
+    n_state = 2 if kind == "lstm" else 1
 
     def fn(x, *args):
-        n_state = 2 if kind == "lstm" else 1
         st = args[:n_state]
-        wi, wh, bi, bh = args[n_state:]
+        if has_len:
+            lens = args[n_state].astype(jnp.int32)
+            wi, wh, bi, bh = args[n_state + 1:]
+        else:
+            lens = None
+            wi, wh, bi, bh = args[n_state:]
         if not time_major:
             x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
-        if is_reverse:
-            x = jnp.flip(x, 0)
+        T = x.shape[0]
+        if has_len:
+            if is_reverse:
+                # gather each sequence's valid region reversed in place
+                t_idx = jnp.clip(lens[None, :] - 1 -
+                                 jnp.arange(T)[:, None], 0)   # [T, B]
+                x = jnp.take_along_axis(x, t_idx[:, :, None], axis=0)
+            mask = (jnp.arange(T)[:, None] < lens[None, :])[..., None]
+        else:
+            if is_reverse:
+                x = jnp.flip(x, 0)
+            mask = jnp.ones((T, 1, 1), bool)
+
+        def masked(m, new, old):
+            return jnp.where(m, new, old)
 
         if kind == "lstm":
-            def step(carry, xt):
+            def step(carry, xm):
+                xt, m = xm
                 h, c = carry
                 gates = xt @ wi.T + bi + h @ wh.T + bh
                 i, f, g, o = jnp.split(gates, 4, axis=-1)
                 i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
                            jax.nn.sigmoid(o))
                 g = jnp.tanh(g)
-                c_new = f * c + i * g
-                h_new = o * jnp.tanh(c_new)
-                return (h_new, c_new), h_new
-            carry, outs = jax.lax.scan(step, (st[0], st[1]), x)
+                c_new = masked(m, f * c + i * g, c)
+                h_new = masked(m, o * jnp.tanh(c_new), h)
+                out = jnp.where(m, h_new, 0.0)
+                return (h_new, c_new), out
+            carry, outs = jax.lax.scan(step, (st[0], st[1]), (x, mask))
             final = carry
         elif kind == "gru":
-            def step(h, xt):
+            def step(h, xm):
+                xt, m = xm
                 gi = xt @ wi.T + bi
                 gh = h @ wh.T + bh
                 ir, iz, ic = jnp.split(gi, 3, axis=-1)
@@ -227,27 +251,37 @@ def _run_cell_scan(cell, inputs, initial_states, is_reverse, time_major):
                 r = jax.nn.sigmoid(ir + hr)
                 z = jax.nn.sigmoid(iz + hz)
                 c = jnp.tanh(ic + r * hc)
-                h_new = (1 - z) * c + z * h
-                return h_new, h_new
-            h_fin, outs = jax.lax.scan(step, st[0], x)
+                h_new = masked(m, (1 - z) * c + z * h, h)
+                return h_new, jnp.where(m, h_new, 0.0)
+            h_fin, outs = jax.lax.scan(step, st[0], (x, mask))
             final = (h_fin,)
         else:
             a_fn = jnp.tanh if act == "tanh" else jax.nn.relu
 
-            def step(h, xt):
-                h_new = a_fn(xt @ wi.T + bi + h @ wh.T + bh)
-                return h_new, h_new
-            h_fin, outs = jax.lax.scan(step, st[0], x)
+            def step(h, xm):
+                xt, m = xm
+                h_new = masked(m, a_fn(xt @ wi.T + bi + h @ wh.T + bh), h)
+                return h_new, jnp.where(m, h_new, 0.0)
+            h_fin, outs = jax.lax.scan(step, st[0], (x, mask))
             final = (h_fin,)
 
         if is_reverse:
-            outs = jnp.flip(outs, 0)
+            if has_len:
+                # p -> lens-1-p is an involution over the valid region
+                t_idx = jnp.clip(lens[None, :] - 1 -
+                                 jnp.arange(T)[:, None], 0)
+                outs = jnp.take_along_axis(outs, t_idx[:, :, None],
+                                           axis=0)
+                outs = jnp.where(mask, outs, 0.0)
+            else:
+                outs = jnp.flip(outs, 0)
         if not time_major:
             outs = jnp.swapaxes(outs, 0, 1)
         return (outs,) + tuple(final)
 
-    n_state = 2 if kind == "lstm" else 1
-    results = apply("rnn_scan", fn, inputs, *[as_tensor(s) for s in states],
+    extra = [as_tensor(sequence_length)] if has_len else []
+    results = apply("rnn_scan", fn, inputs,
+                    *[as_tensor(s) for s in states], *extra,
                     cell.weight_ih, cell.weight_hh, cell.bias_ih,
                     cell.bias_hh, n_outputs=1 + n_state)
     outs = results[0]
@@ -267,10 +301,10 @@ class BiRNN(Layer):
             initial_states = (None, None)
         out_f, st_f = _run_cell_scan(self.cell_fw, inputs,
                                      initial_states[0], False,
-                                     self.time_major)
+                                     self.time_major, sequence_length)
         out_b, st_b = _run_cell_scan(self.cell_bw, inputs,
                                      initial_states[1], True,
-                                     self.time_major)
+                                     self.time_major, sequence_length)
         return concat([out_f, out_b], axis=-1), (st_f, st_b)
 
 
@@ -325,7 +359,7 @@ class _MultiLayerRNN(Layer):
                     else:
                         init = initial_states[idx]
                 o, st = _run_cell_scan(cell, out, init, di == 1,
-                                       self.time_major)
+                                       self.time_major, sequence_length)
                 outs_dir.append(o)
                 if is_lstm:
                     last_h.append(st[0])

@@ -303,6 +303,15 @@ class OneCycleLR(LRScheduler):
         if step <= up_steps and up_steps > 0:
             return self._interp(self.initial_lr, self.max_lr,
                                 step / up_steps)
+        if self.three_phase:
+            # symmetric down phase back to initial_lr, then annihilation
+            down_steps = up_steps
+            if step <= up_steps + down_steps:
+                pct = (step - up_steps) / max(down_steps, 1)
+                return self._interp(self.max_lr, self.initial_lr, pct)
+            rest = max(self.total_steps - up_steps - down_steps, 1)
+            pct = (step - up_steps - down_steps) / rest
+            return self._interp(self.initial_lr, self.end_lr, pct)
         down = (step - up_steps) / max(self.total_steps - up_steps, 1)
         return self._interp(self.max_lr, self.end_lr, down)
 

@@ -55,6 +55,7 @@ class Optimizer:
         self._states: Dict[int, Dict[str, Any]] = {}
         self._step_count = 0
         self._param_groups = None
+        self._current_param: Optional[Tensor] = None
 
     # -- lr ----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -113,6 +114,7 @@ class Optimizer:
         for p, g in params_grads:
             if g is None:
                 continue
+            self._current_param = p  # rules may consult name/attrs
             g_arr = g._data if isinstance(g, Tensor) else g
             state = self._get_state(p)
             if "master" in state:
@@ -129,6 +131,7 @@ class Optimizer:
                 p._data = new_param.astype(p._data.dtype)
             else:
                 p._data = new_param
+        self._current_param = None
 
     minimize_step = step
 
@@ -276,39 +279,6 @@ class AdamW(Adam):
             weight_decay, "_coeff") else float(weight_decay._coeff)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
-        self._current_param = None
-
-    @tape.no_grad_guard()
-    def step(self):
-        # route through base step but remember which param is being updated
-        params = self._params()
-        params_grads = [(p, p.grad) for p in params
-                        if not p.stop_gradient and p._grad is not None]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        lr = self.get_lr()
-        self._step_count += 1
-        for p, g in params_grads:
-            if g is None:
-                continue
-            self._current_param = p
-            g_arr = g._data if isinstance(g, Tensor) else g
-            state = self._get_state(p)
-            if "master" in state:
-                compute_param = state["master"]
-                g_arr = g_arr.astype(jnp.float32)
-            else:
-                compute_param = p._data
-            new_param, new_state = self._update(compute_param, g_arr,
-                                                state, lr)
-            for k, v in new_state.items():
-                state[k] = v
-            if "master" in state:
-                state["master"] = new_param
-                p._data = new_param.astype(p._data.dtype)
-            else:
-                p._data = new_param
-        self._current_param = None
 
     def _update(self, param, grad, state, lr):
         p = self._current_param
@@ -430,7 +400,6 @@ class Lamb(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_decay = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
-        self._current_param = None
 
     def _init_state(self, p):
         return {"moment1": jnp.zeros_like(p._data),

@@ -40,8 +40,9 @@ class ToTensor:
         self.data_format = data_format
 
     def __call__(self, img):
+        raw = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
         a = _chw(img)
-        if a.max() > 1.5:  # uint8-scale input
+        if raw.dtype == np.uint8:  # keyed on dtype, not value range
             a = a / 255.0
         return a
 

@@ -1,0 +1,123 @@
+"""Flagship LLaMA tests: Layer model, functional pretrain engine,
+hybrid-mesh train step, graft entry."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def tiny_cfg(**kw):
+    from paddle_tpu.models import LlamaConfig
+    base = dict(vocab_size=64, hidden_size=32, intermediate_size=96,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=32, tensor_parallel=False)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def test_llama_layer_forward_and_loss():
+    from paddle_tpu.models import LlamaForCausalLM
+    model = LlamaForCausalLM(tiny_cfg())
+    ids = paddle.randint(0, 64, [2, 16])
+    logits = model(ids)
+    assert logits.shape == [2, 16, 64]
+    loss = model(ids, labels=ids)
+    assert loss.size == 1
+    loss.backward()
+    grads = [p for p in model.parameters() if p.grad is not None]
+    assert len(grads) == len(model.parameters())
+
+
+def test_llama_generate():
+    from paddle_tpu.models import LlamaForCausalLM
+    model = LlamaForCausalLM(tiny_cfg())
+    ids = paddle.randint(0, 64, [1, 4])
+    out = model.generate(ids, max_new_tokens=4)
+    assert out.shape == [1, 8]
+
+
+def test_llama_train_converges():
+    from paddle_tpu.models import LlamaForCausalLM
+    paddle.seed(0)
+    model = LlamaForCausalLM(tiny_cfg())
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    ids = paddle.randint(0, 64, [2, 16])
+    first = None
+    for _ in range(15):
+        loss = model(ids, labels=ids)
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first * 0.8, (first, float(loss))
+
+
+def test_pretrain_engine_hybrid_meshes():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama_pretrain import (
+        LlamaPretrainConfig, build_mesh, init_params, init_adamw_state,
+        make_train_step)
+
+    for dp, pp, mp in [(8, 1, 1), (2, 2, 2)]:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=192,
+            num_hidden_layers=2 * max(pp, 1), num_attention_heads=4,
+            num_key_value_heads=4, max_seq_len=32,
+            use_pallas_attention=False, sequence_parallel=(mp > 1),
+            remat=True, dtype=jnp.float32)
+        mesh = build_mesh(dp=dp, pp=pp, sharding=1, sep=1, mp=mp)
+        with mesh:
+            params = init_params(cfg, jax.random.PRNGKey(0), mesh, pp=pp)
+            opt = init_adamw_state(params, mesh, zero_axis="dp")
+            mb = 2 if pp > 1 else 1
+            step = make_train_step(cfg, mesh, pp=pp, microbatches=mb)
+            toks = jnp.asarray(np.random.RandomState(0).randint(
+                0, 128, (4 * dp * mb, 32)))
+            params, opt, loss = step(params, opt, toks)
+            assert np.isfinite(float(loss))
+
+
+def test_pipeline_matches_single_stage():
+    """pp=2 pipeline must produce the same loss as pp=1 on identical
+    params (numerical equivalence of the GPipe schedule)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama_pretrain import (
+        LlamaPretrainConfig, build_mesh, init_params, make_forward)
+
+    cfg = LlamaPretrainConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=4, max_seq_len=16,
+        use_pallas_attention=False, sequence_parallel=False,
+        remat=False, dtype=jnp.float32)
+    mesh = build_mesh(dp=2, pp=2, sharding=1, sep=1, mp=2)
+    toks = jnp.asarray(np.random.RandomState(1).randint(0, 64, (4, 16)))
+    with mesh:
+        params_pp = init_params(cfg, jax.random.PRNGKey(0), mesh, pp=2)
+        loss_pp = jax.jit(make_forward(cfg, mesh, pp=2, microbatches=2))(
+            params_pp, toks)
+        # same weights, flat layer stack
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), params_pp["blocks"])
+        params_flat = dict(params_pp)
+        params_flat["blocks"] = flat
+        loss_flat = jax.jit(make_forward(cfg, mesh, pp=1))(params_flat,
+                                                           toks)
+    np.testing.assert_allclose(float(loss_pp), float(loss_flat),
+                               rtol=2e-5)
+
+
+def test_graft_entry():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    import jax
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert np.isfinite(float(out))
+    ge.dryrun_multichip(8)
