@@ -47,6 +47,9 @@ from . import autograd  # noqa: F401
 from .autograd import (  # noqa: F401
     no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad)
 
+# Pallas hot kernels register themselves into the op dispatch table.
+from .ops import pallas as _pallas  # noqa: F401,E402
+
 # grad-mode helpers paddle exposes at top level
 from .autograd import backward as _autograd_backward  # noqa: F401
 

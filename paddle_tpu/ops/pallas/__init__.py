@@ -1,0 +1,28 @@
+"""Pallas TPU kernels — the hot-op set (SURVEY.md §7 step 10).
+
+``register_pallas_ops()`` installs them in the op dispatch table; called
+at package import.  Each kernel has an interpret-mode path so the same
+code runs (slowly) on CPU for tests (FLAGS_pallas_interpret)."""
+
+from __future__ import annotations
+
+from ..dispatch import register_op_impl
+from .flash_attention import flash_attention
+from .rms_norm import rms_norm
+from .fused_adamw import fused_adamw
+
+__all__ = ["flash_attention", "rms_norm", "fused_adamw",
+           "register_pallas_ops"]
+
+
+def register_pallas_ops() -> None:
+    register_op_impl("flash_attention", flash_attention)
+    register_op_impl("fused_adamw",
+                     lambda p, g, m, v, t, lr, b1, b2, eps, wd:
+                     fused_adamw(p, g, m, v, t, lr, b1, b2, eps, wd))
+    # rms_norm joins the table only where the Pallas path beats XLA's
+    # fusion (long rows); benchmarked per shape — functional layer asks
+    # via get_op_impl("rms_norm").
+
+
+register_pallas_ops()

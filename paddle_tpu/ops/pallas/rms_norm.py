@@ -1,0 +1,111 @@
+"""RMSNorm Pallas kernel (fwd + bwd).
+
+Replacement for the reference's fused_rms_norm CUDA kernel
+(python/paddle/incubate/nn/functional/fused_rms_norm.py).  One VMEM pass:
+fp32 accumulation, fused scale."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["rms_norm"]
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + jnp.float32(eps))
+    o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(
+        o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _bwd_kernel(x_ref, w_ref, rstd_ref, do_ref, dx_ref, dwp_ref, *,
+                eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    do = do_ref[:].astype(jnp.float32)
+    xhat = x * rstd
+    wdo = w * do
+    h = x.shape[-1]
+    c = jnp.mean(xhat * wdo, axis=-1, keepdims=True)
+    dx = (wdo - xhat * c) * rstd
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dwp_ref[:] = jnp.sum(xhat * do, axis=0, keepdims=True)
+
+
+def _interpret() -> bool:
+    from ...flags import flags
+    if flags.FLAGS_pallas_interpret:
+        return True
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def _rows(x):
+    return x.reshape(-1, x.shape[-1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, w, eps: float = 1e-6):
+    out, _ = _fwd(x, w, eps)
+    return out
+
+
+def _block_rows(n):
+    for b in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def _fwd(x, w, eps):
+    orig_shape = x.shape
+    xr = _rows(x)
+    n, h = xr.shape
+    br = _block_rows(n)
+    out, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        out_shape=(jax.ShapeDtypeStruct((n, h), x.dtype),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)),
+        grid=(n // br,),
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0))],
+        out_specs=(pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0))),
+        interpret=_interpret(),
+    )(xr, w.reshape(1, -1))
+    return out.reshape(orig_shape), (xr, w, rstd, orig_shape)
+
+
+def _fwd_vjp(x, w, eps):
+    return _fwd(x, w, eps)
+
+
+def _bwd_vjp(eps, res, dout):
+    xr, w, rstd, orig_shape = res
+    n, h = xr.shape
+    br = _block_rows(n)
+    do = dout.reshape(n, h)
+    dx, dw_partial = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps),
+        out_shape=(jax.ShapeDtypeStruct((n, h), xr.dtype),
+                   jax.ShapeDtypeStruct((n // br, h), jnp.float32)),
+        grid=(n // br,),
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((1, h), lambda i: (0, 0)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((br, h), lambda i: (i, 0))],
+        out_specs=(pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))),
+        interpret=_interpret(),
+    )(xr, w.reshape(1, -1), rstd, do)
+    dw = jnp.sum(dw_partial, axis=0).astype(w.dtype)
+    return dx.reshape(orig_shape), dw
+
+
+rms_norm.defvjp(_fwd_vjp, _bwd_vjp)
