@@ -607,9 +607,13 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     Pallas kernel via register_op_impl('rms_norm', ...)."""
     x = as_tensor(x)
     impl = get_op_impl("rms_norm", None)
-    if impl is not None:
-        if weight is not None:
-            return apply("rms_norm_pallas", impl, x, as_tensor(weight))
+    if (impl is not None and weight is not None
+            and jax.default_backend() in ("tpu", "axon")):
+        # on CPU the Pallas kernel would run in interpret mode — far
+        # slower than the jnp composite below, which XLA fuses anyway
+        return apply("rms_norm_pallas",
+                     lambda a, w: impl(a, w, epsilon),
+                     x, as_tensor(weight))
 
     def fn(a, *w):
         var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1,
@@ -1334,8 +1338,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         logits = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) * scale
         if is_causal:
             s_q, s_k = logits.shape[-2], logits.shape[-1]
-            causal = jnp.tril(jnp.ones((s_q, s_k), bool))
-            logits = jnp.where(causal, logits, -jnp.inf)
+            # diagonal aligned to the END of the kv sequence so a decode
+            # query (s_q=1 against a length-S cache) attends to the whole
+            # cache, matching ops/pallas/flash_attention._xla_sdpa
+            q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+            k_pos = jnp.arange(s_k)[None, :]
+            logits = jnp.where(q_pos >= k_pos, logits, -jnp.inf)
         if mask:
             m = mask[0]
             if m.dtype == jnp.bool_:

@@ -16,13 +16,14 @@ __all__ = ["flash_attention", "rms_norm", "fused_adamw",
 
 
 def register_pallas_ops() -> None:
+    # Compiled-path correctness of these kernels on real TPU is covered
+    # by tests/test_pallas_tpu.py (interpret=False lane); flash_attention
+    # routes unsupported static shapes to its internal XLA fallback.
     register_op_impl("flash_attention", flash_attention)
     register_op_impl("fused_adamw",
                      lambda p, g, m, v, t, lr, b1, b2, eps, wd:
                      fused_adamw(p, g, m, v, t, lr, b1, b2, eps, wd))
-    # rms_norm joins the table only where the Pallas path beats XLA's
-    # fusion (long rows); benchmarked per shape — functional layer asks
-    # via get_op_impl("rms_norm").
+    register_op_impl("rms_norm", rms_norm)
 
 
 register_pallas_ops()

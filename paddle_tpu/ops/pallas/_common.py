@@ -1,0 +1,19 @@
+"""Shared Pallas helpers.
+
+The framework enables jax_enable_x64 globally (paddle_tpu/__init__.py) for
+int64/float64 API parity.  Under x64, Python int literals in BlockSpec
+index maps lower as i64 and Mosaic fails to legalize the mixed-width
+index tuple (``func.return (i32, i32, i64)``).  Every index map in our
+kernels therefore goes through :func:`idx32`, which pins each component
+to int32.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["idx32"]
+
+
+def idx32(*idx):
+    return tuple(jnp.int32(i) for i in idx)

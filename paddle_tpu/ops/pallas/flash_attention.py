@@ -22,11 +22,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ._common import idx32
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention"]
 
 NEG_INF = -1e30
+
+
+def _idiv(a, b):
+    # Mosaic's lowering of jnp floor_divide on traced int scalars
+    # recurses infinitely (promote-to-float path); lax.div is trunc
+    # division — identical for the non-negative indices used here.
+    return jax.lax.div(jnp.int32(a), jnp.int32(b))
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
@@ -41,7 +50,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     if causal:
         # only blocks with k_start <= q_end participate
         num_k_eff = jnp.minimum(
-            ((qi.astype(jnp.int32) + 1) * Bq + block_k - 1) // block_k,
+            _idiv((qi.astype(jnp.int32) + 1) * Bq + block_k - 1, block_k),
             num_k).astype(jnp.int32)
     else:
         num_k_eff = num_k
@@ -91,7 +100,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_k = jnp.int32(S // block_k)
     if causal:
         num_k_eff = jnp.minimum(
-            ((qi.astype(jnp.int32) + 1) * Bq + block_k - 1) // block_k,
+            _idiv((qi.astype(jnp.int32) + 1) * Bq + block_k - 1, block_k),
             num_k).astype(jnp.int32)
     else:
         num_k_eff = num_k
@@ -131,8 +140,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     num_q = jnp.int32(S // block_q)
     if causal:
-        first_q = ((ki.astype(jnp.int32) * Bk) // block_q).astype(
-            jnp.int32)
+        first_q = _idiv(ki.astype(jnp.int32) * Bk, block_q)
     else:
         first_q = jnp.int32(0)
 
@@ -167,14 +175,40 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _pick_blocks(S: int, d: int):
-    bq = min(128, S)
-    bk = min(128, S)
-    while S % bq:
-        bq //= 2
-    while S % bk:
-        bk //= 2
-    return max(bq, 8), max(bk, 8)
+def _pick_blocks(S: int):
+    """Largest power-of-two block <= 128 that divides S, or None when no
+    block >= 8 divides S (caller must fall back to the XLA path — a
+    non-dividing block floor-truncates the grid and leaves rows
+    uninitialized)."""
+    for b in (128, 64, 32, 16, 8):
+        if S % b == 0:
+            return b, b
+    return None
+
+
+def _xla_sdpa(q, k, v, causal):
+    """Reference XLA attention — fallback for shapes the Pallas kernel
+    does not support (indivisible S, decode q_len != kv_len).  XLA fuses
+    this well; autodiff is native."""
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        q_len, k_len = q.shape[1], k.shape[1]
+        if q_len > k_len:
+            # end-aligned causal would fully mask the leading rows and
+            # softmax would silently return uniform garbage
+            raise ValueError(
+                f"causal attention requires q_len <= kv_len, got "
+                f"q_len={q_len} kv_len={k_len}")
+        # align the causal diagonal to the *end* of the kv sequence so a
+        # 1-token decode query attends to the full cache
+        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        k_pos = jnp.arange(k_len)[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def _interpret() -> bool:
@@ -185,9 +219,19 @@ def _interpret() -> bool:
         jax.devices()[0].platform not in ("tpu", "axon")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal: bool = False):
-    """q/k/v: [b, s, h, d] -> out [b, s, h, d]."""
+    """q/k/v: [b, s, h, d] -> out [b, s, h, d].
+
+    Routes to the Pallas kernel when the (static) shapes fit its blocking
+    (q_len == kv_len, a power-of-two block >= 8 divides S); otherwise
+    falls back to a fused XLA attention (decode shapes, odd lengths)."""
+    if q.shape[1] == k.shape[1] and _pick_blocks(q.shape[1]) is not None:
+        return _flash_pallas(q, k, v, causal)
+    return _xla_sdpa(q, k, v, causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_pallas(q, k, v, causal: bool = False):
     out, _ = _flash_fwd(q, k, v, causal)
     return out
 
@@ -206,7 +250,7 @@ def _flash_fwd(q, k, v, causal):
     b, s, h, d = q.shape
     sm_scale = 1.0 / math.sqrt(d)
     qr, kr, vr = _reshape_in(q), _reshape_in(k), _reshape_in(v)
-    bq, bk = _pick_blocks(s, d)
+    bq, bk = _pick_blocks(s)
     grid = (b * h, s // bq)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
@@ -215,13 +259,13 @@ def _flash_fwd(q, k, v, causal):
                    jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: idx32(i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: idx32(i, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bq, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, bq, 1), lambda i, j: idx32(i, j, 0)),
         ),
         interpret=_interpret(),
     )(qr, kr, vr)
@@ -239,7 +283,7 @@ def _flash_bwd_vjp(causal, res, dout):
     do = _reshape_in(dout)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    bq, bk = _pick_blocks(s, d)
+    bq, bk = _pick_blocks(s)
     interp = _interpret()
 
     dq = pl.pallas_call(
@@ -248,14 +292,14 @@ def _flash_bwd_vjp(causal, res, dout):
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), qr.dtype),
         grid=(b * h, s // bq),
         in_specs=[
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bq, 1), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bq, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: idx32(i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: idx32(i, 0, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, bq, 1), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, bq, 1), lambda i, j: idx32(i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: idx32(i, j, 0)),
         interpret=interp,
     )(qr, kr, vr, do, lse, delta)
 
@@ -266,16 +310,16 @@ def _flash_bwd_vjp(causal, res, dout):
                    jax.ShapeDtypeStruct((b * h, s, d), vr.dtype)),
         grid=(b * h, s // bk),
         in_specs=[
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: idx32(i, 0, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: idx32(i, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda i, j: idx32(i, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda i, j: idx32(i, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: idx32(i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: idx32(i, j, 0)),
         ),
         interpret=interp,
     )(qr, kr, vr, do, lse, delta)
@@ -284,4 +328,4 @@ def _flash_bwd_vjp(causal, res, dout):
             _reshape_out(dv, b, h))
 
 
-flash_attention.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+_flash_pallas.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)

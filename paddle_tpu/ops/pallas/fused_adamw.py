@@ -12,23 +12,29 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ._common import idx32
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_adamw"]
 
 
-def _kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref, o_p, o_m, o_v, *,
-            b1: float, b2: float, eps: float, wd: float):
+def _kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, c1_ref, c2_ref,
+            o_p, o_m, o_v, *, b1: float, b2: float, eps: float,
+            wd: float):
+    # c1/c2 = 1 - beta**t bias corrections, computed OUTSIDE the kernel:
+    # Mosaic cannot legalize powf on a traced scalar exponent.
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     m = m_ref[:]
     v = v_ref[:]
     lr = lr_ref[0]
-    t = t_ref[0]
+    c1 = c1_ref[0]
+    c2 = c2_ref[0]
     m_new = jnp.float32(b1) * m + jnp.float32(1.0 - b1) * g
     v_new = jnp.float32(b2) * v + jnp.float32(1.0 - b2) * g * g
-    mhat = m_new / (jnp.float32(1.0) - jnp.float32(b1) ** t)
-    vhat = v_new / (jnp.float32(1.0) - jnp.float32(b2) ** t)
+    mhat = m_new / c1
+    vhat = v_new / c2
     p_new = (p * (jnp.float32(1.0) - lr * jnp.float32(wd)) -
              lr * mhat / (jnp.sqrt(vhat) + jnp.float32(eps)))
     o_p[:] = p_new.astype(o_p.dtype)
@@ -62,7 +68,9 @@ def fused_adamw(p, g, m, v, t, lr, b1=0.9, b2=0.95, eps=1e-8,
         return x if dt is None else x.astype(dt)
 
     lr_arr = jnp.asarray([lr], jnp.float32)
-    t_arr = jnp.asarray([t], jnp.float32)
+    tf = jnp.asarray(t, jnp.float32)
+    c1_arr = (1.0 - jnp.float32(b1) ** tf).reshape(1)
+    c2_arr = (1.0 - jnp.float32(b2) ** tf).reshape(1)
     new_p, new_m, new_v = pl.pallas_call(
         functools.partial(_kernel, b1=b1, b2=b2, eps=eps,
                           wd=weight_decay),
@@ -71,18 +79,24 @@ def fused_adamw(p, g, m, v, t, lr, b1=0.9, b2=0.95, eps=1e-8,
                    jax.ShapeDtypeStruct((rows, h), jnp.float32)),
         grid=(rows // br,),
         in_specs=[
-            pl.BlockSpec((br, h), lambda i: (i, 0)),
-            pl.BlockSpec((br, h), lambda i: (i, 0)),
-            pl.BlockSpec((br, h), lambda i: (i, 0)),
-            pl.BlockSpec((br, h), lambda i: (i, 0)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # lr scalar
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # t scalar
+            pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+            pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+            pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+            pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+            # explicit index maps: the default map emits i64 literals
+            # under x64, which Mosaic cannot legalize
+            pl.BlockSpec((1,), lambda i: idx32(0),
+                         memory_space=pltpu.SMEM),  # lr scalar
+            pl.BlockSpec((1,), lambda i: idx32(0),
+                         memory_space=pltpu.SMEM),  # 1-b1**t
+            pl.BlockSpec((1,), lambda i: idx32(0),
+                         memory_space=pltpu.SMEM),  # 1-b2**t
         ],
-        out_specs=(pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((br, h), lambda i: (i, 0))),
+        out_specs=(pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+                   pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+                   pl.BlockSpec((br, h), lambda i: idx32(i, 0))),
         interpret=_interpret(),
     )(flat2(p), flat2(g, jnp.float32), flat2(m, jnp.float32),
-      flat2(v, jnp.float32), lr_arr, t_arr)
+      flat2(v, jnp.float32), lr_arr, c1_arr, c2_arr)
     return (new_p.reshape(shape),
             {"m": new_m.reshape(shape), "v": new_v.reshape(shape)})

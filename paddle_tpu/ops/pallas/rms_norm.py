@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import idx32
+
 __all__ = ["rms_norm"]
 
 
@@ -73,10 +75,10 @@ def _fwd(x, w, eps):
         out_shape=(jax.ShapeDtypeStruct((n, h), x.dtype),
                    jax.ShapeDtypeStruct((n, 1), jnp.float32)),
         grid=(n // br,),
-        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
-                  pl.BlockSpec((1, h), lambda i: (0, 0))],
-        out_specs=(pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((br, 1), lambda i: (i, 0))),
+        in_specs=[pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+                  pl.BlockSpec((1, h), lambda i: idx32(0, 0))],
+        out_specs=(pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: idx32(i, 0))),
         interpret=_interpret(),
     )(xr, w.reshape(1, -1))
     return out.reshape(orig_shape), (xr, w, rstd, orig_shape)
@@ -96,12 +98,12 @@ def _bwd_vjp(eps, res, dout):
         out_shape=(jax.ShapeDtypeStruct((n, h), xr.dtype),
                    jax.ShapeDtypeStruct((n // br, h), jnp.float32)),
         grid=(n // br,),
-        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
-                  pl.BlockSpec((1, h), lambda i: (0, 0)),
-                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
-                  pl.BlockSpec((br, h), lambda i: (i, 0))],
-        out_specs=(pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))),
+        in_specs=[pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+                  pl.BlockSpec((1, h), lambda i: idx32(0, 0)),
+                  pl.BlockSpec((br, 1), lambda i: idx32(i, 0)),
+                  pl.BlockSpec((br, h), lambda i: idx32(i, 0))],
+        out_specs=(pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
+                   pl.BlockSpec((1, h), lambda i: idx32(i, 0))),
         interpret=_interpret(),
     )(xr, w.reshape(1, -1), rstd, do)
     dw = jnp.sum(dw_partial, axis=0).astype(w.dtype)
