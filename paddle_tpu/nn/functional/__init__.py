@@ -1320,6 +1320,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     still fuses well on the MXU.
     """
     q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    if is_causal and q.shape[1] > k.shape[1]:
+        # end-aligned causal would fully mask the leading query rows and
+        # softmax would return NaN for them
+        raise ValueError(
+            f"causal attention requires q_len <= kv_len, got "
+            f"q_len={q.shape[1]} kv_len={k.shape[1]}")
     impl = get_op_impl("flash_attention", None)
     from ...flags import flags as _flags
     if (impl is not None and _flags.FLAGS_pallas_flash_attention

@@ -176,11 +176,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _pick_blocks(S: int):
-    """Largest power-of-two block <= 128 that divides S, or None when no
+    """Largest power-of-two block <= 512 that divides S, or None when no
     block >= 8 divides S (caller must fall back to the XLA path — a
     non-dividing block floor-truncates the grid and leaves rows
-    uninitialized)."""
-    for b in (128, 64, 32, 16, 8):
+    uninitialized).
+
+    512 measured fastest on v5e at S=2048/d=64: grid-step overhead
+    dominates below 256, VMEM pressure caps above 512 (see BENCH notes)."""
+    for b in (512, 256, 128, 64, 32, 16, 8):
         if S % b == 0:
             return b, b
     return None
