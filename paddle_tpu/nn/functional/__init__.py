@@ -610,8 +610,10 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     if (impl is not None and weight is not None
             and jax.default_backend() in ("tpu", "axon")):
         # on CPU the Pallas kernel would run in interpret mode — far
-        # slower than the jnp composite below, which XLA fuses anyway
-        return apply("rms_norm_pallas",
+        # slower than the jnp composite below, which XLA fuses anyway.
+        # Dispatch under the same op name as the composite so AMP
+        # list-based casting treats both paths identically.
+        return apply("rms_norm",
                      lambda a, w: impl(a, w, epsilon),
                      x, as_tensor(weight))
 
@@ -1319,13 +1321,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     applicable (ops/pallas/flash_attention.py), else an XLA composite that
     still fuses well on the MXU.
     """
+    from ...ops.pallas.flash_attention import causal_mask as _causal_mask
+
     q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
-    if is_causal and q.shape[1] > k.shape[1]:
-        # end-aligned causal would fully mask the leading query rows and
-        # softmax would return NaN for them
-        raise ValueError(
-            f"causal attention requires q_len <= kv_len, got "
-            f"q_len={q.shape[1]} kv_len={k.shape[1]}")
+    if is_causal:
+        _causal_mask(q.shape[1], k.shape[1])  # validates q_len <= kv_len
     impl = get_op_impl("flash_attention", None)
     from ...flags import flags as _flags
     if (impl is not None and _flags.FLAGS_pallas_flash_attention
@@ -1343,13 +1343,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         vv = jnp.swapaxes(vv, 1, 2)
         logits = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) * scale
         if is_causal:
-            s_q, s_k = logits.shape[-2], logits.shape[-1]
-            # diagonal aligned to the END of the kv sequence so a decode
-            # query (s_q=1 against a length-S cache) attends to the whole
-            # cache, matching ops/pallas/flash_attention._xla_sdpa
-            q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
-            k_pos = jnp.arange(s_k)[None, :]
-            logits = jnp.where(q_pos >= k_pos, logits, -jnp.inf)
+            logits = jnp.where(
+                _causal_mask(logits.shape[-2], logits.shape[-1]),
+                logits, -jnp.inf)
         if mask:
             m = mask[0]
             if m.dtype == jnp.bool_:

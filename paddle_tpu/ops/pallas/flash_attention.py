@@ -189,6 +189,23 @@ def _pick_blocks(S: int):
     return None
 
 
+def causal_mask(q_len: int, k_len: int):
+    """Boolean [q_len, k_len] causal mask with the diagonal aligned to
+    the END of the kv sequence, so a 1-token decode query attends to the
+    whole cache.  Single source of truth — the sdpa composite in
+    nn.functional and the XLA fallback here both use it.
+
+    Raises when q_len > k_len: end-aligned causal would fully mask the
+    leading rows and softmax would silently return uniform garbage."""
+    if q_len > k_len:
+        raise ValueError(
+            f"causal attention requires q_len <= kv_len, got "
+            f"q_len={q_len} kv_len={k_len}")
+    q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+    k_pos = jnp.arange(k_len)[None, :]
+    return q_pos >= k_pos
+
+
 def _xla_sdpa(q, k, v, causal):
     """Reference XLA attention — fallback for shapes the Pallas kernel
     does not support (indivisible S, decode q_len != kv_len).  XLA fuses
@@ -197,18 +214,7 @@ def _xla_sdpa(q, k, v, causal):
     qf = q.astype(jnp.float32) / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
     if causal:
-        q_len, k_len = q.shape[1], k.shape[1]
-        if q_len > k_len:
-            # end-aligned causal would fully mask the leading rows and
-            # softmax would silently return uniform garbage
-            raise ValueError(
-                f"causal attention requires q_len <= kv_len, got "
-                f"q_len={q_len} kv_len={k_len}")
-        # align the causal diagonal to the *end* of the kv sequence so a
-        # 1-token decode query attends to the full cache
-        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
-        k_pos = jnp.arange(k_len)[None, :]
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(causal_mask(q.shape[1], k.shape[1]), s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -70,9 +70,13 @@ def _fwd(x, w, eps):
     xr = _rows(x)
     n, h = xr.shape
     br = _block_rows(n)
+    # match the composite path's dtype semantics: norm(x).astype(x.dtype)
+    # * w promotes to the weight dtype (master-weight setups pass f32 w
+    # with bf16 x and expect f32 out)
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
     out, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
-        out_shape=(jax.ShapeDtypeStruct((n, h), x.dtype),
+        out_shape=(jax.ShapeDtypeStruct((n, h), out_dtype),
                    jax.ShapeDtypeStruct((n, 1), jnp.float32)),
         grid=(n // br,),
         in_specs=[pl.BlockSpec((br, h), lambda i: idx32(i, 0)),

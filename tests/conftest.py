@@ -14,7 +14,11 @@ if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# PADDLE_TPU_TESTS_ON_TPU=1 runs the suite on the real chip so the
+# Pallas compiled-path lane (tests/test_pallas_tpu.py) actually
+# exercises Mosaic; default is the fast 8-device virtual CPU mesh.
+if os.environ.get("PADDLE_TPU_TESTS_ON_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,126 @@
+"""Compiled-path (Mosaic, interpret=False) Pallas kernel tests.
+
+Round-1 lesson: interpret-only tests let three broken-on-TPU kernels ship
+green.  This lane runs the kernels through the real Mosaic compiler on
+the TPU chip — parity vs the XLA composite per dtype, decode shapes, and
+the odd-length fallback (reference test model:
+/root/reference/test/legacy_test/op_test.py:2762 per-place/dtype checks).
+
+Run with:  PADDLE_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_pallas_tpu.py
+(the default CPU-pinned suite skips this file).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="compiled Pallas lane needs the real TPU chip",
+)
+
+
+def _mk_qkv(b, s, h, d, dtype, kv_s=None):
+    kk = jax.random.PRNGKey
+    q = jax.random.normal(kk(0), (b, s, h, d), dtype)
+    k = jax.random.normal(kk(1), (b, kv_s or s, h, d), dtype)
+    v = jax.random.normal(kk(2), (b, kv_s or s, h, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-2),
+                                       (jnp.bfloat16, 3e-2)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fwd_parity(dtype, tol, causal):
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention, _xla_sdpa)
+    q, k, v = _mk_qkv(1, 256, 4, 64, dtype)
+    out = flash_attention(q, k, v, causal)
+    ref = _xla_sdpa(q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), causal)
+    err = float(jnp.abs(out.astype(jnp.float32) - ref).max())
+    assert err < tol, err
+
+
+def test_flash_bwd_parity():
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention, _xla_sdpa)
+    q, k, v = _mk_qkv(1, 256, 4, 64, jnp.float32)
+    g = jax.grad(lambda *a: flash_attention(*a, True).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: _xla_sdpa(*a, True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.abs(a - b).max()) < 2e-2
+
+
+def test_flash_decode_and_odd_lengths():
+    """q_len != kv_len (decode) and indivisible S take the XLA fallback
+    and must stay finite/correct (round-1: NaN at S=129, crash at decode)."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention, _xla_sdpa)
+    q, k, v = _mk_qkv(1, 8, 2, 64, jnp.float32, kv_s=8)
+    # decode: 1 query against 8-token cache == last row of full attention
+    dec = flash_attention(q[:, -1:], k, v, True)
+    full = _xla_sdpa(q, k, v, True)
+    # fp32 matmuls run through the MXU at reduced internal precision on
+    # TPU, so parity is ~1e-3, not 1e-6
+    assert float(jnp.abs(dec[:, 0] - full[:, -1]).max()) < 2e-2
+    # odd length: no block divides 129
+    q2, k2, v2 = _mk_qkv(1, 129, 2, 64, jnp.float32)
+    out = flash_attention(q2, k2, v2, True)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_flash_q_longer_than_kv_raises():
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _mk_qkv(1, 16, 2, 64, jnp.float32, kv_s=8)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, True)
+
+
+def test_fused_adamw_compiled():
+    from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+    kk = jax.random.PRNGKey
+    p = jax.random.normal(kk(0), (256, 128), jnp.float32)
+    g = jax.random.normal(kk(1), (256, 128), jnp.float32)
+    m = jnp.full_like(p, 0.5)
+    v = jnp.full_like(p, 0.25)
+    t, lr, b1, b2, eps, wd = 3, 1e-3, 0.9, 0.95, 1e-8, 0.1
+    new_p, slots = fused_adamw(p, g, m, v, t, lr, b1, b2, eps, wd)
+    mn = b1 * np.asarray(m) + (1 - b1) * np.asarray(g)
+    vn = b2 * np.asarray(v) + (1 - b2) * np.asarray(g) ** 2
+    mh = mn / (1 - b1 ** t)
+    vh = vn / (1 - b2 ** t)
+    ref = np.asarray(p) * (1 - lr * wd) - lr * mh / (np.sqrt(vh) + eps)
+    assert np.abs(np.asarray(new_p) - ref).max() < 1e-6
+    assert np.abs(np.asarray(slots["m"]) - mn).max() < 1e-6
+    assert np.abs(np.asarray(slots["v"]) - vn).max() < 1e-6
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 4e-2)])
+def test_rms_norm_compiled(dtype, tol):
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm
+    kk = jax.random.PRNGKey
+    x = jax.random.normal(kk(0), (64, 512), dtype)
+    w = jax.random.normal(kk(1), (512,), jnp.float32)
+    out = rms_norm(x, w)
+    xf = np.asarray(x, np.float32)
+    ref = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) \
+        * np.asarray(w)
+    assert np.abs(np.asarray(out, np.float32) - ref).max() < tol
+    if dtype == jnp.float32:
+        gx, gw = jax.grad(lambda x, w: rms_norm(x, w).sum(),
+                          argnums=(0, 1))(x, w)
+        # numeric check on a few coordinates
+        def f(x):
+            return float(rms_norm(x, w).sum())
+        eps = 1e-3
+        for idx in [(0, 0), (3, 17), (63, 511)]:
+            xp = x.at[idx].add(eps)
+            xm = x.at[idx].add(-eps)
+            num = (f(xp) - f(xm)) / (2 * eps)
+            assert abs(num - float(gx[idx])) < 1e-2
