@@ -195,7 +195,41 @@ def run_backward(tensors: Sequence[Any],
 
     # Seed cotangents.
     node_out_grads: Dict[GradNode, List[Any]] = {}
-    roots: List[GradNode] = []
+    # Hooks fire ONCE on the fully-accumulated gradient (reference:
+    # GradTensorHolder accumulates, then hooks run — backward.cc), never
+    # per consumer edge on partial cotangents.  Leaf totals are staged
+    # until the traversal finishes; non-leaf totals live in the producer
+    # node's slot and are hooked when that node is dequeued (its pending
+    # count reaching zero guarantees every contribution has arrived).
+    leaf_totals: Dict[int, List[Any]] = {}       # id -> [tensor, ct]
+    # (node_id, idx) -> [tensors]: aliases (e.g. Tensor.to copies the
+    # grad node) each get their hooks/capture on the shared slot total
+    slot_tensors: Dict[tuple, List[Any]] = {}
+
+    def _stage_leaf(t, ct):
+        ent = leaf_totals.setdefault(id(t), [t, None])
+        ent[1] = _accumulate(ent[1], ct)
+
+    def _note_slot_tensor(node, idx, t):
+        lst = slot_tensors.setdefault((id(node), idx), [])
+        if not any(x is t for x in lst):
+            lst.append(t)
+
+    def _flush_leaves():
+        for t_leaf, total in leaf_totals.values():
+            if total is None:
+                continue
+            if t_leaf._grad_hooks:
+                total = _apply_hooks(t_leaf, total)
+            t_leaf._accumulate_grad(total)
+
+    def _apply_hooks(t, ct):
+        for hook in t._grad_hooks:
+            out = hook(t._wrap_like(ct))
+            if out is not None:
+                ct = out._data if hasattr(out, "_data") else out
+        return ct
+
     for t, g in zip(tensors, grad_tensors):
         if g is None:
             if t._data.size != 1:
@@ -207,17 +241,16 @@ def run_backward(tensors: Sequence[Any],
             g_arr = g._data if hasattr(g, "_data") else jnp.asarray(g)
         node = t._grad_node
         if node is None:
-            # Leaf: accumulate directly.
+            # Leaf: stage (hooks + accumulation happen once at the end).
             if not t.stop_gradient:
-                t._accumulate_grad(g_arr)
+                _stage_leaf(t, g_arr)
             continue
-        if id(t) in capture:
-            t._accumulate_grad(g_arr)
         slots = node_out_grads.setdefault(node, [None] * len(node.out_avals))
         slots[t._out_idx] = _accumulate(slots[t._out_idx], g_arr)
-        roots.append(node)
+        _note_slot_tensor(node, t._out_idx, t)
 
     if not node_out_grads:
+        _flush_leaves()
         return
 
     # Phase 1: discover reachable subgraph, count consumer contributions.
@@ -252,6 +285,17 @@ def run_backward(tensors: Sequence[Any],
         slots = node_out_grads.pop(node, None)
         if slots is None:
             slots = [None] * len(node.out_avals)
+        # All contributions to this node's outputs have arrived: run each
+        # output tensor's hooks once on the accumulated total, and serve
+        # captured intermediates.
+        for idx in range(len(slots)):
+            if slots[idx] is None:
+                continue
+            for t_out in slot_tensors.get((id(node), idx), ()):
+                if t_out._grad_hooks:
+                    slots[idx] = _apply_hooks(t_out, slots[idx])
+                if id(t_out) in capture:
+                    t_out._accumulate_grad(slots[idx])
         cts_out = [
             s if s is not None else jnp.zeros(av.shape, av.dtype)
             for s, av in zip(slots, node.out_avals)
@@ -265,19 +309,14 @@ def run_backward(tensors: Sequence[Any],
         for ref, ct in zip(node.inputs, in_cts):
             inp = ref.tensor
             if ct is not None and not _is_float0(ct):
-                for hook in inp._grad_hooks:
-                    out = hook(inp._wrap_like(ct))
-                    if out is not None:
-                        ct = out._data if hasattr(out, "_data") else out
                 if ref.node is None:
                     if not inp.stop_gradient:
-                        inp._accumulate_grad(ct)
+                        _stage_leaf(inp, ct)
                 else:
-                    if id(inp) in capture:
-                        inp._accumulate_grad(ct)
                     slots_p = node_out_grads.setdefault(
                         ref.node, [None] * len(ref.node.out_avals))
                     slots_p[ref.idx] = _accumulate(slots_p[ref.idx], ct)
+                    _note_slot_tensor(ref.node, ref.idx, inp)
             # Consumer processed: decrement producer pending count.
             if ref.node is not None and ref.node in pending:
                 pending[ref.node] -= 1
@@ -285,3 +324,6 @@ def run_backward(tensors: Sequence[Any],
                     queue.append(ref.node)
         if not retain_graph:
             node.release()
+
+    # Leaves: hooks once on the accumulated total, then store the grad.
+    _flush_leaves()

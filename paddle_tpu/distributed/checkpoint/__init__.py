@@ -1,10 +1,15 @@
 """Distributed checkpoint (reference: python/paddle/distributed/
 checkpoint/ — save_state_dict.py:104, load_state_dict.py, metadata.py).
 
-Sharded save: each host writes only the shards it owns (addressable
-shards of jax.Array) plus a metadata manifest mapping tensor → shard
-files; load reassembles and re-shards onto the current mesh (reshard-on-
-load across different meshes, like the reference's converter).
+Sharded save: each process writes only the shards it owns (addressable
+shards of jax.Array) into its own ``<rank>_0.distcp`` payload; shard
+manifests are merged across processes so the coordinator's metadata
+covers every rank's shards.  Load reassembles per *destination* shard —
+only the source blocks overlapping each locally-addressable destination
+shard are materialized on host, so a 7B-parameter load never builds the
+full tensor in host memory unless the destination is fully replicated.
+Reshard-on-load across different meshes falls out of that (like the
+reference's auto_parallel converter).
 """
 
 from __future__ import annotations
@@ -12,12 +17,12 @@ from __future__ import annotations
 import json
 import os
 from dataclasses import dataclass, field, asdict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import jax
 import numpy as np
 
-from ...tensor.tensor import Tensor, wrap_array
+from ...tensor.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata",
            "LocalTensorMetadata"]
@@ -25,11 +30,17 @@ __all__ = ["save_state_dict", "load_state_dict", "Metadata",
 
 @dataclass
 class LocalTensorMetadata:
-    """Reference: metadata.py — one shard's placement."""
+    """Reference: metadata.py — one shard's placement.
+
+    ``rank``/``shard_id`` identify the payload entry (``name@rank@i``)
+    exactly; round-1 matched shards by local_shape, which silently
+    dropped data whenever two shards shared a shape."""
     global_offset: List[int]
     local_shape: List[int]
     dtype: str
     file_name: str
+    rank: int
+    shard_id: int
 
 
 @dataclass
@@ -40,7 +51,8 @@ class Metadata:
 
 
 def _iter_shards(arr: jax.Array):
-    """Yield (global_offset, numpy_shard) for addressable shards."""
+    """Yield (global_offset, numpy_shard) for addressable shards,
+    deduplicated by offset (replicated shards saved once)."""
     try:
         shards = arr.addressable_shards
     except Exception:
@@ -56,6 +68,32 @@ def _iter_shards(arr: jax.Array):
         yield offset, np.asarray(s.data)
 
 
+def _merge_metas_across_processes(meta: Metadata) -> Metadata:
+    """Multi-host: gather every rank's shard manifest so the coordinator
+    writes a complete map (round-1 wrote only its own shards)."""
+    if jax.process_count() == 1:
+        return meta
+    from jax.experimental import multihost_utils
+    raw = np.frombuffer(json.dumps(asdict(meta)).encode(), np.uint8)
+    # agree on a pad size collectively (a fixed cap would make one rank
+    # raise pre-collective while the others block in the allgather)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([raw.size], np.int64))
+    pad = int(np.max(sizes))
+    buf = np.zeros(pad, np.uint8)
+    buf[:raw.size] = raw
+    gathered = multihost_utils.process_allgather(buf)
+    merged = Metadata()
+    for row in np.asarray(gathered):
+        s = bytes(row[row != 0]).decode()
+        d = json.loads(s)
+        merged.global_shapes.update(d["global_shapes"])
+        merged.flat_mapping.update(d["flat_mapping"])
+        for name, shards in d["state_dict_metadata"].items():
+            merged.state_dict_metadata.setdefault(name, []).extend(shards)
+    return merged
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save=False) -> None:
@@ -63,7 +101,8 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     meta = Metadata()
-    data_file = os.path.join(path, f"{rank}_0.distcp")
+    fname = f"{rank}_0.distcp"
+    data_file = os.path.join(path, fname)
     payload: Dict[str, np.ndarray] = {}
     for name, t in state_dict.items():
         if isinstance(t, Tensor):
@@ -76,67 +115,109 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         meta.global_shapes[name] = list(arr.shape)
         shard_metas = []
         for i, (offset, np_shard) in enumerate(_iter_shards(arr)):
-            key = f"{name}@{rank}@{i}"
-            payload[key] = np_shard
+            payload[f"{name}@{rank}@{i}"] = np_shard
             shard_metas.append(asdict(LocalTensorMetadata(
                 list(offset), list(np_shard.shape), str(np_shard.dtype),
-                f"{rank}_0.distcp")))
-            payload[key] = np_shard
+                fname, rank, i)))
         meta.state_dict_metadata[name] = shard_metas
     np.savez(data_file, **payload)
+    meta = _merge_metas_across_processes(meta)
     if rank == coordinator_rank:
-        with open(os.path.join(path, f"{rank}.metadata"), "w") as f:
+        with open(os.path.join(path, f"{coordinator_rank}.metadata"),
+                  "w") as f:
             json.dump(asdict(meta), f)
+
+
+def _load_payloads(path: str) -> Dict[str, Any]:
+    """Map payload file name (as recorded in metadata) -> lazy npz."""
+    payloads = {}
+    for fn in os.listdir(path):
+        if ".distcp" not in fn:
+            continue
+        key = fn[:fn.index(".distcp")] + ".distcp"
+        payloads[key] = np.load(os.path.join(path, fn))
+    return payloads
+
+
+def _assemble_block(dst_slices, gshape, shard_metas, payloads, dtype):
+    """Materialize one destination block [dst_slices] of the global
+    tensor from whichever source shards overlap it."""
+    dst_off = [sl.start or 0 for sl in dst_slices]
+    dst_shape = [
+        (sl.stop if sl.stop is not None else g) - (sl.start or 0)
+        for sl, g in zip(dst_slices, gshape)]
+    block = np.zeros(dst_shape, dtype=dtype)
+    for sm in shard_metas:
+        src_off = sm["global_offset"]
+        src_shape = sm["local_shape"]
+        # overlap in global coords
+        lo = [max(a, b) for a, b in zip(src_off, dst_off)]
+        hi = [min(a + s, b + t) for a, s, b, t in
+              zip(src_off, src_shape, dst_off, dst_shape)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        z = payloads.get(sm["file_name"])
+        if z is None:
+            # zero-filling would silently corrupt the loaded weights
+            raise FileNotFoundError(
+                f"checkpoint payload {sm['file_name']!r} referenced by "
+                f"the manifest is missing from the checkpoint directory")
+        key = f"{sm['tensor_name']}@{sm['rank']}@{sm['shard_id']}"
+        arr = z[key]
+        src_sl = tuple(slice(l - o, h - o)
+                       for l, h, o in zip(lo, hi, src_off))
+        dst_sl = tuple(slice(l - o, h - o)
+                       for l, h, o in zip(lo, hi, dst_off))
+        block[dst_sl] = arr[src_sl]
+    return block
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, offload: bool = False) -> None:
-    """Reference: load_state_dict.py — reassembles the global value per
-    tensor, then reshards onto the destination tensor's current sharding
-    (mesh may differ from save time)."""
+    """Reference: load_state_dict.py — assembles each *destination*
+    shard from the overlapping saved shards (keyed name@rank@i, never by
+    shape) and device_puts it; the mesh/sharding may differ from save
+    time (reshard-on-load)."""
     metas = [f for f in os.listdir(path) if f.endswith(".metadata")]
     if not metas:
         raise FileNotFoundError(f"no .metadata manifest in {path}")
     with open(os.path.join(path, metas[0])) as f:
         meta = json.load(f)
-    # load all shard payloads
-    payloads = {}
-    for fname in os.listdir(path):
-        if fname.endswith(".distcp.npz") or fname.endswith(".distcp"):
-            real = os.path.join(path, fname)
-            if not os.path.exists(real):
-                real = real + ".npz"
-            z = np.load(real if os.path.exists(real)
-                        else os.path.join(path, fname) + ".npz")
-            payloads[fname.replace(".npz", "")] = z
+    payloads = _load_payloads(path)
+    import jax.numpy as jnp
     for name, t in state_dict.items():
         if name not in meta["state_dict_metadata"]:
             continue
         gshape = meta["global_shapes"][name]
-        shard_metas = meta["state_dict_metadata"][name]
-        first_dtype = shard_metas[0]["dtype"] if shard_metas else "float32"
-        full = np.zeros(gshape, dtype=first_dtype)
-        for file_key, z in payloads.items():
-            for key in z.files:
-                tname, rank_s, i_s = key.rsplit("@", 2)
-                if tname != name:
-                    continue
-                arr = z[key]
-                sm = None
-                for cand in shard_metas:
-                    if cand["local_shape"] == list(arr.shape):
-                        sm = cand
-                if sm is None:
-                    continue
-                slices = tuple(
-                    slice(o, o + s) for o, s in zip(sm["global_offset"],
-                                                    arr.shape))
-                full[slices] = arr
-        if isinstance(t, Tensor):
-            import jax.numpy as jnp
-            sharding = getattr(t._data, "sharding", None)
-            new = jnp.asarray(full).astype(t._data.dtype)
-            if sharding is not None:
-                new = jax.device_put(new, sharding)  # reshard-on-load
-            t._data = new
+        shard_metas = [dict(sm, tensor_name=name)
+                       for sm in meta["state_dict_metadata"][name]]
+        if not shard_metas:
+            continue
+        dtype = shard_metas[0]["dtype"]
+        if not isinstance(t, Tensor):
+            continue
+        sharding = getattr(t._data, "sharding", None)
+        tgt_dtype = t._data.dtype
+        if sharding is None or not hasattr(t._data, "addressable_shards"):
+            full = _assemble_block(
+                tuple(slice(0, g) for g in gshape), gshape, shard_metas,
+                payloads, dtype)
+            t._data = jnp.asarray(full).astype(tgt_dtype)
+            continue
+        # per-destination-shard assembly: only overlapping source blocks
+        # touch host memory; identical shard indices (replication) are
+        # assembled once and reused across devices
+        arrays = []
+        block_cache = {}
+        for s in t._data.addressable_shards:
+            cache_key = tuple((sl.start, sl.stop) for sl in s.index)
+            block = block_cache.get(cache_key)
+            if block is None:
+                block = jnp.asarray(_assemble_block(
+                    s.index, gshape, shard_metas, payloads,
+                    dtype)).astype(tgt_dtype)
+                block_cache[cache_key] = block
+            arrays.append(jax.device_put(block, s.device))
+        t._data = jax.make_array_from_single_device_arrays(
+            tuple(gshape), sharding, arrays)

@@ -52,7 +52,13 @@ class Accuracy(Metric):
         label_np = _to_np(label)
         idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
         if label_np.ndim == pred_np.ndim:
-            label_np = np.argmax(label_np, axis=-1)
+            if label_np.shape[-1] == 1:
+                # conventional [N, 1] integer labels (reference squeezes
+                # the trailing dim: metric/metrics.py Accuracy.compute) —
+                # NOT one-hot; argmax here would zero every label
+                label_np = label_np[..., 0]
+            else:
+                label_np = np.argmax(label_np, axis=-1)
         correct = idx == label_np[..., None]
         return correct.astype("float32")
 

@@ -1,0 +1,123 @@
+"""Round-2 regression tests for verdict/advisor findings:
+
+- Accuracy treated [N,1] integer labels as one-hot (reported garbage)
+- dist checkpoint matched shards by local_shape (equal-shaped shards
+  collided) and only saved the coordinator's manifest
+- tensor grad hooks ran per consumer edge on partial cotangents
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def test_accuracy_n1_labels():
+    import paddle_tpu as paddle
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2],
+                                      [0.3, 0.7], [0.6, 0.4]], np.float32))
+    label = paddle.to_tensor(np.array([[1], [0], [1], [0]], np.int64))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    assert m.accumulate() == pytest.approx(1.0)
+    m.reset()
+    # half wrong
+    label2 = paddle.to_tensor(np.array([[0], [0], [1], [1]], np.int64))
+    m.update(m.compute(pred, label2))
+    assert m.accumulate() == pytest.approx(0.5)
+
+
+def test_accuracy_one_hot_labels_still_work():
+    import paddle_tpu as paddle
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    onehot = paddle.to_tensor(np.array([[0, 1], [1, 0]], np.float32))
+    m.update(m.compute(pred, onehot))
+    assert m.accumulate() == pytest.approx(1.0)
+
+
+def test_dist_checkpoint_equal_shaped_shards(tmp_path):
+    """Two same-shape shards of one tensor must both survive a round
+    trip (round-1 matched by shape and lost all but the last)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                   load_state_dict)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("x",))
+    val = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arr = jax.device_put(jnp.asarray(val), NamedSharding(mesh, P("x")))
+    t = paddle.to_tensor(np.zeros((8, 8), np.float32))
+    t._data = arr
+    save_state_dict({"w": t}, str(tmp_path))
+
+    dst = paddle.to_tensor(np.zeros((8, 8), np.float32))
+    dst._data = jax.device_put(jnp.zeros((8, 8), jnp.float32),
+                               NamedSharding(mesh, P("x")))
+    load_state_dict({"w": dst}, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(dst._data), val)
+
+
+def test_dist_checkpoint_reshard_across_meshes(tmp_path):
+    """Save on a 4-way mesh sharded over rows, load onto a 2-way mesh
+    sharded over cols — reshard-on-load."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                   load_state_dict)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("x",))
+    val = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    t = paddle.to_tensor(np.zeros_like(val))
+    t._data = jax.device_put(jnp.asarray(val),
+                             NamedSharding(mesh4, P("x", None)))
+    save_state_dict({"w": t, "step": 7}, str(tmp_path))
+
+    mesh2 = Mesh(np.array(jax.devices()[4:6]), ("y",))
+    dst = paddle.to_tensor(np.zeros_like(val))
+    dst._data = jax.device_put(jnp.zeros((8, 16), jnp.float32),
+                               NamedSharding(mesh2, P(None, "y")))
+    load_state_dict({"w": dst}, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(dst._data), val)
+
+
+def test_dist_checkpoint_replicated_dest(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                   load_state_dict)
+    val = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+    t = paddle.to_tensor(val)
+    save_state_dict({"w": t}, str(tmp_path))
+    dst = paddle.to_tensor(np.zeros_like(val))
+    load_state_dict({"w": dst}, str(tmp_path))
+    np.testing.assert_allclose(dst.numpy(), val)
+
+
+def test_grad_hook_runs_once_on_accumulated_total():
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    seen = []
+    y = x * 3.0
+    h = y + y * 1.0  # y has two consumers
+    y.register_hook(lambda g: seen.append(np.asarray(g.numpy()).copy()))
+    h.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [2.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_nonlinear_leaf_hook_clips_total():
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    x.stop_gradient = False
+    x.register_hook(
+        lambda g: paddle.to_tensor(np.minimum(g.numpy(), 1.5)))
+    # dL/dx = 4 via two consumers of x; clip applies to the total
+    (x * 2.0 + x * 2.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.5])
