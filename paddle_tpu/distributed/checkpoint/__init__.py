@@ -186,7 +186,12 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         meta = json.load(f)
     payloads = _load_payloads(path)
     import jax.numpy as jnp
+    import ast
     for name, t in state_dict.items():
+        if name in meta.get("flat_mapping", {}):
+            # scalar entries (step counters, lr) round-trip via repr
+            state_dict[name] = ast.literal_eval(meta["flat_mapping"][name])
+            continue
         if name not in meta["state_dict_metadata"]:
             continue
         gshape = meta["global_shapes"][name]

@@ -102,11 +102,146 @@ class PipelineParallel(Layer):
             return self._layers._loss_fn(x, micro_label)
         return x
 
+    # -- compiled 1F1B path (distributed/parallel/pipeline.py) ----------
+    def _try_build_compiled(self):
+        """Build the shard_map 1F1B engine when (a) a global mesh with a
+        matching 'pp' axis exists, (b) every stage has the same parameter
+        structure (uniform stages — shared-desc embeddings etc. fall back
+        to the eager path), and (c) a loss_fn is set.  Returns True when
+        the compiled path is usable."""
+        if getattr(self, "_compiled_checked", False):
+            return self._compiled_step is not None
+        self._compiled_checked = True
+        self._compiled_step = None
+        if self.num_stages <= 1 or self._layers._loss_fn is None:
+            return False
+        from ... import mesh as _mesh_mod
+        mesh = _mesh_mod.get_global_mesh()
+        if (mesh is None or "pp" not in mesh.axis_names
+                or mesh.shape["pp"] != self.num_stages):
+            return False
+        if self._layers._shared:
+            return False        # cross-stage aliasing is not uniform
+        import jax
+
+        # uniformity: identical parameter structure AND identical
+        # compute structure (layer types / the same plain callables) —
+        # the engine replays stage 0's layer objects with each stage's
+        # arrays, so differing activations would silently diverge
+        def stage_sig(s):
+            sig = []
+            for fn in self._layers.stage_layers(s):
+                sig.append(type(fn).__name__ if isinstance(fn, Layer)
+                           else fn)
+            return tuple(sig)
+
+        sig0 = stage_sig(0)
+        if any(stage_sig(s) != sig0 for s in range(1, self.num_stages)):
+            return False
+        stage_trees = self._collect_stage_trees()
+        struct0 = {k: (v.shape, str(v.dtype))
+                   for k, v in stage_trees[0].items()}
+        for tree in stage_trees[1:]:
+            if {k: (v.shape, str(v.dtype))
+                    for k, v in tree.items()} != struct0:
+                return False
+        if not struct0:
+            return False
+
+        layers0 = self._layers.stage_layers(0)
+        loss_layer = self._layers._loss_fn
+
+        def stage_fn(sp, x):
+            from ....tensor.tensor import Tensor as _T
+            for j, fn in enumerate(layers0):
+                if isinstance(fn, Layer):
+                    sub = {k[len(f"{j}."):]: v for k, v in sp.items()
+                           if k.startswith(f"{j}.")}
+                    x = fn._functional_call(sub, x)
+                else:
+                    x = fn(x)
+            return x._data if isinstance(x, _T) else x
+
+        def loss_fn(out, y):
+            from ....tensor.tensor import Tensor as _T
+            from ....autograd import tape as _tape
+            with _tape.functional_trace_guard():
+                res = loss_layer(out, y)
+            return res._data if isinstance(res, _T) else res
+
+        from ....distributed.parallel.pipeline import (
+            pipeline_value_and_grad)
+        remat = self._layers._recompute_interval > 0
+        pp = self.num_stages
+
+        @jax.jit
+        def step(stacked, x_mb, y_mb):
+            return pipeline_value_and_grad(
+                stage_fn, loss_fn, stacked, x_mb, y_mb, mesh, pp,
+                schedule="1f1b", remat_stage=remat)
+
+        self._compiled_stacked_keys = list(struct0)
+        self._compiled_step = step
+        return True
+
+    def _collect_stage_trees(self):
+        """Per-stage {param_name: array} trees (live views — re-read each
+        batch because the optimizer mutates the tensors)."""
+        trees = []
+        for s in range(self.num_stages):
+            tree = {}
+            for j, fn in enumerate(self._layers.stage_layers(s)):
+                if isinstance(fn, Layer):
+                    for n, p in fn.named_parameters():
+                        tree[f"{j}.{n}"] = p._data
+            trees.append(tree)
+        return trees
+
+    def _run_compiled(self, data):
+        import jax.numpy as jnp
+        inputs, labels = data
+        if isinstance(inputs, (tuple, list)):
+            if len(inputs) != 1:
+                return None
+            inputs = inputs[0]
+        if isinstance(labels, (tuple, list)):
+            if len(labels) != 1:
+                return None
+            labels = labels[0]
+        M = self.accumulate_steps
+        x = inputs._data if isinstance(inputs, Tensor) else \
+            jnp.asarray(inputs)
+        y = labels._data if isinstance(labels, Tensor) else \
+            jnp.asarray(labels)
+        if x.shape[0] != M * self.micro_batch_size:
+            return None
+        x_mb = x.reshape(M, self.micro_batch_size, *x.shape[1:])
+        y_mb = y.reshape(M, self.micro_batch_size, *y.shape[1:])
+        stage_trees = self._collect_stage_trees()
+        stacked = {k: jnp.stack([t[k] for t in stage_trees])
+                   for k in self._compiled_stacked_keys}
+        loss, grads, _ = self._compiled_step(stacked, x_mb, y_mb)
+        # scatter gradients back onto the parameter tensors
+        for s in range(self.num_stages):
+            for j, fn in enumerate(self._layers.stage_layers(s)):
+                if isinstance(fn, Layer):
+                    for n, p in fn.named_parameters():
+                        if not p.stop_gradient:
+                            p._accumulate_grad(grads[f"{j}.{n}"][s])
+        return to_tensor(loss)
+
     def forward_backward_pipeline(self, data, scaler=None):
-        """Reference: :459 — microbatch loop with grad accumulation (the
-        1F1B interleave is a scheduling optimisation; gradients/losses are
-        identical)."""
+        """Reference: :459 — 1F1B.  Uses the compiled shard_map engine
+        (ppermute rotation, interleaved F/B, recompute backward) when the
+        mesh has a matching pp axis and stages are uniform; otherwise the
+        eager microbatch loop with grad accumulation (identical numerics,
+        schedule is an optimisation)."""
         self.scaler = scaler
+        if scaler is None and self._try_build_compiled():
+            out = self._run_compiled(data)
+            if out is not None:
+                self.total_loss = out
+                return out
         total_loss = None
         micro_dataset = FakeMicroDataset(
             data, self.is_pipeline_first_stage(),

@@ -1,0 +1,13 @@
+"""Compiled parallel execution engines (shard_map programs).
+
+``pipeline`` — the reusable pipeline-parallel engine: GPipe rotation and
+interleaved 1F1B over a ``pp`` mesh axis (reference:
+fleet/meta_parallel/pipeline_parallel.py:459, pp_layers.py:92).
+"""
+
+from .data_parallel import DataParallel
+from .pipeline import (gpipe_forward, pipeline_value_and_grad,
+                       stack_stage_params)
+
+__all__ = ["DataParallel", "gpipe_forward", "pipeline_value_and_grad",
+           "stack_stage_params"]

@@ -15,9 +15,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..nn.layer.layers import Layer
-from ..tensor.tensor import Tensor
-from . import mesh as _mesh
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor
+from .. import mesh as _mesh
 
 __all__ = ["DataParallel"]
 

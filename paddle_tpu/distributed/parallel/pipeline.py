@@ -1,0 +1,227 @@
+"""Reusable compiled pipeline-parallel engine.
+
+Reference behavior: fleet/meta_parallel/pipeline_parallel.py —
+forward_backward_pipeline (:459, 1F1B), FThenB (:1831), pp_layers.py:92
+(SegmentLayers).  The reference runs one process per stage exchanging
+activations over NCCL p2p; the TPU-native realization is a single SPMD
+program ``shard_map``-ped over the ``pp`` mesh axis where every rank
+executes the same tick loop and activations rotate with
+``lax.ppermute`` — XLA lowers the permutes onto ICI neighbours.
+
+Two schedules:
+
+* ``fthenb`` (GPipe): forward rotation scan (M + pp - 1 ticks), then JAX
+  differentiates *through* the scan (the backward is automatically the
+  reverse pipeline).  Activation memory grows with M microbatches.
+* ``1f1b``: explicit interleaved schedule.  Each tick has an F phase and
+  a B phase; rank ``r`` forwards microbatch ``m`` at tick ``m + r`` and
+  backwards it at tick ``m + 2(pp-1) - r``, so at most ``2(pp - r) - 1``
+  microbatches are in flight per rank — activation memory is capped by
+  the pipeline depth, not by M (the 1F1B memory property).  The backward
+  recomputes the stage forward from a circular buffer of saved stage
+  inputs (Megatron-style recompute).  Because the F and B phases are
+  separate sub-steps of every tick, the program is SPMD-uniform: no
+  rank-dependent control flow, just masked buffer writes.
+
+The engine is model-agnostic: ``stage_fn(stage_params, x) -> x`` plus a
+leading-axis-stacked parameter pytree (one slice per stage — uniform
+stage structure, the same constraint GSPMD-era pipelining has; put
+non-uniform embedding/head layers outside the trunk as the flagship
+does).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees (identical structure) into one
+    pytree with a leading [pp] axis, ready for in_specs=P('pp')."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def _fwd_rotation(stage_fn, stage_params, xs, pp: int):
+    """Shared GPipe rotation body (runs inside shard_map).
+
+    ``xs``: [M, ...] microbatches; returns [M, ...] last-stage outputs.
+    """
+    idx = jax.lax.axis_index("pp")
+    M = xs.shape[0]
+    ticks = M + pp - 1
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        prev = jax.lax.ppermute(state, "pp", fwd_perm)
+        feed_idx = jnp.minimum(t, M - 1)
+        feed = jax.lax.dynamic_index_in_dim(xs, feed_idx, 0,
+                                            keepdims=False)
+        inp = jnp.where(idx == 0, feed, prev)
+        out = stage_fn(stage_params, inp)
+        w_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+        do_write = jnp.logical_and(idx == pp - 1, t >= pp - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, out, w_idx, 0)
+        outputs = jnp.where(do_write, updated, outputs)
+        return (out, outputs), None
+
+    state0 = jnp.zeros_like(xs[0])
+    outs0 = jnp.zeros_like(xs)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outs0),
+                                   jnp.arange(ticks))
+    return outputs
+
+
+def gpipe_forward(stage_fn: Callable, stacked_params, x_mb, mesh: Mesh,
+                  pp: int, axis: str = "pp"):
+    """Forward-only pipeline: [M, mb, ...] microbatches -> [M, mb, ...]
+    last-stage outputs.  Differentiable (jax.grad produces the reverse
+    pipeline); use ``pipeline_value_and_grad`` for the memory-capped
+    1F1B training path."""
+
+    def body(stacked, xs):
+        sp = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        outputs = _fwd_rotation(stage_fn, sp, xs, pp)
+        return outputs[None]
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                         stacked_params), P()),
+        out_specs=P(axis), axis_names={axis}, check_vma=False)
+    stacked = f(stacked_params, x_mb)        # [pp, M, ...]
+    return stacked[pp - 1]
+
+
+def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
+                            stacked_params, x_mb, y_mb, mesh: Mesh,
+                            pp: int, schedule: str = "1f1b",
+                            axis: str = "pp", remat_stage: bool = False):
+    """Compute mean microbatch loss and parameter gradients through the
+    pipelined trunk.
+
+    ``stage_fn(stage_params, x) -> x``; ``loss_fn(out, y) -> scalar``
+    applies after the LAST stage.  Returns ``(loss, grads, dxs)`` where
+    ``grads`` matches ``stacked_params`` ([pp]-stacked, each rank's slice
+    real only for its own stage — exactly what an optimizer sharded the
+    same way needs) and ``dxs`` is dL/dx_mb (feed it to the vjp of
+    whatever produced the trunk inputs, e.g. the embedding).
+    """
+    if schedule not in ("1f1b", "fthenb"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    M = x_mb.shape[0]
+
+    if schedule == "fthenb":
+        sfn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+
+        def total_loss(stacked, xs, ys):
+            outs = gpipe_forward(sfn, stacked, xs, mesh, pp, axis)
+            losses = jax.vmap(loss_fn)(outs, ys)
+            return jnp.mean(losses)
+
+        loss, (grads, dxs) = jax.value_and_grad(
+            total_loss, argnums=(0, 1))(stacked_params, x_mb, y_mb)
+        return loss, grads, dxs
+
+    # ---- explicit interleaved 1F1B -----------------------------------
+    buf_slots = 2 * pp   # >= max in-flight (2(pp - r) - 1 at rank r)
+
+    def body(stacked, xs, ys):
+        sp = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        r = jax.lax.axis_index(axis)
+        ticks = M + 2 * (pp - 1)
+        fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+        bwd_perm = [(i + 1, i) for i in range(pp - 1)]
+        is_first = r == 0
+        is_last = r == pp - 1
+
+        sfn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+
+        def stage_loss(p, x, y):
+            out = sfn(p, x)
+            return loss_fn(out, y), out
+
+        def tick(carry, t):
+            (fwd_st, bwd_st, in_buf, gacc, lacc, dxs) = carry
+
+            # ---- F phase: rank r forwards microbatch m_f = t - r ----
+            prev = jax.lax.ppermute(fwd_st, axis, fwd_perm)
+            m_f = t - r
+            act_f = jnp.logical_and(m_f >= 0, m_f < M)
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(is_first, feed, prev)
+            slot_f = jnp.clip(m_f, 0, M - 1) % buf_slots
+            in_buf = jnp.where(
+                act_f,
+                jax.lax.dynamic_update_index_in_dim(in_buf, inp, slot_f,
+                                                    0),
+                in_buf)
+            fwd_out = sfn(sp, inp)
+
+            # ---- B phase: rank r backwards m_b = t - 2(pp-1) + r ----
+            nxt = jax.lax.ppermute(bwd_st, axis, bwd_perm)
+            m_b = t - 2 * (pp - 1) + r
+            act_b = jnp.logical_and(m_b >= 0, m_b < M)
+            slot_b = jnp.clip(m_b, 0, M - 1) % buf_slots
+            saved = jax.lax.dynamic_index_in_dim(in_buf, slot_b, 0,
+                                                 keepdims=False)
+            y_mb_b = jax.lax.dynamic_index_in_dim(
+                ys, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
+
+            # recompute fwd for the saved input; one vjp serves both the
+            # last rank (seeded through the loss output with weight 1/M)
+            # and inner ranks (seeded through the activation output with
+            # the incoming grad)
+            (loss_val, out_b), pull = jax.vjp(
+                lambda p, x: stage_loss(p, x, y_mb_b), sp, saved)
+            seed_loss = jnp.where(is_last, jnp.float32(1.0 / M), 0.0)
+            seed_out = jnp.where(is_last, jnp.zeros_like(out_b), nxt)
+            dp, dx = pull((seed_loss.astype(loss_val.dtype), seed_out))
+
+            gacc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(act_b, d, 0).astype(a.dtype),
+                gacc, dp)
+            lacc = lacc + jnp.where(
+                jnp.logical_and(act_b, is_last), loss_val, 0.0)
+            # rank 0's input-grad is dL/dx for the embedding chain
+            dxs = jnp.where(
+                jnp.logical_and(act_b, is_first),
+                jax.lax.dynamic_update_index_in_dim(
+                    dxs, dx, jnp.clip(m_b, 0, M - 1), 0),
+                dxs)
+            return (fwd_out, dx, in_buf, gacc, lacc, dxs), None
+
+        in_buf0 = jnp.zeros((buf_slots,) + xs.shape[1:], xs.dtype)
+        gacc0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), sp)
+        carry0 = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]), in_buf0,
+                  gacc0, jnp.float32(0.0), jnp.zeros_like(xs))
+        (singles, _) = jax.lax.scan(tick, carry0, jnp.arange(ticks))
+        _, _, _, gacc, lacc, dxs = singles
+        # leading [1] axes so the P('pp') out_specs stack per-rank values
+        # (loss lives on the last rank, dxs on rank 0); slicing outside
+        # avoids an activation AllReduce
+        gacc = jax.tree_util.tree_map(lambda a: a[None], gacc)
+        return (gacc, lacc[None], dxs[None])
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                         stacked_params), P(), P()),
+        out_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                          stacked_params),
+                   P(axis), P(axis)),
+        axis_names={axis}, check_vma=False)
+    grads, losses, dxs_all = f(stacked_params, x_mb, y_mb)
+    loss = losses[pp - 1] / M
+    dxs = dxs_all[0]
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, stacked_params)
+    return loss, grads, dxs

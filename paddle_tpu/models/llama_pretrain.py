@@ -252,59 +252,25 @@ def _trunk_scan(blocks, x, cfg, mesh):
 
 
 def _trunk_pipeline(blocks, x_mb, cfg, mesh, pp: int):
-    """pp > 1: hybrid shard_map — manual over 'pp', auto over dp/mp.
+    """pp > 1: the reusable GPipe engine from distributed/parallel/
+    pipeline.py — hybrid shard_map, manual over 'pp', auto over dp/mp.
 
-    ``x_mb``: [M, mb, s, h] microbatches (replicated over pp).
-    Schedule: GPipe rotation via scan + ppermute; M + pp - 1 ticks.
+    ``x_mb``: [M, mb, s, h] microbatches (replicated over pp); each
+    stage scans its own [Lp]-stacked blocks.
     """
+    from ..distributed.parallel.pipeline import gpipe_forward
+
     fwd = _block_forward
     if cfg.remat:
         fwd = jax.checkpoint(fwd, static_argnums=(2,))
 
-    def stage_forward(stage_bp, x):
+    def stage_fn(stage_bp, x):
         def step(carry, bp):
             return fwd(bp, carry, cfg), None
         out, _ = jax.lax.scan(step, x, stage_bp)
         return out
 
-    def body(stage_blocks, xs):
-        # stage_blocks leaves: [1, Lp, ...] (my stage); xs: [M, mb, s, h]
-        stage_bp = jax.tree_util.tree_map(lambda a: a[0], stage_blocks)
-        idx = jax.lax.axis_index("pp")
-        M = xs.shape[0]
-        ticks = M + pp - 1
-        fwd_perm = [(i, i + 1) for i in range(pp - 1)]
-
-        def tick(carry, t):
-            state, outputs = carry
-            prev = jax.lax.ppermute(state, "pp", fwd_perm)
-            feed_idx = jnp.minimum(t, M - 1)
-            feed = jax.lax.dynamic_index_in_dim(xs, feed_idx, 0,
-                                                keepdims=False)
-            inp = jnp.where(idx == 0, feed, prev)
-            out = stage_forward(stage_bp, inp)
-            w_idx = jnp.clip(t - (pp - 1), 0, M - 1)
-            do_write = jnp.logical_and(idx == pp - 1, t >= pp - 1)
-            updated = jax.lax.dynamic_update_index_in_dim(
-                outputs, out, w_idx, 0)
-            outputs = jnp.where(do_write, updated, outputs)
-            return (out, outputs), None
-
-        state0 = jnp.zeros_like(xs[0])
-        outs0 = jnp.zeros_like(xs)
-        (_, outputs), _ = jax.lax.scan(tick, (state0, outs0),
-                                       jnp.arange(ticks))
-        # stack per-stage outputs; only the last stage's slice is real —
-        # the caller slices it out (avoids an activation AllReduce)
-        return outputs[None]
-
-    f = jax.shard_map(body, mesh=mesh,
-                      in_specs=(jax.tree_util.tree_map(
-                          lambda _: P("pp"), blocks), P()),
-                      out_specs=P("pp"), axis_names={"pp"},
-                      check_vma=False)
-    stacked = f(blocks, x_mb)          # [pp, M, mb, s, h]
-    return stacked[pp - 1]
+    return gpipe_forward(stage_fn, blocks, x_mb, mesh, pp)
 
 
 def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,

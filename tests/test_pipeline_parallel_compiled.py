@@ -1,0 +1,123 @@
+"""PipelineParallel wired to the compiled 1F1B engine (verdict item 4):
+a user-defined PipelineLayer (MLP stack, not LLaMA) trains pp=2 (with dp
+and mp axes alive in the mesh) and matches the unpipelined single-device
+run batch for batch.
+
+Reference parity model: test/collective/fleet/hybrid_parallel_pp_*.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology, HybridCommunicateGroup)
+from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+    DistributedStrategy)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+    PipelineParallel)
+
+H, B, MB = 8, 8, 2   # hidden, global batch, microbatch
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return F.tanh(self.fc(x))
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _mk_data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(B, H).astype(np.float32)
+    y = rng.randn(B, H).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def _sync_weights(src_layers, dst_layers):
+    sd = {k: v.numpy() for k, v in src_layers.state_dict().items()}
+    dst_layers.set_state_dict({k: paddle.to_tensor(v)
+                               for k, v in sd.items()})
+
+
+@pytest.fixture
+def hcg():
+    prev = mesh_mod.get_global_mesh()
+    topo = CommunicateTopology(dims=(2, 2, 1, 1, 2))  # dp=2 pp=2 mp=2
+    h = HybridCommunicateGroup(topo)
+    yield h
+    mesh_mod.set_global_mesh(prev)
+
+
+def test_pipeline_parallel_uses_compiled_engine(hcg):
+    descs = [LayerDesc(Block) for _ in range(4)]
+    pipe = PipelineLayer(descs, num_stages=2, loss_fn=_mse)
+    strat = DistributedStrategy()
+    strat.pipeline_configs["micro_batch_size"] = MB
+    strat.pipeline_configs["accumulate_steps"] = B // MB
+    model = PipelineParallel(pipe, hcg, strat)
+
+    # reference: identical weights, plain sequential eager run
+    ref = nn.Sequential(*[Block() for _ in range(4)])
+    ref_params = {}
+    for i in range(4):
+        ref_params[f"{i}.fc.weight"] = pipe.run_function[i].fc.weight
+        ref_params[f"{i}.fc.bias"] = pipe.run_function[i].fc.bias
+    for name, p in ref.named_parameters():
+        p.set_value(paddle.to_tensor(ref_params[name].numpy()))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    ref_opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=ref.parameters())
+
+    x, y = _mk_data()
+    losses, ref_losses = [], []
+    for step in range(4):
+        loss = model.train_batch([(x,), (y,)], opt)
+        losses.append(float(loss))
+
+        mbs = []
+        for i in range(B // MB):
+            xo = ref(x[i * MB:(i + 1) * MB])
+            l = _mse(xo, y[i * MB:(i + 1) * MB])
+            (l / (B // MB)).backward()
+            mbs.append(float(l))
+        ref_opt.step()
+        ref_opt.clear_grad()
+        ref_losses.append(float(np.mean(mbs)))
+
+    # the compiled engine must actually have been used
+    assert model._compiled_step is not None
+    np.testing.assert_allclose(losses, ref_losses, atol=1e-5)
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_parallel_eager_fallback_without_mesh(hcg):
+    """Shared embeddings (non-uniform stages) keep the eager path and
+    still train."""
+    descs = [LayerDesc(Block) for _ in range(3)]  # 3 blocks, 2 stages
+    pipe = PipelineLayer(descs, num_stages=2, loss_fn=_mse)
+    strat = DistributedStrategy()
+    strat.pipeline_configs["micro_batch_size"] = MB
+    strat.pipeline_configs["accumulate_steps"] = B // MB
+    model = PipelineParallel(pipe, hcg, strat)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x, y = _mk_data(1)
+    l0 = float(model.train_batch([(x,), (y,)], opt))
+    # stages are 2-vs-1 blocks: structure differs, compiled path refused
+    assert model._compiled_step is None
+    l1 = float(model.train_batch([(x,), (y,)], opt))
+    assert l1 < l0

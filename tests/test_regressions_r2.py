@@ -82,8 +82,10 @@ def test_dist_checkpoint_reshard_across_meshes(tmp_path):
     dst = paddle.to_tensor(np.zeros_like(val))
     dst._data = jax.device_put(jnp.zeros((8, 16), jnp.float32),
                                NamedSharding(mesh2, P(None, "y")))
-    load_state_dict({"w": dst}, str(tmp_path))
+    sd = {"w": dst, "step": 0}
+    load_state_dict(sd, str(tmp_path))
     np.testing.assert_allclose(np.asarray(dst._data), val)
+    assert sd["step"] == 7   # scalar entries restore via flat_mapping
 
 
 def test_dist_checkpoint_replicated_dest(tmp_path):
