@@ -177,6 +177,19 @@ def _placements_to_spec(placements: Sequence[Placement],
     return P(*entries)
 
 
+def _device_put_robust(arr, sharding):
+    """jax 0.9's device_put can trip an internal assert when resharding a
+    committed array onto a mesh it considers differently ordered; retry
+    through host numpy for concrete arrays."""
+    try:
+        return jax.device_put(arr, sharding)
+    except AssertionError:
+        if isinstance(arr, jax.core.Tracer):
+            raise
+        import numpy as _np
+        return jax.device_put(_np.asarray(arr), sharding)
+
+
 def shard_tensor(data, mesh: ProcessMesh,
                  placements: Sequence[Placement],
                  dtype=None, place=None, stop_gradient=None) -> Tensor:
@@ -185,7 +198,7 @@ def shard_tensor(data, mesh: ProcessMesh,
     t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
     spec = _placements_to_spec(placements, mesh, t.ndim)
     sharding = NamedSharding(mesh.jax_mesh(), spec)
-    t._data = jax.device_put(t._data, sharding)
+    t._data = _device_put_robust(t._data, sharding)
     t.placements = list(placements)
     t.process_mesh = mesh
     if stop_gradient is not None:
@@ -205,7 +218,7 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
     registry (r_to_s, s_to_r, p_to_r ... reshard_function_registry.cc)."""
     spec = _placements_to_spec(placements, mesh, dist_tensor.ndim)
     sharding = NamedSharding(mesh.jax_mesh(), spec)
-    out = wrap_array(jax.device_put(dist_tensor._data, sharding),
+    out = wrap_array(_device_put_robust(dist_tensor._data, sharding),
                      stop_gradient=dist_tensor.stop_gradient)
     out._grad_node = dist_tensor._grad_node
     out._out_idx = dist_tensor._out_idx

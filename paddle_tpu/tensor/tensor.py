@@ -229,7 +229,17 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {src.shape} vs "
                 f"{self._data.shape}")
-        self._data = src.astype(self._data.dtype)
+        src = src.astype(self._data.dtype)
+        # keep the destination's placement: a TP/ZeRO-sharded parameter
+        # must stay sharded after loading new values
+        old_sharding = getattr(self._data, "sharding", None)
+        new_sharding = getattr(src, "sharding", None)
+        if (old_sharding is not None
+                and getattr(old_sharding, "mesh", None) is not None
+                and old_sharding != new_sharding):
+            import jax as _jax
+            src = _jax.device_put(src, old_sharding)
+        self._data = src
 
     def get_tensor(self):  # LoDTensor-compat shim
         return self

@@ -1,0 +1,90 @@
+"""Semi-auto parallel API (shard_tensor / reshard / shard_layer /
+shard_optimizer) on the 8-device mesh (verdict item 5).
+
+Reference: auto_parallel/api.py:131 (shard_tensor), :579 (reshard),
+:678 (shard_layer), :1353 (shard_optimizer).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.auto_parallel import (
+    ProcessMesh, Shard, Replicate, shard_tensor, reshard, shard_layer,
+    shard_optimizer)
+
+
+@pytest.fixture(autouse=True)
+def _restore():
+    prev = mesh_mod.get_global_mesh()
+    yield
+    mesh_mod.set_global_mesh(prev)
+
+
+def _pm():
+    return ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                       dim_names=["x", "y"])
+
+
+def test_shard_tensor_placement():
+    pm = _pm()
+    val = np.arange(64, dtype=np.float32).reshape(8, 8)
+    t = shard_tensor(paddle.to_tensor(val), pm, [Shard(0), Replicate()])
+    np.testing.assert_array_equal(t.numpy(), val)
+    shards = t._data.addressable_shards
+    # 8 rows over the 2 'x' ranks -> 4 rows per shard, replicated over y
+    assert {s.data.shape for s in shards} == {(4, 8)}
+
+
+def test_reshard_changes_placement_preserves_value():
+    pm = _pm()
+    val = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    t = shard_tensor(paddle.to_tensor(val), pm, [Shard(0), Replicate()])
+    t2 = reshard(t, pm, [Replicate(), Shard(1)])
+    np.testing.assert_allclose(t2.numpy(), val, atol=0)
+    # cols over the 4 'y' ranks -> 2 cols per shard
+    assert {s.data.shape for s in t2._data.addressable_shards} == {(8, 2)}
+
+
+def test_shard_layer_params_and_forward():
+    pm = _pm()
+    net = nn.Linear(8, 8)
+
+    def shard_fn(name, layer, mesh):
+        if hasattr(layer, "weight") and layer.weight is not None:
+            layer.weight = shard_tensor(layer.weight, mesh,
+                                        [Replicate(), Shard(1)])
+
+    net = shard_layer(net, pm, shard_fn)
+    w_shards = net.weight._data.addressable_shards
+    assert {s.data.shape for s in w_shards} == {(8, 2)}
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 8).astype(np.float32))
+    out = net(x)
+    ref = x.numpy() @ net.weight.numpy() + net.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_shard_optimizer_states_follow_params():
+    pm = _pm()
+    net = nn.Linear(8, 8)
+    net.weight = shard_tensor(net.weight, pm, [Shard(0), Replicate()])
+    opt = shard_optimizer(paddle.optimizer.AdamW(
+        learning_rate=0.01, parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(4, 8).astype(np.float32))
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    inner = getattr(opt, "_inner", opt)
+    st = list(inner._states.values())[0]
+    moment = next(v for k, v in st.items()
+                  if getattr(v, "ndim", 0) == 2)
+    # moment shards follow the parameter's [Shard(0)] placement (x=2)
+    assert {s.data.shape[0] for s in moment.addressable_shards} == {4}

@@ -1,0 +1,163 @@
+"""Collective API numerics on the 8-device virtual mesh (verdict item 5).
+
+Reference test model: test/collective/collective_allreduce_api.py etc. —
+N-way workers assert collective results vs numpy.  Here the N ways are
+the 8 CPU mesh devices: per-rank semantics run inside a shard_map over
+the group's mesh axis; eager semantics run on axis-sharded jax.Arrays.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as mesh_mod
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    prev = mesh_mod.get_global_mesh()
+    mesh = Mesh(np.array(jax.devices()[:N]), ("dp",))
+    mesh_mod.set_global_mesh(mesh)
+    yield mesh
+    mesh_mod.set_global_mesh(prev)
+
+
+def _run_per_rank(mesh, fn, *per_rank_vals):
+    """Execute fn (which calls the collective API) once per logical rank
+    inside shard_map; per_rank_vals are [N, ...] arrays, one row per
+    rank.  Returns the stacked per-rank results."""
+    def body(*xs):
+        outs = fn(*[x[0] for x in xs])
+        return jnp.asarray(outs)[None]
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=tuple(P("dp") for _ in per_rank_vals),
+        out_specs=P("dp"), axis_names={"dp"},
+        check_vma=False)(*per_rank_vals)
+
+
+def test_all_reduce_sum_and_avg(_mesh):
+    g = dist.new_group(axis_name="dp")
+    vals = np.arange(N, dtype=np.float32).reshape(N, 1) + 1.0
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, group=g)
+        return t._data
+    out = _run_per_rank(_mesh, f, jnp.asarray(vals))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((N, 1), vals.sum()), atol=1e-5)
+
+    def favg(x):
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, op=dist.ReduceOp.AVG, group=g)
+        return t._data
+    out = _run_per_rank(_mesh, favg, jnp.asarray(vals))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((N, 1), vals.mean()), atol=1e-5)
+
+
+def test_all_reduce_max_min(_mesh):
+    g = dist.new_group(axis_name="dp")
+    vals = np.random.RandomState(0).randn(N, 3).astype(np.float32)
+    for op, ref in ((dist.ReduceOp.MAX, vals.max(0)),
+                    (dist.ReduceOp.MIN, vals.min(0))):
+        def f(x, op=op):
+            t = paddle.to_tensor(x)
+            dist.all_reduce(t, op=op, group=g)
+            return t._data
+        out = _run_per_rank(_mesh, f, jnp.asarray(vals))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(ref, (N, 1)), atol=1e-6)
+
+
+def test_all_gather(_mesh):
+    g = dist.new_group(axis_name="dp")
+    vals = np.random.RandomState(1).randn(N, 2).astype(np.float32)
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        lst = []
+        dist.all_gather(lst, t, group=g)
+        return jnp.stack([e._data for e in lst])
+    out = np.asarray(_run_per_rank(_mesh, f, jnp.asarray(vals)))
+    # every rank sees all rows in rank order
+    for r in range(N):
+        np.testing.assert_allclose(out[r], vals, atol=1e-6)
+
+
+def test_reduce_scatter(_mesh):
+    g = dist.new_group(axis_name="dp")
+    # each rank contributes [N] -> each rank keeps sum of its slot
+    vals = np.random.RandomState(2).randn(N, N).astype(np.float32)
+
+    def f(x):
+        out = paddle.zeros([1])
+        dist.reduce_scatter(out, paddle.to_tensor(x), group=g)
+        return out._data
+    out = np.asarray(_run_per_rank(_mesh, f, jnp.asarray(vals)))
+    np.testing.assert_allclose(out.ravel(), vals.sum(0), atol=1e-5)
+
+
+def test_all_to_all(_mesh):
+    g = dist.new_group(axis_name="dp")
+    # rank r sends value r*N + j to rank j
+    vals = np.arange(N * N, dtype=np.float32).reshape(N, N)
+
+    def f(x):
+        ins = [paddle.to_tensor(x[j:j + 1]) for j in range(N)]
+        outs = []
+        dist.all_to_all(outs, ins, group=g)
+        return jnp.concatenate([o._data for o in outs])
+    out = np.asarray(_run_per_rank(_mesh, f, jnp.asarray(vals)))
+    np.testing.assert_allclose(out, vals.T, atol=1e-6)
+
+
+def test_broadcast(_mesh):
+    g = dist.new_group(axis_name="dp")
+    vals = np.random.RandomState(3).randn(N, 4).astype(np.float32)
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        dist.broadcast(t, src=3, group=g)
+        return t._data
+    out = np.asarray(_run_per_rank(_mesh, f, jnp.asarray(vals)))
+    for r in range(N):
+        np.testing.assert_allclose(out[r], vals[3], atol=1e-6)
+
+
+def test_eager_all_reduce_on_sharded_tensor(_mesh):
+    """Outside any axis scope, all_reduce on a dp-sharded array compiles
+    a one-op psum program (the ProcessGroup-style eager path)."""
+    g = dist.new_group(axis_name="dp")
+    vals = np.arange(N * 2, dtype=np.float32).reshape(N, 2)
+    arr = jax.device_put(jnp.asarray(vals),
+                         NamedSharding(_mesh, P("dp", None)))
+    t = paddle.to_tensor(np.zeros_like(vals))
+    t._data = arr
+    dist.all_reduce(t, group=g)
+    out = np.asarray(t._data)
+    # per-rank rows summed across the axis, layout preserved
+    ref = np.tile(vals.reshape(N, 1, 2).sum(0), (N, 1))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_p2p_send_recv_ring(_mesh):
+    from paddle_tpu.distributed.communication import p2p_send_recv
+    g = dist.new_group(axis_name="dp")
+    vals = np.arange(N, dtype=np.float32).reshape(N, 1)
+    perm = [(i, (i + 1) % N) for i in range(N)]
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        out = p2p_send_recv(t, perm, group=g)
+        return out._data
+    out = np.asarray(_run_per_rank(_mesh, f, jnp.asarray(vals)))
+    np.testing.assert_allclose(out.ravel(), np.roll(vals.ravel(), 1),
+                               atol=1e-6)

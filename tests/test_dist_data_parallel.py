@@ -1,0 +1,94 @@
+"""DataParallel gradient parity vs single-device (verdict item 5).
+
+Reference test model: test/legacy_test/test_dist_base.py — dist loss
+must equal the single-process loss on the same global batch.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+
+N, B, IN, OUT = 8, 16, 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    prev = mesh_mod.get_global_mesh()
+    mesh = Mesh(np.array(jax.devices()[:N]), ("dp",))
+    mesh_mod.set_global_mesh(mesh)
+    yield mesh
+    mesh_mod.set_global_mesh(prev)
+
+
+def _make_net(seed):
+    rng = np.random.RandomState(seed)
+    net = nn.Linear(IN, OUT)
+    net.weight.set_value(paddle.to_tensor(
+        rng.randn(IN, OUT).astype(np.float32)))
+    net.bias.set_value(paddle.to_tensor(
+        rng.randn(OUT).astype(np.float32)))
+    return net
+
+
+def test_data_parallel_grads_match_single_device(_mesh):
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, IN).astype(np.float32)
+    y = rng.randn(B, OUT).astype(np.float32)
+
+    ref = _make_net(7)
+    out = ref(paddle.to_tensor(x))
+    loss_ref = ((out - paddle.to_tensor(y)) ** 2).mean()
+    loss_ref.backward()
+
+    net = _make_net(7)
+    dp = paddle.DataParallel(net)
+    out = dp(paddle.to_tensor(x))
+    loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+    loss.backward()
+
+    assert float(loss) == pytest.approx(float(loss_ref), abs=1e-6)
+    np.testing.assert_allclose(net.weight.grad.numpy(),
+                               ref.weight.grad.numpy(), atol=1e-5)
+    np.testing.assert_allclose(net.bias.grad.numpy(),
+                               ref.bias.grad.numpy(), atol=1e-5)
+
+
+def test_data_parallel_batch_is_sharded(_mesh):
+    net = _make_net(1)
+    dp = paddle.DataParallel(net)
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(B, IN).astype(np.float32))
+    out = dp(x)
+    # input scattered over dp: the layer's input shard is B/N rows
+    shards = out._data.addressable_shards
+    assert {s.data.shape[0] for s in shards} == {B // N}
+
+
+def test_data_parallel_training_matches_single_device(_mesh):
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(B, IN).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(B, OUT).astype(np.float32))
+
+    def train(net, steps=4):
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        losses = []
+        for _ in range(steps):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+
+    ref_losses = train(_make_net(5))
+    net = _make_net(5)
+    dp_losses = train(paddle.DataParallel(net))
+    np.testing.assert_allclose(dp_losses, ref_losses, atol=1e-5)
+    assert dp_losses[-1] < dp_losses[0]

@@ -1,0 +1,99 @@
+"""fleet facade routing + topology group math (verdict item 5).
+
+Reference test model: fleet.init building HybridCommunicateGroup
+(fleet.py:599, topology.py:178) and distributed_model picking the
+correct wrapper (model.py:32).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology, HybridCommunicateGroup)
+from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+    DistributedStrategy)
+
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    prev = mesh_mod.get_global_mesh()
+    yield
+    mesh_mod.set_global_mesh(prev)
+
+
+def test_topology_rank_coord_roundtrip():
+    topo = CommunicateTopology(dims=(2, 2, 1, 1, 2))
+    assert topo.world_size() == 8
+    for r in range(8):
+        c = topo.get_coord(r)
+        assert topo.get_rank(**c._asdict()) == r
+    # model-axis groups: consecutive ranks (innermost axis)
+    mp_groups = topo.get_comm_list("model")
+    assert mp_groups == [[i, i + 1] for i in range(0, 8, 2)]
+    # data-axis groups: stride 4 (outermost)
+    dp_groups = topo.get_comm_list("data")
+    assert all(g[1] - g[0] == 4 for g in dp_groups)
+
+
+def test_hcg_builds_matching_mesh():
+    topo = CommunicateTopology(dims=(2, 2, 1, 1, 2))
+    hcg = HybridCommunicateGroup(topo)
+    mesh = mesh_mod.get_global_mesh()
+    assert mesh is not None
+    assert dict(zip(mesh.axis_names,
+                    mesh.devices.shape)) == {"dp": 2, "pp": 2,
+                                             "sharding": 1, "sep": 1,
+                                             "mp": 2}
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    assert hcg.get_data_parallel_world_size() == 2
+
+
+def test_fleet_init_and_distributed_model_dp():
+    strat = DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strat)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg is not None
+    # pure DP default: all 8 devices on dp
+    assert hcg.get_data_parallel_world_size() == 8
+
+    net = nn.Linear(4, 2)
+    model = fleet.distributed_model(net)
+    from paddle_tpu.distributed.parallel import DataParallel
+    assert isinstance(model, DataParallel)
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+    l0 = None
+    for _ in range(3):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        dopt.step()
+        dopt.clear_grad()
+        l0 = l0 or float(loss)
+    assert float(loss) < l0
+
+
+def test_fleet_distributed_model_tensor_parallel_routing():
+    strat = DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                            "pp_degree": 1, "sharding_degree": 1,
+                            "sep_degree": 1}
+    # only 2 of 8 devices used: declared product must match device count,
+    # so declare dp to absorb the rest
+    strat.hybrid_configs["dp_degree"] = 4
+    fleet.init(is_collective=True, strategy=strat)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 2
+
+    from paddle_tpu.distributed.fleet.meta_parallel import TensorParallel
+    net = nn.Linear(4, 2)
+    model = fleet.distributed_model(net)
+    assert isinstance(model, (TensorParallel, paddle.DataParallel))

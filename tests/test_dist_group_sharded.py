@@ -1,0 +1,108 @@
+"""ZeRO / group-sharded stages on the 8-device mesh (verdict item 5):
+numerical parity vs the unsharded run AND evidence that per-device bytes
+actually shrink for the sharded state.
+
+Reference test model: test/collective/fleet/dygraph_group_sharded_*.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+N, B, H = 8, 16, 32
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    prev = mesh_mod.get_global_mesh()
+    mesh = Mesh(np.array(jax.devices()[:N]), ("dp",))
+    mesh_mod.set_global_mesh(mesh)
+    yield mesh
+    mesh_mod.set_global_mesh(prev)
+
+
+def _make(seed=0):
+    rng = np.random.RandomState(seed)
+    net = nn.Sequential(nn.Linear(H, H), nn.Tanh(), nn.Linear(H, 1))
+    for _, p in net.named_parameters():
+        p.set_value(paddle.to_tensor(
+            (rng.randn(*p.shape) * 0.2).astype(np.float32)))
+    x = paddle.to_tensor(rng.randn(B, H).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(B, 1).astype(np.float32))
+    return net, x, y
+
+
+def _train(net, opt, x, y, steps=4):
+    losses = []
+    for _ in range(steps):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _max_local_bytes(arr):
+    return max(s.data.size * s.data.dtype.itemsize
+               for s in arr.addressable_shards)
+
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_group_sharded_parity(level, _mesh):
+    ref_net, x, y = _make()
+    ref_opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=ref_net.parameters())
+    ref_losses = _train(ref_net, ref_opt, x, y)
+
+    net, _, _ = _make()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, level)
+    losses = _train(model, opt, x, y)
+    np.testing.assert_allclose(losses, ref_losses, atol=1e-4)
+    assert losses[-1] < losses[0]
+
+
+def test_stage1_optimizer_state_bytes_shrink(_mesh):
+    net, x, y = _make()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, "os")
+    _train(model, opt, x, y, steps=1)
+    # moment slots for the [H, H] weights must live 1/N per device
+    checked = 0
+    for st in opt._inner._states.values():
+        for k, v in st.items():
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] % N == 0:
+                assert _max_local_bytes(v) == \
+                    v.size * v.dtype.itemsize // N
+                checked += 1
+    assert checked > 0
+
+
+def test_stage3_param_bytes_shrink(_mesh):
+    net, x, y = _make()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, "p_g_os")
+    big = [p for p in net.parameters()
+           if p.ndim >= 1 and p.shape[0] % N == 0]
+    assert big
+    for p in big:
+        assert _max_local_bytes(p._data) == \
+            p._data.size * p._data.dtype.itemsize // N
+    # forward still works with sharded params (XLA gathers on use)
+    _train(model, opt, x, y, steps=1)
+    # get_all_parameters re-replicates (the stage-3 gather API)
+    model.get_all_parameters()
+    for p in big:
+        assert _max_local_bytes(p._data) == \
+            p._data.size * p._data.dtype.itemsize

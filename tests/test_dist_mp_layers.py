@@ -1,0 +1,124 @@
+"""Tensor-parallel (mpu) layers vs dense equivalents on the 8-device
+mesh (verdict item 5).
+
+Reference test model: test/collective/fleet/hybrid_parallel_mp_layers.py
+— column/row/vocab-parallel layers must match the dense single-device
+layer numerically, forward and backward.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy)
+
+MP = 4
+IN, OUT, B = 8, 12, 4
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    prev = mesh_mod.get_global_mesh()
+    mesh = Mesh(np.array(jax.devices()[:MP]).reshape(1, MP),
+                ("dp", "mp"))
+    mesh_mod.set_global_mesh(mesh)
+    yield mesh
+    mesh_mod.set_global_mesh(prev)
+
+
+def _dense_ref(w, b, x):
+    ref = nn.Linear(IN, OUT)
+    ref.weight.set_value(paddle.to_tensor(w))
+    ref.bias.set_value(paddle.to_tensor(b))
+    out = ref(paddle.to_tensor(x))
+    loss = (out ** 2).mean()
+    loss.backward()
+    return (out.numpy(), loss.numpy(), ref.weight.grad.numpy(),
+            ref.bias.grad.numpy())
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (ColumnParallelLinear, {"gather_output": True}),
+    (RowParallelLinear, {}),
+])
+def test_parallel_linear_matches_dense(_mesh, cls, kwargs):
+    rng = np.random.RandomState(0)
+    w = rng.randn(IN, OUT).astype(np.float32)
+    b = rng.randn(OUT).astype(np.float32)
+    x = rng.randn(B, IN).astype(np.float32)
+    ref_out, ref_loss, ref_gw, ref_gb = _dense_ref(w, b, x)
+
+    layer = cls(IN, OUT, **kwargs)
+    assert layer.is_mp
+    layer.weight.set_value(paddle.to_tensor(w))
+    layer.bias.set_value(paddle.to_tensor(b))
+    out = layer(paddle.to_tensor(x))
+    loss = (out ** 2).mean()
+    loss.backward()
+
+    np.testing.assert_allclose(out.numpy(), ref_out, atol=1e-5)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-6)
+    np.testing.assert_allclose(layer.weight.grad.numpy(), ref_gw,
+                               atol=1e-5)
+    np.testing.assert_allclose(layer.bias.grad.numpy(), ref_gb,
+                               atol=1e-5)
+    # the weight must actually be sharded over the mp axis
+    shards = layer.weight._data.addressable_shards
+    sizes = {s.data.size for s in shards}
+    assert sizes == {w.size // MP}
+
+
+def test_column_parallel_no_gather_keeps_sharded_output(_mesh):
+    rng = np.random.RandomState(1)
+    layer = ColumnParallelLinear(IN, OUT, gather_output=False,
+                                 has_bias=False)
+    x = paddle.to_tensor(rng.randn(B, IN).astype(np.float32))
+    out = layer(x)
+    assert tuple(out.shape) == (B, OUT)
+    shards = out._data.addressable_shards
+    assert {s.data.shape[-1] for s in shards} == {OUT // MP}
+
+
+def test_vocab_parallel_embedding_matches_dense(_mesh):
+    V, H = 16, 8
+    rng = np.random.RandomState(2)
+    w = rng.randn(V, H).astype(np.float32)
+    ids = rng.randint(0, V, (B, 5))
+
+    ref = nn.Embedding(V, H)
+    ref.weight.set_value(paddle.to_tensor(w))
+    ref_out = ref(paddle.to_tensor(ids))
+    ref_loss = (ref_out ** 2).mean()
+    ref_loss.backward()
+
+    layer = VocabParallelEmbedding(V, H)
+    layer.weight.set_value(paddle.to_tensor(w))
+    out = layer(paddle.to_tensor(ids))
+    loss = (out ** 2).mean()
+    loss.backward()
+    np.testing.assert_allclose(out.numpy(), ref_out.numpy(), atol=1e-5)
+    np.testing.assert_allclose(layer.weight.grad.numpy(),
+                               ref.weight.grad.numpy(), atol=1e-5)
+
+
+def test_parallel_cross_entropy_matches_dense(_mesh):
+    import paddle_tpu.nn.functional as F
+    V = 16
+    rng = np.random.RandomState(3)
+    logits = rng.randn(B, V).astype(np.float32)
+    labels = rng.randint(0, V, (B,)).astype(np.int64)
+
+    pce = ParallelCrossEntropy()
+    out = pce(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    ref = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels), reduction="none")
+    np.testing.assert_allclose(out.numpy().ravel(),
+                               ref.numpy().ravel(), atol=1e-5)
