@@ -33,6 +33,15 @@ def _to_list(x):
     return [x]
 
 
+def _batch_len(ins, default):
+    """Leading-dim size of the first input array, else ``default``."""
+    first = ins[0] if isinstance(ins, (list, tuple)) and ins else ins
+    shape = getattr(first, "shape", None)
+    if shape is not None and len(shape) > 0:
+        return int(shape[0])
+    return default
+
+
 class _DynamicGraphAdapter:
     """Reference: hapi/model.py:776."""
 
@@ -201,31 +210,54 @@ class Model:
                                 metrics=self._metrics_name())
         self.stop_training = False
         cbks.on_train_begin()
+        from ..profiler.timer import benchmark
+        bench = benchmark()
+        bench.begin('train')
         it_count = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            accum = 0
-            for step, data in enumerate(train_loader):
-                cbks.on_train_batch_begin(step)
-                ins, labels = self._split_data(data)
-                accum += 1
-                update = accum % accumulate_grad_batches == 0
-                out = self.train_batch(ins, labels, update=update)
-                logs = self._make_logs(out)
-                logs["batch_size"] = batch_size
-                cbks.on_train_batch_end(step, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    self.stop_training = True
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                accum = 0
+                it = iter(train_loader)
+                step = 0
+                bench.reset_step_timer()
+                while True:
+                    bench.before_reader()
+                    try:
+                        data = next(it)
+                    except StopIteration:
+                        break
+                    bench.after_reader()
+                    cbks.on_train_batch_begin(step)
+                    ins, labels = self._split_data(data)
+                    accum += 1
+                    update = accum % accumulate_grad_batches == 0
+                    out = self.train_batch(ins, labels, update=update)
+                    logs = self._make_logs(out)
+                    # actual per-batch sample count (last batch may be short;
+                    # a user-supplied DataLoader ignores the batch_size arg)
+                    n_samples = _batch_len(ins, batch_size)
+                    logs["batch_size"] = n_samples
+                    bench.after_step(n_samples)
+                    logs["ips"] = bench.current_event.speed_average() \
+                        if bench.current_event else 0.0
+                    cbks.on_train_batch_end(step, logs)
+                    it_count += 1
+                    step += 1
+                    if num_iters is not None and it_count >= num_iters:
+                        self.stop_training = True
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self._run_eval(eval_loader, cbks)
+                bench.reset_step_timer()
+                if self.stop_training:
                     break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader, cbks)
-            if self.stop_training:
-                break
+        finally:
+            bench.end()
         cbks.on_train_end(logs)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

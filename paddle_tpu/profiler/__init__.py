@@ -1,0 +1,40 @@
+"""paddle.profiler — TPU-native profiling (reference: python/paddle/profiler).
+
+Host-side span collection + schedule live here; the device timeline is
+captured by XLA's own profiler via ``jax.profiler.start_trace`` into a
+TensorBoard/Perfetto-readable directory.  See profiler.py for the design.
+"""
+
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    SortedKeys,
+    SummaryView,
+    export_chrome_tracing,
+    export_protobuf,
+    get_profiler,
+    make_scheduler,
+)
+from .utils import (  # noqa: F401
+    RecordEvent,
+    TracerEventType,
+    in_profiler_mode,
+    load_profiler_result,
+    wrap_optimizers,
+)
+from . import timer  # noqa: F401
+from .timer import benchmark  # noqa: F401
+
+__all__ = [
+    'ProfilerState',
+    'ProfilerTarget',
+    'make_scheduler',
+    'export_chrome_tracing',
+    'export_protobuf',
+    'Profiler',
+    'RecordEvent',
+    'load_profiler_result',
+    'SortedKeys',
+    'SummaryView',
+]

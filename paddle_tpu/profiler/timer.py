@@ -1,0 +1,207 @@
+"""Step/throughput timer (reference: python/paddle/profiler/timer.py).
+
+The reference maintains a ``benchmark()`` singleton that the hapi training
+loop feeds (``before_reader``/``after_reader``/``after_step``) so ProgBar can
+display reader cost, batch cost, and ips.  Here the same protocol is kept but
+implemented around host wall-clock only: on TPU, device work is asynchronous,
+so the step boundary must be fenced by the caller (hapi fences on the loss
+fetch, which is the natural sync point).
+"""
+
+from __future__ import annotations
+
+import timeit
+from collections import OrderedDict
+
+
+class TimeAverager:
+    """Running average with call count (reference timer.py:229)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total_time = 0.0
+        self._total_samples = 0
+        self._cnt = 0
+
+    def record(self, usetime, num_samples=None):
+        self._total_time += usetime
+        self._cnt += 1
+        if num_samples:
+            self._total_samples += num_samples
+
+    def get_average(self):
+        return self._total_time / self._cnt if self._cnt else 0.0
+
+    def get_ips_average(self):
+        if not self._total_samples or not self._total_time:
+            return 0.0
+        return self._total_samples / self._total_time
+
+    @property
+    def total_time(self):
+        return self._total_time
+
+    @property
+    def cnt(self):
+        return self._cnt
+
+
+class Event:
+    """Per-phase (train/eval/predict) cost record (reference timer.py:44)."""
+
+    def __init__(self):
+        self.reader_cost_averager = TimeAverager()
+        self.batch_cost_averager = TimeAverager()
+        self.total_samples = 0
+        self.total_iters = 0
+        self.skip_iter = 10
+        self.reader_records = {'max': 0.0, 'min': float('inf'), 'total': 0.0}
+        self.batch_records = {'max': 0.0, 'min': float('inf'), 'total': 0.0}
+        self.speed_records = {'max': 0.0, 'min': float('inf')}
+        self.reader = None
+        self.need_record = True
+        self.speed_unit = 'samples/sec'
+
+    def reset(self):
+        self.reader_cost_averager.reset()
+        self.batch_cost_averager.reset()
+
+    def record_reader(self, usetime):
+        self.reader_cost_averager.record(usetime)
+        if self.total_iters >= self.skip_iter:
+            self._update_records(usetime, self.reader_records)
+
+    def record_batch(self, usetime, num_samples=None):
+        self.batch_cost_averager.record(usetime, num_samples)
+        self.total_iters += 1
+        if num_samples:
+            self.total_samples += num_samples
+        if self.total_iters >= self.skip_iter:
+            self._update_records(usetime, self.batch_records)
+            if num_samples and usetime > 0:
+                speed = num_samples / usetime
+                if speed > self.speed_records['max']:
+                    self.speed_records['max'] = speed
+                if speed < self.speed_records['min']:
+                    self.speed_records['min'] = speed
+
+    def _update_records(self, current, records):
+        records['total'] += current
+        if current > records['max']:
+            records['max'] = current
+        if current < records['min']:
+            records['min'] = current
+
+    def reader_average(self):
+        return self.reader_cost_averager.get_average()
+
+    def batch_average(self):
+        return self.batch_cost_averager.get_average()
+
+    def speed_average(self):
+        return self.batch_cost_averager.get_ips_average()
+
+    def get_summary(self):
+        n = max(self.total_iters - self.skip_iter, 1)
+        return {
+            'reader_summary': {
+                'max': self.reader_records['max'],
+                'min': self.reader_records['min'],
+                'avg': self.reader_records['total'] / n,
+            },
+            'batch_summary': {
+                'max': self.batch_records['max'],
+                'min': self.batch_records['min'],
+                'avg': self.batch_records['total'] / n,
+            },
+            'ips_summary': self.speed_records,
+        }
+
+
+class Benchmark:
+    """Global step-timing state machine fed by training loops.
+
+    Protocol (same call sites as the reference's TimerHook):
+      ``check_if_need_record(reader)`` when a new iterator appears,
+      ``before_reader()`` / ``after_reader()`` around the next-batch fetch,
+      ``after_step(num_samples)`` once the step result is on host.
+    """
+
+    def __init__(self):
+        self.num_samples = None
+        self.speed_mode = 'samples ips'
+        self.speed_unit = 'samples/s'
+        self.events = OrderedDict()
+        self.current_event = None
+        self._reader_t = None
+        self._step_t = None
+
+    def begin(self, name='train'):
+        # a fresh Event per run: costs from a previous fit()/Profiler on the
+        # same phase name must not blend into this run's averages
+        ev = Event()
+        self.events[name] = ev
+        self.current_event = ev
+        self._step_t = timeit.default_timer()
+        return ev
+
+    def reset_step_timer(self):
+        """Re-arm the step clock, excluding out-of-band work (epoch-end
+        callbacks, mid-training eval) from the next batch's cost."""
+        self._step_t = timeit.default_timer()
+
+    def check_if_need_record(self, reader):
+        if self.current_event is None:
+            return
+        if self.current_event.need_record:
+            if self.current_event.reader is None:
+                self.current_event.reader = reader
+            elif self.current_event.reader.__dict__ is not reader.__dict__:
+                self.current_event.need_record = False
+        else:
+            if self.current_event.reader.__dict__ is reader.__dict__:
+                self.current_event.need_record = True
+
+    def before_reader(self):
+        self._reader_t = timeit.default_timer()
+
+    def after_reader(self):
+        if self.current_event is None or self._reader_t is None:
+            return
+        self.current_event.record_reader(
+            timeit.default_timer() - self._reader_t)
+
+    def after_step(self, num_samples=None):
+        if self.current_event is None:
+            return
+        now = timeit.default_timer()
+        if self._step_t is not None:
+            self.current_event.record_batch(now - self._step_t, num_samples)
+        self._step_t = now
+
+    def step_info(self, unit='samples'):
+        ev = self.current_event
+        if ev is None:
+            return ''
+        msg = (f" reader_cost: {ev.reader_average():.5f} s"
+               f" batch_cost: {ev.batch_average():.5f} s")
+        ips = ev.speed_average()
+        if ips:
+            msg += f" ips: {ips:.3f} {unit}/s"
+        ev.reset()
+        return msg
+
+    def end(self):
+        self.current_event = None
+        self._step_t = None
+        self._reader_t = None
+
+
+_benchmark = Benchmark()
+
+
+def benchmark():
+    """Return the global Benchmark singleton (reference timer.py:440)."""
+    return _benchmark

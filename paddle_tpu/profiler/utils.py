@@ -1,0 +1,131 @@
+"""RecordEvent and host-span collection (reference: profiler/utils.py:40).
+
+TPU-first design: a ``RecordEvent`` does two things at once —
+  1. appends a wall-clock span to the in-process span buffer (used for the
+     framework-side summary table and chrome-trace export), and
+  2. opens a ``jax.profiler.TraceAnnotation`` so the same name shows up in
+     the XLA device trace when a ``Profiler`` capture is active.
+
+Device-side op timing belongs to XLA's own profiler (captured via
+``jax.profiler.start_trace``); the framework does not attempt to re-time
+individual ops on host, which would fence the async dispatch queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import timeit
+from contextlib import ContextDecorator
+
+import jax
+
+
+class TracerEventType:
+    """Event categories (reference: paddle/fluid/platform/profiler/trace_event.h)."""
+    Operator = "Operator"
+    Dataloader = "Dataloader"
+    ProfileStep = "ProfileStep"
+    Forward = "Forward"
+    Backward = "Backward"
+    Optimization = "Optimization"
+    Communication = "Communication"
+    PythonOp = "PythonOp"
+    UserDefined = "UserDefined"
+
+
+class _SpanBuffer:
+    """Thread-safe buffer of completed host spans."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans = []
+        self.enabled = False
+
+    def add(self, name, event_type, start, end, tid):
+        with self._lock:
+            self._spans.append((name, event_type, start, end, tid))
+
+    def drain(self):
+        with self._lock:
+            spans, self._spans = self._spans, []
+        return spans
+
+    def clear(self):
+        with self._lock:
+            self._spans = []
+
+
+_buffer = _SpanBuffer()
+
+
+def in_profiler_mode():
+    return _buffer.enabled
+
+
+def _enable_collection():
+    _buffer.enabled = True
+
+
+def _disable_collection():
+    _buffer.enabled = False
+
+
+def _drain_spans():
+    return _buffer.drain()
+
+
+class RecordEvent(ContextDecorator):
+    """User-facing interval annotation (reference: profiler/utils.py:40).
+
+    Usage::
+
+        with paddle.profiler.RecordEvent("attention"):
+            out = model(x)
+
+    or via ``begin()`` / ``end()``.  Cheap no-op when no profiler is active.
+    """
+
+    def __init__(self, name, event_type=TracerEventType.PythonOp):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+        self._ann = None
+
+    def begin(self):
+        if not _buffer.enabled:
+            return
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._start = timeit.default_timer()
+
+    def end(self):
+        if self._start is None:
+            return
+        end = timeit.default_timer()
+        _buffer.add(self.name, self.event_type, self._start, end,
+                    threading.get_ident())
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.end()
+        return False
+
+
+def wrap_optimizers():
+    """Reference wraps optimizer.step in a RecordEvent; our op-dispatch layer
+    already annotates whole jitted steps, so this is a documented no-op."""
+    return None
+
+
+def load_profiler_result(filename):
+    """Load a chrome-trace JSON previously written by export_chrome_tracing."""
+    import json
+    with open(filename) as f:
+        return json.load(f)

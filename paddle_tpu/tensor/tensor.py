@@ -237,8 +237,8 @@ class Tensor:
         if (old_sharding is not None
                 and getattr(old_sharding, "mesh", None) is not None
                 and old_sharding != new_sharding):
-            import jax as _jax
-            src = _jax.device_put(src, old_sharding)
+            from ..distributed.auto_parallel import _device_put_robust
+            src = _device_put_robust(src, old_sharding)
         self._data = src
 
     def get_tensor(self):  # LoDTensor-compat shim

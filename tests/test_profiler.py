@@ -1,0 +1,133 @@
+"""Profiler subsystem tests (reference: test/legacy_test/test_profiler.py)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 make_scheduler)
+
+
+def test_make_scheduler_cycle():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # cycle 2
+    assert states[8] == ProfilerState.RECORD_AND_RETURN
+    assert states[9] == ProfilerState.CLOSED          # repeat exhausted
+
+
+def test_make_scheduler_validates():
+    with pytest.raises(ValueError):
+        make_scheduler(closed=1, ready=1, record=0)
+
+
+def test_profiler_records_spans_and_steps(tmp_path):
+    p = Profiler(targets=[profiler.ProfilerTarget.CPU],
+                 trace_dir=str(tmp_path / "trace"))
+    p.start()
+    for _ in range(3):
+        with RecordEvent("my_span"):
+            x = jnp.ones((8, 8))
+            (x @ x).block_until_ready()
+        p.step(num_samples=8)
+    p.stop()
+    assert p.step_num == 3
+    # spans collected
+    names = [s[0] for s in p._spans]
+    assert names.count("my_span") == 3
+    # summary prints a table containing the span
+    table = p.summary()
+    assert "my_span" in table
+    assert "ProfileStep" in table
+    # chrome export round-trips through load_profiler_result
+    out = str(tmp_path / "trace.json")
+    p.export(out)
+    data = profiler.load_profiler_result(out)
+    evnames = {e["name"] for e in data["traceEvents"]}
+    assert "my_span" in evnames
+    assert any(n.startswith("ProfileStep#") for n in evnames)
+
+
+def test_record_event_noop_outside_profiler():
+    # must be cheap + harmless with no active profiler
+    with RecordEvent("orphan"):
+        pass
+    assert not profiler.in_profiler_mode()
+
+
+def test_profiler_schedule_window(tmp_path):
+    captured = []
+    p = Profiler(targets=[profiler.ProfilerTarget.CPU],
+                 scheduler=(2, 4),
+                 on_trace_ready=lambda prof: captured.append(prof.step_num),
+                 trace_dir=str(tmp_path / "t"))
+    p.start()
+    for i in range(6):
+        with RecordEvent(f"step{i}"):
+            pass
+        p.step()
+    p.stop()
+    names = [s[0] for s in p._spans]
+    # only steps inside the [2, 4) RECORD window (and the READY warmup) collect
+    assert "step2" in names and "step3" in names
+    assert "step5" not in names
+
+
+def test_benchmark_timer_ips():
+    from paddle_tpu.profiler.timer import Benchmark
+    b = Benchmark()
+    b.begin('train')
+    import time
+    for _ in range(3):
+        b.before_reader()
+        b.after_reader()
+        time.sleep(0.01)
+        b.after_step(num_samples=32)
+    ev = b.events['train']
+    assert ev.total_iters == 3
+    assert ev.total_samples == 96
+    assert ev.speed_average() > 0
+    info = b.step_info()
+    assert "batch_cost" in info and "ips" in info
+    b.end()
+
+
+def test_step_time_ms():
+    p = Profiler(targets=[profiler.ProfilerTarget.CPU], timer_only=True)
+    p.start()
+    for _ in range(4):
+        p.step()
+    p.stop()
+    assert p.step_time_ms(skip_first=1) >= 0.0
+
+
+def test_hapi_fit_feeds_benchmark():
+    import numpy as np
+    from paddle_tpu.profiler.timer import benchmark
+    net = paddle.nn.Sequential(paddle.nn.Flatten(), paddle.nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return (rng.randn(16).astype("float32"),
+                    np.array([i % 4], dtype="int64"))
+
+    model.fit(DS(), batch_size=8, epochs=1, verbose=0)
+    ev = benchmark().events.get('train')
+    assert ev is not None and ev.total_iters >= 2
