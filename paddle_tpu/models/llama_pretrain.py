@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["LlamaPretrainConfig", "init_params", "make_train_step",
@@ -54,12 +55,29 @@ class LlamaPretrainConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # remat_policy: 'full' recomputes the whole block; 'flash' saves the
+    # flash-attention residuals and remats only projections/FFN (fastest
+    # on v5e, see PERF.md); 'dots'/'names' are jax checkpoint policies.
+    remat_policy: str = "full"
     sequence_parallel: bool = True
     use_pallas_attention: bool = True
+    # context parallelism over the 'sep' mesh axis: None, 'ring'
+    # (ppermute blockwise attention, O(s/P) memory) or 'ulysses'
+    # (head<->seq all_to_all; needs heads % sep == 0).  See
+    # distributed/parallel/context_parallel.py.
+    context_parallel: Optional[str] = None
 
     def __post_init__(self):
         if self.num_key_value_heads is None:
             self.num_key_value_heads = self.num_attention_heads
+        if self.remat_policy not in ("full", "flash", "dots", "names"):
+            raise ValueError(
+                f"remat_policy must be one of full/flash/dots/names, "
+                f"got {self.remat_policy!r}")
+        if self.context_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel must be None, 'ring' or 'ulysses', "
+                f"got {self.context_parallel!r}")
 
     @property
     def head_dim(self) -> int:
@@ -181,11 +199,19 @@ def _rope(q, k, theta):
     return rot(q), rot(k)
 
 
-def _attention(q, k, v, cfg):
-    """Causal attention [b, s, n, d].  Uses the Pallas flash kernel when
-    registered (ops/pallas), else the fused XLA composite."""
+def _attention(q, k, v, cfg, mesh=None):
+    """Causal attention [b, s, n, d].  Routes to context-parallel
+    attention over the sep axis when configured, else the Pallas flash
+    kernel when registered (ops/pallas), else the fused XLA composite."""
     from ..ops.dispatch import get_op_impl
     from ..flags import flags
+    if cfg.context_parallel and mesh is not None and \
+            mesh.shape.get("sep", 1) > 1:
+        from ..distributed.parallel.context_parallel import (
+            ring_attention, ulysses_attention)
+        cp = ring_attention if cfg.context_parallel == "ring" \
+            else ulysses_attention
+        return cp(q, k, v, mesh, axis="sep", causal=True)
     impl = get_op_impl("flash_attention", None)
     if impl is not None and cfg.use_pallas_attention and \
             flags.FLAGS_pallas_flash_attention:
@@ -199,14 +225,13 @@ def _attention(q, k, v, cfg):
     return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
 
-def _block_forward(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig):
-    """One transformer block; x [b, s, h] in compute dtype."""
+def _block_pre_attn(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig):
+    """ln1 + QKV projections + rope + GQA repeat -> q, k, v.
+    Single source of block math shared by every remat policy."""
     b, s, h = x.shape
     n, d = cfg.num_attention_heads, cfg.head_dim
     nkv = cfg.num_key_value_heads
     dt = cfg.dtype
-
-    res = x
     y = _rms_norm(x, bp["ln1"], cfg.rms_norm_eps)
     q = (y @ bp["wq"].astype(dt)).reshape(b, s, n, d)
     k = (y @ bp["wk"].astype(dt)).reshape(b, s, nkv, d)
@@ -216,22 +241,75 @@ def _block_forward(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig):
         rep = n // nkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    attn = _attention(q, k, v, cfg).reshape(b, s, h)
-    x = res + attn @ bp["wo"].astype(dt)
+    return q, k, v
 
+
+def _block_post_attn(bp: Dict[str, Any], x, attn,
+                     cfg: LlamaPretrainConfig):
+    """Output projection + residual + FFN."""
+    b, s, h = x.shape
+    dt = cfg.dtype
+    attn = _ckpt_name(attn.reshape(b, s, h), "attn_out")
+    x = x + attn @ bp["wo"].astype(dt)
     res = x
     y = _rms_norm(x, bp["ln2"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(y @ bp["w_gate"].astype(dt))
-    up = y @ bp["w_up"].astype(dt)
-    x = res + (gate * up) @ bp["w_down"].astype(dt)
-    return x
+    gate = _ckpt_name(jax.nn.silu(y @ bp["w_gate"].astype(dt)), "ffn_gate")
+    up = _ckpt_name(y @ bp["w_up"].astype(dt), "ffn_up")
+    return res + (gate * up) @ bp["w_down"].astype(dt)
+
+
+def _block_forward(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig,
+                   mesh: Optional[Mesh] = None):
+    """One transformer block; x [b, s, h] in compute dtype."""
+    q, k, v = _block_pre_attn(bp, x, cfg)
+    attn = _attention(q, k, v, cfg, mesh)
+    return _block_post_attn(bp, x, attn, cfg)
+
+
+def _block_forward_flash_saved(bp: Dict[str, Any], x,
+                               cfg: LlamaPretrainConfig,
+                               mesh: Optional[Mesh] = None):
+    """Block forward where only the projections/FFN are rematerialised.
+
+    The flash-attention call sits OUTSIDE the two checkpoint regions, so
+    its custom-vjp residuals (q/k/v/o/lse) are saved for the backward
+    pass instead of re-running the O(S^2) kernel during recompute —
+    measured the best FLOPs/HBM trade on v5e at seq 2048 (the fwd kernel
+    is ~30% of a block's forward time; its residuals are ~150MB/layer at
+    b=8, which fits alongside fp32 params+moments for the 350M bench).
+    The math is the shared _block_pre_attn/_block_post_attn — only the
+    checkpoint boundaries differ from _block_forward."""
+    pre = jax.checkpoint(
+        lambda bp, x: _block_pre_attn(bp, x, cfg))
+    post = jax.checkpoint(
+        lambda bp, x, attn: _block_post_attn(bp, x, attn, cfg))
+    q, k, v = pre(bp, x)
+    attn = _attention(q, k, v, cfg, mesh)
+    return post(bp, x, attn)
+
+
+def _remat_wrap(fwd, cfg):
+    """Apply the configured rematerialisation policy to a block forward."""
+    if not cfg.remat:
+        return fwd
+    if cfg.remat_policy == "flash":
+        # selective: block internals remat, flash residuals saved
+        return _block_forward_flash_saved
+    if cfg.remat_policy == "dots":
+        # save matmul outputs, recompute elementwise/softmax in bwd —
+        # ~halves the trunk recompute FLOPs at the cost of HBM
+        pol = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        return jax.checkpoint(fwd, static_argnums=(2, 3), policy=pol)
+    if cfg.remat_policy == "names":
+        pol = jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "ffn_gate", "ffn_up")
+        return jax.checkpoint(fwd, static_argnums=(2, 3), policy=pol)
+    return jax.checkpoint(fwd, static_argnums=(2, 3))
 
 
 def _trunk_scan(blocks, x, cfg, mesh):
     """pp == 1: scan over the layer-stacked block params with remat."""
-    fwd = _block_forward
-    if cfg.remat:
-        fwd = jax.checkpoint(fwd, static_argnums=(2,))
+    fwd = _remat_wrap(_block_forward, cfg)
     # Megatron-SP activation constraints are a TPU optimisation; XLA:CPU's
     # AllReducePromotion/partitioner passes crash on the collectives they
     # produce inside scan+remat, so they're disabled on the CPU
@@ -241,7 +319,7 @@ def _trunk_scan(blocks, x, cfg, mesh):
              jax.default_backend() != "cpu")
 
     def step(carry, bp):
-        out = fwd(bp, carry, cfg)
+        out = fwd(bp, carry, cfg, mesh)
         if sp_on:
             out = jax.lax.with_sharding_constraint(
                 out, NamedSharding(mesh, P("dp", "mp", None)))
@@ -260,13 +338,11 @@ def _trunk_pipeline(blocks, x_mb, cfg, mesh, pp: int):
     """
     from ..distributed.parallel.pipeline import gpipe_forward
 
-    fwd = _block_forward
-    if cfg.remat:
-        fwd = jax.checkpoint(fwd, static_argnums=(2,))
+    fwd = _remat_wrap(_block_forward, cfg)
 
     def stage_fn(stage_bp, x):
         def step(carry, bp):
-            return fwd(bp, carry, cfg), None
+            return fwd(bp, carry, cfg, None), None
         out, _ = jax.lax.scan(step, x, stage_bp)
         return out
 
@@ -282,10 +358,22 @@ def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
         inputs = tokens[:, :-1]
         targets = tokens[:, 1:]
         x = jnp.take(params["embed"], inputs, axis=0).astype(dt)
+        cp_on = False
         if mesh is not None:
+            cp_on = bool(cfg.context_parallel and
+                         mesh.shape.get("sep", 1) > 1)
             x = jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P("dp", None, None)))
+                x, NamedSharding(
+                    mesh, P("dp", "sep" if cp_on else None, None)))
         if pp > 1:
+            if cp_on:
+                # the pipeline stage runs inside a shard_map manual over
+                # 'pp' and does not thread the mesh into attention, so
+                # the sep path would silently degrade to full-sequence
+                # GSPMD attention — refuse rather than quietly OOM
+                raise NotImplementedError(
+                    "context_parallel with pp > 1 is not supported yet; "
+                    "use sep parallelism with pp == 1")
             B = x.shape[0]
             mb = B // microbatches
             x_mb = x.reshape(microbatches, mb, *x.shape[1:])
@@ -358,12 +446,36 @@ def adamw_update(params, grads, state, lr=3e-4, b1=0.9, b2=0.95,
 
 def make_train_step(cfg: LlamaPretrainConfig, mesh: Mesh, pp: int = 1,
                     microbatches: int = 1, lr: float = 3e-4,
-                    weight_decay: float = 0.1):
-    """One donated, jitted XLA program: fwd + bwd + AdamW."""
+                    weight_decay: float = 0.1, accum_steps: int = 1):
+    """One donated, jitted XLA program: fwd + bwd + AdamW.
+
+    ``accum_steps > 1`` runs gradient accumulation over microbatches via
+    ``lax.scan``.  On TPU this is the preferred memory/FLOPs trade: each
+    microbatch's activations are live only inside its own scan iteration,
+    so ``cfg.remat`` can stay off — full rematerialisation costs ~30%
+    extra trunk FLOPs, while accumulation costs none (the optimizer and
+    its HBM traffic also amortise over the larger global batch).
+    """
     fwd = make_forward(cfg, mesh, pp, microbatches)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(fwd)(params, tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(fwd)(params, tokens)
+        else:
+            tb = tokens.reshape(accum_steps, -1, tokens.shape[-1])
+
+            def mb_step(g_acc, tok):
+                loss, g = jax.value_and_grad(fwd)(params, tok)
+                g_acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                return g_acc, loss
+
+            g0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            grads, losses = jax.lax.scan(mb_step, g0, tb)
+            grads = jax.tree_util.tree_map(
+                lambda g: g / accum_steps, grads)
+            loss = jnp.mean(losses)
         params, opt_state = adamw_update(params, grads, opt_state,
                                          lr=lr,
                                          weight_decay=weight_decay)

@@ -41,10 +41,13 @@ def _idiv(a, b):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                 sm_scale: float, block_k: int):
     # q_ref: [Bq, d]; k_ref/v_ref: [S, d]; o_ref: [Bq, d]; lse_ref: [Bq, 1]
+    # MXU dots run on the native (bf16) inputs with fp32 accumulation —
+    # v5e's fp32 matmul rate is ~1/4 of bf16, so upcasting the operands
+    # would quarter kernel throughput for no accuracy gain.
     qi = pl.program_id(1)
     Bq, d = q_ref.shape
     S = k_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32) * jnp.float32(sm_scale)
+    q = q_ref[:]
 
     num_k = jnp.int32(S // block_k)
     if causal:
@@ -57,10 +60,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
 
     def body(ki, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * jnp.float32(sm_scale)
         if causal:
             q_pos = qi * Bq + jax.lax.broadcasted_iota(
                 jnp.int32, (Bq, block_k), 0)
@@ -73,7 +77,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
@@ -92,8 +96,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     Bq, d = q_ref.shape
     S = k_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
+    q = q_ref[:]
+    do = do_ref[:]
     lse = lse_ref[:]            # [Bq, 1]
     delta = delta_ref[:]        # [Bq, 1]
 
@@ -106,10 +110,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         num_k_eff = num_k
 
     def body(ki, dq):
-        k = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q * jnp.float32(sm_scale), k, (((1,), (1,)), ((), ())),
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * jnp.float32(sm_scale)
         if causal:
             q_pos = qi * Bq + jax.lax.broadcasted_iota(
                 jnp.int32, (Bq, block_k), 0)
@@ -120,7 +125,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * jnp.float32(sm_scale)
-        dq = dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        dq = dq + jax.lax.dot_general(ds.astype(k.dtype), k,
+                                      (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dq
 
@@ -135,8 +141,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
     Bk, d = k_ref.shape
     S = q_ref.shape[0]
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    k = k_ref[:]
+    v = v_ref[:]
 
     num_q = jnp.int32(S // block_q)
     if causal:
@@ -146,12 +152,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qi * block_q, block_q), :]
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[pl.ds(qi * block_q, block_q), :]
         delta = delta_ref[pl.ds(qi * block_q, block_q), :]
-        s = jax.lax.dot_general(q * jnp.float32(sm_scale), k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * jnp.float32(sm_scale)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, Bk), 0)
@@ -159,12 +166,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, Bk), 1)
             s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        pb = p.astype(do.dtype)
+        dv = dv + jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * jnp.float32(sm_scale)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
