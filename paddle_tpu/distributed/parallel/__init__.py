@@ -3,11 +3,27 @@
 ``pipeline`` — the reusable pipeline-parallel engine: GPipe rotation and
 interleaved 1F1B over a ``pp`` mesh axis (reference:
 fleet/meta_parallel/pipeline_parallel.py:459, pp_layers.py:92).
+
+``context_parallel`` — sequence/context parallelism over the ``sep``
+mesh axis: Ulysses head<->seq all_to_all and ring attention
+(reference: fleet/meta_parallel/segment_parallel.py:26).
+
+``expert_parallel`` — MoE expert parallelism: GShard dense-capacity
+dispatch with all_to_all token exchange over a mesh axis (reference:
+moe_layer.py:263, moe_utils.py global_scatter/global_gather).
 """
 
+from .context_parallel import (ring_attention, ring_attention_local,
+                               ulysses_attention, ulysses_attention_local)
 from .data_parallel import DataParallel
+from .expert_parallel import (init_expert_params, moe_layer_ep,
+                              moe_layer_ep_local, moe_route,
+                              swiglu_expert)
 from .pipeline import (gpipe_forward, pipeline_value_and_grad,
                        stack_stage_params)
 
 __all__ = ["DataParallel", "gpipe_forward", "pipeline_value_and_grad",
-           "stack_stage_params"]
+           "stack_stage_params", "ulysses_attention", "ring_attention",
+           "ulysses_attention_local", "ring_attention_local",
+           "moe_layer_ep", "moe_layer_ep_local", "moe_route",
+           "init_expert_params", "swiglu_expert"]

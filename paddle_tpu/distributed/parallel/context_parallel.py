@@ -1,0 +1,183 @@
+"""Context (sequence-segment) parallelism over the ``sep`` mesh axis.
+
+Reference behavior: fleet/meta_parallel/segment_parallel.py:26 (the sep
+parallel wrapper) and topology.py:494 (the sep axis in the 5-axis hybrid
+topology).  The reference splits long sequences across ranks and runs
+attention with NCCL all-to-all (DeepSpeed-Ulysses style); ring attention
+(Liu et al.) is the blockwise alternative that rotates K/V around the
+ring instead of gathering heads.
+
+TPU-native realization — both strategies as pure SPMD functions:
+
+* **Ulysses** (:func:`ulysses_attention`): two ``lax.all_to_all`` ops
+  swap the sharded dimension seq<->heads around the attention call, so
+  each device sees the FULL sequence for ``n/P`` heads and any
+  single-device attention kernel (the Pallas flash kernel included)
+  runs unchanged in the middle.  Head-count must divide by the sep
+  degree; comm volume is O(b*s*h*d/P) per device — rides ICI.
+* **Ring** (:func:`ring_attention`): K/V chunks rotate around the sep
+  ring with ``lax.ppermute`` while each device's Q stays resident;
+  an online-softmax (m, l, acc) merge — flash attention's math at the
+  inter-chip level — keeps O(s_local) memory and exact numerics.  No
+  head-divisibility requirement; seq length can exceed any single
+  device's memory.
+
+Both run inside ``shard_map`` (manual over ``sep`` only, GSPMD-auto over
+dp/mp/...) and are reverse-differentiable: the ring loop is a
+``lax.scan``, whose VJP is the reverse ring.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "ulysses_attention", "ring_attention",
+    "ulysses_attention_local", "ring_attention_local",
+    "NEG_INF",
+]
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# local (inside-shard_map) bodies
+# ---------------------------------------------------------------------------
+def _default_attn(q, k, v, causal):
+    """Single-device attention used inside Ulysses.  Honors the same
+    Pallas kill switch as every other attention path (op registered +
+    FLAGS_pallas_flash_attention on); otherwise the fused XLA sdpa."""
+    from ...flags import flags
+    from ...ops.dispatch import get_op_impl
+    from ...ops.pallas.flash_attention import _xla_sdpa
+    impl = get_op_impl("flash_attention", None)
+    if impl is not None and flags.FLAGS_pallas_flash_attention:
+        return impl(q, k, v, causal=causal)
+    return _xla_sdpa(q, k, v, causal)
+
+
+def ulysses_attention_local(q, k, v, *, axis: str = "sep",
+                            causal: bool = True,
+                            attn_fn: Optional[Callable] = None):
+    """Runs INSIDE shard_map.  q/k/v: [b, s/P, n, d] (seq sharded over
+    ``axis``) -> out [b, s/P, n, d].
+
+    all_to_all #1 reshards seq-sharded -> head-sharded ([b, s, n/P, d]),
+    attention runs on the full sequence, all_to_all #2 reshards back.
+    """
+    if attn_fn is None:
+        attn_fn = _default_attn
+    n = q.shape[2]
+    p = jax.lax.axis_size(axis)
+    if n % p != 0:
+        raise ValueError(
+            f"ulysses needs heads % sep == 0, got {n} heads, sep={p}")
+    # [b, s/P, n, d] -> [b, s, n/P, d]: split heads across the group,
+    # gather sequence
+    q, k, v = (jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True) for x in (q, k, v))
+    out = attn_fn(q, k, v, causal)
+    # inverse: split seq, gather heads
+    return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ring_attention_local(q, k, v, *, axis: str = "sep",
+                         causal: bool = True):
+    """Runs INSIDE shard_map.  q/k/v: [b, s/P, n, d] (seq sharded over
+    ``axis``, contiguous chunks in ring order) -> out [b, s/P, n, d].
+
+    P steps of blockwise attention; at step t the device holds the K/V
+    chunk originally owned by rank (idx - t) mod P.  Online-softmax
+    merge in fp32; causal masking uses global positions, so chunks
+    entirely in the future contribute nothing (masked, not skipped —
+    the program stays SPMD-uniform).
+    """
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, sl, n, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def block(carry, t):
+        m_prev, l_prev, acc, kc, vc = carry
+        # owner rank of kc/vc (i32 arithmetic: x64 mode is on package-wide)
+        src = jax.lax.rem(jnp.int32(idx) - t + jnp.int32(p), jnp.int32(p))
+        s = jnp.einsum("bqnd,bknd->bnqk", qf,
+                       kc.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = idx * sl + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, sl, sl), 2)
+            k_pos = src * sl + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, sl, sl), 3)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # all-masked rows keep NEG_INF; exp underflows to 0 harmlessly
+        pr = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(pr, axis=-1, keepdims=True)
+        # acc [b,sl,n,d]; alpha [b,n,sl,1] -> [b,sl,n,1] to broadcast
+        acc = acc * jnp.moveaxis(alpha, 1, 2) + jnp.einsum(
+            "bnqk,bknd->bqnd", pr, vc.astype(jnp.float32))
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (m_new, l_new, acc, kc, vc), None
+
+    m0 = jnp.full((b, n, sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, sl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, sl, n, d), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        block, (m0, l0, acc0, k, v), jnp.arange(p, dtype=jnp.int32))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / jnp.moveaxis(l_safe, 1, 2)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# global wrappers (build the shard_map)
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=64)
+def _cp_shard_map(kind: str, mesh: Mesh, axis: str, causal: bool,
+                  attn_fn: Optional[Callable]):
+    """Build (and cache) the jitted shard_map for one (strategy, mesh,
+    axis, causal, attn_fn) combination — eager callers in a training
+    loop must hit the jit cache, not retrace every step."""
+    if kind == "ulysses":
+        local = functools.partial(ulysses_attention_local, axis=axis,
+                                  causal=causal, attn_fn=attn_fn)
+    else:
+        local = functools.partial(ring_attention_local, axis=axis,
+                                  causal=causal)
+    spec = P(None, axis, None, None)
+    f = jax.shard_map(local, mesh=mesh,
+                      in_specs=(spec, spec, spec), out_specs=spec,
+                      axis_names={axis}, check_vma=False)
+    # partial-manual (axis_names ⊂ mesh axes) shard_map only traces
+    # inside jit; jit here so eager callers work too (an enclosing jit
+    # makes this a no-op inline)
+    return jax.jit(f)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "sep",
+                      causal: bool = True,
+                      attn_fn: Optional[Callable] = None):
+    """Global-array Ulysses attention: q/k/v [b, s, n, d] sharded (or
+    shardable) on seq over ``axis``.  Differentiable."""
+    return _cp_shard_map("ulysses", mesh, axis, causal, attn_fn)(q, k, v)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sep",
+                   causal: bool = True):
+    """Global-array ring attention: q/k/v [b, s, n, d] sharded on seq
+    over ``axis``; O(s/P) activation memory per device.  Differentiable
+    (the scan VJP runs the reverse ring)."""
+    return _cp_shard_map("ring", mesh, axis, causal, None)(q, k, v)

@@ -1,0 +1,139 @@
+"""Context-parallel (sep axis) attention: Ulysses a2a + ring attention.
+
+Parity vs single-device attention on the 8-device virtual CPU mesh
+(reference behavior: fleet/meta_parallel/segment_parallel.py, the sep
+axis of topology.py:494).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.parallel.context_parallel import (
+    ring_attention, ulysses_attention)
+
+
+def _ref_attention(q, k, v, causal=True):
+    d = q.shape[-1]
+    s = jnp.einsum("bqnd,bknd->bnqk",
+                   q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(d)
+    if causal:
+        sl = q.shape[1]
+        mask = jnp.tril(jnp.ones((sl, sl), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnqk,bknd->bqnd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _mesh(sep):
+    devs = np.array(jax.devices()[:sep]).reshape(sep)
+    return Mesh(devs.reshape(1, sep), ("dp", "sep"))
+
+
+def _qkv(b=2, s=32, n=4, d=8, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (b, s, n, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("sep", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_parity(sep, causal):
+    q, k, v = _qkv()
+    mesh = _mesh(sep)
+    ref = _ref_attention(q, k, v, causal)
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("sep", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_parity(sep, causal):
+    q, k, v = _qkv()
+    mesh = _mesh(sep)
+    ref = _ref_attention(q, k, v, causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_needs_divisible_heads():
+    q, k, v = _qkv(n=3)
+    mesh = _mesh(2)
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh)
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_gradients_match(impl):
+    q, k, v = _qkv(b=1, s=16, n=4, d=8)
+    mesh = _mesh(4)
+    fn = ulysses_attention if impl == "ulysses" else ring_attention
+
+    def loss_cp(q, k, v):
+        return jnp.sum(fn(q, k, v, mesh, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v, True).astype(jnp.float32) ** 2)
+
+    g_cp = jax.grad(loss_cp, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_cp, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_ring_sharded_inputs_stay_sharded():
+    """Feeding already-sharded global arrays works and output sharding
+    preserves the seq partitioning."""
+    sep = 4
+    mesh = _mesh(sep)
+    q, k, v = _qkv()
+    sh = NamedSharding(mesh, P(None, "sep", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh))(qs, ks, vs)
+    assert out.sharding.spec == P(None, "sep", None, None)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref_attention(q, k, v, True)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flagship_sep_axis_parity():
+    """The flagship engine runs sep=2 with the same loss as sep=1
+    (VERDICT item 7 done-condition)."""
+    from paddle_tpu.models.llama_pretrain import (
+        LlamaPretrainConfig, build_mesh, init_params, make_forward)
+
+    cfg = LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_seq_len=64, use_pallas_attention=False, sequence_parallel=False,
+        remat=False, dtype=jnp.float32, context_parallel="ring")
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 65)))
+
+    mesh1 = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1,
+                       devices=jax.devices()[:1])
+    params1 = init_params(cfg, jax.random.PRNGKey(0), mesh1, pp=1)
+    with mesh1:
+        loss1 = jax.jit(make_forward(cfg, mesh1))(params1, tokens)
+
+    mesh2 = build_mesh(dp=2, pp=1, sharding=1, sep=2, mp=1,
+                       devices=jax.devices()[:4])
+    params2 = init_params(cfg, jax.random.PRNGKey(0), mesh2, pp=1)
+    with mesh2:
+        loss2 = jax.jit(make_forward(cfg, mesh2))(params2, tokens)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+
+    # ulysses path too
+    cfg_u = cfg.__class__(**{**cfg.__dict__, "context_parallel": "ulysses"})
+    with mesh2:
+        loss3 = jax.jit(make_forward(cfg_u, mesh2))(params2, tokens)
+    np.testing.assert_allclose(float(loss1), float(loss3), rtol=1e-5)

@@ -1,0 +1,112 @@
+"""Expert-parallel MoE over a mesh axis (VERDICT item 8).
+
+Done-condition: an E=8-expert MoE layer on 8 CPU devices matches the
+single-device layer numerically.  Reference: moe_layer.py:263,
+moe_utils.py:20 (global_scatter/global_gather).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.parallel.expert_parallel import (
+    init_expert_params, moe_layer_ep, moe_route, swiglu_expert)
+
+
+def _mesh(n, axis="ep"):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def _inputs(T=64, h=16, E=8, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(k1, (T, h), jnp.float32)
+    gate_w = jax.random.normal(k2, (h, E), jnp.float32) * 0.1
+    experts = init_expert_params(k3, E, h, 32)
+    return x, gate_w, experts
+
+
+@pytest.mark.parametrize("ep", [2, 4, 8])
+def test_ep_matches_single_device(ep):
+    x, gate_w, experts = _inputs()
+    out1, aux1 = moe_layer_ep(x, gate_w, experts, _mesh(1), axis="ep",
+                              num_expert=8, capacity_factor=8.0)
+    outp, auxp = moe_layer_ep(x, gate_w, experts, _mesh(ep), axis="ep",
+                              num_expert=8, capacity_factor=8.0)
+    np.testing.assert_allclose(np.asarray(outp), np.asarray(out1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(auxp), float(aux1), rtol=1e-5)
+
+
+def test_capacity_drops_tokens():
+    """With tiny capacity some tokens get zero combine weight."""
+    x, gate_w, experts = _inputs(T=32)
+    out, _ = moe_layer_ep(x, gate_w, experts, _mesh(8), axis="ep",
+                          num_expert=8, capacity_factor=0.25)
+    out_full, _ = moe_layer_ep(x, gate_w, experts, _mesh(8), axis="ep",
+                               num_expert=8, capacity_factor=8.0)
+    assert not np.allclose(np.asarray(out), np.asarray(out_full))
+
+
+def test_moe_route_shapes_and_aux():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    disp, comb, aux, _, _ = moe_route(logits, top_k=2, capacity=16)
+    assert disp.shape == (16, 2, 4, 16)
+    assert comb.shape == (16, 2, 4, 16)
+    # each token dispatched to exactly top_k slots when capacity allows
+    np.testing.assert_allclose(np.asarray(disp.sum((1, 2, 3))),
+                               np.full(16, 2.0))
+    # combine weights of each token sum to 1
+    np.testing.assert_allclose(np.asarray(comb.sum((1, 2, 3))),
+                               np.ones(16), rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_ep_gradients_flow():
+    x, gate_w, experts = _inputs(T=32)
+    mesh = _mesh(4)
+
+    def loss(gate_w, experts):
+        out, aux = moe_layer_ep(x, gate_w, experts, mesh, axis="ep",
+                                num_expert=8, capacity_factor=8.0)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    g_gate, g_exp = jax.grad(loss, argnums=(0, 1))(gate_w, experts)
+    assert float(jnp.abs(g_gate).sum()) > 0
+    for leaf in jax.tree_util.tree_leaves(g_exp):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    # parity of gradients vs single-device
+    g1_gate, g1_exp = jax.grad(
+        lambda gw, ex: jnp.sum(moe_layer_ep(
+            x, gw, ex, _mesh(1), axis="ep", num_expert=8,
+            capacity_factor=8.0)[0] ** 2) + 0.01 * moe_layer_ep(
+            x, gw, ex, _mesh(1), axis="ep", num_expert=8,
+            capacity_factor=8.0)[1],
+        argnums=(0, 1))(gate_w, experts)
+    np.testing.assert_allclose(np.asarray(g_gate), np.asarray(g1_gate),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ep_requires_divisible_experts():
+    x, gate_w, experts = _inputs(E=6)
+    with pytest.raises(ValueError, match="divide"):
+        moe_layer_ep(x, gate_w, experts, _mesh(4), axis="ep",
+                     num_expert=6)
+
+
+def test_custom_expert_fn():
+    """Any per-expert function works — here a plain linear expert."""
+    T, h, E = 32, 8, 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, h))
+    gate_w = jax.random.normal(jax.random.PRNGKey(1), (h, E)) * 0.1
+    w = jax.random.normal(jax.random.PRNGKey(2), (E, h, h)) * 0.1
+
+    def linear_expert(p, xc):
+        return xc @ p
+
+    out, _ = moe_layer_ep(x, gate_w, w, _mesh(4), axis="ep",
+                          num_expert=E, capacity_factor=8.0,
+                          expert_fn=linear_expert)
+    assert out.shape == (T, h)
