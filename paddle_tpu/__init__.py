@@ -67,6 +67,9 @@ from . import distributed  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import hapi as _hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402

@@ -1,0 +1,29 @@
+"""paddle.quantization — PTQ + QAT (reference: python/paddle/quantization).
+
+TPU-first simulated quantization: observers/quanters run in the compute
+dtype with straight-through gradients; the converted model is a normal
+XLA program whose quant-dequant patterns int8-capable backends can
+rewrite.  See base.py for the core math.
+"""
+
+from .base import (  # noqa: F401
+    BaseObserver, BaseQuanter, QuanterFactory, fake_quant_dequant, quanter)
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .observers import (  # noqa: F401
+    AbsmaxObserver, MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver)
+from .quanters import (  # noqa: F401
+    FakeQuanterChannelWiseAbsMax, FakeQuanterWithAbsMaxObserver)
+from .ptq import PTQ  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .wrapper import (  # noqa: F401
+    ConvertedQuantedLinear, ObserveWrapper, QuantedConv2D, QuantedLinear)
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "PTQ", "QAT",
+    "BaseObserver", "BaseQuanter", "QuanterFactory", "quanter",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "PerChannelAbsmaxObserver",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
+    "ObserveWrapper", "QuantedLinear", "QuantedConv2D",
+    "ConvertedQuantedLinear", "fake_quant_dequant",
+]

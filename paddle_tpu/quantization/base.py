@@ -1,0 +1,125 @@
+"""Quantization core: quant math, BaseObserver/BaseQuanter, factories.
+
+Reference: python/paddle/quantization/{base_observer.py, base_quanter.py,
+factory.py}.  TPU-first: fake-quantization is simulated in the compute
+dtype (quantize->round->clip->dequantize) so the whole model stays one
+XLA program; the straight-through estimator is the `x + (dq - x).detach()`
+identity, which XLA folds into the fwd while autograd sees d(dq)/dx = 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["BaseObserver", "BaseQuanter", "QuanterFactory",
+           "quanter", "fake_quant_dequant"]
+
+
+def _qrange(bit_length: int):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return -qmax, qmax
+
+
+def fake_quant_dequant(x, scale, bit_length: int = 8):
+    """Symmetric quant->dequant with straight-through gradients.
+
+    ``scale`` maps |x|max -> qmax (so scale == absmax / qmax).
+    """
+    import paddle_tpu as paddle
+    qmin, qmax = _qrange(bit_length)
+    s = paddle.maximum(scale, paddle.to_tensor(1e-9, dtype=x.dtype))
+    q = paddle.clip(paddle.round(x / s), qmin, qmax)
+    dq = q * s
+    # straight-through: forward dq, backward identity
+    return x + (dq - x).detach()
+
+
+class BaseObserver(Layer):
+    """Collects activation/weight statistics; pass-through forward.
+
+    Subclasses implement ``_observe(x)`` updating internal state and
+    ``scales()`` returning the quantization scale (reference
+    base_observer.py: BaseObserver.cal_thresholds)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._enabled = True
+
+    def enable(self, on: bool = True):
+        self._enabled = on
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self) -> Optional[int]:
+        return None
+
+    @classmethod
+    def partial(cls, **kw):
+        return QuanterFactory(cls, **kw)
+
+    def forward(self, x):
+        if self._enabled:
+            self._observe(x)
+        return x
+
+    def _observe(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        """Finalize statistics (no-op for absmax-style observers)."""
+        return None
+
+
+class BaseQuanter(Layer):
+    """Fake-quantizes in forward (QAT); also tracks scales so the
+    trained model can be converted/exported (reference base_quanter.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self) -> Optional[int]:
+        return None
+
+    @classmethod
+    def partial(cls, **kw):
+        return QuanterFactory(cls, **kw)
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class QuanterFactory:
+    """Partial-application holder: ``QuanterFactory(cls, **kw)`` builds
+    the observer/quanter per layer at quantize() time (reference
+    factory.py: ObserverFactory/QuanterFactory)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __repr__(self):
+        return f"QuanterFactory({self._cls.__name__})"
+
+
+def quanter(cls):
+    """Class decorator mirroring paddle.quantization.quanter: makes the
+    class usable directly as its own factory."""
+    def partial(*args, **kwargs):
+        return QuanterFactory(cls, *args, **kwargs)
+    cls.partial = staticmethod(partial)
+    return cls

@@ -1,0 +1,109 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py:60).
+
+Maps layers (by instance, name, or type) to (activation, weight)
+observer/quanter factories.  Priority: layer > name > type > default —
+same resolution order as the reference's _get_config_by_layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ..nn.layer.layers import Layer
+from .base import QuanterFactory
+
+__all__ = ["QuantConfig", "SingleLayerConfig"]
+
+DEFAULT_QAT_LAYER_MAPPINGS: dict = {}   # filled in wrapper.py import
+
+
+class SingleLayerConfig:
+    """Reference config.py:35."""
+
+    def __init__(self, activation: Optional[QuanterFactory],
+                 weight: Optional[QuanterFactory]):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
+class QuantConfig:
+    def __init__(self, activation: Optional[QuanterFactory],
+                 weight: Optional[QuanterFactory]):
+        if activation is None and weight is None:
+            self._global_config = None
+        else:
+            self._global_config = SingleLayerConfig(activation, weight)
+        self._layer2config: dict = {}      # id(layer) -> cfg
+        self._prefix2config: dict = {}     # full name -> cfg
+        self._type2config: dict = {}       # type -> cfg
+        self._qat_layer_mapping = dict(DEFAULT_QAT_LAYER_MAPPINGS)
+        self._customized_leaves: list = []
+
+    # -- registration -----------------------------------------------------
+    def add_layer_config(self, layer: Union[Layer, list],
+                         activation: QuanterFactory = None,
+                         weight: QuanterFactory = None):
+        layers = layer if isinstance(layer, list) else [layer]
+        for l in layers:
+            self._layer2config[id(l)] = SingleLayerConfig(activation,
+                                                          weight)
+
+    def add_name_config(self, layer_name: Union[str, list],
+                        activation: QuanterFactory = None,
+                        weight: QuanterFactory = None):
+        names = layer_name if isinstance(layer_name, list) else [layer_name]
+        for n in names:
+            self._prefix2config[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type: Union[type, list],
+                        activation: QuanterFactory = None,
+                        weight: QuanterFactory = None):
+        types = layer_type if isinstance(layer_type, list) else [layer_type]
+        for t in types:
+            self._type2config[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source: type, target: type):
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaf(self, layer_type: type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def customized_leaves(self):
+        return self._customized_leaves
+
+    @property
+    def qat_layer_mappings(self):
+        return self._qat_layer_mapping
+
+    @property
+    def default_qat_layer_mapping(self):
+        return self._qat_layer_mapping
+
+    # -- resolution -------------------------------------------------------
+    def _get_config_by_layer(self, name: str,
+                             layer: Layer) -> Optional[SingleLayerConfig]:
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        if name in self._prefix2config:
+            return self._prefix2config[name]
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        if type(layer) in self._qat_layer_mapping:
+            return self._global_config
+        return None
+
+    def _is_quantifiable(self, name: str, layer: Layer) -> bool:
+        return self._get_config_by_layer(name, layer) is not None

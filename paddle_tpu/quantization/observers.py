@@ -1,0 +1,78 @@
+"""PTQ observers (reference: python/paddle/quantization/observers/).
+
+``AbsmaxObserver`` — running max of |x| (abs_max.py).
+``MovingAverageAbsmaxObserver`` — EMA of per-batch absmax.
+``PerChannelAbsmaxObserver`` — channel-wise absmax for weights
+(imperative/ptq_quantizer.py PerChannelAbsmaxQuantizer role).
+"""
+
+from __future__ import annotations
+
+from .base import BaseObserver, QuanterFactory, _qrange
+
+__all__ = ["AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "PerChannelAbsmaxObserver"]
+
+
+class AbsmaxObserver(BaseObserver):
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def _observe(self, x):
+        import paddle_tpu as paddle
+        self._absmax = max(self._absmax,
+                           float(paddle.max(paddle.abs(x.detach()))))
+
+    def scales(self):
+        import paddle_tpu as paddle
+        _, qmax = _qrange(self._quant_bits)
+        return paddle.to_tensor(self._absmax / qmax, dtype="float32")
+
+    @classmethod
+    def partial(cls, **kw):
+        return QuanterFactory(cls, **kw)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+        self._state = None
+
+    def _observe(self, x):
+        import paddle_tpu as paddle
+        cur = float(paddle.max(paddle.abs(x.detach())))
+        self._state = cur if self._state is None else \
+            self._rate * self._state + (1 - self._rate) * cur
+
+    def scales(self):
+        import paddle_tpu as paddle
+        _, qmax = _qrange(self._quant_bits)
+        return paddle.to_tensor((self._state or 0.0) / qmax,
+                                dtype="float32")
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Channel-wise absmax; ``quant_axis`` is the output-channel dim
+    (1 for this framework's Linear [in, out] weights, 0 for Conv2D
+    [out, in, kh, kw])."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 1):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._absmax = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def _observe(self, x):
+        import paddle_tpu as paddle
+        reduce_dims = [d for d in range(x.ndim) if d != self._axis]
+        cur = paddle.max(paddle.abs(x.detach()), axis=reduce_dims)
+        self._absmax = cur if self._absmax is None else \
+            paddle.maximum(self._absmax, cur)
+
+    def scales(self):
+        _, qmax = _qrange(self._quant_bits)
+        return self._absmax / qmax

@@ -1,0 +1,78 @@
+"""Post-training quantization driver (reference: quantization/ptq.py:24).
+
+Flow: ``PTQ(config).quantize(model)`` wraps configured layers with
+observers; run calibration batches through the wrapped model;
+``PTQ.convert(model)`` replaces wrapped layers with converted layers
+whose weights carry the calibrated quant-dequant.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .wrapper import ConvertedQuantedLinear, ObserveWrapper
+
+__all__ = ["PTQ"]
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+
+def _replace_sublayers(model: Layer, decide):
+    """Walk the tree; ``decide(full_name, layer) -> new_layer | None``."""
+    def walk(layer: Layer, prefix: str):
+        for name, sub in list(layer._sub_layers.items()):
+            full = prefix + ("." if prefix else "") + name
+            new = decide(full, sub)
+            if new is not None:
+                layer._sub_layers[name] = new
+            else:
+                walk(sub, full)
+    walk(model, "")
+    return model
+
+
+class PTQ(Quantization):
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        cfg = self._config
+
+        def decide(full, sub):
+            c = cfg._get_config_by_layer(full, sub)
+            if c is None:
+                return None
+            act = c.activation.instance(sub) if c.activation else None
+            wt = c.weight.instance(sub) if c.weight else None
+            return ObserveWrapper(sub, act, wt)
+
+        return _replace_sublayers(model, decide)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Replace ObserveWrappers with converted inference layers using
+        the calibrated scales (reference ptq.py convert)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        from ..nn.layer.common import Linear
+
+        def decide(full, sub):
+            if not isinstance(sub, ObserveWrapper):
+                return None
+            inner = sub._observed
+            act_scale = (sub._act_observer.scales()
+                         if sub._act_observer is not None else None)
+            wt_scale = (sub._wt_observer.scales()
+                        if sub._wt_observer is not None else None)
+            if isinstance(inner, Linear):
+                bits = (sub._wt_observer.bit_length()
+                        if sub._wt_observer is not None else 8)
+                return ConvertedQuantedLinear(inner, act_scale, wt_scale,
+                                              bits)
+            return inner  # unknown type: unwrap, keep float
+
+        return _replace_sublayers(model, decide)

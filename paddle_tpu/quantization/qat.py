@@ -1,0 +1,58 @@
+"""Quantization-aware training driver (reference: quantization/qat.py:23).
+
+``QAT(config).quantize(model)`` replaces mapped layers (Linear→
+QuantedLinear, Conv2D→QuantedConv2D) so training runs with fake-quant
+in the graph; gradients flow via the straight-through estimator.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .ptq import Quantization, _replace_sublayers
+
+__all__ = ["QAT"]
+
+
+class QAT(Quantization):
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.train()
+        cfg = self._config
+        mapping = cfg.qat_layer_mappings
+
+        def decide(full, sub):
+            c = cfg._get_config_by_layer(full, sub)
+            if c is None:
+                return None
+            target = mapping.get(type(sub))
+            if target is None:
+                return None
+            return target(sub, c)
+
+        return _replace_sublayers(model, decide)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Strip quanters, baking the final weight quant-dequant in
+        (reference qat.py convert → ConvertibleQuantedLayer.convert)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        from ..nn.layer.common import Linear
+        from .wrapper import ConvertedQuantedLinear, QuantedLinear
+
+        def decide(full, sub):
+            if not isinstance(sub, QuantedLinear):
+                return None
+            lin = Linear(sub.weight.shape[0], sub.weight.shape[1])
+            lin.weight = sub.weight
+            lin.bias = sub.bias
+            act_scale = (sub.activation_quanter.scales()
+                         if sub.activation_quanter is not None else None)
+            wt_scale = (sub.weight_quanter.scales()
+                        if sub.weight_quanter is not None else None)
+            return ConvertedQuantedLinear(lin, act_scale, wt_scale)
+
+        return _replace_sublayers(model, decide)

@@ -1,0 +1,74 @@
+"""QAT fake-quanters (reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver).
+"""
+
+from __future__ import annotations
+
+from .base import BaseQuanter, QuanterFactory, _qrange, fake_quant_dequant
+
+__all__ = ["FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMax"]
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average absmax scale + fake quant-dequant in forward —
+    the training-time simulated-int8 path with straight-through grads."""
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8,
+                 dtype=None, name=None):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+        self._state = None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        cur = float(paddle.max(paddle.abs(x.detach())))
+        if self.training:
+            self._state = cur if self._state is None else \
+                self._rate * self._state + (1 - self._rate) * cur
+        absmax = self._state if self._state is not None else cur
+        _, qmax = _qrange(self._quant_bits)
+        scale = paddle.to_tensor(absmax / qmax, dtype=x.dtype)
+        return fake_quant_dequant(x, scale, self._quant_bits)
+
+    def scales(self):
+        import paddle_tpu as paddle
+        _, qmax = _qrange(self._quant_bits)
+        return paddle.to_tensor((self._state or 0.0) / qmax,
+                                dtype="float32")
+
+    @classmethod
+    def partial(cls, **kw):
+        return QuanterFactory(cls, **kw)
+
+
+class FakeQuanterChannelWiseAbsMax(BaseQuanter):
+    """Channel-wise weight fake-quanter (quant_axis = output channels)."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 1,
+                 dtype=None, name=None):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._absmax = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def forward(self, w):
+        import paddle_tpu as paddle
+        reduce_dims = [d for d in range(w.ndim) if d != self._axis]
+        cur = paddle.max(paddle.abs(w.detach()), axis=reduce_dims)
+        self._absmax = cur
+        _, qmax = _qrange(self._quant_bits)
+        shape = [1] * w.ndim
+        shape[self._axis] = -1
+        scale = paddle.reshape(cur / qmax, shape)
+        return fake_quant_dequant(w, scale, self._quant_bits)
+
+    def scales(self):
+        _, qmax = _qrange(self._quant_bits)
+        return None if self._absmax is None else self._absmax / qmax
+
+    @classmethod
+    def partial(cls, **kw):
+        return QuanterFactory(cls, **kw)

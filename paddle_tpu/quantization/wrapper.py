@@ -1,0 +1,147 @@
+"""Quantization wrappers + quanted layers.
+
+Reference: python/paddle/quantization/wrapper.py (ObserveWrapper) and
+python/paddle/nn/quant/qat/{linear.py, conv.py} (QuantedLinear,
+QuantedConv2D).
+"""
+
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .base import fake_quant_dequant
+
+__all__ = ["ObserveWrapper", "QuantedLinear", "QuantedConv2D",
+           "ConvertedQuantedLinear"]
+
+
+class ObserveWrapper(Layer):
+    """PTQ: wraps a layer, observing input activations and (once)
+    weights; forward behaviour is unchanged."""
+
+    def __init__(self, observed: Layer, activation_observer=None,
+                 weight_observer=None):
+        super().__init__()
+        self._observed = observed
+        self._act_observer = activation_observer
+        self._wt_observer = weight_observer
+        self._wt_seen = False
+        if activation_observer is not None:
+            self.add_sublayer("activation_observer", activation_observer)
+        if weight_observer is not None:
+            self.add_sublayer("weight_observer", weight_observer)
+        self.add_sublayer("observed", observed)
+
+    def forward(self, *args, **kwargs):
+        if self._act_observer is not None and args:
+            args = (self._act_observer(args[0]),) + args[1:]
+        if self._wt_observer is not None and not self._wt_seen and \
+                hasattr(self._observed, "weight"):
+            self._wt_observer(self._observed.weight)
+            self._wt_seen = True
+        return self._observed(*args, **kwargs)
+
+
+class QuantedLinear(Layer):
+    """QAT Linear: fake-quant on activation and weight around the matmul
+    (reference nn/quant/qat/linear.py)."""
+
+    def __init__(self, layer: Layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self.activation_quanter = (
+            q_config.activation.instance(layer)
+            if q_config and q_config.activation else None)
+        self.weight_quanter = (
+            q_config.weight.instance(layer)
+            if q_config and q_config.weight else None)
+        if self.activation_quanter is not None:
+            self.add_sublayer("activation_quanter",
+                              self.activation_quanter)
+        if self.weight_quanter is not None:
+            self.add_sublayer("weight_quanter", self.weight_quanter)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT Conv2D (reference nn/quant/qat/conv.py)."""
+
+    def __init__(self, layer: Layer, q_config):
+        super().__init__()
+        self._layer = layer
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self.activation_quanter = (
+            q_config.activation.instance(layer)
+            if q_config and q_config.activation else None)
+        self.weight_quanter = (
+            q_config.weight.instance(layer)
+            if q_config and q_config.weight else None)
+        if self.activation_quanter is not None:
+            self.add_sublayer("activation_quanter",
+                              self.activation_quanter)
+        if self.weight_quanter is not None:
+            self.add_sublayer("weight_quanter", self.weight_quanter)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, self.bias,
+                        stride=self._layer._stride,
+                        padding=self._layer._padding,
+                        dilation=self._layer._dilation,
+                        groups=self._layer._groups)
+
+
+class ConvertedQuantedLinear(Layer):
+    """Inference form after PTQ convert: weights stored quant-dequanted
+    with the calibrated scale; activations quant-dequanted on entry.
+    Simulated-int8 — on TPU the conversion benefit is exercised through
+    XLA int8 matmul rewrites when exported."""
+
+    def __init__(self, layer: Layer, act_scale, wt_scale, bits: int = 8):
+        super().__init__()
+        self.bias = getattr(layer, "bias", None)
+        self._act_scale = act_scale
+        self._bits = bits
+        w = layer.weight
+        if wt_scale is not None and wt_scale.ndim >= 1 and \
+                wt_scale.size > 1:
+            shape = [1] * w.ndim
+            shape[-1] = -1
+            import paddle_tpu as paddle
+            wt_scale = paddle.reshape(wt_scale, shape)
+        self.weight = fake_quant_dequant(w.detach(), wt_scale, bits) \
+            if wt_scale is not None else w
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self._act_scale is not None:
+            x = fake_quant_dequant(x, self._act_scale, self._bits)
+        return F.linear(x, self.weight, self.bias)
+
+
+def _register_default_mappings():
+    from ..nn.layer.common import Linear
+    from .config import DEFAULT_QAT_LAYER_MAPPINGS
+    DEFAULT_QAT_LAYER_MAPPINGS[Linear] = QuantedLinear
+    try:
+        from ..nn.layer.conv import Conv2D
+        DEFAULT_QAT_LAYER_MAPPINGS[Conv2D] = QuantedConv2D
+    except ImportError:
+        pass
+
+
+_register_default_mappings()

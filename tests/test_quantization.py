@@ -1,0 +1,148 @@
+"""Quantization subsystem: PTQ observers/convert, QAT fake-quant/STE.
+
+Reference test model: test/quantization/test_ptq.py, test_qat.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (
+    AbsmaxObserver, ConvertedQuantedLinear, FakeQuanterChannelWiseAbsMax,
+    FakeQuanterWithAbsMaxObserver, MovingAverageAbsmaxObserver,
+    ObserveWrapper, PerChannelAbsmaxObserver, PTQ, QAT, QuantConfig,
+    QuantedLinear, QuanterFactory, fake_quant_dequant)
+
+
+def _model():
+    return paddle.nn.Sequential(
+        paddle.nn.Flatten(),
+        paddle.nn.Linear(16, 32),
+        paddle.nn.ReLU(),
+        paddle.nn.Linear(32, 4),
+    )
+
+
+def test_fake_quant_dequant_roundtrip():
+    x = paddle.to_tensor(np.linspace(-1, 1, 255, dtype="float32"))
+    scale = paddle.to_tensor(1.0 / 127.0)
+    dq = fake_quant_dequant(x, scale, 8)
+    # quantization error bounded by scale/2
+    assert float(paddle.max(paddle.abs(dq - x))) <= 0.5 / 127 + 1e-6
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor([0.3, -0.7], stop_gradient=False)
+    scale = paddle.to_tensor(1.0 / 127.0)
+    y = fake_quant_dequant(x, scale, 8)
+    y.sum().backward()
+    # straight-through: gradient is identity
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(2), rtol=1e-6)
+
+
+def test_absmax_observer():
+    obs = AbsmaxObserver()
+    obs(paddle.to_tensor([1.0, -3.0]))
+    obs(paddle.to_tensor([2.0, 0.5]))
+    assert abs(float(obs.scales()) - 3.0 / 127) < 1e-6
+
+
+def test_moving_average_observer():
+    obs = MovingAverageAbsmaxObserver(moving_rate=0.5)
+    obs(paddle.to_tensor([4.0]))
+    obs(paddle.to_tensor([2.0]))
+    assert abs(float(obs.scales()) - 3.0 / 127) < 1e-6
+
+
+def test_per_channel_observer():
+    obs = PerChannelAbsmaxObserver(quant_axis=1)
+    w = paddle.to_tensor(np.array([[1., -2.], [3., 0.5]], dtype="float32"))
+    obs(w)
+    np.testing.assert_allclose(obs.scales().numpy(),
+                               np.array([3., 2.]) / 127, rtol=1e-6)
+
+
+def test_ptq_quantize_observe_convert():
+    net = _model()
+    q_config = QuantConfig(activation=AbsmaxObserver.partial(),
+                           weight=AbsmaxObserver.partial())
+    ptq = PTQ(q_config)
+    quant_model = ptq.quantize(net)
+    # both Linears wrapped
+    wrapped = [l for _, l in quant_model.named_sublayers()
+               if isinstance(l, ObserveWrapper)]
+    assert len(wrapped) == 2
+    # original model untouched (inplace=False)
+    assert not any(isinstance(l, ObserveWrapper)
+                   for _, l in net.named_sublayers())
+
+    # calibrate
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8, 16).astype("float32"))
+    y_float = net(x)
+    for _ in range(3):
+        quant_model(x)
+
+    converted = ptq.convert(quant_model)
+    conv_layers = [l for _, l in converted.named_sublayers()
+                   if isinstance(l, ConvertedQuantedLinear)]
+    assert len(conv_layers) == 2
+    y_q = converted(x)
+    # int8 simulation stays close to float
+    err = float(paddle.max(paddle.abs(y_q - y_float)))
+    ref = float(paddle.max(paddle.abs(y_float)))
+    assert err < 0.1 * max(ref, 1.0)
+
+
+def test_qat_quantize_and_train_step():
+    net = _model()
+    q_config = QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver.partial(),
+        weight=FakeQuanterChannelWiseAbsMax.partial())
+    qat = QAT(q_config)
+    qmodel = qat.quantize(net, inplace=False)
+    qlayers = [l for _, l in qmodel.named_sublayers()
+               if isinstance(l, QuantedLinear)]
+    assert len(qlayers) == 2
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=qmodel.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8, 16).astype("float32"))
+    label = paddle.to_tensor(np.arange(8, dtype="int64") % 4)
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(5):
+        loss = loss_fn(qmodel(x), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # STE gradients actually train
+
+    converted = qat.convert(qmodel)
+    y = converted(x)
+    assert y.shape == [8, 4]
+
+
+def test_quant_config_priority():
+    net = _model()
+    lin0 = net[1]
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(paddle.nn.Linear,
+                        activation=AbsmaxObserver.partial())
+    cfg.add_layer_config(lin0, weight=AbsmaxObserver.partial())
+    c0 = cfg._get_config_by_layer("1", lin0)
+    assert c0.weight is not None and c0.activation is None  # layer wins
+    c1 = cfg._get_config_by_layer("3", net[3])
+    assert c1.activation is not None  # type rule applies
+
+
+def test_ptq_selective_by_name():
+    net = _model()
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_name_config("1", activation=AbsmaxObserver.partial())
+    quant_model = PTQ(cfg).quantize(net)
+    wrapped = [n for n, l in quant_model.named_sublayers()
+               if isinstance(l, ObserveWrapper)]
+    assert len(wrapped) == 1
