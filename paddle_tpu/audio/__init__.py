@@ -1,0 +1,28 @@
+"""paddle.audio (reference: python/paddle/audio/__init__.py).
+
+``features`` — Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers.
+``functional`` — window functions, mel filterbanks, dB conversion, DCT.
+Backends (soundfile IO) are gated: this environment has no audio IO
+libraries, so ``load``/``save`` raise with instructions.
+"""
+
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram)
+
+__all__ = ["features", "functional", "backends", "load", "save",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def load(*args, **kwargs):
+    raise RuntimeError(
+        "paddle_tpu.audio.load requires an audio IO backend (soundfile) "
+        "which is not bundled; decode to a numpy array externally and "
+        "feed it to the feature layers directly")
+
+
+def save(*args, **kwargs):
+    raise RuntimeError(
+        "paddle_tpu.audio.save requires an audio IO backend (soundfile) "
+        "which is not bundled")

@@ -1,0 +1,146 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py).
+
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC as nn.Layers.  The
+STFT is static-shape framing + ``jnp.fft.rfft`` (XLA FFT on device);
+the mel projection is a single matmul.  All layers are differentiable
+(the whole chain is jnp math through the op-dispatch tape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_mag(x, n_fft, hop_length, win, center, pad_mode, power):
+    """x [..., T] -> [..., n_fft//2+1, frames] magnitude**power."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    frames = 1 + (T - n_fft) // hop_length
+    idx = (np.arange(frames)[:, None] * hop_length +
+           np.arange(n_fft)[None, :])                 # [frames, n_fft]
+    segs = x[..., idx]                                # [..., frames, n_fft]
+    segs = segs * jnp.asarray(win, segs.dtype)
+    spec = jnp.fft.rfft(segs, n=n_fft, axis=-1)       # [..., frames, bins]
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)                  # [..., bins, frames]
+
+
+class Spectrogram(Layer):
+    """Reference features/layers.py:24."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        w = get_window(window, self.win_length, fftbins=True, dtype=dtype)
+        if self.win_length < n_fft:   # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = np.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.fft_window = w
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        return apply(
+            "spectrogram",
+            lambda a: _stft_mag(a, self.n_fft, self.hop_length,
+                                self.fft_window, self.center,
+                                self.pad_mode, self.power), x)
+
+
+class MelSpectrogram(Layer):
+    """Reference features/layers.py:106."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)   # [n_mels, bins]
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        fb = self.fbank_matrix
+        return apply(
+            "mel_project",
+            lambda s: jnp.einsum("mb,...bt->...mt",
+                                 jnp.asarray(fb, s.dtype), s), spec)
+
+
+class LogMelSpectrogram(Layer):
+    """Reference features/layers.py:206."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Reference features/layers.py:309."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)
+        dct = self.dct_matrix
+        return apply(
+            "mfcc_dct",
+            lambda m: jnp.einsum("mk,...mt->...kt",
+                                 jnp.asarray(dct, m.dtype), m), mel)

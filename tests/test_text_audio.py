@@ -1,0 +1,187 @@
+"""paddle.text + paddle.audio subsystems.
+
+Reference test models: test/legacy_test/test_viterbi_decode_op.py,
+python/paddle/audio tests (test/legacy_test/test_audio_functions.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio.functional import (
+    compute_fbank_matrix, create_dct, fft_frequencies, get_window,
+    hz_to_mel, mel_frequencies, mel_to_hz, power_to_db)
+from paddle_tpu.audio import MFCC, LogMelSpectrogram, MelSpectrogram, \
+    Spectrogram
+from paddle_tpu.text import (Imdb, Imikolov, Movielens, UCIHousing,
+                             ViterbiDecoder, viterbi_decode)
+
+
+# -- viterbi ---------------------------------------------------------------
+def _brute_force_viterbi(pot, trans, length, bos_eos):
+    """Enumerate all tag paths for one sequence (small N/T only)."""
+    import itertools
+    T, N = pot.shape
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(N), repeat=length):
+        s = pot[0, path[0]] + (trans[-1, path[0]] if bos_eos else 0.0)
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if bos_eos:
+            s += trans[path[length - 1], -2]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N, N).astype("float32")
+    lengths = np.array([5, 3, 4], dtype="int64")
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+    for b in range(B):
+        ref_s, ref_p = _brute_force_viterbi(pot[b], trans,
+                                            int(lengths[b]), bos_eos)
+        assert abs(float(scores.numpy()[b]) - ref_s) < 1e-4
+        got = paths.numpy()[b][:int(lengths[b])].tolist()
+        assert got == ref_p, (b, got, ref_p)
+        # padding is zeroed
+        assert all(v == 0 for v in paths.numpy()[b][int(lengths[b]):])
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(1)
+    trans = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+    dec = ViterbiDecoder(trans)
+    pot = paddle.to_tensor(rng.randn(2, 6, 4).astype("float32"))
+    lengths = paddle.to_tensor(np.array([6, 6], dtype="int64"))
+    scores, paths = dec(pot, lengths)
+    assert scores.shape == [2] and paths.shape == [2, 6]
+
+
+# -- text datasets ---------------------------------------------------------
+def test_imdb_synthetic():
+    ds = Imdb(mode="train")
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label.shape == (1,)
+    assert len(ds) > 0 and "<unk>" in ds.word_idx
+
+
+def test_imikolov_ngram():
+    ds = Imikolov(mode="train", window_size=5)
+    item = ds[0]
+    assert len(item) == 5
+    assert all(x.dtype == np.int64 for x in item)
+
+
+def test_ucihousing_shapes_and_normalization():
+    tr = UCIHousing(mode="train")
+    te = UCIHousing(mode="test")
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(tr) + len(te) == 506
+    allx = np.stack([tr[i][0] for i in range(len(tr))])
+    assert np.abs(allx).max() <= 1.0 + 1e-5  # normalized
+
+
+def test_movielens_fields():
+    ds = Movielens(mode="train")
+    fields = ds[0]
+    assert len(fields) == 8
+    assert fields[-1].dtype == np.float32
+
+
+def test_download_rejected():
+    with pytest.raises(RuntimeError, match="download"):
+        Imdb(download=True)
+
+
+# -- audio functional ------------------------------------------------------
+def test_hz_mel_roundtrip():
+    for htk in (False, True):
+        f = np.array([100.0, 440.0, 1000.0, 4000.0])
+        back = mel_to_hz(hz_to_mel(f, htk), htk)
+        np.testing.assert_allclose(back, f, rtol=1e-4)
+
+
+def test_mel_frequencies_monotone():
+    freqs = mel_frequencies(n_mels=40, f_min=0.0, f_max=8000.0)
+    assert freqs.shape == (40,)
+    assert np.all(np.diff(freqs) > 0)
+    assert abs(freqs[-1] - 8000.0) < 1.0
+
+
+def test_fft_frequencies():
+    f = fft_frequencies(sr=16000, n_fft=512)
+    assert f.shape == (257,) and f[0] == 0 and abs(f[-1] - 8000) < 1e-3
+
+
+def test_fbank_matrix_properties():
+    fb = compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert np.all(fb >= 0)
+    assert np.all(fb.sum(axis=1) > 0)  # every filter non-empty
+
+
+def test_power_to_db():
+    x = np.array([1.0, 10.0, 100.0], dtype="float32")
+    db = power_to_db(x, top_db=None)
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+    t = power_to_db(paddle.to_tensor(x), top_db=None)
+    np.testing.assert_allclose(t.numpy(), [0.0, 10.0, 20.0], atol=1e-4)
+
+
+def test_windows():
+    for w in ("hann", "hamming", "blackman", "bartlett", "triang",
+              "bohman", "gaussian", "kaiser"):
+        win = get_window(w, 64)
+        assert win.shape == (64,)
+        assert np.all(win <= 1.0 + 1e-6) and np.all(win >= -1e-6)
+
+
+def test_create_dct_orthonormal():
+    d = create_dct(n_mfcc=13, n_mels=40)
+    assert d.shape == (40, 13)
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-4)
+
+
+# -- audio feature layers --------------------------------------------------
+def _sine(sr=16000, dur=0.3, f=440.0):
+    t = np.arange(int(sr * dur)) / sr
+    return np.sin(2 * np.pi * f * t).astype("float32")
+
+
+def test_spectrogram_peak_at_tone():
+    sr, f0 = 16000, 1000.0
+    spec_layer = Spectrogram(n_fft=512, hop_length=256)
+    x = paddle.to_tensor(_sine(sr=sr, f=f0)[None, :])
+    spec = spec_layer(x)
+    assert spec.shape[1] == 257
+    peak_bin = int(np.argmax(spec.numpy()[0].mean(axis=1)))
+    expected = int(round(f0 * 512 / sr))
+    assert abs(peak_bin - expected) <= 1
+
+
+def test_mel_and_logmel_and_mfcc_shapes():
+    x = paddle.to_tensor(_sine()[None, :])
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40, f_min=50.0)(x)
+    assert mel.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40,
+                               f_min=50.0)(x)
+    assert logmel.shape == mel.shape
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40, f_min=50.0)(x)
+    assert mfcc.shape[1] == 13
+
+
+def test_spectrogram_differentiable():
+    x = paddle.to_tensor(_sine()[None, :], stop_gradient=False)
+    spec = Spectrogram(n_fft=256, hop_length=128)(x)
+    spec.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
