@@ -1,0 +1,365 @@
+"""paddle.inference — the deployment/serving engine.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:104
+(AnalysisPredictor), paddle_inference_api.h:53 (Predictor, Config,
+create_predictor), python/paddle/inference/wrapper.py.
+
+TPU-native architecture: the reference's inference program format
+(__model__ + params, IR passes, engine subgraphs) maps onto **StableHLO
+AOT export**.  ``convert_to_export`` traces a Layer once per input
+signature with ``jax.export`` and serializes the compiler-ready artifact
+(portable across processes/hosts, loadable without the Python model
+class); ``Predictor`` loads either such an artifact or a
+``paddle.jit.save`` model directory, compiles on first run, and serves
+through the reference's handle-based API (get_input_handle /
+copy_from_cpu / run / copy_to_cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
+           "create_predictor", "convert_to_export", "get_version",
+           "PlaceType", "DataType"]
+
+
+def get_version() -> str:
+    import paddle_tpu
+    return paddle_tpu.__version__
+
+
+class PlaceType:
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kTPU = 4
+
+
+class DataType:
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class Config:
+    """Reference: paddle_inference_api.h Config / analysis_config.h.
+
+    Device/IR toggles that have no TPU meaning are accepted and recorded
+    (the XLA pipeline is always-on optimization), so reference deploy
+    scripts run unchanged."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and params_file is None and \
+                os.path.isdir(prog_file):
+            self._model_dir = prog_file
+            self._prog_file = None
+            self._params_file = None
+        else:
+            self._model_dir = None
+            self._prog_file = prog_file
+            self._params_file = params_file
+        self._use_device = "tpu"
+        self._memory_optim = True
+        self._ir_optim = True
+        self._profile = False
+        self._num_threads = 1
+        self._exported = None  # path to a .stablehlo artifact
+
+    # -- model paths ------------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        if params_file is None and os.path.isdir(prog_file):
+            self._model_dir = prog_file
+        else:
+            self._prog_file = prog_file
+            self._params_file = params_file
+
+    def set_prog_file(self, path):
+        self._prog_file = path
+
+    def set_params_file(self, path):
+        self._params_file = path
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    # -- device / optimization toggles (recorded; XLA governs reality) ---
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_device = "gpu-compat"
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def enable_xpu(self, *a, **kw):
+        self._use_device = "xpu-compat"
+
+    def enable_custom_device(self, device_type="tpu", device_id=0):
+        self._use_device = device_type
+
+    def use_gpu(self):
+        return self._use_device == "gpu-compat"
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._num_threads = n
+
+    def enable_profile(self):
+        self._profile = True
+
+    def summary(self) -> str:
+        return json.dumps({
+            "model_dir": self._model_dir, "prog_file": self._prog_file,
+            "params_file": self._params_file, "device": self._use_device,
+            "ir_optim": self._ir_optim,
+            "memory_optim": self._memory_optim})
+
+
+class Tensor:
+    """Handle-style IO tensor (reference: paddle_tensor.h ZeroCopyTensor):
+    ``copy_from_cpu(np)`` stages input, ``copy_to_cpu()`` fetches."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._value = None
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+def convert_to_export(layer_or_fn, input_spec: Sequence, path: str,
+                      platforms: Optional[Sequence[str]] = None) -> str:
+    """AOT-export to a serialized StableHLO artifact + weights.
+
+    ``input_spec``: list of (shape, dtype) tuples or ShapeDtypeStructs.
+    The artifact loads WITHOUT the Python model class — the TPU-native
+    analog of the reference's __model__ program file."""
+    import jax
+    from jax import export as jexport
+    import jax.numpy as jnp
+
+    from ..nn.layer.layers import Layer
+    from ..tensor.tensor import Tensor as PTensor
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, jax.ShapeDtypeStruct):
+            specs.append(s)
+        else:
+            shape, dtype = s
+            specs.append(jax.ShapeDtypeStruct(tuple(shape),
+                                              jnp.dtype(dtype)))
+
+    kw = {}
+    if platforms is not None:
+        kw["platforms"] = tuple(platforms)
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        was_training = layer.training
+        layer.eval()
+        state = {
+            "params": {k: np.asarray(v.numpy())
+                       for k, v in layer.named_parameters()},
+            "buffers": {k: np.asarray(v.numpy())
+                        for k, v in layer.named_buffers()},
+        }
+
+        def fn(st, *xs):
+            outs = layer._functional_call(
+                st["params"], *[PTensor(x) for x in xs],
+                buffers=st["buffers"])
+            if isinstance(outs, (list, tuple)):
+                return [o._data for o in outs]
+            return [outs._data]
+
+        state_specs = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), state)
+        try:
+            exp = jexport.export(jax.jit(fn), **kw)(state_specs, *specs)
+        finally:
+            if was_training:
+                layer.train()
+        params_blob = pickle.dumps(state)
+    else:
+        def fn(*xs):
+            out = layer_or_fn(*xs)
+            return list(out) if isinstance(out, (list, tuple)) else [out]
+        exp = jexport.export(jax.jit(fn), **kw)(*specs)
+        params_blob = pickle.dumps({})
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exp.serialize())
+    # NOT .pdiparams: that name/format belongs to paddle.jit.save via
+    # framework.io; the AOT weight blob is a raw pickle
+    with open(path + ".stablehlo.params", "wb") as f:
+        f.write(params_blob)
+    with open(path + ".meta.json", "w") as f:
+        json.dump({"n_inputs": len(specs),
+                   "n_outputs": len(exp.out_avals),
+                   "input_shapes": [list(s.shape) for s in specs],
+                   "input_dtypes": [str(s.dtype) for s in specs]}, f)
+    return path + ".stablehlo"
+
+
+class Predictor:
+    """Reference: analysis_predictor.h:104.  Serves either a StableHLO
+    export (``Config(prog_file='x.stablehlo')``) or a paddle.jit.save
+    model path; compiles on first run and caches per input signature."""
+
+    def __init__(self, config: Config, _shared_from=None):
+        self._config = config
+        self._exp = None          # jax.export.Exported
+        self._state = None
+        self._layer = None
+        self._inputs: Dict[str, Tensor] = {}
+        self._outputs: List[np.ndarray] = []
+        self._n_inputs = 1
+        self._n_outputs = None
+        if _shared_from is not None:
+            # PredictorPool: share the loaded program + weights
+            self._exp = _shared_from._exp
+            self._state = _shared_from._state
+            self._layer = _shared_from._layer
+            self._n_inputs = _shared_from._n_inputs
+            self._n_outputs = _shared_from._n_outputs
+        else:
+            self._load()
+
+    def _load(self):
+        from jax import export as jexport
+        prog = self._config.prog_file()
+        if prog and prog.endswith(".stablehlo"):
+            with open(prog, "rb") as f:
+                self._exp = jexport.deserialize(f.read())
+            base = prog[:-len(".stablehlo")]
+            params = self._config.params_file() or \
+                prog + ".params"
+            with open(params, "rb") as f:
+                self._state = pickle.loads(f.read())
+            meta_path = base + ".meta.json"
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                self._n_inputs = meta["n_inputs"]
+                self._n_outputs = meta.get("n_outputs")
+            return
+        # fall back to a paddle.jit.save bundle
+        base = prog
+        if base and base.endswith(".pdmodel"):
+            base = base[:-len(".pdmodel")]
+        if base is None and self._config.model_dir():
+            base = os.path.join(self._config.model_dir(), "inference")
+        from .. import jit as pjit
+        self._layer = pjit.load(base)
+        self._layer.eval()
+
+    # -- reference handle API --------------------------------------------
+    def get_input_names(self):
+        return [f"x{i}" for i in range(self._n_inputs)]
+
+    def get_input_handle(self, name) -> Tensor:
+        return self._inputs.setdefault(name, Tensor(name))
+
+    def get_output_names(self):
+        n = self._n_outputs if self._n_outputs is not None else \
+            (len(self._outputs) or 1)
+        return [f"out{i}" for i in range(n)]
+
+    def get_output_handle(self, name) -> Tensor:
+        t = Tensor(name)
+        idx = int(name.replace("out", "") or 0)
+        if idx < len(self._outputs):
+            t._value = self._outputs[idx]
+        return t
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """With ``inputs``: functional form, returns list of np arrays
+        (reference Predictor::Run zero-copy form).  Without: consumes the
+        staged input handles."""
+        functional = inputs is not None
+        if inputs is None:
+            # numeric order: sorted() would put x10 before x2
+            names = sorted(self._inputs,
+                           key=lambda n: int(n.lstrip("x") or 0)
+                           if n.lstrip("x").isdigit() else n)
+            inputs = [self._inputs[n].copy_to_cpu() for n in names]
+        outs = self._execute(inputs)
+        self._outputs = [np.asarray(o) for o in outs]
+        return self._outputs if functional else None
+
+    def _execute(self, inputs):
+        if self._exp is not None:
+            if self._n_inputs != len(inputs):
+                raise ValueError(
+                    f"predictor expects {self._n_inputs} inputs, got "
+                    f"{len(inputs)}")
+            if self._state:
+                return self._exp.call(self._state, *inputs)
+            return self._exp.call(*inputs)
+        from ..tensor.tensor import Tensor as PTensor
+        import paddle_tpu as paddle
+        with paddle.no_grad():
+            out = self._layer(*[paddle.to_tensor(x) for x in inputs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.numpy() for o in outs]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+class PredictorPool:
+    """Reference: paddle_inference_api.h:253 — a pool of predictors
+    sharing one loaded program (XLA executables are thread-safe, so the
+    pool shares a single Predictor's compiled artifacts)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._predictors = [first] + [
+            Predictor(config, _shared_from=first)
+            for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

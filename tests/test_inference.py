@@ -1,0 +1,156 @@
+"""Inference engine: StableHLO AOT export + Predictor serving API.
+
+Reference model: paddle/fluid/inference (AnalysisPredictor), exercised
+via Config/create_predictor/handles as reference deploy scripts do.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (Config, Predictor, PredictorPool,
+                                  convert_to_export, create_predictor,
+                                  get_version)
+
+
+def _net():
+    paddle.seed(7)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 3))
+
+
+def test_export_and_predict(tmp_path):
+    net = _net()
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "model")
+    artifact = convert_to_export(net, [((4, 8), "float32")], path)
+    assert artifact.endswith(".stablehlo")
+
+    cfg = Config(prog_file=artifact)
+    pred = create_predictor(cfg)
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_export_loads_without_model_class(tmp_path):
+    """The artifact must be servable with no access to the Layer —
+    the whole point of AOT export."""
+    net = _net()
+    x = np.random.RandomState(1).randn(2, 8).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "m2")
+    convert_to_export(net, [((2, 8), "float32")], path)
+    del net
+
+    pred = Predictor(Config(prog_file=path + ".stablehlo"))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_handle_api(tmp_path):
+    net = _net()
+    x = np.random.RandomState(2).randn(4, 8).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "m3")
+    convert_to_export(net, [((4, 8), "float32")], path)
+
+    pred = Predictor(Config(prog_file=path + ".stablehlo"))
+    names = pred.get_input_names()
+    assert names == ["x0"]
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_plain_function_export(tmp_path):
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return a @ b
+
+    path = str(tmp_path / "fn")
+    convert_to_export(f, [((2, 3), "float32"), ((3, 4), "float32")], path)
+    pred = Predictor(Config(prog_file=path + ".stablehlo"))
+    a = np.ones((2, 3), "float32")
+    b = np.ones((3, 4), "float32")
+    outs = pred.run([a, b])
+    np.testing.assert_allclose(outs[0], a @ b)
+
+
+def test_jit_save_fallback(tmp_path):
+    """Predictor also serves paddle.jit.save bundles (the non-AOT path)."""
+    net = _net()
+    x = np.random.RandomState(3).randn(4, 8).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+    base = str(tmp_path / "jitmodel")
+    paddle.jit.save(net, base)
+    pred = Predictor(Config(prog_file=base + ".pdmodel"))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_pool_and_config_summary(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m4")
+    convert_to_export(net, [((4, 8), "float32")], path)
+    cfg = Config(prog_file=path + ".stablehlo")
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    pool = PredictorPool(cfg, size=2)
+    x = np.zeros((4, 8), "float32")
+    o0 = pool.retrieve(0).run([x])[0]
+    o1 = pool.retrieve(1).run([x])[0]
+    np.testing.assert_allclose(o0, o1)
+    assert "ir_optim" in cfg.summary()
+    assert get_version()
+
+
+def test_export_does_not_corrupt_layer(tmp_path):
+    """_functional_call must restore real weights after tracing."""
+    net = _net()
+    x = paddle.to_tensor(np.ones((4, 8), "float32"))
+    before = net(x).numpy()
+    convert_to_export(net, [((4, 8), "float32")], str(tmp_path / "m5"))
+    after = net(x).numpy()
+    np.testing.assert_allclose(before, after)
+
+
+def test_functional_run_after_handle_creation(tmp_path):
+    """Creating a handle must not break the functional run() form."""
+    net = _net()
+    x = np.random.RandomState(4).randn(4, 8).astype("float32")
+    path = str(tmp_path / "m6")
+    convert_to_export(net, [((4, 8), "float32")], path)
+    pred = Predictor(Config(prog_file=path + ".stablehlo"))
+    pred.get_input_handle("x0")          # inspect-only
+    outs = pred.run([x])                 # functional form still returns
+    assert outs is not None and outs[0].shape == (4, 3)
+
+
+def test_output_names_before_run(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m7")
+    convert_to_export(net, [((4, 8), "float32")], path)
+    pred = Predictor(Config(prog_file=path + ".stablehlo"))
+    assert pred.get_output_names() == ["out0"]  # known pre-run from meta
+
+
+def test_wrong_arity_raises(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m8")
+    convert_to_export(net, [((4, 8), "float32")], path)
+    pred = Predictor(Config(prog_file=path + ".stablehlo"))
+    with pytest.raises(ValueError, match="expects 1 inputs"):
+        pred.run([np.zeros((4, 8), "float32"),
+                  np.zeros((4, 8), "float32")])
+
+
+def test_export_restores_training_mode(tmp_path):
+    net = _net()
+    net.train()
+    convert_to_export(net, [((4, 8), "float32")], str(tmp_path / "m9"))
+    assert net.training
