@@ -310,6 +310,17 @@ def default_collate_fn(batch: List[Any]):
     return batch
 
 
+def _tree_to_tensor(batch):
+    """numpy batch structure -> Tensor structure (host->device)."""
+    if isinstance(batch, (np.ndarray, np.generic)):
+        return to_tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_tree_to_tensor(b) for b in batch]
+    if isinstance(batch, dict):
+        return {k: _tree_to_tensor(v) for k, v in batch.items()}
+    return batch
+
+
 class DataLoader:
     """Reference: io/reader.py:216."""
 
@@ -323,6 +334,16 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        if persistent_workers:
+            import warnings
+            warnings.warn(
+                "persistent_workers=True is accepted but workers are "
+                "(re)spawned per epoch in this implementation",
+                stacklevel=2)
         self.is_iterable_ds = isinstance(dataset, IterableDataset)
         if self.is_iterable_ds:
             self.batch_sampler = None
@@ -369,10 +390,29 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
-        # thread-pool prefetch pipeline
         if self.is_iterable_ds:
             yield from self._iter_batches()
             return
+        if self.use_shared_memory and self.batch_sampler is not None:
+            # multiprocess workers (reference dataloader_iter.py:365):
+            # workers collate to numpy; the parent does the host->device
+            # transfer, which doubles as async device prefetch
+            from .worker import MultiprocessBatchIterator, np_collate
+            worker_collate = self.collate_fn \
+                if self.collate_fn is not default_collate_fn else np_collate
+            it = MultiprocessBatchIterator(
+                self.dataset, list(self.batch_sampler),
+                collate_fn=worker_collate,
+                num_workers=self.num_workers,
+                prefetch_factor=self.prefetch_factor,
+                worker_init_fn=self.worker_init_fn,
+                timeout=self.timeout, to_device=_tree_to_tensor)
+            try:
+                yield from it
+            finally:
+                it.shutdown()
+            return
+        # thread-pool prefetch pipeline (use_shared_memory=False path)
         pool = ThreadPoolExecutor(max_workers=self.num_workers)
         try:
             sampler_iter = iter(self.batch_sampler)

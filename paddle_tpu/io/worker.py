@@ -1,0 +1,191 @@
+"""Multiprocess DataLoader workers.
+
+Reference behavior: io/dataloader/dataloader_iter.py:365
+(_DataLoaderIterMultiProcess) + worker.py — worker subprocesses pull
+index batches from per-worker queues, collate, and push result batches
+through a shared data queue; the parent reorders and (TPU-native twist)
+performs the host->device transfer itself, so device state never crosses
+a process boundary.  The transfer doubles as device prefetch: jax
+dispatch is async, so converting batch N+1 while batch N is being
+consumed overlaps H2D with compute (the role of the reference's
+buffered reader / pin-memory thread).
+
+Workers run pure-Python dataset code only — no jax — which keeps fork()
+safe even with an initialized backend in the parent.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import traceback
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["np_collate", "MultiprocessBatchIterator"]
+
+
+def np_collate(batch: List[Any]):
+    """default_collate that stays in numpy (picklable, no device)."""
+    sample = batch[0]
+    if hasattr(sample, "numpy") and not isinstance(sample, np.ndarray):
+        # framework Tensor leaked into a worker: convert to host numpy
+        # before pickling (device handles must not cross processes)
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [np_collate(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: np_collate([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.msg = "".join(traceback.format_exception(exc))
+
+
+def _to_numpy_tree(x):
+    """Strip any framework Tensors a custom collate_fn produced."""
+    if hasattr(x, "numpy") and not isinstance(x, (np.ndarray, np.generic)):
+        return np.asarray(x.numpy())
+    if isinstance(x, (list, tuple)):
+        return [_to_numpy_tree(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _to_numpy_tree(v) for k, v in x.items()}
+    return x
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn,
+                 worker_init_fn, worker_id, num_workers, base_seed):
+    """Reference: dataloader/worker.py _worker_loop."""
+    np.random.seed((base_seed + worker_id) % (2 ** 32))
+    try:
+        import paddle_tpu.io as _io  # set get_worker_info() state
+        _io._worker_info = _io._WorkerInfo(
+            id=worker_id, num_workers=num_workers, dataset=dataset)
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+    except Exception as e:  # noqa: BLE001
+        data_queue.put((-1, _WorkerError(e)))
+        return
+    while True:
+        try:
+            job = index_queue.get()
+        except (EOFError, KeyboardInterrupt):
+            return
+        if job is None:  # shutdown sentinel
+            return
+        batch_idx, idx_batch = job
+        try:
+            samples = [dataset[i] for i in idx_batch]
+            data_queue.put(
+                (batch_idx, _to_numpy_tree(collate_fn(samples))))
+        except Exception as e:  # noqa: BLE001
+            data_queue.put((batch_idx, _WorkerError(e)))
+
+
+class MultiprocessBatchIterator:
+    """Iterates collated numpy batches produced by worker processes, in
+    submission order.  ``to_device`` (applied in the parent) converts
+    each batch as soon as it is reordered — async H2D prefetch."""
+
+    def __init__(self, dataset, batch_indices, collate_fn=None,
+                 num_workers: int = 2, prefetch_factor: int = 2,
+                 worker_init_fn: Optional[Callable] = None,
+                 timeout: float = 0,
+                 to_device: Optional[Callable] = None,
+                 mp_context: Optional[str] = None):
+        self._batches = list(batch_indices)
+        self._collate = collate_fn or np_collate
+        self._timeout = timeout or None
+        self._to_device = to_device or (lambda x: x)
+        ctx = mp.get_context(
+            mp_context or os.environ.get("PADDLE_TPU_MP_CONTEXT", "fork"))
+        self._num_workers = max(1, num_workers)
+        self._data_queue = ctx.Queue()
+        self._index_queues = []
+        self._procs = []
+        base_seed = int.from_bytes(os.urandom(4), "little")
+        for wid in range(self._num_workers):
+            iq = ctx.Queue()
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, iq, self._data_queue, self._collate,
+                      worker_init_fn, wid, self._num_workers, base_seed),
+                daemon=True)
+            p.start()
+            self._index_queues.append(iq)
+            self._procs.append(p)
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._reorder = {}
+        depth = self._num_workers * max(prefetch_factor, 2)
+        for _ in range(min(depth, len(self._batches))):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._send_idx < len(self._batches):
+            wid = self._send_idx % self._num_workers
+            self._index_queues[wid].put(
+                (self._send_idx, self._batches[self._send_idx]))
+            self._send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._rcvd_idx >= len(self._batches):
+            self.shutdown()
+            raise StopIteration
+        waited = 0.0
+        while self._rcvd_idx not in self._reorder:
+            try:
+                idx, payload = self._data_queue.get(timeout=5.0)
+            except queue_mod.Empty:
+                waited += 5.0
+                dead = [p for p in self._procs if not p.is_alive()]
+                if dead:
+                    self.shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker exited abnormally (exit "
+                        f"codes {[p.exitcode for p in dead]})") from None
+                if self._timeout and waited >= self._timeout:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after "
+                        f"{self._timeout}s") from None
+                continue
+            if isinstance(payload, _WorkerError):
+                self.shutdown()
+                raise RuntimeError(
+                    "DataLoader worker raised:\n" + payload.msg)
+            self._reorder[idx] = payload
+        batch = self._reorder.pop(self._rcvd_idx)
+        self._rcvd_idx += 1
+        self._dispatch()
+        return self._to_device(batch)
+
+    def shutdown(self):
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for p in self._procs:
+            p.join(timeout=1.0)
+            if p.is_alive():
+                p.terminate()
+        self._procs = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,141 @@
+"""Multiprocess DataLoader workers (reference: dataloader_iter.py:365).
+
+Order preservation, worker_init_fn, error propagation, custom collate,
+and parity with the single-process path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.worker import MultiprocessBatchIterator, np_collate
+
+
+class RangeDS(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((4,), i, dtype="float32"),
+                np.array([i % 10], dtype="int64"))
+
+
+class FailingDS(RangeDS):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("poison sample")
+        return super().__getitem__(i)
+
+
+def test_np_collate_structure():
+    batch = [(np.zeros(3), {"a": 1}), (np.ones(3), {"a": 2})]
+    out = np_collate(batch)
+    assert out[0].shape == (2, 3)
+    assert out[1]["a"].tolist() == [1, 2]
+
+
+def test_multiprocess_matches_single_process():
+    ds = RangeDS(40)
+    dl0 = DataLoader(ds, batch_size=8, num_workers=0)
+    dl2 = DataLoader(ds, batch_size=8, num_workers=2)
+    b0 = [x.numpy() for x, _ in dl0]
+    b2 = [x.numpy() for x, _ in dl2]
+    assert len(b0) == len(b2) == 5
+    for a, b in zip(b0, b2):
+        np.testing.assert_array_equal(a, b)  # order preserved
+
+
+def test_returns_tensors():
+    dl = DataLoader(RangeDS(16), batch_size=4, num_workers=2)
+    x, y = next(iter(dl))
+    assert isinstance(x, paddle.Tensor) and isinstance(y, paddle.Tensor)
+    assert x.shape == [4, 4] and y.shape == [4, 1]
+
+
+def test_worker_error_propagates():
+    dl = DataLoader(FailingDS(32), batch_size=8, num_workers=2)
+    with pytest.raises(RuntimeError, match="poison sample"):
+        list(dl)
+
+
+def test_worker_init_fn_runs():
+    import tempfile, os, glob
+    d = tempfile.mkdtemp()
+
+    def init(worker_id):
+        open(os.path.join(d, f"w{worker_id}"), "w").close()
+
+    dl = DataLoader(RangeDS(16), batch_size=4, num_workers=2,
+                    worker_init_fn=init)
+    list(dl)
+    assert len(glob.glob(os.path.join(d, "w*"))) == 2
+
+
+def test_custom_collate_runs_in_worker():
+    def collate(samples):
+        xs, ys = zip(*samples)
+        return np.stack(xs) * 2.0
+
+    dl = DataLoader(RangeDS(8), batch_size=4, num_workers=2,
+                    collate_fn=collate)
+    batches = list(dl)
+    np.testing.assert_array_equal(
+        batches[0].numpy()[1], np.full(4, 2.0, dtype="float32"))
+
+
+def test_shuffle_with_workers():
+    paddle.seed(3)
+    dl = DataLoader(RangeDS(32), batch_size=8, num_workers=2,
+                    shuffle=True)
+    seen = np.concatenate([x.numpy()[:, 0] for x, _ in dl])
+    assert sorted(seen.tolist()) == list(range(32))
+
+
+def test_use_shared_memory_false_falls_back_to_threads():
+    dl = DataLoader(RangeDS(16), batch_size=4, num_workers=2,
+                    use_shared_memory=False)
+    assert len(list(dl)) == 4
+
+
+def test_direct_iterator_shutdown():
+    ds = RangeDS(16)
+    it = MultiprocessBatchIterator(ds, [[0, 1], [2, 3]], num_workers=2)
+    batches = list(it)
+    assert len(batches) == 2
+    it.shutdown()  # idempotent
+
+
+def test_get_worker_info_in_worker():
+    from paddle_tpu.io import get_worker_info
+
+    class InfoDS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            info = get_worker_info()
+            assert info is not None and info.num_workers == 2
+            return np.array([info.id], dtype="int64")
+
+    dl = DataLoader(InfoDS(), batch_size=4, num_workers=2)
+    ids = np.concatenate([b.numpy() for b in dl]).ravel()
+    assert set(ids.tolist()) <= {0, 1}
+
+
+def test_tensor_samples_with_workers():
+    """Datasets yielding framework Tensors still batch correctly."""
+
+    class TensorDS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return paddle.to_tensor(np.full((3,), i, dtype="float32"))
+
+    dl = DataLoader(TensorDS(), batch_size=4, num_workers=2)
+    b = next(iter(dl))
+    assert isinstance(b, paddle.Tensor) and b.shape == [4, 3]
