@@ -1,0 +1,132 @@
+"""Elastic training manager (reference: fleet/elastic/manager.py:124).
+
+The reference watches an etcd prefix of alive nodes; when the set
+changes within [min, max] replicas it rewrites the trainer endpoints
+and restarts training.  Here the store is the launcher's KV master
+(launch/master.py) — same heartbeat-TTL discipline, no etcd dependency.
+
+States mirror the reference: ElasticStatus HOLD/RESTART/COMPLETED/ERROR
+and ELASTIC_AUTO_PARALLEL_EXIT_CODE-style restart signalling is replaced
+by a callback the launcher wires to pod restart.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ...launch.master import KVClient
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Watches the alive-node set; decides HOLD vs RESTART.
+
+    Args:
+        endpoint: KV master endpoint (host:port).
+        job_id / node_id: identity under /elastic/{job_id}/.
+        np_range: (min, max) replicas.
+        heartbeat_interval / heartbeat_ttl: liveness parameters.
+        on_scale: callback(list_of_alive_node_ids) fired on change.
+    """
+
+    def __init__(self, endpoint: str, job_id: str, node_id: str,
+                 np_range, heartbeat_interval: float = 1.0,
+                 heartbeat_ttl: float = 5.0,
+                 on_scale: Optional[Callable[[List[str]], None]] = None,
+                 server=None):
+        self.client = KVClient(endpoint)
+        self.prefix = f"/elastic/{job_id}"
+        self.node_id = node_id
+        self.np_min, self.np_max = np_range
+        self.interval = heartbeat_interval
+        self.ttl = heartbeat_ttl
+        self.on_scale = on_scale
+        self._server = server      # KVServer for TTL expiry (master only)
+        self._stop = threading.Event()
+        self._threads = []
+        self._alive: List[str] = []
+        self.status = ElasticStatus.HOLD
+
+    # -- liveness ---------------------------------------------------------
+    def register(self):
+        self.client.put(f"{self.prefix}/{self.node_id}", str(time.time()))
+
+    def alive_nodes(self) -> List[str]:
+        peers = self.client.prefix(self.prefix)
+        return sorted(k.rsplit("/", 1)[-1] for k in peers)
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.interval):
+            self.register()
+
+    def _watch_loop(self):
+        while not self._stop.wait(self.interval):
+            if self._server is not None:
+                self._server.expire(self.prefix, self.ttl)
+            alive = self.alive_nodes()
+            if alive != self._alive:
+                prev, self._alive = self._alive, alive
+                self._on_change(prev, alive)
+
+    def _on_change(self, prev: List[str], alive: List[str]):
+        n = len(alive)
+        if n < self.np_min:
+            self.status = ElasticStatus.HOLD   # wait for peers to return
+        elif prev and alive != prev:
+            # membership change, not just count: a same-size node swap
+            # also requires a restart with the new endpoint set
+            self.status = ElasticStatus.RESTART
+            if self.on_scale:
+                self.on_scale(alive)
+        else:
+            self.status = ElasticStatus.HOLD
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self.register()
+        self._alive = self.alive_nodes()
+        for fn in (self._heartbeat_loop, self._watch_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def wait_for_np(self, n: int, timeout: float = 60.0) -> List[str]:
+        """Block until >= n nodes are alive (reference wait_resource)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            alive = self.alive_nodes()
+            if len(alive) >= n:
+                return alive
+            time.sleep(self.interval)
+        raise TimeoutError(
+            f"elastic: waited {timeout}s for {n} nodes, have "
+            f"{len(self.alive_nodes())}")
+
+    def leave(self):
+        # an in-flight heartbeat PUT can land after the DELETE and
+        # resurrect the key; verify and retry until it stays gone
+        key = f"{self.prefix}/{self.node_id}"
+        for _ in range(20):
+            self.client.delete(key)
+            time.sleep(max(self.interval / 2, 0.05))
+            if self.client.get(key) is None:
+                return
+        self.client.delete(key)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._threads = []

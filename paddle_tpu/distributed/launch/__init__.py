@@ -1,1 +1,7 @@
+"""Distributed launcher package (reference: distributed/launch/)."""
+
 from . import main  # noqa: F401
+from .controllers import CollectiveController, Controller  # noqa: F401
+from .job import Container, Job, Pod  # noqa: F401
+from .master import KVClient, KVServer, Master, rendezvous  # noqa: F401
+from .watchdog import Watchdog  # noqa: F401

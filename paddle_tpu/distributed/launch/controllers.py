@@ -1,0 +1,101 @@
+"""Launch controllers (reference: distributed/launch/controllers/
+{controller.py, collective.py, watcher.py}).
+
+``CollectiveController`` builds this host's Pod, deploys it, and runs
+the watch loop: poll container status, restart failed pods up to
+``max_restarts`` (the reference's replicas/restart policy), propagate
+the final exit code.  Failure detection is process-level here;
+in-process collective hangs are covered by ``watchdog.Watchdog``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .job import Job, Pod
+
+__all__ = ["Controller", "CollectiveController"]
+
+
+class Controller:
+    def __init__(self, args):
+        self.args = args
+        self.job = Job(jid=args.job_id, mode=args.run_mode,
+                       nnodes=str(args.nnodes))
+        self.pod = Pod()
+        self.restart_count = 0
+        self.max_restarts = getattr(args, "max_restart", 3)
+
+    # -- hooks ------------------------------------------------------------
+    def build_pod(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def run(self) -> int:
+        self.build_pod()
+        self.pod.deploy()
+        return self.watch()
+
+    def watch(self) -> int:
+        """Reference controller.py watch loop + watcher.py: act on the
+        FIRST failed container — siblings may be blocked in collectives
+        waiting for the dead peer, so is_done() alone would hang."""
+        while True:
+            failed = self.pod.failed_containers()
+            if failed or self.pod.is_done():
+                if not failed:
+                    return 0
+                if self.restart_count < self.max_restarts:
+                    self.restart_count += 1
+                    sys.stderr.write(
+                        f"[launch] container failed (exit "
+                        f"{failed[0].exit_code}); restart "
+                        f"{self.restart_count}/{self.max_restarts}\n")
+                    self.pod.stop(force=True)
+                    self.build_pod()
+                    self.pod.deploy()
+                    continue
+                return failed[0].exit_code or 1
+            time.sleep(0.5)
+
+    def stop(self):
+        self.pod.stop(force=True)
+
+
+class CollectiveController(Controller):
+    """One container driving all local TPU chips; multi-node wires the
+    jax.distributed coordination env (reference collective.py:31)."""
+
+    def build_pod(self):
+        args = self.args
+        self.pod = Pod(name=f"{self.job.id}-pod")
+        self.pod.restart_count = self.restart_count
+        env = {
+            # elastic range sizes the world at MIN: the job must come up
+            # with the minimum quorum; scale-ups restart with more
+            "PADDLE_TRAINERS_NUM": str(self.job.replicas_min),
+            "PADDLE_JOB_ID": self.job.id,
+            "PADDLE_RESTART_COUNT": str(self.restart_count),
+        }
+        nnodes = self.job.replicas_min
+        if nnodes > 1:
+            if not args.master:
+                raise SystemExit(
+                    "--master host:port is required for multi-node")
+            rank = args.rank if args.rank >= 0 else int(
+                os.environ.get("PADDLE_TRAINER_ID", "0"))
+            # distributed/env.py's init_parallel_env reads
+            # PADDLE_MASTER / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID
+            # and feeds them to jax.distributed.initialize
+            env["PADDLE_MASTER"] = args.master
+            env["PADDLE_TRAINER_ID"] = str(rank)
+        else:
+            env["PADDLE_TRAINER_ID"] = "0"
+        out = os.path.join(args.log_dir, f"workerlog.0")
+        self.pod.add_container(
+            [sys.executable, args.training_script,
+             *args.training_script_args],
+            env=env, out=out if getattr(args, "log_to_file", False)
+            else None)

@@ -1,0 +1,140 @@
+"""Job/Pod/Container model (reference: distributed/launch/job/{job.py,
+pod.py, container.py}).
+
+A Job is the whole distributed program; a Pod is this host's set of
+Containers; a Container wraps one worker subprocess with its env, log
+file and exit status.  On TPU one container drives all local chips
+(SPMD), so a pod usually holds a single container.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Job", "Pod", "Container", "ContainerStatus"]
+
+
+class ContainerStatus:
+    INIT = "init"
+    RUNNING = "running"
+    FAILED = "failed"
+    COMPLETED = "completed"
+
+
+class Container:
+    def __init__(self, entrypoint: List[str], env: Optional[Dict] = None,
+                 out: Optional[str] = None):
+        self.entrypoint = list(entrypoint)
+        self.env = dict(env or {})
+        self.out = out
+        self._proc: Optional[subprocess.Popen] = None
+        self._logf = None
+        self.exit_code: Optional[int] = None
+
+    @property
+    def status(self) -> str:
+        if self._proc is None:
+            return ContainerStatus.INIT
+        rc = self._proc.poll()
+        if rc is None:
+            return ContainerStatus.RUNNING
+        self.exit_code = rc
+        return (ContainerStatus.COMPLETED if rc == 0
+                else ContainerStatus.FAILED)
+
+    def start(self):
+        full_env = {**os.environ, **self.env}
+        if self.out:
+            os.makedirs(os.path.dirname(self.out) or ".", exist_ok=True)
+            self._logf = open(self.out, "ab")
+        self._proc = subprocess.Popen(
+            self.entrypoint, env=full_env,
+            stdout=self._logf or None,
+            stderr=subprocess.STDOUT if self._logf else None)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        if self._proc is None:
+            return None
+        try:
+            self.exit_code = self._proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        return self.exit_code
+
+    def terminate(self, force: bool = False):
+        if self._proc is not None and self._proc.poll() is None:
+            (self._proc.kill if force else self._proc.terminate)()
+        if self._logf:
+            self._logf.close()
+            self._logf = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc else None
+
+
+class Pod:
+    """This host's containers (reference job/pod.py)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name or f"pod-{os.getpid()}"
+        self.containers: List[Container] = []
+        self.restart_count = 0
+
+    def add_container(self, entrypoint, env=None, out=None) -> Container:
+        c = Container(entrypoint, env, out)
+        self.containers.append(c)
+        return c
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def join(self) -> int:
+        """Wait for all containers; first nonzero exit wins."""
+        rc = 0
+        for c in self.containers:
+            r = c.wait()
+            if r and not rc:
+                rc = r
+        return rc
+
+    def stop(self, force: bool = False):
+        for c in self.containers:
+            c.terminate(force)
+
+    def failed_containers(self) -> List[Container]:
+        return [c for c in self.containers
+                if c.status == ContainerStatus.FAILED]
+
+    def is_running(self) -> bool:
+        return any(c.status == ContainerStatus.RUNNING
+                   for c in self.containers)
+
+    def is_done(self) -> bool:
+        return all(c.status in (ContainerStatus.COMPLETED,
+                                ContainerStatus.FAILED)
+                   for c in self.containers)
+
+
+class Job:
+    """Reference job/job.py — id + replica bounds (elastic range)."""
+
+    def __init__(self, jid: str = "default", mode: str = "collective",
+                 nnodes: str = "1"):
+        self.id = jid
+        self.mode = mode
+        if ":" in str(nnodes):
+            lo, hi = str(nnodes).split(":")
+            self.replicas_min, self.replicas_max = int(lo), int(hi)
+        else:
+            self.replicas_min = self.replicas_max = int(nnodes)
+        self.elastic = self.replicas_min != self.replicas_max
+
+    def __repr__(self):
+        return (f"Job(id={self.id}, mode={self.mode}, "
+                f"replicas=[{self.replicas_min},{self.replicas_max}])")

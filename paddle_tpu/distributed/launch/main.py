@@ -1,25 +1,28 @@
 """Launcher (reference: python/paddle/distributed/launch/main.py:21).
 
 ``python -m paddle_tpu.distributed.launch train.py`` — on TPU a single
-process drives all local chips (SPMD), so the single-host launch execs the
-script once with the distributed env set; multi-host (--ips) sets PjRt
-coordination env per host (one process per host, not per device).
+process drives all local chips (SPMD), so a pod holds one container per
+host; multi-host sets the jax.distributed coordination env per host.
+The controller provides the reference's watch loop: process-level
+failure detection with a bounded restart policy.  See controllers.py,
+job.py, master.py, watchdog.py and fleet/elastic for the pieces.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-import runpy
-import subprocess
 import sys
+
+from .controllers import CollectiveController
 
 __all__ = ["launch", "main"]
 
 
-def _parse():
+def _parse(argv=None):
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
-    p.add_argument("--nnodes", type=str, default="1")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="N or MIN:MAX (elastic range)")
     p.add_argument("--nproc_per_node", type=int, default=None)
     p.add_argument("--ips", type=str, default=None)
     p.add_argument("--master", type=str, default=None)
@@ -27,38 +30,23 @@ def _parse():
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    dest="devices")
     p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--log_to_file", action="store_true")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_timeout", type=float, default=60.0)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args()
+    return p.parse_args(argv)
 
 
-def launch():
-    args = _parse()
-    env = os.environ.copy()
-    nnodes = int(str(args.nnodes).split(":")[0])
-    if nnodes > 1:
-        if args.master is None:
-            raise SystemExit("--master is required for multi-node launch")
-        env["PADDLE_MASTER"] = args.master
-        env["PADDLE_TRAINERS_NUM"] = str(nnodes)
-        rank = args.rank if args.rank >= 0 else int(
-            env.get("PADDLE_TRAINER_ID", "0"))
-        env["PADDLE_TRAINER_ID"] = str(rank)
-    else:
-        env.setdefault("PADDLE_TRAINERS_NUM", "1")
-        env.setdefault("PADDLE_TRAINER_ID", "0")
+def launch(argv=None):
+    args = _parse(argv)
     os.makedirs(args.log_dir, exist_ok=True)
-    log_path = os.path.join(args.log_dir, "workerlog.0")
-    with open(log_path, "ab") as logf:
-        proc = subprocess.Popen(
-            [sys.executable, args.training_script] +
-            args.training_script_args,
-            env=env, stdout=None, stderr=None)
-        ret = proc.wait()
-    if ret != 0:
-        raise SystemExit(ret)
+    controller = CollectiveController(args)
+    rc = controller.run()
+    if rc != 0:
+        raise SystemExit(rc)
 
 
 def main():

@@ -1,0 +1,209 @@
+"""Rendezvous master: an in-process KV/barrier service.
+
+Reference: distributed/launch/controllers/master.py — HTTPMaster (KVServer
+on the rank-0 host) / ETCDMaster.  Peers register under a prefix and
+fetch the full peer list once every expected rank has arrived; elastic
+mode adds TTL heartbeats so departures are detected.
+
+TPU-native role: host-level rendezvous only — it elects the coordinator
+address and assigns process ids, which then feed
+``jax.distributed.initialize``; tensor traffic never touches this
+channel (that is ICI/DCN via XLA collectives).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["KVServer", "KVClient", "Master", "rendezvous"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: Dict[str, bytes] = {}
+    stamps: Dict[str, float] = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n)
+        with self.lock:
+            self.store[self.path] = val
+            self.stamps[self.path] = time.time()
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        with self.lock:
+            self.store.pop(self.path, None)
+            self.stamps.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        with self.lock:
+            if self.path.endswith("/"):  # prefix scan
+                items = {k: v.decode() for k, v in self.store.items()
+                         if k.startswith(self.path)}
+                body = json.dumps(items).encode()
+            elif self.path in self.store:
+                body = self.store[self.path]
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class KVServer:
+    """Threaded KV server (reference utils/kv_server.py)."""
+
+    def __init__(self, port: int = 0):
+        # fresh maps per server so tests don't share state
+        handler = type("H", (_Handler,), {
+            "store": {}, "stamps": {}, "lock": threading.Lock()})
+        # bind all interfaces: remote peers must reach the master
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self._handler = handler
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def expire(self, prefix: str, ttl: float) -> List[str]:
+        """Drop entries under prefix older than ttl; return dropped keys."""
+        now = time.time()
+        dropped = []
+        with self._handler.lock:
+            for k in list(self._handler.store):
+                if k.startswith(prefix) and \
+                        now - self._handler.stamps.get(k, now) > ttl:
+                    del self._handler.store[k]
+                    self._handler.stamps.pop(k, None)
+                    dropped.append(k)
+        return dropped
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.startswith("http"):
+            self.endpoint = "http://" + self.endpoint
+
+    def _req(self, method, path, data=None, timeout=5.0):
+        req = urllib.request.Request(self.endpoint + path, data=data,
+                                     method=method)
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    def put(self, key: str, value: str) -> bool:
+        try:
+            return self._req("PUT", key, value.encode()).status == 200
+        except OSError:
+            return False
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            return self._req("GET", key).read().decode()
+        except OSError:
+            return None
+
+    def prefix(self, prefix: str) -> Dict[str, str]:
+        try:
+            body = self._req("GET", prefix.rstrip("/") + "/").read()
+            return json.loads(body)
+        except OSError:
+            return {}
+
+    def delete(self, key: str) -> bool:
+        try:
+            return self._req("DELETE", key).status == 200
+        except OSError:
+            return False
+
+
+class Master:
+    """Rank-0 hosts the KVServer; everyone rendezvouses through it
+    (reference controllers/master.py HTTPMaster.sync_peers)."""
+
+    def __init__(self, endpoint: Optional[str], is_master: bool):
+        self.is_master = is_master
+        self.server = None
+        if is_master:
+            port = 0
+            if endpoint and ":" in endpoint:
+                port = int(endpoint.split(":")[1])
+            self.server = KVServer(port).start()
+            endpoint = f"127.0.0.1:{self.server.port}" if endpoint is None \
+                else endpoint
+        self.endpoint = endpoint
+        self.client = KVClient(endpoint) if endpoint else None
+
+    def sync_peers(self, prefix: str, key: str, value: str, size: int,
+                   timeout: float = 60.0) -> Tuple[List[str], int]:
+        """Register value under prefix/key and wait until ``size`` peers
+        registered.  Returns (sorted peer values, my rank)."""
+        deadline = time.time() + timeout
+        self.client.put(f"{prefix}/{key}", value)
+
+        def order(k):
+            # natural order so rank '10' sorts after '9', not after '1'
+            tail = k.rsplit("/", 1)[-1]
+            return (0, int(tail)) if tail.isdigit() else (1, tail)
+
+        while time.time() < deadline:
+            peers = self.client.prefix(prefix)
+            if len(peers) >= size:
+                ks = sorted(peers, key=order)
+                ordered = [peers[k] for k in ks]
+                rank = ks.index(f"{prefix}/{key}")
+                return ordered, rank
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"rendezvous {prefix}: {size} peers expected, got "
+            f"{len(self.client.prefix(prefix))}")
+
+    def heartbeat(self, prefix: str, key: str):
+        self.client.put(f"{prefix}/{key}", str(time.time()))
+
+    def stop(self):
+        if self.server:
+            self.server.stop()
+
+
+def rendezvous(master_endpoint: Optional[str], rank: int, size: int,
+               job_id: str = "default", timeout: float = 60.0,
+               is_master: Optional[bool] = None):
+    """One-call rendezvous: returns (master, peer list, rank).
+
+    rank<0 auto-assigns by registration order; exactly ONE caller must
+    host the KV server — by default rank 0, or pass ``is_master``
+    explicitly when using auto-rank (rank<0 with is_master unset raises,
+    since every auto-rank node claiming mastership can never meet)."""
+    if is_master is None:
+        if rank < 0:
+            raise ValueError(
+                "auto-rank rendezvous needs an explicit is_master: "
+                "exactly one node must host the KV server")
+        is_master = rank == 0
+    m = Master(master_endpoint, is_master=is_master)
+    key = f"{rank}" if rank >= 0 else f"auto-{time.time_ns()}"
+    peers, got_rank = m.sync_peers(f"/rdzv/{job_id}", key,
+                                   value=key, size=size, timeout=timeout)
+    return m, peers, (rank if rank >= 0 else got_rank)

@@ -1,0 +1,76 @@
+"""Collective/progress watchdog — in-process failure detection.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.h:37 +
+comm_context timeouts — a background thread that detects stalled
+collectives and aborts the job instead of hanging forever (NCCL-style
+watchdog).
+
+TPU-native: XLA collectives cannot be individually timed from Python
+(they are fused into the step program), so the observable unit is the
+*step*: the training loop calls ``tick()`` after each fenced step; the
+watchdog thread fires ``on_stall`` (default: log + SIGABRT the process
+so the launcher's restart policy kicks in) when no tick arrives within
+``timeout`` seconds.  ``watch()`` wraps a loop as a context manager.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["Watchdog"]
+
+
+class Watchdog:
+    def __init__(self, timeout: float = 600.0,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 poll_interval: Optional[float] = None):
+        self.timeout = timeout
+        self.on_stall = on_stall or self._default_stall
+        self._poll = poll_interval or min(timeout / 4, 10.0)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stalled = False
+
+    def _default_stall(self, elapsed: float):
+        import sys
+        sys.stderr.write(
+            f"[watchdog] no progress for {elapsed:.0f}s (timeout "
+            f"{self.timeout:.0f}s) — aborting so the launcher can "
+            f"restart\n")
+        os.kill(os.getpid(), signal.SIGABRT)
+
+    def start(self):
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self._poll):
+            elapsed = time.monotonic() - self._last
+            if elapsed > self.timeout:
+                self.stalled = True
+                self.on_stall(elapsed)
+                return
+
+    def tick(self):
+        """Record progress (call after each fenced train step)."""
+        self._last = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
