@@ -71,6 +71,8 @@ from . import quantization  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import hapi as _hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402

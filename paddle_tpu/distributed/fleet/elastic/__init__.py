@@ -115,8 +115,12 @@ class ElasticManager:
             f"{len(self.alive_nodes())}")
 
     def leave(self):
-        # an in-flight heartbeat PUT can land after the DELETE and
-        # resurrect the key; verify and retry until it stays gone
+        # the heartbeat thread must stop FIRST or it re-registers the
+        # key right after the delete; then clear any in-flight PUT
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
         key = f"{self.prefix}/{self.node_id}"
         for _ in range(20):
             self.client.delete(key)

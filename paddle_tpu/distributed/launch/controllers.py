@@ -28,21 +28,66 @@ class Controller:
         self.pod = Pod()
         self.restart_count = 0
         self.max_restarts = getattr(args, "max_restart", 3)
+        self._elastic = None
+        self._world = self.job.replicas_min
 
     # -- hooks ------------------------------------------------------------
     def build_pod(self):  # pragma: no cover - abstract
         raise NotImplementedError
 
     def run(self) -> int:
+        if self.job.elastic and self.args.master:
+            self._start_elastic()
         self.build_pod()
         self.pod.deploy()
         return self.watch()
+
+    def _start_elastic(self):
+        """Join the elastic membership group and size the world to the
+        CURRENT quorum (>= replicas_min); membership changes flip the
+        manager to RESTART, which the watch loop acts on."""
+        import os
+        from ..fleet.elastic import ElasticManager
+        node_id = os.environ.get("PADDLE_TRAINER_ID", None) or \
+            f"node-{os.getpid()}"
+        is_master = os.environ.get("PADDLE_TRAINER_ID", "0") == "0"
+        server = None
+        if is_master:
+            from .master import KVServer
+            port = int(self.args.master.split(":")[1])
+            try:
+                server = KVServer(port).start()
+            except OSError:
+                server = None   # another local controller already hosts
+        self._elastic = ElasticManager(
+            self.args.master, self.job.id, str(node_id),
+            (self.job.replicas_min, self.job.replicas_max),
+            server=server).start()
+        alive = self._elastic.wait_for_np(
+            self.job.replicas_min,
+            timeout=getattr(self.args, "elastic_timeout", 60.0))
+        self._world = max(self.job.replicas_min,
+                          min(len(alive), self.job.replicas_max))
 
     def watch(self) -> int:
         """Reference controller.py watch loop + watcher.py: act on the
         FIRST failed container — siblings may be blocked in collectives
         waiting for the dead peer, so is_done() alone would hang."""
+        from ..fleet.elastic import ElasticStatus
         while True:
+            if self._elastic is not None and \
+                    self._elastic.status == ElasticStatus.RESTART:
+                alive = self._elastic.alive_nodes()
+                self._world = max(self.job.replicas_min,
+                                  min(len(alive), self.job.replicas_max))
+                self._elastic.status = ElasticStatus.HOLD
+                sys.stderr.write(
+                    f"[launch] elastic membership change -> world size "
+                    f"{self._world}; restarting pod\n")
+                self.pod.stop(force=True)
+                self.build_pod()
+                self.pod.deploy()
+                continue
             failed = self.pod.failed_containers()
             if failed or self.pod.is_done():
                 if not failed:
@@ -61,6 +106,8 @@ class Controller:
             time.sleep(0.5)
 
     def stop(self):
+        if self._elastic is not None:
+            self._elastic.stop()
         self.pod.stop(force=True)
 
 
@@ -72,14 +119,16 @@ class CollectiveController(Controller):
         args = self.args
         self.pod = Pod(name=f"{self.job.id}-pod")
         self.pod.restart_count = self.restart_count
+        nnodes = self._world
         env = {
-            # elastic range sizes the world at MIN: the job must come up
-            # with the minimum quorum; scale-ups restart with more
-            "PADDLE_TRAINERS_NUM": str(self.job.replicas_min),
+            # operator-preset coordination env wins in the single-node
+            # path (per-host launches with external coordination)
+            "PADDLE_TRAINERS_NUM": os.environ.get(
+                "PADDLE_TRAINERS_NUM", str(nnodes))
+            if nnodes == 1 else str(nnodes),
             "PADDLE_JOB_ID": self.job.id,
             "PADDLE_RESTART_COUNT": str(self.restart_count),
         }
-        nnodes = self.job.replicas_min
         if nnodes > 1:
             if not args.master:
                 raise SystemExit(
@@ -92,7 +141,8 @@ class CollectiveController(Controller):
             env["PADDLE_MASTER"] = args.master
             env["PADDLE_TRAINER_ID"] = str(rank)
         else:
-            env["PADDLE_TRAINER_ID"] = "0"
+            env["PADDLE_TRAINER_ID"] = os.environ.get(
+                "PADDLE_TRAINER_ID", "0")
         out = os.path.join(args.log_dir, f"workerlog.0")
         self.pod.add_container(
             [sys.executable, args.training_script,

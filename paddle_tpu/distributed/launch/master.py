@@ -91,6 +91,7 @@ class KVServer:
         """Drop entries under prefix older than ttl; return dropped keys."""
         now = time.time()
         dropped = []
+        prefix = prefix.rstrip("/") + "/"   # job 'j1' must not match 'j10'
         with self._handler.lock:
             for k in list(self._handler.store):
                 if k.startswith(prefix) and \

@@ -1,18 +1,33 @@
-"""Minimal RPC (reference: python/paddle/distributed/rpc/rpc.py) —
-in-process executor for single-controller; cross-host RPC requires a
-multi-host launch (documented limitation)."""
+"""Distributed RPC (reference: python/paddle/distributed/rpc/rpc.py,
+backed by the C++ RpcAgent — paddle/fluid/distributed/rpc/rpc_agent.cc).
+
+TPU-native realization: a lightweight TCP request/reply agent per
+worker.  ``init_rpc`` starts a server thread on an ephemeral port and
+registers ``name -> host:port`` with the launcher's KV master
+(launch/master.py; rank 0 hosts it).  ``rpc_sync(to=...)`` resolves the
+target's endpoint, ships a pickled (fn, args, kwargs), and returns the
+pickled result — exceptions propagate.  Control-plane only: tensor
+traffic belongs on ICI/DCN via XLA collectives, so payloads are
+host data (numpy/python), same division of labor as the reference.
+"""
 
 from __future__ import annotations
 
 import concurrent.futures
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
-           "get_worker_info", "get_all_worker_infos", "get_current_worker_info"]
+from ..launch.master import KVClient, KVServer
 
-_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
-_name = "worker0"
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
 
 
 @dataclass
@@ -23,38 +38,183 @@ class WorkerInfo:
     port: int = 0
 
 
+def _local_ip() -> str:
+    """Advertised address: PADDLE_LOCAL_IP overrides; else the host's
+    outbound address; else loopback (single-host)."""
+    import os
+    ip = os.environ.get("PADDLE_LOCAL_IP")
+    if ip:
+        return ip
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class _Agent:
+    def __init__(self):
+        self.name = None
+        self.rank = 0
+        self.server = None
+        self.kv_server = None
+        self.client = None
+        self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        self.workers = {}
+
+
+_agent: Optional[_Agent] = None
+
+
+def _send_msg(sock, obj):
+    blob = pickle.dumps(obj)
+    sock.sendall(struct.pack("!Q", len(blob)) + blob)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    n = struct.unpack("!Q", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+            try:
+                result = fn(*(args or ()), **(kwargs or {}))
+                _send_msg(self.request, ("ok", result))
+            except Exception as e:  # noqa: BLE001
+                _send_msg(self.request, ("err", e))
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 def init_rpc(name: str, rank: int = 0, world_size: int = 1,
              master_endpoint: Optional[str] = None) -> None:
-    global _pool, _name
-    _name = name
-    _pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+    """Reference rpc.py init_rpc — start the agent + rendezvous."""
+    global _agent
+    _agent = _Agent()
+    _agent.name = name
+    _agent.rank = rank
+    # trust model: the agent executes pickled callables from anyone who
+    # can reach the port — bind only the advertised interface and run
+    # inside the pod/VPC boundary (same model as the reference's
+    # brpc-based agent); never expose this port publicly
+    ip = _local_ip()
+    _agent.server = _Server((ip if ip != "127.0.0.1" else "127.0.0.1", 0),
+                            _Handler)
+    port = _agent.server.server_address[1]
+    threading.Thread(target=_agent.server.serve_forever,
+                     daemon=True).start()
+    if master_endpoint is None:
+        master_endpoint = "127.0.0.1:0"
+    if rank == 0:
+        kv_port = int(master_endpoint.split(":")[1])
+        _agent.kv_server = KVServer(kv_port).start()
+        master_endpoint = f"127.0.0.1:{_agent.kv_server.port}" \
+            if kv_port == 0 else master_endpoint
+    _agent.client = KVClient(master_endpoint)
+    _agent.master_endpoint = master_endpoint
+    info = WorkerInfo(name, rank, ip, port)
+    # register and wait for the full world
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if _agent.client.put(f"/rpc/{name}",
+                             f"{info.rank},{info.ip},{info.port}"):
+            break
+        time.sleep(0.2)
+    while time.time() < deadline:
+        peers = _agent.client.prefix("/rpc")
+        if len(peers) >= world_size:
+            for k, v in peers.items():
+                r, ip, p = v.split(",")
+                _agent.workers[k.rsplit("/", 1)[-1]] = WorkerInfo(
+                    k.rsplit("/", 1)[-1], int(r), ip, int(p))
+            return
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"init_rpc: {world_size} workers expected, have "
+        f"{len(_agent.client.prefix('/rpc'))}")
+
+
+def _require_agent() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent
 
 
 def rpc_sync(to: str, fn: Callable, args=None, kwargs=None,
              timeout=-1) -> Any:
-    return fn(*(args or ()), **(kwargs or {}))
+    """Execute fn on worker ``to`` and return the result."""
+    a = _require_agent()
+    if to == a.name:
+        return fn(*(args or ()), **(kwargs or {}))
+    w = a.workers.get(to)
+    if w is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(a.workers)}")
+    with socket.create_connection(
+            (w.ip, w.port),
+            timeout=None if timeout in (-1, None) else timeout) as s:
+        _send_msg(s, (fn, args, kwargs))
+        status, payload = _recv_msg(s)
+    if status == "err":
+        raise payload
+    return payload
 
 
 def rpc_async(to: str, fn: Callable, args=None, kwargs=None, timeout=-1):
-    if _pool is None:
-        raise RuntimeError("call init_rpc first")
-    return _pool.submit(fn, *(args or ()), **(kwargs or {}))
+    a = _require_agent()
+    return a.pool.submit(rpc_sync, to, fn, args, kwargs, timeout)
 
 
-def shutdown() -> None:
-    global _pool
-    if _pool is not None:
-        _pool.shutdown()
-        _pool = None
-
-
-def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
-    return WorkerInfo(name or _name, 0)
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent().workers[name]
 
 
 def get_all_worker_infos():
-    return [get_worker_info()]
+    return sorted(_require_agent().workers.values(),
+                  key=lambda w: w.rank)
 
 
-def get_current_worker_info():
-    return get_worker_info()
+def get_current_worker_info() -> WorkerInfo:
+    a = _require_agent()
+    return a.workers.get(a.name, WorkerInfo(a.name, a.rank))
+
+
+def shutdown() -> None:
+    global _agent
+    if _agent is None:
+        return
+    _agent.client.delete(f"/rpc/{_agent.name}")
+    _agent.server.shutdown()
+    _agent.server.server_close()
+    _agent.pool.shutdown(wait=False)
+    if _agent.kv_server is not None:
+        # let peers finish their own deregistration first
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                _agent.client.prefix("/rpc"):
+            time.sleep(0.1)
+        _agent.kv_server.stop()
+    _agent = None

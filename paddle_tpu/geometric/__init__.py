@@ -1,0 +1,277 @@
+"""paddle.geometric — graph learning ops (reference: python/paddle/geometric/).
+
+TPU-native: every op is a jax segment reduction (``jax.ops.segment_*``)
+or gather + scatter composed so XLA fuses the message/reduce pipeline —
+the fusion the reference implements in its graph_send_recv CUDA kernels.
+All ops are differentiable through the op-dispatch tape.
+
+Sampling/reindex APIs are host-side preprocessing in the reference too
+(dynamic output shapes); they run as numpy here, documented as such.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor, to_tensor
+
+__all__ = [
+    'send_u_recv', 'send_ue_recv', 'send_uv',
+    'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+    'reindex_graph', 'reindex_heter_graph',
+    'sample_neighbors', 'weighted_sample_neighbors',
+]
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = np.asarray(segment_ids.numpy()
+                     if isinstance(segment_ids, Tensor) else segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(op_name, jax_fn, data, segment_ids, num):
+    return apply(
+        op_name,
+        lambda d, i: jax_fn(d, i.astype(jnp.int32), num_segments=num),
+        data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Reference math.py:23.  Ids must be sorted non-decreasing (same
+    contract as the reference); unsorted ids still reduce correctly here
+    (jax segment ops don't require sortedness)."""
+    num = _num_segments(segment_ids, None)
+    return _segment("segment_sum", jax.ops.segment_sum, data,
+                    segment_ids, num)
+
+
+def segment_mean(data, segment_ids, name=None):
+    num = _num_segments(segment_ids, None)
+    return apply("segment_mean",
+                 lambda d, i: _reduce(d, i, num, "mean"),
+                 data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    num = _num_segments(segment_ids, None)
+    return apply("segment_min",
+                 lambda d, i: _reduce(d, i, num, "min"),
+                 data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    num = _num_segments(segment_ids, None)
+    return apply("segment_max",
+                 lambda d, i: _reduce(d, i, num, "max"),
+                 data, segment_ids)
+
+
+def _reduce(msg, dst, num, reduce_op):
+    dst = dst.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msg, dst, num_segments=num)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=num)
+        c = jax.ops.segment_sum(jnp.ones(dst.shape, msg.dtype), dst,
+                                num_segments=num)
+        shape = (num,) + (1,) * (msg.ndim - 1)
+        return s / jnp.maximum(c.reshape(shape), 1)
+    fn = jax.ops.segment_max if reduce_op == "max" else \
+        jax.ops.segment_min
+    out = fn(msg, dst, num_segments=num)
+    c = jax.ops.segment_sum(jnp.ones(dst.shape, jnp.int32), dst,
+                            num_segments=num)
+    shape = (num,) + (1,) * (msg.ndim - 1)
+    return jnp.where(c.reshape(shape) > 0, out, jnp.zeros_like(out))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Reference message_passing/send_recv.py:36 — gather x[src], reduce
+    into dst slots."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    num = out_size if out_size and int(out_size) > 0 else None
+    if num is None:
+        num = x.shape[0]
+
+    def f(x, src, dst):
+        msg = jnp.take(x, src.astype(jnp.int32), axis=0)
+        return _reduce(msg, dst, int(num), reduce_op)
+
+    return apply(f"send_u_recv_{reduce_op}", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Reference send_recv.py:187 — message = x[src] (op) y_edge, then
+    reduce into dst."""
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    num = out_size if out_size and int(out_size) > 0 else None
+    if num is None:
+        num = x.shape[0]
+
+    def f(x, y, src, dst):
+        msg = jnp.take(x, src.astype(jnp.int32), axis=0)
+        ye = y.astype(msg.dtype)
+        if message_op == "add":
+            msg = msg + ye
+        elif message_op == "sub":
+            msg = msg - ye
+        elif message_op == "mul":
+            msg = msg * ye
+        else:
+            msg = msg / ye
+        return _reduce(msg, dst, int(num), reduce_op)
+
+    return apply(f"send_ue_recv_{message_op}_{reduce_op}", f,
+                 x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Reference send_recv.py:392 — per-edge message x[src] (op) y[dst],
+    no reduction."""
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"unsupported message_op {message_op!r}")
+
+    def f(x, y, src, dst):
+        xs = jnp.take(x, src.astype(jnp.int32), axis=0)
+        yd = jnp.take(y, dst.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            return xs + yd
+        if message_op == "sub":
+            return xs - yd
+        if message_op == "mul":
+            return xs * yd
+        return xs / yd
+
+    return apply(f"send_uv_{message_op}", f, x, y, src_index, dst_index)
+
+
+# ---------------------------------------------------------------------------
+# host-side graph preprocessing (dynamic shapes — numpy, like the
+# reference's CPU kernels; TPU consumes the static-shape results)
+# ---------------------------------------------------------------------------
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reference reindex.py:25 — compact node renumbering: x first, then
+    first-seen order of neighbors.  Returns (reindex_src, reindex_dst,
+    out_nodes)."""
+    xs, nb, cnt = _np(x), _np(neighbors), _np(count)
+    mapping = {}
+    out_nodes = []
+    for v in xs.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.empty(len(nb), dtype=np.int64)
+    for i, v in enumerate(nb.tolist()):
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+        reindex_src[i] = mapping[v]
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (to_tensor(reindex_src), to_tensor(dst),
+            to_tensor(np.asarray(out_nodes, dtype=np.int64)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reference reindex.py heterogeneous variant: neighbors/count are
+    lists (one per edge type) sharing the x mapping."""
+    xs = _np(x)
+    mapping = {}
+    out_nodes = []
+    for v in xs.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    srcs, dsts = [], []
+    for nb, cnt in zip(neighbors, count):
+        nb, cnt = _np(nb), _np(cnt)
+        r = np.empty(len(nb), dtype=np.int64)
+        for i, v in enumerate(nb.tolist()):
+            if v not in mapping:
+                mapping[v] = len(out_nodes)
+                out_nodes.append(v)
+            r[i] = mapping[v]
+        srcs.append(r)
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt))
+    return (to_tensor(np.concatenate(srcs)),
+            to_tensor(np.concatenate(dsts)),
+            to_tensor(np.asarray(out_nodes, dtype=np.int64)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Reference sampling/neighbors.py:23 — CSC neighbor sampling.
+    Returns (out_neighbors, out_count[, out_eids])."""
+    rows, cp, nodes = _np(row), _np(colptr), _np(input_nodes)
+    eid = _np(eids) if eids is not None else None
+    rng = np.random.RandomState()
+    out_nb, out_cnt, out_eid = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(beg, end)
+        if 0 <= sample_size < len(idx):
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        out_nb.append(rows[idx])
+        out_cnt.append(len(idx))
+        if return_eids and eid is not None:
+            out_eid.append(eid[idx])
+    nb = np.concatenate(out_nb) if out_nb else np.empty(0, np.int64)
+    cnt = np.asarray(out_cnt, dtype=np.int64)
+    if return_eids:
+        if eid is None:
+            raise ValueError("return_eids=True requires eids")
+        return (to_tensor(nb), to_tensor(cnt),
+                to_tensor(np.concatenate(out_eid)
+                          if out_eid else np.empty(0, np.int64)))
+    return to_tensor(nb), to_tensor(cnt)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Reference sampling/neighbors.py weighted variant — probability
+    proportional to edge weight."""
+    rows, cp, nodes = _np(row), _np(colptr), _np(input_nodes)
+    w = _np(edge_weight).astype(np.float64)
+    eid = _np(eids) if eids is not None else None
+    rng = np.random.RandomState()
+    out_nb, out_cnt, out_eid = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(beg, end)
+        if 0 <= sample_size < len(idx):
+            p = w[beg:end]
+            p = p / p.sum() if p.sum() > 0 else None
+            idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_nb.append(rows[idx])
+        out_cnt.append(len(idx))
+        if return_eids and eid is not None:
+            out_eid.append(eid[idx])
+    nb = np.concatenate(out_nb) if out_nb else np.empty(0, np.int64)
+    cnt = np.asarray(out_cnt, dtype=np.int64)
+    if return_eids:
+        if eid is None:
+            raise ValueError("return_eids=True requires eids")
+        return (to_tensor(nb), to_tensor(cnt),
+                to_tensor(np.concatenate(out_eid)
+                          if out_eid else np.empty(0, np.int64)))
+    return to_tensor(nb), to_tensor(cnt)

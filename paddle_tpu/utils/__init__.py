@@ -1,0 +1,31 @@
+"""paddle.utils (reference: python/paddle/utils/__init__.py)."""
+
+from . import dlpack  # noqa: F401
+
+__all__ = ["dlpack", "try_import", "run_check"]
+
+
+def try_import(module_name, err_msg=None):
+    """Reference utils/lazy_import.py."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed")
+
+
+def run_check():
+    """Reference utils/install_check.py — smoke-test the install."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), dtype="float32"),
+                         stop_gradient=False)
+    y = (x @ x).sum()
+    y.backward()
+    # d/dx sum(x@x) at x=1 is 4 (each entry used twice per row/col pair)
+    assert np.allclose(x.grad.numpy(), 4 * np.ones((2, 2)))
+    n = paddle.device.cuda.device_count() if hasattr(
+        paddle.device, "cuda") else 0
+    print(f"paddle_tpu is installed successfully! "
+          f"(backend devices: {max(n, 1)})")

@@ -1,0 +1,45 @@
+"""paddle.utils.dlpack (reference: python/paddle/utils/dlpack.py).
+
+Zero-copy tensor exchange via the DLPack protocol.  Modern consumers
+(torch/numpy/jax) accept any object implementing ``__dlpack__``/
+``__dlpack_device__``, so ``to_dlpack`` returns the protocol-bearing
+device array itself; legacy PyCapsule input is still accepted by
+``from_dlpack`` via a CPU-device shim.
+"""
+
+from __future__ import annotations
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol object (reference dlpack.py to_dlpack).
+
+    The returned jax array implements ``__dlpack__``/``__dlpack_device__``;
+    pass it straight to ``torch.from_dlpack`` / ``np.from_dlpack``."""
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+class _CapsuleHolder:
+    """Adapter for legacy one-shot PyCapsule producers (kDLCPU)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(dlpack) -> Tensor:
+    """DLPack protocol object (or legacy capsule) -> Tensor."""
+    import jax.numpy as jnp
+    if not hasattr(dlpack, "__dlpack__"):
+        dlpack = _CapsuleHolder(dlpack)
+    return Tensor(jnp.from_dlpack(dlpack))

@@ -1,0 +1,222 @@
+"""paddle.geometric + real RPC + dlpack interop.
+
+Reference models: test/legacy_test/test_graph_send_recv_op.py,
+test_segment_ops.py, distributed/rpc tests, test_dlpack.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+# -- segment ops -----------------------------------------------------------
+def test_segment_sum_mean_max_min():
+    data = paddle.to_tensor(
+        np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], "float32"))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[4., 6.], [12., 14.]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[2., 3.], [6., 7.]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                               [[3., 4.], [7., 8.]])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                               [[1., 2.], [5., 6.]])
+
+
+def test_segment_empty_segment_zero():
+    data = paddle.to_tensor(np.array([[1., 1.]], "float32"))
+    ids = paddle.to_tensor(np.array([2]))  # segments 0,1 empty
+    out = G.segment_max(data, ids)
+    np.testing.assert_allclose(out.numpy(),
+                               [[0., 0.], [0., 0.], [1., 1.]])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(
+        np.array([[1., 2.], [3., 4.]], "float32"), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([0, 0]))
+    G.segment_sum(data, ids).sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((2, 2)))
+
+
+# -- message passing -------------------------------------------------------
+def test_send_u_recv_docstring_example():
+    x = paddle.to_tensor(
+        np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], "float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(
+        out.numpy(), [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+
+def test_send_u_recv_mean_and_out_size():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.]], "float32"))
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([0, 0]))
+    out = G.send_u_recv(x, src, dst, reduce_op="mean", out_size=2)
+    np.testing.assert_allclose(out.numpy(), [[1.5], [0.]])
+
+
+def test_send_ue_recv():
+    x = paddle.to_tensor(np.array([[1., 1.], [2., 2.]], "float32"))
+    y = paddle.to_tensor(np.array([[10., 10.], [20., 20.]], "float32"))
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([1, 1]))
+    out = G.send_ue_recv(x, y, src, dst, message_op="mul",
+                         reduce_op="max")
+    np.testing.assert_allclose(out.numpy(), [[0., 0.], [40., 40.]])
+
+
+def test_send_uv():
+    x = paddle.to_tensor(np.array([[1.], [2.]], "float32"))
+    y = paddle.to_tensor(np.array([[10.], [20.]], "float32"))
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([1, 0]))
+    out = G.send_uv(x, y, src, dst, message_op="add")
+    np.testing.assert_allclose(out.numpy(), [[21.], [12.]])
+
+
+def test_invalid_ops_raise():
+    x = paddle.to_tensor(np.zeros((2, 2), "float32"))
+    idx = paddle.to_tensor(np.array([0, 1]))
+    with pytest.raises(ValueError):
+        G.send_u_recv(x, idx, idx, reduce_op="prod")
+    with pytest.raises(ValueError):
+        G.send_uv(x, x, idx, idx, message_op="pow")
+
+
+# -- graph preprocessing ---------------------------------------------------
+def test_reindex_graph():
+    x = paddle.to_tensor(np.array([0, 5, 9]))
+    neighbors = paddle.to_tensor(np.array([5, 9, 7, 0]))
+    count = paddle.to_tensor(np.array([2, 1, 1]))
+    src, dst, nodes = G.reindex_graph(x, neighbors, count)
+    assert nodes.numpy().tolist() == [0, 5, 9, 7]
+    assert src.numpy().tolist() == [1, 2, 3, 0]
+    assert dst.numpy().tolist() == [0, 0, 1, 2]
+
+
+def test_sample_neighbors():
+    # CSC graph: node0 -> {1,2,3}, node1 -> {3}, node2 -> {}
+    row = paddle.to_tensor(np.array([1, 2, 3, 3]))
+    colptr = paddle.to_tensor(np.array([0, 3, 4, 4]))
+    nb, cnt = G.sample_neighbors(row, colptr,
+                                 paddle.to_tensor(np.array([0, 1, 2])),
+                                 sample_size=2)
+    assert cnt.numpy().tolist() == [2, 1, 0]
+    assert len(nb.numpy()) == 3
+    assert set(nb.numpy()[:2].tolist()) <= {1, 2, 3}
+
+
+def test_weighted_sample_prefers_heavy_edges():
+    row = paddle.to_tensor(np.arange(100))
+    colptr = paddle.to_tensor(np.array([0, 100]))
+    w = np.zeros(100); w[7] = 1000.0; w += 1e-9
+    nb, cnt = G.weighted_sample_neighbors(
+        row, colptr, paddle.to_tensor(w.astype("float32")),
+        paddle.to_tensor(np.array([0])), sample_size=1)
+    assert cnt.numpy().tolist() == [1]
+    assert nb.numpy()[0] == 7
+
+
+# -- dlpack ----------------------------------------------------------------
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    y = from_dlpack(to_dlpack(x))
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def test_dlpack_from_numpy_and_torch():
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+    a = np.arange(4, dtype="float32")
+    t = from_dlpack(a)          # protocol object
+    np.testing.assert_allclose(t.numpy(), a)
+    torch = pytest.importorskip("torch")
+    tt = torch.arange(4, dtype=torch.float32)
+    t2 = from_dlpack(tt)
+    np.testing.assert_allclose(t2.numpy(), a)
+    # and the reverse: torch consumes our protocol object
+    back = torch.from_dlpack(to_dlpack(
+        paddle.to_tensor(a)))
+    np.testing.assert_allclose(back.numpy(), a)
+
+
+# -- RPC -------------------------------------------------------------------
+def _add(a, b):
+    return a + b
+
+
+def _whoami():
+    from paddle_tpu.distributed import rpc
+    return rpc.get_current_worker_info().name
+
+
+def test_rpc_two_workers_cross_process():
+    import multiprocessing as mp
+    from paddle_tpu.distributed import rpc
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+
+    def worker1(master_ep, q):
+        from paddle_tpu.distributed import rpc as r
+        r.init_rpc("worker1", rank=1, world_size=2,
+                   master_endpoint=master_ep)
+        # serve until worker0 posts the stop result
+        q.put(r.rpc_sync("worker0", _add, args=(40, 2)))
+        import time
+        time.sleep(2)
+        r.shutdown()
+
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:0")
+    ep = rpc._agent.master_endpoint
+    p = ctx.Process(target=worker1, args=(ep, q), daemon=True)
+    p.start()
+    # wait until worker1 appears, then call INTO it
+    import time
+    deadline = time.time() + 15
+    while time.time() < deadline and \
+            "worker1" not in rpc._agent.client.prefix("/rpc").get(
+                "/rpc/worker1", ""):
+        peers = rpc._agent.client.prefix("/rpc")
+        if "/rpc/worker1" in peers:
+            break
+        time.sleep(0.2)
+    rpc._agent.workers.clear()
+    for k, v in rpc._agent.client.prefix("/rpc").items():
+        r, ip, port = v.split(",")
+        rpc._agent.workers[k.rsplit("/", 1)[-1]] = rpc.WorkerInfo(
+            k.rsplit("/", 1)[-1], int(r), ip, int(port))
+    out = rpc.rpc_sync("worker1", _add, args=(1, 2))
+    assert out == 3
+    name = rpc.rpc_sync("worker1", _whoami)
+    assert name == "worker1"
+    fut = rpc.rpc_async("worker1", _add, args=(5, 6))
+    assert fut.result(timeout=10) == 11
+    assert q.get(timeout=15) == 42   # reverse direction worked too
+    p.join(10)
+    rpc.shutdown()
+
+
+def test_rpc_exception_propagates():
+    from paddle_tpu.distributed import rpc
+
+    def boom():
+        raise ValueError("remote boom")
+
+    rpc.init_rpc("solo", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:0")
+    try:
+        # self-call executes locally
+        with pytest.raises(ValueError, match="remote boom"):
+            rpc.rpc_sync("solo", boom)
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            rpc.rpc_sync("nobody", _add, args=(1, 2))
+    finally:
+        rpc.shutdown()

@@ -211,3 +211,43 @@ def test_watchdog_fires_on_stall():
     time.sleep(0.8)
     wd.stop()
     assert fired and wd.stalled
+
+
+def test_controller_elastic_restarts_on_scale_up(tmp_path):
+    """--nnodes 1:3 with a master: a new node joining mid-run restarts
+    the pod with the larger world size."""
+    from paddle_tpu.distributed.launch.master import KVServer
+
+    script = str(tmp_path / "train.py")
+    with open(script, "w") as f:
+        f.write("import time, os\n"
+                "time.sleep(1.5)\n")
+    args = _args(tmp_path, script)
+    args.nnodes = "1:3"
+    # controller will host the KV server at this port
+    import socket
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    args.master = f"127.0.0.1:{port}"
+
+    c = CollectiveController(args)
+    import threading
+    rc_box = {}
+
+    def run():
+        rc_box["rc"] = c.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # second "node" joins after the pod is up
+    time.sleep(0.7)
+    m2 = ElasticManager(args.master, "t", "node-extra", (1, 3),
+                        heartbeat_interval=0.1,
+                        heartbeat_ttl=1.0).start()
+    t.join(20)
+    m2.stop()
+    c.stop()
+    assert rc_box.get("rc") == 0
+    # the restarted pod saw the grown world
+    assert c._world == 2
+    assert c.pod.containers[0].env["PADDLE_TRAINERS_NUM"] == "2"
