@@ -1,0 +1,258 @@
+"""paddle.onnx — ONNX export (reference: python/paddle/onnx/export.py,
+which delegates to paddle2onnx).
+
+The environment bundles no ``onnx`` package, so the exporter emits the
+ModelProto wire format directly (_proto.py) from a structural walk of
+the Layer tree.  Supported layers: Linear, Conv2D, BatchNorm2D,
+LayerNorm, ReLU/GELU/Sigmoid/Tanh/Softmax, MaxPool2D/AvgPool2D,
+Flatten, Dropout (folded), Sequential and arbitrary nesting of
+containers whose forward is the sequential composition of children.
+Models with a custom forward need ``contributions`` via the
+``op_mapper`` hook or fall back to ``paddle_tpu.inference``'s StableHLO
+export (the deployment path TPU serving actually uses).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..nn.layer.layers import Layer, Sequential
+from . import _proto as P
+
+__all__ = ["export"]
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.counter = 0
+
+    def fresh(self, base: str) -> str:
+        self.counter += 1
+        return f"{base}_{self.counter}"
+
+    def add_init(self, name: str, arr: np.ndarray):
+        arr = np.ascontiguousarray(arr)
+        dtype = P.FLOAT if arr.dtype != np.int64 else P.INT64
+        self.initializers.append(
+            P.tensor_proto(name, arr.shape, dtype,
+                           arr.astype(
+                               np.float32 if dtype == P.FLOAT
+                               else np.int64).tobytes()))
+
+    def add_node(self, op: str, inputs, outputs, **attrs):
+        self.nodes.append(P.node(op, list(inputs), list(outputs),
+                                 name=self.fresh(op.lower()),
+                                 attrs=attrs or None))
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return [int(v[0]), int(v[1])]
+    return [int(v), int(v)]
+
+
+def _pads4(pad, cls):
+    """2D padding -> ONNX pads[4]; string modes need the StableHLO path."""
+    if isinstance(pad, str):
+        raise NotImplementedError(
+            f"ONNX export of {cls} with padding={pad!r} is not supported; "
+            f"use paddle_tpu.inference.convert_to_export (StableHLO)")
+    if isinstance(pad, int):
+        return [pad] * 4
+    return [int(p) for p in list(pad) * 2]
+
+
+def _emit_layer(b: _Builder, layer: Layer, x: str) -> str:
+    """Emit ONNX nodes for one layer; returns the output tensor name."""
+    from ..nn.layer import activation as act
+    from ..nn.layer import common, conv, norm, pooling
+    cls = type(layer).__name__
+
+    if isinstance(layer, Sequential):
+        for child in layer._sub_layers.values():
+            x = _emit_layer(b, child, x)
+        return x
+
+    if cls == "Linear":
+        w = np.asarray(layer.weight.numpy())          # [in, out]
+        wn, out = b.fresh("w"), b.fresh("linear_out")
+        b.add_init(wn, w)
+        if layer.bias is not None:
+            bn = b.fresh("b")
+            b.add_init(bn, np.asarray(layer.bias.numpy()))
+            mm = b.fresh("mm")
+            b.add_node("MatMul", [x, wn], [mm])
+            b.add_node("Add", [mm, bn], [out])
+        else:
+            b.add_node("MatMul", [x, wn], [out])
+        return out
+
+    if cls == "Conv2D":
+        w = np.asarray(layer.weight.numpy())          # [out,in,kh,kw]
+        wn, out = b.fresh("convw"), b.fresh("conv_out")
+        b.add_init(wn, w)
+        ins = [x, wn]
+        if layer.bias is not None:
+            bn = b.fresh("convb")
+            b.add_init(bn, np.asarray(layer.bias.numpy()))
+            ins.append(bn)
+        b.add_node("Conv", ins, [out],
+                   kernel_shape=list(w.shape[2:]),
+                   strides=_pair(layer._stride),
+                   pads=_pads4(layer._padding, cls),
+                   dilations=_pair(layer._dilation),
+                   group=int(layer._groups))
+        return out
+
+    if cls in ("BatchNorm2D", "BatchNorm1D", "BatchNorm"):
+        out = b.fresh("bn_out")
+        names = []
+        for attr, base in ((layer.weight, "scale"), (layer.bias, "bias"),
+                           (layer._mean, "mean"),
+                           (layer._variance, "var")):
+            n = b.fresh(base)
+            b.add_init(n, np.asarray(attr.numpy()))
+            names.append(n)
+        b.add_node("BatchNormalization", [x] + names, [out],
+                   epsilon=float(layer._epsilon))
+        return out
+
+    if cls == "LayerNorm":
+        # LayerNormalization only enters the default domain at opset 17;
+        # decompose with opset-13 ops: (x-mean)/sqrt(var+eps)*scale+bias
+        sn, bn2 = b.fresh("ln_scale"), b.fresh("ln_bias")
+        eps = b.fresh("ln_eps")
+        b.add_init(sn, np.asarray(layer.weight.numpy()))
+        b.add_init(bn2, np.asarray(layer.bias.numpy()))
+        b.add_init(eps, np.float32(layer._epsilon).reshape(()))
+        mean, diff, sq, var, veps, std, norm, scaled, out = (
+            b.fresh(t) for t in ("ln_mean", "ln_diff", "ln_sq", "ln_var",
+                                 "ln_veps", "ln_std", "ln_norm",
+                                 "ln_scaled", "ln_out"))
+        b.add_node("ReduceMean", [x], [mean], axes=[-1], keepdims=1)
+        b.add_node("Sub", [x, mean], [diff])
+        b.add_node("Mul", [diff, diff], [sq])
+        b.add_node("ReduceMean", [sq], [var], axes=[-1], keepdims=1)
+        b.add_node("Add", [var, eps], [veps])
+        b.add_node("Sqrt", [veps], [std])
+        b.add_node("Div", [diff, std], [norm])
+        b.add_node("Mul", [norm, sn], [scaled])
+        b.add_node("Add", [scaled, bn2], [out])
+        return out
+
+    simple = {"ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
+              "Identity": None, "Dropout": None, "Dropout2D": None}
+    if cls in simple:
+        op = simple[cls]
+        if op is None:      # folded at inference
+            return x
+        out = b.fresh(f"{op.lower()}_out")
+        b.add_node(op, [x], [out])
+        return out
+
+    if cls == "ReLU6":
+        # opset-13 Clip takes min/max as INPUTS (attrs were pre-11)
+        lo, hi = b.fresh("clip_min"), b.fresh("clip_max")
+        b.add_init(lo, np.float32(0.0).reshape(()))
+        b.add_init(hi, np.float32(6.0).reshape(()))
+        out = b.fresh("relu6_out")
+        b.add_node("Clip", [x, lo, hi], [out])
+        return out
+
+    if cls == "GELU":
+        # Gelu only enters the default ONNX domain at opset 20;
+        # decompose exactly: 0.5 * x * (1 + erf(x / sqrt(2)))
+        inv_sqrt2 = b.fresh("gelu_inv_sqrt2")
+        one = b.fresh("gelu_one")
+        half = b.fresh("gelu_half")
+        b.add_init(inv_sqrt2, np.float32(1.0 / np.sqrt(2.0)).reshape(()))
+        b.add_init(one, np.float32(1.0).reshape(()))
+        b.add_init(half, np.float32(0.5).reshape(()))
+        scaled, erf, plus1, times_x, out = (
+            b.fresh("gelu_scaled"), b.fresh("gelu_erf"),
+            b.fresh("gelu_plus1"), b.fresh("gelu_times_x"),
+            b.fresh("gelu_out"))
+        b.add_node("Mul", [x, inv_sqrt2], [scaled])
+        b.add_node("Erf", [scaled], [erf])
+        b.add_node("Add", [erf, one], [plus1])
+        b.add_node("Mul", [x, plus1], [times_x])
+        b.add_node("Mul", [times_x, half], [out])
+        return out
+
+    if cls == "Softmax":
+        out = b.fresh("softmax_out")
+        b.add_node("Softmax", [x], [out],
+                   axis=int(getattr(layer, "_axis", -1)))
+        return out
+
+    if cls == "Flatten":
+        out = b.fresh("flatten_out")
+        b.add_node("Flatten", [x], [out],
+                   axis=int(getattr(layer, "start_axis", 1)))
+        return out
+
+    if cls in ("MaxPool2D", "AvgPool2D"):
+        out = b.fresh("pool_out")
+        b.add_node("MaxPool" if cls == "MaxPool2D" else "AveragePool",
+                   [x], [out],
+                   kernel_shape=_pair(layer._kernel_size),
+                   strides=_pair(layer._stride or layer._kernel_size),
+                   pads=_pads4(layer._padding, cls))
+        return out
+
+    if cls == "AdaptiveAvgPool2D":
+        out = b.fresh("gap_out")
+        osize = getattr(layer, "_output_size", 1)
+        if osize in (1, (1, 1), [1, 1]):
+            b.add_node("GlobalAveragePool", [x], [out])
+            return out
+        raise NotImplementedError(
+            "AdaptiveAvgPool2D export only supports output_size=1")
+
+    # containers with only children and pass-through forward
+    children = list(layer._sub_layers.values())
+    if children and type(layer).forward is Layer.forward:
+        for child in children:
+            x = _emit_layer(b, child, x)
+        return x
+
+    raise NotImplementedError(
+        f"ONNX export does not support layer {cls}; use "
+        f"paddle_tpu.inference.convert_to_export (StableHLO) for "
+        f"arbitrary models")
+
+
+def export(layer: Layer, path: str, input_spec: Sequence = None,
+           opset_version: int = 13, **configs) -> str:
+    """Export ``layer`` to ``path + '.onnx'`` (reference onnx/export.py
+    signature).  ``input_spec``: [(shape, dtype)] — one input."""
+    if input_spec is None:
+        raise ValueError("input_spec is required, e.g. [((1, 3, 224, "
+                         "224), 'float32')]")
+    shape, dtype = input_spec[0] if isinstance(input_spec[0],
+                                               (tuple, list)) and \
+        not isinstance(input_spec[0][0], int) else (input_spec[0], "float32")
+    if isinstance(shape[0], (tuple, list)):
+        shape, dtype = shape
+    b = _Builder()
+    layer.eval()
+    out_name = _emit_layer(b, layer, "input")
+    # alias final output name
+    b.add_node("Identity", [out_name], ["output"])
+    elem = P.FLOAT if "float" in str(dtype) else P.INT64
+    g = P.graph(b.nodes, "paddle_tpu_graph", b.initializers,
+                [P.value_info("input", elem, tuple(int(s) for s in shape))],
+                [P.value_info("output", P.FLOAT, None)])  # rank unknown
+    blob = P.model(g, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

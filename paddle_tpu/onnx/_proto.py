@@ -1,0 +1,211 @@
+"""Minimal protobuf wire-format encoder/decoder for the ONNX subset.
+
+The environment does not bundle the ``onnx`` package, so the exporter
+serializes ModelProto by hand.  Protobuf wire format is tag-length-value
+(varint tags: field_number << 3 | wire_type); the ONNX field numbers
+used here come from the public stable onnx.proto3 schema:
+
+  ModelProto:  ir_version=1, producer_name=2, producer_version=3,
+               graph=7, opset_import=8
+  OperatorSetIdProto: domain=1, version=2
+  GraphProto:  node=1, name=2, initializer=5, input=11, output=12
+  NodeProto:   input=1, output=2, name=3, op_type=4, attribute=5
+  AttributeProto: name=1, f=2, i=3, s=4, t=5, type=20, floats=7,
+               ints=8
+  TensorProto: dims=1, data_type=2, name=8, raw_data=9
+  ValueInfoProto: name=1, type=2
+  TypeProto:   tensor_type=1;  TypeProto.Tensor: elem_type=1, shape=2
+  TensorShapeProto: dim=1;  Dimension: dim_value=1
+
+A matching decoder is provided so tests can round-trip structurally
+without the onnx package.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+# ONNX TensorProto.DataType
+FLOAT = 1
+INT64 = 7
+INT32 = 6
+
+# AttributeProto.AttributeType
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_TENSOR = 4
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        # protobuf int64: negatives are two's-complement, 10 bytes
+        n &= (1 << 64) - 1
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _str_field(field: int, s: str) -> bytes:
+    return _len_field(field, s.encode())
+
+
+def tensor_proto(name: str, dims: Tuple[int, ...], data_type: int,
+                 raw: bytes) -> bytes:
+    msg = b""
+    for d in dims:
+        msg += _int_field(1, d)
+    msg += _int_field(2, data_type)
+    msg += _str_field(8, name)
+    msg += _len_field(9, raw)
+    return msg
+
+
+def _dim(value: int) -> bytes:
+    return _int_field(1, value)
+
+
+def _shape(dims: Tuple[int, ...]) -> bytes:
+    return b"".join(_len_field(1, _dim(d)) for d in dims)
+
+
+def type_proto(elem_type: int, dims) -> bytes:
+    """dims=None omits the shape entirely (unknown rank); an empty
+    tuple would declare a rank-0 scalar."""
+    tensor_type = _int_field(1, elem_type)
+    if dims is not None:
+        tensor_type += _len_field(2, _shape(dims))
+    return _len_field(1, tensor_type)
+
+
+def value_info(name: str, elem_type: int, dims) -> bytes:
+    return _str_field(1, name) + _len_field(2, type_proto(elem_type, dims))
+
+
+def attribute(name: str, value: Any) -> bytes:
+    msg = _str_field(1, name)
+    if isinstance(value, float):
+        msg += _tag(2, 5) + struct.pack("<f", value)
+        msg += _int_field(20, ATTR_FLOAT)
+    elif isinstance(value, bool):
+        msg += _int_field(3, int(value))
+        msg += _int_field(20, ATTR_INT)
+    elif isinstance(value, int):
+        msg += _int_field(3, value)
+        msg += _int_field(20, ATTR_INT)
+    elif isinstance(value, str):
+        msg += _len_field(4, value.encode())
+        msg += _int_field(20, ATTR_STRING)
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        for v in value:
+            msg += _tag(7, 5) + struct.pack("<f", v)
+        msg += _int_field(20, ATTR_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            msg += _int_field(8, int(v))
+        msg += _int_field(20, ATTR_INTS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return msg
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str],
+         name: str = "", attrs: Dict[str, Any] = None) -> bytes:
+    msg = b""
+    for i in inputs:
+        msg += _str_field(1, i)
+    for o in outputs:
+        msg += _str_field(2, o)
+    if name:
+        msg += _str_field(3, name)
+    msg += _str_field(4, op_type)
+    for k, v in (attrs or {}).items():
+        msg += _len_field(5, attribute(k, v))
+    return msg
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    msg = b""
+    for n in nodes:
+        msg += _len_field(1, n)
+    msg += _str_field(2, name)
+    for t in initializers:
+        msg += _len_field(5, t)
+    for i in inputs:
+        msg += _len_field(11, i)
+    for o in outputs:
+        msg += _len_field(12, o)
+    return msg
+
+
+def model(graph_msg: bytes, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    opset_msg = _str_field(1, "") + _int_field(2, opset)
+    msg = _int_field(1, 8)          # ir_version 8
+    msg += _str_field(2, producer)
+    msg += _str_field(3, "0.1.0")
+    msg += _len_field(7, graph_msg)
+    msg += _len_field(8, opset_msg)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# decoder (structural, for tests + load())
+# ---------------------------------------------------------------------------
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def parse_message(buf: bytes) -> Dict[int, List[Any]]:
+    """Parse one protobuf message into {field: [values]}; length-
+    delimited fields stay raw bytes for the caller to recurse."""
+    out: Dict[int, List[Any]] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out

@@ -1,0 +1,193 @@
+"""ONNX export: wire-format round-trip + numeric parity via a tiny
+interpreter over the parsed graph.
+
+Reference model: python/paddle/onnx/export.py (paddle2onnx-backed).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import onnx as ponnx
+from paddle_tpu.onnx import _proto as P
+
+
+def _parse_model(path):
+    with open(path, "rb") as f:
+        m = P.parse_message(f.read())
+    graph = P.parse_message(m[7][0])
+    nodes = [P.parse_message(n) for n in graph.get(1, [])]
+    inits = {}
+    for t in graph.get(5, []):
+        tp = P.parse_message(t)
+        dims = tp.get(1, [])
+        dt = tp[2][0]
+        name = tp[8][0].decode()
+        raw = tp[9][0]
+        arr = np.frombuffer(
+            raw, dtype=np.float32 if dt == P.FLOAT else np.int64)
+        inits[name] = arr.reshape(dims)
+    return m, nodes, inits
+
+
+def _node_fields(n):
+    return {
+        "inputs": [x.decode() for x in n.get(1, [])],
+        "outputs": [x.decode() for x in n.get(2, [])],
+        "op": n[4][0].decode(),
+        "attrs": {P.parse_message(a)[1][0].decode(): P.parse_message(a)
+                  for a in n.get(5, [])},
+    }
+
+
+def _run_graph(nodes, inits, x):
+    """Minimal ONNX interpreter for MLP-class ops."""
+    env = dict(inits)
+    env["input"] = x
+    for raw in nodes:
+        n = _node_fields(raw)
+        i = [env[k] for k in n["inputs"]]
+        if n["op"] == "MatMul":
+            out = i[0] @ i[1]
+        elif n["op"] == "Add":
+            out = i[0] + i[1]
+        elif n["op"] == "Relu":
+            out = np.maximum(i[0], 0)
+        elif n["op"] == "Flatten":
+            out = i[0].reshape(i[0].shape[0], -1)
+        elif n["op"] == "Softmax":
+            e = np.exp(i[0] - i[0].max(-1, keepdims=True))
+            out = e / e.sum(-1, keepdims=True)
+        elif n["op"] == "Identity":
+            out = i[0]
+        elif n["op"] == "Mul":
+            out = i[0] * i[1]
+        elif n["op"] == "Erf":
+            from scipy.special import erf as _erf
+            out = _erf(i[0])
+        elif n["op"] == "Clip":
+            out = np.clip(i[0], i[1], i[2])
+        elif n["op"] == "Sub":
+            out = i[0] - i[1]
+        elif n["op"] == "Div":
+            out = i[0] / i[1]
+        elif n["op"] == "Sqrt":
+            out = np.sqrt(i[0])
+        elif n["op"] == "ReduceMean":
+            axes = tuple(a - (1 << 64) if a >= 1 << 63 else a
+                         for a in n["attrs"]["axes"].get(8, [-1]))
+            out = i[0].mean(axis=axes, keepdims=True)
+        else:
+            raise NotImplementedError(n["op"])
+        env[n["outputs"][0]] = out
+    return env["output"]
+
+
+def test_mlp_export_numeric_parity(tmp_path):
+    paddle.seed(11)
+    net = paddle.nn.Sequential(
+        paddle.nn.Flatten(),
+        paddle.nn.Linear(12, 32), paddle.nn.ReLU(),
+        paddle.nn.Dropout(0.5),          # folded at export
+        paddle.nn.Linear(32, 5), paddle.nn.Softmax())
+    net.eval()                           # dropout is folded at export
+    x = np.random.RandomState(0).randn(4, 12).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+
+    path = ponnx.export(net, str(tmp_path / "mlp"),
+                        input_spec=[((4, 12), "float32")])
+    assert path.endswith(".onnx")
+    m, nodes, inits = _parse_model(path)
+    # model header: ir_version + producer
+    assert m[1][0] == 8
+    assert m[2][0].decode() == "paddle_tpu"
+    ops = [_node_fields(n)["op"] for n in nodes]
+    assert ops == ["Flatten", "MatMul", "Add", "Relu", "MatMul", "Add",
+                   "Softmax", "Identity"]
+    y = _run_graph(nodes, inits, x)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lenet_style_structure(tmp_path):
+    net = paddle.nn.Sequential(
+        paddle.nn.Conv2D(1, 6, 5, padding=2), paddle.nn.ReLU(),
+        paddle.nn.MaxPool2D(2, 2),
+        paddle.nn.Conv2D(6, 16, 5), paddle.nn.ReLU(),
+        paddle.nn.MaxPool2D(2, 2),
+        paddle.nn.Flatten(),
+        paddle.nn.Linear(400, 10))
+    path = ponnx.export(net, str(tmp_path / "lenet"),
+                        input_spec=[((1, 1, 28, 28), "float32")])
+    _, nodes, inits = _parse_model(path)
+    ops = [_node_fields(n)["op"] for n in nodes]
+    assert ops == ["Conv", "Relu", "MaxPool", "Conv", "Relu", "MaxPool",
+                   "Flatten", "MatMul", "Add", "Identity"]
+    # conv kernel initializer has the right shape
+    conv_w = [v for k, v in inits.items() if k.startswith("convw")]
+    assert conv_w[0].shape == (6, 1, 5, 5)
+    # conv node attrs carry stride/pads
+    conv = _node_fields(nodes[0])
+    assert "strides" in conv["attrs"] and "pads" in conv["attrs"]
+
+
+def test_gelu_relu6_opset13_decomposition(tmp_path):
+    """GELU must not emit a Gelu node (absent before opset 20) and
+    ReLU6 must emit a bounded Clip — both checked numerically."""
+    paddle.seed(3)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(6, 16), paddle.nn.GELU(),
+        paddle.nn.Linear(16, 8), paddle.nn.ReLU6())
+    net.eval()
+    x = (np.random.RandomState(1).randn(5, 6) * 4).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+    path = ponnx.export(net, str(tmp_path / "act"),
+                        input_spec=[((5, 6), "float32")])
+    _, nodes, inits = _parse_model(path)
+    ops = [_node_fields(n)["op"] for n in nodes]
+    assert "Gelu" not in ops
+    assert "Erf" in ops                      # exact-GELU decomposition
+    clip = [n for n in nodes if _node_fields(n)["op"] == "Clip"]
+    assert len(clip) == 1
+    assert len(_node_fields(clip[0])["inputs"]) == 3  # x, min, max
+    y = _run_graph(nodes, inits, x)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_opset13_decomposition(tmp_path):
+    """LayerNormalization is opset-17+; export must decompose it."""
+    paddle.seed(5)
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 10),
+                               paddle.nn.LayerNorm(10))
+    net.eval()
+    x = np.random.RandomState(2).randn(3, 6).astype("float32")
+    y_ref = net(paddle.to_tensor(x)).numpy()
+    path = ponnx.export(net, str(tmp_path / "ln"),
+                        input_spec=[((3, 6), "float32")])
+    _, nodes, inits = _parse_model(path)
+    ops = [_node_fields(n)["op"] for n in nodes]
+    assert "LayerNormalization" not in ops
+    assert ops.count("ReduceMean") == 2
+    y = _run_graph(nodes, inits, x)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_string_padding_raises_cleanly(tmp_path):
+    net = paddle.nn.Conv2D(3, 8, 3, padding="SAME")
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        ponnx.export(net, str(tmp_path / "c"),
+                     input_spec=[((1, 3, 8, 8), "float32")])
+
+
+def test_unsupported_layer_raises(tmp_path):
+    class Weird(paddle.nn.Layer):
+        def forward(self, x):
+            return x * 2
+
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        ponnx.export(Weird(), str(tmp_path / "w"),
+                     input_spec=[((1, 4), "float32")])
+
+
+def test_input_spec_required(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        ponnx.export(paddle.nn.Linear(2, 2), str(tmp_path / "x"))
