@@ -1,0 +1,13 @@
+"""Native runtime core (C++ components compiled on demand).
+
+Mirrors the role of the reference's C++ substrate for the pieces that
+stay host-side in a TPU framework: coordination (kvstore.cc — the
+TCPStore analog, reference paddle/phi/core/distributed/store/tcp_store.h)
+and IPC transports (shmring.cc — the shared-memory DataLoader path,
+reference paddle/fluid/memory/allocation/mmap_allocator.cc).  The TPU
+compute path itself is JAX/XLA — see SURVEY.md §7.
+"""
+
+from . import native  # noqa: F401
+
+__all__ = ["native"]

@@ -1,0 +1,117 @@
+"""Native (C++) runtime components, compiled on demand.
+
+The reference framework's runtime substrate is C++ (store/tcp_store.h,
+memory/allocation/mmap_allocator.cc, ...).  Here the TPU compute path is
+JAX/XLA, but the runtime *around* it — rendezvous, IPC transports — is
+native too.  This package compiles `kvstore.cc` + `shmring.cc` into one
+shared library with g++ the first time it is needed (cached by source
+hash next to the sources) and binds it with ctypes.
+
+``load()`` returns the bound library or None when no toolchain exists;
+callers fall back to pure-Python paths so tests stay green anywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["kvstore.cc", "shmring.cc"]
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    sigs = {
+        # kvstore
+        "kv_server_start": ([c.c_int, c.POINTER(c.c_int)], c.c_void_p),
+        "kv_server_stop": ([c.c_void_p], None),
+        "kv_server_port": ([c.c_void_p], c.c_int),
+        "kv_connect": ([c.c_char_p, c.c_int, c.c_int], c.c_int),
+        "kv_close": ([c.c_int], None),
+        "kv_set": ([c.c_int, c.c_char_p, c.c_char_p, c.c_uint32], c.c_int),
+        "kv_get": ([c.c_int, c.c_char_p, c.c_void_p, c.c_uint32], c.c_int64),
+        "kv_wait": ([c.c_int, c.c_char_p, c.c_uint64, c.c_void_p,
+                     c.c_uint32], c.c_int64),
+        "kv_add": ([c.c_int, c.c_char_p, c.c_int64], c.c_int64),
+        "kv_del": ([c.c_int, c.c_char_p], c.c_int),
+        "kv_list": ([c.c_int, c.c_char_p, c.c_void_p, c.c_uint32], c.c_int64),
+        "kv_ping": ([c.c_int], c.c_int),
+        # shmring
+        "shmring_open": ([c.c_char_p, c.c_uint64, c.c_int], c.c_void_p),
+        "shmring_close": ([c.c_void_p], None),
+        "shmring_push": ([c.c_void_p, c.c_char_p, c.c_uint32, c.c_int64],
+                         c.c_int),
+        "shmring_pop": ([c.c_void_p, c.c_void_p, c.c_uint32, c.c_int64],
+                        c.c_int64),
+        "shmring_next_len": ([c.c_void_p], c.c_int64),
+        "shmring_used": ([c.c_void_p], c.c_uint64),
+        "shmring_capacity": ([c.c_void_p], c.c_uint64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def build(verbose: bool = False) -> str:
+    """Compile the native library if needed; returns the .so path."""
+    tag = _source_hash()
+    so_path = os.path.join(_DIR, f"libpaddle_native_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+           *srcs, "-lpthread", "-lrt"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose,
+                       cwd=_DIR, timeout=120)
+        os.replace(tmp, so_path)  # atomic for concurrent builders
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    # clear stale builds
+    for f in os.listdir(_DIR):
+        if f.startswith("libpaddle_native_") and f.endswith(".so") \
+                and f != os.path.basename(so_path):
+            try:
+                os.unlink(os.path.join(_DIR, f))
+            except OSError:
+                pass
+    return so_path
+
+
+def load():
+    """Build+load the native library; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE", "0") == "1":
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(build()))
+        except Exception:  # noqa: BLE001 - no toolchain: pure-python path
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

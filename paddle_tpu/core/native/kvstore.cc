@@ -1,0 +1,375 @@
+// TCP key-value store: the rendezvous/coordination primitive.
+//
+// Reference behavior: paddle/phi/core/distributed/store/tcp_store.h:121
+// (TCPStore: master socket on rank 0, set/get/wait/add with timeouts,
+// used to bootstrap every ProcessGroup).  TPU-native role: the same
+// bootstrap seam — it elects the coordinator and exchanges small
+// endpoint/topology blobs before jax.distributed.initialize; tensor
+// traffic never flows here (that is ICI/DCN via XLA collectives).
+//
+// Design: one acceptor thread + one thread per client connection over a
+// shared {map, mutex, condvar}.  WAIT blocks on the condvar until the
+// key exists (or timeout), so clients get push-style notification
+// without polling.  ADD is the atomic counter used for barriers and
+// rank assignment.  Wire format (little-endian):
+//   request:  u8 op | u32 klen | key | u32 vlen | value
+//   response: i32 status (0 ok, <0 error) | u32 len | payload
+// Ops: 1=SET 2=GET 3=WAIT(value = u64 timeout_ms) 4=ADD(value = i64
+// delta; returns new value as i64 payload) 5=DEL 6=LIST(key = prefix;
+// returns k\0v\0... pairs) 7=PING
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread acceptor;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
+  std::mutex conns_mu;
+  Store store;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, int32_t status, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  std::string out;
+  out.resize(8 + payload.size());
+  std::memcpy(&out[0], &status, 4);
+  std::memcpy(&out[4], &len, 4);
+  if (!payload.empty()) std::memcpy(&out[8], payload.data(), payload.size());
+  return write_exact(fd, out.data(), out.size());
+}
+
+void serve_conn(Server* srv, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_exact(fd, &op, 1) || !read_exact(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, &key[0], klen)) break;
+    if (!read_exact(fd, &vlen, 4)) break;
+    if (vlen > (1u << 30)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_exact(fd, &val[0], vlen)) break;
+
+    Store& st = srv->store;
+    bool ok = true;
+    switch (op) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          st.kv[key] = val;
+        }
+        st.cv.notify_all();
+        ok = send_resp(fd, 0, "");
+        break;
+      }
+      case 2: {  // GET
+        std::string out;
+        bool found;
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          auto it = st.kv.find(key);
+          found = it != st.kv.end();
+          if (found) out = it->second;
+        }
+        ok = send_resp(fd, found ? 0 : -1, out);
+        break;
+      }
+      case 3: {  // WAIT
+        uint64_t timeout_ms = 0;
+        if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+        std::unique_lock<std::mutex> g(st.mu);
+        bool found = st.cv.wait_for(
+            g, std::chrono::milliseconds(timeout_ms),
+            [&] { return st.kv.count(key) > 0 || srv->stopping.load(); });
+        std::string out = found && st.kv.count(key) ? st.kv[key] : "";
+        bool have = found && !srv->stopping.load() && st.kv.count(key);
+        g.unlock();
+        ok = send_resp(fd, have ? 0 : -2, out);
+        break;
+      }
+      case 4: {  // ADD
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          int64_t cur = 0;
+          auto it = st.kv.find(key);
+          if (it != st.kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string enc(8, '\0');
+          std::memcpy(&enc[0], &now, 8);
+          st.kv[key] = enc;
+        }
+        st.cv.notify_all();
+        std::string out(8, '\0');
+        std::memcpy(&out[0], &now, 8);
+        ok = send_resp(fd, 0, out);
+        break;
+      }
+      case 5: {  // DEL
+        size_t n;
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          n = st.kv.erase(key);
+        }
+        ok = send_resp(fd, n ? 0 : -1, "");
+        break;
+      }
+      case 6: {  // LIST prefix -> [u32 klen|key|u32 vlen|value]...
+        // length-prefixed so binary values (e.g. ADD counters) survive
+        std::string out;
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          for (auto it = st.kv.lower_bound(key); it != st.kv.end(); ++it) {
+            if (it->first.compare(0, key.size(), key) != 0) break;
+            uint32_t kl = static_cast<uint32_t>(it->first.size());
+            uint32_t vl = static_cast<uint32_t>(it->second.size());
+            out.append(reinterpret_cast<const char*>(&kl), 4);
+            out += it->first;
+            out.append(reinterpret_cast<const char*>(&vl), 4);
+            out += it->second;
+          }
+        }
+        ok = send_resp(fd, 0, out);
+        break;
+      }
+      case 7: {  // PING
+        ok = send_resp(fd, 0, "pong");
+        break;
+      }
+      default:
+        ok = send_resp(fd, -3, "");
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a server on `port` (0 = ephemeral).  Returns an opaque handle
+// or nullptr; the bound port is written to *out_port.
+void* kv_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = srv->port;
+  srv->acceptor = std::thread([srv] {
+    for (;;) {
+      int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (srv->stopping.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(srv->conns_mu);
+      srv->conn_fds.push_back(cfd);
+      srv->conns.emplace_back(serve_conn, srv, cfd);
+    }
+  });
+  return srv;
+}
+
+void kv_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  if (!srv) return;
+  srv->stopping.store(true);
+  srv->store.cv.notify_all();  // unpark WAITers (predicate sees stopping)
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->acceptor.joinable()) srv->acceptor.join();
+  {
+    // unblock conn threads parked in read(), then JOIN them — they
+    // reference srv->store, so srv must outlive every one of them
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : srv->conns)
+      if (t.joinable()) t.join();
+  }
+  delete srv;
+}
+
+int kv_server_port(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  return srv ? srv->port : -1;
+}
+
+// ---- client ----
+
+int kv_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+         0) {
+    if (std::chrono::steady_clock::now() >= deadline) {
+      ::close(fd);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void kv_close(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+namespace {
+int kv_request(int fd, uint8_t op, const char* key, const void* val,
+               uint32_t vlen, std::string* payload) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  std::string req;
+  req.resize(1 + 4 + klen + 4 + vlen);
+  size_t off = 0;
+  req[off++] = static_cast<char>(op);
+  std::memcpy(&req[off], &klen, 4);
+  off += 4;
+  std::memcpy(&req[off], key, klen);
+  off += klen;
+  std::memcpy(&req[off], &vlen, 4);
+  off += 4;
+  if (vlen) std::memcpy(&req[off], val, vlen);
+  if (!write_exact(fd, req.data(), req.size())) return -100;
+  int32_t status;
+  uint32_t len;
+  if (!read_exact(fd, &status, 4) || !read_exact(fd, &len, 4)) return -100;
+  payload->resize(len);
+  if (len && !read_exact(fd, &(*payload)[0], len)) return -100;
+  return status;
+}
+}  // namespace
+
+int kv_set(int fd, const char* key, const void* val, uint32_t vlen) {
+  std::string p;
+  return kv_request(fd, 1, key, val, vlen, &p);
+}
+
+// Returns payload length (>=0) or negative status.  Caller provides buf.
+int64_t kv_get(int fd, const char* key, void* buf, uint32_t buflen) {
+  std::string p;
+  int st = kv_request(fd, 2, key, nullptr, 0, &p);
+  if (st != 0) return st;
+  uint32_t n = p.size() < buflen ? static_cast<uint32_t>(p.size()) : buflen;
+  if (n) std::memcpy(buf, p.data(), n);
+  return static_cast<int64_t>(p.size());
+}
+
+int64_t kv_wait(int fd, const char* key, uint64_t timeout_ms, void* buf,
+                uint32_t buflen) {
+  std::string p;
+  int st = kv_request(fd, 3, key, &timeout_ms, 8, &p);
+  if (st != 0) return st;
+  uint32_t n = p.size() < buflen ? static_cast<uint32_t>(p.size()) : buflen;
+  if (n) std::memcpy(buf, p.data(), n);
+  return static_cast<int64_t>(p.size());
+}
+
+int64_t kv_add(int fd, const char* key, int64_t delta) {
+  std::string p;
+  int st = kv_request(fd, 4, key, &delta, 8, &p);
+  if (st != 0 || p.size() != 8) return INT64_MIN;
+  int64_t out;
+  std::memcpy(&out, p.data(), 8);
+  return out;
+}
+
+int kv_del(int fd, const char* key) {
+  std::string p;
+  return kv_request(fd, 5, key, nullptr, 0, &p);
+}
+
+int64_t kv_list(int fd, const char* prefix, void* buf, uint32_t buflen) {
+  std::string p;
+  int st = kv_request(fd, 6, prefix, nullptr, 0, &p);
+  if (st != 0) return st;
+  uint32_t n = p.size() < buflen ? static_cast<uint32_t>(p.size()) : buflen;
+  if (n) std::memcpy(buf, p.data(), n);
+  return static_cast<int64_t>(p.size());
+}
+
+int kv_ping(int fd) {
+  std::string p;
+  return kv_request(fd, 7, "", nullptr, 0, &p);
+}
+
+}  // extern "C"

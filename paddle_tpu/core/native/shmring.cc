@@ -1,0 +1,212 @@
+// Shared-memory SPSC ring buffer: the DataLoader worker->parent batch
+// transport.
+//
+// Reference behavior: python/paddle/io/dataloader/dataloader_iter.py:365
+// (_DataLoaderIterMultiProcess with use_shared_memory=True) + the C++
+// shm helpers in paddle/fluid/memory/allocation/mmap_allocator.cc —
+// worker processes place collated batches in shared memory so the
+// parent never pays a pipe/pickle copy per array.  TPU-native role:
+// feeding the host side of the input pipeline fast enough that H2D
+// transfer (async jax.device_put) is the only remaining stage.
+//
+// Design: one ring per worker (SPSC), fixed capacity, allocated in a
+// POSIX shm object.  Layout:
+//   [u64 capacity][atomic u64 head][atomic u64 tail][pad to 64B][data]
+// head = next write offset, tail = next read offset (both monotonically
+// increasing; index = off % capacity).  Records are [u32 len][payload]
+// written contiguously; a record that would straddle the end writes a
+// wrap marker (len = 0xFFFFFFFF) and starts at offset 0.  Producer
+// blocks (sleep 50us) while full; consumer returns -1 on timeout.
+// Single-producer/single-consumer means plain acquire/release atomics
+// suffice — no locks in the data path.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+
+namespace {
+
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+constexpr size_t kHeaderSize = 64;
+
+struct Header {
+  uint64_t capacity;
+  std::atomic<uint64_t> head;  // producer cursor
+  std::atomic<uint64_t> tail;  // consumer cursor
+};
+
+struct Ring {
+  Header* hdr = nullptr;
+  char* data = nullptr;
+  size_t map_len = 0;
+  std::string name;
+  bool owner = false;
+};
+
+inline uint64_t used(const Header* h) {
+  return h->head.load(std::memory_order_acquire) -
+         h->tail.load(std::memory_order_acquire);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or open (owner=0) a ring of `capacity` payload bytes.
+void* shmring_open(const char* name, uint64_t capacity, int owner) {
+  int flags = owner ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = ::shm_open(name, flags, 0600);
+  if (fd < 0 && owner) {  // stale object from a killed run: replace it
+    ::shm_unlink(name);
+    fd = ::shm_open(name, flags, 0600);
+  }
+  if (fd < 0) return nullptr;
+  size_t map_len = kHeaderSize + capacity;
+  if (owner && ::ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  if (!owner) {
+    struct stat st {};
+    if (::fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < map_len) {
+      ::close(fd);
+      return nullptr;
+    }
+  }
+  void* mem =
+      ::mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* ring = new Ring();
+  ring->hdr = static_cast<Header*>(mem);
+  ring->data = static_cast<char*>(mem) + kHeaderSize;
+  ring->map_len = map_len;
+  ring->name = name;
+  ring->owner = owner != 0;
+  if (owner) {
+    ring->hdr->capacity = capacity;
+    ring->hdr->head.store(0, std::memory_order_relaxed);
+    ring->hdr->tail.store(0, std::memory_order_relaxed);
+  }
+  return ring;
+}
+
+void shmring_close(void* handle) {
+  auto* ring = static_cast<Ring*>(handle);
+  if (!ring) return;
+  ::munmap(ring->hdr, ring->map_len);
+  if (ring->owner) ::shm_unlink(ring->name.c_str());
+  delete ring;
+}
+
+// Push one record.  Blocks while the ring is full (up to timeout_ms;
+// <0 = wait forever).  Returns 0 ok, -1 timeout, -2 record too large.
+int shmring_push(void* handle, const void* buf, uint32_t len,
+                 int64_t timeout_ms) {
+  auto* ring = static_cast<Ring*>(handle);
+  Header* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  // worst case a record costs contig (wrap waste, < 4+len) plus 4+len,
+  // so only records up to cap/2 are guaranteed to ever fit
+  if ((static_cast<uint64_t>(len) + 4) * 2 > cap) return -2;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+  for (;;) {
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t idx = head % cap;
+    uint64_t contig = cap - idx;  // bytes to the physical end
+    // a record never straddles the end; wrap if needed
+    uint64_t need = 4 + len;
+    bool wrap = contig < need && contig >= 4;
+    uint64_t total = wrap ? contig + need : (contig < 4 ? contig + need : need);
+    if (used(h) + total <= cap) {
+      if (contig < 4) {
+        // too small even for a marker: dead bytes, jump to 0
+        head += contig;
+        idx = 0;
+      } else if (wrap) {
+        std::memcpy(ring->data + idx, &kWrapMarker, 4);
+        head += contig;
+        idx = 0;
+      }
+      std::memcpy(ring->data + idx, &len, 4);
+      if (len) std::memcpy(ring->data + idx + 4, buf, len);
+      h->head.store(head + 4 + len, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
+      return -1;
+    std::this_thread::sleep_for(std::chrono::microseconds(50));
+  }
+}
+
+// Peek the next record's length without consuming (0 if empty).
+int64_t shmring_next_len(void* handle) {
+  auto* ring = static_cast<Ring*>(handle);
+  Header* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  for (;;) {
+    if (h->head.load(std::memory_order_acquire) == tail) return 0;
+    uint64_t idx = tail % cap;
+    uint64_t contig = cap - idx;
+    if (contig < 4) {
+      tail += contig;  // dead bytes
+      h->tail.store(tail, std::memory_order_release);
+      continue;
+    }
+    uint32_t len;
+    std::memcpy(&len, ring->data + idx, 4);
+    if (len == kWrapMarker) {
+      tail += contig;
+      h->tail.store(tail, std::memory_order_release);
+      continue;
+    }
+    return static_cast<int64_t>(len);
+  }
+}
+
+// Pop one record into buf (must be >= record length; use
+// shmring_next_len).  Returns record length, -1 on timeout.
+int64_t shmring_pop(void* handle, void* buf, uint32_t buflen,
+                    int64_t timeout_ms) {
+  auto* ring = static_cast<Ring*>(handle);
+  Header* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+  for (;;) {
+    int64_t n = shmring_next_len(handle);
+    if (n > 0) {
+      uint64_t tail = h->tail.load(std::memory_order_relaxed);
+      uint64_t idx = tail % cap;
+      uint32_t len = static_cast<uint32_t>(n);
+      uint32_t m = len < buflen ? len : buflen;
+      if (m) std::memcpy(buf, ring->data + idx + 4, m);
+      h->tail.store(tail + 4 + len, std::memory_order_release);
+      return n;
+    }
+    if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
+      return -1;
+    std::this_thread::sleep_for(std::chrono::microseconds(50));
+  }
+}
+
+uint64_t shmring_used(void* handle) {
+  return used(static_cast<Ring*>(handle)->hdr);
+}
+
+uint64_t shmring_capacity(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->capacity;
+}
+
+}  // extern "C"

@@ -1,0 +1,397 @@
+"""paddle.distributed TCPStore — rendezvous/coordination KV store.
+
+Reference behavior: paddle/phi/core/distributed/store/tcp_store.h:121 and
+store/store.h:24 — the master rank hosts a TCP server; every rank's
+store speaks {set, get (blocking), add (atomic counter), wait} to it.
+Paddle uses it to bootstrap ProcessGroups; here it bootstraps
+``jax.distributed`` / the launch rendezvous and backs barriers in the
+launch controllers.
+
+The server and wire protocol are native C++ (core/native/kvstore.cc,
+compiled on demand); a pure-Python client/server speaking the same
+protocol is the fallback when no toolchain exists, so behavior is
+identical either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core import native
+
+__all__ = ["TCPStore", "Store"]
+
+
+class Store:
+    """Abstract store interface (reference store/store.h:24)."""
+
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- python
+# fallback server/client implementing the kvstore.cc wire protocol
+
+_OP_SET, _OP_GET, _OP_WAIT, _OP_ADD, _OP_DEL, _OP_LIST, _OP_PING = \
+    1, 2, 3, 4, 5, 6, 7
+
+
+class _PyKVServer:
+    def __init__(self, port: int = 0):
+        self._kv: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._acceptor = threading.Thread(target=self._accept, daemon=True)
+        self._acceptor.start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                op = self._read_exact(conn, 1)[0]
+                klen, = struct.unpack("<I", self._read_exact(conn, 4))
+                key = self._read_exact(conn, klen).decode()
+                vlen, = struct.unpack("<I", self._read_exact(conn, 4))
+                val = self._read_exact(conn, vlen)
+                status, payload = self._handle(op, key, val)
+                conn.sendall(struct.pack("<iI", status, len(payload))
+                             + payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, op, key, val):
+        if op == _OP_SET:
+            with self._cv:
+                self._kv[key] = val
+                self._cv.notify_all()
+            return 0, b""
+        if op == _OP_GET:
+            with self._cv:
+                if key in self._kv:
+                    return 0, self._kv[key]
+            return -1, b""
+        if op == _OP_WAIT:
+            timeout_ms, = struct.unpack("<Q", val) if len(val) == 8 else (0,)
+            deadline = time.monotonic() + timeout_ms / 1000.0
+            with self._cv:
+                while key not in self._kv and not self._stop:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0 or not self._cv.wait(timeout=rem):
+                        break
+                if key in self._kv:
+                    return 0, self._kv[key]
+            return -2, b""
+        if op == _OP_ADD:
+            delta, = struct.unpack("<q", val) if len(val) == 8 else (0,)
+            with self._cv:
+                raw = self._kv.get(key, b"\0" * 8)
+                # non-counter value under this key: treat as 0, exactly
+                # like the native server (kvstore.cc ADD)
+                cur = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
+                now = cur + delta
+                self._kv[key] = struct.pack("<q", now)
+                self._cv.notify_all()
+            return 0, struct.pack("<q", now)
+        if op == _OP_DEL:
+            with self._cv:
+                return (0 if self._kv.pop(key, None) is not None else -1), b""
+        if op == _OP_LIST:
+            # length-prefixed pairs, same wire format as kvstore.cc LIST
+            out = b""
+            with self._cv:
+                for k in sorted(self._kv):
+                    if k.startswith(key):
+                        kb = k.encode()
+                        out += struct.pack("<I", len(kb)) + kb
+                        out += struct.pack("<I", len(self._kv[k])) \
+                            + self._kv[k]
+            return 0, out
+        if op == _OP_PING:
+            return 0, b"pong"
+        return -3, b""
+
+    def stop(self):
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PyKVClient:
+    def __init__(self, host: str, port: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5.0)
+                break
+            except OSError as e:
+                last = e
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"cannot reach TCPStore at {host}:{port}") from last
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+
+    def request(self, op: int, key: str, val: bytes = b""):
+        kb = key.encode()
+        msg = struct.pack("<BI", op, len(kb)) + kb + \
+            struct.pack("<I", len(val)) + val
+        with self._lock:
+            self._sock.sendall(msg)
+            hdr = _PyKVServer._read_exact(self._sock, 8)
+            status, length = struct.unpack("<iI", hdr)
+            payload = _PyKVServer._read_exact(self._sock, length) \
+                if length else b""
+        return status, payload
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------- native
+
+class _NativeBackend:
+    def __init__(self, lib):
+        self.lib = lib
+        self.server = None
+        self.fd = -1
+
+    def start_server(self, port):
+        out = ctypes.c_int(0)
+        self.server = self.lib.kv_server_start(port, ctypes.byref(out))
+        if not self.server:
+            raise RuntimeError(f"TCPStore: cannot bind port {port}")
+        return out.value
+
+    def connect(self, host, port, timeout):
+        # kv_connect takes a dotted quad; resolve names first
+        ip = socket.gethostbyname(host)
+        self.fd = self.lib.kv_connect(ip.encode(), port,
+                                      int(timeout * 1000))
+        if self.fd < 0:
+            raise ConnectionError(
+                f"cannot reach TCPStore at {host}:{port}")
+
+
+class TCPStore(Store):
+    """TCP KV store (reference tcp_store.h:121 API surface).
+
+    One process passes ``is_master=True`` and hosts the server; every
+    process (master included) is a client.  Values are bytes/str.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._barrier_seq: Dict[str, int] = {}
+        self._lib = native.load()
+        self._py_server = None
+        self._nat = None
+        self._py_client = None
+        if self._lib is not None:
+            self._nat = _NativeBackend(self._lib)
+            if is_master:
+                port = self._nat.start_server(port)
+            self.port = port
+            self._nat.connect(host, port, timeout)
+        else:
+            if is_master:
+                self._py_server = _PyKVServer(port)
+                port = self._py_server.port
+            self.port = port
+            self._py_client = _PyKVClient(host, port, timeout)
+
+    # -- Store API --------------------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if self._nat:
+            rc = self._lib.kv_set(self._nat.fd, key.encode(), value,
+                                  len(value))
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.set({key!r}) failed: {rc}")
+        else:
+            st, _ = self._py_client.request(_OP_SET, key, value)
+            if st != 0:
+                raise RuntimeError(f"TCPStore.set({key!r}) failed: {st}")
+
+    def get(self, key: str) -> bytes:
+        """Blocking get (reference Store::get waits for the key)."""
+        payload = self._wait_one(key, self.timeout)
+        if payload is None:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out after "
+                               f"{self.timeout}s")
+        return payload
+
+    def _nat_get(self, key: str, size_hint: int = 1 << 20):
+        """Native GET sized exactly: retry with the reported length when
+        the value outgrows the first buffer (no silent truncation)."""
+        while True:
+            buf = ctypes.create_string_buffer(size_hint)
+            n = self._lib.kv_get(self._nat.fd, key.encode(), buf, size_hint)
+            if n < 0:
+                return None
+            if n <= size_hint:
+                return bytes(buf.raw[:n])
+            size_hint = int(n)
+
+    def get_nowait(self, key: str) -> Optional[bytes]:
+        if self._nat:
+            return self._nat_get(key)
+        st, payload = self._py_client.request(_OP_GET, key)
+        return payload if st == 0 else None
+
+    def _wait_one(self, key: str, timeout: float) -> Optional[bytes]:
+        ms = max(int(timeout * 1000), 1)
+        if self._nat:
+            buf = ctypes.create_string_buffer(1 << 20)
+            n = self._lib.kv_wait(self._nat.fd, key.encode(), ms, buf,
+                                  1 << 20)
+            if n < 0:
+                return None
+            if n <= 1 << 20:
+                return bytes(buf.raw[:n])
+            return self._nat_get(key, int(n))  # key exists now; re-fetch
+        st, payload = self._py_client.request(
+            _OP_WAIT, key, struct.pack("<Q", ms))
+        return payload if st == 0 else None
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._nat:
+            out = self._lib.kv_add(self._nat.fd, key.encode(), amount)
+            if out == -(2 ** 63):
+                raise RuntimeError(f"TCPStore.add({key!r}) failed")
+            return out
+        st, payload = self._py_client.request(
+            _OP_ADD, key, struct.pack("<q", amount))
+        if st != 0:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed: {st}")
+        return struct.unpack("<q", payload)[0]
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        deadline = time.monotonic() + (timeout or self.timeout)
+        for k in keys:
+            rem = deadline - time.monotonic()
+            if rem <= 0 or self._wait_one(k, rem) is None:
+                raise TimeoutError(f"TCPStore.wait: key {k!r} not set")
+
+    def delete_key(self, key: str) -> bool:
+        if self._nat:
+            return self._lib.kv_del(self._nat.fd, key.encode()) == 0
+        st, _ = self._py_client.request(_OP_DEL, key)
+        return st == 0
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        if self._nat:
+            size = 1 << 22
+            while True:
+                buf = ctypes.create_string_buffer(size)
+                n = self._lib.kv_list(self._nat.fd, prefix.encode(), buf,
+                                      size)
+                if n <= size:
+                    raw = bytes(buf.raw[:n]) if n > 0 else b""
+                    break
+                size = int(n)  # listing outgrew the buffer: retry sized
+        else:
+            _, raw = self._py_client.request(_OP_LIST, prefix)
+        out: Dict[str, bytes] = {}
+        pos = 0
+        while pos + 4 <= len(raw):
+            kl, = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            k = raw[pos:pos + kl].decode()
+            pos += kl
+            vl, = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            out[k] = raw[pos:pos + vl]
+            pos += vl
+        return out
+
+    def barrier(self, name: str = "barrier", timeout: Optional[float] = None):
+        """All ``world_size`` processes meet (add + wait pattern).
+
+        Reusable: each instance counts how many times it has entered a
+        barrier of this name, so round K only completes once every rank
+        has entered K times (same contract as the reference's
+        store-based barrier)."""
+        seq = self._barrier_seq.get(name, 0) + 1
+        self._barrier_seq[name] = seq
+        n = self.add(f"/__barrier__/{name}/{seq}", 1)
+        if n >= self.world_size:
+            self.set(f"/__barrier_done__/{name}/{seq}", b"1")
+        self.wait([f"/__barrier_done__/{name}/{seq}"], timeout)
+
+    def stop(self):
+        if self._nat:
+            if self._nat.fd >= 0:
+                self._lib.kv_close(self._nat.fd)
+                self._nat.fd = -1
+            if self._nat.server:
+                self._lib.kv_server_stop(self._nat.server)
+                self._nat.server = None
+        if self._py_client is not None:
+            self._py_client.close()
+            self._py_client = None
+        if self._py_server is not None:
+            self._py_server.stop()
+            self._py_server = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001
+            pass

@@ -62,16 +62,24 @@ def _to_numpy_tree(x):
     return x
 
 
+_SHM_SENTINEL = "__shm__"
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn,
-                 worker_init_fn, worker_id, num_workers, base_seed):
+                 worker_init_fn, worker_id, num_workers, base_seed,
+                 shm_name=None, shm_bytes=0):
     """Reference: dataloader/worker.py _worker_loop."""
     np.random.seed((base_seed + worker_id) % (2 ** 32))
+    ring = None
     try:
         import paddle_tpu.io as _io  # set get_worker_info() state
         _io._worker_info = _io._WorkerInfo(
             id=worker_id, num_workers=num_workers, dataset=dataset)
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
+        if shm_name is not None:
+            from . import shm as _shm
+            ring = _shm.ShmRing(shm_name, shm_bytes, owner=False)
     except Exception as e:  # noqa: BLE001
         data_queue.put((-1, _WorkerError(e)))
         return
@@ -85,10 +93,21 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn,
         batch_idx, idx_batch = job
         try:
             samples = [dataset[i] for i in idx_batch]
-            data_queue.put(
-                (batch_idx, _to_numpy_tree(collate_fn(samples))))
+            batch = _to_numpy_tree(collate_fn(samples))
+            if ring is not None:
+                from . import shm as _shm
+                ring.push(_shm.pack_tree(batch))
+                # control message only; payload went through this
+                # worker's FIFO ring, so (sentinel, wid) is enough for
+                # the parent to pop the matching record
+                data_queue.put((batch_idx, (_SHM_SENTINEL, worker_id)))
+            else:
+                data_queue.put((batch_idx, batch))
         except Exception as e:  # noqa: BLE001
             data_queue.put((batch_idx, _WorkerError(e)))
+
+
+_shm_tag_counter = [0]
 
 
 class MultiprocessBatchIterator:
@@ -101,7 +120,9 @@ class MultiprocessBatchIterator:
                  worker_init_fn: Optional[Callable] = None,
                  timeout: float = 0,
                  to_device: Optional[Callable] = None,
-                 mp_context: Optional[str] = None):
+                 mp_context: Optional[str] = None,
+                 use_shared_memory: Optional[bool] = None,
+                 shm_ring_bytes: int = 64 << 20):
         self._batches = list(batch_indices)
         self._collate = collate_fn or np_collate
         self._timeout = timeout or None
@@ -112,13 +133,35 @@ class MultiprocessBatchIterator:
         self._data_queue = ctx.Queue()
         self._index_queues = []
         self._procs = []
+        # shared-memory payload path (reference use_shared_memory=True);
+        # on by default whenever the native ring is available
+        self._rings = []
+        if use_shared_memory is None:
+            use_shared_memory = os.environ.get(
+                "PADDLE_TPU_USE_SHM", "1") == "1"
+        if use_shared_memory:
+            try:
+                from . import shm as _shm
+                if _shm.shm_available():
+                    # process-wide counter: names stay unique across all
+                    # concurrently-alive loaders in this process
+                    _shm_tag_counter[0] += 1
+                    tag = f"/pt_dl_{os.getpid()}_{_shm_tag_counter[0]}"
+                    self._rings = [
+                        _shm.ShmRing(f"{tag}_{wid}", shm_ring_bytes,
+                                     owner=True)
+                        for wid in range(self._num_workers)]
+            except Exception:  # noqa: BLE001 - fall back to queue payloads
+                self._rings = []
         base_seed = int.from_bytes(os.urandom(4), "little")
         for wid in range(self._num_workers):
             iq = ctx.Queue()
+            shm_name = self._rings[wid].name if self._rings else None
             p = ctx.Process(
                 target=_worker_loop,
                 args=(dataset, iq, self._data_queue, self._collate,
-                      worker_init_fn, wid, self._num_workers, base_seed),
+                      worker_init_fn, wid, self._num_workers, base_seed,
+                      shm_name, shm_ring_bytes),
                 daemon=True)
             p.start()
             self._index_queues.append(iq)
@@ -166,6 +209,16 @@ class MultiprocessBatchIterator:
                 self.shutdown()
                 raise RuntimeError(
                     "DataLoader worker raised:\n" + payload.msg)
+            if isinstance(payload, tuple) and len(payload) == 2 and \
+                    isinstance(payload[0], str) and \
+                    payload[0] == _SHM_SENTINEL:
+                from . import shm as _shm
+                blob = self._rings[payload[1]].pop(timeout=30.0)
+                if blob is None:
+                    self.shutdown()
+                    raise RuntimeError(
+                        "DataLoader shm ring timed out fetching a batch")
+                payload = _shm.unpack_tree(blob)
             self._reorder[idx] = payload
         batch = self._reorder.pop(self._rcvd_idx)
         self._rcvd_idx += 1
@@ -183,6 +236,12 @@ class MultiprocessBatchIterator:
             if p.is_alive():
                 p.terminate()
         self._procs = []
+        for r in self._rings:
+            try:
+                r.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._rings = []
 
     def __del__(self):
         try:

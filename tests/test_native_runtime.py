@@ -1,0 +1,219 @@
+"""Native runtime components: TCPStore (kvstore.cc) + shm ring
+(shmring.cc) + the shared-memory DataLoader path.
+
+Reference: paddle/phi/core/distributed/store/tcp_store.h (TCPStore
+set/get/wait/add semantics), python/paddle/io/dataloader worker shm
+payloads.
+"""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.io.shm import (ShmRing, pack_tree, shm_available,
+                               unpack_tree)
+from paddle_tpu.io.worker import MultiprocessBatchIterator
+
+
+def test_native_builds():
+    # the toolchain is part of the image; the native path must be real
+    assert native.available()
+
+
+def test_tcpstore_set_get_add():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                      timeout=10.0)
+    client = TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=2, timeout=10.0)
+    master.set("k", b"v1")
+    assert client.get("k") == b"v1"
+    client.set("k", "v2")
+    assert master.get("k") == b"v2"
+    assert master.add("ctr", 3) == 3
+    assert client.add("ctr", 4) == 7
+    assert client.get_nowait("absent") is None
+    assert master.delete_key("k")
+    assert master.get_nowait("k") is None
+    d = {f"/p/{i}": str(i).encode() for i in range(3)}
+    for k, v in d.items():
+        client.set(k, v)
+    assert master.list_prefix("/p/") == d
+    # binary values (8-byte counters contain NULs) must survive listing
+    client.add("/p/ctr", 1)
+    assert master.list_prefix("/p/")["/p/ctr"] == (1).to_bytes(8, "little")
+    client.stop()
+    master.stop()
+
+
+def test_tcpstore_wait_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10.0)
+    result = {}
+
+    def waiter():
+        c = TCPStore("127.0.0.1", master.port, timeout=10.0)
+        t0 = time.monotonic()
+        result["value"] = c.get("late")           # blocking get
+        result["dt"] = time.monotonic() - t0
+        c.stop()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    master.set("late", b"now")
+    t.join(timeout=5.0)
+    assert result["value"] == b"now"
+    assert result["dt"] >= 0.25                   # actually blocked
+    master.stop()
+
+
+def test_tcpstore_wait_timeout():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=0.3)
+    with pytest.raises(TimeoutError):
+        master.get("never")
+    master.stop()
+
+
+def test_tcpstore_barrier_across_threads():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=3,
+                      timeout=10.0)
+    arrived = []
+
+    def member(i):
+        c = TCPStore("127.0.0.1", master.port, world_size=3, timeout=10.0)
+        c.barrier("b1")
+        arrived.append(i)
+        c.stop()
+
+    threads = [threading.Thread(target=member, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    assert arrived == []                          # still parked
+    master.barrier("b1")
+    for t in threads:
+        t.join(timeout=5.0)
+    assert sorted(arrived) == [0, 1]
+    master.stop()
+
+
+def test_tcpstore_barrier_is_reusable():
+    """Round K of a same-named barrier must wait for round-K entries."""
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                      timeout=10.0)
+    client = TCPStore("127.0.0.1", master.port, world_size=2, timeout=10.0)
+    order = []
+
+    def member():
+        client.barrier("epoch")
+        order.append("c1")
+        time.sleep(0.3)
+        client.barrier("epoch")
+        order.append("c2")
+
+    t = threading.Thread(target=member)
+    t.start()
+    master.barrier("epoch")
+    # round 2: the client sleeps 0.3s before entering; if the barrier
+    # were not generation-aware this would fall straight through
+    t0 = time.monotonic()
+    master.barrier("epoch")
+    assert time.monotonic() - t0 >= 0.25
+    t.join(timeout=5.0)
+    assert order == ["c1", "c2"]
+    client.stop()
+    master.stop()
+
+
+def test_tcpstore_add_on_non_counter_value():
+    """ADD on a key holding junk treats it as 0 (native + python)."""
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    master.set("junk", b"x")
+    assert master.add("junk", 5) == 5
+    master.stop()
+
+
+def test_tcpstore_large_value_roundtrip():
+    """Values beyond the 1 MiB first-try buffer must not truncate."""
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10.0)
+    blob = bytes(range(256)) * (8 << 12)          # 8 MiB patterned
+    master.set("big", blob)
+    got = master.get("big")
+    assert len(got) == len(blob) and got == blob
+    master.stop()
+
+
+def test_pack_unpack_tree_roundtrip():
+    tree = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+            {"ids": np.arange(5, dtype=np.int64), "tag": "x", "n": 7},
+            (np.float32(1.5), [np.ones((2, 2), dtype=np.uint8)])]
+    out = unpack_tree(pack_tree(tree))
+    np.testing.assert_array_equal(out[0], tree[0])
+    np.testing.assert_array_equal(out[1]["ids"], tree[1]["ids"])
+    assert out[1]["tag"] == "x" and out[1]["n"] == 7
+    assert isinstance(out[2], tuple)
+    np.testing.assert_array_equal(out[2][1][0], tree[2][1][0])
+
+
+def _ring_producer(name, cap, n):
+    ring = ShmRing(name, cap, owner=False)
+    for i in range(n):
+        blob = pack_tree({"i": np.full((100,), i, dtype=np.int32)})
+        ring.push(blob, timeout=10.0)
+    ring.close()
+
+
+def test_shmring_cross_process_fifo():
+    if not shm_available():
+        pytest.skip("no native toolchain")
+    name, cap, n = "/pt_test_fifo", 1 << 16, 40   # forces wraparound
+    ring = ShmRing(name, cap, owner=True)
+    p = mp.get_context("fork").Process(
+        target=_ring_producer, args=(name, cap, n))
+    p.start()
+    for i in range(n):
+        blob = ring.pop(timeout=10.0)
+        assert blob is not None
+        tree = unpack_tree(blob)
+        assert tree["i"][0] == i                  # strict FIFO
+    p.join(timeout=5.0)
+    ring.close()
+
+
+def test_shmring_too_large_record_raises():
+    if not shm_available():
+        pytest.skip("no native toolchain")
+    ring = ShmRing("/pt_test_big", 1 << 12, owner=True)
+    with pytest.raises(ValueError, match="capacity"):
+        ring.push(b"x" * (1 << 13))
+    ring.close()
+
+
+class _ArrayDataset:
+    def __init__(self, n=64):
+        self.x = np.random.RandomState(0).randn(n, 8).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i]
+
+
+@pytest.mark.parametrize("use_shm", [True, False])
+def test_dataloader_shm_payload_parity(use_shm):
+    if use_shm and not shm_available():
+        pytest.skip("no native toolchain")
+    ds = _ArrayDataset()
+    batches = [list(range(i, i + 8)) for i in range(0, 64, 8)]
+    it = MultiprocessBatchIterator(
+        ds, batches, num_workers=2, use_shared_memory=use_shm,
+        shm_ring_bytes=1 << 20)
+    got = list(it)
+    assert len(got) == 8
+    for bi, batch in zip(batches, got):
+        np.testing.assert_array_equal(batch, ds.x[bi])
