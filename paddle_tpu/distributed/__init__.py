@@ -27,3 +27,4 @@ from . import utils  # noqa: F401
 from . import rpc  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401
+from . import auto_tuner  # noqa: F401

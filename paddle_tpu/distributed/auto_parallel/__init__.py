@@ -23,7 +23,8 @@ from .. import mesh as _mesh
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
            "shard_op", "get_mesh", "set_mesh", "to_static", "Strategy",
-           "DistAttr", "dtensor_to_local"]
+           "DistAttr", "dtensor_to_local", "Engine", "Cluster",
+           "CostEstimator", "complete_jaxpr"]
 
 
 class Placement:
@@ -296,6 +297,7 @@ class Strategy:
         self.gradient_merge = _SubConfig(config.get("gradient_merge", {}))
         self.pipeline = _SubConfig(config.get("pipeline", {}))
         self.amp = _SubConfig(config.get("amp", {}))
+        self.recompute = _SubConfig(config.get("recompute", {}))
 
 
 class _SubConfig:
@@ -317,3 +319,8 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
     jit around the layer."""
     from ...jit import to_static as jit_to_static
     return jit_to_static(layer)
+
+
+# static auto-parallel engine (reference static/engine.py — D14)
+from .static_engine import (  # noqa: F401,E402
+    Cluster, CostEstimator, Engine, complete_jaxpr)

@@ -1,0 +1,241 @@
+"""Distributed-config auto-tuner (reference: python/paddle/distributed/
+auto_tuner/ — tuner.py Tuner, prune.py prune rules, utils.py search
+space, recorder.py history).
+
+Searches the hybrid-parallel grid {dp, mp, pp, sharding stage,
+micro-batch, recompute} for a model + cluster, prunes infeasible points
+with divisibility and a memory model, ranks the rest with an analytic
+step-time model (MXU compute + DP/MP/PP communication over ICI), and
+can optionally measure the top candidates with a user-supplied
+``run_fn`` (the reference launches real trial jobs; here a trial is a
+callback so tests can run it in-process on the CPU mesh).
+
+TPU-native notes: the memory model follows ZeRO placement semantics
+(stage 1 shards optimizer states over dp, stage 2 adds grads, stage 3
+adds params) and the comm model prices XLA collectives with the ring
+model on ICI bandwidth — the same Cluster used by the static engine's
+cost model.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from dataclasses import dataclass, field, asdict
+from typing import Callable, Dict, List, Optional
+
+from ..auto_parallel.static_engine import Cluster
+
+__all__ = ["ModelSpec", "SearchSpace", "Candidate", "MemoryModel",
+           "TimeModel", "Tuner", "prune_candidates"]
+
+
+@dataclass
+class ModelSpec:
+    """Transformer-shaped workload description."""
+    num_layers: int = 32
+    hidden: int = 4096
+    ffn_hidden: int = 11008
+    num_heads: int = 32
+    vocab_size: int = 32000
+    seq_len: int = 2048
+    global_batch: int = 64            # sequences per step
+    dtype_bytes: int = 2              # bf16 params/activations
+
+    @property
+    def num_params(self) -> float:
+        per_layer = (4 * self.hidden * self.hidden
+                     + 3 * self.hidden * self.ffn_hidden)
+        return per_layer * self.num_layers + \
+            2 * self.vocab_size * self.hidden
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.global_batch * self.seq_len
+
+
+@dataclass
+class SearchSpace:
+    dp: Optional[List[int]] = None            # None = all divisors
+    mp: List[int] = field(default_factory=lambda: [1, 2, 4, 8])
+    pp: List[int] = field(default_factory=lambda: [1, 2, 4, 8])
+    sharding_stage: List[int] = field(default_factory=lambda: [0, 1, 2, 3])
+    micro_batch: List[int] = field(default_factory=lambda: [1, 2, 4, 8])
+    recompute: List[bool] = field(default_factory=lambda: [False, True])
+
+
+@dataclass
+class Candidate:
+    dp: int
+    mp: int
+    pp: int
+    sharding_stage: int
+    micro_batch: int
+    recompute: bool
+    est_memory: float = 0.0
+    est_time: float = 0.0
+    measured_time: Optional[float] = None
+    pruned: Optional[str] = None
+
+    def as_dict(self) -> Dict:
+        return asdict(self)
+
+
+class MemoryModel:
+    """Per-device HBM estimate (reference prune.py memory rules).
+
+    AdamW states are fp32 m+v plus an fp32 master copy = 12 bytes/param;
+    params/grads live in ``dtype_bytes``.  ZeRO shards: stage1 states/dp,
+    stage2 +grads/dp, stage3 +params/dp.  Activations per microbatch
+    follow the standard transformer estimate, /2 under recompute-heavy
+    policy, and only live for the layers resident on this pp stage."""
+
+    def __init__(self, model: ModelSpec, cluster: Cluster):
+        self.m = model
+        self.c = cluster
+
+    def estimate(self, cand: Candidate) -> float:
+        m = self.m
+        p_local = m.num_params / cand.mp / cand.pp
+        shard = max(cand.dp, 1)
+        param_b = m.dtype_bytes * p_local / (
+            shard if cand.sharding_stage >= 3 else 1)
+        grad_b = m.dtype_bytes * p_local / (
+            shard if cand.sharding_stage >= 2 else 1)
+        opt_b = 12.0 * p_local / (
+            shard if cand.sharding_stage >= 1 else 1)
+        layers_here = max(m.num_layers // cand.pp, 1)
+        act_per_layer = m.seq_len * cand.micro_batch * m.hidden * \
+            m.dtype_bytes * (34.0 / max(cand.mp, 1))
+        if cand.recompute:
+            act_per_layer /= 8.0              # keep boundaries only
+        # 1F1B keeps up to pp microbatches of this stage's activations
+        # in flight on the first stage (bounded by the microbatch count)
+        micro_count = max(
+            m.global_batch // max(cand.dp, 1) // cand.micro_batch, 1)
+        act_b = act_per_layer * layers_here * min(cand.pp, micro_count)
+        return param_b + grad_b + opt_b + act_b
+
+
+class TimeModel:
+    """Analytic step time: MXU compute + DP grad all-reduce + MP
+    per-layer all-reduces + PP bubble (reference cost model role, tuned
+    for the ICI ring model)."""
+
+    MFU = 0.4                                  # attainable fraction
+
+    def __init__(self, model: ModelSpec, cluster: Cluster):
+        self.m = model
+        self.c = cluster
+
+    def estimate(self, cand: Candidate) -> float:
+        m, c = self.m, self.c
+        n_dev = cand.dp * cand.mp * cand.pp
+        flops = 6.0 * m.num_params * m.tokens_per_step
+        if cand.recompute:
+            flops *= 4.0 / 3.0                 # extra fwd in bwd
+        compute = flops / (n_dev * c.flops_per_device * self.MFU)
+
+        grad_bytes = m.dtype_bytes * m.num_params / cand.mp / cand.pp
+        t_dp = c.collective_time("all_reduce", grad_bytes, cand.dp)
+
+        # MP: 4 all-reduces per layer per microbatch (2 fwd + 2 bwd)
+        micro_count = max(
+            m.global_batch // max(cand.dp, 1) // cand.micro_batch, 1)
+        act_bytes = m.seq_len * cand.micro_batch * m.hidden * m.dtype_bytes
+        t_mp = 4 * m.num_layers / cand.pp * micro_count * \
+            c.collective_time("all_reduce", act_bytes, cand.mp)
+
+        # PP: bubble fraction (pp-1)/(micro_count + pp - 1) on compute,
+        # per-microbatch boundary sends, and a fixed per-microbatch
+        # schedule/dispatch overhead (each microbatch is its own program
+        # step on every stage — this is what makes pp a loss for models
+        # whose compute does not dwarf launch costs)
+        bubble = (cand.pp - 1) / max(micro_count + cand.pp - 1, 1)
+        t_pp = compute * bubble + micro_count * 2 * \
+            c.collective_time("ppermute", act_bytes, cand.pp) * \
+            (cand.pp - 1) / max(cand.pp, 1)
+        if cand.pp > 1:
+            t_pp += micro_count * 25e-6
+        return compute + t_dp + t_mp + t_pp
+
+
+def prune_candidates(cands: List[Candidate], model: ModelSpec,
+                     cluster: Cluster) -> List[Candidate]:
+    """Reference prune.py rule set, adapted: divisibility, topology, and
+    memory feasibility.  Pruned candidates keep a reason string."""
+    mem = MemoryModel(model, cluster)
+    kept = []
+    for c in cands:
+        n = c.dp * c.mp * c.pp
+        if n != cluster.num_devices:
+            c.pruned = f"dp*mp*pp={n} != num_devices"
+        elif model.hidden % c.mp or model.num_heads % c.mp:
+            c.pruned = "hidden/heads not divisible by mp"
+        elif model.num_layers % c.pp:
+            c.pruned = "layers not divisible by pp"
+        elif model.global_batch % (c.dp * c.micro_batch):
+            c.pruned = "global_batch not divisible by dp*micro"
+        elif c.sharding_stage > 0 and c.dp == 1:
+            c.pruned = "sharding needs dp>1"
+        elif c.sharding_stage >= 2 and c.pp > 1:
+            # grad-sharding inside a pipeline conflicts with grad accum
+            c.pruned = "stage>=2 incompatible with pp"
+        else:
+            c.est_memory = mem.estimate(c)
+            if c.est_memory > cluster.hbm_bytes * 0.92:
+                c.pruned = (f"memory {c.est_memory/1e9:.1f}GB > HBM "
+                            f"{cluster.hbm_bytes/1e9:.0f}GB")
+        if c.pruned is None:
+            kept.append(c)
+    return kept
+
+
+class Tuner:
+    """Reference tuner.py Tuner: generate -> prune -> rank -> (optionally)
+    measure top-k with run_fn -> best config."""
+
+    def __init__(self, model: ModelSpec, cluster: Optional[Cluster] = None,
+                 space: Optional[SearchSpace] = None,
+                 run_fn: Optional[Callable[[Candidate], float]] = None):
+        self.model = model
+        self.cluster = cluster or Cluster()
+        self.space = space or SearchSpace()
+        self.run_fn = run_fn
+        self.history: List[Candidate] = []
+
+    def generate(self) -> List[Candidate]:
+        n = self.cluster.num_devices
+        dps = self.space.dp or [d for d in range(1, n + 1) if n % d == 0]
+        out = []
+        for dp, mp, pp, st, mb, rc in itertools.product(
+                dps, self.space.mp, self.space.pp,
+                self.space.sharding_stage, self.space.micro_batch,
+                self.space.recompute):
+            out.append(Candidate(dp, mp, pp, st, mb, rc))
+        return out
+
+    def tune(self, top_k: int = 3) -> Candidate:
+        cands = self.generate()
+        feasible = prune_candidates(cands, self.model, self.cluster)
+        self.history = cands
+        if not feasible:
+            raise RuntimeError(
+                "auto_tuner: no feasible parallel config; model too "
+                "large for the cluster even with mp*pp sharding")
+        tm = TimeModel(self.model, self.cluster)
+        for c in feasible:
+            c.est_time = tm.estimate(c)
+        feasible.sort(key=lambda c: c.est_time)
+        if self.run_fn is not None:
+            for c in feasible[:top_k]:
+                c.measured_time = float(self.run_fn(c))
+            feasible[:top_k] = sorted(
+                feasible[:top_k],
+                key=lambda c: c.measured_time)
+        return feasible[0]
+
+    def export_history(self, path: str):
+        """recorder.py analog: dump every candidate with prune reasons."""
+        with open(path, "w") as f:
+            json.dump([c.as_dict() for c in self.history], f, indent=1)
