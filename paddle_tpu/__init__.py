@@ -73,6 +73,7 @@ from . import audio  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
+from . import strings  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import hapi as _hapi  # noqa: F401,E402

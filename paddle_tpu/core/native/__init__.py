@@ -20,7 +20,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["kvstore.cc", "shmring.cc"]
+_SOURCES = ["kvstore.cc", "shmring.cc", "tokenizer.cc"]
 _lock = threading.Lock()
 _lib = None
 _tried = False
@@ -61,6 +61,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "shmring_next_len": ([c.c_void_p], c.c_int64),
         "shmring_used": ([c.c_void_p], c.c_uint64),
         "shmring_capacity": ([c.c_void_p], c.c_uint64),
+        # tokenizer
+        "tok_create": ([c.c_char_p, c.c_uint64, c.c_int, c.c_char_p],
+                       c.c_void_p),
+        "tok_free": ([c.c_void_p], None),
+        "tok_vocab_size": ([c.c_void_p], c.c_int64),
+        "tok_token_id": ([c.c_void_p, c.c_char_p], c.c_int64),
+        "tok_encode": ([c.c_void_p, c.c_char_p,
+                        c.POINTER(c.c_int64), c.c_uint64], c.c_int64),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

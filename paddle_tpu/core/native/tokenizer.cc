@@ -1,0 +1,180 @@
+// WordPiece tokenizer: the faster_tokenizer op's native core.
+//
+// Reference behavior: paddle/fluid/operators/string/faster_tokenizer_op
+// (BertTokenizer: basic tokenize -> wordpiece over a vocab, CLS/SEP,
+// truncation, lowercase option) backed by the C++ string tensors in
+// paddle/phi/core/string_tensor.h.  TPU-native role: tokenization is a
+// host-side input-pipeline stage; this keeps it off the Python hot path
+// so the DataLoader can feed id arrays at device speed.
+//
+// API (extern "C", ctypes-bound):
+//   tok_create(vocab_blob, len, do_lower)  vocab = token\n token\n ...
+//   tok_encode(handle, text, out_ids, cap) -> n ids (wordpiece only)
+//   tok_free(handle)
+// Batch assembly (CLS/SEP/pad/truncate) happens in Python/numpy where
+// it is a cheap O(batch) reshape.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int64_t> vocab;
+  int64_t unk_id = 0;
+  bool do_lower = true;
+  int max_chars_per_word = 100;
+};
+
+// basic tokenization: split on whitespace, isolate punctuation/CJK
+void basic_split(const std::string& text, bool lower,
+                 std::vector<std::string>* out) {
+  std::string cur;
+  size_t i = 0;
+  const size_t n = text.size();
+  auto flush = [&] {
+    if (!cur.empty()) {
+      out->push_back(cur);
+      cur.clear();
+    }
+  };
+  while (i < n) {
+    unsigned char c = static_cast<unsigned char>(text[i]);
+    if (c < 0x80) {                       // ASCII
+      if (std::isspace(c)) {
+        flush();
+        ++i;
+      } else if (std::ispunct(c)) {
+        flush();
+        out->push_back(std::string(1, static_cast<char>(c)));
+        ++i;
+      } else {
+        cur.push_back(lower ? static_cast<char>(std::tolower(c))
+                            : static_cast<char>(c));
+        ++i;
+      }
+    } else {                              // multi-byte UTF-8 sequence
+      size_t len = (c >= 0xF0) ? 4 : (c >= 0xE0) ? 3 : 2;
+      if (i + len > n) len = n - i;
+      uint32_t cp = 0;
+      if (len == 2)
+        cp = ((c & 0x1F) << 6) | (text[i + 1] & 0x3F);
+      else if (len == 3)
+        cp = ((c & 0x0F) << 12) | ((text[i + 1] & 0x3F) << 6) |
+             (text[i + 2] & 0x3F);
+      else if (len == 4)
+        cp = ((c & 0x07) << 18) | ((text[i + 1] & 0x3F) << 12) |
+             ((text[i + 2] & 0x3F) << 6) | (text[i + 3] & 0x3F);
+      // CJK ideographs tokenize as single characters (BERT rule)
+      bool cjk = (cp >= 0x4E00 && cp <= 0x9FFF) ||
+                 (cp >= 0x3400 && cp <= 0x4DBF) ||
+                 (cp >= 0xF900 && cp <= 0xFAFF);
+      if (cjk) {
+        flush();
+        out->push_back(text.substr(i, len));
+      } else {
+        cur += text.substr(i, len);
+      }
+      i += len;
+    }
+  }
+  flush();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tok_create(const char* vocab_blob, uint64_t blob_len, int do_lower,
+                 const char* unk_token) {
+  auto* t = new Tokenizer();
+  t->do_lower = do_lower != 0;
+  std::string blob(vocab_blob, blob_len);
+  size_t pos = 0;
+  int64_t idx = 0;
+  while (pos < blob.size()) {
+    size_t nl = blob.find('\n', pos);
+    if (nl == std::string::npos) nl = blob.size();
+    std::string tok = blob.substr(pos, nl - pos);
+    if (!tok.empty() && tok.back() == '\r') tok.pop_back();
+    if (!tok.empty()) t->vocab.emplace(tok, idx);
+    ++idx;
+    pos = nl + 1;
+  }
+  auto it = t->vocab.find(unk_token ? unk_token : "[UNK]");
+  t->unk_id = it != t->vocab.end() ? it->second : 0;
+  return t;
+}
+
+void tok_free(void* handle) { delete static_cast<Tokenizer*>(handle); }
+
+int64_t tok_vocab_size(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<Tokenizer*>(handle)->vocab.size());
+}
+
+int64_t tok_token_id(void* handle, const char* token) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  auto it = t->vocab.find(token);
+  return it != t->vocab.end() ? it->second : -1;
+}
+
+// Encode one text into wordpiece ids.  Returns the number of ids
+// (<= cap; extra ids are dropped).
+int64_t tok_encode(void* handle, const char* text_c, int64_t* out_ids,
+                   uint64_t cap) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  std::vector<std::string> words;
+  basic_split(text_c, t->do_lower, &words);
+  uint64_t n = 0;
+  for (const auto& w : words) {
+    if (n >= cap) break;
+    if (static_cast<int>(w.size()) > t->max_chars_per_word) {
+      out_ids[n++] = t->unk_id;
+      continue;
+    }
+    // greedy longest-match-first wordpiece
+    std::vector<int64_t> pieces;
+    size_t start = 0;
+    bool bad = false;
+    while (start < w.size()) {
+      size_t end = w.size();
+      int64_t cur_id = -1;
+      while (start < end) {
+        std::string sub = w.substr(start, end - start);
+        if (start > 0) sub = "##" + sub;
+        auto it = t->vocab.find(sub);
+        if (it != t->vocab.end()) {
+          cur_id = it->second;
+          break;
+        }
+        // back off one UTF-8 character, not one byte
+        do {
+          --end;
+        } while (end > start &&
+                 (static_cast<unsigned char>(w[end]) & 0xC0) == 0x80);
+      }
+      if (cur_id < 0) {
+        bad = true;
+        break;
+      }
+      pieces.push_back(cur_id);
+      start = end;
+    }
+    if (bad) {
+      out_ids[n++] = t->unk_id;
+    } else {
+      for (int64_t id : pieces) {
+        if (n >= cap) break;
+        out_ids[n++] = id;
+      }
+    }
+  }
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -1,0 +1,159 @@
+"""String tensors + string kernels (reference: paddle/phi/core/
+string_tensor.h, kernels/strings/strings_lower_upper_kernel.h,
+strings_empty_kernel.h, strings_copy_kernel.h, unicode.cc).
+
+TPU-native design: strings are a HOST datatype — XLA has no string
+dtype, and the reference only ever runs string kernels as input-pipeline
+stages feeding the tokenizer.  ``StringTensor`` is therefore a numpy
+object-array container with the reference kernel surface (empty/
+empty_like/lower/upper/copy), full unicode semantics via Python's str
+(the role unicode.cc plays for the CUDA path), and a ``to_ids`` bridge
+that hands off to the native WordPiece tokenizer
+(core/native/tokenizer.cc) to produce device-ready int arrays.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "lower", "upper",
+           "copy", "to_string_tensor"]
+
+
+class StringTensor:
+    """N-D tensor of (unicode) strings, host-resident.
+
+    Reference: phi::StringTensor (string_tensor.h) — shape + pstring
+    buffer; here a numpy object array of ``str``."""
+
+    def __init__(self, data=None, name: Optional[str] = None):
+        if data is None:
+            data = np.empty((0,), dtype=object)
+        # own copy: normalization below must not mutate a caller array
+        arr = np.array(data, dtype=object, copy=True)
+        # normalize bytes -> str (utf-8), everything else -> str
+        flat = arr.reshape(-1)
+        for i, v in enumerate(flat):
+            if isinstance(v, bytes):
+                flat[i] = v.decode("utf-8")
+            elif not isinstance(v, str):
+                flat[i] = str(v)
+        self._data = flat.reshape(arr.shape)
+        self.name = name or "string_tensor"
+
+    # -- meta --------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            return bool(np.array_equal(self._data, other._data))
+        return NotImplemented
+
+    def __repr__(self):
+        return (f"StringTensor(shape={self.shape}, "
+                f"data={self._data.tolist()!r})")
+
+    # -- kernels (reference kernels/strings/) -----------------------
+    def lower(self, use_utf8_encoding: bool = True) -> "StringTensor":
+        return lower(self, use_utf8_encoding)
+
+    def upper(self, use_utf8_encoding: bool = True) -> "StringTensor":
+        return upper(self, use_utf8_encoding)
+
+    def copy_(self, src: "StringTensor") -> "StringTensor":
+        self._data = src._data.copy()
+        return self
+
+    # -- tokenizer bridge -------------------------------------------
+    def to_ids(self, tokenizer, max_seq_len: int = 128,
+               pad: bool = True):
+        """Encode every string through a FasterTokenizer, returning
+        (input_ids, seq_lens) numpy int64 arrays."""
+        texts = [str(s) for s in self._data.reshape(-1)]
+        return tokenizer.encode_batch(texts, max_seq_len=max_seq_len,
+                                      pad=pad)
+
+
+def to_string_tensor(data, name: Optional[str] = None) -> StringTensor:
+    return data if isinstance(data, StringTensor) else \
+        StringTensor(data, name)
+
+
+def empty(shape: Sequence[int], name: Optional[str] = None) -> StringTensor:
+    """strings_empty_kernel.h: uninitialized = empty strings."""
+    arr = np.full(tuple(shape), "", dtype=object)
+    return StringTensor(arr, name)
+
+
+def empty_like(x: StringTensor, name: Optional[str] = None) -> StringTensor:
+    return empty(x.shape, name)
+
+
+def _map(x: StringTensor, fn) -> StringTensor:
+    flat = x._data.reshape(-1)
+    out = np.empty_like(flat)
+    for i, v in enumerate(flat):
+        out[i] = fn(v)
+    r = StringTensor.__new__(StringTensor)
+    r._data = out.reshape(x._data.shape)
+    r.name = x.name
+    return r
+
+
+def lower(x: Union[StringTensor, Sequence[str]],
+          use_utf8_encoding: bool = True) -> StringTensor:
+    """strings_lower_upper_kernel.h StringLower; utf8 flag mirrors the
+    reference's ascii-fast-path/utf8 split (unicode.cc) — Python str
+    covers both."""
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        c.lower() if ord(c) < 128 else c for c in s))
+
+
+def upper(x: Union[StringTensor, Sequence[str]],
+          use_utf8_encoding: bool = True) -> StringTensor:
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        c.upper() if ord(c) < 128 else c for c in s))
+
+
+def copy(src: StringTensor, dst: Optional[StringTensor] = None
+         ) -> StringTensor:
+    """strings_copy_kernel.h."""
+    if dst is None:
+        return StringTensor(src._data.copy())
+    return dst.copy_(src)

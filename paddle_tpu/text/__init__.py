@@ -3,8 +3,10 @@
 from .datasets import (  # noqa: F401
     WMT14, WMT16, Conll05st, Imdb, Imikolov, Movielens, UCIHousing)
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .tokenizer import FasterTokenizer, load_vocab  # noqa: F401
 
 __all__ = [
     'Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing',
     'WMT14', 'WMT16', 'ViterbiDecoder', 'viterbi_decode',
+    'FasterTokenizer', 'load_vocab',
 ]

@@ -1,0 +1,169 @@
+"""FasterTokenizer: BERT-style WordPiece over the native core.
+
+Reference: paddle/fluid/operators/string/faster_tokenizer_op.h
+(BertTokenizer::Encode — basic tokenize, wordpiece, CLS/SEP insertion,
+truncation, padding, token_type ids).  The per-word greedy
+longest-match runs in C++ (core/native/tokenizer.cc); a pure-Python
+fallback keeps behavior identical without a toolchain.  Output is
+numpy int64 — device-ready for an embedding lookup.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..core import native
+
+__all__ = ["FasterTokenizer", "load_vocab"]
+
+
+def load_vocab(path: str) -> List[str]:
+    with open(path, encoding="utf-8") as f:
+        return [line.rstrip("\r\n") for line in f if line.rstrip("\r\n")]
+
+
+class FasterTokenizer:
+    """WordPiece tokenizer (faster_tokenizer_op parity).
+
+    vocab: list of tokens (index = id) or {token: id} dict.
+    """
+
+    def __init__(self, vocab: Union[Sequence[str], Dict[str, int]],
+                 do_lower_case: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]"):
+        if isinstance(vocab, dict):
+            items = sorted(vocab.items(), key=lambda kv: kv[1])
+            vocab = [k for k, _ in items]
+        self.vocab = list(vocab)
+        self.token_to_id = {t: i for i, t in enumerate(self.vocab)}
+        self.do_lower_case = do_lower_case
+        self.unk_token, self.cls_token = unk_token, cls_token
+        self.sep_token, self.pad_token = sep_token, pad_token
+        self.unk_id = self.token_to_id.get(unk_token, 0)
+        self.cls_id = self.token_to_id.get(cls_token)
+        self.sep_id = self.token_to_id.get(sep_token)
+        self.pad_id = self.token_to_id.get(pad_token, 0)
+        self._lib = native.load()
+        self._h = None
+        if self._lib is not None:
+            blob = "\n".join(self.vocab).encode("utf-8")
+            self._h = self._lib.tok_create(blob, len(blob),
+                                           1 if do_lower_case else 0,
+                                           unk_token.encode())
+
+    # -- core encode -------------------------------------------------
+    def _encode_native(self, text: str, cap: int) -> List[int]:
+        buf = (ctypes.c_int64 * cap)()
+        n = self._lib.tok_encode(self._h, text.encode("utf-8"), buf, cap)
+        return list(buf[:n])
+
+    def _encode_python(self, text: str, cap: int) -> List[int]:
+        """Bit-identical to tokenizer.cc basic_split + wordpiece: ASCII
+        whitespace/punct/lowercase rules only (non-ASCII chars pass
+        through unchanged except CJK, which splits per character), so a
+        text tokenizes the same with or without the native library."""
+        import string as _string
+        words: List[str] = []
+        cur = ""
+        for ch in text:
+            o = ord(ch)
+            if o < 128 and ch in " \t\n\r\v\f":
+                if cur:
+                    words.append(cur)
+                    cur = ""
+            elif o < 128 and ch in _string.punctuation:
+                if cur:
+                    words.append(cur)
+                    cur = ""
+                words.append(ch)
+            elif 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF or \
+                    0xF900 <= o <= 0xFAFF:
+                if cur:
+                    words.append(cur)
+                    cur = ""
+                words.append(ch)
+            else:
+                if o < 128 and self.do_lower_case:
+                    cur += ch.lower()
+                else:
+                    cur += ch
+        if cur:
+            words.append(cur)
+        ids: List[int] = []
+        for w in words:
+            if len(ids) >= cap:
+                break
+            if len(w) > 100:
+                ids.append(self.unk_id)
+                continue
+            pieces, start, bad = [], 0, False
+            while start < len(w):
+                end = len(w)
+                cur_id = None
+                while start < end:
+                    sub = ("##" if start else "") + w[start:end]
+                    if sub in self.token_to_id:
+                        cur_id = self.token_to_id[sub]
+                        break
+                    end -= 1
+                if cur_id is None:
+                    bad = True
+                    break
+                pieces.append(cur_id)
+                start = end
+            ids.extend([self.unk_id] if bad else pieces)
+        return ids[:cap]
+
+    def encode(self, text: str, max_seq_len: int = 128) -> List[int]:
+        """Wordpiece ids with [CLS]/[SEP] (when present in the vocab),
+        truncated to max_seq_len."""
+        specials = int(self.cls_id is not None) + \
+            int(self.sep_id is not None)
+        cap = max(max_seq_len - specials, 0)
+        core = self._encode_native(text, cap) if self._h else \
+            self._encode_python(text, cap)
+        out = []
+        if self.cls_id is not None:
+            out.append(self.cls_id)
+        out.extend(core)
+        if self.sep_id is not None:
+            out.append(self.sep_id)
+        return out
+
+    def encode_batch(self, texts: Sequence[str], max_seq_len: int = 128,
+                     pad: bool = True
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (input_ids [B, L], seq_lens [B]) int64 arrays, padded
+        with pad_id (faster_tokenizer_op batch semantics)."""
+        encoded = [self.encode(t, max_seq_len) for t in texts]
+        lens = np.asarray([len(e) for e in encoded], dtype=np.int64)
+        width = max_seq_len if pad else (int(lens.max()) if len(lens)
+                                         else 0)
+        ids = np.full((len(encoded), width), self.pad_id, dtype=np.int64)
+        for i, e in enumerate(encoded):
+            ids[i, :len(e)] = e
+        return ids, lens
+
+    def __call__(self, texts, max_seq_len: int = 128):
+        """faster_tokenizer_op-style call: returns framework Tensors
+        (input_ids, token_type_ids)."""
+        from ..tensor.tensor import to_tensor
+        if isinstance(texts, str):
+            texts = [texts]
+        from ..strings import StringTensor
+        if isinstance(texts, StringTensor):
+            texts = [str(s) for s in texts.numpy().reshape(-1)]
+        ids, _ = self.encode_batch(list(texts), max_seq_len=max_seq_len)
+        return (to_tensor(ids),
+                to_tensor(np.zeros_like(ids)))
+
+    def __del__(self):
+        try:
+            if self._h and self._lib:
+                self._lib.tok_free(self._h)
+        except Exception:  # noqa: BLE001
+            pass

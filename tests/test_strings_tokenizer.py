@@ -1,0 +1,144 @@
+"""String tensors (N7) + FasterTokenizer.
+
+Reference: paddle/phi/core/string_tensor.h, kernels/strings/
+strings_lower_upper_kernel.h, fluid/operators/string/
+faster_tokenizer_op.h.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+from paddle_tpu.text import FasterTokenizer
+
+
+# ------------------------------------------------------------------
+# StringTensor kernels
+# ------------------------------------------------------------------
+def test_string_tensor_basic():
+    st = strings.StringTensor([["Hello", "World"], ["Füß", b"bytes"]])
+    assert st.shape == [2, 2]
+    assert st[0][1] == "World"
+    assert st[1][1] == "bytes"            # utf-8 decoded
+    assert st.numel() == 4
+    assert st.dtype == "pstring"
+
+
+def test_strings_empty_and_copy():
+    e = strings.empty([2, 3])
+    assert e.shape == [2, 3] and e[0][0] == ""
+    src = strings.StringTensor(["a", "b"])
+    dst = strings.empty_like(src)
+    strings.copy(src, dst)
+    assert dst == src
+    clone = strings.copy(src)
+    clone._data[0] = "z"
+    assert src[0] == "a"                  # deep copy
+
+
+def test_strings_lower_upper_unicode():
+    st = strings.StringTensor(["Hello WORLD", "Straße", "ĄĆĘ"])
+    lo = strings.lower(st)
+    up = strings.upper(st)
+    assert lo.tolist() == ["hello world", "straße", "ąćę"]
+    assert up.tolist()[0] == "HELLO WORLD"
+    assert up.tolist()[2] == "ĄĆĘ"
+    # ascii-only mode leaves non-ascii untouched (reference ascii path)
+    lo_ascii = strings.lower(strings.StringTensor(["AbĆ"]),
+                             use_utf8_encoding=False)
+    assert lo_ascii.tolist() == ["abĆ"]
+
+
+# ------------------------------------------------------------------
+# FasterTokenizer
+# ------------------------------------------------------------------
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]",
+         "the", "quick", "brown", "fox", "jump", "##ed", "##s",
+         "over", "lazy", "dog", ",", ".", "un", "##affable", "你", "好"]
+
+
+def _tok(**kw):
+    return FasterTokenizer(VOCAB, **kw)
+
+
+def test_wordpiece_greedy_longest_match():
+    t = _tok()
+    ids = t.encode("unaffable", max_seq_len=16)
+    # [CLS] un ##affable [SEP]
+    assert ids == [2, 16, 17, 3]
+
+
+def test_wordpiece_suffix_pieces_and_punct():
+    t = _tok()
+    ids = t.encode("The fox jumped, jumps.", max_seq_len=32)
+    toks = [VOCAB[i] for i in ids]
+    assert toks == ["[CLS]", "the", "fox", "jump", "##ed", ",",
+                    "jump", "##s", ".", "[SEP]"]
+
+
+def test_unknown_word_maps_to_unk():
+    t = _tok()
+    ids = t.encode("the zyzzyva", max_seq_len=16)
+    assert ids == [2, 4, 1, 3]
+
+
+def test_cjk_chars_split_individually():
+    t = _tok()
+    ids = t.encode("你好", max_seq_len=16)
+    assert [VOCAB[i] for i in ids] == ["[CLS]", "你", "好", "[SEP]"]
+
+
+def test_truncation_and_padding_batch():
+    t = _tok()
+    ids, lens = t.encode_batch(
+        ["the quick brown fox", "the"], max_seq_len=5)
+    assert ids.shape == (2, 5)
+    assert lens.tolist() == [5, 3]
+    assert ids[1].tolist() == [2, 4, 3, 0, 0]     # CLS the SEP PAD PAD
+    # truncated row still ends within budget (core capped at L-2)
+    assert ids[0, 0] == 2 and ids[0, -1] == 3
+
+
+def test_native_and_python_paths_agree():
+    t = _tok()
+    texts = ["The quick brown fox jumped over the lazy dog.",
+             "unaffable zyzzyva 你好,world", "", "UPPER case",
+             # unicode hazards: non-ascii case, curly quotes, accents
+             "Café “quoted” naïve…Straße", "ĄĆĘ mixed ascii"]
+    for s in texts:
+        cap = 30
+        py = t._encode_python(s, cap)
+        if t._h is not None:
+            nat = t._encode_native(s, cap)
+            assert nat == py, s
+
+
+def test_string_tensor_does_not_mutate_caller():
+    import numpy as np
+    a = np.array([b"x", 3], dtype=object)
+    st = __import__("paddle_tpu").strings.StringTensor(a)
+    assert a[0] == b"x" and a[1] == 3          # caller array untouched
+    a[1] = "mutated"
+    assert st[1] == "3"                        # no shared buffer
+
+
+def test_tokenizer_call_returns_tensors():
+    t = _tok()
+    input_ids, token_type = t(["the fox", "lazy dog"], max_seq_len=8)
+    assert input_ids.shape == [2, 8]
+    assert np.asarray(token_type.numpy()).sum() == 0
+
+
+def test_string_tensor_to_ids_bridge():
+    st = strings.StringTensor(["the fox", "lazy dog"])
+    ids, lens = st.to_ids(_tok(), max_seq_len=8)
+    assert ids.shape == (2, 8) and lens.tolist() == [4, 4]
+
+
+def test_vocab_dict_input_and_lookup():
+    t = FasterTokenizer({tok: i for i, tok in enumerate(VOCAB)})
+    assert t.token_to_id["fox"] == 7
+    if t._h is not None:
+        assert t._lib.tok_vocab_size(t._h) == len(VOCAB)
+        assert t._lib.tok_token_id(t._h, b"fox") == 7
