@@ -25,6 +25,11 @@ __all__ = [
     "Binomial", "ContinuousBernoulli", "Chi2", "ExponentialFamily",
     "TransformedDistribution", "Independent", "MultivariateNormal",
     "kl_divergence", "register_kl",
+    # transforms (reference distribution/transform.py)
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
 ]
 
 
@@ -920,3 +925,12 @@ def _kl_dirichlet(p, q):
                 jnp.sum(g(qc), -1) +
                 jnp.sum((pc - qc) * (dg(pc) - dg(p0)[..., None]), -1))
     return apply("kl_dirichlet", fn, p.concentration, q.concentration)
+
+
+# -- transforms (reference: distribution/transform.py) ----------------------
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform)

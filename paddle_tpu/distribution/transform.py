@@ -1,0 +1,412 @@
+"""paddle.distribution.transform — bijective tensor transforms.
+
+Reference: python/paddle/distribution/transform.py (Transform base
+:59, AbsTransform :350, AffineTransform :422, ChainTransform :504,
+ExpTransform :629, IndependentTransform :678, PowerTransform :773,
+ReshapeTransform :837, SigmoidTransform :960, SoftmaxTransform :1003,
+StackTransform :1059, StickBreakingTransform :1179, TanhTransform
+:1245).
+
+Each transform supplies forward/inverse and log|det J| as jnp maps run
+through the framework op table, so TransformedDistribution.log_prob
+differentiates end-to-end.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+
+__all__ = ["Type", "Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform",
+           "StickBreakingTransform", "TanhTransform"]
+
+
+class Type(enum.Enum):
+    """Mapping type (reference transform.py:45)."""
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t) -> bool:
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.BIJECTION
+
+    @classmethod
+    def _is_injective(cls) -> bool:
+        return Type.is_injective(cls._type)
+
+    def __call__(self, x):
+        if isinstance(x, Transform):
+            return ChainTransform([x, self])
+        return self.forward(x)
+
+    # event dims consumed/produced (reference _domain/_codomain ranks)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    def forward(self, x):
+        return apply(f"{type(self).__name__}_fwd", self._forward,
+                     as_tensor(x))
+
+    def inverse(self, y):
+        return apply(f"{type(self).__name__}_inv", self._inverse,
+                     as_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(f"{type(self).__name__}_fldj",
+                     self._forward_log_det_jacobian, as_tensor(x))
+
+    def inverse_log_det_jacobian(self, y):
+        from ..tensor.math import multiply
+        x = self.inverse(y)
+        return multiply(self.forward_log_det_jacobian(x),
+                        as_tensor(-1.0).astype(x.dtype))
+
+    def forward_shape(self, shape: Sequence[int]):
+        return list(shape)
+
+    def inverse_shape(self, shape: Sequence[int]):
+        return list(shape)
+
+    # jnp-level implementations (override)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| — surjective, not injective (reference :350)."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y                      # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference :422)."""
+
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc)
+        self.scale = as_tensor(scale)
+
+    def forward(self, x):
+        return apply("affine_fwd", lambda x_, l, s: l + s * x_,
+                     as_tensor(x), self.loc, self.scale)
+
+    def inverse(self, y):
+        return apply("affine_inv", lambda y_, l, s: (y_ - l) / s,
+                     as_tensor(y), self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply("affine_fldj",
+                     lambda x_, s: jnp.broadcast_to(
+                         jnp.log(jnp.abs(s)), x_.shape),
+                     as_tensor(x), self.scale)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference :629)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on R+ (reference :773)."""
+
+    def __init__(self, power):
+        self.power = as_tensor(power)
+
+    def forward(self, x):
+        return apply("power_fwd", lambda x_, p: jnp.power(x_, p),
+                     as_tensor(x), self.power)
+
+    def inverse(self, y):
+        return apply("power_inv", lambda y_, p: jnp.power(y_, 1.0 / p),
+                     as_tensor(y), self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return apply("power_fldj",
+                     lambda x_, p: jnp.log(jnp.abs(
+                         p * jnp.power(x_, p - 1.0))),
+                     as_tensor(x), self.power)
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference :960)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference :1245)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x): surjection onto the simplex (reference :1003);
+    inverse returns log(y) (a representative preimage)."""
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not injective; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex via stick breaking (reference :1179)."""
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        head = z * lead
+        return jnp.concatenate([head, zc[..., -1:]], -1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        # d head_i / d x_i = z(1-z) * prod_{j<i}(1-z_j)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), -1)
+
+    def forward_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] + 1]
+
+    def inverse_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] - 1]
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)) (reference :504)."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    @classmethod
+    def _is_injective(cls):
+        return True
+
+    @property
+    def _domain_event_rank(self):
+        return max((t._domain_event_rank for t in self.transforms),
+                   default=0)
+
+    @property
+    def _codomain_event_rank(self):
+        return max((t._codomain_event_rank for t in self.transforms),
+                   default=0)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        """Per-transform log-dets are summed after realigning event
+        ranks: a per-element term (event rank 0) is reduced over the
+        chain's overall event dims before adding to an event-summed
+        term, so mixing e.g. TanhTransform with StickBreakingTransform
+        yields the correctly-shaped total."""
+        from ..tensor.math import add
+        target = max((max(t._domain_event_rank, t._codomain_event_rank)
+                      for t in self.transforms), default=0)
+        total = None
+        for t in self.transforms:
+            term = t.forward_log_det_jacobian(x)
+            extra = target - max(t._domain_event_rank,
+                                 t._codomain_event_rank)
+            if extra > 0:
+                term = apply(
+                    "chain_fldj_reduce",
+                    lambda a, k=extra: jnp.sum(
+                        a, axis=tuple(range(-k, 0))), term)
+            total = term if total is None else add(total, term)
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost reinterpreted_batch_rank dims as event
+    dims: log-det sums over them (reference :678)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    @property
+    def _domain_event_rank(self):
+        return self.base._domain_event_rank + self.rank
+
+    @property
+    def _codomain_event_rank(self):
+        return self.base._codomain_event_rank + self.rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        return apply(
+            "indep_fldj",
+            lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
+            ldj)
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ReshapeTransform(Transform):
+    """Reshape event dims (reference :837)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError("reshape: element counts differ")
+
+    def forward(self, x):
+        x = as_tensor(x)
+        batch = tuple(x.shape[:x.ndim - len(self.in_event_shape)])
+        return apply("reshape_fwd",
+                     lambda a: a.reshape(batch + self.out_event_shape), x)
+
+    def inverse(self, y):
+        y = as_tensor(y)
+        batch = tuple(y.shape[:y.ndim - len(self.out_event_shape)])
+        return apply("reshape_inv",
+                     lambda a: a.reshape(batch + self.in_event_shape), y)
+
+    def forward_log_det_jacobian(self, x):
+        x = as_tensor(x)
+        batch = tuple(x.shape[:x.ndim - len(self.in_event_shape)])
+        return apply("reshape_fldj",
+                     lambda a: jnp.zeros(batch, a.dtype), x)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return list(shape[:len(shape) - n]) + list(self.out_event_shape)
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return list(shape[:len(shape) - n]) + list(self.in_event_shape)
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along ``axis`` (reference :1059)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, x, method):
+        from ..tensor.manipulation import stack, unstack
+        parts = unstack(as_tensor(x), axis=self.axis)
+        outs = [getattr(t, method)(p)
+                for t, p in zip(self.transforms, parts)]
+        return stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map(x, "forward")
+
+    def inverse(self, y):
+        return self._map(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")

@@ -12,6 +12,7 @@ points route to the same jnp/Pallas implementations the core uses.
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
 from ..optimizer.optimizer import LBFGS  # noqa: F401
 
 

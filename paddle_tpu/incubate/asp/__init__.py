@@ -1,0 +1,247 @@
+"""ASP — automatic structured (N:M) sparsity.
+
+Reference: python/paddle/incubate/asp/ (asp.py:216 decorate, :302
+prune_model, :40 set_excluded_layers; utils.py:184 get_mask_1d, :326
+get_mask_2d_greedy, :442 get_mask_2d_best, :78 calculate_density, :569
+check_sparsity).
+
+TPU-native redesign: masks are a pytree alongside the parameters, and
+the sparsity guarantee is a functional constraint — ``decorate`` wraps
+the optimizer's ``step`` so ``w <- mask * w`` re-applies after every
+update, the same contract as the reference's
+OptimizerWithSparsityGuarantee (asp.py:912) without its program-pass
+machinery.  Mask computation itself is vectorized numpy (argpartition
+over m-wide groups) instead of the reference's per-group Python loops.
+"""
+
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["MaskAlgo", "CheckMethod", "calculate_density",
+           "get_mask_1d", "get_mask_2d_greedy", "get_mask_2d_best",
+           "check_mask_1d", "check_mask_2d", "create_mask",
+           "check_sparsity", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo):
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D \
+            else CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    """utils.py:78 — fraction of nonzeros."""
+    a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def _pad_cols(mat: np.ndarray, m: int) -> np.ndarray:
+    pad = (-mat.shape[1]) % m
+    if pad:
+        mat = np.concatenate(
+            [mat, np.zeros((mat.shape[0], pad), mat.dtype)], axis=1)
+    return mat
+
+
+def get_mask_1d(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest |values| in every m-wide row group
+    (utils.py:184), vectorized with argpartition."""
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    padded = _pad_cols(np.abs(mat), m)
+    groups = padded.reshape(-1, m)
+    # indices of the top-n per group
+    top = np.argpartition(groups, -n, axis=1)[:, -n:]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, top, 1.0, axis=1)
+    return mask.reshape(rows, -1)[:, :cols].astype(mat.dtype)
+
+
+def check_mask_1d(mat: np.ndarray, n: int, m: int) -> bool:
+    """utils.py:134 — every m-wide group has <= n nonzeros."""
+    mat = np.asarray(mat)
+    groups = _pad_cols((mat != 0).astype(np.int64), m).reshape(-1, m)
+    return bool((groups.sum(axis=1) <= n).all())
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """utils.py:326 — per m x m block, greedily keep entries so every
+    row and column of the block has at most n survivors."""
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    rpad, cpad = (-rows) % m, (-cols) % m
+    padded = np.abs(np.pad(mat, ((0, rpad), (0, cpad))))
+    mask = np.zeros_like(padded)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            order = np.argsort(-block, axis=None)
+            rcount = np.zeros(m, np.int64)
+            ccount = np.zeros(m, np.int64)
+            for flat in order:
+                r, c = divmod(int(flat), m)
+                if rcount[r] < n and ccount[c] < n:
+                    mask[bi + r, bj + c] = 1.0
+                    rcount[r] += 1
+                    ccount[c] += 1
+    return mask[:rows, :cols].astype(mat.dtype)
+
+
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """utils.py:401 — all m x m 0/1 matrices with exactly n ones per row
+    and per column (cached)."""
+    key = (n, m)
+    if key not in _pattern_cache:
+        rows = [np.array(p) for p in itertools.combinations(range(m), n)]
+        pats = []
+        for combo in itertools.product(range(len(rows)), repeat=m):
+            mat = np.zeros((m, m), np.float64)
+            for r, ci in enumerate(combo):
+                mat[r, rows[ci]] = 1.0
+            if (mat.sum(axis=0) == n).all():
+                pats.append(mat)
+        _pattern_cache[key] = np.stack(pats)
+    return _pattern_cache[key]
+
+
+_pattern_cache: Dict = {}
+
+
+def get_mask_2d_best(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """utils.py:442 — exhaustive best pattern per m x m block."""
+    mat = np.asarray(mat)
+    pats = _valid_2d_patterns(n, m)          # [P, m, m]
+    rows, cols = mat.shape
+    rpad, cpad = (-rows) % m, (-cols) % m
+    padded = np.abs(np.pad(mat, ((0, rpad), (0, cpad))))
+    R, C = padded.shape
+    blocks = padded.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    scores = np.einsum("brij,pij->brp", blocks, pats)
+    best = np.argmax(scores, axis=-1)        # [R/m, C/m]
+    mask_blocks = pats[best]                 # [R/m, C/m, m, m]
+    mask = mask_blocks.transpose(0, 2, 1, 3).reshape(R, C)
+    return mask[:rows, :cols].astype(mat.dtype)
+
+
+def check_mask_2d(mat: np.ndarray, n: int, m: int) -> bool:
+    """utils.py:269 — every m x m block has <= n nonzeros per row+col."""
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    rpad, cpad = (-rows) % m, (-cols) % m
+    nz = np.pad((mat != 0).astype(np.int64), ((0, rpad), (0, cpad)))
+    R, C = nz.shape
+    blocks = nz.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    return bool((blocks.sum(axis=3) <= n).all() and
+                (blocks.sum(axis=2) <= n).all())
+
+
+def _as_2d(arr: np.ndarray):
+    """Reference create_mask reshapes conv kernels [O,I,H,W] -> 2-D."""
+    if arr.ndim == 1:
+        return arr.reshape(1, -1), arr.shape
+    if arr.ndim == 2:
+        return arr, arr.shape
+    return arr.reshape(arr.shape[0], -1), arr.shape
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """utils.py:498."""
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor)
+                     else tensor)
+    mat, orig_shape = _as_2d(arr)
+    fn = globals()[func_name.value if isinstance(func_name, MaskAlgo)
+                   else func_name]
+    return fn(mat, n, m).reshape(orig_shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    """utils.py:569."""
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor)
+                     else tensor)
+    mat, _ = _as_2d(arr)
+    fn = globals()[func_name.value if isinstance(func_name, CheckMethod)
+                   else func_name]
+    return fn(mat, n, m)
+
+
+# ==========================================================================
+# model-level API (asp.py)
+# ==========================================================================
+_masks: Dict[int, np.ndarray] = {}       # id(param) -> mask
+_excluded: set = set()                   # param names
+
+
+def set_excluded_layers(model_or_names, param_names=None):
+    """asp.py:40 — exclude parameters (by name) from pruning."""
+    names = param_names if param_names is not None else model_or_names
+    for n in names:
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable_params(model):
+    for name, p in model.named_parameters():
+        if p is None or name in _excluded:
+            continue
+        if p.ndim < 2:                    # biases/norm scales skipped
+            continue
+        # sublayer param name suffix check (reference supports
+        # Linear weight [in,out] and Conv kernels)
+        yield name, p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """asp.py:302 — compute masks, zero the pruned weights, remember
+    masks so decorate() keeps them zero through training."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    out = {}
+    for name, p in _prunable_params(model):
+        mask = create_mask(p, algo, n, m)
+        p._data = p._data * jnp.asarray(mask, dtype=p._data.dtype)
+        if with_mask:
+            _masks[id(p)] = mask
+        out[name] = mask
+    return out
+
+
+def decorate(optimizer):
+    """asp.py:216 — OptimizerWithSparsityGuarantee: after every step,
+    re-apply the masks so pruned weights stay exactly zero."""
+    orig_step = optimizer.step
+
+    def step_with_masks(*args, **kwargs):
+        result = orig_step(*args, **kwargs)
+        for p in optimizer._params():
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask,
+                                                dtype=p._data.dtype)
+        return result
+
+    optimizer.step = step_with_masks
+    optimizer.minimize_step = step_with_masks
+    return optimizer

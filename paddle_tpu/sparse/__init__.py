@@ -1,135 +1,498 @@
-"""paddle_tpu.sparse — COO/CSR sparse tensors + sparse nn.
+"""paddle_tpu.sparse — COO/CSR sparse tensors + sparse ops/nn.
 
-Reference: python/paddle/sparse/ backed by phi/kernels/sparse.
-TPU-native: wraps jax.experimental.sparse (BCOO/BCSR); dense fallbacks are
-used where XLA has no sparse lowering (XLA densifies most sparse compute
-on TPU anyway — the MXU wants dense tiles).
+Reference: python/paddle/sparse/ (unary.py, binary.py, multiary.py,
+nn/) backed by phi/kernels/sparse/ (sparse_coo_tensor.h,
+sparse_csr_tensor.h, matmul_kernel, fused_attention_kernel).
+
+TPU-native design: sparse tensors store REAL compressed payloads —
+``indices [ndim, nnz]`` + ``values [nnz, ...]`` for COO, ``crows/cols/
+values`` for CSR — and every op computes on the compressed form:
+
+  * unary ops (relu/sqrt/sin/tanh/abs/...) map over ``values`` only,
+    through the framework op table so autograd flows to the values;
+  * ``add``/``multiply`` on COO concatenate/intersect patterns with
+    segment reductions (no densification);
+  * ``matmul(sparse, dense)`` is a gather+segment-sum SpMM — a
+    compiler-friendly formulation (static shapes, no scatter in the
+    hot loop) that XLA tiles well on TPU;
+  * ``masked_matmul`` is SDDMM: computes dense@dense only at the mask's
+    nnz coordinates;
+  * ``nn.functional.attention`` composes SDDMM -> sparse softmax ->
+    SpMM, the reference's fused_attention_kernel contract.
+
+Gradients: sparse ops keep the sparsity pattern in the backward pass
+(grads live on ``values``), matching the reference kernels.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import sparse as jsparse
 
-from ..tensor.tensor import Tensor, wrap_array
 from ..ops.dispatch import apply, as_tensor
+from ..tensor.tensor import Tensor, wrap_array
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "is_same_shape", "add", "multiply", "matmul", "masked_matmul",
-           "relu", "sqrt", "sin", "tanh", "nn"]
+from . import nn  # noqa: E402  (submodule defined below in nn.py)
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_same_shape", "coalesce",
+           "add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul", "mv", "addmm",
+           "relu", "relu6", "leaky_relu", "sqrt", "sin", "tan", "asin",
+           "atan", "sinh", "tanh", "asinh", "atanh", "abs", "pow",
+           "square", "log1p", "expm1", "neg", "cast", "deg2rad",
+           "rad2deg", "to_sparse_coo", "to_sparse_csr", "nn"]
 
 
-class SparseCooTensor(Tensor):
-    """A Tensor whose payload is a BCOO; dense ops see it densified."""
+# ==========================================================================
+# containers
+# ==========================================================================
+class SparseCooTensor:
+    """COO sparse tensor: indices [sparse_ndim, nnz] (int64) + values
+    [nnz, *dense_dims].  Reference: phi/core/sparse_coo_tensor.h."""
 
-    def __init__(self, bcoo: jsparse.BCOO):
-        super().__init__(bcoo.todense())
-        self._bcoo = bcoo
+    is_sparse = True
+
+    def __init__(self, indices: Tensor, values: Tensor,
+                 shape: Sequence[int], coalesced: bool = False,
+                 stop_gradient: bool = True):
+        self._indices = as_tensor(indices)
+        self._values = as_tensor(values)
+        self._shape = [int(s) for s in shape]
+        self._coalesced = coalesced
+        # never sever a live grad chain: values recorded by the tape
+        # (stop_gradient=False) keep requiring grad regardless of the
+        # constructor default, so sparse op chains stay differentiable
+        self.stop_gradient = stop_gradient and self._values.stop_gradient
+        self._values.stop_gradient = self.stop_gradient
+
+    # -- meta -------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
 
     @property
-    def is_sparse_coo(self):
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self._indices.shape[0])
+
+    @property
+    def dense_dim(self) -> int:
+        return self._values.ndim - 1
+
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def indices(self) -> Tensor:
+        return self._indices
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def backward(self, *a, **kw):
+        return self._values.backward(*a, **kw)
+
+    def is_sparse_coo(self) -> bool:
         return True
 
-    def indices(self):
-        return wrap_array(jnp.asarray(self._bcoo.indices.T))
+    def is_sparse_csr(self) -> bool:
+        return False
 
-    def values(self):
-        return wrap_array(self._bcoo.data)
+    # -- conversions ------------------------------------------------
+    def to_dense(self) -> Tensor:
+        idx = self._indices
+        vals = self._values
+        shape = tuple(self._shape)
 
-    def to_dense(self):
-        return wrap_array(self._bcoo.todense())
+        def fn(idx_a, vals_a):
+            flat = jnp.zeros(
+                (int(np.prod(shape[:idx_a.shape[0]])),)
+                + vals_a.shape[1:], vals_a.dtype)
+            lin = jnp.ravel_multi_index(
+                tuple(idx_a), shape[:idx_a.shape[0]], mode="clip")
+            return flat.at[lin].add(vals_a).reshape(shape)
 
-    def nnz(self):
-        return int(self._bcoo.nse)
+        return apply("sparse_to_dense", fn, idx, vals)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim != 2:
+            raise ValueError("to_sparse_csr needs 2 sparse dims")
+        co = self.coalesce()
+        rows = np.asarray(co._indices._data[0])
+        cols = np.asarray(co._indices._data[1])
+        nrows = self._shape[0]
+        crows = np.zeros(nrows + 1, dtype=np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(
+            wrap_array(jnp.asarray(crows)), wrap_array(jnp.asarray(cols)),
+            co._values, self._shape,
+            stop_gradient=self.stop_gradient)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return coalesce(self)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
 
 
+class SparseCsrTensor:
+    """CSR sparse matrix: crows [rows+1], cols [nnz], values [nnz].
+    Reference: phi/core/sparse_csr_tensor.h."""
+
+    is_sparse = True
+
+    def __init__(self, crows: Tensor, cols: Tensor, values: Tensor,
+                 shape: Sequence[int], stop_gradient: bool = True):
+        self._crows = as_tensor(crows)
+        self._cols = as_tensor(cols)
+        self._values = as_tensor(values)
+        self._shape = [int(s) for s in shape]
+        # see SparseCooTensor.__init__: keep live grad chains alive
+        self.stop_gradient = stop_gradient and self._values.stop_gradient
+        self._values.stop_gradient = self.stop_gradient
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return self._crows
+
+    def cols(self) -> Tensor:
+        return self._cols
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        crows = np.asarray(self._crows._data)
+        rows = np.repeat(np.arange(len(crows) - 1),
+                         np.diff(crows).astype(np.int64))
+        idx = jnp.stack([jnp.asarray(rows),
+                         jnp.asarray(self._cols._data)])
+        return SparseCooTensor(wrap_array(idx), self._values, self._shape,
+                               coalesced=True,
+                               stop_gradient=self.stop_gradient)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+
+# ==========================================================================
+# constructors
+# ==========================================================================
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
-                      stop_gradient=True):
-    idx = as_tensor(indices)._data.T  # paddle is [ndim, nnz]; BCOO wants
-    vals = as_tensor(values)._data
+                      stop_gradient=True) -> SparseCooTensor:
+    """Reference: python/paddle/sparse/creation.py sparse_coo_tensor —
+    indices laid out [sparse_ndim, nnz]."""
+    idx = as_tensor(indices)
+    vals = as_tensor(values)
     if dtype is not None:
-        from ..framework.dtype import to_jax_dtype
-        vals = vals.astype(to_jax_dtype(dtype))
-    bcoo = jsparse.BCOO((vals, idx.astype(jnp.int32)),
-                        shape=tuple(shape) if shape else None)
-    t = SparseCooTensor(bcoo)
-    t.stop_gradient = stop_gradient
-    return t
+        vals = vals.astype(dtype)
+    if idx._data.dtype not in (jnp.int32, jnp.int64):
+        idx = wrap_array(idx._data.astype(jnp.int64))
+    if shape is None:
+        mx = np.asarray(jnp.max(idx._data, axis=1))
+        shape = [int(m) + 1 for m in mx] + list(vals.shape[1:])
+    return SparseCooTensor(idx, vals, shape, stop_gradient=stop_gradient)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
-                      stop_gradient=True):
-    crows_a = np.asarray(as_tensor(crows)._data)
-    cols_a = np.asarray(as_tensor(cols)._data)
-    vals = np.asarray(as_tensor(values)._data)
-    # convert CSR to COO rows
-    rows = np.repeat(np.arange(len(crows_a) - 1),
-                     np.diff(crows_a).astype(int))
-    idx = np.stack([rows, cols_a])
-    return sparse_coo_tensor(idx, vals, shape, dtype, place, stop_gradient)
+                      stop_gradient=True) -> SparseCsrTensor:
+    vals = as_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return SparseCsrTensor(as_tensor(crows), as_tensor(cols), vals, shape,
+                           stop_gradient=stop_gradient)
 
 
-def is_same_shape(x, y):
+def to_sparse_coo(x: Tensor, sparse_dim: Optional[int] = None
+                  ) -> SparseCooTensor:
+    """Dense -> COO (reference Tensor.to_sparse_coo)."""
+    x = as_tensor(x)
+    sparse_dim = sparse_dim or x.ndim
+    arr = np.asarray(x._data)
+    mask = np.abs(arr).reshape(
+        arr.shape[:sparse_dim] + (-1,)).sum(-1) != 0
+    idx = np.stack(np.nonzero(mask)).astype(np.int64)
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(
+        wrap_array(jnp.asarray(idx)), wrap_array(jnp.asarray(vals)),
+        list(arr.shape), coalesced=True, stop_gradient=x.stop_gradient)
+
+
+def to_sparse_csr(x: Tensor) -> SparseCsrTensor:
+    return to_sparse_coo(x, 2).to_sparse_csr()
+
+
+def is_same_shape(x, y) -> bool:
     return tuple(x.shape) == tuple(y.shape)
 
 
-def _dense(x):
-    return x.to_dense() if isinstance(x, SparseCooTensor) else as_tensor(x)
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    """Sort indices, merge duplicates (reference coalesce kernel)."""
+    if x._coalesced:
+        return x
+    shape = tuple(x._shape[:x.sparse_dim])
+    idx = np.asarray(x._indices._data)
+    lin = np.ravel_multi_index(tuple(idx), shape)
+    uniq, inv = np.unique(lin, return_inverse=True)
+    seg = wrap_array(jnp.asarray(inv.astype(np.int32)))
+    n_out = len(uniq)
+
+    def fn(vals_a, seg_a):
+        return jax.ops.segment_sum(vals_a, seg_a, num_segments=n_out)
+
+    vals = apply("sparse_coalesce", fn, x._values, seg)
+    new_idx = jnp.asarray(
+        np.stack(np.unravel_index(uniq, shape)).astype(np.int64))
+    return SparseCooTensor(wrap_array(new_idx), vals, x._shape,
+                           coalesced=True,
+                           stop_gradient=x.stop_gradient)
+
+
+# ==========================================================================
+# unary ops: compute on values only (reference sparse/unary.py)
+# ==========================================================================
+def _unary(name, fn):
+    def op(x, name_arg=None):
+        vals = apply(f"sparse_{name}", fn, x.values())
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, vals, x._shape,
+                                   coalesced=x._coalesced,
+                                   stop_gradient=x.stop_gradient)
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape,
+                               stop_gradient=x.stop_gradient)
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+sqrt = _unary("sqrt", jnp.sqrt)
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+abs = _unary("abs", jnp.abs)                      # noqa: A001
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary("leaky_relu",
+                  lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def pow(x, factor, name=None):                    # noqa: A001
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    vals = x.values()
+    if value_dtype is not None:
+        vals = vals.astype(value_dtype)
+    idx = x.indices() if isinstance(x, SparseCooTensor) else None
+    if isinstance(x, SparseCooTensor):
+        if index_dtype is not None:
+            idx = idx.astype(index_dtype)
+        return SparseCooTensor(idx, vals, x._shape,
+                               coalesced=x._coalesced)
+    crows, cols = x._crows, x._cols
+    if index_dtype is not None:
+        crows, cols = crows.astype(index_dtype), cols.astype(index_dtype)
+    return SparseCsrTensor(crows, cols, vals, x._shape)
+
+
+# ==========================================================================
+# binary ops on COO patterns (reference sparse/binary.py)
+# ==========================================================================
+def _as_coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCooTensor):
+        return x.coalesce()
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def _pattern_union(x: SparseCooTensor, y: SparseCooTensor, combine):
+    """Union-pattern elementwise combine via concat + coalesce-style
+    segment reduction.  combine acts on stacked values."""
+    shape = tuple(x._shape[:x.sparse_dim])
+    xi = np.asarray(x._indices._data)
+    yi = np.asarray(y._indices._data)
+    lx = np.ravel_multi_index(tuple(xi), shape)
+    ly = np.ravel_multi_index(tuple(yi), shape)
+    uniq, inv = np.unique(np.concatenate([lx, ly]), return_inverse=True)
+    segx = wrap_array(jnp.asarray(inv[:len(lx)].astype(np.int32)))
+    segy = wrap_array(jnp.asarray(inv[len(lx):].astype(np.int32)))
+    n_out = len(uniq)
+
+    def fn(xv, yv, sx, sy):
+        dense_shape = (n_out,) + xv.shape[1:]
+        a = jax.ops.segment_sum(xv, sx, num_segments=n_out).reshape(
+            dense_shape)
+        b = jax.ops.segment_sum(yv, sy, num_segments=n_out).reshape(
+            dense_shape)
+        return combine(a, b)
+
+    vals = apply("sparse_elementwise", fn, x._values, y._values,
+                 segx, segy)
+    idx = jnp.asarray(np.stack(np.unravel_index(uniq, shape))
+                      .astype(np.int64))
+    return SparseCooTensor(wrap_array(idx), vals, x._shape,
+                           coalesced=True)
+
+
+def _binary(name, x, y, combine):
+    if not is_same_shape(x, y):
+        raise ValueError(f"sparse.{name}: shape mismatch "
+                         f"{x.shape} vs {y.shape}")
+    out = _pattern_union(_as_coo(x), _as_coo(y), combine)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
 
 
 def add(x, y, name=None):
-    from ..tensor.math import add as dadd
-    return dadd(_dense(x), _dense(y))
+    return _binary("add", x, y, lambda a, b: a + b)
+
+
+def subtract(x, y, name=None):
+    return _binary("subtract", x, y, lambda a, b: a - b)
 
 
 def multiply(x, y, name=None):
-    from ..tensor.math import multiply as dmul
-    return dmul(_dense(x), _dense(y))
+    return _binary("multiply", x, y, lambda a, b: a * b)
 
 
+def divide(x, y, name=None):
+    """Same-pattern elementwise divide (a union pattern would emit
+    x/0 = inf at positions missing from y — the reference CSR divide
+    requires matching patterns for the same reason)."""
+    if not is_same_shape(x, y):
+        raise ValueError(f"sparse.divide: shape mismatch "
+                         f"{x.shape} vs {y.shape}")
+    xc, yc = _as_coo(x), _as_coo(y)
+    if not np.array_equal(np.asarray(xc._indices._data),
+                          np.asarray(yc._indices._data)):
+        raise ValueError(
+            "sparse.divide requires identical sparsity patterns "
+            "(dividing by an implicit zero is undefined)")
+
+    def fn(a, b):
+        return a / b
+
+    vals = apply("sparse_divide", fn, xc._values, yc._values)
+    out = SparseCooTensor(xc._indices, vals, xc._shape, coalesced=True)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+# ==========================================================================
+# matmul family (reference sparse/binary.py matmul, masked_matmul)
+# ==========================================================================
 def matmul(x, y, name=None):
-    from ..tensor.linalg import matmul as dmm
-    return dmm(_dense(x), _dense(y))
+    """SpMM: sparse [*, M, K] @ dense [*, K, N] via gather + segment-sum
+    (TPU-friendly: static shapes, MXU-eligible inner products)."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)) and \
+            not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        # dense @ sparse = (sparse^T @ dense^T)^T  (2-D)
+        from ..tensor.manipulation import transpose as dtrans
+        yt = _transpose_coo(_as_coo(y))
+        out = matmul(yt, dtrans(as_tensor(x), [1, 0]))
+        return dtrans(out, [1, 0])
+    xc = _as_coo(x)
+    if xc.sparse_dim != 2:
+        raise ValueError("sparse.matmul supports 2-D sparse")
+    y = as_tensor(y)
+    rows = wrap_array(xc._indices._data[0].astype(jnp.int32))
+    cols = wrap_array(xc._indices._data[1].astype(jnp.int32))
+    m = xc._shape[0]
+
+    def fn(vals, rows_a, cols_a, dense):
+        gathered = jnp.take(dense, cols_a, axis=0)      # [nnz, N]
+        contrib = gathered * vals[:, None]
+        return jax.ops.segment_sum(contrib, rows_a, num_segments=m)
+
+    return apply("sparse_matmul", fn, xc._values, rows, cols, y)
+
+
+def _transpose_coo(x: SparseCooTensor) -> SparseCooTensor:
+    idx = x._indices._data
+    new_idx = jnp.stack([idx[1], idx[0]])
+    return SparseCooTensor(wrap_array(new_idx), x._values,
+                           [x._shape[1], x._shape[0]])
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector."""
+    from ..tensor.manipulation import reshape as dreshape
+    out = matmul(x, dreshape(as_tensor(vec), [-1, 1]))
+    return dreshape(out, [-1])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y), x sparse."""
+    from ..tensor.math import add as dadd
+    prod = matmul(x, y)
+    return dadd(as_tensor(input) * beta, prod * alpha)
 
 
 def masked_matmul(x, y, mask, name=None):
-    from ..tensor.linalg import matmul as dmm
-    from ..tensor.math import multiply as dmul
-    out = dmm(_dense(x), _dense(y))
-    return dmul(out, _dense(mask))
+    """SDDMM: (x @ y) evaluated only at mask's nnz coordinates —
+    reference masked_matmul (kernels/sparse/gpu/matmul_kernel.cu)."""
+    mc = _as_coo(mask)
+    x = as_tensor(x)
+    y = as_tensor(y)
+    rows = wrap_array(mc._indices._data[0].astype(jnp.int32))
+    cols = wrap_array(mc._indices._data[1].astype(jnp.int32))
 
+    def fn(xa, ya, rows_a, cols_a):
+        xr = jnp.take(xa, rows_a, axis=0)               # [nnz, K]
+        yc = jnp.take(ya.T, cols_a, axis=0)             # [nnz, K]
+        return jnp.sum(xr * yc, axis=-1)                # [nnz]
 
-def relu(x, name=None):
-    from ..nn.functional import relu as drelu
-    return drelu(_dense(x))
-
-
-def sqrt(x, name=None):
-    from ..tensor.math import sqrt as dsqrt
-    return dsqrt(_dense(x))
-
-
-def sin(x, name=None):
-    from ..tensor.math import sin as dsin
-    return dsin(_dense(x))
-
-
-def tanh(x, name=None):
-    from ..tensor.math import tanh as dtanh
-    return dtanh(_dense(x))
-
-
-class nn:
-    """paddle.sparse.nn — dense-computed equivalents."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-    @staticmethod
-    def functional_relu(x):
-        return relu(x)
+    vals = apply("sparse_sddmm", fn, x, y, rows, cols)
+    out = SparseCooTensor(mc._indices, vals,
+                          [x.shape[0], y.shape[1]], coalesced=True)
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) \
+        else out

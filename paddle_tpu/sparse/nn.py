@@ -1,0 +1,146 @@
+"""paddle.sparse.nn — sparse layers + functional (reference:
+python/paddle/sparse/nn/layer/activation.py, functional/activation.py,
+functional/transformer.py attention -> phi fused_attention sparse
+kernel).
+
+``functional.attention`` is the sparse-attention contract: the score
+matrix only materializes at the positions of a sparse mask (SDDMM),
+softmax runs segment-wise over each query row's nonzeros, and the
+value aggregation is an SpMM — O(nnz) instead of O(L^2) memory, the
+TPU-idiomatic route to long-sequence sparse attention.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply, as_tensor
+from ..tensor.tensor import wrap_array
+
+
+# ------------------------------------------------------------------
+# layers (reference sparse/nn/layer/activation.py)
+# ------------------------------------------------------------------
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import relu6
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from . import leaky_relu
+        return leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    """Sparse softmax over the last sparse dim (per-row on CSR/COO)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+
+    def forward(self, x):
+        return functional.softmax(x)
+
+
+class _Functional:
+    """paddle.sparse.nn.functional namespace."""
+
+    @staticmethod
+    def relu(x, name=None):
+        from . import relu as _relu
+        return _relu(x)
+
+    @staticmethod
+    def relu6(x, name=None):
+        from . import relu6 as _relu6
+        return _relu6(x)
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01, name=None):
+        from . import leaky_relu as _lrelu
+        return _lrelu(x, negative_slope)
+
+    @staticmethod
+    def softmax(x, axis=-1, name=None):
+        """Row-wise softmax over nonzeros (reference sparse softmax
+        kernel): normalizes over the LAST sparse dim, so every leading
+        index tuple (batch dims + row) is its own segment."""
+        import numpy as np
+        from . import SparseCooTensor, SparseCsrTensor, _as_coo
+        xc = _as_coo(x)
+        idx = np.asarray(xc._indices._data)
+        lead_shape = tuple(xc._shape[:xc.sparse_dim - 1])
+        lin = np.ravel_multi_index(tuple(idx[:-1]), lead_shape) \
+            if len(lead_shape) > 1 else idx[0]
+        rows = wrap_array(jnp.asarray(lin.astype(np.int32)))
+        m = int(np.prod(lead_shape, dtype=np.int64))
+
+        def fn(vals, rows_a):
+            mx = jax.ops.segment_max(vals, rows_a, num_segments=m)
+            e = jnp.exp(vals - jnp.take(mx, rows_a))
+            denom = jax.ops.segment_sum(e, rows_a, num_segments=m)
+            return e / jnp.take(denom, rows_a)
+
+        vals = apply("sparse_softmax", fn, xc._values, rows)
+        out = SparseCooTensor(xc._indices, vals, xc._shape,
+                              coalesced=True)
+        return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+            else out
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-mask attention (reference functional/transformer.py
+        attention, fused CSR kernel): softmax(QK^T/sqrt(d) at mask) @ V.
+
+        query/key/value: [B, H, L, D] dense; sparse_mask: [L, L] sparse
+        (shared across batch/heads).  Returns [B, H, L, D] dense.
+        """
+        from . import _as_coo
+        q = as_tensor(query)
+        k = as_tensor(key)
+        v = as_tensor(value)
+        mc = _as_coo(sparse_mask)
+        rows = wrap_array(mc._indices._data[0].astype(jnp.int32))
+        cols = wrap_array(mc._indices._data[1].astype(jnp.int32))
+        L = int(q.shape[-2])
+        d = int(q.shape[-1])
+        scale = 1.0 / math.sqrt(d)
+
+        def fn(qa, ka, va, rows_a, cols_a):
+            def one_head(qh, kh, vh):
+                qr = jnp.take(qh, rows_a, axis=0)        # [nnz, D]
+                kc = jnp.take(kh, cols_a, axis=0)        # [nnz, D]
+                scores = jnp.sum(qr * kc, -1) * scale    # SDDMM
+                mx = jax.ops.segment_max(scores, rows_a, num_segments=L)
+                e = jnp.exp(scores - jnp.take(mx, rows_a))
+                denom = jax.ops.segment_sum(e, rows_a, num_segments=L)
+                p = e / jnp.take(denom, rows_a)          # sparse softmax
+                contrib = jnp.take(vh, cols_a, axis=0) * p[:, None]
+                return jax.ops.segment_sum(contrib, rows_a,
+                                           num_segments=L)  # SpMM
+            flat = (qa.reshape(-1, L, d), ka.reshape(-1, L, d),
+                    va.reshape(-1, L, d))
+            out = jax.vmap(one_head)(*flat)
+            return out.reshape(qa.shape)
+
+        return apply("sparse_attention", fn, q, k, v, rows, cols)
+
+
+functional = _Functional()

@@ -1,0 +1,104 @@
+"""ASP N:M structured sparsity (reference incubate/asp/).
+
+Covers mask algorithms (1d, 2d greedy, 2d best), checkers, density,
+prune_model + decorate keeping sparsity through real training steps.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+
+
+def test_get_mask_1d_keeps_top_n_per_group():
+    mat = np.array([[1.0, -5.0, 0.2, 3.0, 9.0, 0.1, -8.0, 2.0]])
+    mask = asp.get_mask_1d(mat, 2, 4)
+    np.testing.assert_array_equal(
+        mask, [[0, 1, 0, 1, 1, 0, 1, 0]])
+    assert asp.check_mask_1d(mat * mask, 2, 4)
+    assert not asp.check_mask_1d(np.ones((1, 4)), 2, 4)
+
+
+def test_get_mask_1d_ragged_width():
+    mat = np.random.RandomState(0).randn(3, 10)   # 10 % 4 != 0
+    mask = asp.get_mask_1d(mat, 2, 4)
+    assert mask.shape == (3, 10)
+    assert asp.check_mask_1d(mat * mask, 2, 4)
+
+
+def test_get_mask_2d_greedy_and_best():
+    rng = np.random.RandomState(1)
+    mat = rng.randn(8, 8)
+    for fn in (asp.get_mask_2d_greedy, asp.get_mask_2d_best):
+        mask = fn(mat, 2, 4)
+        pruned = mat * mask
+        assert asp.check_mask_2d(pruned, 2, 4), fn.__name__
+        assert mask.sum() == 8 * 8 // 2           # exactly 50%
+    # best >= greedy in preserved magnitude
+    g = np.abs(mat * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+    b = np.abs(mat * asp.get_mask_2d_best(mat, 2, 4)).sum()
+    assert b >= g - 1e-9
+
+
+def test_calculate_density():
+    x = np.zeros((4, 4))
+    x[0, 0] = x[1, 1] = 1.0
+    assert asp.calculate_density(x) == 2 / 16
+    assert asp.calculate_density(paddle.to_tensor(x)) == 2 / 16
+
+
+def test_create_mask_conv_kernel():
+    rng = np.random.RandomState(2)
+    kernel = rng.randn(8, 4, 3, 3).astype("float32")
+    mask = asp.create_mask(kernel, asp.MaskAlgo.MASK_1D, 2, 4)
+    assert mask.shape == kernel.shape
+    assert asp.check_sparsity(kernel * mask, asp.CheckMethod.CHECK_1D,
+                              2, 4)
+
+
+def test_prune_model_and_decorate_keep_sparsity():
+    paddle.seed(33)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, 4))
+    asp.reset_excluded_layers()
+    masks = asp.prune_model(net, n=2, m=4)
+    assert len(masks) == 2                        # two weight matrices
+    for _, p in net.named_parameters():
+        if p.ndim >= 2:
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+
+    opt = asp.decorate(paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net.parameters()))
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(32, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randn(32, 4).astype("float32"))
+    for _ in range(3):
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity survived training
+    for _, p in net.named_parameters():
+        if p.ndim >= 2:
+            arr = np.asarray(p.numpy())
+            assert asp.check_mask_1d(arr if arr.ndim == 2 else
+                                     arr.reshape(arr.shape[0], -1), 2, 4)
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+
+
+def test_excluded_layers_skipped():
+    paddle.seed(34)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8),
+                               paddle.nn.Linear(8, 8))
+    names = [n for n, p in net.named_parameters() if p.ndim >= 2]
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers([names[0]])
+    masks = asp.prune_model(net, n=2, m=4)
+    assert names[0] not in masks and names[1] in masks
+    dens = {n: asp.calculate_density(p)
+            for n, p in net.named_parameters() if p.ndim >= 2}
+    assert dens[names[0]] > 0.9                   # untouched
+    assert abs(dens[names[1]] - 0.5) < 1e-6
+    asp.reset_excluded_layers()

@@ -1,0 +1,125 @@
+"""Real dataset file formats: IDX (MNIST) and CIFAR pickle-tar loaders,
+plus the VERDICT bar — LeNet Model.fit end-to-end on real MNIST files.
+
+Reference: python/paddle/vision/datasets/mnist.py (IDX parsing),
+cifar.py (tarfile of pickled batches).
+"""
+
+import gzip
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.datasets import MNIST, Cifar10, Cifar100
+
+
+def _write_idx(tmp_path, images, labels, gz=True, tag=""):
+    """Write IDX3 (images, uint8) + IDX1 (labels) files."""
+    n, rows, cols = images.shape[0], images.shape[2], images.shape[3]
+    img_blob = struct.pack(">IIII", 0x803, n, rows, cols) + \
+        (images * 255).astype(np.uint8).tobytes()
+    lab_blob = struct.pack(">II", 0x801, n) + \
+        labels.astype(np.uint8).tobytes()
+    suffix = ".gz" if gz else ""
+    ip = str(tmp_path / f"{tag}images-idx3-ubyte{suffix}")
+    lp = str(tmp_path / f"{tag}labels-idx1-ubyte{suffix}")
+    op = gzip.open if gz else open
+    with op(ip, "wb") as f:
+        f.write(img_blob)
+    with op(lp, "wb") as f:
+        f.write(lab_blob)
+    return ip, lp
+
+
+def _fake_mnist(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, n)
+    return images, labels
+
+
+def test_mnist_idx_gz_roundtrip(tmp_path):
+    images, labels = _fake_mnist()
+    ip, lp = _write_idx(tmp_path, images, labels, gz=True)
+    ds = MNIST(image_path=ip, label_path=lp, mode="train")
+    assert len(ds) == 64
+    img, lab = ds[5]
+    assert img.shape == (1, 28, 28)
+    assert int(lab) == labels[5]
+    np.testing.assert_allclose(
+        img, (images[5] * 255).astype(np.uint8) / 255.0, atol=1e-6)
+
+
+def test_mnist_idx_plain_roundtrip(tmp_path):
+    images, labels = _fake_mnist(32, seed=1)
+    ip, lp = _write_idx(tmp_path, images, labels, gz=False)
+    ds = MNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 32
+    assert ds[0][0].dtype == np.float32
+
+
+def _write_cifar(tmp_path, n_batches=2, per=32, classes=10):
+    name = str(tmp_path / "cifar.tar.gz")
+    rng = np.random.RandomState(3)
+    truth = {}
+    with tarfile.open(name, "w:gz") as tar:
+        import io
+        def addfile(fname, obj):
+            blob = pickle.dumps(obj)
+            info = tarfile.TarInfo(fname)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+        key = b"labels" if classes == 10 else b"fine_labels"
+        for b in range(n_batches):
+            data = (rng.rand(per, 3072) * 255).astype(np.uint8)
+            labels = rng.randint(0, classes, per).tolist()
+            truth[f"data_batch_{b+1}"] = (data, labels)
+            addfile(f"cifar-10-batches-py/data_batch_{b+1}",
+                    {b"data": data, key: labels})
+        tdata = (rng.rand(per, 3072) * 255).astype(np.uint8)
+        tlabels = rng.randint(0, classes, per).tolist()
+        truth["test_batch"] = (tdata, tlabels)
+        addfile("cifar-10-batches-py/test_batch",
+                {b"data": tdata, key: tlabels})
+    return name, truth
+
+
+def test_cifar10_tar_roundtrip(tmp_path):
+    name, truth = _write_cifar(tmp_path)
+    train = Cifar10(data_file=name, mode="train")
+    assert len(train) == 64                     # 2 batches x 32
+    img, lab = train[0]
+    assert img.shape == (3, 32, 32)
+    ref = truth["data_batch_1"][0][0].reshape(3, 32, 32) / 255.0
+    np.testing.assert_allclose(img, ref.astype("float32"), atol=1e-6)
+    test = Cifar10(data_file=name, mode="test")
+    assert len(test) == 32
+    assert int(test[3][1]) == truth["test_batch"][1][3]
+
+
+def test_lenet_fit_on_real_mnist_files(tmp_path):
+    """VERDICT item 10 'done' bar: LeNet e2e on real MNIST files."""
+    # learnable data: distinct per-class prototypes + small noise
+    rng = np.random.RandomState(7)
+    protos = rng.rand(10, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, 128)
+    images = np.clip(protos[labels] * 0.85
+                     + 0.15 * rng.rand(128, 1, 28, 28), 0, 1)
+    ip, lp = _write_idx(tmp_path, images.astype("float32"), labels)
+    ds = MNIST(image_path=ip, label_path=lp, mode="train")
+
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(44)
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=2e-3,
+                              parameters=model.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    hist = model.fit(ds, epochs=4, batch_size=32, verbose=0)
+    res = model.evaluate(ds, batch_size=32, verbose=0)
+    assert res["acc"] > 0.8, res

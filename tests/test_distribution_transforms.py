@@ -1,0 +1,184 @@
+"""Distribution transforms (reference distribution/transform.py):
+forward/inverse consistency, log-det correctness vs autodiff, shape
+rules, and TransformedDistribution integration.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _x(*shape, seed=0, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(
+        (rng.rand(*shape) * (hi - lo) + lo).astype("float32"))
+
+
+def _check_bijection(t, x, rtol=1e-4):
+    y = t.forward(x)
+    back = t.inverse(y)
+    np.testing.assert_allclose(np.asarray(back.numpy()),
+                               np.asarray(x.numpy()), rtol=rtol,
+                               atol=1e-5)
+    # ildj == -fldj at matching points
+    fldj = np.asarray(t.forward_log_det_jacobian(x).numpy())
+    ildj = np.asarray(t.inverse_log_det_jacobian(y).numpy())
+    np.testing.assert_allclose(ildj, -fldj, rtol=rtol, atol=1e-5)
+
+
+def _numeric_fldj_scalar(t, x0, eps=1e-4):
+    """d f / d x via central differences for elementwise transforms."""
+    xp = paddle.to_tensor(np.asarray([x0 + eps], dtype="float64"))
+    xm = paddle.to_tensor(np.asarray([x0 - eps], dtype="float64"))
+    fp = float(t.forward(xp).numpy()[0])
+    fm = float(t.forward(xm).numpy()[0])
+    return np.log(np.abs((fp - fm) / (2 * eps)))
+
+
+@pytest.mark.parametrize("t,x0", [
+    (D.ExpTransform(), 0.7),
+    (D.SigmoidTransform(), 0.3),
+    (D.TanhTransform(), 0.4),
+    (D.AffineTransform(paddle.to_tensor(1.0), paddle.to_tensor(-2.5)),
+     0.9),
+    (D.PowerTransform(paddle.to_tensor(3.0)), 1.3),
+])
+def test_elementwise_bijections(t, x0):
+    x = _x(5, seed=3, lo=0.2, hi=1.5)
+    _check_bijection(t, x)
+    got = float(t.forward_log_det_jacobian(
+        paddle.to_tensor(np.asarray([x0], "float64"))).numpy()[0])
+    want = _numeric_fldj_scalar(t, x0)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_abs_transform_surjection():
+    t = D.AbsTransform()
+    x = paddle.to_tensor([-3.0, 2.0])
+    np.testing.assert_array_equal(t.forward(x).numpy(), [3.0, 2.0])
+    assert not D.AbsTransform._is_injective()
+    assert D.ExpTransform._is_injective()
+
+
+def test_chain_transform():
+    t = D.ChainTransform([D.ExpTransform(),
+                          D.AffineTransform(paddle.to_tensor(0.0),
+                                            paddle.to_tensor(2.0))])
+    x = _x(4, seed=5)
+    y = t.forward(x)
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               2 * np.exp(np.asarray(x.numpy())),
+                               rtol=1e-5)
+    _check_bijection(t, x)
+    # fldj = x + log(2)
+    np.testing.assert_allclose(
+        np.asarray(t.forward_log_det_jacobian(x).numpy()),
+        np.asarray(x.numpy()) + np.log(2.0), rtol=1e-5)
+
+
+def test_independent_transform_sums_event_dims():
+    t = D.IndependentTransform(D.ExpTransform(), 1)
+    x = _x(3, 4, seed=6)
+    ldj = t.forward_log_det_jacobian(x)
+    assert list(ldj.shape) == [3]
+    np.testing.assert_allclose(np.asarray(ldj.numpy()),
+                               np.asarray(x.numpy()).sum(-1), rtol=1e-5)
+
+
+def test_reshape_transform():
+    t = D.ReshapeTransform((2, 3), (6,))
+    x = _x(5, 2, 3, seed=7)
+    y = t.forward(x)
+    assert list(y.shape) == [5, 6]
+    assert t.forward_shape([9, 2, 3]) == [9, 6]
+    assert t.inverse_shape([9, 6]) == [9, 2, 3]
+    back = t.inverse(y)
+    np.testing.assert_array_equal(np.asarray(back.numpy()),
+                                  np.asarray(x.numpy()))
+    assert list(t.forward_log_det_jacobian(x).shape) == [5]
+
+
+def test_softmax_transform():
+    t = D.SoftmaxTransform()
+    x = _x(4, 5, seed=8)
+    y = np.asarray(t.forward(x).numpy())
+    np.testing.assert_allclose(y.sum(-1), np.ones(4), rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        t.forward_log_det_jacobian(x)
+
+
+def test_stick_breaking_transform():
+    t = D.StickBreakingTransform()
+    x = _x(6, 3, seed=9)
+    y = np.asarray(t.forward(x).numpy())
+    assert y.shape == (6, 4)
+    np.testing.assert_allclose(y.sum(-1), np.ones(6), rtol=1e-5)
+    assert (y > 0).all()
+    back = np.asarray(t.inverse(paddle.to_tensor(y)).numpy())
+    np.testing.assert_allclose(back, np.asarray(x.numpy()), rtol=1e-3,
+                               atol=1e-4)
+    assert t.forward_shape([6, 3]) == [6, 4]
+    # log-det vs autodiff jacobian of the first K components
+    import jax, jax.numpy as jnp
+    x0 = np.asarray(x.numpy())[0].astype("float64")
+    jac = jax.jacfwd(lambda a: t._forward(a)[:-1])(jnp.asarray(x0))
+    want = np.linalg.slogdet(np.asarray(jac))[1]
+    got = float(np.asarray(t.forward_log_det_jacobian(
+        paddle.to_tensor(x0)).numpy()))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_stack_transform():
+    t = D.StackTransform([D.ExpTransform(), D.TanhTransform()], axis=1)
+    x = _x(3, 2, seed=10)
+    y = np.asarray(t.forward(x).numpy())
+    xa = np.asarray(x.numpy())
+    np.testing.assert_allclose(y[:, 0], np.exp(xa[:, 0]), rtol=1e-5)
+    np.testing.assert_allclose(y[:, 1], np.tanh(xa[:, 1]), rtol=1e-5)
+    back = np.asarray(t.inverse(paddle.to_tensor(y)).numpy())
+    np.testing.assert_allclose(back, xa, rtol=1e-4, atol=1e-5)
+
+
+def test_chain_mixed_event_ranks():
+    """Per-element + event-summed terms must align: chain of Tanh
+    (rank 0) then StickBreaking (rank 1) gives a [B]-shaped log-det
+    equal to the sum of tanh's per-element terms plus SB's row term."""
+    t = D.ChainTransform([D.TanhTransform(), D.StickBreakingTransform()])
+    x = _x(4, 3, seed=12, lo=-0.5, hi=0.5)
+    ldj = t.forward_log_det_jacobian(x)
+    assert list(ldj.shape) == [4]
+    tanh = D.TanhTransform()
+    sb = D.StickBreakingTransform()
+    want = np.asarray(tanh.forward_log_det_jacobian(x).numpy()).sum(-1) \
+        + np.asarray(sb.forward_log_det_jacobian(
+            tanh.forward(x)).numpy())
+    np.testing.assert_allclose(np.asarray(ldj.numpy()), want, rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal_parity():
+    """Normal + ExpTransform must equal LogNormal densities."""
+    base = D.Normal(paddle.to_tensor(0.3), paddle.to_tensor(0.8))
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(paddle.to_tensor(0.3), paddle.to_tensor(0.8))
+    v = paddle.to_tensor([0.5, 1.0, 2.3])
+    np.testing.assert_allclose(np.asarray(td.log_prob(v).numpy()),
+                               np.asarray(ln.log_prob(v).numpy()),
+                               rtol=1e-5)
+    s = td.sample((100,))
+    assert (np.asarray(s.numpy()) > 0).all()
+
+
+def test_transform_call_composition():
+    e = D.ExpTransform()
+    a = D.AffineTransform(paddle.to_tensor(0.0), paddle.to_tensor(3.0))
+    chained = a(e)            # Transform(Transform) -> ChainTransform
+    assert isinstance(chained, D.ChainTransform)
+    x = _x(3, seed=11)
+    np.testing.assert_allclose(
+        np.asarray(chained.forward(x).numpy()),
+        3 * np.exp(np.asarray(x.numpy())), rtol=1e-5)
+    # calling with a tensor applies forward
+    np.testing.assert_allclose(np.asarray(e(x).numpy()),
+                               np.exp(np.asarray(x.numpy())), rtol=1e-5)

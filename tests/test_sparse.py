@@ -1,0 +1,222 @@
+"""Real sparse tensors (P12): compressed storage, COO/CSR ops, SpMM,
+SDDMM, sparse softmax/attention, autograd on values.
+
+Reference: python/paddle/sparse/ + phi/kernels/sparse/.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    # [[0, 2, 0], [3, 0, 4]]
+    return sparse.sparse_coo_tensor(
+        [[0, 1, 1], [1, 0, 2]], [2.0, 3.0, 4.0], shape=[2, 3])
+
+
+def test_coo_storage_is_compressed():
+    sp = _coo()
+    assert sp.nnz() == 3
+    assert sp.indices().shape == [2, 3]
+    assert sp.values().shape == [3]
+    dense = np.asarray(sp.to_dense().numpy())
+    np.testing.assert_array_equal(dense, [[0, 2, 0], [3, 0, 4]])
+
+
+def test_coo_csr_roundtrip():
+    sp = _coo()
+    csr = sp.to_sparse_csr()
+    assert csr.crows().numpy().tolist() == [0, 1, 3]
+    assert csr.cols().numpy().tolist() == [1, 0, 2]
+    np.testing.assert_array_equal(
+        np.asarray(csr.to_dense().numpy()),
+        np.asarray(sp.to_dense().numpy()))
+    back = csr.to_sparse_coo()
+    assert back.nnz() == 3
+
+
+def test_dense_to_sparse_and_back():
+    x = paddle.to_tensor([[0.0, 5.0], [6.0, 0.0]])
+    sp = sparse.to_sparse_coo(x)
+    assert sp.nnz() == 2
+    np.testing.assert_array_equal(np.asarray(sp.to_dense().numpy()),
+                                  np.asarray(x.numpy()))
+    csr = sparse.to_sparse_csr(x)
+    assert csr.nnz() == 2
+
+
+def test_coalesce_merges_duplicates():
+    sp = sparse.sparse_coo_tensor(
+        [[0, 0, 1], [1, 1, 0]], [1.0, 2.0, 5.0], shape=[2, 2])
+    co = sp.coalesce()
+    assert co.nnz() == 2
+    np.testing.assert_array_equal(np.asarray(co.to_dense().numpy()),
+                                  [[0, 3], [5, 0]])
+
+
+def test_unary_ops_stay_sparse():
+    sp = _coo()
+    out = sparse.sqrt(sp)
+    assert isinstance(out, sparse.SparseCooTensor)
+    assert out.nnz() == 3
+    np.testing.assert_allclose(out.values().numpy(),
+                               np.sqrt([2.0, 3.0, 4.0]), rtol=1e-6)
+    relu = sparse.relu(sparse.sparse_coo_tensor(
+        [[0, 1]], [-1.0, 2.0], shape=[2]))
+    np.testing.assert_array_equal(relu.values().numpy(), [0.0, 2.0])
+
+
+def test_add_multiply_union_pattern():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 0]], [10.0, 7.0], [2, 2])
+    s = sparse.add(a, b)
+    np.testing.assert_array_equal(np.asarray(s.to_dense().numpy()),
+                                  [[11, 0], [7, 2]])
+    m = sparse.multiply(a, b)
+    np.testing.assert_array_equal(np.asarray(m.to_dense().numpy()),
+                                  [[10, 0], [0, 0]])
+    d = sparse.subtract(a, b)
+    np.testing.assert_array_equal(np.asarray(d.to_dense().numpy()),
+                                  [[-9, 0], [-7, 2]])
+
+
+def test_spmm_matches_dense():
+    rng = np.random.RandomState(0)
+    dense_a = rng.randn(6, 5).astype("float32")
+    dense_a[dense_a < 0.4] = 0          # sparsify
+    y = rng.randn(5, 3).astype("float32")
+    sp = sparse.to_sparse_coo(paddle.to_tensor(dense_a))
+    out = sparse.matmul(sp, paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out.numpy()), dense_a @ y,
+                               rtol=1e-5, atol=1e-6)
+    # CSR input too
+    out_csr = sparse.matmul(sp.to_sparse_csr(), paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out_csr.numpy()), dense_a @ y,
+                               rtol=1e-5, atol=1e-6)
+    # mv
+    v = rng.randn(5).astype("float32")
+    mv = sparse.mv(sp, paddle.to_tensor(v))
+    np.testing.assert_allclose(np.asarray(mv.numpy()), dense_a @ v,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_spmm_gradients_flow():
+    dense_a = np.array([[1.0, 0.0], [0.0, 2.0]], dtype="float32")
+    sp = sparse.to_sparse_coo(paddle.to_tensor(dense_a))
+    sp.values().stop_gradient = False
+    y = paddle.to_tensor(np.ones((2, 2), dtype="float32"))
+    y.stop_gradient = False
+    out = sparse.matmul(sp, y)
+    out.sum().backward()
+    # d(sum)/d(values[k]) = sum_j y[col_k, j]
+    np.testing.assert_allclose(sp.values().grad.numpy(), [2.0, 2.0])
+    # d(sum)/dy[k, :] = sum of values in column k of A
+    np.testing.assert_allclose(np.asarray(y.grad.numpy()),
+                               [[1.0, 1.0], [2.0, 2.0]])
+
+
+def test_sddmm_masked_matmul():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype("float32")
+    y = rng.randn(6, 4).astype("float32")
+    mask = sparse.sparse_coo_tensor(
+        [[0, 1, 3], [0, 2, 3]], [1.0, 1.0, 1.0], [4, 4])
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    assert isinstance(out, sparse.SparseCooTensor)
+    full = x @ y
+    np.testing.assert_allclose(
+        out.values().numpy(),
+        [full[0, 0], full[1, 2], full[3, 3]], rtol=1e-5)
+
+
+def test_sparse_softmax_rows():
+    sp = sparse.sparse_coo_tensor(
+        [[0, 0, 1], [0, 2, 1]], [1.0, 3.0, 5.0], [2, 3])
+    out = sparse.nn.functional.softmax(sp)
+    v = np.asarray(out.values().numpy())
+    # row 0 has two entries, row 1 one entry
+    e = np.exp([1.0 - 3.0, 0.0])
+    np.testing.assert_allclose(v[:2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+
+
+def test_sparse_attention_matches_dense_masked():
+    rng = np.random.RandomState(2)
+    B, H, L, D = 2, 2, 8, 4
+    q = rng.randn(B, H, L, D).astype("float32")
+    k = rng.randn(B, H, L, D).astype("float32")
+    v = rng.randn(B, H, L, D).astype("float32")
+    # causal mask as a sparse pattern
+    ij = np.array([(i, j) for i in range(L) for j in range(i + 1)]).T
+    mask = sparse.sparse_coo_tensor(ij, np.ones(ij.shape[1], "float32"),
+                                    [L, L])
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        mask)
+    # dense reference
+    scores = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D)
+    causal = np.tril(np.ones((L, L))) == 0
+    scores[:, :, causal] = -1e30
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhlm,bhmd->bhld", p, v)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_nn_layers():
+    sp = sparse.sparse_coo_tensor([[0, 1]], [-3.0, 4.0], [2])
+    out = sparse.nn.ReLU()(sp)
+    np.testing.assert_array_equal(out.values().numpy(), [0.0, 4.0])
+    lr = sparse.nn.LeakyReLU(0.1)(sp)
+    np.testing.assert_allclose(lr.values().numpy(), [-0.3, 4.0],
+                               rtol=1e-6)
+
+
+def test_grad_flows_through_sparse_op_chain():
+    """relu -> matmul chain: constructors must not sever the tape."""
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-2.0, 3.0], [2, 2],
+                                 stop_gradient=False)
+    y = paddle.to_tensor(np.ones((2, 2), dtype="float32"))
+    out = sparse.matmul(sparse.relu(a), y)
+    out.sum().backward()
+    g = a.values().grad
+    assert g is not None
+    # relu kills the -2 entry's gradient, keeps the 3.0 entry's (2 cols)
+    np.testing.assert_allclose(np.asarray(g.numpy()), [0.0, 2.0])
+
+
+def test_divide_requires_same_pattern():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [4.0, 9.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0], [2, 2])
+    out = sparse.divide(a, b)
+    np.testing.assert_allclose(out.values().numpy(), [2.0, 3.0])
+    c = sparse.sparse_coo_tensor([[0], [1]], [1.0], [2, 2])
+    with pytest.raises(ValueError, match="pattern"):
+        sparse.divide(a, c)
+    with pytest.raises(ValueError, match="shape"):
+        sparse.multiply(a, sparse.sparse_coo_tensor(
+            [[0], [0]], [1.0], [3, 3]))
+
+
+def test_sparse_softmax_batched_3d():
+    """[B, L, L] sparse softmax normalizes per (batch, row), not per
+    batch slice."""
+    idx = [[0, 0, 1], [0, 0, 0], [1, 2, 1]]     # b, row, col
+    sp = sparse.sparse_coo_tensor(idx, [1.0, 3.0, 7.0], [2, 3, 3])
+    out = sparse.nn.functional.softmax(sp)
+    v = np.asarray(out.values().numpy())
+    e = np.exp([1.0 - 3.0, 0.0])
+    np.testing.assert_allclose(v[:2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+
+
+def test_cast_and_is_same_shape():
+    sp = _coo()
+    c = sparse.cast(sp, index_dtype="int32", value_dtype="float64")
+    assert "float64" in str(c.values().dtype)
+    assert sparse.is_same_shape(sp, c)
