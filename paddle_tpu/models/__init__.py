@@ -4,6 +4,12 @@ BASELINE.md configs 3-5)."""
 
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer)
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    gpt3_1p3b_config)
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification,
+    BertForQuestionAnswering, bert_base_config)
 from . import llama_pretrain  # noqa: F401
 from .llama_pretrain import (  # noqa: F401
     LlamaPretrainConfig, make_train_step, init_params, init_adamw_state,

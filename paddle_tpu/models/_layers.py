@@ -1,0 +1,58 @@
+"""Shared model-building seams: TP-aware linear dispatch + attention
+mask normalization.  Used by llama/gpt/bert so the mesh-detection logic
+lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import Linear
+from ..ops.dispatch import apply, as_tensor
+
+__all__ = ["make_tp_linear", "normalize_attn_mask"]
+
+
+def make_tp_linear(tensor_parallel: bool, in_f: int, out_f: int,
+                   kind: str, has_bias: bool = False):
+    """Column/Row-parallel linear when a global mesh exposes an mp axis
+    with size > 1, else a plain Linear (the seam TP models share)."""
+    if tensor_parallel:
+        from ..distributed.mesh import get_global_mesh
+        mesh = get_global_mesh()
+        if mesh is not None and "mp" in mesh.axis_names and \
+                mesh.shape["mp"] > 1:
+            from ..distributed.fleet.meta_parallel import (
+                ColumnParallelLinear, RowParallelLinear)
+            if kind == "col":
+                return ColumnParallelLinear(in_f, out_f,
+                                            has_bias=has_bias,
+                                            gather_output=False)
+            return RowParallelLinear(in_f, out_f, has_bias=has_bias,
+                                     input_is_parallel=True)
+    return Linear(in_f, out_f, bias_attr=None if has_bias else False)
+
+
+def normalize_attn_mask(mask, neg: float = -1e9):
+    """Accepts the conventional mask forms and returns what
+    scaled_dot_product_attention expects ([B, 1|H, L, L] bool or
+    additive float):
+
+      * [B, L] 0/1 padding mask (PaddleNLP contract)  -> additive
+        [B, 1, 1, L] with ``neg`` at padded keys;
+      * [B, L, L] bool/float                            -> [B, 1, L, L];
+      * 4-D masks pass through unchanged.
+    """
+    if mask is None:
+        return None
+    m = as_tensor(mask)
+    if m.ndim == 2:
+        def fn(a):
+            add = (1.0 - a.astype(jnp.float32)) * neg
+            return add[:, None, None, :]
+        return apply("attn_mask_pad", fn, m)
+    if m.ndim == 3:
+        def fn3(a):
+            return a[:, None, :, :]
+        return apply("attn_mask_3d", fn3, m)
+    return m

@@ -50,19 +50,9 @@ class LlamaConfig:
 
 def _make_linear(cfg, in_f, out_f, kind):
     """Column/Row-parallel when an mp mesh axis exists, else plain."""
-    if cfg.tensor_parallel:
-        from ..distributed.mesh import get_global_mesh
-        mesh = get_global_mesh()
-        if mesh is not None and "mp" in mesh.axis_names and \
-                mesh.shape["mp"] > 1:
-            from ..distributed.fleet.meta_parallel import (
-                ColumnParallelLinear, RowParallelLinear)
-            if kind == "col":
-                return ColumnParallelLinear(in_f, out_f, has_bias=False,
-                                            gather_output=False)
-            return RowParallelLinear(in_f, out_f, has_bias=False,
-                                     input_is_parallel=True)
-    return Linear(in_f, out_f, bias_attr=False)
+    from ._layers import make_tp_linear
+    return make_tp_linear(cfg.tensor_parallel, in_f, out_f, kind,
+                          has_bias=False)
 
 
 class LlamaAttention(Layer):
