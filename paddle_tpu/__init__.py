@@ -76,6 +76,10 @@ from . import onnx  # noqa: F401,E402
 from . import strings  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
 from . import hapi as _hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402

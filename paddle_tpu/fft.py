@@ -1,0 +1,167 @@
+"""Discrete Fourier transforms (capability mirror of
+/root/reference/python/paddle/fft.py — fft/ifft/rfft/... with
+"backward"/"ortho"/"forward" norms).
+
+TPU-native: every transform is ``jnp.fft.*`` dispatched through
+:func:`paddle_tpu.ops.dispatch.apply`, so values flow through XLA's FFT
+custom-call and gradients through ``jax.vjp``. The reference instead routes
+to dedicated C++ kernels (fft_c2c/fft_r2c/fft_c2r, fft.py:1389-1613);
+here XLA owns the kernel and the r2c/c2r split is just numpy-style API.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import apply, as_tensor
+from .tensor.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm}. Norm should be forward, backward or ortho")
+    return norm
+
+
+def _check_n(n):
+    if n is not None and n <= 0:
+        raise ValueError(f"Invalid FFT argument n({n}), it should be a positive integer.")
+
+
+def _1d(name, jfn, x, n, axis, norm):
+    _check_norm(norm)
+    _check_n(n)
+    return apply(name, lambda a: jfn(a, n=n, axis=axis, norm=norm), as_tensor(x))
+
+
+def _nd(name, jfn, x, s, axes, norm):
+    _check_norm(norm)
+    if s is not None:
+        for n in s:
+            _check_n(n)
+    return apply(name, lambda a: jfn(a, s=s, axes=axes, norm=norm), as_tensor(x))
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _1d("fft", jnp.fft.fft, x, n, axis, norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _1d("ifft", jnp.fft.ifft, x, n, axis, norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _1d("rfft", jnp.fft.rfft, x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _1d("irfft", jnp.fft.irfft, x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _1d("hfft", jnp.fft.hfft, x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _1d("ihfft", jnp.fft.ihfft, x, n, axis, norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nd("fftn", jnp.fft.fftn, x, s, axes, norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nd("ifftn", jnp.fft.ifftn, x, s, axes, norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nd("rfftn", jnp.fft.rfftn, x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nd("irfftn", jnp.fft.irfftn, x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    # jnp has no hfftn; hfftn(x, norm) == irfftn(conj(x), norm=inv) exactly
+    # (the Hermitian forward transform is the inverse c2r transform with the
+    # normalisation roles swapped). numpy also lacks hfftn; the reference
+    # implements it via its c2r kernel (fft.py:760).
+    _check_norm(norm)
+    x = as_tensor(x)
+
+    def fn(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        ax = [d % a.ndim for d in ax]
+        inv = {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+        return jnp.fft.irfftn(jnp.conj(a), s=s, axes=ax, norm=inv)
+
+    return apply("hfftn", fn, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    # ihfftn(x, norm) == conj(rfftn(x, norm=inv)) exactly.
+    _check_norm(norm)
+    x = as_tensor(x)
+
+    def fn(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        ax = [d % a.ndim for d in ax]
+        inv = {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+        return jnp.conj(jnp.fft.rfftn(a, s=s, axes=ax, norm=inv))
+
+    return apply("ihfftn", fn, x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nd("fft2", jnp.fft.fftn, x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nd("ifft2", jnp.fft.ifftn, x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nd("rfft2", jnp.fft.rfftn, x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nd("irfft2", jnp.fft.irfftn, x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework import dtype as dtypes
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply("fftfreq", lambda: jnp.fft.fftfreq(n, d=d).astype(jdt or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework import dtype as dtypes
+    jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return apply("rfftfreq", lambda: jnp.fft.rfftfreq(n, d=d).astype(jdt or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), as_tensor(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), as_tensor(x))

@@ -1,0 +1,74 @@
+"""Model hub: ``list``/``help``/``load`` entrypoints from a ``hubconf.py``
+(mirror of /root/reference/python/paddle/hapi/hub.py, re-exported at
+/root/reference/python/paddle/hub.py:15).
+
+The reference fetches github/gitee archives into a cache dir and imports the
+repo's ``hubconf.py``. This build supports ``source='local'`` fully (import
+hubconf from a directory); remote sources raise — the deployment
+environment has no network egress, and a cached repo dir can be passed as a
+local source instead.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _import_hubconf(repo_dir: str):
+    hubconf_path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(hubconf_path):
+        raise FileNotFoundError(f"{MODULE_HUBCONF} not found in {repo_dir}")
+    sys.path.insert(0, repo_dir)
+    try:
+        spec = importlib.util.spec_from_file_location("hubconf", hubconf_path)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(m, VAR_DEPENDENCY, [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"Missing dependencies: {missing}")
+    return m
+
+
+def _resolve_repo(repo: str, source: str, force_reload: bool):
+    if source == "local":
+        return os.path.expanduser(repo)
+    raise RuntimeError(
+        f"source={source!r} requires network access, which this environment "
+        f"does not provide; clone the repo and use source='local'.")
+
+
+def _load_entry(m, name: str):
+    fn = getattr(m, name, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {name} in {MODULE_HUBCONF}")
+    return fn
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """List callable entrypoints defined by the repo's hubconf.py."""
+    m = _import_hubconf(_resolve_repo(repo_dir, source, force_reload))
+    return [f for f in dir(m) if callable(getattr(m, f)) and not f.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """Return the docstring of one entrypoint."""
+    m = _import_hubconf(_resolve_repo(repo_dir, source, force_reload))
+    return _load_entry(m, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entrypoint: ``load('/path/to/repo', 'resnet18', source='local')``."""
+    m = _import_hubconf(_resolve_repo(repo_dir, source, force_reload))
+    return _load_entry(m, model)(**kwargs)

@@ -66,6 +66,10 @@ class LlamaPretrainConfig:
     # (head<->seq all_to_all; needs heads % sep == 0).  See
     # distributed/parallel/context_parallel.py.
     context_parallel: Optional[str] = None
+    # loss head: >1 = chunked softmax cross-entropy (custom vjp that never
+    # materialises fp32 [B,S,V] logits; see ops/chunked_loss.py); 0/1 =
+    # plain log_softmax head.  seq-1 must be divisible by the chunk count.
+    loss_chunks: int = 0
 
     def __post_init__(self):
         if self.num_key_value_heads is None:
@@ -382,6 +386,10 @@ def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
         else:
             x = _trunk_scan(params["blocks"], x, cfg, mesh)
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        if cfg.loss_chunks > 1:
+            from ..ops.chunked_loss import chunked_softmax_cross_entropy
+            return chunked_softmax_cross_entropy(
+                x, params["lm_head"], targets, cfg.loss_chunks, dt)
         logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, -1)
         ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]

@@ -1,0 +1,113 @@
+"""Chunked softmax cross-entropy: the LM loss head without the [B,S,V]
+fp32 round-trip.
+
+The straightforward head (reference: ParallelCrossEntropy and
+softmax_with_cross_entropy, /root/reference/python/paddle/nn/functional/loss.py)
+materialises fp32 logits [B,S,V], log_softmax's them (another full
+read+write) and keeps them as residuals for backward — at B=8, S=2047,
+V=32000 that is ~2.1 GB per pass of pure HBM traffic and the same again in
+residency.
+
+TPU-native design: a ``jax.custom_vjp`` that
+  * forward: flattens tokens to [T,H] and scans over T-chunks, computing
+    per-chunk logits with a bf16 MXU matmul accumulated in fp32
+    (``preferred_element_type``), reducing each chunk immediately to
+    (logsumexp, target-logit) — the [C,V] block dies in VMEM/local HBM
+    instead of being written back;
+  * backward: re-runs the same scan, forming d_logits = softmax - onehot
+    per chunk (the one-hot is an iota comparison XLA fuses into the
+    subtraction) and accumulating dx and dW; nothing [T,V]-shaped is ever
+    a residual — only x, W, targets are saved.
+
+This is remat applied surgically to the loss head, with the savings
+guaranteed by construction rather than left to the global remat policy.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_logits(xc, w, dt):
+    # bf16 inputs on the MXU, fp32 accumulation/output.
+    return jax.lax.dot_general(
+        xc.astype(dt), w.astype(dt),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _flatten(x, targets, num_chunks):
+    H = x.shape[-1]
+    xf = x.reshape(-1, H)
+    tf = targets.reshape(-1)
+    T = xf.shape[0]
+    if T % num_chunks:
+        raise ValueError(
+            f"token count {T} not divisible by loss chunk count {num_chunks}")
+    C = T // num_chunks
+    return xf.reshape(num_chunks, C, H), tf.reshape(num_chunks, C), T
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_softmax_cross_entropy(x, w, targets, num_chunks: int = 8,
+                                  compute_dtype=jnp.bfloat16):
+    """Mean NLL of ``softmax(x @ w)`` at ``targets`` without materialising
+    the full logits tensor.
+
+    x: [..., H] activations (any float dtype), w: [H, V] unembedding,
+    targets: [...] int labels; the leading dims are flattened and must be
+    divisible by ``num_chunks``.
+    """
+    nll, _ = _ce_forward(x, w, targets, num_chunks, compute_dtype)
+    return nll
+
+
+def _ce_forward(x, w, targets, num_chunks, dt):
+    xs, ts, T = _flatten(x, targets, num_chunks)
+
+    def step(acc, inp):
+        xc, tc = inp
+        logits = _chunk_logits(xc, w, dt)                        # [C,V] f32
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)       # [C]
+        tgt = jnp.take_along_axis(logits, tc[:, None], -1)[:, 0]
+        return acc + jnp.sum(lse - tgt), None
+
+    total, _ = jax.lax.scan(step, jnp.zeros((), jnp.float32), (xs, ts))
+    return total / T, (x, w, targets)
+
+
+def _ce_fwd(x, w, targets, num_chunks, dt):
+    return _ce_forward(x, w, targets, num_chunks, dt)
+
+
+def _ce_bwd(num_chunks, dt, res, g):
+    x, w, targets = res
+    H, V = w.shape
+    xs, ts, T = _flatten(x, targets, num_chunks)
+    scale = (g / T).astype(jnp.float32)
+
+    def step(dw_acc, inp):
+        xc, tc = inp
+        logits = _chunk_logits(xc, w, dt)
+        p = jax.nn.softmax(logits, axis=-1)                      # [C,V] f32
+        d_logits = (p - jax.nn.one_hot(tc, V, dtype=p.dtype)) * scale
+        d_logits_c = d_logits.astype(dt)
+        dxc = jax.lax.dot_general(                               # [C,H]
+            d_logits_c, w.astype(dt),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwc = jax.lax.dot_general(                               # [H,V]
+            xc.astype(dt), d_logits_c,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dw_acc + dwc, dxc
+
+    dw, dxs = jax.lax.scan(step, jnp.zeros((H, V), jnp.float32), (xs, ts))
+    dx = dxs.reshape(x.shape)
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+chunked_softmax_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

@@ -1,0 +1,179 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+Capability mirror of /root/reference/python/paddle/signal.py (frame :30,
+overlap_add :145, stft :246, istft :423). The reference routes to dedicated
+C++ frame/overlap_add kernels; here framing is a gather and overlap-add a
+scatter-add, both fused by XLA, with the FFT stage on jnp.fft.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.dispatch import apply, as_tensor
+from .tensor.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_array(a, frame_length, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Attribute axis should be 0 or -1, but got {axis}.")
+    seq = a.shape[axis]
+    if frame_length > seq:
+        raise ValueError(
+            f"Attribute frame_length should be less equal than sequence length, "
+            f"but got ({frame_length}) > ({seq}).")
+    n_frames = 1 + (seq - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(n_frames)[None, :])  # [L, F]
+    if axis == -1:
+        return jnp.take(a, idx, axis=-1)              # (..., L, F)
+    return jnp.take(a, idx.T, axis=0)                 # axis == 0 → (F, L, ...)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slide a window over ``axis``; axis=-1 → (..., frame_length, n_frames),
+    axis=0 → (n_frames, frame_length, ...)."""
+    if hop_length < 1:
+        raise ValueError(f"Attribute hop_length should be at least 1, but got ({hop_length}).")
+    return apply("frame",
+                 lambda a: _frame_array(a, frame_length, hop_length, axis),
+                 as_tensor(x))
+
+
+def _overlap_add_array(a, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Attribute axis should be 0 or -1, but got {axis}.")
+    if axis == -1:
+        frame_length, n_frames = a.shape[-2], a.shape[-1]
+        seq = (n_frames - 1) * hop_length + frame_length
+        pos = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])  # [L, F]
+        out = jnp.zeros(a.shape[:-2] + (seq,), dtype=a.dtype)
+        return out.at[..., pos].add(a)
+    n_frames, frame_length = a.shape[0], a.shape[1]
+    seq = (n_frames - 1) * hop_length + frame_length
+    pos = (hop_length * jnp.arange(n_frames)[:, None]
+           + jnp.arange(frame_length)[None, :])  # [F, L]
+    out = jnp.zeros((seq,) + a.shape[2:], dtype=a.dtype)
+    return out.at[pos].add(a)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    if hop_length < 1:
+        raise ValueError(f"Attribute hop_length should be at least 1, but got ({hop_length}).")
+    return apply("overlap_add",
+                 lambda a: _overlap_add_array(a, hop_length, axis),
+                 as_tensor(x))
+
+
+def _resolve_window(window, win_length, n_fft, dtype, onesided):
+    if window is None:
+        w = jnp.ones((win_length,), dtype=dtype)
+    else:
+        w = as_tensor(window)._data
+        if w.shape != (win_length,):
+            raise ValueError(
+                f"expected a 1D window tensor of size equal to win_length({win_length}),"
+                f" but got window with shape {w.shape}.")
+        if jnp.iscomplexobj(w):
+            if onesided:
+                raise ValueError(
+                    "onesided should be False when input or window is a complex Tensor")
+        else:
+            w = w.astype(dtype)
+    if win_length < n_fft:  # centre-pad the window out to n_fft
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+    return w
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform. x: [seq] or [batch, seq] (real or
+    complex) → complex [(batch,) n_fft//2+1 | n_fft, n_frames]."""
+    xt = as_tensor(x)
+    squeeze = xt.ndim == 1
+    if xt.ndim not in (1, 2):
+        raise ValueError(f"x should be a 1D or 2D real tensor, but got rank {xt.ndim}.")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    real_dt = jnp.float64 if xt._data.dtype in (jnp.float64, jnp.complex128) else jnp.float32
+    w = _resolve_window(window, win_length, n_fft, real_dt, onesided)
+    is_complex = jnp.iscomplexobj(xt._data) or jnp.iscomplexobj(w)
+    if is_complex and onesided:
+        raise ValueError("onesided should be False when input or window is a complex Tensor")
+
+    def fn(a):
+        b = a[None] if squeeze else a
+        if center:
+            pad = n_fft // 2
+            b = jnp.pad(b, ((0, 0), (pad, pad)), mode=pad_mode)
+        frames = _frame_array(b, n_fft, hop_length, -1)     # [B, n_fft, F]
+        frames = frames * w[None, :, None]
+        if is_complex:
+            spec = jnp.fft.fft(frames, axis=1)
+        elif onesided:
+            spec = jnp.fft.rfft(frames, axis=1)
+        else:
+            spec = jnp.fft.fft(frames.astype(jnp.complex64 if real_dt == jnp.float32
+                                             else jnp.complex128), axis=1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, real_dt))
+        return spec[0] if squeeze else spec
+
+    return apply("stft", fn, xt)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT (least-squares overlap-add with window-envelope
+    normalisation, matching the reference's istft semantics)."""
+    xt = as_tensor(x)
+    squeeze = xt.ndim == 2
+    if xt.ndim not in (2, 3):
+        raise ValueError(f"x should be a 2D or 3D complex tensor, but got rank {xt.ndim}.")
+    if onesided and return_complex:
+        raise ValueError(
+            "onesided output is real-valued; return_complex=True requires onesided=False")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    real_dt = jnp.float64 if xt._data.dtype == jnp.complex128 else jnp.float32
+    w = _resolve_window(window, win_length, n_fft, real_dt, onesided)
+
+    def fn(a):
+        spec = a[None] if squeeze else a                    # [B, bins, F]
+        n_frames = spec.shape[-1]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, real_dt))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[None, :, None]
+        y = _overlap_add_array(frames, hop_length, -1)      # [B, seq]
+        env = _overlap_add_array(
+            jnp.broadcast_to((w * jnp.conj(w)).real[None, :, None],
+                             (1, n_fft, n_frames)),
+            hop_length, -1)[0]
+        y = y / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            y = y[:, n_fft // 2:]
+        if length is not None:
+            y = y[:, :length]
+        elif center:
+            y = y[:, : y.shape[1] - n_fft // 2]
+        return y[0] if squeeze else y
+
+    return apply("istft", fn, xt)

@@ -1,0 +1,25 @@
+"""Build/installation introspection (mirror of
+/root/reference/python/paddle/sysconfig.py — get_include/get_lib).
+
+TPU-native: the "native library" directory is where the framework's C++
+runtime shared objects live (paddle_tpu/core builds them in-tree), and the
+include dir exposes headers for custom-op extension builds.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of C/C++ header files for building extensions."""
+    return os.path.join(_ROOT, "core", "include")
+
+
+def get_lib() -> str:
+    """Directory containing the framework's native shared libraries."""
+    return os.path.join(_ROOT, "core")

@@ -1,0 +1,184 @@
+"""paddle.fft / paddle.signal / paddle.hub / paddle.sysconfig parity tests.
+
+Reference behaviors: /root/reference/python/paddle/fft.py (numpy-compatible
+transforms with backward/ortho/forward norms), signal.py (frame :30,
+overlap_add :145, stft :246, istft :423), hub.py (local hubconf loading).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(X), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(_np(back).real, x, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_rfft_norms(self, norm):
+        x = np.random.RandomState(1).randn(8, 32).astype(np.float32)
+        got = _np(paddle.fft.rfft(paddle.to_tensor(x), norm=norm))
+        np.testing.assert_allclose(got, np.fft.rfft(x, norm=norm), rtol=1e-4, atol=1e-4)
+
+    def test_irfft_n(self):
+        x = np.random.RandomState(2).randn(17).astype(np.float32)
+        spec = np.fft.rfft(x)
+        got = _np(paddle.fft.irfft(paddle.to_tensor(spec), n=17))
+        np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-4)
+
+    def test_hfft_ihfft(self):
+        x = np.random.RandomState(3).randn(9).astype(np.float32)
+        got = _np(paddle.fft.hfft(paddle.to_tensor(x.astype(np.complex64))))
+        np.testing.assert_allclose(got, np.fft.hfft(x), rtol=1e-4, atol=1e-4)
+        got2 = _np(paddle.fft.ihfft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(got2, np.fft.ihfft(x), rtol=1e-4, atol=1e-4)
+
+    def test_fft2_fftn(self):
+        x = np.random.RandomState(4).randn(3, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.fft.fft2(paddle.to_tensor(x))),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(paddle.fft.fftn(paddle.to_tensor(x))),
+                                   np.fft.fftn(x), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(_np(paddle.fft.rfft2(paddle.to_tensor(x))),
+                                   np.fft.rfft2(x), rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_hfftn_matches_1d_hfft(self, norm):
+        # hfftn over a single axis must agree with numpy's hfft (incl. norm
+        # scaling — regression for the spurious total-length factor)
+        x = (np.random.RandomState(7).randn(9)
+             + 1j * np.random.RandomState(8).randn(9)).astype(np.complex64)
+        got = _np(paddle.fft.hfftn(paddle.to_tensor(x), axes=(0,), norm=norm))
+        np.testing.assert_allclose(got, np.fft.hfft(x, norm=norm), rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_ihfftn_matches_1d_ihfft(self, norm):
+        x = np.random.RandomState(9).randn(10).astype(np.float32)
+        got = _np(paddle.fft.ihfftn(paddle.to_tensor(x), axes=(0,), norm=norm))
+        np.testing.assert_allclose(got, np.fft.ihfft(x, norm=norm), rtol=1e-4, atol=1e-5)
+
+    def test_freq_shift_helpers(self):
+        np.testing.assert_allclose(_np(paddle.fft.fftfreq(10, d=0.5)),
+                                   np.fft.fftfreq(10, d=0.5).astype(np.float32))
+        np.testing.assert_allclose(_np(paddle.fft.rfftfreq(10)),
+                                   np.fft.rfftfreq(10).astype(np.float32))
+        x = np.arange(10.0, dtype=np.float32)
+        np.testing.assert_allclose(_np(paddle.fft.fftshift(paddle.to_tensor(x))),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(_np(paddle.fft.ifftshift(paddle.to_tensor(x))),
+                                   np.fft.ifftshift(x))
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(np.random.RandomState(5).randn(16).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.real() ** 2 + y.imag() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        # Parseval: d/dx sum |rfft(x)|^2 ≈ 2*N*x for interior bins; just check finite+shape
+        assert _np(x.grad).shape == (16,)
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_bad_norm_raises(self):
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.to_tensor(np.ones(4, np.float32)), norm="bad")
+
+
+class TestSignal:
+    def test_frame_last_axis(self):
+        x = np.arange(10, dtype=np.float32)
+        f = _np(paddle.signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2))
+        assert f.shape == (4, 4)
+        np.testing.assert_allclose(f[:, 0], x[0:4])
+        np.testing.assert_allclose(f[:, 2], x[4:8])
+
+    def test_frame_axis0_batched(self):
+        x = np.random.RandomState(0).randn(12, 3).astype(np.float32)
+        f = _np(paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=0))
+        assert f.shape == (3, 4, 3)
+        np.testing.assert_allclose(f[1], x[4:8])
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = np.random.RandomState(1).randn(2, 12).astype(np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 4, 4)
+        y = _np(paddle.signal.overlap_add(f, hop_length=4))
+        np.testing.assert_allclose(y, x, rtol=1e-6, atol=1e-6)
+
+    def test_overlap_add_sums_overlaps(self):
+        frames = np.ones((4, 3), dtype=np.float32)  # L=4, F=3, hop=2
+        y = _np(paddle.signal.overlap_add(paddle.to_tensor(frames), hop_length=2))
+        # positions: frame j covers [2j, 2j+4); middles get double coverage
+        np.testing.assert_allclose(y, np.array([1, 1, 2, 2, 2, 2, 1, 1], np.float32))
+
+    def test_stft_matches_manual(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 64).astype(np.float32)
+        n_fft, hop = 16, 8
+        win = np.hanning(n_fft).astype(np.float32)
+        got = _np(paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                                     window=paddle.to_tensor(win), center=False))
+        # manual: frame then windowed rfft
+        n_frames = 1 + (64 - n_fft) // hop
+        assert got.shape == (2, n_fft // 2 + 1, n_frames)
+        for j in range(n_frames):
+            seg = x[:, j * hop: j * hop + n_fft] * win
+            np.testing.assert_allclose(got[:, :, j], np.fft.rfft(seg, axis=-1),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(256).astype(np.float32)
+        n_fft = 32
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft,
+                                  window=paddle.to_tensor(win))
+        y = _np(paddle.signal.istft(spec, n_fft, window=paddle.to_tensor(win),
+                                    length=256))
+        # COLA holds for hann with hop = n_fft//4 → near-exact reconstruction
+        np.testing.assert_allclose(y[n_fft:-n_fft], x[n_fft:-n_fft], rtol=1e-3, atol=1e-3)
+
+    def test_onesided_complex_raises(self):
+        x = (np.ones(32) + 1j * np.ones(32)).astype(np.complex64)
+        with pytest.raises(ValueError):
+            paddle.signal.stft(paddle.to_tensor(x), 8)
+        # complex window with onesided also rejected
+        cw = (np.ones(8) + 1j).astype(np.complex64)
+        with pytest.raises(ValueError):
+            paddle.signal.stft(paddle.to_tensor(np.ones(32, np.float32)), 8,
+                               window=paddle.to_tensor(cw))
+
+    def test_istft_onesided_return_complex_raises(self):
+        spec = np.ones((5, 4), np.complex64)
+        with pytest.raises(ValueError):
+            paddle.signal.istft(paddle.to_tensor(spec), 8, onesided=True,
+                                return_complex=True)
+
+
+class TestHubSysconfig:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def toy_model(scale=2):\n"
+            "    'a toy entrypoint'\n"
+            "    return {'scale': scale}\n")
+        entries = paddle.hub.list(str(tmp_path), source="local")
+        assert "toy_model" in entries
+        assert "toy entrypoint" in paddle.hub.help(str(tmp_path), "toy_model", source="local")
+        assert paddle.hub.load(str(tmp_path), "toy_model", source="local", scale=5) == {"scale": 5}
+
+    def test_hub_remote_raises(self):
+        with pytest.raises(RuntimeError):
+            paddle.hub.load("owner/repo", "m", source="github")
+
+    def test_sysconfig_paths(self):
+        assert "core" in paddle.sysconfig.get_lib()
+        assert paddle.sysconfig.get_include().endswith("include")
