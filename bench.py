@@ -39,13 +39,17 @@ def main() -> None:
     if on_tpu:
         # remat_policy="flash" keeps the flash-attention residuals and
         # remats only projections/FFN; accum_steps=4 amortises the
-        # optimizer + loss head over a 64k-token global batch.  Measured
-        # (v5e, 2026-07): full remat b8 = 27.3k tok/s (30.7% MFU);
-        # flash policy = 29.4k (33.0%); + accumulation = 31.8k (35.7%).
+        # optimizer + loss head over a 64k-token global batch.  8 heads of
+        # dim 128 (not 16x64): the MXU is a 128-deep systolic array, so
+        # d=64 attention dots run at half throughput — head_dim 128 is the
+        # TPU-native choice (same params/FLOPs).  Measured (v5e, 2026-07):
+        # full remat b8 16x64 = 27.3k tok/s (30.7% MFU); flash policy =
+        # 29.4k (33.0%); + accumulation = 31.8k (35.7%); + d=128 heads +
+        # diagonal-only causal masking = 40.3k (45.4%).
         cfg = LlamaPretrainConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2752,
-            num_hidden_layers=24, num_attention_heads=16,
-            num_key_value_heads=16, max_seq_len=2048,
+            num_hidden_layers=24, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
             use_pallas_attention=True, sequence_parallel=False,
             remat=True, remat_policy="flash", dtype=jnp.bfloat16)
         batch, seq = 32, 2048

@@ -68,7 +68,8 @@ class LlamaPretrainConfig:
     context_parallel: Optional[str] = None
     # loss head: >1 = chunked softmax cross-entropy (custom vjp that never
     # materialises fp32 [B,S,V] logits; see ops/chunked_loss.py); 0/1 =
-    # plain log_softmax head.  seq-1 must be divisible by the chunk count.
+    # plain log_softmax head.  The flattened token count batch*(seq-1)
+    # must be divisible by the chunk count.
     loss_chunks: int = 0
 
     def __post_init__(self):

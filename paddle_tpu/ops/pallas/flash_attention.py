@@ -31,13 +31,6 @@ __all__ = ["flash_attention"]
 NEG_INF = -1e30
 
 
-def _idiv(a, b):
-    # Mosaic's lowering of jnp floor_divide on traced int scalars
-    # recurses infinitely (promote-to-float path); lax.div is trunc
-    # division — identical for the non-negative indices used here.
-    return jax.lax.div(jnp.int32(a), jnp.int32(b))
-
-
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                 sm_scale: float, block_k: int):
     # q_ref: [Bq, d]; k_ref/v_ref: [S, d]; o_ref: [Bq, d]; lse_ref: [Bq, 1]
@@ -50,22 +43,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     q = q_ref[:]
 
     num_k = jnp.int32(S // block_k)
-    if causal:
-        # only blocks with k_start <= q_end participate
-        num_k_eff = jnp.minimum(
-            _idiv((qi.astype(jnp.int32) + 1) * Bq + block_k - 1, block_k),
-            num_k).astype(jnp.int32)
-    else:
-        num_k_eff = num_k
 
-    def body(ki, carry):
+    def body(ki, carry, masked):
         m_prev, l_prev, acc = carry
         k = k_ref[pl.ds(ki * block_k, block_k), :]
         v = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * jnp.float32(sm_scale)
-        if causal:
+        if masked:
+            # only the diagonal block pays for the mask (iota+cmp+select
+            # are pure VPU work; off-diagonal causal blocks are all-visible
+            # because the loop bound below already excludes future blocks)
             q_pos = qi * Bq + jax.lax.broadcasted_iota(
                 jnp.int32, (Bq, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -84,8 +73,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     m0 = jnp.full((Bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((Bq, 1), jnp.float32)
     acc0 = jnp.zeros((Bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(jnp.int32(0), num_k_eff, body,
-                                  (m0, l0, acc0))
+    init = (m0, l0, acc0)
+    assert not causal or Bq == block_k, \
+        "_pick_blocks guarantees square blocks; causal masking relies on it"
+    if causal:
+        # blocks [0, qi) are fully visible; block qi is the masked diagonal
+        carry = jax.lax.fori_loop(
+            jnp.int32(0), qi.astype(jnp.int32),
+            lambda ki, c: body(ki, c, masked=False), init)
+        m, l, acc = body(qi.astype(jnp.int32), carry, masked=True)
+    else:
+        m, l, acc = jax.lax.fori_loop(
+            jnp.int32(0), num_k,
+            lambda ki, c: body(ki, c, masked=False), init)
     l_safe = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[:] = (m + jnp.log(l_safe)).astype(jnp.float32)
@@ -102,20 +102,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[:]        # [Bq, 1]
 
     num_k = jnp.int32(S // block_k)
-    if causal:
-        num_k_eff = jnp.minimum(
-            _idiv((qi.astype(jnp.int32) + 1) * Bq + block_k - 1, block_k),
-            num_k).astype(jnp.int32)
-    else:
-        num_k_eff = num_k
 
-    def body(ki, dq):
+    def body(ki, dq, masked):
         k = k_ref[pl.ds(ki * block_k, block_k), :]
         v = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * jnp.float32(sm_scale)
-        if causal:
+        if masked:
             q_pos = qi * Bq + jax.lax.broadcasted_iota(
                 jnp.int32, (Bq, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -130,8 +124,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                       preferred_element_type=jnp.float32)
         return dq
 
-    dq = jax.lax.fori_loop(jnp.int32(0), num_k_eff, body,
-                           jnp.zeros((Bq, d), jnp.float32))
+    dq0 = jnp.zeros((Bq, d), jnp.float32)
+    assert not causal or Bq == block_k, \
+        "_pick_blocks guarantees square blocks; causal masking relies on it"
+    if causal:
+        dq = jax.lax.fori_loop(
+            jnp.int32(0), qi.astype(jnp.int32),
+            lambda ki, c: body(ki, c, masked=False), dq0)
+        dq = body(qi.astype(jnp.int32), dq, masked=True)
+    else:
+        dq = jax.lax.fori_loop(
+            jnp.int32(0), num_k,
+            lambda ki, c: body(ki, c, masked=False), dq0)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
@@ -145,12 +149,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[:]
 
     num_q = jnp.int32(S // block_q)
-    if causal:
-        first_q = _idiv(ki.astype(jnp.int32) * Bk, block_q)
-    else:
-        first_q = jnp.int32(0)
 
-    def body(qi, carry):
+    def body(qi, carry, masked):
         dk, dv = carry
         q = q_ref[pl.ds(qi * block_q, block_q), :]
         do = do_ref[pl.ds(qi * block_q, block_q), :]
@@ -159,7 +159,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * jnp.float32(sm_scale)
-        if causal:
+        if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, Bk), 0)
             k_pos = ki * Bk + jax.lax.broadcasted_iota(
@@ -179,7 +179,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((Bk, d), jnp.float32)
     dv0 = jnp.zeros((Bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (dk0, dv0))
+    assert not causal or Bk == block_q, \
+        "_pick_blocks guarantees square blocks; causal masking relies on it"
+    if causal:
+        # diagonal block qi == ki is masked; strictly-later q blocks see
+        # this k block in full
+        carry = body(ki.astype(jnp.int32), (dk0, dv0), masked=True)
+        dk, dv = jax.lax.fori_loop(
+            ki.astype(jnp.int32) + 1, num_q,
+            lambda qi, c: body(qi, c, masked=False), carry)
+    else:
+        dk, dv = jax.lax.fori_loop(
+            jnp.int32(0), num_q,
+            lambda qi, c: body(qi, c, masked=False), (dk0, dv0))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 

@@ -71,6 +71,21 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None):
                  as_tensor(x))
 
 
+def _resolve_lengths(hop_length, win_length, n_fft):
+    # explicit invalid values must raise, not silently fall back to the
+    # defaults ("or" would swallow an explicit 0)
+    if hop_length is None:
+        hop_length = n_fft // 4
+    elif hop_length < 1:
+        raise ValueError(f"Attribute hop_length should be at least 1, but got ({hop_length}).")
+    if win_length is None:
+        win_length = n_fft
+    elif not 0 < win_length <= n_fft:
+        raise ValueError(
+            f"Attribute win_length should be in (0, n_fft({n_fft})], but got ({win_length}).")
+    return hop_length, win_length
+
+
 def _resolve_window(window, win_length, n_fft, dtype, onesided):
     if window is None:
         w = jnp.ones((win_length,), dtype=dtype)
@@ -102,8 +117,7 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
     squeeze = xt.ndim == 1
     if xt.ndim not in (1, 2):
         raise ValueError(f"x should be a 1D or 2D real tensor, but got rank {xt.ndim}.")
-    hop_length = hop_length or n_fft // 4
-    win_length = win_length or n_fft
+    hop_length, win_length = _resolve_lengths(hop_length, win_length, n_fft)
     real_dt = jnp.float64 if xt._data.dtype in (jnp.float64, jnp.complex128) else jnp.float32
     w = _resolve_window(window, win_length, n_fft, real_dt, onesided)
     is_complex = jnp.iscomplexobj(xt._data) or jnp.iscomplexobj(w)
@@ -145,8 +159,7 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     if onesided and return_complex:
         raise ValueError(
             "onesided output is real-valued; return_complex=True requires onesided=False")
-    hop_length = hop_length or n_fft // 4
-    win_length = win_length or n_fft
+    hop_length, win_length = _resolve_lengths(hop_length, win_length, n_fft)
     real_dt = jnp.float64 if xt._data.dtype == jnp.complex128 else jnp.float32
     w = _resolve_window(window, win_length, n_fft, real_dt, onesided)
 
@@ -159,9 +172,11 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
             frames = jnp.fft.irfft(spec, n=n_fft, axis=1)
         else:
             frames = jnp.fft.ifft(spec, axis=1)
-            if not return_complex:
-                frames = frames.real
         frames = frames * w[None, :, None]
+        if not return_complex and jnp.iscomplexobj(frames):
+            # realise AFTER the window multiply so a complex window cannot
+            # re-complexify output the caller asked to be real
+            frames = frames.real
         y = _overlap_add_array(frames, hop_length, -1)      # [B, seq]
         env = _overlap_add_array(
             jnp.broadcast_to((w * jnp.conj(w)).real[None, :, None],
