@@ -389,17 +389,37 @@ def _pool_nd(name, x, kernel, stride, padding, nd, reducer, init,
     p = _norm_tuple(padding, nd)
     window = (1, 1) + kernel
     strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((i, i) for i in p)
 
     def fn(a):
+        pads = [(0, 0), (0, 0)]
+        for d in range(nd):
+            hi = p[d]
+            if ceil_mode:
+                # right-pad so the last partial window produces an output
+                # element: out = ceil((L + 2p - k)/s) + 1
+                L = a.shape[2 + d]
+                out_len = -(-(L + 2 * p[d] - kernel[d]) // stride[d]) + 1
+                hi += max(0, (out_len - 1) * stride[d] + kernel[d]
+                          - (L + 2 * p[d]))
+            pads.append((p[d], hi))
+        pads = tuple(pads)
         out = jax.lax.reduce_window(a, init, reducer, window, strides, pads)
         if average:
-            if count_include_pad:
-                denom = float(np.prod(kernel))
-                return out / denom
+            if count_include_pad and not ceil_mode:
+                return out / float(np.prod(kernel))
+            # denominator: count explicit padding iff count_include_pad;
+            # ceil-mode extra cells never count (reference semantics)
             ones = jnp.ones_like(a)
+            if count_include_pad:
+                ones = jnp.pad(ones, [(0, 0), (0, 0)]
+                               + [(p[d], p[d]) for d in range(nd)],
+                               constant_values=1.0)
+                cpads = tuple((0, pads[i][1] - p[i - 2]) if i >= 2 else (0, 0)
+                              for i in range(nd + 2))
+            else:
+                cpads = pads
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                           strides, pads)
+                                           strides, cpads)
             return out / counts
         return out
 
@@ -409,7 +429,7 @@ def _pool_nd(name, x, kernel, stride, padding, nd, reducer, init,
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     out = _pool_nd("max_pool2d", x, kernel_size, stride, padding, 2,
-                   jax.lax.max, -jnp.inf)
+                   jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
     if return_mask:
         return out, None
     return out
@@ -419,28 +439,28 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool_nd("avg_pool2d", x, kernel_size, stride, padding, 2,
-                    jax.lax.add, 0.0, average=True,
+                    jax.lax.add, 0.0, average=True, ceil_mode=ceil_mode,
                     count_include_pad=not exclusive)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     out = _pool_nd("max_pool1d", x, kernel_size, stride, padding, 1,
-                   jax.lax.max, -jnp.inf)
+                   jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
     return (out, None) if return_mask else out
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, name=None):
     return _pool_nd("avg_pool1d", x, kernel_size, stride, padding, 1,
-                    jax.lax.add, 0.0, average=True,
+                    jax.lax.add, 0.0, average=True, ceil_mode=ceil_mode,
                     count_include_pad=not exclusive)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
     out = _pool_nd("max_pool3d", x, kernel_size, stride, padding, 3,
-                   jax.lax.max, -jnp.inf)
+                   jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
     return (out, None) if return_mask else out
 
 
@@ -448,7 +468,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return _pool_nd("avg_pool3d", x, kernel_size, stride, padding, 3,
-                    jax.lax.add, 0.0, average=True,
+                    jax.lax.add, 0.0, average=True, ceil_mode=ceil_mode,
                     count_include_pad=not exclusive)
 
 

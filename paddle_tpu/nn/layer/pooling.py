@@ -30,7 +30,7 @@ class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0,
                  return_mask=False, ceil_mode=False, name=None):
         super().__init__("max_pool1d", kernel_size, stride, padding,
-                         return_mask=return_mask)
+                         return_mask=return_mask, ceil_mode=ceil_mode)
 
 
 class MaxPool2D(_Pool):
@@ -38,7 +38,7 @@ class MaxPool2D(_Pool):
                  return_mask=False, ceil_mode=False, data_format="NCHW",
                  name=None):
         super().__init__("max_pool2d", kernel_size, stride, padding,
-                         return_mask=return_mask)
+                         return_mask=return_mask, ceil_mode=ceil_mode)
 
 
 class MaxPool3D(_Pool):
@@ -46,14 +46,14 @@ class MaxPool3D(_Pool):
                  return_mask=False, ceil_mode=False, data_format="NCDHW",
                  name=None):
         super().__init__("max_pool3d", kernel_size, stride, padding,
-                         return_mask=return_mask)
+                         return_mask=return_mask, ceil_mode=ceil_mode)
 
 
 class AvgPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
                  ceil_mode=False, name=None):
         super().__init__("avg_pool1d", kernel_size, stride, padding,
-                         exclusive=exclusive)
+                         exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 class AvgPool2D(_Pool):
@@ -61,7 +61,7 @@ class AvgPool2D(_Pool):
                  exclusive=True, divisor_override=None, data_format="NCHW",
                  name=None):
         super().__init__("avg_pool2d", kernel_size, stride, padding,
-                         exclusive=exclusive)
+                         exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 class AvgPool3D(_Pool):
@@ -69,7 +69,7 @@ class AvgPool3D(_Pool):
                  exclusive=True, divisor_override=None,
                  data_format="NCDHW", name=None):
         super().__init__("avg_pool3d", kernel_size, stride, padding,
-                         exclusive=exclusive)
+                         exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 class _AdaptivePool(Layer):

@@ -162,6 +162,9 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     hop_length, win_length = _resolve_lengths(hop_length, win_length, n_fft)
     real_dt = jnp.float64 if xt._data.dtype == jnp.complex128 else jnp.float32
     w = _resolve_window(window, win_length, n_fft, real_dt, onesided)
+    if jnp.iscomplexobj(w) and not return_complex:
+        raise ValueError(
+            "Data type of window should not be complex when return_complex is False")
 
     def fn(a):
         spec = a[None] if squeeze else a                    # [B, bins, F]

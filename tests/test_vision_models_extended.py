@@ -1,0 +1,102 @@
+"""Extended vision model families (reference: python/paddle/vision/models/
+alexnet.py, squeezenet.py, densenet.py, googlenet.py, inceptionv3.py,
+mobilenetv3.py, shufflenetv2.py) + pooling ceil_mode semantics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import models as M
+
+
+def _img(n=1, c=3, s=224):
+    return paddle.to_tensor(np.random.RandomState(0).randn(n, c, s, s)
+                            .astype("float32"))
+
+
+@pytest.mark.parametrize("ctor", [
+    M.alexnet, M.squeezenet1_0, M.squeezenet1_1, M.mobilenet_v3_small,
+    M.mobilenet_v3_large, M.shufflenet_v2_x0_25, M.shufflenet_v2_swish,
+])
+def test_forward_shapes_224(ctor):
+    m = ctor(num_classes=10)
+    m.eval()
+    out = m(_img())
+    assert tuple(out.shape) == (1, 10)
+
+
+def test_densenet121():
+    m = M.densenet121(num_classes=7)
+    m.eval()
+    assert tuple(m(_img()).shape) == (1, 7)
+
+
+def test_googlenet_aux_heads():
+    m = M.googlenet(num_classes=5)
+    m.eval()
+    out, a1, a2 = m(_img())
+    assert tuple(out.shape) == tuple(a1.shape) == tuple(a2.shape) == (1, 5)
+
+
+def test_inception_v3():
+    m = M.inception_v3(num_classes=4)
+    m.eval()
+    assert tuple(m(_img(s=299)).shape) == (1, 4)
+
+
+def test_channel_shuffle():
+    from paddle_tpu.vision.models.shufflenetv2 import channel_shuffle
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1))
+    y = channel_shuffle(x, 2).numpy().reshape(-1)
+    # groups=2: [0..3 | 4..7] interleaved -> 0,4,1,5,2,6,3,7
+    np.testing.assert_allclose(y, [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def test_vision_model_trains():
+    # one SGD step decreases loss on a tiny batch — exercises BN/depthwise
+    # conv/SE gradients through a real model
+    m = M.shufflenet_v2_x0_25(num_classes=3)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    x = _img(n=2, s=64)
+    label = paddle.to_tensor(np.array([0, 2]))
+    losses = []
+    for _ in range(3):
+        out = m(x)
+        loss = F.cross_entropy(out, label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+class TestCeilModePooling:
+    """ceil_mode was silently ignored before; reference semantics: right-pad
+    so the final partial window emits an output, pad cells never counted in
+    avg denominators (ceil-extra) / counted iff not exclusive (explicit)."""
+
+    def test_max_pool2d_ceil_shape_and_values(self):
+        x = np.random.RandomState(1).randn(1, 2, 14, 14).astype("float32")
+        out = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, ceil_mode=True)
+        assert tuple(out.shape) == (1, 2, 7, 7)
+        # last output = max of the 2x2 remainder window
+        np.testing.assert_allclose(out.numpy()[0, 0, 6, 6],
+                                   x[0, 0, 12:, 12:].max())
+        flat = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, ceil_mode=False)
+        assert tuple(flat.shape) == (1, 2, 6, 6)
+
+    def test_avg_pool2d_ceil_excludes_extra(self):
+        x = np.ones((1, 1, 5, 5), np.float32)
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, stride=2, ceil_mode=True)
+        # all windows average 1.0 — ceil-extra cells must not dilute
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 3, 3)),
+                                   rtol=1e-6)
+
+    def test_layer_passes_ceil_mode(self):
+        import paddle_tpu.nn as nn
+        x = paddle.to_tensor(np.random.randn(1, 1, 14, 14).astype("float32"))
+        out = nn.MaxPool2D(3, stride=2, ceil_mode=True)(x)
+        assert tuple(out.shape) == (1, 1, 7, 7)
