@@ -1,0 +1,48 @@
+/* paddle_tpu custom-op extension ABI.
+ *
+ * TPU-native custom-op story (counterpart of the reference's PD_BUILD_OP,
+ * /root/reference/paddle/fluid/framework/custom_operator.cc): device-side
+ * compute belongs in Pallas/XLA, but host-side C++ ops plug in through
+ * this C ABI and run under jit via host callbacks.
+ *
+ * A custom-op library exports:
+ *
+ *   extern "C" const char* paddle_tpu_ops();
+ *       comma-separated op names, e.g. "my_relu,my_axpy"
+ *
+ * and, per op NAME, one forward (shape-preserving, float32):
+ *
+ *   extern "C" void NAME_fwd (const float* x, float* y,
+ *                             const int64_t* shape, int32_t ndim);   // unary
+ *   extern "C" void NAME_fwd2(const float* a, const float* b, float* y,
+ *                             const int64_t* shape, int32_t ndim);   // binary
+ *
+ * and optionally a backward:
+ *
+ *   extern "C" void NAME_bwd (const float* x, const float* gy, float* gx,
+ *                             const int64_t* shape, int32_t ndim);
+ *   extern "C" void NAME_bwd2(const float* a, const float* b,
+ *                             const float* gy, float* ga, float* gb,
+ *                             const int64_t* shape, int32_t ndim);
+ *
+ * Build + load from Python:
+ *
+ *   from paddle_tpu.utils.cpp_extension import load
+ *   mod = load(name="my_ops", sources=["my_ops.cc"])
+ *   y = mod.my_relu(x)          # Tensor in, Tensor out, autograd-aware
+ */
+
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include <cstdint>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+static inline int64_t pt_numel(const int64_t* shape, int32_t ndim) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+#endif  // PADDLE_TPU_EXT_H_

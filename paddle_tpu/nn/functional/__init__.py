@@ -396,9 +396,14 @@ def _pool_nd(name, x, kernel, stride, padding, nd, reducer, init,
             hi = p[d]
             if ceil_mode:
                 # right-pad so the last partial window produces an output
-                # element: out = ceil((L + 2p - k)/s) + 1
+                # element: out = ceil((L + 2p - k)/s) + 1, except that a
+                # window starting entirely in right padding is dropped
+                # (reference rule: last window must start within input or
+                # left padding)
                 L = a.shape[2 + d]
                 out_len = -(-(L + 2 * p[d] - kernel[d]) // stride[d]) + 1
+                if (out_len - 1) * stride[d] >= L + p[d]:
+                    out_len -= 1
                 hi += max(0, (out_len - 1) * stride[d] + kernel[d]
                           - (L + 2 * p[d]))
             pads.append((p[d], hi))

@@ -19,7 +19,7 @@ __all__ = [
     "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh", "solve",
     "triangular_solve", "lstsq", "pinv", "matrix_power", "matrix_rank",
     "cond", "lu", "lu_unpack", "corrcoef", "cov", "householder_product",
-    "multi_dot", "svd_lowrank", "pca_lowrank",
+    "multi_dot", "svd_lowrank", "pca_lowrank", "matrix_exp", "ormqr",
 ]
 
 
@@ -367,3 +367,52 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         from .math import mean, subtract
         x = subtract(x, mean(x, axis=-2, keepdim=True))
     return svd_lowrank(x, q=q, niter=niter)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential via jax.scipy.linalg.expm (reference:
+    python/paddle/tensor/linalg.py matrix_exp — Pade approximation)."""
+    return apply("matrix_exp", jax.scipy.linalg.expm, as_tensor(x))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply ``y`` by the implicit full Q (or Q^T) of a Householder QR
+    factorisation (reference: python/paddle/tensor/linalg.py ormqr).
+    Applies the k elementary reflectors H_i = I - tau_i v_i v_i^T directly
+    — rank-1 updates XLA fuses well — rather than materialising the m x m
+    Q."""
+    x, tau, y = as_tensor(x), as_tensor(tau), as_tensor(y)
+
+    def core(a, t, b):
+        m, k = a.shape
+        idx = jnp.arange(m)
+
+        def reflector(i):
+            col = a[:, i]
+            return jnp.where(idx < i, 0.0,
+                             jnp.where(idx == i, 1.0, col)).astype(a.dtype)
+
+        # Q = H_0 H_1 ... H_{k-1}; H_i is symmetric.  The reflectors are
+        # applied in reverse order exactly when left != transpose (Q y and
+        # y Q^T), forward otherwise (Q^T y and y Q).
+        order = range(k - 1, -1, -1) if left != transpose else range(k)
+        out = b
+        for i in order:
+            v = reflector(i)
+            if left:
+                out = out - t[i] * jnp.outer(v, v @ out)
+            else:
+                out = out - t[i] * jnp.outer(out @ v, v)
+        return out
+
+    def fn(a, t, b):
+        if a.ndim == 2:
+            return core(a, t, b)
+        batch = a.shape[:-2]
+        af = a.reshape((-1,) + a.shape[-2:])
+        tf = t.reshape((-1,) + t.shape[-1:])
+        bf = b.reshape((-1,) + b.shape[-2:])
+        out = jax.vmap(core)(af, tf, bf)
+        return out.reshape(batch + out.shape[-2:])
+
+    return apply("ormqr", fn, x, tau, y)

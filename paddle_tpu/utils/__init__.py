@@ -1,8 +1,9 @@
 """paddle.utils (reference: python/paddle/utils/__init__.py)."""
 
 from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
 
-__all__ = ["dlpack", "try_import", "run_check"]
+__all__ = ["dlpack", "cpp_extension", "try_import", "run_check"]
 
 
 def try_import(module_name, err_msg=None):

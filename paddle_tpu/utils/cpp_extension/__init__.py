@@ -1,0 +1,208 @@
+"""Custom C++ op extensions (reference capability:
+python/paddle/utils/cpp_extension/ + paddle/fluid/framework/custom_operator.cc
+— user C++ ops JIT-built and loaded at runtime).
+
+TPU-native design: the device compute path is XLA/Pallas, so user C++ runs
+host-side and enters traced programs through ``jax.pure_callback`` (which
+works under jit; XLA schedules the host transfer).  The extension ABI is
+the C header ``paddle_tpu/core/include/paddle_tpu_ext.h``:
+
+* the library exports ``paddle_tpu_ops()`` naming its ops;
+* per op, ``<name>_fwd``/``<name>_fwd2`` (unary/binary, shape-preserving,
+  float32) and optionally ``<name>_bwd``/``<name>_bwd2``.
+
+``load()`` compiles with g++ (cached by source hash), binds with ctypes,
+wires each op into the framework dispatch table (so autograd, AMP hooks
+and NaN checks apply) and returns a module-like handle.  Ops with a
+backward symbol get a ``jax.custom_vjp``; ops without are forward-only
+(stop_gradient outputs).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply, as_tensor, register_op_impl
+from ... import sysconfig
+
+__all__ = ["load", "get_build_directory", "CppExtension", "setup",
+           "ExtensionModule"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
+             build_directory: Optional[str], verbose: bool) -> str:
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cflags or []).encode())
+    so_path = os.path.join(build_dir, f"{name}_{h.hexdigest()[:16]}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            f"-I{sysconfig.get_include()}"]
+           + list(extra_cflags or []) + list(sources)
+           + ["-o", so_path] + list(extra_ldflags or []))
+    if verbose:
+        print("paddle_tpu.cpp_extension:", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"extension build failed (rc={proc.returncode}):\n{proc.stderr}")
+    return so_path
+
+
+class _CustomOp:
+    """One extension op bound to the dispatch table."""
+
+    def __init__(self, name: str, lib: ctypes.CDLL, arity: int,
+                 has_bwd: bool):
+        self.name = name
+        self._arity = arity
+        c = ctypes
+        f32p = c.POINTER(c.c_float)
+        i64p = c.POINTER(c.c_int64)
+        if arity == 1:
+            self._fwd = getattr(lib, f"{name}_fwd")
+            self._fwd.argtypes = [f32p, f32p, i64p, c.c_int32]
+            self._bwd = getattr(lib, f"{name}_bwd", None) if has_bwd else None
+            if self._bwd is not None:
+                self._bwd.argtypes = [f32p, f32p, f32p, i64p, c.c_int32]
+        else:
+            self._fwd = getattr(lib, f"{name}_fwd2")
+            self._fwd.argtypes = [f32p, f32p, f32p, i64p, c.c_int32]
+            self._bwd = getattr(lib, f"{name}_bwd2", None) if has_bwd else None
+            if self._bwd is not None:
+                self._bwd.argtypes = [f32p, f32p, f32p, f32p, f32p, i64p,
+                                      c.c_int32]
+        self._jax_fn = self._build_jax_fn()
+        register_op_impl(name, self._jax_fn)
+
+    # -- host callbacks ----------------------------------------------------
+    def _run_fwd(self, *arrays):
+        arrs = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        out = np.empty_like(arrs[0])
+        shape = (ctypes.c_int64 * max(out.ndim, 1))(*out.shape or (1,))
+        ptrs = [a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                for a in arrs]
+        self._fwd(*ptrs, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  shape, out.ndim)
+        return out
+
+    def _run_bwd(self, *arrays):
+        *ins, gy = [np.ascontiguousarray(a, dtype=np.float32)
+                    for a in arrays]
+        grads = [np.empty_like(x) for x in ins]
+        shape = (ctypes.c_int64 * max(gy.ndim, 1))(*gy.shape or (1,))
+        ptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        self._bwd(*[ptr(x) for x in ins], ptr(gy),
+                  *[ptr(g) for g in grads], shape, gy.ndim)
+        return tuple(grads) if len(grads) > 1 else grads[0]
+
+    # -- traced entry ------------------------------------------------------
+    def _build_jax_fn(self):
+        def fwd_cb(*arrays):
+            spec = jax.ShapeDtypeStruct(arrays[0].shape, jnp.float32)
+            return jax.pure_callback(self._run_fwd, spec, *arrays,
+                                     vmap_method="sequential")
+
+        if self._bwd is None:
+            return fwd_cb
+
+        @jax.custom_vjp
+        def op(*arrays):
+            return fwd_cb(*arrays)
+
+        def op_fwd(*arrays):
+            return fwd_cb(*arrays), arrays
+
+        def op_bwd(res, gy):
+            specs = tuple(jax.ShapeDtypeStruct(a.shape, jnp.float32)
+                          for a in res)
+            out = jax.pure_callback(
+                self._run_bwd, specs if len(specs) > 1 else specs[0],
+                *res, gy, vmap_method="sequential")
+            return out if isinstance(out, tuple) else (out,)
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+
+    def __call__(self, *tensors):
+        if len(tensors) != self._arity:
+            raise TypeError(
+                f"op {self.name} takes {self._arity} tensors, got "
+                f"{len(tensors)}")
+        return apply(self.name, self._jax_fn,
+                     *(as_tensor(t) for t in tensors))
+
+
+class ExtensionModule:
+    def __init__(self, name: str, so_path: str):
+        self.name = name
+        self.so_path = so_path
+        lib = ctypes.CDLL(so_path)
+        lib.paddle_tpu_ops.restype = ctypes.c_char_p
+        names = lib.paddle_tpu_ops().decode().split(",")
+        self.ops: List[str] = []
+        for op_name in (n.strip() for n in names if n.strip()):
+            arity = 1 if hasattr(lib, f"{op_name}_fwd") else 2
+            sym = f"{op_name}_fwd" if arity == 1 else f"{op_name}_fwd2"
+            if not hasattr(lib, sym):
+                raise RuntimeError(
+                    f"{so_path} lists op {op_name!r} but exports no {sym}")
+            has_bwd = hasattr(lib, f"{op_name}_bwd") or \
+                hasattr(lib, f"{op_name}_bwd2")
+            setattr(self, op_name, _CustomOp(op_name, lib, arity, has_bwd))
+            self.ops.append(op_name)
+
+
+def load(name: str, sources: Sequence[str], extra_cflags=None,
+         extra_ldflags=None, build_directory: Optional[str] = None,
+         verbose: bool = False) -> ExtensionModule:
+    """Compile + load a custom-op library; returns a handle whose
+    attributes are the ops (Tensor -> Tensor, autograd-aware)."""
+    so_path = _compile(name, sources, extra_cflags, extra_ldflags,
+                       build_directory, verbose)
+    return ExtensionModule(name, so_path)
+
+
+class CppExtension:
+    """setup()-style extension description (API-parity shim over load)."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 *args, **kwargs):
+        self.sources = list(sources)
+        self.name = name
+        self.kwargs = kwargs
+
+
+def setup(name: str, ext_modules=None, **kwargs) -> ExtensionModule:
+    """Eager in-process analog of the reference's setuptools flow: builds
+    the extension immediately and returns the loaded module."""
+    if ext_modules is None:
+        raise ValueError("setup() requires ext_modules")
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    ext = exts[0]
+    return load(name=ext.name or name, sources=ext.sources,
+                extra_cflags=ext.kwargs.get("extra_compile_args"),
+                extra_ldflags=ext.kwargs.get("extra_link_args"))

@@ -1,0 +1,38 @@
+"""matrix_exp / ormqr (reference: python/paddle/tensor/linalg.py)."""
+
+import numpy as np
+import pytest
+import scipy.linalg
+import torch
+
+import paddle_tpu as paddle
+
+
+def test_matrix_exp():
+    a = np.random.RandomState(0).randn(4, 4).astype("float32") * 0.3
+    got = paddle.linalg.matrix_exp(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(got, scipy.linalg.expm(a), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_matrix_exp_batched():
+    a = np.random.RandomState(1).randn(3, 4, 4).astype("float32") * 0.2
+    got = paddle.linalg.matrix_exp(paddle.to_tensor(a)).numpy()
+    for i in range(3):
+        np.testing.assert_allclose(got[i], scipy.linalg.expm(a[i]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("left,transpose", [(True, False), (True, True),
+                                            (False, False), (False, True)])
+def test_ormqr_matches_torch(left, transpose):
+    m = np.random.RandomState(2).randn(5, 3).astype("float64")
+    y = np.random.RandomState(3).randn(5, 2).astype("float64")
+    qr_t, tau_t = torch.geqrf(torch.tensor(m))
+    yy = y if left else np.ascontiguousarray(y.T)
+    ref = torch.ormqr(qr_t, tau_t, torch.tensor(yy), left=left,
+                      transpose=transpose).numpy()
+    got = paddle.linalg.ormqr(
+        paddle.to_tensor(qr_t.numpy()), paddle.to_tensor(tau_t.numpy()),
+        paddle.to_tensor(yy), left=left, transpose=transpose).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-8, atol=1e-9)

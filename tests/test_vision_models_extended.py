@@ -88,6 +88,19 @@ class TestCeilModePooling:
         flat = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, ceil_mode=False)
         assert tuple(flat.shape) == (1, 2, 6, 6)
 
+    def test_ceil_mode_drops_window_fully_in_padding(self):
+        # kernel=2 stride=2 pad=1 on length 5: the would-be 4th window
+        # starts at padded index 6 >= L + pad_left = 6 and must be dropped
+        x = np.random.RandomState(2).randn(1, 1, 5, 5).astype("float32")
+        out = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, padding=1,
+                           ceil_mode=True)
+        assert tuple(out.shape) == (1, 1, 3, 3)
+        assert np.isfinite(out.numpy()).all()
+        avg = F.avg_pool2d(paddle.to_tensor(x), 2, stride=2, padding=1,
+                           ceil_mode=True)
+        assert tuple(avg.shape) == (1, 1, 3, 3)
+        assert np.isfinite(avg.numpy()).all()
+
     def test_avg_pool2d_ceil_excludes_extra(self):
         x = np.ones((1, 1, 5, 5), np.float32)
         out = F.avg_pool2d(paddle.to_tensor(x), 2, stride=2, ceil_mode=True)
