@@ -78,6 +78,7 @@ from . import utils  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
+from . import decomposition  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import hapi as _hapi  # noqa: F401,E402

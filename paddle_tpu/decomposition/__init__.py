@@ -1,0 +1,158 @@
+"""Composite-op decomposition registry ("prim" mode).
+
+Reference capability: python/paddle/decomposition/ (register.py Registry,
+decomp.py:192 decompose — rewrite composite ops in a program into
+primitive ops) + fluid/primitive composite rules, used for higher-order
+autodiff and backends without fused kernels.
+
+TPU-native design: there is no separate program IR to rewrite — ops ARE
+traced jax functions — so the registry plugs into the dispatch layer
+instead.  Op call sites that have a registered rule resolve through
+``ops.dispatch.resolve_impl(name, default, **attrs)``; under
+``enable_prim()`` the composite rule (primitive jnp/lax math only, no
+``jax.nn`` fused helpers, no erf-free approximations hidden in libraries)
+replaces the library implementation inside the SAME trace, so jit, vjp
+and higher-order grads all see the primitive formulation.
+
+``decompose(fn)`` wraps a callable so it always runs with prim mode on —
+the functional analog of the reference's program-level pass.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import dispatch as _dispatch
+
+__all__ = ["register_decomp", "get_decomp_rule", "has_decomp_rule",
+           "enable_prim", "disable_prim", "prim_enabled", "prim_guard",
+           "decompose"]
+
+
+def register_decomp(op_type: str, rule: Optional[Callable] = None):
+    """Register (or decorate) a composite rule for ``op_type``.
+
+    Rules take raw jax arrays plus the op's static attrs as keyword args
+    and must be built from primitive math only."""
+    def _do(fn):
+        if op_type in _dispatch._decomp_table:
+            raise ValueError(f"decomposition for {op_type!r} already registered")
+        _dispatch._decomp_table[op_type] = fn
+        return fn
+    return _do(rule) if rule is not None else _do
+
+
+def get_decomp_rule(op_type: str):
+    return _dispatch._decomp_table.get(op_type)
+
+
+def has_decomp_rule(op_type: str) -> bool:
+    return op_type in _dispatch._decomp_table
+
+
+def enable_prim() -> None:
+    _dispatch.set_prim_enabled(True)
+
+
+def disable_prim() -> None:
+    _dispatch.set_prim_enabled(False)
+
+
+def prim_enabled() -> bool:
+    return _dispatch.prim_enabled()
+
+
+@contextlib.contextmanager
+def prim_guard(flag: bool = True):
+    prev = _dispatch.prim_enabled()
+    _dispatch.set_prim_enabled(flag)
+    try:
+        yield
+    finally:
+        _dispatch.set_prim_enabled(prev)
+
+
+def decompose(fn: Callable) -> Callable:
+    """Return ``fn`` wrapped to always execute with prim mode on (the
+    functional analog of the reference's decompose(program) pass)."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with prim_guard(True):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# default composite rules (counterparts of fluid/primitive/composite rules)
+# ---------------------------------------------------------------------------
+@register_decomp("softmax")
+def _softmax_rule(a, *, axis=-1):
+    m = jnp.max(a, axis=axis, keepdims=True)
+    e = jnp.exp(a - jax.lax.stop_gradient(m))
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax_rule(a, *, axis=-1):
+    m = jnp.max(a, axis=axis, keepdims=True)
+    shifted = a - jax.lax.stop_gradient(m)
+    return shifted - jnp.log(jnp.sum(jnp.exp(shifted), axis=axis,
+                                     keepdims=True))
+
+
+@register_decomp("gelu")
+def _gelu_rule(a, *, approximate=False):
+    if approximate:
+        c = 0.7978845608028654  # sqrt(2/pi)
+        return 0.5 * a * (1.0 + jnp.tanh(c * (a + 0.044715 * a ** 3)))
+    return 0.5 * a * (1.0 + jax.lax.erf(a / jnp.sqrt(jnp.asarray(2.0, a.dtype))))
+
+
+@register_decomp("silu")
+def _silu_rule(a):
+    return a / (1.0 + jnp.exp(-a))
+
+
+@register_decomp("sigmoid")
+def _sigmoid_rule(a):
+    return 1.0 / (1.0 + jnp.exp(-a))
+
+
+@register_decomp("layer_norm")
+def _layer_norm_rule(a, *wb, epsilon=1e-5, begin_norm_axis=None,
+                     has_weight=False, has_bias=False):
+    axes = tuple(range(begin_norm_axis if begin_norm_axis is not None
+                       else a.ndim - 1, a.ndim))
+    mean = jnp.mean(a, axis=axes, keepdims=True)
+    var = jnp.mean((a - mean) ** 2, axis=axes, keepdims=True)
+    out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+    i = 0
+    if has_weight:
+        out = out * wb[i]
+        i += 1
+    if has_bias:
+        out = out + wb[i]
+    return out
+
+
+@register_decomp("rms_norm")
+def _rms_norm_rule(a, *weights, epsilon=1e-6):
+    ms = jnp.mean(a * a, axis=-1, keepdims=True)
+    out = a * jax.lax.rsqrt(ms + epsilon)
+    if weights and weights[0] is not None:
+        out = out * weights[0]
+    return out
+
+
+@register_decomp("mean")
+def _mean_rule(a, *, axis=None, keepdims=False):
+    n = a.size if axis is None else \
+        int(jnp.prod(jnp.asarray([a.shape[i] for i in
+                                  (axis if isinstance(axis, (tuple, list))
+                                   else (axis,))])))
+    return jnp.sum(a, axis=axis, keepdims=keepdims) / n

@@ -13,12 +13,15 @@ def grad(func, xs, v=None):
 
 
 def enable_prim():
-    pass
+    from ...decomposition import enable_prim as _e
+    _e()
 
 
 def disable_prim():
-    pass
+    from ...decomposition import disable_prim as _d
+    _d()
 
 
 def prim_enabled():
-    return True
+    from ...decomposition import prim_enabled as _p
+    return _p()

@@ -57,7 +57,9 @@ __all__ = [
 # ---------------------------------------------------------------------------
 def _act(name, jfn):
     def op(x, name=None):
-        return apply(op.__name__, jfn, as_tensor(x))
+        from ...ops.dispatch import resolve_impl
+        return apply(op.__name__, resolve_impl(op.__name__, jfn),
+                     as_tensor(x))
     op.__name__ = name
     return op
 
@@ -118,9 +120,11 @@ def celu(x, alpha=1.0, name=None):
 
 
 def gelu(x, approximate=False, name=None):
-    return apply("gelu",
-                 lambda a: jax.nn.gelu(a, approximate=approximate),
-                 as_tensor(x))
+    from ...ops.dispatch import resolve_impl
+    impl = resolve_impl("gelu",
+                        lambda a: jax.nn.gelu(a, approximate=approximate),
+                        approximate=approximate)
+    return apply("gelu", impl, as_tensor(x))
 
 
 def hardshrink(x, threshold=0.5, name=None):
@@ -165,25 +169,32 @@ def maxout(x, groups, axis=1, name=None):
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
+    from ...ops.dispatch import resolve_impl
     x = as_tensor(x)
     jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    impl = resolve_impl("softmax", lambda a: jax.nn.softmax(a, axis=axis),
+                        axis=axis)
 
     def fn(a):
         if jdt is not None:
             a = a.astype(jdt)
-        return jax.nn.softmax(a, axis=axis)
+        return impl(a)
 
     return apply("softmax", fn, x)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...ops.dispatch import resolve_impl
     x = as_tensor(x)
     jdt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    impl = resolve_impl("log_softmax",
+                        lambda a: jax.nn.log_softmax(a, axis=axis),
+                        axis=axis)
 
     def fn(a):
         if jdt is not None:
             a = a.astype(jdt)
-        return jax.nn.log_softmax(a, axis=axis)
+        return impl(a)
 
     return apply("log_softmax", fn, x)
 
@@ -609,20 +620,29 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     nd = len(normalized_shape)
     axes = tuple(range(x.ndim - nd, x.ndim))
 
-    def fn(a, *wb):
+    has_w, has_b = weight is not None, bias is not None
+
+    def _default(a, *wb):
         m = jnp.mean(a, axis=axes, keepdims=True)
         v = jnp.var(a, axis=axes, keepdims=True)
         out = (a - m) / jnp.sqrt(v + epsilon)
-        if len(wb) >= 1:
-            out = out * wb[0]
-        if len(wb) == 2:
-            out = out + wb[1]
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
         return out
 
+    from ...ops.dispatch import resolve_impl
+    fn = resolve_impl("layer_norm", _default, epsilon=epsilon,
+                      begin_norm_axis=x.ndim - nd, has_weight=has_w,
+                      has_bias=has_b)
+
     args = [x]
-    if weight is not None:
+    if has_w:
         args.append(as_tensor(weight))
-    if bias is not None:
+    if has_b:
         args.append(as_tensor(bias))
     return apply("layer_norm", fn, *args)
 
@@ -630,7 +650,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (reference: incubate fused_rms_norm).  Dispatchable to the
     Pallas kernel via register_op_impl('rms_norm', ...)."""
+    from ...ops.dispatch import resolve_impl
     x = as_tensor(x)
+    rule = resolve_impl("rms_norm", None, epsilon=epsilon)
+    if rule is not None:
+        if weight is not None:
+            return apply("rms_norm", rule, x, as_tensor(weight))
+        return apply("rms_norm", rule, x)
     impl = get_op_impl("rms_norm", None)
     if (impl is not None and weight is not None
             and jax.default_backend() in ("tpu", "axon")):

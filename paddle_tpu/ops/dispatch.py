@@ -69,6 +69,36 @@ def get_op_impl(name: str, default: Callable) -> Callable:
     return _op_table.get(name, default)
 
 
+# -- decomposition (prim mode) ------------------------------------------------
+# paddle_tpu.decomposition installs composite rules here; op call sites with
+# a registered rule resolve through resolve_impl(), which substitutes the
+# rule (with the site's attrs bound) for the fused/library implementation
+# when prim mode is on — the dispatch-layer analog of the reference's
+# decomp pass (python/paddle/decomposition/decomp.py:192).
+_decomp_table: Dict[str, Callable] = {}
+_prim_enabled: bool = False
+
+
+def set_prim_enabled(flag: bool) -> None:
+    global _prim_enabled
+    _prim_enabled = bool(flag)
+
+
+def prim_enabled() -> bool:
+    return _prim_enabled
+
+
+def resolve_impl(name: str, default_fn: Callable, **attrs) -> Callable:
+    """Pick the composite decomposition rule over ``default_fn`` when prim
+    mode is on.  Rules have signature ``rule(*arrays, **attrs)``."""
+    if _prim_enabled and name in _decomp_table:
+        rule = _decomp_table[name]
+        if attrs:
+            return functools.partial(rule, **attrs)
+        return rule
+    return default_fn
+
+
 def as_tensor(x: Any, dtype=None) -> Tensor:
     """Coerce op operand to Tensor (scalars become weak-typed arrays)."""
     if isinstance(x, Tensor):

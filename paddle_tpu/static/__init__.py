@@ -134,6 +134,13 @@ class Executor:
             return_numpy=True, **kwargs):
         feed = feed or {}
         fetch_list = fetch_list or []
+        if program is not None and hasattr(program, "get_input_names") \
+                and hasattr(program, "run"):
+            # an inference Predictor from load_inference_model
+            names = program.get_input_names()
+            ordered = [np.asarray(feed[n]) for n in names] if feed else []
+            outs = program.run(ordered)
+            return outs if return_numpy else [to_tensor(o) for o in outs]
         results = []
         for target in fetch_list:
             if callable(target):
@@ -155,15 +162,44 @@ class Executor:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None, **kwargs):
-    from ..jit import save as jsave
-    raise NotImplementedError(
-        "static save_inference_model: use paddle_tpu.jit.save(layer, path) "
-        "— the jit path is the static path on TPU")
+    """AOT-export a model for serving (reference: static/io.py
+    save_inference_model -> __model__ + params files).
+
+    TPU-native: the artifact is the inference engine's serialized
+    StableHLO export (inference/convert_to_export), not a ProgramDesc.
+    ``fetch_vars`` (or ``program``) must be the model callable or Layer —
+    in this framework the "static program" IS a python callable traced by
+    jax.jit; ``feed_vars`` supply the input specs.
+    """
+    from ..inference import convert_to_export
+
+    target = program
+    if target is None:
+        fv = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+            else [fetch_vars]
+        target = next((f for f in fv if callable(f)
+                       and not isinstance(f, Tensor)), None)
+    if target is None:
+        raise TypeError(
+            "save_inference_model needs the model callable or Layer as "
+            "program= or among fetch_vars: the TPU static path exports a "
+            "traced function, not a recorded graph")
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    specs = [(tuple(t.shape), str(t.dtype).replace("paddle.", ""))
+             for t in feeds]
+    return convert_to_export(target, specs, path_prefix)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "static load_inference_model: use paddle_tpu.jit.load(path)")
+    """Load an AOT-exported model; returns (predictor, feed_names,
+    fetch_names) — pass the predictor as ``program=`` to ``Executor.run``
+    or call it directly (reference: static/io.py load_inference_model
+    returns [program, feed_target_names, fetch_targets])."""
+    from ..inference import Config, create_predictor
+    cfg = Config(path_prefix + ".stablehlo"
+                 if not path_prefix.endswith(".stablehlo") else path_prefix)
+    pred = create_predictor(cfg)
+    return pred, list(pred.get_input_names()), list(pred.get_output_names())
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -286,9 +286,13 @@ nanmean = _make_reduce("nanmean", jnp.nanmean)
 
 @_export
 def mean(x, axis=None, keepdim=False, name=None):
+    from ..ops.dispatch import resolve_impl
     x = as_tensor(x)
     ax = _normalize_axis(axis)
-    return apply("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+    impl = resolve_impl("mean",
+                        lambda a: jnp.mean(a, axis=ax, keepdims=keepdim),
+                        axis=ax, keepdims=keepdim)
+    return apply("mean", impl, x)
 
 
 @_export

@@ -54,7 +54,12 @@ def _compile(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
     for s in sources:
         with open(s, "rb") as f:
             h.update(f.read())
+    with open(os.path.join(sysconfig.get_include(),
+                           "paddle_tpu_ext.h"), "rb") as f:
+        h.update(f.read())
     h.update(" ".join(extra_cflags or []).encode())
+    h.update(b"\0")
+    h.update(" ".join(extra_ldflags or []).encode())
     so_path = os.path.join(build_dir, f"{name}_{h.hexdigest()[:16]}.so")
     if os.path.exists(so_path):
         return so_path
@@ -100,6 +105,12 @@ class _CustomOp:
     # -- host callbacks ----------------------------------------------------
     def _run_fwd(self, *arrays):
         arrs = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        if any(a.shape != arrs[0].shape for a in arrs[1:]):
+            # the C kernel iterates numel(inputs[0]) over every buffer —
+            # mismatched shapes would read out of bounds in native code
+            raise ValueError(
+                f"op {self.name}: all inputs must share one shape, got "
+                f"{[a.shape for a in arrs]}")
         out = np.empty_like(arrs[0])
         shape = (ctypes.c_int64 * max(out.ndim, 1))(*out.shape or (1,))
         ptrs = [a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
@@ -151,8 +162,12 @@ class _CustomOp:
             raise TypeError(
                 f"op {self.name} takes {self._arity} tensors, got "
                 f"{len(tensors)}")
-        return apply(self.name, self._jax_fn,
-                     *(as_tensor(t) for t in tensors))
+        ts = [as_tensor(t) for t in tensors]
+        if any(tuple(t.shape) != tuple(ts[0].shape) for t in ts[1:]):
+            raise ValueError(
+                f"op {self.name}: all inputs must share one shape, got "
+                f"{[tuple(t.shape) for t in ts]}")
+        return apply(self.name, self._jax_fn, *ts)
 
 
 class ExtensionModule:
@@ -195,14 +210,18 @@ class CppExtension:
         self.kwargs = kwargs
 
 
-def setup(name: str, ext_modules=None, **kwargs) -> ExtensionModule:
+def setup(name: str, ext_modules=None, **kwargs):
     """Eager in-process analog of the reference's setuptools flow: builds
-    the extension immediately and returns the loaded module."""
+    every extension immediately.  Returns the loaded module, or a list of
+    modules when several extensions are given."""
     if ext_modules is None:
         raise ValueError("setup() requires ext_modules")
     exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
         else [ext_modules]
-    ext = exts[0]
-    return load(name=ext.name or name, sources=ext.sources,
-                extra_cflags=ext.kwargs.get("extra_compile_args"),
-                extra_ldflags=ext.kwargs.get("extra_link_args"))
+    mods = [load(name=ext.name or (name if len(exts) == 1
+                                   else f"{name}_{i}"),
+                 sources=ext.sources,
+                 extra_cflags=ext.kwargs.get("extra_compile_args"),
+                 extra_ldflags=ext.kwargs.get("extra_link_args"))
+            for i, ext in enumerate(exts)]
+    return mods[0] if len(mods) == 1 else mods

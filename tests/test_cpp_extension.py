@@ -97,6 +97,13 @@ def test_binary_forward_under_jit(ext):
                                [64.0, 128.0], rtol=1e-6)
 
 
+def test_binary_shape_mismatch_rejected(ext):
+    a = paddle.to_tensor(np.zeros(4, np.float32))
+    b = paddle.to_tensor(np.zeros(2, np.float32))
+    with pytest.raises(ValueError):
+        ext.caxpby(a, b)
+
+
 def test_build_cache_reused(ext, tmp_path):
     # same sources → same .so path, no recompilation
     src = tmp_path / "my_ops.cc"

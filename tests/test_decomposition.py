@@ -1,0 +1,125 @@
+"""Decomposition registry / prim mode (reference:
+python/paddle/decomposition/register.py Registry, decomp.py:192)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import decomposition as D
+
+
+@pytest.fixture(autouse=True)
+def _prim_off():
+    yield
+    D.disable_prim()
+
+
+def _x(shape=(4, 8)):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(*shape).astype("float32"))
+
+
+@pytest.mark.parametrize("op,call", [
+    ("softmax", lambda x: F.softmax(x, axis=-1)),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=0)),
+    ("gelu", lambda x: F.gelu(x)),
+    ("gelu_tanh", lambda x: F.gelu(x, approximate=True)),
+    ("silu", lambda x: F.silu(x)),
+    ("rms_norm", lambda x: F.rms_norm(x, epsilon=1e-5)),
+    ("layer_norm", lambda x: F.layer_norm(x, 8)),
+])
+def test_rules_match_library_impl(op, call):
+    x = _x()
+    ref = call(x).numpy()
+    D.enable_prim()
+    got = call(x).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_prim_mode_actually_substitutes():
+    # a custom rule must take effect only under prim mode
+    @D.register_decomp("softsign_test_only")
+    def rule(a):
+        return a * 0 + 42.0
+
+    from paddle_tpu.ops.dispatch import resolve_impl
+    default = lambda a: a
+    assert resolve_impl("softsign_test_only", default) is default
+    D.enable_prim()
+    out = resolve_impl("softsign_test_only", default)(jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(out), [42.0] * 3)
+
+
+def test_layer_norm_bias_without_weight():
+    # regression: the bias used to be multiplied instead of added when no
+    # weight was passed (positional wb ambiguity), in both impls
+    x = _x((3, 4))
+    b = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    ref = F.layer_norm(x, 4).numpy() + b.numpy()
+    for flag in (False, True):
+        with D.prim_guard(flag):
+            got = F.layer_norm(x, 4, bias=b).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_activation_rules_actually_consulted():
+    # swap the silu rule for a marker and confirm prim mode routes to it
+    from paddle_tpu.ops import dispatch as dsp
+    orig = dsp._decomp_table["silu"]
+    dsp._decomp_table["silu"] = lambda a: a * 0 + 7.0
+    try:
+        x = _x((3,))
+        with D.prim_guard(True):
+            np.testing.assert_allclose(F.silu(x).numpy(), [7.0] * 3)
+        assert not np.allclose(F.silu(x).numpy(), [7.0] * 3)
+    finally:
+        dsp._decomp_table["silu"] = orig
+
+
+def test_duplicate_registration_rejected():
+    with pytest.raises(ValueError):
+        D.register_decomp("softmax", lambda a: a)
+
+
+def test_grad_through_decomposed_rule():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(5).astype("float32"),
+                         stop_gradient=False)
+    ref = None
+    for flag in (False, True):
+        if flag:
+            D.enable_prim()
+        y = F.gelu(x)
+        y.sum().backward()
+        g = x.grad.numpy().copy()
+        x.clear_grad()
+        if ref is None:
+            ref = g
+        else:
+            np.testing.assert_allclose(g, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_decompose_wrapper_and_guard():
+    x = _x((3, 4))
+
+    def f(t):
+        assert D.prim_enabled()
+        return F.softmax(t)
+
+    out = D.decompose(f)(x)
+    assert not D.prim_enabled()
+    np.testing.assert_allclose(out.numpy().sum(-1), np.ones(3), rtol=1e-5)
+    with D.prim_guard(True):
+        assert D.prim_enabled()
+    assert not D.prim_enabled()
+
+
+def test_incubate_primapi_delegates():
+    from paddle_tpu.incubate.autograd import (enable_prim, disable_prim,
+                                              prim_enabled)
+    assert not prim_enabled()
+    enable_prim()
+    assert prim_enabled() and D.prim_enabled()
+    disable_prim()
+    assert not prim_enabled()

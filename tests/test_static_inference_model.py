@@ -1,0 +1,35 @@
+"""static.save_inference_model / load_inference_model over the AOT export
+(reference: python/paddle/static/io.py; TPU realization: StableHLO export
++ Predictor, SURVEY §7 'inference')."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def test_save_load_round_trip(tmp_path):
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    net.eval()
+    x = static.data("x", [1, 4], "float32")
+    prefix = os.path.join(str(tmp_path), "model")
+    exe = static.Executor()
+    path = static.save_inference_model(prefix, [x], [net], exe)
+    assert os.path.exists(path)
+
+    prog, feeds, fetches = static.load_inference_model(prefix, exe)
+    xin = np.random.RandomState(0).randn(1, 4).astype("float32")
+    out = exe.run(prog, feed={feeds[0]: xin}, fetch_list=fetches)
+    ref = net(paddle.to_tensor(xin)).numpy()
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_save_requires_callable():
+    exe = static.Executor()
+    x = static.data("x2", [1, 4], "float32")
+    with pytest.raises(TypeError):
+        static.save_inference_model("/tmp/nope", [x], [x], exe)
