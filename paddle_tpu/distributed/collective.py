@@ -135,8 +135,11 @@ def wait(tensor, group: Optional[Group] = None, use_calc_stream=True):
 
 def barrier(group: Optional[Group] = None) -> None:
     """Device barrier: flush outstanding work.  (Cross-process barrier uses
-    the PjRt coordination service when multi-host.)"""
-    (jax.device_put(0) + 0).block_until_ready()
+    the PjRt coordination service when multi-host.)  Watchdog-bounded:
+    a dead peer shows up as a timed-out 'barrier' CommTask."""
+    from .communication.watchdog import comm_task
+    with comm_task("barrier", group):
+        (jax.device_put(0) + 0).block_until_ready()
 
 
 def is_main_process() -> bool:

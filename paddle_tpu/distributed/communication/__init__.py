@@ -451,3 +451,30 @@ class _StreamNamespace:
 
 
 stream = _StreamNamespace()
+
+
+# ---------------------------------------------------------------------------
+# comm watchdog: bound the eager dispatch of every public collective with a
+# CommTask so the manager thread can flag hangs (reference:
+# phi/core/distributed/comm_task_manager.h:37).  The group kwarg position
+# varies per op, so the wrapper pulls it from kwargs/args generically.
+# ---------------------------------------------------------------------------
+from .watchdog import comm_task as _comm_task, manager as comm_manager  # noqa: E402
+
+
+def _watchdogged(op_name, fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        group = kwargs.get("group")
+        if group is None:
+            group = next((a for a in args if isinstance(a, Group)), None)
+        with _comm_task(op_name, group):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+for _name in ("all_reduce", "all_gather", "all_to_all", "all_to_all_single",
+              "broadcast", "reduce", "reduce_scatter", "scatter", "gather",
+              "send", "recv"):
+    globals()[_name] = _watchdogged(_name, globals()[_name])
+del _name

@@ -1,0 +1,172 @@
+"""Collective watchdog — hang/timeout detection for distributed comms.
+
+Reference: the NCCL comm-task watchdog
+(/root/reference/paddle/phi/core/distributed/comm_task.h:36,
+comm_task_manager.h:37) — every collective is wrapped in a CommTask with
+start/end events; a background manager thread flags tasks that exceed the
+timeout and aborts the communicator.
+
+TPU-native shape: collectives lowered inside a jit program are scheduled
+by XLA and cannot be interposed per-op; what CAN hang at the Python layer
+is (a) multi-host rendezvous/initialization, (b) eager collective
+dispatch that blocks on peer participation, and (c) host-side barrier /
+store traffic.  Those are exactly the paths the reference watchdog
+guards, so this manager wraps the eager collective API and the barrier:
+
+* ``task(op, group)`` context: registers a CommTask at entry, completes
+  at exit; a daemon thread scans outstanding tasks every second.
+* a task outliving ``FLAGS_comm_timeout_s`` (default 600s) triggers the abort handler — by default
+  it logs the stuck op/group/elapsed to stderr and records it; callers
+  can install a handler that kills the process (the reference's abort)
+  via ``set_abort_handler``.
+* ``check()`` raises if any task has timed out — surfacing a hang to the
+  training loop instead of waiting forever.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...flags import flags
+
+__all__ = ["CommTask", "CommTaskManager", "manager", "comm_task",
+           "set_abort_handler"]
+
+
+class CommTask:
+    __slots__ = ("op", "group_name", "started_at", "done", "timed_out",
+                 "task_id")
+
+    def __init__(self, op: str, group_name: str, task_id: int):
+        self.op = op
+        self.group_name = group_name
+        self.started_at = time.monotonic()
+        self.done = False
+        self.timed_out = False
+        self.task_id = task_id
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.started_at
+
+    def __repr__(self):
+        state = "timed-out" if self.timed_out else (
+            "done" if self.done else "running")
+        return (f"<CommTask {self.op}@{self.group_name} {state} "
+                f"{self.elapsed():.1f}s>")
+
+
+class CommTaskManager:
+    """Background scanner over outstanding comm tasks (singleton via
+    :data:`manager`)."""
+
+    def __init__(self, scan_interval: float = 1.0):
+        self._tasks: Dict[int, CommTask] = {}
+        self._timed_out: List[CommTask] = []
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._scan_interval = scan_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._abort_handler: Callable[[CommTask], None] = self._default_abort
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._scan_loop,
+                                            name="comm-watchdog",
+                                            daemon=True)
+            self._thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+
+    # -- task API ----------------------------------------------------------
+    def start_task(self, op: str, group_name: str) -> CommTask:
+        with self._lock:
+            t = CommTask(op, group_name, self._next_id)
+            self._next_id += 1
+            self._tasks[t.task_id] = t
+        self._ensure_thread()
+        return t
+
+    def finish_task(self, task: CommTask):
+        task.done = True
+        with self._lock:
+            self._tasks.pop(task.task_id, None)
+
+    def outstanding(self) -> List[CommTask]:
+        with self._lock:
+            return list(self._tasks.values())
+
+    def timed_out_tasks(self) -> List[CommTask]:
+        with self._lock:
+            return list(self._timed_out)
+
+    def clear_timeouts(self):
+        with self._lock:
+            self._timed_out.clear()
+
+    def check(self):
+        """Raise if any collective has exceeded the timeout (call from the
+        training loop to surface hangs)."""
+        stuck = self.timed_out_tasks()
+        if stuck:
+            raise RuntimeError(
+                f"distributed communication timed out: {stuck}")
+
+    # -- abort -------------------------------------------------------------
+    @staticmethod
+    def _default_abort(task: CommTask):
+        print(f"[paddle_tpu comm-watchdog] {task!r} exceeded "
+              f"{flags.FLAGS_comm_timeout_s}s — the peer is "
+              f"likely dead or desynchronized", file=sys.stderr)
+
+    def set_abort_handler(self, handler: Callable[[CommTask], None]):
+        self._abort_handler = handler
+
+    # -- scanner -----------------------------------------------------------
+    def _scan_loop(self):
+        while not self._stop.wait(self._scan_interval):
+            limit = float(flags.FLAGS_comm_timeout_s)
+            if limit <= 0:
+                continue
+            with self._lock:
+                running = list(self._tasks.values())
+            for t in running:
+                if not t.done and not t.timed_out and t.elapsed() > limit:
+                    t.timed_out = True
+                    with self._lock:
+                        self._timed_out.append(t)
+                    try:
+                        self._abort_handler(t)
+                    except Exception:
+                        pass
+
+
+manager = CommTaskManager()
+
+
+def set_abort_handler(handler: Callable[[CommTask], None]):
+    manager.set_abort_handler(handler)
+
+
+class comm_task:
+    """``with comm_task("all_reduce", group): ...`` — bounds the eager
+    dispatch of one collective."""
+
+    def __init__(self, op: str, group=None):
+        self._op = op
+        self._group = getattr(group, "name", None) or "world"
+        self._task: Optional[CommTask] = None
+
+    def __enter__(self):
+        self._task = manager.start_task(self._op, self._group)
+        return self._task
+
+    def __exit__(self, exc_type, exc, tb):
+        manager.finish_task(self._task)
+        return False

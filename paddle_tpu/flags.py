@@ -144,9 +144,10 @@ define_flag("FLAGS_use_stride_kernel", True,
             "XLA; flag kept for API parity).")
 define_flag("FLAGS_set_to_1d", False, "Return 1-D tensors for 0-D results "
             "(legacy behaviour; default off like modern Paddle).")
-define_flag("FLAGS_comm_timeout_s", 600,
-            "Collective watchdog timeout in seconds "
-            "(reference: comm_task_manager.h:37).")
+define_flag("FLAGS_comm_timeout_s", 600.0,
+            "Collective watchdog timeout in seconds, enforced by "
+            "distributed.communication.watchdog.CommTaskManager "
+            "(reference: comm_task_manager.h:37). <=0 disables.")
 define_flag("FLAGS_allocator_strategy", "xla",
             "Kept for parity; allocation is delegated to PjRt/XLA.")
 define_flag("FLAGS_cudnn_deterministic", False,

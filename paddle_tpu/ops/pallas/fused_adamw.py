@@ -55,15 +55,23 @@ def fused_adamw(p, g, m, v, t, lr, b1=0.9, b2=0.95, eps=1e-8,
     llama_pretrain adamw_update rule."""
     shape = p.shape
     flat_n = int(p.size)
-    h = 128 if flat_n % 128 == 0 else 1
-    rows = flat_n // h
+    # always lay out as [rows, 128]: a [N, 1] fallback would be tiled
+    # (8, 128) by the TPU memory system — a 128x padded-HBM blowup.
+    # Indivisible sizes get zero-padded to a whole number of rows (the
+    # padded tail updates zeros against zero grads: wasted lanes only).
+    h = 128
+    pad = (-flat_n) % (8 * h)  # whole (8, 128) tiles: sublane x lane
+    rows = (flat_n + pad) // h
     br = rows
-    for cand in (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+    for cand in (1024, 512, 256, 128, 64, 32, 16, 8):
         if rows % cand == 0:
             br = cand
             break
 
     def flat2(x, dt=None):
+        x = x.reshape(-1)
+        if pad:
+            x = jnp.pad(x, (0, pad))
         x = x.reshape(rows, h)
         return x if dt is None else x.astype(dt)
 
@@ -98,5 +106,11 @@ def fused_adamw(p, g, m, v, t, lr, b1=0.9, b2=0.95, eps=1e-8,
         interpret=_interpret(),
     )(flat2(p), flat2(g, jnp.float32), flat2(m, jnp.float32),
       flat2(v, jnp.float32), lr_arr, c1_arr, c2_arr)
-    return (new_p.reshape(shape),
-            {"m": new_m.reshape(shape), "v": new_v.reshape(shape)})
+
+    def unflat(x):
+        x = x.reshape(-1)
+        if pad:
+            x = x[:flat_n]
+        return x.reshape(shape)
+
+    return (unflat(new_p), {"m": unflat(new_m), "v": unflat(new_v)})

@@ -11,8 +11,13 @@ from ..tensor.tensor import Tensor
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "BrightnessTransform", "Pad", "RandomRotation",
-           "to_tensor", "normalize", "resize", "hflip", "vflip"]
+           "Transpose", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter",
+           "Grayscale", "RandomResizedCrop", "RandomErasing",
+           "RandomAffine", "RandomPerspective", "Pad", "RandomRotation",
+           "to_tensor", "normalize", "resize", "hflip", "vflip",
+           "adjust_brightness", "adjust_contrast", "adjust_saturation",
+           "adjust_hue", "to_grayscale", "rotate", "erase"]
 
 
 def _chw(img) -> np.ndarray:
@@ -162,9 +167,8 @@ class BrightnessTransform:
         self.value = value
 
     def __call__(self, img):
-        a = _chw(img)
-        factor = 1 + np.random.uniform(-self.value, self.value)
-        return np.clip(a * factor, 0, 1)
+        return adjust_brightness(img, np.random.uniform(
+            max(0.0, 1 - self.value), 1 + self.value))
 
 
 class Pad:
@@ -190,3 +194,313 @@ class RandomRotation:
         a = _chw(img)
         k = np.random.randint(0, 4)
         return np.rot90(a, k, axes=(-2, -1)).copy()
+
+
+# ---------------------------------------------------------------------------
+# photometric transforms (reference: vision/transforms/functional.py
+# adjust_brightness/adjust_contrast/adjust_saturation/adjust_hue)
+# ---------------------------------------------------------------------------
+def _chw_ranged(img):
+    """CHW float array + its value ceiling so photometric math clips in
+    the right range.  uint8 input is 0-255; float input is judged by its
+    values (a chained transform hands the next one a float array still in
+    0-255) — floats entirely within [0, 1] use ceiling 1."""
+    raw = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+    a = _chw(img)
+    hi = 255.0 if (raw.dtype == np.uint8 or (a.size and a.max() > 1.0)) \
+        else 1.0
+    return a, hi
+
+
+def adjust_brightness(img, factor):
+    a, hi = _chw_ranged(img)
+    return np.clip(a * factor, 0, hi)
+
+
+def adjust_contrast(img, factor):
+    a, hi = _chw_ranged(img)
+    mean = a.mean(axis=(-2, -1), keepdims=True)
+    return np.clip(mean + factor * (a - mean), 0, hi)
+
+
+def _rgb_to_hsv(a):
+    r, g, b = a[0], a[1], a[2]
+    maxc = np.max(a, axis=0)
+    minc = np.min(a, axis=0)
+    v = maxc
+    diff = maxc - minc
+    s = np.where(maxc > 0, diff / np.maximum(maxc, 1e-12), 0.0)
+    safe = np.maximum(diff, 1e-12)
+    rc, gc, bc = (maxc - r) / safe, (maxc - g) / safe, (maxc - b) / safe
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(diff > 0, (h / 6.0) % 1.0, 0.0)
+    return np.stack([h, s, v])
+
+
+def _hsv_to_rgb(a):
+    h, s, v = a[0], a[1], a[2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    choices = [np.stack([v, t, p]), np.stack([q, v, p]),
+               np.stack([p, v, t]), np.stack([p, q, v]),
+               np.stack([t, p, v]), np.stack([v, p, q])]
+    out = np.zeros_like(a)
+    for k, c in enumerate(choices):
+        out = np.where(i[None] == k, c, out)
+    return out
+
+
+def adjust_saturation(img, factor):
+    a, hi = _chw_ranged(img)
+    hsv = _rgb_to_hsv(a / hi)
+    hsv[1] = np.clip(hsv[1] * factor, 0, 1)
+    return np.clip(_hsv_to_rgb(hsv), 0, 1) * hi
+
+
+def adjust_hue(img, delta):
+    """delta in [-0.5, 0.5] — fraction of the hue circle."""
+    a, hi = _chw_ranged(img)
+    hsv = _rgb_to_hsv(a / hi)
+    hsv[0] = (hsv[0] + delta) % 1.0
+    return np.clip(_hsv_to_rgb(hsv), 0, 1) * hi
+
+
+def to_grayscale(img, num_output_channels=1):
+    a = _chw(img)
+    gray = (0.299 * a[0] + 0.587 * a[1] + 0.114 * a[2])[None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=0)
+    return gray
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, fill=0):
+    import scipy.ndimage as ndi
+    a = _chw(img)
+    order = 1 if interpolation == "bilinear" else 0
+    return np.stack([
+        ndi.rotate(c, angle, reshape=expand, order=order, cval=fill,
+                   mode="constant") for c in a])
+
+
+def erase(img, i, j, h, w, v=0.0):
+    a = _chw(img).copy()
+    a[:, i:i + h, j:j + w] = v
+    return a
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        # factor never negative (reference samples max(0, 1-v)..1+v)
+        return adjust_contrast(img, np.random.uniform(
+            max(0.0, 1 - self.value), 1 + self.value))
+
+
+class SaturationTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        return adjust_saturation(img, np.random.uniform(
+            max(0.0, 1 - self.value), 1 + self.value))
+
+
+class HueTransform:
+    def __init__(self, value, keys=None):
+        self.value = value  # max hue shift as a fraction of the circle
+
+    def __call__(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation/hue in random order
+    (reference: transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for idx in order:
+            img = self.transforms[idx](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomResizedCrop:
+    """Crop a random area/aspect patch, resize to ``size``
+    (reference: transforms.RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        a = _chw(img)
+        _, H, W = a.shape
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                i = np.random.randint(0, H - h + 1)
+                j = np.random.randint(0, W - w + 1)
+                patch = a[:, i:i + h, j:j + w]
+                return _resize_np(patch, self.size)
+        # fallback: center crop of the max fitting square
+        s = min(H, W)
+        i, j = (H - s) // 2, (W - s) // 2
+        return _resize_np(a[:, i:i + s, j:j + s], self.size)
+
+
+class RandomErasing:
+    """Blank a random rectangle (reference: transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        a = _chw(img)
+        if np.random.rand() >= self.prob:
+            return a
+        _, H, W = a.shape
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W:
+                i = np.random.randint(0, H - h + 1)
+                j = np.random.randint(0, W - w + 1)
+                return erase(a, i, j, h, w, self.value)
+        return a
+
+
+class RandomAffine:
+    """Random rotation/translation/scale/shear via an inverse affine map
+    (reference: transforms.RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.order = 1 if interpolation == "bilinear" else 0
+        self.fill = fill
+
+    def __call__(self, img):
+        import scipy.ndimage as ndi
+        a = _chw(img)
+        _, H, W = a.shape
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        s = np.random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None or self.shear == 0:
+            shear = 0.0
+        elif isinstance(self.shear, (int, float)):
+            shear = np.deg2rad(np.random.uniform(-self.shear, self.shear))
+        else:  # sequence [lo, hi] (degrees), the documented API shape
+            shear = np.deg2rad(np.random.uniform(self.shear[0],
+                                                 self.shear[1]))
+        tx = ty = 0.0
+        if self.translate:
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * H
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * W
+        c, si = np.cos(angle), np.sin(angle)
+        # forward map: shear, then rotate, then scale, about the centre
+        R = np.array([[c, -si], [si, c]])
+        # coordinates are (row, col) = (y, x): shear displaces x by y
+        Sh = np.array([[1.0, 0.0], [np.tan(shear), 1.0]])
+        M = (R @ Sh) * s
+        Minv = np.linalg.inv(M)
+        centre = np.array([(H - 1) / 2, (W - 1) / 2])
+        offset = centre - Minv @ (centre + np.array([ty, tx]))
+        return np.stack([
+            ndi.affine_transform(ch, Minv, offset=offset, order=self.order,
+                                 cval=self.fill, mode="constant")
+            for ch in a])
+
+
+class RandomPerspective:
+    """Random four-point perspective warp (reference:
+    transforms.RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.order = 1 if interpolation == "bilinear" else 0
+        self.fill = fill
+
+    @staticmethod
+    def _solve_homography(src, dst):
+        # standard DLT: 8 equations in the 8 unknown homography params
+        A, b = [], []
+        for (x, y), (u, v) in zip(src, dst):
+            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y]); b.append(u)
+            A.append([0, 0, 0, x, y, 1, -v * x, -v * y]); b.append(v)
+        h = np.linalg.solve(np.asarray(A, float), np.asarray(b, float))
+        return np.append(h, 1.0).reshape(3, 3)
+
+    def __call__(self, img):
+        import scipy.ndimage as ndi
+        a = _chw(img)
+        if np.random.rand() >= self.prob:
+            return a
+        _, H, W = a.shape
+        d = self.distortion_scale
+        dx, dy = W * d / 2, H * d / 2
+        corners = np.array([[0, 0], [W - 1, 0], [W - 1, H - 1], [0, H - 1]],
+                           float)
+        jitter = np.stack([np.random.uniform(-dx, dx, 4),
+                           np.random.uniform(-dy, dy, 4)], axis=1)
+        signs = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], float)
+        dst = corners + np.abs(jitter) * signs
+        # inverse map: for each output pixel find the source coordinate
+        Hmat = self._solve_homography(dst, corners)
+        ys, xs = np.mgrid[0:H, 0:W]
+        ones = np.ones_like(xs)
+        pts = np.stack([xs.ravel(), ys.ravel(), ones.ravel()])
+        src = Hmat @ pts
+        sx = (src[0] / src[2]).reshape(H, W)
+        sy = (src[1] / src[2]).reshape(H, W)
+        return np.stack([
+            ndi.map_coordinates(ch, [sy, sx], order=self.order,
+                                cval=self.fill, mode="constant")
+            for ch in a])

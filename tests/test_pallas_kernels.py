@@ -102,3 +102,24 @@ def test_fused_adamw_parity():
     np.testing.assert_allclose(new_p, p_ref, atol=1e-6)
     np.testing.assert_allclose(mo["m"], m_ref, atol=1e-6)
     np.testing.assert_allclose(mo["v"], v_ref, atol=1e-6)
+
+
+def test_fused_adamw_indivisible_size():
+    """Sizes not divisible by 128 must pad to (8,128) tiles rather than
+    fall back to a [N,1] layout (128x padded-HBM blowup under TPU tiling)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+    n = 1000
+    p = jnp.arange(n, dtype=jnp.float32) * 0.01
+    g = jnp.ones(n, jnp.float32) * 0.1
+    m = jnp.zeros(n, jnp.float32)
+    v = jnp.zeros(n, jnp.float32)
+    p2, st = fused_adamw(p, g, m, v, 1, 1e-2)
+    b1, b2, eps, wd, lr, t = 0.9, 0.95, 1e-8, 0.1, 1e-2, 1
+    m2 = (1 - b1) * g
+    v2 = (1 - b2) * g * g
+    ref = (p * (1 - lr * wd)
+           - lr * (m2 / (1 - b1 ** t)) / (jnp.sqrt(v2 / (1 - b2 ** t)) + eps))
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(ref), rtol=1e-4,
+                               atol=1e-7)
+    assert p2.shape == (n,) and st["m"].shape == (n,) and st["v"].shape == (n,)

@@ -1,0 +1,121 @@
+"""Extended vision transforms (reference: python/paddle/vision/transforms/
+— ColorJitter, Grayscale, RandomResizedCrop, RandomErasing, RandomAffine,
+RandomPerspective and the photometric functionals)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+@pytest.fixture
+def img():
+    return np.random.RandomState(0).rand(3, 24, 24).astype("float32")
+
+
+class TestPhotometric:
+    def test_hsv_round_trip(self, img):
+        # saturation factor 1 and hue shift 0 must be identities
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_hue_full_circle_identity(self, img):
+        np.testing.assert_allclose(T.adjust_hue(img, 1.0), img,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_grayscale_weights(self, img):
+        g = T.to_grayscale(img)
+        ref = 0.299 * img[0] + 0.587 * img[1] + 0.114 * img[2]
+        np.testing.assert_allclose(g[0], ref, rtol=1e-6)
+        assert T.to_grayscale(img, 3).shape == (3, 24, 24)
+
+    def test_contrast_extremes(self, img):
+        flat = T.adjust_contrast(img, 0.0)
+        # factor 0 collapses each channel to its mean
+        for c in range(3):
+            np.testing.assert_allclose(flat[c], img[c].mean(), rtol=1e-4)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_saturation_zero_is_gray(self, img):
+        g = T.adjust_saturation(img, 0.0)
+        # r == g == b after full desaturation
+        np.testing.assert_allclose(g[0], g[1], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(g[1], g[2], rtol=1e-4, atol=1e-5)
+
+
+class TestGeometric:
+    def test_random_resized_crop_shape(self, img):
+        out = T.RandomResizedCrop(12)(img)
+        assert out.shape == (3, 12, 12)
+
+    def test_random_erasing_erases(self, img):
+        np.random.seed(3)
+        out = T.RandomErasing(prob=1.0, value=0.5)(img + 1.0)
+        assert (out == 0.5).any()
+        # prob=0 is identity
+        np.testing.assert_allclose(T.RandomErasing(prob=0.0)(img), img)
+
+    def test_affine_identity(self, img):
+        t = T.RandomAffine(degrees=(0, 0))
+        np.testing.assert_allclose(t(img), img, rtol=1e-5, atol=1e-5)
+
+    def test_perspective_zero_distortion_identity(self, img):
+        t = T.RandomPerspective(prob=1.0, distortion_scale=0.0)
+        np.testing.assert_allclose(t(img), img, rtol=1e-4, atol=1e-4)
+
+    def test_rotate_90(self):
+        img = np.zeros((1, 8, 8), np.float32)
+        img[0, 0, :] = 1.0  # top row lit
+        out = T.rotate(img, 90)
+        # 90 deg ccw moves the top row to a column
+        assert out.shape == (1, 8, 8)
+        assert out[0, :, 0].sum() > 4 or out[0, :, -1].sum() > 4
+
+
+class TestRangeAndShear:
+    def test_uint8_input_preserved(self):
+        # photometric transforms must respect the 0-255 range of uint8
+        # input (regression: clipping to [0,1] destroyed such images)
+        img = (np.random.RandomState(0).rand(3, 16, 16) * 255).astype(
+            np.uint8)
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+        assert out.max() > 2.0
+        g = T.adjust_saturation(img, 1.0)
+        np.testing.assert_allclose(g, img.astype("float32"), rtol=1e-3,
+                                   atol=0.5)
+
+    def test_factor_never_negative(self):
+        np.random.seed(0)
+        img = np.random.rand(3, 8, 8).astype("float32")
+        t = T.ContrastTransform(5.0)  # value > 1 must not invert
+        for _ in range(20):
+            out = t(img)
+            # inversion around the mean would flip the ordering of the
+            # brightest and darkest pixel
+            c = img[0]
+            o = out[0]
+            assert (o.flat[c.argmax()] >= o.flat[c.argmin()] - 1e-6)
+
+    def test_shear_sequence_applied(self):
+        img = np.zeros((1, 16, 16), np.float32)
+        img[0, :, 8] = 1.0  # vertical line
+        np.random.seed(0)
+        t = T.RandomAffine(degrees=(0, 0), shear=[30, 30])
+        out = t(img)
+        # a 30-degree shear tilts the line: mass leaves the centre column
+        assert out[0, :, 8].sum() < img[0, :, 8].sum() - 1.0
+
+
+class TestColorJitter:
+    def test_all_components_and_pipeline(self, img):
+        np.random.seed(0)
+        jit = T.ColorJitter(0.3, 0.3, 0.3, 0.2)
+        out = jit(img)
+        assert out.shape == img.shape
+        assert out.min() >= 0 and out.max() <= 1
+        pipe = T.Compose([T.ColorJitter(0.2), T.Grayscale(3),
+                          T.RandomErasing(prob=1.0)])
+        assert pipe(img).shape == img.shape
