@@ -13,6 +13,7 @@ from .layer.pooling import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .layer.extras import *  # noqa: F401,F403
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
 from ..framework.param import Parameter, ParamAttr  # noqa: F401

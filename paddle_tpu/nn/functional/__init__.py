@@ -49,6 +49,21 @@ __all__ = [
     "square_error_cost", "log_loss",
     # attention
     "scaled_dot_product_attention", "sequence_mask",
+    # long tail (extras.py)
+    "pairwise_distance", "label_smooth", "zeropad2d", "lp_pool1d",
+    "lp_pool2d", "adaptive_max_pool3d", "max_pool2d_with_index",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d", "dice_loss",
+    "poisson_nll_loss", "npair_loss", "multi_label_soft_margin_loss",
+    "hsigmoid_loss", "margin_cross_entropy", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "gaussian_nll_loss",
+    "gather_tree", "rnnt_loss", "temporal_shift", "class_center_sample",
+    "sparse_attention", "adaptive_log_softmax_with_loss",
+    "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+    "flash_attention_with_sparse_mask",
+    # in-place aliases
+    "elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+    "thresholded_relu_",
 ]
 
 
@@ -82,6 +97,30 @@ hardswish = _act("hardswish",
 
 def relu_(x, name=None):
     return x._inplace_assign(relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_assign(elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._inplace_assign(hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._inplace_assign(leaky_relu(x, negative_slope))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_assign(softmax(x, axis, dtype))
+
+
+def tanh_(x, name=None):
+    return x._inplace_assign(tanh(x))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._inplace_assign(thresholded_relu(x, threshold, value))
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
@@ -444,11 +483,14 @@ def _pool_nd(name, x, kernel, stride, padding, nd, reducer, init,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    out = _pool_nd("max_pool2d", x, kernel_size, stride, padding, 2,
-                   jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
     if return_mask:
-        return out, None
-    return out
+        from .extras import max_pool2d_with_index
+        if ceil_mode:
+            raise NotImplementedError(
+                "return_mask with ceil_mode is not supported")
+        return max_pool2d_with_index(x, kernel_size, stride, padding)
+    return _pool_nd("max_pool2d", x, kernel_size, stride, padding, 2,
+                    jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -1415,3 +1457,6 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if dropout_p > 0.0 and training:
         out = dropout(out, p=dropout_p, training=training)
     return out
+
+
+from .extras import *  # noqa: F401,F403,E402

@@ -1,0 +1,808 @@
+"""Long-tail nn functionals (reference: python/paddle/nn/functional/ —
+pooling variants, distance/label ops, extra losses, beam-search helpers).
+
+Split from __init__ to keep the hot-path module lean; __init__ re-exports
+everything here.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply, as_tensor
+from ...framework import random as framework_random
+
+__all__ = [
+    "pairwise_distance", "label_smooth", "zeropad2d",
+    "lp_pool1d", "lp_pool2d", "adaptive_max_pool3d",
+    "max_pool2d_with_index", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
+    "dice_loss", "poisson_nll_loss", "npair_loss",
+    "multi_label_soft_margin_loss", "hsigmoid_loss", "margin_cross_entropy",
+    "multi_margin_loss", "triplet_margin_with_distance_loss",
+    "gaussian_nll_loss", "gather_tree", "rnnt_loss",
+    "temporal_shift", "class_center_sample", "sparse_attention",
+    "adaptive_log_softmax_with_loss", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "flash_attention_with_sparse_mask",
+]
+
+
+def _nt(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(i) for i in v)
+
+
+# ---------------------------------------------------------------------------
+# distances / label ops / padding
+# ---------------------------------------------------------------------------
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last axis (reference:
+    nn/functional/distance.py)."""
+    def fn(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply("pairwise_distance", fn, as_tensor(x), as_tensor(y))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """(1-eps)*label + eps*uniform_or_prior (reference:
+    nn/functional/common.py label_smooth)."""
+    label = as_tensor(label)
+
+    if prior_dist is not None:
+        pd = as_tensor(prior_dist)
+
+        def fn(l, d):
+            return (1.0 - epsilon) * l + epsilon * d
+        return apply("label_smooth", fn, label, pd)
+
+    def fn(l):
+        return (1.0 - epsilon) * l + epsilon / l.shape[-1]
+    return apply("label_smooth", fn, label)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = _nt(padding, 4)  # [left, right, top, bottom]
+
+    def fn(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])))
+        return jnp.pad(a, ((0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)))
+    return apply("zeropad2d", fn, as_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# pooling variants
+# ---------------------------------------------------------------------------
+def _flat_window_index(kernel, stride, out, sp, nd):
+    """[*out, prod(kernel)] flat spatial index of every window element."""
+    per_dim = []
+    for d in range(nd):
+        starts = jnp.arange(out[d]) * stride[d]
+        offs = jnp.arange(kernel[d])
+        per_dim.append(starts[:, None] + offs[None, :])  # [out_d, k_d]
+    # combine: flat = sum_d idx_d * prod(sp[d+1:])
+    mul = [int(np.prod(sp[d + 1:])) for d in range(nd)]
+    total = None
+    for d in range(nd):
+        shape = [1] * (2 * nd)
+        shape[d] = out[d]
+        shape[nd + d] = kernel[d]
+        contrib = per_dim[d].reshape(out[d], kernel[d]) * mul[d]
+        contrib = contrib.reshape([out[d] if i == d else 1 for i in range(nd)]
+                                  + [kernel[d] if i == d else 1
+                                     for i in range(nd)])
+        total = contrib if total is None else total + contrib
+    total = jnp.broadcast_to(total, tuple(out) + tuple(kernel))
+    return total.reshape(tuple(out) + (-1,))
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    """Max pool returning (out, mask) where mask holds the flat H*W index
+    of each max (reference: max_pool2d(..., return_mask=True) semantics)."""
+    x = as_tensor(x)
+    k = _nt(kernel_size, 2)
+    s = _nt(stride if stride is not None else kernel_size, 2)
+    p = _nt(padding, 2)
+
+    def fn(a):
+        if any(p):
+            a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                        constant_values=-jnp.inf)
+        sp = a.shape[2:]
+        out = tuple((sp[d] - k[d]) // s[d] + 1 for d in range(2))
+        patches = a
+        for d in range(2):
+            axis = 2 + 2 * d
+            starts = jnp.arange(out[d]) * s[d]
+            offs = jnp.arange(k[d])
+            patches = jnp.take(patches, starts[:, None] + offs[None, :],
+                               axis=axis)
+        patches = patches.transpose(0, 1, 2, 4, 3, 5)   # N,C,oh,ow,kh,kw
+        flatp = patches.reshape(patches.shape[:4] + (-1,))
+        val = jnp.max(flatp, axis=-1)
+        arg = jnp.argmax(flatp, axis=-1)
+        widx = _flat_window_index(k, s, out, sp, 2)      # [oh, ow, kh*kw]
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(widx, flatp.shape), arg[..., None], -1)[..., 0]
+        if any(p):
+            # translate padded-plane indices back to the unpadded plane
+            H, W = sp
+            r, c = mask // W, mask % W
+            mask = (r - p[0]) * (W - 2 * p[1]) + (c - p[1])
+        return val, mask.astype(jnp.int32)
+
+    return apply("max_pool2d_with_index", fn, x, n_outputs=2)
+
+
+def _unpool(name, x, indices, kernel_size, stride, padding, output_size,
+            nd, data_format):
+    x, indices = as_tensor(x), as_tensor(indices)
+    k = _nt(kernel_size, nd)
+    s = _nt(stride if stride is not None else kernel_size, nd)
+
+    p = _nt(padding, nd)
+
+    def fn(a, idx):
+        out_sp = output_size
+        if out_sp is None:
+            sp = a.shape[2:]
+            o = tuple((sp[d] - 1) * s[d] - 2 * p[d] + k[d]
+                      for d in range(nd))
+        else:
+            o = tuple(out_sp[-nd:])
+        total = int(np.prod(o))
+        N, C = a.shape[:2]
+        flat = jnp.zeros((N, C, total), a.dtype)
+        ii = idx.reshape(N, C, -1)
+        vv = a.reshape(N, C, -1)
+        flat = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None], ii].set(vv)
+        return flat.reshape((N, C) + o)
+
+    return apply(name, fn, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """Reverse of max_pool1d(return_mask=True) (reference:
+    nn/functional/pooling.py max_unpool1d)."""
+    return _unpool("max_unpool1d", x, indices, kernel_size, stride,
+                   padding, output_size, 1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    return _unpool("max_unpool2d", x, indices, kernel_size, stride,
+                   padding, output_size, 2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    return _unpool("max_unpool3d", x, indices, kernel_size, stride,
+                   padding, output_size, 3, data_format)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Power-average pooling: (sum |x|^p)^(1/p) (reference: lp_pool1d)."""
+    from . import _pool_nd
+    pw = float(norm_type)
+    xt = as_tensor(x)
+
+    def fn(a):
+        return a ** pw
+    powed = apply("lp_pool_pow", fn, xt)
+    summed = _pool_nd("lp_pool1d", powed, kernel_size, stride, padding, 1,
+                      jax.lax.add, 0.0, ceil_mode=ceil_mode)
+    return apply("lp_pool_root", lambda a: a ** (1.0 / pw),
+                 as_tensor(summed))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from . import _pool_nd
+    pw = float(norm_type)
+    xt = as_tensor(x)
+    powed = apply("lp_pool_pow", lambda a: a ** pw, xt)
+    summed = _pool_nd("lp_pool2d", powed, kernel_size, stride, padding, 2,
+                      jax.lax.add, 0.0, ceil_mode=ceil_mode)
+    return apply("lp_pool_root", lambda a: a ** (1.0 / pw),
+                 as_tensor(summed))
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not supported")
+    from . import _adaptive_pool
+    return _adaptive_pool("adaptive_max_pool3d", x, output_size, 3,
+                          average=False)
+
+
+def _fractional_regions(in_len, out_len, key):
+    """Random monotone region boundaries for fractional pooling
+    (Graham 2014): cumulative steps of floor/ceil(alpha)."""
+    alpha = in_len / out_len
+    u = jax.random.uniform(key, ())
+    idx = jnp.floor(alpha * (jnp.arange(out_len + 1) + u)).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, in_len)
+    idx = idx.at[0].set(0)
+    idx = idx.at[-1].set(in_len)
+    return idx
+
+
+def _fractional_pool(x, output_size, nd, kernel_size=None, random_u=None,
+                     name=""):
+    x = as_tensor(x)
+    outs = _nt(output_size, nd)
+    ks = _nt(kernel_size, nd) if kernel_size is not None else None
+    key = framework_random.next_key()
+
+    def fn(a):
+        sp = a.shape[2:]
+        keys = jax.random.split(key, nd)
+        res = a
+        for d in range(nd):
+            out_d = outs[d]
+            if random_u is not None:
+                u = jnp.asarray(random_u)
+                bounds = jnp.clip(jnp.floor(
+                    (sp[d] / out_d) * (jnp.arange(out_d + 1) + u)
+                ).astype(jnp.int32), 0, sp[d])
+                bounds = bounds.at[0].set(0).at[-1].set(sp[d])
+            else:
+                bounds = _fractional_regions(sp[d], out_d, keys[d])
+            # window i covers [bounds[i], bounds[i+1]) — or, with an
+            # explicit kernel, the overlapping [bounds[i], bounds[i]+k)
+            ax = 2 + d
+            seg_max = []
+            # static python loop over output bins (out_d is static)
+            for i in range(out_d):
+                lo = bounds[i]
+                hi = jnp.minimum(lo + ks[d], sp[d]) if ks is not None \
+                    else bounds[i + 1]
+                pos = jnp.arange(sp[d])
+                m = (pos >= lo) & (pos < jnp.maximum(hi, lo + 1))
+                shape = [1] * res.ndim
+                shape[ax] = sp[d]
+                mb = m.reshape(shape)
+                seg = jnp.where(mb, res, -jnp.inf)
+                seg_max.append(jnp.max(seg, axis=ax, keepdims=True))
+            res = jnp.concatenate(seg_max, axis=ax)
+            sp = res.shape[2:]
+        return res
+
+    return apply(name or "fractional_max_pool", fn, x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (Graham 2014; reference:
+    nn/functional/pooling.py fractional_max_pool2d)."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not supported")
+    return _fractional_pool(x, output_size, 2, kernel_size, random_u,
+                            "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not supported")
+    return _fractional_pool(x, output_size, 3, kernel_size, random_u,
+                            "fractional_max_pool3d")
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y|/(|X|+|Y|) over the trailing class axis (reference:
+    nn/functional/loss.py dice_loss)."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def fn(x, t):
+        t = jax.nn.one_hot(t[..., 0], x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * t, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(t, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", fn, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def fn(x, t):
+        if log_input:
+            out = jnp.exp(x) - t * x
+        else:
+            out = x - t * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(t!)
+            stir = t * jnp.log(t + (t == 0)) - t + 0.5 * jnp.log(
+                2 * jnp.pi * jnp.maximum(t, 1.0))
+            out = out + jnp.where(t > 1, stir, 0.0)
+        return _reduce(out, reduction)
+
+    return apply("poisson_nll_loss", fn, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (Sohn 2016; reference: nn/functional/loss.py
+    npair_loss)."""
+    anchor, positive, labels = (as_tensor(anchor), as_tensor(positive),
+                                as_tensor(labels))
+
+    def fn(a, p, y):
+        y = y.reshape(-1).astype(jnp.float32)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        logits = a @ p.T
+        ce = -jnp.sum(same * jax.nn.log_softmax(logits, -1), axis=-1)
+        reg = jnp.mean(jnp.sum(a * a, -1) + jnp.sum(p * p, -1))
+        return jnp.mean(ce) + l2_reg * reg * 0.25
+
+    return apply("npair_loss", fn, anchor, positive, labels)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def fn(x, t, *w):
+        loss = -(t * jax.nn.log_sigmoid(x)
+                 + (1 - t) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    if weight is not None:
+        return apply("multi_label_soft_margin_loss", fn, input, label,
+                     as_tensor(weight))
+    return apply("multi_label_soft_margin_loss", fn, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def fn(x, t, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, t[:, None], -1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            m = m * jnp.take(w[0], t)[:, None]
+        m = m * (1 - jax.nn.one_hot(t, c, dtype=x.dtype))
+        return _reduce(jnp.sum(m, -1) / c, reduction)
+
+    if weight is not None:
+        return apply("multi_margin_loss", fn, input, label,
+                     as_tensor(weight))
+    return apply("multi_margin_loss", fn, input, label)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    input, positive, negative = (as_tensor(input), as_tensor(positive),
+                                 as_tensor(negative))
+    dist = distance_function or (
+        lambda a, b: jnp.sqrt(jnp.sum((a - b) ** 2, -1) + 1e-12))
+
+    def fn(a, p, n):
+        dp = dist(a, p)
+        dn = dist(a, n)
+        if swap:
+            dn = jnp.minimum(dn, dist(p, n))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply("triplet_margin_with_distance_loss", fn, input, positive,
+                 negative)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    input, label, variance = (as_tensor(input), as_tensor(label),
+                              as_tensor(variance))
+
+    def fn(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (t - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(out, reduction)
+
+    return apply("gaussian_nll_loss", fn, input, label, variance)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss, default complete-binary-tree coding
+    (reference: nn/functional/loss.py hsigmoid_loss).  TPU note: the
+    default tree has depth ceil(log2(C)); each sample's path is computed
+    densely — no sparse-row machinery needed at these sizes."""
+    input, label, weight = as_tensor(input), as_tensor(label), \
+        as_tensor(weight)
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported; "
+            "use the default complete binary tree")
+    depth = max(1, int(math.ceil(math.log2(max(2, num_classes)))))
+
+    def fn(x, t, w, *b):
+        # Huffman-free coding: internal node index for label l at level d
+        # follows the complete-tree bit path of l
+        t = t.reshape(-1)
+        codes = ((t[:, None] >> jnp.arange(depth)[None, :]) & 1).astype(
+            x.dtype)                                   # [N, depth]
+        node = jnp.zeros_like(t)
+        losses = []
+        for d in range(depth):
+            logits = jnp.sum(x * w[node], axis=-1)     # [N]
+            if b:
+                logits = logits + b[0][node].reshape(-1)
+            c = codes[:, d]
+            losses.append(-(c * jax.nn.log_sigmoid(logits)
+                            + (1 - c) * jax.nn.log_sigmoid(-logits)))
+            node = node * 2 + 1 + c.astype(t.dtype)
+            node = jnp.minimum(node, w.shape[0] - 1)
+        return jnp.mean(sum(losses))
+
+    if bias is not None:
+        return apply("hsigmoid_loss", fn, input, label, weight,
+                     as_tensor(bias))
+    return apply("hsigmoid_loss", fn, input, label, weight)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax (reference:
+    nn/functional/loss.py margin_cross_entropy — the single-card path)."""
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    def fn(x, t):
+        t = t.reshape(-1)
+        theta = jnp.arccos(jnp.clip(
+            jnp.take_along_axis(x, t[:, None], -1)[:, 0], -1 + 1e-7,
+            1 - 1e-7))
+        marked = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(t, x.shape[-1], dtype=x.dtype)
+        adjusted = x * (1 - onehot) + marked[:, None] * onehot
+        adjusted = adjusted * scale
+        logp = jax.nn.log_softmax(adjusted, -1)
+        loss = -jnp.take_along_axis(logp, t[:, None], -1)[:, 0]
+        red = _reduce(loss, reduction)
+        if return_softmax:
+            return red, jnp.exp(logp)
+        return red
+
+    if return_softmax:
+        return apply("margin_cross_entropy", fn, logits, label, n_outputs=2)
+    return apply("margin_cross_entropy", fn, logits, label)
+
+
+# ---------------------------------------------------------------------------
+# decoding helpers
+# ---------------------------------------------------------------------------
+def gather_tree(ids, parents):
+    """Beam-search backtrace: follow parent pointers from the last step
+    (reference: nn/functional gather_tree; shape [T, B, beam])."""
+    ids, parents = as_tensor(ids), as_tensor(parents)
+
+    def fn(i, p):
+        T = i.shape[0]
+
+        def step(carry, inp):
+            beams = carry                       # [B, beam] current beam ids
+            step_ids, step_parents = inp
+            vals = jnp.take_along_axis(step_ids, beams, axis=-1)
+            beams = jnp.take_along_axis(step_parents, beams, axis=-1)
+            return beams, vals
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2])[None, :],
+                                i.shape[1:])
+        _, out = jax.lax.scan(step, init, (i[::-1], p[::-1]))
+        return out[::-1]
+
+    return apply("gather_tree", fn, ids, parents)
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss via the standard forward DP over the (t, u)
+    lattice (reference: nn/functional/loss.py rnnt_loss; CUDA warp-rnnt in
+    the reference — here a lax.scan over time with a u-dimension vector
+    update, which XLA vectorizes)."""
+    logits, labels = as_tensor(logits), as_tensor(labels)
+    logit_lengths, label_lengths = (as_tensor(logit_lengths),
+                                    as_tensor(label_lengths))
+
+    def fn(x, y, tlen, ulen):
+        # x: [B, T, U+1, V] log-probs (normalized here), y: [B, U]
+        x = jax.nn.log_softmax(x, -1)
+        B, T, U1, V = x.shape
+        U = U1 - 1
+        blank_lp = x[..., blank]                        # [B, T, U+1]
+        y_exp = y[:, None, :].astype(jnp.int32)         # [B, 1, U]
+        lab_lp = jnp.take_along_axis(
+            x[:, :, :U, :], jnp.broadcast_to(
+                y_exp[..., None], (B, T, U, 1)), -1)[..., 0]  # [B, T, U]
+        NEG = -1e30
+
+        def step(alpha, t):
+            # alpha: [B, U+1] forward scores at time t
+            blank_t = blank_lp[:, t, :]
+            lab_t = lab_lp[:, t, :]
+
+            # emit transitions within the same t: alpha[u] from alpha[u-1]
+            def emit_fix(al):
+                def body(u, al):
+                    cand = al[:, u - 1] + lab_t[:, u - 1]
+                    return al.at[:, u].set(jnp.logaddexp(al[:, u], cand))
+                return jax.lax.fori_loop(1, U + 1, body, al)
+
+            # time transition: alpha_new[u] = alpha[u] + blank[t-1, u]
+            is_first = t == 0
+            shifted = jnp.where(is_first,
+                                jnp.where(jnp.arange(U + 1)[None] == 0,
+                                          0.0, NEG),
+                                alpha + blank_lp[:, jnp.maximum(t - 1, 0), :])
+            new = emit_fix(shifted)
+            return new, new
+
+        alpha0 = jnp.full((B, U + 1), NEG)
+        _, alphas = jax.lax.scan(step, alpha0, jnp.arange(T))
+        # total log-prob: alpha[tlen-1, ulen] + blank at (tlen-1, ulen)
+        t_idx = (tlen - 1).astype(jnp.int32)
+        u_idx = ulen.astype(jnp.int32)
+        batch = jnp.arange(B)
+        final = alphas[t_idx, batch, u_idx] + blank_lp[batch, t_idx, u_idx]
+        loss = -final
+        return _reduce(loss, reduction)
+
+    return apply("rnnt_loss", fn, logits, labels, logit_lengths,
+                 label_lengths)
+
+
+# ---------------------------------------------------------------------------
+# attention variants + misc extension ops
+# ---------------------------------------------------------------------------
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM channel shift across segments (reference:
+    nn/functional/extension.py:228): the first shift_ratio of channels
+    reads from t-1, the second from t+1, the rest stay."""
+    x = as_tensor(x)
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = a.transpose(0, 3, 1, 2)
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, :c1]), v[:, :-1, :c1]], axis=1)
+        bwd = jnp.concatenate(
+            [v[:, 1:, c1:c2], jnp.zeros_like(v[:, :1, c1:c2])], axis=1)
+        out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = out.transpose(0, 2, 3, 1)
+        return out
+
+    return apply("temporal_shift", fn, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (reference:
+    nn/functional/common.py:2103): keep every positive class center, fill
+    up to num_samples with random negatives, remap labels into the
+    sampled index space.  When the batch has more unique positives than
+    num_samples, ALL positives are kept and the output grows (reference
+    semantics) — the op is host-side bookkeeping with no gradient, so the
+    data-dependent size is computed in numpy, not traced."""
+    from ...tensor.tensor import wrap_array, Tensor
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor)
+                     else label).reshape(-1)
+    key = framework_random.next_key()
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.RandomState(seed)
+    positives = np.unique(lab)
+    n_neg = max(0, num_samples - len(positives))
+    negatives = np.setdiff1d(np.arange(num_classes), positives)
+    if n_neg:
+        negatives = rng.choice(negatives, size=min(n_neg, len(negatives)),
+                               replace=False)
+        sampled = np.sort(np.concatenate([positives, negatives]))
+    else:
+        sampled = positives
+    inv = np.full(num_classes, -1, lab.dtype)
+    inv[sampled] = np.arange(len(sampled), dtype=lab.dtype)
+    return (wrap_array(jnp.asarray(inv[lab])),
+            wrap_array(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR-described layout (reference:
+    nn/functional/sparse_attention.py, CUDA-only there).  TPU realization:
+    the CSR pattern becomes a dense boolean mask — XLA fuses the masked
+    softmax; truly-sparse long-context paths should use the ring /
+    blockwise attention in distributed.parallel instead."""
+    query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
+    offs, cols = as_tensor(sparse_csr_offset), as_tensor(sparse_csr_columns)
+
+    def fn(q, k, v, off, col, *masks):
+        B, H, S, D = q.shape
+        nnz = col.shape[-1]
+
+        def one_allow(off1, col1):
+            rows = jnp.repeat(jnp.arange(S), jnp.diff(off1),
+                              total_repeat_length=nnz)
+            # entries past off1[-1] are padding (heads may have fewer
+            # nonzeros than the array length) — route them out of bounds
+            valid = jnp.arange(nnz) < off1[-1]
+            rows = jnp.where(valid, rows, S)
+            return jnp.zeros((S, S), bool).at[rows, col1].set(
+                True, mode="drop")
+
+        allow = jax.vmap(jax.vmap(one_allow))(
+            off.reshape(B, H, -1), col.reshape(B, H, -1))  # [B,H,S,S]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+        s = jnp.where(allow, s, -1e30)
+        i = 0
+        if key_padding_mask is not None:
+            s = jnp.where(masks[i][:, None, None, :] > 0, s, -1e30)
+            i += 1
+        if attn_mask is not None:
+            s = s + masks[i]
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(allow, p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    extra = []
+    if key_padding_mask is not None:
+        extra.append(as_tensor(key_padding_mask))
+    if attn_mask is not None:
+        extra.append(as_tensor(attn_mask))
+    return apply("sparse_attention", fn, query, key, value, offs, cols,
+                 *extra)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Functional clustered softmax (reference: nn/functional/loss.py
+    adaptive_log_softmax_with_loss); tail_weights is a list of
+    [proj, out] weight pairs matching the layer's parameters."""
+    input, label = as_tensor(input), as_tensor(label)
+    shortlist = cutoffs[0]
+    parts_w = [w for pair in tail_weights for w in pair]
+    n_clusters = len(tail_weights)
+
+    def fn(x, t, hw, *rest):
+        i = 0
+        hb = None
+        if head_bias is not None:
+            hb = rest[0]
+            rest = rest[1:]
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, -1)
+        pieces = [head_lp[..., :shortlist]]
+        for c in range(n_clusters):
+            proj_w, out_w = rest[2 * c], rest[2 * c + 1]
+            tail_lp = jax.nn.log_softmax((x @ proj_w) @ out_w, -1)
+            pieces.append(tail_lp + head_lp[..., shortlist + c][..., None])
+        logp = jnp.concatenate(pieces, axis=-1)
+        out = jnp.take_along_axis(logp, t[:, None], -1)[:, 0]
+        return out, -out.mean()
+
+    args = [input, label, as_tensor(head_weight)]
+    if head_bias is not None:
+        args.append(as_tensor(head_bias))
+    args.extend(as_tensor(w) for w in parts_w)
+    return apply("adaptive_log_softmax_with_loss", fn, *args, n_outputs=2)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, name=None):
+    """Packed-QKV flash attention: qkv [B, S, 3, H, D] (reference:
+    nn/functional/flash_attention.py flash_attn_qkvpacked)."""
+    from . import scaled_dot_product_attention
+    qkv = as_tensor(qkv)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                       dropout_p=dropout)
+    if return_softmax:
+        return out, None
+    return out
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Variable-length packed flash attention over concatenated sequences
+    (reference: flash_attn_unpadded / flash_attn_varlen_qkvpacked).  The
+    ragged batch is processed per sequence via the dense kernel — correct
+    and simple; the padded+masked route is preferable for TPU batching."""
+    from . import scaled_dot_product_attention
+    qkv = as_tensor(qkv)
+    cu = np.asarray(as_tensor(cu_seqlens_q).numpy()).astype(np.int64)
+    outs = []
+    for i in range(len(cu) - 1):
+        seg = qkv[int(cu[i]):int(cu[i + 1])]
+        q, k, v = seg[:, 0][None], seg[:, 1][None], seg[:, 2][None]
+        outs.append(scaled_dot_product_attention(
+            q, k, v, is_causal=causal, dropout_p=dropout)[0])
+    from ...tensor.manipulation import concat
+    return (concat(outs, axis=0), None) if return_softmax \
+        else concat(outs, axis=0)
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices=None,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, name=None):
+    """Flash attention whose mask is given as per-row start indices
+    (reference: flash_attention_with_sparse_mask): row i may attend keys
+    j >= start_row_indices[..., i]... combined with causal."""
+    from . import scaled_dot_product_attention
+    query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
+    if attn_mask_start_row_indices is None:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=is_causal,
+                                            dropout_p=dropout_p)
+    starts = as_tensor(attn_mask_start_row_indices)
+
+    def fn(q, k, v, st):
+        B, S, H, D = q.shape
+        if st.ndim == 4:        # [B, H, 1, S] -> [B, H, S]
+            st = st[:, :, 0, :]
+        kpos = jnp.arange(S)
+        qpos = jnp.arange(S)[:, None]
+        # reference builds mask[start_row:, col] = -inf: key j is visible
+        # only to queries i < st[..., j]
+        allow = qpos[None, None] < st[:, :, None, :]
+        if is_causal:
+            allow = allow & (qpos[None, None] >= kpos[None, None, None, :])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+        s = jnp.where(allow, s, -1e30)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+    return apply("flash_attention_with_sparse_mask", fn, query, key, value,
+                 starts)

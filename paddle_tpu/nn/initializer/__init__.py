@@ -16,7 +16,8 @@ from ...tensor.tensor import Tensor
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "Assign", "Orthogonal", "Dirac", "Bilinear", "calculate_gain",
+    "set_global_initializer",
 ]
 
 
@@ -189,3 +190,39 @@ def _apply_initializer(init, shape, dtype, is_bias=False):
     if callable(init):
         return init(shape, dtype)
     raise TypeError(f"bad initializer {init!r}")
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernels for transposed-conv upsampling
+    (reference: nn/initializer/Bilinear — each [kh, kw] slice is the
+    tent-filter weight grid)."""
+
+    def __call__(self, shape, dtype):
+        jdt = dtypes.to_jax_dtype(dtype)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer requires a 4-D shape")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy = 1 - jnp.abs(jnp.arange(kh) / fh - ch)
+        xx = 1 - jnp.abs(jnp.arange(kw) / fw - cw)
+        kern = (yy[:, None] * xx[None, :]).astype(jdt)
+        return jnp.broadcast_to(kern, tuple(shape))
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Install default initializers for parameters created afterwards
+    (reference: nn/initializer/set_global_initializer).  Pass None, None
+    to restore the framework defaults."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init

@@ -74,7 +74,10 @@ class Layer:
         if attr is None:
             return None
         dtype = dtype or self._dtype
-        init = default_initializer or attr.initializer
+        # priority: user ParamAttr initializer > set_global_initializer >
+        # the layer's own default > framework default
+        init = attr.initializer or I._global_initializer(is_bias) \
+            or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         data = init(shape, dtype)

@@ -1,0 +1,326 @@
+"""Long-tail nn functionals + layers (reference: python/paddle/nn/ — the
+pooling/loss/container/decoding surface added for API completeness).
+Torch is the independent oracle where it implements the same op."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLossParityVsTorch:
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 6).astype("float32")
+    y = rs.randn(4, 6).astype("float32")
+
+    def test_pairwise_distance(self):
+        got = F.pairwise_distance(_t(self.x), _t(self.y)).numpy()
+        ref = torch.nn.functional.pairwise_distance(
+            torch.tensor(self.x), torch.tensor(self.y)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_multi_margin(self):
+        t = self.rs.randint(0, 6, 4)
+        got = F.multi_margin_loss(_t(self.x), _t(t)).numpy()
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(self.x), torch.tensor(t)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_gaussian_nll(self):
+        var = np.abs(self.rs.randn(4, 6)).astype("float32") + 0.1
+        got = F.gaussian_nll_loss(_t(self.x), _t(self.y), _t(var)).numpy()
+        ref = torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(self.x), torch.tensor(self.y),
+            torch.tensor(var)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_poisson_nll(self):
+        got = F.poisson_nll_loss(_t(self.x), _t(np.abs(self.y))).numpy()
+        ref = torch.nn.functional.poisson_nll_loss(
+            torch.tensor(self.x), torch.tensor(np.abs(self.y))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_multilabel_soft_margin(self):
+        lab = (self.rs.rand(4, 6) > 0.5).astype("float32")
+        got = F.multi_label_soft_margin_loss(_t(self.x), _t(lab)).numpy()
+        ref = torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(self.x), torch.tensor(lab)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_triplet_with_distance(self):
+        a, p, n = (self.rs.randn(4, 6).astype("float32") for _ in range(3))
+        got = F.triplet_margin_with_distance_loss(_t(a), _t(p), _t(n)).numpy()
+        ref = torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+class TestPoolingVariants:
+    def test_max_pool_with_index_vs_torch(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+        out, mask = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+        tout, tmask = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, stride=2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(mask.numpy(), tmask.numpy())
+
+    def test_unpool_round_trip_vs_torch(self):
+        x = np.random.RandomState(1).randn(2, 3, 8, 8).astype("float32")
+        out, mask = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+        rec = F.max_unpool2d(out, mask, 2, stride=2)
+        tout, tmask = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, stride=2, return_indices=True)
+        tref = torch.nn.functional.max_unpool2d(tout, tmask, 2, stride=2)
+        np.testing.assert_allclose(rec.numpy(), tref.numpy(), rtol=1e-6)
+
+    def test_lp_pool_vs_torch(self):
+        x = np.abs(np.random.RandomState(2).randn(1, 2, 8, 8)).astype(
+            "float32")
+        got = F.lp_pool2d(_t(x), 2, 2).numpy()
+        ref = torch.nn.functional.lp_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_fractional_pool_shape_and_values(self):
+        x = np.random.RandomState(3).randn(1, 2, 9, 9).astype("float32")
+        out = F.fractional_max_pool2d(_t(x), 4, random_u=0.3)
+        assert tuple(out.shape) == (1, 2, 4, 4)
+        # every output must be an element of the input (max of a region)
+        assert np.isin(out.numpy(), x).all()
+
+
+class TestRNNT:
+    def test_matches_brute_force(self):
+        import itertools
+        import jax.nn as jnn
+        import jax.numpy as jnp
+        rs = np.random.RandomState(0)
+        B, T, U, V = 1, 3, 2, 4
+        logits = rs.randn(B, T, U + 1, V).astype("float32")
+        labels = rs.randint(1, V, (B, U))
+        got = float(np.asarray(F.rnnt_loss(
+            _t(logits), _t(labels), _t(np.array([T])), _t(np.array([U])),
+            blank=0, reduction="none").numpy()).reshape(-1)[0])
+        lp = np.asarray(jnn.log_softmax(jnp.asarray(logits), -1))[0]
+        total = -np.inf
+        for ts in itertools.product(range(T), repeat=U):
+            if any(ts[i] > ts[i + 1] for i in range(U - 1)):
+                continue
+            s, u = 0.0, 0
+            for t in range(T):
+                while u < U and ts[u] == t:
+                    s += lp[t, u, labels[0, u]]
+                    u += 1
+                s += lp[t, u, 0]
+            total = np.logaddexp(total, s)
+        assert abs(got - (-total)) < 1e-3
+
+
+class TestLayersAndDecoding:
+    def test_layer_dict(self):
+        ld = nn.LayerDict({"a": nn.Linear(4, 4)})
+        ld["b"] = nn.Linear(4, 2)
+        assert set(ld.keys()) == {"a", "b"} and len(ld) == 2
+        assert "a" in ld
+        popped = ld.pop("a")
+        assert isinstance(popped, nn.Linear) and len(ld) == 1
+        # params of contained layers are visible
+        ld2 = nn.LayerDict({"x": nn.Linear(2, 2)})
+        assert len(list(ld2.parameters())) == 2
+
+    def test_adaptive_log_softmax_normalizes(self):
+        als = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [4, 10])
+        inp = _t(np.random.RandomState(1).randn(6, 16).astype("float32"))
+        lab = _t(np.random.RandomState(2).randint(0, 20, 6))
+        out, loss = als(inp, lab)
+        np.testing.assert_allclose(np.exp(als.log_prob(inp).numpy()).sum(-1),
+                                   np.ones(6), rtol=1e-4)
+        assert float(loss) > 0
+        pred = als.predict(inp)
+        assert pred.shape[0] == 6
+
+    def test_adaptive_log_softmax_bad_cutoffs(self):
+        with pytest.raises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(8, 10, [5, 3])
+
+    def test_beam_search_decode(self):
+        paddle.seed(0)
+        V, H, B = 12, 16, 2
+        cell = nn.GRUCell(H, H)
+        emb = nn.Embedding(V, H)
+        proj = nn.Linear(H, V)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        h0 = _t(np.random.RandomState(0).randn(B, H).astype("float32"))
+        ids, logp = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        assert ids.shape[0] == B and ids.shape[1] == 3
+        assert (np.diff(logp.numpy(), axis=1) <= 1e-5).all()
+
+    def test_gather_tree(self):
+        # T=3, B=1, beam=2; parents chain the beams
+        ids = _t(np.array([[[1, 2]], [[3, 4]], [[5, 6]]]))
+        parents = _t(np.array([[[0, 0]], [[1, 0]], [[0, 1]]]))
+        out = F.gather_tree(ids, parents).numpy()
+        # beam 0's final token 5 has parent 0 at t=2 -> token 3 at t=1,
+        # whose parent is beam 1 -> token 2 at t=0
+        assert out.shape == (3, 1, 2)
+        np.testing.assert_allclose(out[:, 0, 0], [2, 3, 5])
+        # beam 1: 6 <- parent 1 -> 4 <- parent 0 -> 1
+        np.testing.assert_allclose(out[:, 0, 1], [1, 4, 6])
+
+    def test_inplace_activation_variants(self):
+        x = _t(np.array([-2.0, 0.5, 3.0], np.float32))
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh([-2.0, 0.5, 3.0]),
+                                   rtol=1e-6)
+
+    def test_hsigmoid_loss_runs_and_trains(self):
+        paddle.seed(1)
+        layer = nn.HSigmoidLoss(8, 6)
+        x = _t(np.random.RandomState(0).randn(4, 8).astype("float32"))
+        x.stop_gradient = False
+        lab = _t(np.random.RandomState(1).randint(0, 6, (4, 1)))
+        loss = layer(x, lab)
+        loss.backward()
+        assert float(loss) > 0 and np.isfinite(x.grad.numpy()).all()
+
+
+class TestAttentionVariantsAndMisc:
+    rs = np.random.RandomState(0)
+
+    def test_temporal_shift(self):
+        x = self.rs.randn(4, 8, 2, 2).astype("float32")  # N=2 x T=2
+        out = F.temporal_shift(_t(x), seg_num=2).numpy().reshape(
+            2, 2, 8, 2, 2)
+        v = x.reshape(2, 2, 8, 2, 2)
+        assert np.allclose(out[:, 0, :2], 0)          # t=0 fwd zero-fill
+        assert np.allclose(out[:, 1, :2], v[:, 0, :2])
+        assert np.allclose(out[:, 0, 2:4], v[:, 1, 2:4])  # bwd shift
+        assert np.allclose(out[:, :, 4:], v[:, :, 4:])    # rest untouched
+
+    def test_class_center_sample(self):
+        lab = _t(np.array([1, 5, 5, 9]))
+        remapped, sampled = F.class_center_sample(lab, 20, 6)
+        sa, rm = sampled.numpy(), remapped.numpy()
+        assert {1, 5, 9}.issubset(set(sa.tolist()))
+        assert len(sa) == 6
+        for i, l in enumerate([1, 5, 5, 9]):
+            assert sa[rm[i]] == l
+
+    def test_sparse_attention_dense_parity(self):
+        B, H, S, D = 1, 2, 4, 8
+        q, k, v = (_t(self.rs.randn(B, H, S, D).astype("float32"))
+                   for _ in range(3))
+        off = _t(np.tile(np.arange(0, (S + 1) * S, S).reshape(1, 1, -1),
+                         (B, H, 1)))
+        cols = _t(np.tile(np.tile(np.arange(S), S).reshape(1, 1, -1),
+                          (B, H, 1)))
+        out = F.sparse_attention(q, k, v, off, cols).numpy()
+        s = np.einsum("bhqd,bhkd->bhqk", q.numpy(), k.numpy()) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v.numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_qkvpacked_and_varlen(self):
+        qkv = _t(self.rs.randn(2, 16, 3, 4, 8).astype("float32"))
+        out = F.flash_attn_qkvpacked(qkv, causal=True)
+        assert tuple(out.shape) == (2, 16, 4, 8)
+        flat = _t(self.rs.randn(24, 3, 4, 8).astype("float32"))
+        cu = _t(np.array([0, 10, 24]))
+        ov = F.flash_attn_varlen_qkvpacked(flat, cu, cu, 14, 14)
+        assert tuple(ov.shape) == (24, 4, 8)
+        # each segment equals the dense call on that segment alone
+        seg = F.flash_attn_qkvpacked(flat[0:10].unsqueeze(0))
+        np.testing.assert_allclose(ov.numpy()[:10], seg.numpy()[0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_adaptive_log_softmax_functional_matches_layer(self):
+        als = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [4, 10])
+        inp = _t(self.rs.randn(6, 16).astype("float32"))
+        lab = _t(self.rs.randint(0, 20, 6))
+        o1, l1 = als(inp, lab)
+        tw = [(p.weight, o.weight) for p, o in als.tail]
+        o2, l2 = F.adaptive_log_softmax_with_loss(
+            inp, lab, als.head.weight, tw, als.cutoffs[:-1])
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-5)
+
+
+class TestReviewRegressions:
+    """Regressions for the review findings on the long-tail surface."""
+
+    def test_class_center_sample_keeps_all_positives(self):
+        lab = _t(np.array([0, 1, 2, 3, 4, 5, 6]))
+        remapped, sampled = F.class_center_sample(lab, 20, 6)
+        assert len(sampled.numpy()) == 7          # positives > num_samples
+        assert (remapped.numpy() >= 0).all()
+
+    def test_sparse_mask_reference_semantics(self):
+        # key j is visible only to queries i < start[j]
+        B, S, H, D = 1, 4, 1, 8
+        rs = np.random.RandomState(0)
+        q, k, v = (_t(rs.randn(B, S, H, D).astype("float32"))
+                   for _ in range(3))
+        st = _t(np.array([[[4, 4, 2, 1]]]))
+        out = F.flash_attention_with_sparse_mask(q, k, v, st, is_causal=True)
+        s = np.einsum("bqhd,bkhd->bhqk", q.numpy(), k.numpy()) / np.sqrt(D)
+        mask = np.tril(np.ones((4, 4), bool)) & (
+            np.arange(4)[:, None] < np.array([4, 4, 2, 1])[None, :])
+        s = np.where(mask[None, None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, v.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_padded_unpool_with_output_size_vs_torch(self):
+        x = np.random.RandomState(1).randn(1, 1, 8, 8).astype("float32")
+        o, m = F.max_pool2d(_t(x), 3, stride=2, padding=1, return_mask=True)
+        to_, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 3, stride=2, padding=1, return_indices=True)
+        np.testing.assert_allclose(m.numpy(), tm.numpy())
+        rec = F.max_unpool2d(o, m, 3, stride=2, padding=1,
+                             output_size=(8, 8))
+        tref = torch.nn.functional.max_unpool2d(
+            to_, tm, 3, stride=2, padding=1, output_size=(8, 8))
+        np.testing.assert_allclose(rec.numpy(), tref.numpy(), rtol=1e-5)
+
+    def test_sparse_attention_per_head_patterns(self):
+        # head 0: full attention; head 1: diagonal only — outputs differ
+        B, H, S, D = 1, 2, 4, 8
+        rs = np.random.RandomState(2)
+        q, k, v = (_t(rs.randn(B, H, S, D).astype("float32"))
+                   for _ in range(3))
+        off = np.zeros((B, H, S + 1), np.int64)
+        off[0, 0] = np.arange(0, (S + 1) * S, S)          # 4 cols per row
+        off[0, 1] = np.arange(S + 1)                      # 1 col per row
+        cols = np.zeros((B, H, S * S), np.int64)
+        cols[0, 0] = np.tile(np.arange(S), S)
+        cols[0, 1, :S] = np.arange(S)                     # diagonal
+        out = F.sparse_attention(_t(q.numpy()), _t(k.numpy()), _t(v.numpy()),
+                                 _t(off), _t(cols)).numpy()
+        # diagonal-only head returns v rows unchanged
+        np.testing.assert_allclose(out[0, 1], v.numpy()[0, 1], rtol=1e-4)
+        assert not np.allclose(out[0, 0], v.numpy()[0, 0])
+
+    def test_fractional_kernel_size_overlapping(self):
+        x = np.random.RandomState(3).randn(1, 1, 9, 9).astype("float32")
+        a = F.fractional_max_pool2d(_t(x), 4, random_u=0.4)
+        b = F.fractional_max_pool2d(_t(x), 4, kernel_size=5, random_u=0.4)
+        assert a.shape == b.shape
+        # wider overlapping windows can only increase the max
+        assert (b.numpy() >= a.numpy() - 1e-6).all()
+
+    def test_return_mask_unsupported_raises(self):
+        x = _t(np.zeros((1, 1, 4, 4, 4), np.float32))
+        with pytest.raises(NotImplementedError):
+            F.adaptive_max_pool3d(x, 2, return_mask=True)
+        with pytest.raises(NotImplementedError):
+            F.fractional_max_pool2d(_t(np.zeros((1, 1, 4, 4), np.float32)),
+                                    2, return_mask=True)
