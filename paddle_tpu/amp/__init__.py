@@ -23,6 +23,7 @@ from ..framework import dtype as dtypes
 from ..ops import dispatch as _dispatch
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "is_float16_supported", "is_bfloat16_supported",
            "AmpScaler", "white_list", "black_list", "is_auto_cast_enabled",
            "get_amp_dtype", "debugging"]
 
@@ -293,3 +294,15 @@ class GradScaler:
 AmpScaler = GradScaler
 
 from . import debugging  # noqa: E402,F401
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support (reference: amp/auto_cast.py).  TPU MXUs are
+    bf16-native; fp16 works through XLA but without native rate benefit."""
+    import jax
+    return jax.devices()[0].platform in ("tpu", "axon", "gpu")
+
+
+def is_bfloat16_supported(device=None):
+    import jax
+    return True  # bf16 is the native TPU compute dtype; CPU XLA supports it

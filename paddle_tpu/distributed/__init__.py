@@ -28,3 +28,76 @@ from . import rpc  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401
 from . import auto_tuner  # noqa: F401
+
+# -- reference-parity re-exports and long-tail API -------------------------
+from .communication import (  # noqa: F401
+    all_to_all as alltoall, all_to_all_single as alltoall_single)
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    shard_optimizer, to_static, Strategy, DistAttr, DistModel,
+    ReduceType, ShardingStage1, ShardingStage2, ShardingStage3,
+    shard_scaler, shard_dataloader, unshard_dtensor)
+from .fleet.base.topology import ParallelMode  # noqa: F401
+from . import io  # noqa: F401
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry, ShowClickEntry, ProbabilityEntry)
+from .ps_dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+
+def get_backend():
+    """Name of the communication backend (reference: parallel.py
+    get_backend — NCCL/GLOO/XCCL).  Collectives here are XLA programs
+    over the device mesh."""
+    import jax as _jax
+    return "XLA:" + _jax.devices()[0].platform.upper()
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-only rendezvous (reference: parallel.py gloo_init_parallel_env,
+    backed by gloo).  Here the TCP KV store provides the barrier
+    namespace; collectives on CPU run through the same XLA path."""
+    from .env import init_parallel_env
+    import os
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+    barrier()
+
+
+def gloo_release():
+    """Release the CPU rendezvous resources (no persistent gloo context
+    exists here; the KV store is closed by its owner)."""
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style sharded linear/embedding op (reference:
+    collective.py split): builds the column/row-parallel layer over the
+    'mp' mesh axis and applies it.  Prefer the mpu layers directly for
+    model code; this mirrors the one-shot functional API."""
+    from .fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, hidden = size
+        layer = VocabParallelEmbedding(vocab, hidden,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

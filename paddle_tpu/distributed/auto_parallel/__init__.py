@@ -314,13 +314,156 @@ class DistAttr:
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    """Reference: api.py:2345 — returns a DistModel-style wrapper; on TPU
-    the dynamic SPMD path is already static-quality (jit), so this wraps
-    jit around the layer."""
-    from ...jit import to_static as jit_to_static
-    return jit_to_static(layer)
+    """Reference: api.py:2345 — returns a DistModel; on TPU the dynamic
+    SPMD path is already static-quality (jit), so the DistModel drives the
+    layer directly (train/eval/predict modes honoring loss/optimizer).
+    Without a loss the plain jit wrapper is returned."""
+    if loss is None and optimizer is None:
+        from ...jit import to_static as jit_to_static
+        return jit_to_static(layer)
+    return DistModel(layer, loss=loss, optimizer=optimizer)
 
 
 # static auto-parallel engine (reference static/engine.py — D14)
 from .static_engine import (  # noqa: F401,E402
     Cluster, CostEstimator, Engine, complete_jaxpr)
+
+
+class ReduceType:
+    """Partial-state reduction kinds (reference:
+    phi/core/distributed/auto_parallel/placement_types.h ReduceType)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class ShardingStage1:
+    """ZeRO-1 marker for shard_optimizer's shard_fn (reference:
+    auto_parallel/api.py ShardingStage1): optimizer states sharded over
+    the given mesh axis."""
+
+    stage = 1
+
+    def __init__(self, axis_or_mesh_dim="dp", mesh=None):
+        self.mesh_dim = axis_or_mesh_dim
+        self.mesh = mesh
+
+    def __call__(self, key, param, accumulator):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return accumulator  # placement is applied by shard_optimizer
+
+
+class ShardingStage2(ShardingStage1):
+    """ZeRO-2: states + grads sharded (grad sharding is a placement
+    policy the train step honors)."""
+    stage = 2
+
+
+class ShardingStage3(ShardingStage1):
+    """ZeRO-3: parameters sharded too."""
+    stage = 3
+
+
+def shard_scaler(scaler):
+    """Make an amp GradScaler's found-inf reduction span the mesh
+    (reference: auto_parallel/api.py shard_scaler).  GSPMD already reduces
+    the found-inf flag globally because it is computed from sharded grads,
+    so the scaler is returned as-is."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset=False):
+    """Wrap a DataLoader so each batch is placed on the mesh, sharded
+    along the batch dim (reference: auto_parallel/api.py
+    shard_dataloader)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    dim_names = list(getattr(mesh, "dim_names", []) or [])
+    dim = shard_dims if isinstance(shard_dims, str) else (
+        dim_names[0] if dim_names else None)
+    # placements are per MESH dim: Shard(0) must sit at the index of the
+    # requested axis, Replicate elsewhere
+    if dim is not None and dim in dim_names:
+        placements = [Shard(0) if n == dim else Replicate()
+                      for n in dim_names]
+    else:
+        placements = [Replicate() for _ in dim_names] or [Replicate()]
+
+    def _place(it):
+        if isinstance(it, dict):
+            return {k: _place(v) for k, v in it.items()}
+        if isinstance(it, (list, tuple)):
+            return type(it)(_place(v) for v in it)
+        return shard_tensor(it, mesh, placements)
+
+    class _ShardedLoader:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __len__(self):
+            return len(self._dl)
+
+        def __iter__(self):
+            for batch in self._dl:
+                yield _place(batch)
+
+    return _ShardedLoader(dataloader)
+
+
+class DistModel:
+    """Static-graph dist wrapper returned by to_static (reference:
+    auto_parallel/api.py DistModel): callable train/eval/predict modes
+    over a jitted layer."""
+
+    def __init__(self, layer, loss=None, optimizer=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "predict" or self._loss is None:
+            return self.network(*args)
+        *inputs, label = args
+        out = self.network(*inputs)
+        loss = self._loss(out, label)
+        if self._mode == "train" and self._optimizer is not None:
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return loss
+
+    def dist_main_program(self, mode=None):
+        return None
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a sharded tensor into a fully-replicated one (reference:
+    auto_parallel/api.py unshard_dtensor)."""
+    from ...tensor.tensor import wrap_array
+    arr = dist_tensor._data if hasattr(dist_tensor, "_data") else dist_tensor
+    return wrap_array(jax.numpy.asarray(jax.device_get(arr)))
+
+
+__all__ += ["ReduceType", "ShardingStage1", "ShardingStage2",
+            "ShardingStage3", "shard_scaler", "shard_dataloader",
+            "DistModel", "unshard_dtensor"]

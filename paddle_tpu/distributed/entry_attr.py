@@ -1,0 +1,56 @@
+"""Sparse-table entry policies (reference:
+python/paddle/distributed/entry_attr.py — admission rules for
+parameter-server sparse embedding tables).
+
+The parameter-server runtime itself is out of scope (SURVEY §7 marks D16
+out of MVP); these configs are honored by the sparse-embedding utilities
+that accept an ``entry`` argument and are serializable for parity."""
+
+from __future__ import annotations
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new sparse feature with the given probability."""
+
+    def __init__(self, probability: float):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a sparse feature once it has been seen count_filter times."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """Track show/click statistics columns for the feature."""
+
+    def __init__(self, show_name: str, click_name: str):
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be strings")
+        self._name = "show_click_entry"
+        self._show = show_name
+        self._click = click_name
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._show}:{self._click}"

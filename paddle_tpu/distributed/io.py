@@ -1,0 +1,50 @@
+"""Distributed IO helpers (reference: python/paddle/distributed/io.py —
+save/load persistables for inference and training on distributed
+programs)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable", "save_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    """Parameters and buffers persist; activations do not."""
+    from ..framework.param import Parameter
+    return isinstance(var, Parameter) or getattr(var, "persistable", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a program's (here: a Layer's) persistable state per rank
+    (reference: distributed/io.py save_persistables)."""
+    from ..framework.io import save
+    from .env import get_rank
+    layer = main_program
+    if layer is None or not hasattr(layer, "state_dict"):
+        raise ValueError(
+            "pass the Layer whose state should persist as main_program=")
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or f"rank{get_rank()}.pdparams")
+    save(layer.state_dict(), path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import load
+    from .env import get_rank
+    layer = main_program
+    if layer is None or not hasattr(layer, "set_state_dict"):
+        raise ValueError(
+            "pass the Layer to restore as main_program=")
+    path = os.path.join(dirname, filename or f"rank{get_rank()}.pdparams")
+    layer.set_state_dict(load(path))
+    return layer
+
+
+def save_inference_model_distributed(path_prefix, feed_vars, fetch_vars,
+                                     executor, **kwargs):
+    from ..static import save_inference_model
+    return save_inference_model(path_prefix, feed_vars, fetch_vars,
+                                executor, **kwargs)

@@ -1,0 +1,139 @@
+"""Dataset runtimes for file-based training (reference:
+python/paddle/distributed/fleet/dataset/dataset.py InMemoryDataset /
+QueueDataset — the C++ data-feed backed loaders of the PS stack).
+
+TPU-native scope: the PS trainer loop is out of MVP (SURVEY §7/D16), but
+the dataset API is used stand-alone, so both classes are real here:
+line-oriented files parsed by a user pipe/command or a slot schema,
+shuffled (InMemory) or streamed (Queue), batched to numpy."""
+
+from __future__ import annotations
+
+import os
+import random as _random
+import subprocess
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._pipe_command: Optional[str] = None
+        self._use_var: Sequence = ()
+        self._parse_fn: Optional[Callable[[str], Sequence] ] = None
+
+    def init(self, batch_size=1, thread_num=1, pipe_command=None,
+             use_var=(), parse_fn=None, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._pipe_command = pipe_command
+        self._use_var = use_var
+        self._parse_fn = parse_fn
+
+    def set_filelist(self, filelist: Sequence[str]):
+        missing = [f for f in filelist if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files not found: {missing}")
+        self._filelist = list(filelist)
+
+    def get_filelist(self):
+        return list(self._filelist)
+
+    # -- record pipeline ---------------------------------------------------
+    def _iter_lines(self, path):
+        if self._pipe_command:
+            with open(path, "rb") as fin:
+                proc = subprocess.Popen(
+                    self._pipe_command, shell=True, stdin=fin,
+                    stdout=subprocess.PIPE, text=True)
+                try:
+                    yield from proc.stdout
+                finally:
+                    proc.stdout.close()
+                    rc = proc.wait()
+                    if rc != 0:
+                        raise RuntimeError(
+                            f"pipe_command {self._pipe_command!r} failed "
+                            f"with rc={rc} on {path}")
+        else:
+            with open(path) as f:
+                yield from f
+
+    def _parse(self, line: str):
+        if self._parse_fn is not None:
+            return self._parse_fn(line)
+        return np.fromstring(line, dtype=np.float32, sep=" ") \
+            if hasattr(np, "fromstring") else \
+            np.array(line.split(), np.float32)
+
+    def _batches(self, records):
+        buf = []
+        for r in records:
+            buf.append(r)
+            if len(buf) == self._batch_size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+
+class InMemoryDataset(_DatasetBase):
+    """Load every record into host memory; supports global shuffle
+    (reference dataset.py InMemoryDataset — load_into_memory,
+    global_shuffle, release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._filelist:
+            for line in self._iter_lines(path):
+                line = line.rstrip("\n")
+                if line:
+                    self._records.append(self._parse(line))
+        self._loaded = True
+
+    def local_shuffle(self, seed=0):
+        _random.Random(seed).shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=0):
+        # single-controller SPMD: local == global
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+        self._loaded = False
+
+    def __iter__(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        yield from self._batches(iter(self._records))
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming dataset: records flow file-by-file without residency
+    (reference dataset.py QueueDataset)."""
+
+    def __iter__(self):
+        def records():
+            for path in self._filelist:
+                for line in self._iter_lines(path):
+                    line = line.rstrip("\n")
+                    if line:
+                        yield self._parse(line)
+        yield from self._batches(records())

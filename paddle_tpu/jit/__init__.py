@@ -32,6 +32,7 @@ from ..ops.dispatch import apply
 from ..tensor.tensor import Tensor, wrap_array
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "set_code_level", "set_verbosity",
            "TranslatedLayer", "InputSpec", "enable_to_static"]
 
 _to_static_enabled = [True]
@@ -270,3 +271,16 @@ def load(path, **configs):
     state = fload(str(path) + ".pdiparams")
     layer.set_state_dict(state)
     return TranslatedLayer(layer)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed code at the given level (reference:
+    jit/dy2static/logging_utils.py).  The jax trace IS the transformed
+    code; this sets the framework log level used by trace diagnostics."""
+    from ..flags import flags
+    flags.FLAGS_log_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    from ..flags import flags
+    flags.FLAGS_log_level = level

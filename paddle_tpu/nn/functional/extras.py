@@ -536,6 +536,11 @@ def gather_tree(ids, parents):
 
 def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
               fastemit_lambda=0.0, reduction="mean", name=None):
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "FastEmit regularization (fastemit_lambda != 0) is not "
+            "implemented; the unregularized transducer loss would "
+            "silently differ from what was requested")
     """RNN-Transducer loss via the standard forward DP over the (t, u)
     lattice (reference: nn/functional/loss.py rnnt_loss; CUDA warp-rnnt in
     the reference — here a lax.scan over time with a u-dimension vector
@@ -758,14 +763,26 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
     """Variable-length packed flash attention over concatenated sequences
     (reference: flash_attn_unpadded / flash_attn_varlen_qkvpacked).  The
     ragged batch is processed per sequence via the dense kernel — correct
-    and simple; the padded+masked route is preferable for TPU batching."""
+    and simple; the padded+masked route is preferable for TPU batching.
+    QKV-packed means q and k share segment boundaries: mismatched
+    cu_seqlens are rejected rather than silently mis-segmented."""
     from . import scaled_dot_product_attention
     qkv = as_tensor(qkv)
     cu = np.asarray(as_tensor(cu_seqlens_q).numpy()).astype(np.int64)
+    cu_k = np.asarray(as_tensor(cu_seqlens_k).numpy()).astype(np.int64)
+    if not np.array_equal(cu, cu_k):
+        raise ValueError(
+            "qkv-packed varlen attention requires cu_seqlens_q == "
+            "cu_seqlens_k (q/k come from the same packed tensor)")
+    D = qkv.shape[-1]
     outs = []
     for i in range(len(cu) - 1):
         seg = qkv[int(cu[i]):int(cu[i + 1])]
         q, k, v = seg[:, 0][None], seg[:, 1][None], seg[:, 2][None]
+        if scale is not None:
+            # sdpa applies 1/sqrt(D); pre-scale q so the effective
+            # softmax scale is the caller's
+            q = q * (scale * math.sqrt(D))
         outs.append(scaled_dot_product_attention(
             q, k, v, is_causal=causal, dropout_p=dropout)[0])
     from ...tensor.manipulation import concat

@@ -17,6 +17,8 @@ from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
+from .inplace import *  # noqa: F401,F403
 
 
 def _patch_tensor_methods() -> None:

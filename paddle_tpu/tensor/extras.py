@@ -1,0 +1,475 @@
+"""Long-tail tensor ops (reference: python/paddle/tensor/ math.py,
+manipulation.py, search.py — the remaining public surface).
+
+Everything here is a thin jnp/lax composition dispatched through apply()
+so autograd, AMP and NaN checks apply uniformly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from .tensor import Tensor, wrap_array
+
+__all__ = [
+    "block_diag", "logcumsumexp", "is_complex", "is_integer",
+    "is_floating_point", "isin", "mm", "shape", "cdist", "pdist", "sinc",
+    "gammainc", "gammaincc", "reduce_as", "increment", "set_printoptions",
+    "disable_signal_handler", "reverse", "check_shape", "renorm",
+    "multigammaln", "take", "frexp", "trapezoid", "cumulative_trapezoid",
+    "unflatten", "unfold", "polygamma", "bitwise_left_shift",
+    "bitwise_right_shift", "index_fill", "diagonal_scatter", "combinations",
+    "signbit", "flops", "LazyGuard", "batch",
+]
+
+
+def block_diag(inputs, name=None):
+    """Stack square/rect matrices along the diagonal (reference:
+    tensor/creation.py block_diag)."""
+    ts = [as_tensor(t) for t in inputs]
+
+    def fn(*mats):
+        mats = [m if m.ndim == 2 else m.reshape(1, -1) for m in mats]
+        R = sum(m.shape[0] for m in mats)
+        C = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((R, C), mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype),
+                                               (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply("block_diag", fn, *ts)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """log(cumsum(exp(x))) via an associative logaddexp scan — numerically
+    stable and O(log n) depth on TPU (reference: tensor/math.py
+    logcumsumexp)."""
+    x = as_tensor(x)
+
+    def fn(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            return jax.lax.associative_scan(jnp.logaddexp, flat)
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=axis)
+
+    return apply("logcumsumexp", fn, x)
+
+
+def is_complex(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.floating)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply("isin",
+                 lambda a, b: jnp.isin(a, b, invert=invert),
+                 as_tensor(x), as_tensor(test_x))
+
+
+def mm(input, mat2, name=None):
+    from .linalg import matmul
+    return matmul(input, mat2)
+
+
+def shape(input):
+    """Shape as an int32 tensor (reference: tensor/attribute.py shape)."""
+    return wrap_array(jnp.asarray(as_tensor(input).shape, jnp.int32))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row batches [..., P, M] x [..., R, M]
+    (reference: tensor/linalg.py cdist).  p=2 uses the MXU-friendly
+    x@y^T expansion."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        if p == 2.0 and "use_mm" in compute_mode:
+            a2 = jnp.sum(a * a, -1, keepdims=True)
+            b2 = jnp.sum(b * b, -1, keepdims=True)
+            sq = a2 + jnp.swapaxes(b2, -1, -2) - 2 * (
+                a @ jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum(d != 0, -1).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(d, -1)
+        return jnp.sum(d ** p, -1) ** (1.0 / p)
+
+    return apply("cdist", fn, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of a [N, M] matrix (upper triangle,
+    reference: tensor/linalg.py pdist)."""
+    x = as_tensor(x)
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def fn(a):
+        d = jnp.abs(a[:, None, :] - a[None, :, :])
+        if p == float("inf"):
+            full = jnp.max(d, -1)
+        elif p == 0:
+            full = jnp.sum(d != 0, -1).astype(a.dtype)
+        else:
+            full = jnp.sum(d ** p, -1) ** (1.0 / p)
+        return full[iu]
+
+    return apply("pdist", fn, x)
+
+
+def sinc(x, name=None):
+    return apply("sinc", jnp.sinc, as_tensor(x))
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y)."""
+    return apply("gammainc", jax.scipy.special.gammainc,
+                 as_tensor(x), as_tensor(y))
+
+
+def gammaincc(x, y, name=None):
+    return apply("gammaincc", jax.scipy.special.gammaincc,
+                 as_tensor(x), as_tensor(y))
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference: tensor/math.py
+    reduce_as)."""
+    x, target = as_tensor(x), as_tensor(target)
+    tshape = tuple(target.shape)
+
+    def fn(a, t):
+        extra = a.ndim - len(tshape)
+        axes = list(range(extra))
+        for i, s in enumerate(tshape):
+            if a.shape[extra + i] != s:
+                axes.append(extra + i)
+        out = jnp.sum(a, axis=tuple(axes), keepdims=False)
+        return out.reshape(tshape)
+
+    return apply("reduce_as", fn, x, target)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda a: a + value, as_tensor(x))
+    if isinstance(x, Tensor):
+        return x._inplace_assign(out)
+    return out
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: this runtime installs no signal handlers (the reference
+    unhooks its C++ fault handlers)."""
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def check_shape(x, shape=None):
+    """Static shape assertion helper."""
+    if shape is not None and tuple(as_tensor(x).shape) != tuple(shape):
+        raise ValueError(
+            f"shape mismatch: got {tuple(as_tensor(x).shape)}, "
+            f"expected {tuple(shape)}")
+    return x
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale each axis-slice so its p-norm is at most max_norm
+    (reference: tensor/math.py renorm)."""
+    x = as_tensor(x)
+    ax = axis % x.ndim
+
+    def fn(a):
+        red = tuple(i for i in range(a.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=red,
+                        keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * scale
+
+    return apply("renorm", fn, x)
+
+
+def multigammaln(x, p, name=None):
+    return apply("multigammaln",
+                 lambda a: jax.scipy.special.multigammaln(a, p),
+                 as_tensor(x))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference: tensor/math.py take): indices address
+    the flattened tensor; negative indices wrap; 'clip' clamps."""
+    x, index = as_tensor(x), as_tensor(index)
+
+    def fn(a, i):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = i % n
+        elif mode == "clip":
+            i = jnp.clip(i, -n, n - 1)
+        i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply("take", fn, x, index)
+
+
+def frexp(x, name=None):
+    return apply("frexp", lambda a: jnp.frexp(a), as_tensor(x),
+                 n_outputs=2)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        return apply("trapezoid",
+                     lambda a, b: jnp.trapezoid(a, b, axis=axis),
+                     y, as_tensor(x))
+    return apply("trapezoid",
+                 lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoid integral (reference: tensor/math.py
+    cumulative_trapezoid)."""
+    y = as_tensor(y)
+
+    def core(a, xs=None):
+        a1 = jax.lax.slice_in_dim(a, 1, a.shape[axis], axis=axis)
+        a0 = jax.lax.slice_in_dim(a, 0, a.shape[axis] - 1, axis=axis)
+        if xs is not None:
+            d = (jax.lax.slice_in_dim(xs, 1, xs.shape[axis], axis=axis)
+                 - jax.lax.slice_in_dim(xs, 0, xs.shape[axis] - 1,
+                                        axis=axis))
+        else:
+            d = dx or 1.0
+        return jnp.cumsum((a0 + a1) * d / 2.0, axis=axis)
+
+    if x is not None:
+        return apply("cumulative_trapezoid", core, y, as_tensor(x))
+    return apply("cumulative_trapezoid", core, y)
+
+
+def unflatten(x, axis, shape, name=None):
+    from .manipulation import reshape
+    x = as_tensor(x)
+    ax = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(x.shape[ax] // known if s == -1 else s for s in shape)
+    new = tuple(x.shape[:ax]) + shape + tuple(x.shape[ax + 1:])
+    return reshape(x, new)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis``: output gains a trailing window dim
+    (reference: tensor/manipulation.py unfold; torch.Tensor.unfold)."""
+    x = as_tensor(x)
+    ax = axis % x.ndim
+    n = x.shape[ax]
+    n_win = (n - size) // step + 1
+
+    def fn(a):
+        idx = (jnp.arange(n_win)[:, None] * step
+               + jnp.arange(size)[None, :])          # [n_win, size]
+        out = jnp.take(a, idx, axis=ax)
+        # windows replace axis -> [..., n_win, size, ...]; move the size
+        # dim to the end per the reference layout
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply("unfold", fn, x)
+
+
+def polygamma(x, n, name=None):
+    return apply("polygamma",
+                 lambda a: jax.scipy.special.polygamma(n, a), as_tensor(x))
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return apply("bitwise_left_shift", jnp.left_shift,
+                 as_tensor(x), as_tensor(y))
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    fn = jnp.right_shift if is_arithmetic else \
+        lambda a, b: jax.lax.shift_right_logical(a, b.astype(a.dtype))
+    return apply("bitwise_right_shift", fn, as_tensor(x), as_tensor(y))
+
+
+def index_fill(x, index, axis, value, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    ax = axis % x.ndim
+
+    def fn(a, i):
+        moved = jnp.moveaxis(a, ax, 0)
+        moved = moved.at[i].set(value)
+        return jnp.moveaxis(moved, 0, ax)
+
+    return apply("index_fill", fn, x, index)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the selected diagonal (reference: tensor/
+    manipulation.py diagonal_scatter)."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        n1, n2 = a.shape[axis1], a.shape[axis2]
+        if offset >= 0:
+            L = min(n1, n2 - offset)
+            i1 = jnp.arange(L)
+            i2 = jnp.arange(L) + offset
+        else:
+            L = min(n1 + offset, n2)
+            i1 = jnp.arange(L) - offset
+            i2 = jnp.arange(L)
+        # move the two axes to front for a simple scatter
+        moved = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        bm = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        moved = moved.at[i1, i2].set(bm)
+        return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+    return apply("diagonal_scatter", fn, x, y)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor (reference: tensor/math.py
+    combinations).  The index set is static; the gather is traced."""
+    x = as_tensor(x)
+    n = x.shape[0]
+    maker = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.asarray(list(maker(range(n), r)), np.int32).reshape(-1, r)
+
+    def fn(a):
+        return a[jnp.asarray(idx)]
+
+    return apply("combinations", fn, x)
+
+
+def signbit(x, name=None):
+    return apply("signbit", jnp.signbit, as_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# utilities
+# ---------------------------------------------------------------------------
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate a Layer's forward FLOPs by tracing a dummy batch with
+    per-layer hooks (reference: hapi/dynamic_flops.py paddle.flops)."""
+    import paddle_tpu as paddle
+    from ..nn.layer.layers import Layer
+    counts = {"flops": 0}
+    details = []
+
+    def conv_flops(layer, x, out):
+        kh_kw = int(np.prod(layer._kernel_size)) if hasattr(
+            layer, "_kernel_size") else 1
+        cin = getattr(layer, "_in_channels", 1)
+        groups = getattr(layer, "_groups", 1)
+        return int(np.prod(out.shape)) * cin // groups * kh_kw * 2
+
+    def linear_flops(layer, x, out):
+        return 2 * int(np.prod(x.shape)) * layer.weight.shape[-1]
+
+    handlers = {"Conv2D": conv_flops, "Conv1D": conv_flops,
+                "Conv3D": conv_flops, "Linear": linear_flops}
+    if custom_ops:
+        handlers.update({k.__name__ if isinstance(k, type) else k: v
+                         for k, v in custom_ops.items()})
+
+    hooks = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, outputs):
+            h = handlers.get(type(lyr).__name__)
+            if h is not None:
+                f = int(h(lyr, inputs[0], outputs))
+                counts["flops"] += f
+                details.append((type(lyr).__name__, f))
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(make_hook(sub)))
+    try:
+        x = paddle.zeros(list(input_size))
+        was_training = net.training
+        net.eval()
+        net(x)
+        if was_training:
+            net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        for name, f in details:
+            print(f"{name:>12}: {f:,} FLOPs")
+        print(f"Total FLOPs: {counts['flops']:,}")
+    return counts["flops"]
+
+
+class LazyGuard:
+    """Context that defers parameter materialization (reference:
+    fluid/dygraph/base.py LazyGuard).  In this runtime parameter init is
+    already lazy per-first-use at the jax level, so the guard only marks
+    the scope; layers built inside behave identically."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (reference:
+    python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

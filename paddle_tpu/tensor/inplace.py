@@ -1,0 +1,85 @@
+"""In-place op variants (reference: the ``op_`` functions across
+python/paddle/tensor/*.py, generated there by inplace codegen).
+
+jax arrays are immutable, so "in-place" means: run the functional op and
+rebind this Tensor handle to the result (``Tensor._inplace_assign`` —
+older tape consumers keep their by-value snapshots, mirroring the
+reference's version-counter semantics).  Every wrapper below is generated
+from its functional base at import time.
+"""
+
+from __future__ import annotations
+
+from .tensor import Tensor
+
+__all__ = []  # filled by _make below
+
+
+def _make(name: str, base):
+    def op_(x, *args, **kwargs):
+        if not isinstance(x, Tensor):
+            raise TypeError(f"{name} requires a Tensor, got {type(x)}")
+        return x._inplace_assign(base(x, *args, **kwargs))
+    op_.__name__ = name
+    op_.__qualname__ = name
+    op_.__doc__ = (f"In-place variant of :func:`{base.__module__}."
+                   f"{base.__name__}`.")
+    globals()[name] = op_
+    __all__.append(name)
+    return op_
+
+
+def _init():
+    from . import math as m
+    from . import manipulation as mp
+    from . import logic as lg
+    from . import creation as cr
+    from . import random as rnd
+    from . import extras as ex
+
+    # (in-place name, source module, functional base name)
+    table = [
+        ("addmm_", m, "addmm"), ("cumsum_", m, "cumsum"),
+        ("cumprod_", m, "cumprod"), ("logit_", m, "logit"),
+        ("tan_", m, "tan"), ("acos_", m, "acos"), ("atan_", m, "atan"),
+        ("sinh_", m, "sinh"), ("expm1_", m, "expm1"),
+        ("square_", m, "square"), ("erf_", m, "erf"),
+        ("log_", m, "log"), ("log2_", m, "log2"), ("log10_", m, "log10"),
+        ("trunc_", m, "trunc"), ("frac_", m, "frac"),
+        ("digamma_", m, "digamma"), ("lgamma_", m, "lgamma"),
+        ("gammaln_", m, "gammaln"), ("gcd_", m, "gcd"), ("lcm_", m, "lcm"),
+        ("hypot_", m, "hypot"), ("ldexp_", m, "ldexp"), ("i0_", m, "i0"),
+        ("copysign_", m, "copysign"), ("nan_to_num_", m, "nan_to_num"),
+        ("floor_divide_", m, "floor_divide"), ("floor_mod_", m, "mod"),
+        ("logical_and_", lg, "logical_and"),
+        ("logical_or_", lg, "logical_or"),
+        ("logical_xor_", lg, "logical_xor"),
+        ("logical_not_", lg, "logical_not"),
+        ("bitwise_and_", m, "bitwise_and"), ("bitwise_or_", m, "bitwise_or"),
+        ("bitwise_xor_", m, "bitwise_xor"),
+        ("bitwise_not_", m, "bitwise_not"),
+        ("equal_", lg, "equal"), ("less_than_", lg, "less_than"),
+        ("less_equal_", lg, "less_equal"),
+        ("greater_than_", lg, "greater_than"),
+        ("greater_equal_", lg, "greater_equal"),
+        ("tril_", cr, "tril"), ("triu_", cr, "triu"),
+        ("t_", mp, "t"), ("transpose_", mp, "transpose"),
+        ("index_add_", mp, "index_add"), ("index_put_", mp, "index_put"),
+        ("index_fill_", ex, "index_fill"),
+        ("masked_fill_", mp, "masked_fill"),
+        ("masked_scatter_", mp, "masked_scatter"),
+        ("renorm_", ex, "renorm"), ("sinc_", ex, "sinc"),
+        ("gammainc_", ex, "gammainc"), ("gammaincc_", ex, "gammaincc"),
+        ("multigammaln_", ex, "multigammaln"),
+        ("polygamma_", ex, "polygamma"),
+        ("bitwise_left_shift_", ex, "bitwise_left_shift"),
+        ("bitwise_right_shift_", ex, "bitwise_right_shift"),
+    ]
+    for name, mod, base_name in table:
+        base = getattr(mod, base_name, None)
+        if base is None:
+            continue
+        _make(name, base)
+
+
+_init()

@@ -23,6 +23,7 @@ __all__ = [
     "uniform_", "normal", "normal_", "standard_normal", "standard_gamma",
     "multinomial", "bernoulli", "bernoulli_", "poisson", "binomial",
     "exponential_", "randn_like", "rand_like", "log_normal",
+    "log_normal_", "geometric_",
 ]
 
 
@@ -192,3 +193,23 @@ def exponential_(x, lam=1.0, name=None):
 def shuffle_(x, name=None):
     x._data = jax.random.permutation(_next_key(), x._data, axis=0)
     return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill x in place with LogNormal(mean, std) samples (reference:
+    tensor/random.py log_normal_)."""
+    return x._inplace_assign(log_normal(mean, std, list(x.shape)))
+
+
+def geometric_(x, probs=0.5, name=None):
+    """Fill x in place with Geometric(probs) samples (number of Bernoulli
+    trials until first success; reference: tensor/random.py geometric_)."""
+    from ..ops.dispatch import apply
+    key = _next_key()
+
+    def fn(a):
+        u = jax.random.uniform(key, a.shape, jnp.float32, 1e-7, 1.0)
+        g = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.asarray(probs, jnp.float32)))
+        return g.astype(a.dtype)
+
+    return x._inplace_assign(apply("geometric_", fn, x))

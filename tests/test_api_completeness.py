@@ -1,0 +1,242 @@
+"""Audit-driven API completeness: every name in the reference's public
+__all__ lists must exist, and the non-trivial new ops must be correct
+(torch/scipy as oracles)."""
+
+import ast
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as sd
+import torch
+
+import paddle_tpu as paddle
+
+
+def _ref_all(path):
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    return [ast.literal_eval(e) for e in node.value.elts]
+    return []
+
+
+REF = "/root/reference/python/paddle"
+
+
+@pytest.mark.parametrize("ref_path,module_attr", [
+    ("__init__.py", None),
+    ("nn/__init__.py", "nn"),
+    ("nn/functional/__init__.py", "nn.functional"),
+    ("nn/initializer/__init__.py", "nn.initializer"),
+    ("optimizer/__init__.py", "optimizer"),
+    ("metric/__init__.py", "metric"),
+    ("io/__init__.py", "io"),
+    ("distributed/__init__.py", "distributed"),
+    ("amp/__init__.py", "amp"),
+    ("jit/__init__.py", "jit"),
+    ("vision/__init__.py", "vision"),
+])
+def test_public_surface_complete(ref_path, module_attr):
+    names = _ref_all(f"{REF}/{ref_path}")
+    mod = paddle
+    if module_attr:
+        for part in module_attr.split("."):
+            mod = getattr(mod, part)
+    missing = [n for n in names if not hasattr(mod, n)]
+    assert not missing, f"{module_attr or 'paddle'}: missing {missing}"
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestNewTensorOps:
+    rs = np.random.RandomState(0)
+
+    def test_block_diag(self):
+        a = self.rs.randn(2, 3).astype("float32")
+        b = self.rs.randn(2, 2).astype("float32")
+        got = paddle.block_diag([_t(a), _t(b)]).numpy()
+        ref = torch.block_diag(torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_logcumsumexp(self):
+        x = self.rs.randn(3, 5).astype("float32")
+        got = paddle.logcumsumexp(_t(x), axis=1).numpy()
+        ref = torch.logcumsumexp(torch.tensor(x), dim=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_cdist_pdist(self):
+        x = self.rs.randn(5, 4).astype("float64")
+        got = paddle.cdist(_t(x), _t(x)).numpy()
+        np.testing.assert_allclose(got, sd.cdist(x, x), atol=1e-6)
+        np.testing.assert_allclose(paddle.pdist(_t(x)).numpy(),
+                                   sd.pdist(x), atol=1e-6)
+
+    def test_take_unfold_diagonal_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_allclose(
+            paddle.take(_t(x), _t(np.array([0, 5, -1]))).numpy(),
+            torch.take(torch.tensor(x), torch.tensor([0, 5, -1])).numpy())
+        np.testing.assert_allclose(
+            paddle.unfold(_t(x), 1, 2, 1).numpy(),
+            torch.tensor(x).unfold(1, 2, 1).numpy())
+        np.testing.assert_allclose(
+            paddle.diagonal_scatter(_t(np.zeros((3, 4), np.float32)),
+                                    _t(np.ones(3, np.float32))).numpy(),
+            torch.diagonal_scatter(torch.zeros(3, 4), torch.ones(3)).numpy())
+
+    def test_renorm(self):
+        x = self.rs.randn(3, 4, 5).astype("float32")
+        got = paddle.renorm(_t(x), 2.0, 0, 1.0).numpy()
+        ref = torch.renorm(torch.tensor(x), 2.0, 0, 1.0).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_combinations(self):
+        x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        got = paddle.combinations(_t(x), 2).numpy()
+        ref = torch.combinations(torch.tensor(x), 2).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_trapezoid(self):
+        y = self.rs.randn(8).astype("float32")
+        np.testing.assert_allclose(
+            paddle.trapezoid(_t(y), dx=0.5).numpy(),
+            torch.trapezoid(torch.tensor(y), dx=0.5).numpy(), rtol=1e-5)
+        got = paddle.cumulative_trapezoid(_t(y), dx=0.5).numpy()
+        ref = torch.cumulative_trapezoid(torch.tensor(y), dx=0.5).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_type_predicates_and_misc(self):
+        assert paddle.is_floating_point(_t(np.zeros(2, np.float32)))
+        assert paddle.is_integer(_t(np.zeros(2, np.int32)))
+        assert paddle.is_complex(_t(np.zeros(2, np.complex64)))
+        assert paddle.signbit(_t(np.array([-1.0, 2.0]))).numpy().tolist() \
+            == [True, False]
+        np.testing.assert_allclose(
+            paddle.shape(_t(np.zeros((2, 5)))).numpy(), [2, 5])
+        m, e = paddle.frexp(_t(np.array([8.0, 0.5])))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0, 0.5])
+
+    def test_isin_reduce_as(self):
+        got = paddle.isin(_t(np.array([1, 2, 3, 4])),
+                          _t(np.array([2, 4]))).numpy()
+        np.testing.assert_allclose(got, [False, True, False, True])
+        x = np.ones((2, 3, 4), np.float32)
+        tgt = np.zeros((3, 1), np.float32)
+        np.testing.assert_allclose(
+            paddle.reduce_as(_t(x), _t(tgt)).numpy(), np.full((3, 1), 8.0))
+
+
+class TestInplaceVariants:
+    def test_elementwise_inplace(self):
+        x0 = np.random.RandomState(1).rand(3, 4).astype("float32") + 0.5
+        x = _t(x0.copy())
+        paddle.log_(x)
+        np.testing.assert_allclose(x.numpy(), np.log(x0), rtol=1e-6)
+
+    def test_binary_inplace(self):
+        a = _t(np.array([6, 4], np.int64))
+        paddle.gcd_(a, _t(np.array([9, 6], np.int64)))
+        np.testing.assert_allclose(a.numpy(), [3, 2])
+
+    def test_inplace_requires_tensor(self):
+        with pytest.raises(TypeError):
+            paddle.tan_(np.zeros(3))
+
+    def test_masked_fill_(self):
+        x = _t(np.zeros((2, 2), np.float32))
+        paddle.masked_fill_(x, _t(np.array([[True, False],
+                                            [False, True]])), 5.0)
+        np.testing.assert_allclose(x.numpy(), [[5, 0], [0, 5]])
+
+    def test_sampling_inplace(self):
+        z = _t(np.zeros((64,), np.float32))
+        paddle.geometric_(z, 0.3)
+        vals = z.numpy()
+        assert (vals >= 1).all() and vals.std() > 0
+
+
+class TestDistributedSurface:
+    def test_aliases_and_enums(self):
+        import paddle_tpu.distributed as dist
+        assert dist.alltoall is not None
+        assert dist.ReduceType.kRedSum == 0
+        assert dist.ShardingStage2.stage == 2
+        assert dist.get_backend().startswith("XLA:")
+
+    def test_ps_datasets(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        f = tmp_path / "data.txt"
+        f.write_text("1 2 3\n4 5 6\n7 8 9\n10 11 12\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, parse_fn=lambda s: np.array(s.split(),
+                                                         np.float32))
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 4
+        ds.global_shuffle(seed=3)
+        batches = list(ds)
+        assert len(batches) == 2 and len(batches[0]) == 2
+        ds.release_memory()
+
+        qs = dist.QueueDataset()
+        qs.init(batch_size=3, parse_fn=lambda s: np.array(s.split(),
+                                                          np.float32))
+        qs.set_filelist([str(f)])
+        got = list(qs)
+        assert len(got) == 2 and len(got[0]) == 3 and len(got[1]) == 1
+
+    def test_entry_attrs(self):
+        import paddle_tpu.distributed as dist
+        assert dist.ProbabilityEntry(0.5)._to_attr() == \
+            "probability_entry:0.5"
+        assert dist.CountFilterEntry(10)._to_attr() == \
+            "count_filter_entry:10"
+        assert dist.ShowClickEntry("s", "c")._to_attr() == \
+            "show_click_entry:s:c"
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(2.0)
+
+    def test_dist_io_round_trip(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+        net = nn.Linear(3, 2)
+        ref = net.weight.numpy().copy()
+        dist.io.save_persistables(None, str(tmp_path), main_program=net)
+        net2 = nn.Linear(3, 2)
+        dist.io.load_persistables(None, str(tmp_path), main_program=net2)
+        np.testing.assert_allclose(net2.weight.numpy(), ref)
+
+
+class TestVisionAmpJitTail:
+    def test_image_load_ppm(self, tmp_path):
+        img = (np.random.RandomState(0).rand(4, 5, 3) * 255).astype(
+            np.uint8)
+        p = tmp_path / "img.ppm"
+        with open(p, "wb") as f:
+            f.write(b"P6\n5 4\n255\n")
+            f.write(img.tobytes())
+        back = paddle.vision.image_load(str(p))
+        np.testing.assert_allclose(back, img)
+
+    def test_amp_support_queries(self):
+        assert paddle.amp.is_bfloat16_supported() is True
+        assert isinstance(paddle.amp.is_float16_supported(), bool)
+
+    def test_jit_verbosity(self):
+        paddle.jit.set_verbosity(3)
+        from paddle_tpu.flags import flags
+        assert flags.FLAGS_log_level == 3
+        paddle.jit.set_verbosity(0)
+
+
+def test_flops_counts_linear_and_conv():
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.Flatten(),
+                        nn.Linear(2 * 8 * 8, 4))
+    total = paddle.flops(net, [1, 1, 8, 8])
+    # conv: 64 out-pixels*2ch*1in*9k*2 = 2304; linear: 2*128*4 = 1024
+    assert total == 2 * 64 * 2 * 9 + 2 * 128 * 4
