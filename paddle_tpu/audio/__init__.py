@@ -2,27 +2,18 @@
 
 ``features`` — Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers.
 ``functional`` — window functions, mel filterbanks, dB conversion, DCT.
-Backends (soundfile IO) are gated: this environment has no audio IO
-libraries, so ``load``/``save`` raise with instructions.
+``backends`` — wav IO over the stdlib wave module (info/load/save).
+``datasets`` — ESC50/TESS over local extracted archives (no egress).
 """
 
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import (  # noqa: F401
     MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram)
 
-__all__ = ["features", "functional", "backends", "load", "save",
+__all__ = ["features", "functional", "backends", "datasets", "info",
+           "load", "save",
            "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
-
-
-def load(*args, **kwargs):
-    raise RuntimeError(
-        "paddle_tpu.audio.load requires an audio IO backend (soundfile) "
-        "which is not bundled; decode to a numpy array externally and "
-        "feed it to the feature layers directly")
-
-
-def save(*args, **kwargs):
-    raise RuntimeError(
-        "paddle_tpu.audio.save requires an audio IO backend (soundfile) "
-        "which is not bundled")

@@ -1,0 +1,95 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ —
+wave_backend.py info/load/save over the stdlib wave module)."""
+
+from __future__ import annotations
+
+import wave as _wave
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..tensor.tensor import Tensor, wrap_array
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+class AudioInfo(NamedTuple):
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def list_available_backends() -> List[str]:
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"only the stdlib wave backend is available, got "
+            f"{backend_name!r}")
+
+
+def info(filepath: str) -> AudioInfo:
+    """Metadata of a .wav file (reference: wave_backend.py:37)."""
+    with _wave.open(filepath, "rb") as w:
+        bits = w.getsampwidth() * 8
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=bits,
+                         encoding=f"PCM_{'S' if bits > 8 else 'U'}")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Load PCM wav to a float tensor in [-1, 1] (reference:
+    wave_backend.py:89)."""
+    import jax.numpy as jnp
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = num_frames if num_frames >= 0 else w.getnframes() - frame_offset
+        raw = w.readframes(n)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if normalize:
+        if width == 1:
+            arr = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            arr = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    else:
+        arr = data.astype(np.float32)
+    if channels_first:
+        arr = arr.T
+    return wrap_array(jnp.asarray(arr)), sr
+
+
+def save(filepath: str, src: Tensor, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_16",
+         bits_per_sample: Optional[int] = 16):
+    """Write a float tensor in [-1, 1] as PCM wav (reference:
+    wave_backend.py:168)."""
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T
+    bits = bits_per_sample or 16
+    if bits != 16:
+        raise NotImplementedError("only PCM_16 output is supported")
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(pcm.shape[1] if pcm.ndim == 2 else 1)
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())

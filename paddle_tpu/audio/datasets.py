@@ -1,0 +1,112 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — ESC50/TESS
+audio-classification datasets over downloaded archives).
+
+Zero-egress environment: the download path raises with instructions; a
+local extracted directory works fully (the reference also accepts a local
+archive)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends as _backends
+from .features import MelSpectrogram
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+class AudioClassificationDataset(Dataset):
+    """wav files + integer labels, optional mel-feature transform
+    (reference: audio/datasets/dataset.py)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = 16000,
+                 **feat_kwargs):
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        if feat_type == "melspectrogram":
+            self._feat = MelSpectrogram(sr=sample_rate, **feat_kwargs)
+        elif feat_type == "raw":
+            self._feat = None
+        else:
+            raise NotImplementedError(
+                f"feat_type {feat_type!r}; use 'raw' or 'melspectrogram'")
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, _sr = _backends.load(self.files[idx])
+        sig = wav[0] if wav.ndim == 2 else wav   # mono
+        if self._feat is not None:
+            sig = self._feat(sig.unsqueeze(0))[0]
+        return np.asarray(sig.numpy()), np.array(self.labels[idx])
+
+
+class _LocalArchiveDataset(AudioClassificationDataset):
+    url = ""
+    meta_csv = ""
+
+    def __init__(self, mode="train", data_dir: Optional[str] = None,
+                 feat_type="raw", **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                f"{type(self).__name__}: no network egress in this "
+                f"environment — download {self.url} elsewhere, extract, "
+                f"and pass data_dir=<extracted path>")
+        files, labels = self._collect(data_dir, mode)
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+    def _collect(self, data_dir, mode):
+        raise NotImplementedError
+
+
+class ESC50(_LocalArchiveDataset):
+    """ESC-50 environmental sounds (reference: audio/datasets/esc50.py;
+    folds 1-4 = train, fold 5 = dev)."""
+
+    url = "https://paddleaudio.bj.bcebos.com/datasets/ESC-50-master.zip"
+
+    def _collect(self, data_dir, mode):
+        import csv
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        audio_dir = os.path.join(data_dir, "audio")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                keep = fold < 5 if mode == "train" else fold == 5
+                if keep:
+                    files.append(os.path.join(audio_dir, row["filename"]))
+                    labels.append(int(row["target"]))
+        return files, labels
+
+
+class TESS(_LocalArchiveDataset):
+    """TESS emotional speech (reference: audio/datasets/tess.py; labels
+    parsed from the *_<emotion>.wav filename)."""
+
+    url = ("https://bj.bcebos.com/paddleaudio/datasets/"
+           "TESS_Toronto_emotional_speech_set.zip")
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def _collect(self, data_dir, mode):
+        files, labels = [], []
+        for root, _dirs, names in os.walk(data_dir):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                emo = n.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.emotions:
+                    files.append(os.path.join(root, n))
+                    labels.append(self.emotions.index(emo))
+        # 9:1 train/dev split like the reference's n_folds handling
+        cut = int(len(files) * 0.9)
+        if mode == "train":
+            return files[:cut], labels[:cut]
+        return files[cut:], labels[cut:]

@@ -20,7 +20,7 @@ from .tape import (no_grad_guard as no_grad, enable_grad_guard as
 
 __all__ = ["no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
            "grad", "backward", "PyLayer", "PyLayerContext", "jacobian",
-           "hessian", "vjp", "jvp"]
+           "hessian", "vjp", "jvp", "saved_tensors_hooks"]
 
 
 class set_grad_enabled:
@@ -245,14 +245,20 @@ class PyLayerContext:
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors) -> None:
-        self._saved = list(tensors)
+        pack = _saved_tensor_hooks[-1][0] if _saved_tensor_hooks else None
+        self._saved = [pack(t) if pack else t for t in tensors]
+        self._packed = bool(pack)
+        self._hook = _saved_tensor_hooks[-1] if _saved_tensor_hooks else None
 
     @property
     def saved_tensor(self):
+        if getattr(self, "_packed", False):
+            unpack = self._hook[1]
+            return [unpack(t) for t in self._saved]
         return self._saved
 
     def saved_tensors(self):
-        return self._saved
+        return self.saved_tensor
 
     def mark_not_inplace(self, *args) -> None:
         self.not_inplace_tensors = args
@@ -405,3 +411,31 @@ def jvp(func, xs, v=None):
     touts = wrap_array(tangent_out) if not isinstance(
         tangent_out, tuple) else [wrap_array(t) for t in tangent_out]
     return outs, touts
+
+
+# -- saved-tensor hooks ------------------------------------------------------
+_saved_tensor_hooks = []
+
+
+class saved_tensors_hooks:
+    """Intercept activations saved for backward (reference:
+    autograd/saved_tensors_hooks.py): ``pack`` runs when a tensor is
+    stashed, ``unpack`` when backward retrieves it — the host-offload /
+    compression seam.
+
+    Scope on this substrate: applies to PyLayer ``save_for_backward``
+    (user-managed residuals).  Op-level residuals live inside XLA's vjp
+    closures, where rematerialisation (`jax.checkpoint`) is the
+    TPU-native equivalent of offload hooks."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.pop()
+        return False

@@ -107,3 +107,120 @@ class cuda:
     def stream_guard(stream):
         import contextlib
         return contextlib.nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# stream/event surface (reference: device/__init__.py Stream/Event,
+# current_stream, set_stream, stream_guard).  XLA owns scheduling on TPU:
+# there is one logical compute stream per device; events record host-side
+# timestamps around async dispatch, which is what the reference's timing
+# use-case needs.
+# ---------------------------------------------------------------------------
+class Event:
+    def __init__(self, device=None, enable_timing=True, blocking=False,
+                 interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time as _time
+        synchronize()
+        self._t = _time.perf_counter()
+
+    def query(self):
+        return self._t is not None
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            raise RuntimeError("both events must be recorded first")
+        return (end_event._t - self._t) * 1000.0
+
+
+class Stream:
+    """The (single) logical execution stream of a device."""
+
+    def __init__(self, device=None, priority=None, blocking=False):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+def set_stream(stream: Stream) -> Stream:
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference returns None when not compiled with
+    CUDA)."""
+    return None
+
+
+class IPUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("IPU devices are not supported by this build")
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True  # jax.distributed + XLA collectives are always built in
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    import jax
+    return device_type in ("tpu", "axon") and \
+        jax.devices()[0].platform in ("tpu", "axon")
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+__all__ += ["Event", "Stream", "current_stream", "set_stream",
+            "stream_guard", "get_cudnn_version", "IPUPlace",
+            "is_compiled_with_ipu", "is_compiled_with_distribute",
+            "is_compiled_with_custom_device", "get_all_device_type"]

@@ -52,12 +52,16 @@ class _DatasetBase:
                 proc = subprocess.Popen(
                     self._pipe_command, shell=True, stdin=fin,
                     stdout=subprocess.PIPE, text=True)
+                completed = False
                 try:
                     yield from proc.stdout
+                    completed = True
                 finally:
                     proc.stdout.close()
                     rc = proc.wait()
-                    if rc != 0:
+                    # a consumer breaking early SIGPIPEs the command;
+                    # only a failure during a full read is an error
+                    if completed and rc != 0:
                         raise RuntimeError(
                             f"pipe_command {self._pipe_command!r} failed "
                             f"with rc={rc} on {path}")

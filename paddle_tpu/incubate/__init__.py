@@ -27,3 +27,166 @@ def softmax_mask_fuse_upper_triangle(x):
         return jax.nn.softmax(jnp.where(mask, a, -jnp.inf), axis=-1)
 
     return apply("softmax_mask_fuse_upper_triangle", fn, as_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# wrapper optimizers (reference: incubate/optimizer/lookahead.py,
+# modelaverage.py)
+# ---------------------------------------------------------------------------
+class LookAhead:
+    """Lookahead optimizer (Zhang et al. 2019): every k inner steps, the
+    slow weights move alpha of the way toward the fast weights and the
+    fast weights are reset to them (reference:
+    incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0 <= alpha <= 1:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+
+    def _params(self):
+        return self.inner_optimizer._params()
+
+    def step(self):
+        import jax.numpy as jnp
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in self.inner_optimizer._params():
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._data
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            p._data = slow
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "steps": self._steps}
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference:
+    incubate/optimizer/modelaverage.py): accumulates sums of params; the
+    apply()/restore() pair swaps averaged weights in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameters = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        # two-window accumulation (the reference's sum_1/sum_2 restart
+        # scheme): the effective window stays within [max_w, 2*max_w]
+        self._cur = {id(p): p._data * 0 for p in self._parameters}
+        self._old = {id(p): p._data * 0 for p in self._parameters}
+        self._cur_n = 0
+        self._old_n = 0
+        self._backup = None
+
+    def step(self):
+        self._cur_n += 1
+        for p in self._parameters:
+            self._cur[id(p)] = self._cur[id(p)] + p._data
+        if self._cur_n >= self._max_w:
+            self._old = self._cur
+            self._old_n = self._cur_n
+            self._cur = {id(p): p._data * 0 for p in self._parameters}
+            self._cur_n = 0
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._backup = {id(p): p._data for p in self._parameters}
+            n = max(1, self._old_n + self._cur_n)
+            for p in self._parameters:
+                p._data = (self._old[id(p)] + self._cur[id(p)]) / n
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return guard()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._parameters:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+    def minimize(self, loss, *a, **k):
+        raise NotImplementedError(
+            "ModelAverage wraps evaluation weights; drive training with "
+            "the inner optimizer and call step() after it")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused by XLA (reference:
+    incubate/operators/softmax_mask_fuse.py)."""
+    from ..ops.dispatch import apply, as_tensor
+    import jax
+
+    def fn(a, m):
+        return jax.nn.softmax(a + m, axis=-1)
+
+    return apply("softmax_mask_fuse", fn, as_tensor(x), as_tensor(mask))
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a value as the loss for IPU-style pipelines (reference:
+    incubate/nn/functional/identity_loss — here numerics only)."""
+    from ..tensor import math as _m
+    if reduction in (0, "sum"):
+        return _m.sum(x)
+    if reduction in (1, "mean"):
+        return _m.mean(x)
+    return x
+
+
+# graph ops live in paddle.geometric; incubate keeps the legacy names
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: E402,F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, name=None):
+    raise NotImplementedError(
+        "multi-hop sampling: compose paddle.geometric.sample_neighbors "
+        "per hop (the reference's fused khop sampler is a CUDA-side "
+        "optimization of exactly that loop)")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)

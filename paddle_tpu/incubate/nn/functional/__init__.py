@@ -13,13 +13,19 @@ import jax.numpy as jnp
 
 from ....ops.dispatch import apply, as_tensor, get_op_impl
 from ....tensor.tensor import Tensor
+from ....tensor.math import add
+from ....nn import functional as F
 
 __all__ = ["fused_rms_norm", "fused_layer_norm",
            "fused_rotary_position_embedding", "swiglu",
            "fused_bias_act", "fused_linear",
            "fused_linear_activation", "fused_dropout_add",
            "fused_multi_head_attention", "masked_multihead_attention",
-           "fused_feedforward", "fused_matmul_bias"]
+           "fused_feedforward", "fused_matmul_bias",
+           "fused_bias_dropout_residual_layer_norm", "fused_ec_moe",
+           "fused_multi_transformer",
+           "variable_length_memory_efficient_attention",
+           "blha_get_max_len", "block_multihead_attention"]
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
@@ -242,3 +248,158 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
                            ln2_epsilon)
     return out
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """layer_norm(residual + dropout(x + bias)) — one XLA fusion group
+    (reference: incubate/nn/functional/fused_transformer.py
+    fused_bias_dropout_residual_layer_norm)."""
+    out = x if bias is None else add(x, bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    out = add(residual, out)
+    return F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Expert-choice MoE FFN: softmax gate over experts, two batched
+    matmuls (reference: incubate/nn/functional/fused_ec_moe.py — the
+    cutlass grouped-GEMM there is jnp.einsum here; XLA maps it onto the
+    MXU batched)."""
+    from ....ops.dispatch import apply as _apply, as_tensor as _at
+    import jax
+
+    def fn(xa, ga, w0, b0, w1, b1):
+        # xa: [B, S, D]; w0: [E, D, H]; w1: [E, H, D]; ga: [B, S, E]
+        probs = jax.nn.softmax(ga, axis=-1)
+        h = jnp.einsum("bsd,edh->ebsh", xa, w0) + b0[:, None, None]
+        if act_type == "gelu":
+            h = jax.nn.gelu(h)
+        else:
+            h = jax.nn.relu(h)
+        y = jnp.einsum("ebsh,ehd->ebsd", h, w1) + b1[:, None, None]
+        return jnp.einsum("ebsd,bse->bsd", y, probs)
+
+    return _apply("fused_ec_moe", fn, _at(x), _at(gate), _at(bmm0_weight),
+                  _at(bmm0_bias), _at(bmm1_weight), _at(bmm1_bias))
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """Attention over per-sequence valid lengths (reference:
+    incubate/nn/functional/variable_length_memory_efficient_attention.py).
+    q/k/v: [B, H, S, D]; invalid key positions are masked out."""
+    from ....ops.dispatch import apply as _apply, as_tensor as _at
+    import jax
+    import math as _math
+
+    def fn(q, k, v, sl, kvl, *m):
+        B, H, S, D = q.shape
+        sc = scale if scale is not None else 1.0 / _math.sqrt(D)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+        kpos = jnp.arange(k.shape[2])
+        valid = kpos[None, :] < kvl.reshape(-1, 1)
+        s = jnp.where(valid[:, None, None, :], s, -1e30)
+        if causal:
+            # end-aligned diagonal handles cross-length (cached-decode)
+            # shapes: query i sees keys j with j <= i + (K - S)
+            K = k.shape[2]
+            qpos = jnp.arange(S)[:, None] + (K - S)
+            s = jnp.where(qpos >= kpos[None, :][None, None], s, -1e30)
+        if m:
+            s = s + m[0]
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(q.dtype)
+
+    args = [_at(query), _at(key), _at(value), _at(seq_lens),
+            _at(kv_seq_lens)]
+    if mask is not None:
+        args.append(_at(mask))
+    return _apply("variable_length_memory_efficient_attention", fn, *args)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """Max encoder/decoder lengths for block attention scheduling
+    (reference: incubate/nn/functional/blha_get_max_len.py)."""
+    from ....ops.dispatch import apply as _apply, as_tensor as _at
+
+    def fn(enc, dec):
+        return jnp.max(enc), jnp.max(dec)
+
+    return _apply("blha_get_max_len", fn, _at(seq_lens_encoder),
+                  _at(seq_lens_decoder), n_outputs=2)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0, activation="gelu",
+        training=False, mode="upscale_in_train", ring_id=-1, name=None):
+    """Whole pre-LN transformer stack in one call (reference:
+    incubate/nn/functional/fused_transformer.py fused_multi_transformer —
+    the CUDA mega-kernel is one jitted XLA region here).  Supports the
+    encoder path (no cache) with optional additive attn_mask."""
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "decode-with-cache path: drive generation through "
+            "paddle_tpu.models (kv-cache attention lives there)")
+    num_layers = len(qkv_weights)
+    out = x
+    for i in range(num_layers):
+        residual = out
+        h = F.layer_norm(out, [out.shape[-1]], ln_scales[i], ln_biases[i],
+                         epsilon) if pre_layer_norm else out
+        from ....tensor.manipulation import reshape as _reshape
+        w = qkv_weights[i]
+        if w.ndim == 4:
+            # reference layout [3, num_heads, head_dim, embed]: flatten to
+            # a [embed, 3*H*Dh] matmul and remember the head split
+            heads, head_dim = int(w.shape[1]), int(w.shape[2])
+            wm = _reshape(w, [3 * heads * head_dim, w.shape[3]]).t()
+        else:
+            heads, head_dim = 1, None
+            wm = w
+        qkv = fused_linear(h, wm, qkv_biases[i])
+        B, S = qkv.shape[0], qkv.shape[1]
+        if head_dim is None:
+            head_dim = qkv.shape[-1] // 3
+        q, k, v = (t.squeeze(2) for t in _reshape(
+            qkv, [B, S, 3, -1]).split(3, axis=2))
+        q = _reshape(q, [B, S, heads, head_dim])
+        k = _reshape(k, [B, S, heads, head_dim])
+        v = _reshape(v, [B, S, heads, head_dim])
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+        attn = _reshape(attn, [B, S, -1])
+        attn = fused_linear(attn, linear_weights[i], linear_biases[i])
+        out = add(residual, F.dropout(attn, p=dropout_rate,
+                                      training=training, mode=mode))
+        residual = out
+        h = F.layer_norm(out, [out.shape[-1]], ffn_ln_scales[i],
+                         ffn_ln_biases[i], epsilon) if pre_layer_norm \
+            else out
+        h = fused_linear(h, ffn1_weights[i], ffn1_biases[i])
+        h = F.gelu(h) if activation == "gelu" else F.relu(h)
+        h = fused_linear(h, ffn2_weights[i], ffn2_biases[i])
+        out = add(residual, F.dropout(h, p=dropout_rate,
+                                      training=training, mode=mode))
+    return out
+
+
+def block_multihead_attention(*args, **kwargs):
+    """Paged/blocked KV-cache attention (reference:
+    incubate/nn/functional/block_multihead_attention.py — the vLLM-style
+    serving kernel).  The TPU serving path uses contiguous caches inside
+    jitted decode loops (models/ kv-cache attention); a paged-block table
+    has no benefit without the CUDA allocator it was built around."""
+    raise NotImplementedError(
+        "block_multihead_attention: use the contiguous kv-cache decode in "
+        "paddle_tpu.models / scaled_dot_product_attention — paged block "
+        "tables are a CUDA-allocator workaround with no TPU analog")

@@ -119,12 +119,17 @@ class SparseCooTensor:
         shape = tuple(self._shape)
 
         def fn(idx_a, vals_a):
+            # bool values can't scatter-add; accumulate as int and re-cast
+            # (duplicate coords OR together, matching add semantics)
+            is_bool = vals_a.dtype == jnp.bool_
+            acc = vals_a.astype(jnp.int32) if is_bool else vals_a
             flat = jnp.zeros(
                 (int(np.prod(shape[:idx_a.shape[0]])),)
-                + vals_a.shape[1:], vals_a.dtype)
+                + vals_a.shape[1:], acc.dtype)
             lin = jnp.ravel_multi_index(
                 tuple(idx_a), shape[:idx_a.shape[0]], mode="clip")
-            return flat.at[lin].add(vals_a).reshape(shape)
+            out = flat.at[lin].add(acc).reshape(shape)
+            return out.astype(jnp.bool_) if is_bool else out
 
         return apply("sparse_to_dense", fn, idx, vals)
 
@@ -496,3 +501,114 @@ def masked_matmul(x, y, mask, name=None):
                           [x.shape[0], y.shape[1]], coalesced=True)
     return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) \
         else out
+
+
+# ==========================================================================
+# long-tail sparse ops (reference: python/paddle/sparse/ unary/binary/
+# multiary — the remaining public surface)
+# ==========================================================================
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sum over a sparse tensor (reference: sparse/unary.py sum).
+    axis=None sums the values directly (zeros contribute nothing); an
+    explicit axis reduces through the dense form and re-sparsifies."""
+    from ..tensor import math as _m
+    if axis is None:
+        out = _m.sum(x.values() if callable(getattr(x, "values", None))
+                     else x._values)
+        return out if dtype is None else out.astype(dtype)
+    dense = _m.sum(x.to_dense(), axis=axis, keepdim=keepdim)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return to_sparse_coo(dense, max(1, dense.ndim))
+
+
+def transpose(x, perm, name=None):
+    """Permute a COO tensor by permuting its index rows (no dense
+    round-trip; reference: sparse/unary.py transpose)."""
+    from ..ops.dispatch import apply as _apply
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    perm = [int(p) for p in perm]
+    new_shape = [x.shape[p] for p in perm]
+    sd = x.sparse_dim
+    if sorted(perm[:sd]) != list(range(sd)) or \
+            perm[sd:] != list(range(sd, len(perm))):
+        # permuting dense trailing dims (or mixing sparse/dense) — the
+        # stored values would need reordering too; go through dense
+        from ..tensor.manipulation import transpose as dtrans
+        return to_sparse_coo(dtrans(x.to_dense(), perm), len(new_shape))
+    idx = x.indices()
+    rows = [idx[p] for p in perm[:sd]]
+    from ..tensor.manipulation import stack
+    new_idx = stack(rows, axis=0)
+    return SparseCooTensor(new_idx, x.values(), new_shape)
+
+
+def reshape(x, shape, name=None):
+    """Reshape via linearized COO coordinates (reference: sparse/unary.py
+    reshape)."""
+    import numpy as _np
+    from ..ops.dispatch import apply as _apply
+    from ..tensor.tensor import wrap_array
+    import jax.numpy as _jnp
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    old_shape = x.shape
+    total = int(_np.prod(old_shape))
+    shape = [int(s) for s in shape]
+    if -1 in shape:
+        known = int(_np.prod([s for s in shape if s != -1]))
+        shape = [total // known if s == -1 else s for s in shape]
+    if x.sparse_dim != len(old_shape):
+        from ..tensor.manipulation import reshape as drehape
+        return to_sparse_coo(drehape(x.to_dense(), shape), len(shape))
+    idx = x.indices()._data
+    mul = _jnp.asarray([int(_np.prod(old_shape[i + 1:]))
+                        for i in range(len(old_shape))])
+    flat = (idx * mul[:, None]).sum(0)
+    new_mul = [int(_np.prod(shape[i + 1:])) for i in range(len(shape))]
+    new_idx = _jnp.stack([(flat // m) % s for m, s in zip(new_mul, shape)])
+    return SparseCooTensor(wrap_array(new_idx), x.values(), shape)
+
+
+def isnan(x, name=None):
+    """Elementwise NaN test on the stored values (zeros are never NaN;
+    reference: sparse/unary.py isnan)."""
+    from ..tensor.math import isnan as disnan
+    if isinstance(x, SparseCsrTensor):
+        coo = x.to_sparse_coo()
+        return SparseCooTensor(coo.indices(), disnan(coo.values()),
+                               coo.shape)
+    return SparseCooTensor(x.indices(), disnan(x.values()), x.shape)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice through the dense form (reference: sparse/multiary slice)."""
+    from ..tensor.manipulation import slice as dslice
+    dense = dslice(x.to_dense(), axes, starts, ends)
+    return to_sparse_coo(dense, max(1, dense.ndim))
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at mask's sparsity pattern (reference:
+    sparse/binary.py mask_as)."""
+    from ..ops.dispatch import apply as _apply
+    from ..tensor.tensor import wrap_array
+    import jax.numpy as _jnp
+    if isinstance(mask, SparseCsrTensor):
+        mask = mask.to_sparse_coo()
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    idx = mask.indices()
+    vals = dense._data[tuple(idx._data[i] for i in range(idx.shape[0]))]
+    return SparseCooTensor(idx, wrap_array(vals), mask.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA of a sparse matrix via its dense form (reference:
+    sparse/multiary pca_lowrank; jax SVD does the work)."""
+    from ..tensor.linalg import pca_lowrank as dpca
+    return dpca(x.to_dense(), q=q, center=center, niter=niter)
+
+
+__all__ += ["sum", "transpose", "reshape", "isnan", "slice", "mask_as",
+            "pca_lowrank"]

@@ -227,3 +227,343 @@ class nn:
         if activation:
             out = getattr(F, activation)(out)
         return out
+
+
+# ---------------------------------------------------------------------------
+# program state + serialization (reference: python/paddle/static/io.py)
+# ---------------------------------------------------------------------------
+def save(program, model_path, protocol=4, **configs):
+    """Persist a 'program' — here a Layer or a state_dict — to
+    ``model_path`` (reference: static/io.py save)."""
+    from ..framework.io import save as fsave
+    state = program.state_dict() if hasattr(program, "state_dict") \
+        else program
+    fsave(state, model_path if model_path.endswith(".pdparams")
+          else model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as fload
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    state = fload(path)
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+        return program
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """state_dict as numpy arrays (reference: static/io.py
+    load_program_state)."""
+    state = load(None, model_path)
+    return {k: (v.numpy() if hasattr(v, "numpy") else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    if not hasattr(program, "set_state_dict"):
+        raise TypeError("pass the Layer to restore as `program`")
+    program.set_state_dict(state_dict)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """Serialized compute artifact: the StableHLO export bytes
+    (reference: static/io.py serialize_program serializes ProgramDesc)."""
+    import pickle
+    target = next((f for f in (fetch_vars if isinstance(
+        fetch_vars, (list, tuple)) else [fetch_vars])
+        if callable(f) and not isinstance(f, Tensor)), None)
+    if target is None:
+        raise TypeError("fetch_vars must include the model callable")
+    import tempfile, os
+    from ..inference import convert_to_export
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    specs = [(tuple(t.shape), str(t.dtype).replace("paddle.", ""))
+             for t in feeds]
+    d = tempfile.mkdtemp()
+    path = convert_to_export(target, specs, os.path.join(d, "m"))
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    target = next((f for f in (fetch_vars if isinstance(
+        fetch_vars, (list, tuple)) else [fetch_vars])
+        if hasattr(f, "state_dict")), None)
+    if target is None:
+        raise TypeError("fetch_vars must include the Layer")
+    state = {k: v.numpy() for k, v in target.state_dict().items()}
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data: bytes):
+    """Rehydrate a serialized program: returns a callable running the
+    StableHLO export (reference: static/io.py deserialize_program)."""
+    from jax import export as jexport
+    exp = jexport.deserialize(data)
+
+    def run(*inputs):
+        return exp.call(*inputs)
+
+    run.exported = exp
+    return run
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+        return program
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """The jit trace is already normalized (no feed/fetch pruning needed);
+    returns the program unchanged."""
+    return program
+
+
+# ---------------------------------------------------------------------------
+# vars + metric ops (reference: static/nn/common.py, static/nn/metric.py)
+# ---------------------------------------------------------------------------
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as np
+    from ..tensor.tensor import to_tensor
+    t = to_tensor(np.full(shape, value, dtype=str(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.param import Parameter, ParamAttr
+    from ..nn import initializer as I
+    init = default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    data = init(shape, dtype)
+    return Parameter(data, dtype=dtype, name=name)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy op (reference: static/nn/metric.py accuracy)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Batch AUC (reference: static/nn/metric.py auc) — returns
+    (auc_value, batch_auc, [state])."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    import numpy as np
+    m.update(np.asarray(input.numpy()), np.asarray(label.numpy()))
+    from ..tensor.tensor import to_tensor
+    v = to_tensor(np.asarray(m.accumulate(), np.float32))
+    return v, v, []
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug-print op (reference: static/nn/control_flow.py Print):
+    prints eagerly and returns the input unchanged."""
+    prefix = (message + " ") if message else ""
+    print(f"{prefix}{getattr(input, 'name', 'var')} "
+          f"shape={tuple(input.shape)} values="
+          f"{input.numpy().reshape(-1)[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Wrap a host python function as an op (reference:
+    static/nn/common.py py_func) — jax.pure_callback keeps it jittable."""
+    import jax
+    import numpy as np
+    from ..ops.dispatch import apply, as_tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+             for o in outs]
+
+    def fn(*arrays):
+        res = jax.pure_callback(
+            lambda *a: func(*[np.asarray(v) for v in a]),
+            specs if len(specs) > 1 else specs[0], *arrays,
+            vmap_method="sequential")
+        return res
+
+    return apply("py_func", fn, *[as_tensor(t) for t in xs],
+                 n_outputs=len(outs))
+
+
+from ..framework.param import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """Weight-normalized parameter attr (reference:
+    static/nn/common.py WeightNormParamAttr).  Carried as metadata; the
+    dynamic-graph weight_norm utility applies the reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, trainable=trainable)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: static/__init__.py
+    ExponentialMovingAverage): update() after each step; apply()/
+    restore() swap averaged weights for evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def _track(self, params):
+        self._params = list(params)
+        for p in self._params:
+            if id(p) not in self._ema:
+                # zero-initialized so the 1 - decay**t debias below is exact
+                self._ema[id(p)] = p._data * 0.0
+
+    def update(self, parameters=None):
+        if parameters is not None or not self._params:
+            import paddle_tpu  # default: all live parameters unavailable —
+            if parameters is None:
+                raise ValueError("pass parameters= on first update()")
+            self._track(parameters)
+        self._step += 1
+        d = self._decay
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1.0 - d) * p._data
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._backup = {id(p): p._data for p in self._params}
+            bias_fix = 1.0 - self._decay ** max(self._step, 1)
+            for p in self._params:
+                p._data = self._ema[id(p)] / bias_fix
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference: pybind/compiled_program.cc
+    BuildStrategy).  XLA owns fusion/memory decisions; fields are
+    recorded for compatibility."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """Reference: compiled_program.cc — wraps a program for execution.
+    jit compilation is implicit here; the wrapper preserves the API."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA devices in a TPU build (reference returns [] too)
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+    return guard()
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    return layer
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise RuntimeError("IPU devices are not supported by this build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU devices are not supported by this build")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (reference: static/nn/metric.py ctr_metric_bundle):
+    returns (sqrerr, abserr, prob, q, pos, total)."""
+    import numpy as np
+    from ..tensor.tensor import to_tensor
+    p = np.asarray(input.numpy()).reshape(-1)
+    y = np.asarray(label.numpy()).reshape(-1).astype(np.float64)
+    sqrerr = float(((p - y) ** 2).sum())
+    abserr = float(np.abs(p - y).sum())
+    prob = float(p.sum())
+    q = float(p.sum())
+    pos = float(y.sum())
+    total = float(len(y))
+    return tuple(to_tensor(np.asarray(v, np.float32))
+                 for v in (sqrerr, abserr, prob, q, pos, total))
+
+
+__all__ += ["save", "load", "load_program_state", "set_program_state",
+            "serialize_program", "serialize_persistables", "save_to_file",
+            "load_from_file", "deserialize_program",
+            "deserialize_persistables", "normalize_program",
+            "create_global_var", "create_parameter", "accuracy", "auc",
+            "Print", "py_func", "WeightNormParamAttr",
+            "ExponentialMovingAverage", "BuildStrategy", "CompiledProgram",
+            "cuda_places", "xpu_places", "ipu_shard_guard", "set_ipu_shard",
+            "IpuStrategy", "IpuCompiledProgram", "ctr_metric_bundle"]

@@ -101,7 +101,9 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
     x, y = as_tensor(x), as_tensor(y)
 
     def fn(a, b):
-        if p == 2.0 and "use_mm" in compute_mode:
+        if p == 2.0 and compute_mode in (
+                "use_mm_for_euclid_dist_if_necessary",
+                "use_mm_for_euclid_dist"):
             a2 = jnp.sum(a * a, -1, keepdims=True)
             b2 = jnp.sum(b * b, -1, keepdims=True)
             sq = a2 + jnp.swapaxes(b2, -1, -2) - 2 * (
@@ -245,8 +247,10 @@ def take(x, index, mode="raise", name=None):
         if mode == "wrap":
             i = i % n
         elif mode == "clip":
-            i = jnp.clip(i, -n, n - 1)
-        i = jnp.where(i < 0, i + n, i)
+            # clip clamps into [0, n-1]; negative indices do NOT wrap
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
         return flat[i]
 
     return apply("take", fn, x, index)

@@ -20,6 +20,7 @@ __all__ = [
     "triangular_solve", "lstsq", "pinv", "matrix_power", "matrix_rank",
     "cond", "lu", "lu_unpack", "corrcoef", "cov", "householder_product",
     "multi_dot", "svd_lowrank", "pca_lowrank", "matrix_exp", "ormqr",
+    "cholesky_inverse",
 ]
 
 
@@ -416,3 +417,18 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
         return out.reshape(batch + out.shape[-2:])
 
     return apply("ormqr", fn, x, tau, y)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference:
+    tensor/linalg.py cholesky_inverse): A^-1 = (LL^T)^-1 via two
+    triangular solves against the identity."""
+    x = as_tensor(x)
+
+    def fn(l):
+        n = l.shape[-1]
+        eye = jnp.eye(n, dtype=l.dtype)
+        li = jax.scipy.linalg.solve_triangular(l, eye, lower=not upper)
+        return li.T @ li if not upper else li @ li.T
+
+    return apply("cholesky_inverse", fn, x)

@@ -3,7 +3,7 @@
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401
 
-__all__ = ["dlpack", "cpp_extension", "try_import", "run_check"]
+__all__ = ["dlpack", "cpp_extension", "try_import", "run_check", "deprecated", "require_version"]
 
 
 def try_import(module_name, err_msg=None):
@@ -30,3 +30,45 @@ def run_check():
         paddle.device, "cuda") else 0
     print(f"paddle_tpu is installed successfully! "
           f"(backend devices: {max(n, 1)})")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    utils/deprecated.py): warns on call, errors at level 2."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated "
+               f"since {since or 'an earlier release'}"
+               + (f"; use {update_to} instead" if update_to else "")
+               + (f". Reason: {reason}" if reason else ""))
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level >= 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        inner.__deprecated_message__ = msg
+        return inner
+    return wrap
+
+
+def require_version(min_version, max_version=None):
+    """Assert the framework version lies in [min_version, max_version]
+    (reference: utils/install_check.py require_version)."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {cur} is below required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {cur} is above allowed {max_version}")
+    return True
