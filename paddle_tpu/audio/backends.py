@@ -61,6 +61,10 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
         w.setpos(frame_offset)
         n = num_frames if num_frames >= 0 else w.getnframes() - frame_offset
         raw = w.readframes(n)
+    if width not in (1, 2, 4):
+        raise NotImplementedError(
+            f"{width * 8}-bit PCM is not supported (8/16/32-bit only); "
+            f"convert the file or decode it externally")
     dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
     data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
     if normalize:

@@ -96,17 +96,19 @@ class TESS(_LocalArchiveDataset):
     emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
 
     def _collect(self, data_dir, mode):
-        files, labels = [], []
+        entries = []
         for root, _dirs, names in os.walk(data_dir):
-            for n in sorted(names):
+            for n in names:
                 if not n.lower().endswith(".wav"):
                     continue
                 emo = n.rsplit("_", 1)[-1][:-4].lower()
                 if emo in self.emotions:
-                    files.append(os.path.join(root, n))
-                    labels.append(self.emotions.index(emo))
-        # 9:1 train/dev split like the reference's n_folds handling
-        cut = int(len(files) * 0.9)
-        if mode == "train":
-            return files[:cut], labels[:cut]
-        return files[cut:], labels[cut:]
+                    entries.append((os.path.join(root, n),
+                                    self.emotions.index(emo)))
+        # deterministic per-SAMPLE 9:1 split (sort globally, every 10th
+        # sample is dev) — a directory-order cut would put whole emotion
+        # folders in one split and vary across filesystems
+        entries.sort()
+        keep = [(f, l) for i, (f, l) in enumerate(entries)
+                if (i % 10 == 9) == (mode != "train")]
+        return [f for f, _ in keep], [l for _, l in keep]

@@ -211,8 +211,7 @@ def is_compiled_with_distribute() -> bool:
 
 def is_compiled_with_custom_device(device_type: str) -> bool:
     import jax
-    return device_type in ("tpu", "axon") and \
-        jax.devices()[0].platform in ("tpu", "axon")
+    return jax.devices()[0].platform == device_type
 
 
 def get_all_device_type():

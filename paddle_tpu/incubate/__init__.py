@@ -45,7 +45,10 @@ class LookAhead:
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
         self.k = int(k)
-        self._slow = {}
+        # slow weights anchor at the CONSTRUCTION-time parameters (t=0),
+        # per the algorithm — a lazy first-sync init would make the first
+        # interpolation an identity
+        self._slow = {id(p): p._data for p in inner_optimizer._params()}
         self._steps = 0
 
     def _params(self):
@@ -58,9 +61,7 @@ class LookAhead:
         if self._steps % self.k:
             return
         for p in self.inner_optimizer._params():
-            slow = self._slow.get(id(p))
-            if slow is None:
-                slow = p._data
+            slow = self._slow.get(id(p), p._data)
             slow = slow + self.alpha * (p._data - slow)
             self._slow[id(p)] = slow
             p._data = slow
@@ -117,9 +118,11 @@ class ModelAverage:
         @contextlib.contextmanager
         def guard():
             self._backup = {id(p): p._data for p in self._parameters}
-            n = max(1, self._old_n + self._cur_n)
+            n = self._old_n + self._cur_n
             for p in self._parameters:
-                p._data = (self._old[id(p)] + self._cur[id(p)]) / n
+                if n > 0:
+                    p._data = (self._old[id(p)] + self._cur[id(p)]) / n
+                # n == 0 (no step() yet): current weights ARE the average
             try:
                 yield
             finally:

@@ -358,15 +358,18 @@ def fused_multi_transformer(
                          epsilon) if pre_layer_norm else out
         from ....tensor.manipulation import reshape as _reshape
         w = qkv_weights[i]
+        b = qkv_biases[i]
         if w.ndim == 4:
             # reference layout [3, num_heads, head_dim, embed]: flatten to
             # a [embed, 3*H*Dh] matmul and remember the head split
             heads, head_dim = int(w.shape[1]), int(w.shape[2])
             wm = _reshape(w, [3 * heads * head_dim, w.shape[3]]).t()
+            if b is not None and b.ndim > 1:
+                b = _reshape(b, [-1])
         else:
             heads, head_dim = 1, None
             wm = w
-        qkv = fused_linear(h, wm, qkv_biases[i])
+        qkv = fused_linear(h, wm, b)
         B, S = qkv.shape[0], qkv.shape[1]
         if head_dim is None:
             head_dim = qkv.shape[-1] // 3

@@ -270,7 +270,6 @@ def set_program_state(program, state_dict):
 def serialize_program(feed_vars, fetch_vars, **kwargs):
     """Serialized compute artifact: the StableHLO export bytes
     (reference: static/io.py serialize_program serializes ProgramDesc)."""
-    import pickle
     target = next((f for f in (fetch_vars if isinstance(
         fetch_vars, (list, tuple)) else [fetch_vars])
         if callable(f) and not isinstance(f, Tensor)), None)
@@ -282,10 +281,10 @@ def serialize_program(feed_vars, fetch_vars, **kwargs):
         else [feed_vars]
     specs = [(tuple(t.shape), str(t.dtype).replace("paddle.", ""))
              for t in feeds]
-    d = tempfile.mkdtemp()
-    path = convert_to_export(target, specs, os.path.join(d, "m"))
-    with open(path, "rb") as f:
-        return f.read()
+    with tempfile.TemporaryDirectory() as d:
+        path = convert_to_export(target, specs, os.path.join(d, "m"))
+        with open(path, "rb") as f:
+            return f.read()
 
 
 def serialize_persistables(feed_vars, fetch_vars, **kwargs):
@@ -355,10 +354,15 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     from ..framework.param import Parameter, ParamAttr
     from ..nn import initializer as I
-    init = default_initializer or (
+    attr = ParamAttr._to_attr(attr)
+    init = (attr.initializer if attr is not None and attr.initializer
+            else default_initializer) or (
         I.Constant(0.0) if is_bias else I.XavierNormal())
     data = init(shape, dtype)
-    return Parameter(data, dtype=dtype, name=name)
+    return Parameter(data, dtype=dtype,
+                     name=name or (attr.name if attr else None),
+                     trainable=attr.trainable if attr else True,
+                     attr=attr)
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):

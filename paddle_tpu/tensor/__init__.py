@@ -24,8 +24,9 @@ from .inplace import *  # noqa: F401,F403
 def _patch_tensor_methods() -> None:
     """Attach op functions + dunders to Tensor (reference:
     python/paddle/base/dygraph/tensor_patch_methods.py)."""
+    from . import extras, inplace
     mods = [math, manipulation, linalg, logic, search, stat, creation,
-            random]
+            random, extras, inplace]
     skip = {"to_tensor", "wrap_array", "is_tensor", "meshgrid",
             "broadcast_tensors", "add_n", "concat", "stack", "hstack",
             "vstack", "dstack", "column_stack", "row_stack", "einsum",
@@ -34,6 +35,9 @@ def _patch_tensor_methods() -> None:
             "triu_indices", "rand", "randn", "randint", "randperm",
             "uniform", "normal", "standard_normal", "create_parameter",
             "assign", "scatter_nd", "broadcast_shape",
+            # extras that are not tensor methods in the reference
+            "block_diag", "set_printoptions", "disable_signal_handler",
+            "check_shape", "flops", "LazyGuard", "batch",
             }
     for mod in mods:
         for name in getattr(mod, "__all__", []):
