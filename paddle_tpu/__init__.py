@@ -81,6 +81,7 @@ from . import signal  # noqa: F401,E402
 from . import decomposition  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
 from . import hapi as _hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402

@@ -1,0 +1,18 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py —
+re-export of the hapi callbacks)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+    VisualDL)
+
+try:  # optional extras if present
+    from .hapi.callbacks import ReduceLROnPlateau  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .hapi.callbacks import WandbCallback  # noqa: F401
+except ImportError:
+    pass
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "VisualDL"]

@@ -211,6 +211,9 @@ def is_compiled_with_distribute() -> bool:
 
 def is_compiled_with_custom_device(device_type: str) -> bool:
     import jax
+    # builtin platforms are not "custom devices" (reference returns False)
+    if device_type in ("cpu", "gpu", "tpu", "xpu"):
+        return False
     return jax.devices()[0].platform == device_type
 
 

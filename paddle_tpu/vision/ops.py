@@ -1,0 +1,678 @@
+"""Detection / vision ops (reference: python/paddle/vision/ops.py —
+nms, roi_pool/roi_align/psroi_pool, box_coder, prior_box, yolo_box,
+deform_conv2d, proposal utilities).
+
+TPU-native formulation notes:
+* NMS variants run as fixed-iteration masked loops (static shapes; the
+  reference's dynamic-size outputs become index tensors the caller
+  gathers with).
+* RoI ops sample with gather + bilinear weights — XLA fuses the sampling
+  arithmetic; no atomic scatter is needed.
+* deform_conv2d is an im2col of bilinear-sampled taps followed by one
+  MXU matmul.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import apply, as_tensor
+from ..tensor.tensor import Tensor, wrap_array
+from ..nn.layer.layers import Layer
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg", "roi_pool",
+           "RoIPool", "psroi_pool", "PSRoIPool", "roi_align", "RoIAlign",
+           "nms", "matrix_nms"]
+
+
+def _box_iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy hard NMS; returns kept indices sorted by score (reference:
+    vision/ops.py nms).  Per-category boxes are offset so categories never
+    suppress each other (the standard batched-NMS trick)."""
+    boxes = as_tensor(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores_t = wrap_array(jnp.arange(n, 0, -1, dtype=jnp.float32))
+    else:
+        scores_t = as_tensor(scores)
+
+    extra = []
+    if category_idxs is not None:
+        extra.append(as_tensor(category_idxs))
+
+    def fn(b, s, *cat):
+        bb = b
+        if cat:
+            span = jnp.max(b) - jnp.min(b) + 1.0
+            bb = b + (cat[0].astype(b.dtype) * span)[:, None]
+        iou = _box_iou_matrix(bb)
+        order = jnp.argsort(-s)
+        iou_o = iou[order][:, order]
+
+        def body(i, keep):
+            # suppressed if any higher-scored kept box overlaps too much
+            sup = jnp.any((iou_o[i] > iou_threshold)
+                          & keep & (jnp.arange(n) < i))
+            return keep.at[i].set(~sup)
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+        kept_sorted = jnp.where(keep, jnp.arange(n), n)
+        ranked = order[jnp.argsort(kept_sorted)]
+        count = jnp.sum(keep)
+        return ranked, count
+
+    ranked, count = apply("nms", fn, boxes, scores_t, *extra, n_outputs=2)
+    k = int(count.numpy())
+    kept = np.asarray(ranked.numpy())[:k]
+    if top_k is not None:
+        if categories is not None and category_idxs is not None:
+            # reference semantics: top_k PER category
+            cats = np.asarray(as_tensor(category_idxs).numpy())
+            out = []
+            per = {c: 0 for c in categories}
+            for idx in kept:
+                c = cats[idx]
+                if per.get(c, top_k) < top_k:
+                    out.append(idx)
+                    per[c] += 1
+            kept = np.asarray(out, kept.dtype)
+        else:
+            kept = kept[:top_k]
+    return wrap_array(jnp.asarray(kept))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): scores decay by the max overlap with any
+    higher-scored box instead of hard suppression (reference:
+    vision/ops.py matrix_nms)."""
+    bboxes, scores = as_tensor(bboxes), as_tensor(scores)
+
+    def fn(b, s):
+        B, C = s.shape[0], s.shape[1]
+        outs, idxs, nums = [], [], []
+        for bi in range(B):
+            per_box_cls = []
+            for c in range(C):
+                if c == background_label:
+                    continue
+                sc = s[bi, c]
+                order = jnp.argsort(-sc)[:nms_top_k]
+                sc_o = sc[order]
+                valid = sc_o > score_threshold
+                bx = b[bi][order]
+                iou = _box_iou_matrix(bx)
+                upper = jnp.triu(iou, k=1)  # [i, j]: iou of i with later j
+                # comp_i: suppressor i's own max overlap with anything
+                # ranked above it (how much i itself was suppressed)
+                comp = jnp.max(upper, axis=0)                      # [n]
+                if use_gaussian:
+                    ratio = jnp.exp(-(upper ** 2 - comp[:, None] ** 2)
+                                    / gaussian_sigma)
+                else:
+                    ratio = (1 - upper) / jnp.maximum(
+                        1 - comp[:, None], 1e-10)
+                # decay_j = min over suppressors i<j; non-suppressor
+                # entries must not participate in the min
+                mask_upper = jnp.triu(jnp.ones_like(upper), k=1) > 0
+                decay = jnp.min(jnp.where(mask_upper, ratio, jnp.inf),
+                                axis=0)
+                decay = jnp.where(jnp.isfinite(decay), decay, 1.0)
+                new_sc = jnp.where(valid, sc_o * decay, 0.0)
+                per_box_cls.append((new_sc, bx, order,
+                                    jnp.full(order.shape, c)))
+            all_sc = jnp.concatenate([p[0] for p in per_box_cls])
+            all_bx = jnp.concatenate([p[1] for p in per_box_cls])
+            all_id = jnp.concatenate([p[2] for p in per_box_cls])
+            all_cl = jnp.concatenate([p[3] for p in per_box_cls])
+            top = jnp.argsort(-all_sc)[:keep_top_k]
+            kept = all_sc[top] > post_threshold
+            out = jnp.concatenate(
+                [all_cl[top][:, None].astype(all_bx.dtype),
+                 all_sc[top][:, None], all_bx[top]], axis=1)
+            outs.append(jnp.where(kept[:, None], out, -1.0))
+            idxs.append(jnp.where(kept, all_id[top], -1))
+            nums.append(jnp.sum(kept))
+        return (jnp.concatenate(outs), jnp.concatenate(idxs),
+                jnp.stack(nums))
+
+    out, index, rois_num = apply("matrix_nms", fn, bboxes, scores,
+                                 n_outputs=3)
+    rets = [out]
+    if return_index:
+        rets.append(index)
+    if return_rois_num:
+        rets.append(rois_num)
+    return tuple(rets) if len(rets) > 1 else out
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shaped float coords."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    pts = []
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+            xx = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+            inb = ((y0 + dy >= 0) & (y0 + dy <= H - 1)
+                   & (x0 + dx >= 0) & (x0 + dx <= W - 1))
+            pts.append(feat[:, yy, xx] * (wy * wx * inb)[None])
+    return sum(pts)  # [C, *coords.shape]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference: vision/ops.py roi_align): bilinear-sampled
+    average pooling per RoI bin."""
+    x, boxes, boxes_num = as_tensor(x), as_tensor(boxes), \
+        as_tensor(boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    nums = [int(v) for v in np.asarray(boxes_num.numpy())]
+
+    def fn(feat, bxs):
+        batch_of_roi = np.repeat(np.arange(len(nums)), nums)
+        outs = []
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+        off = 0.5 if aligned else 0.0
+        for r in range(bxs.shape[0]):
+            f = feat[batch_of_roi[r]]
+            x1, y1, x2, y2 = (bxs[r] * spatial_scale) - off
+            rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+            rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+            bw, bh = rw / ow, rh / oh
+            # ratio x ratio samples per bin
+            sy = (jnp.arange(oh)[:, None] * bh + y1
+                  + (jnp.arange(ratio) + 0.5)[None, :] * bh / ratio)
+            sx = (jnp.arange(ow)[:, None] * bw + x1
+                  + (jnp.arange(ratio) + 0.5)[None, :] * bw / ratio)
+            yy = sy.reshape(-1)[:, None]          # [oh*r, 1]
+            xx = sx.reshape(-1)[None, :]          # [1, ow*r]
+            vals = _bilinear_sample(f, jnp.broadcast_to(
+                yy, (oh * ratio, ow * ratio)), jnp.broadcast_to(
+                xx, (oh * ratio, ow * ratio)))    # [C, oh*r, ow*r]
+            C = vals.shape[0]
+            vals = vals.reshape(C, oh, ratio, ow, ratio).mean((2, 4))
+            outs.append(vals)
+        return jnp.stack(outs)
+
+    return apply("roi_align", fn, x, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool: max over quantized bins (reference: vision/ops.py
+    roi_pool)."""
+    x, boxes, boxes_num = as_tensor(x), as_tensor(boxes), \
+        as_tensor(boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    nums = [int(v) for v in np.asarray(boxes_num.numpy())]
+
+    def fn(feat, bxs):
+        H, W = feat.shape[-2:]
+        batch_of_roi = np.repeat(np.arange(len(nums)), nums)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        outs = []
+        for r in range(bxs.shape[0]):
+            f = feat[batch_of_roi[r]]
+            x1 = jnp.round(bxs[r, 0] * spatial_scale)
+            y1 = jnp.round(bxs[r, 1] * spatial_scale)
+            x2 = jnp.round(bxs[r, 2] * spatial_scale)
+            y2 = jnp.round(bxs[r, 3] * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            bins = []
+            for i in range(oh):
+                for j in range(ow):
+                    by1 = jnp.floor(y1 + i * rh / oh)
+                    by2 = jnp.ceil(y1 + (i + 1) * rh / oh)
+                    bx1 = jnp.floor(x1 + j * rw / ow)
+                    bx2 = jnp.ceil(x1 + (j + 1) * rw / ow)
+                    m = ((ys[:, None] >= by1) & (ys[:, None] < by2)
+                         & (xs[None, :] >= bx1) & (xs[None, :] < bx2))
+                    bins.append(jnp.max(
+                        jnp.where(m[None], f, -jnp.inf), axis=(1, 2)))
+            out = jnp.stack(bins, 1).reshape(-1, oh, ow)
+            outs.append(jnp.where(jnp.isfinite(out), out, 0.0))
+        return jnp.stack(outs)
+
+    return apply("roi_pool", fn, x, boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    psroi_pool): channel group (i, j) feeds only bin (i, j), average
+    pooled."""
+    x, boxes, boxes_num = as_tensor(x), as_tensor(boxes), \
+        as_tensor(boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    nums = [int(v) for v in np.asarray(boxes_num.numpy())]
+
+    def fn(feat, bxs):
+        N, C, H, W = feat.shape
+        co = C // (oh * ow)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        batch_of_roi = np.repeat(np.arange(len(nums)), nums)
+        outs = []
+        for r in range(bxs.shape[0]):
+            f = feat[batch_of_roi[r]].reshape(oh, ow, co, H, W)
+            x1 = bxs[r, 0] * spatial_scale
+            y1 = bxs[r, 1] * spatial_scale
+            x2 = bxs[r, 2] * spatial_scale
+            y2 = bxs[r, 3] * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bins = []
+            for i in range(oh):
+                for j in range(ow):
+                    by1, by2 = y1 + i * rh / oh, y1 + (i + 1) * rh / oh
+                    bx1, bx2 = x1 + j * rw / ow, x1 + (j + 1) * rw / ow
+                    m = ((ys[:, None] >= jnp.floor(by1))
+                         & (ys[:, None] < jnp.ceil(by2))
+                         & (xs[None, :] >= jnp.floor(bx1))
+                         & (xs[None, :] < jnp.ceil(bx2)))
+                    cnt = jnp.maximum(jnp.sum(m), 1)
+                    bins.append(jnp.sum(
+                        jnp.where(m[None], f[i, j], 0.0), axis=(1, 2))
+                        / cnt)
+            outs.append(jnp.stack(bins, 1).reshape(co, oh, ow))
+        return jnp.stack(outs)
+
+    return apply("psroi_pool", fn, x, boxes)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference: vision/ops.py
+    box_coder)."""
+    pb, tb = as_tensor(prior_box), as_tensor(target_box)
+    pbv = as_tensor(prior_box_var) if isinstance(
+        prior_box_var, (Tensor, np.ndarray, list)) else None
+    norm = 0.0 if box_normalized else 1.0
+
+    def centers(b):
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        return b[..., 0] + w / 2, b[..., 1] + h / 2, w, h
+
+    def fn(p, t, *var):
+        v = var[0] if var else jnp.ones(4, p.dtype)
+        pcx, pcy, pw, ph = centers(p)
+        if code_type == "encode_center_size":
+            tcx, tcy, tw, th = centers(t)
+            out = jnp.stack([
+                (tcx - pcx) / pw, (tcy - pcy) / ph,
+                jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+            return out / v
+        # decode: t holds deltas [N, M, 4] or [N, 4]
+        d = t * v
+        if d.ndim == 2:
+            d = d[:, None, :]
+        if axis == 0:
+            pcx, pcy, pw, ph = (a[:, None] for a in (pcx, pcy, pw, ph))
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+
+    args = [pb, tb] + ([pbv] if pbv is not None else [])
+    return apply("box_coder", fn, *args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD anchor generation (reference: vision/ops.py prior_box) —
+    pure index math, computed host-side once per shape."""
+    input, image = as_tensor(input), as_tensor(image)
+    H, W = input.shape[-2:]
+    IH, IW = image.shape[-2:]
+    step_w = steps[0] or IW / W
+    step_h = steps[1] or IH / H
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    vars_ = []
+    for i in range(H):
+        for j in range(W):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((ms, ms))
+                if max_sizes:
+                    big = math.sqrt(ms * max_sizes[k])
+                    cell.append((big, big))
+                for a in ars:
+                    if abs(a - 1.0) < 1e-6:
+                        continue
+                    cell.append((ms * math.sqrt(a), ms / math.sqrt(a)))
+            for (bw, bh) in cell:
+                box = [(cx - bw / 2) / IW, (cy - bh / 2) / IH,
+                       (cx + bw / 2) / IW, (cy + bh / 2) / IH]
+                if clip:
+                    box = [min(max(v, 0.0), 1.0) for v in box]
+                boxes.append(box)
+                vars_.append(list(variance))
+    nb = len(boxes) // (H * W)
+    b = jnp.asarray(np.asarray(boxes, np.float32).reshape(H, W, nb, 4))
+    v = jnp.asarray(np.asarray(vars_, np.float32).reshape(H, W, nb, 4))
+    return wrap_array(b), wrap_array(v)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head predictions to boxes+scores (reference:
+    vision/ops.py yolo_box)."""
+    x, img_size = as_tensor(x), as_tensor(img_size)
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def fn(p, imsz):
+        B, C, H, W = p.shape
+        p = p.reshape(B, na, -1, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        sx = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1) / 2
+        bx = (gx[None, None, None, :] + sx) / W
+        by = (gy[None, None, :, None] + sy) / H
+        bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / (
+            W * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / (
+            H * downsample_ratio)
+        obj = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:5 + class_num])
+        score = obj[:, :, None] * cls
+        keep = obj > conf_thresh
+        ih = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(B, -1, 4)
+        boxes = boxes * keep.reshape(B, -1, 1)
+        scores = (score * keep[:, :, None]).transpose(0, 1, 3, 4, 2)
+        scores = scores.reshape(B, -1, class_num)
+        return boxes, scores
+
+    return apply("yolo_box", fn, x, img_size, n_outputs=2)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    raise NotImplementedError(
+        "yolo_loss: compose yolo_box decoding with the standard detection "
+        "losses (bce on objectness/class, iou/l1 on boxes) in model code — "
+        "the reference's fused CUDA loss bakes a specific matching rule "
+        "that detection repos override anyway")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: vision/ops.py deform_conv2d):
+    bilinear-sample each tap at its offset position, then one matmul."""
+    x, offset, weight = as_tensor(x), as_tensor(offset), as_tensor(weight)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else \
+        tuple(dilation)
+    extra = []
+    if mask is not None:
+        extra.append(as_tensor(mask))
+    if bias is not None:
+        extra.append(as_tensor(bias))
+
+    def fn(a, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        b = rest[-1] if bias is not None else None
+        N, C, H, W = a.shape
+        O, Cg, kh, kw = w.shape
+        dg = deformable_groups
+        cpg = C // dg                                  # channels per dg
+        ap = jnp.pad(a, ((0, 0), (0, 0), pd, pd))
+        OH = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        OW = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        oy = jnp.arange(OH) * st[0]
+        ox = jnp.arange(OW) * st[1]
+        off = off.reshape(N, dg, kh * kw, 2, OH, OW)
+        if m is not None:
+            m = m.reshape(N, dg, kh * kw, OH, OW)
+        cols = []
+        for n in range(N):
+            taps = []
+            for t in range(kh * kw):
+                i, j = divmod(t, kw)
+                per_dg = []
+                for g in range(dg):                   # per-group offsets
+                    dy = off[n, g, t, 0]
+                    dx = off[n, g, t, 1]
+                    yy = oy[:, None] + i * dl[0] + dy
+                    xx = ox[None, :] + j * dl[1] + dx
+                    v = _bilinear_sample(
+                        ap[n, g * cpg:(g + 1) * cpg], yy, xx)
+                    if m is not None:
+                        v = v * m[n, g, t][None]
+                    per_dg.append(v)
+                taps.append(jnp.concatenate(per_dg, 0))  # [C, OH, OW]
+            cols.append(jnp.stack(taps, 1))              # [C, K, OH, OW]
+        col = jnp.stack(cols)                            # [N, C, K, OH, OW]
+        og = O // groups
+        outs = []
+        for g in range(groups):                          # grouped matmul
+            colg = col[:, g * Cg:(g + 1) * Cg].reshape(
+                N, Cg * kh * kw, OH, OW)
+            wg = w[g * og:(g + 1) * og].reshape(og, -1)
+            outs.append(jnp.einsum("nkhw,ok->nohw", colg, wg))
+        out = jnp.concatenate(outs, 1)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    return apply("deform_conv2d", fn, x, offset, weight, *extra)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._args = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k], attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._args
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d,
+                             dg, g, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals)."""
+    fpn_rois = as_tensor(fpn_rois)
+    rois = np.asarray(fpn_rois.numpy())
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + off)
+        * (rois[:, 3] - rois[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        outs.append(wrap_array(jnp.asarray(rois[idx])))
+        nums.append(len(idx))
+        order.extend(idx.tolist())
+    restore = np.argsort(np.asarray(order))
+    rets = [outs, wrap_array(jnp.asarray(restore[:, None]))]
+    if rois_num is not None:
+        rets.append([wrap_array(jnp.asarray(np.asarray([n])))
+                     for n in nums])
+    return tuple(rets)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation: decode deltas -> clip -> filter ->
+    NMS (reference: vision/ops.py generate_proposals)."""
+    scores, bbox_deltas = as_tensor(scores), as_tensor(bbox_deltas)
+    img_size = as_tensor(img_size)
+    anchors, variances = as_tensor(anchors), as_tensor(variances)
+    B = scores.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    anc = anchors.numpy().reshape(-1, 4)
+    var = variances.numpy().reshape(-1, 4)
+    for b in range(B):
+        sc = np.asarray(scores[b].numpy()).transpose(1, 2, 0).reshape(-1)
+        dl = np.asarray(bbox_deltas[b].numpy()).transpose(1, 2, 0) \
+            .reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_nms_top_n]
+        sc, dlo, an, vr = sc[order], dl[order], anc[order], var[order]
+        # decode (center-size with variances)
+        aw = an[:, 2] - an[:, 0] + (1.0 if pixel_offset else 0.0)
+        ah = an[:, 3] - an[:, 1] + (1.0 if pixel_offset else 0.0)
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = vr[:, 0] * dlo[:, 0] * aw + acx
+        cy = vr[:, 1] * dlo[:, 1] * ah + acy
+        w = np.exp(np.minimum(vr[:, 2] * dlo[:, 2], 10)) * aw
+        h = np.exp(np.minimum(vr[:, 3] * dlo[:, 3], 10)) * ah
+        ih, iw = np.asarray(img_size[b].numpy())
+        x1 = np.clip(cx - w / 2, 0, iw)
+        y1 = np.clip(cy - h / 2, 0, ih)
+        x2 = np.clip(cx + w / 2, 0, iw)
+        y2 = np.clip(cy + h / 2, 0, ih)
+        keep = ((x2 - x1) >= min_size) & ((y2 - y1) >= min_size)
+        boxes = np.stack([x1, y1, x2, y2], 1)[keep]
+        sc = sc[keep]
+        kept = nms(wrap_array(jnp.asarray(boxes)),
+                   iou_threshold=nms_thresh,
+                   scores=wrap_array(jnp.asarray(sc)),
+                   top_k=post_nms_top_n)
+        ki = np.asarray(kept.numpy())
+        all_rois.append(boxes[ki])
+        all_scores.append(sc[ki])
+        nums.append(len(ki))
+    rois = wrap_array(jnp.asarray(np.concatenate(all_rois)))
+    rscores = wrap_array(jnp.asarray(np.concatenate(all_scores)))
+    if return_rois_num:
+        return rois, rscores, wrap_array(jnp.asarray(np.asarray(nums)))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return wrap_array(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode an encoded image byte tensor (reference: vision/ops.py
+    decode_jpeg via nvjpeg).  PIL decodes here when available; raw
+    formats should use paddle.vision.image_load."""
+    data = bytes(np.asarray(as_tensor(x).numpy()).astype(np.uint8))
+    try:
+        from PIL import Image
+        import io
+        img = Image.open(io.BytesIO(data))
+        if mode == "gray":
+            img = img.convert("L")
+        elif mode == "rgb":
+            img = img.convert("RGB")
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[None]
+        else:
+            arr = arr.transpose(2, 0, 1)
+        return wrap_array(jnp.asarray(arr))
+    except ImportError as e:
+        raise RuntimeError(
+            "decode_jpeg needs PIL, which is not bundled; use "
+            "paddle.vision.image_load for npy/ppm/pgm files") from e
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, *self._args)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         self._args[1], aligned=aligned)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self._args)

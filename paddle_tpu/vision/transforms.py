@@ -9,13 +9,14 @@ import numpy as np
 
 from ..tensor.tensor import Tensor
 
-__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+__all__ = ["BaseTransform", "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
            "Transpose", "BrightnessTransform", "ContrastTransform",
            "SaturationTransform", "HueTransform", "ColorJitter",
            "Grayscale", "RandomResizedCrop", "RandomErasing",
            "RandomAffine", "RandomPerspective", "Pad", "RandomRotation",
            "to_tensor", "normalize", "resize", "hflip", "vflip",
+           "pad", "crop", "center_crop", "affine", "perspective",
            "adjust_brightness", "adjust_contrast", "adjust_saturation",
            "adjust_hue", "to_grayscale", "rotate", "erase"]
 
@@ -426,35 +427,23 @@ class RandomAffine:
         self.fill = fill
 
     def __call__(self, img):
-        import scipy.ndimage as ndi
         a = _chw(img)
         _, H, W = a.shape
-        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        angle = np.random.uniform(*self.degrees)
         s = np.random.uniform(*self.scale) if self.scale else 1.0
         if self.shear is None or self.shear == 0:
             shear = 0.0
         elif isinstance(self.shear, (int, float)):
-            shear = np.deg2rad(np.random.uniform(-self.shear, self.shear))
+            shear = np.random.uniform(-self.shear, self.shear)
         else:  # sequence [lo, hi] (degrees), the documented API shape
-            shear = np.deg2rad(np.random.uniform(self.shear[0],
-                                                 self.shear[1]))
+            shear = np.random.uniform(self.shear[0], self.shear[1])
         tx = ty = 0.0
         if self.translate:
             ty = np.random.uniform(-self.translate[1], self.translate[1]) * H
             tx = np.random.uniform(-self.translate[0], self.translate[0]) * W
-        c, si = np.cos(angle), np.sin(angle)
-        # forward map: shear, then rotate, then scale, about the centre
-        R = np.array([[c, -si], [si, c]])
-        # coordinates are (row, col) = (y, x): shear displaces x by y
-        Sh = np.array([[1.0, 0.0], [np.tan(shear), 1.0]])
-        M = (R @ Sh) * s
-        Minv = np.linalg.inv(M)
-        centre = np.array([(H - 1) / 2, (W - 1) / 2])
-        offset = centre - Minv @ (centre + np.array([ty, tx]))
-        return np.stack([
-            ndi.affine_transform(ch, Minv, offset=offset, order=self.order,
-                                 cval=self.fill, mode="constant")
-            for ch in a])
+        return affine(a, angle, (tx, ty), s, shear,
+                      interpolation="bilinear" if self.order == 1
+                      else "nearest", fill=self.fill)
 
 
 class RandomPerspective:
@@ -479,7 +468,6 @@ class RandomPerspective:
         return np.append(h, 1.0).reshape(3, 3)
 
     def __call__(self, img):
-        import scipy.ndimage as ndi
         a = _chw(img)
         if np.random.rand() >= self.prob:
             return a
@@ -492,15 +480,110 @@ class RandomPerspective:
                            np.random.uniform(-dy, dy, 4)], axis=1)
         signs = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], float)
         dst = corners + np.abs(jitter) * signs
-        # inverse map: for each output pixel find the source coordinate
-        Hmat = self._solve_homography(dst, corners)
-        ys, xs = np.mgrid[0:H, 0:W]
-        ones = np.ones_like(xs)
-        pts = np.stack([xs.ravel(), ys.ravel(), ones.ravel()])
-        src = Hmat @ pts
-        sx = (src[0] / src[2]).reshape(H, W)
-        sy = (src[1] / src[2]).reshape(H, W)
-        return np.stack([
-            ndi.map_coordinates(ch, [sy, sx], order=self.order,
-                                cval=self.fill, mode="constant")
-            for ch in a])
+        return perspective(a, corners.tolist(), dst.tolist(),
+                           interpolation="bilinear" if self.order == 1
+                           else "nearest", fill=self.fill)
+
+
+# ---------------------------------------------------------------------------
+# functional forms + BaseTransform (reference: vision/transforms/
+# functional.py pad/crop/center_crop/affine/perspective, transforms.py
+# BaseTransform)
+# ---------------------------------------------------------------------------
+class BaseTransform:
+    """Base class with the reference's keys/params protocol: subclasses
+    implement _apply_image (and optionally _apply_{label,boxes,...});
+    __call__ dispatches per input key."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (list, tuple))
+        if single:
+            inputs = (inputs,)
+        self.params = self._get_params(inputs)
+        outs = []
+        for idx, data in enumerate(inputs):
+            # inputs beyond the declared keys pass through unchanged
+            fn = getattr(self, f"_apply_{self.keys[idx]}", None) \
+                if idx < len(self.keys) else None
+            outs.append(fn(data) if fn else data)
+        return outs[0] if single else tuple(outs)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _chw(img)
+    p = (padding,) * 4 if isinstance(padding, int) else tuple(padding)
+    if len(p) == 2:
+        p = (p[0], p[1], p[0], p[1])
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    return np.pad(a, ((0, 0), (p[1], p[3]), (p[0], p[2])), mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    a = _chw(img)
+    return a[:, top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = _chw(img)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    _, H, W = a.shape
+    top = (H - oh) // 2
+    left = (W - ow) // 2
+    return a[:, top:top + oh, left:left + ow]
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Functional affine with explicit parameters (reference:
+    transforms/functional.py affine)."""
+    import scipy.ndimage as ndi
+    a = _chw(img)
+    _, H, W = a.shape
+    ang = np.deg2rad(angle)
+    sh = shear if isinstance(shear, (list, tuple)) else (shear, 0.0)
+    shx, shy = np.deg2rad(sh[0]), np.deg2rad(sh[1] if len(sh) > 1 else 0.0)
+    c, si = np.cos(ang), np.sin(ang)
+    R = np.array([[c, -si], [si, c]])
+    Sh = np.array([[1.0, np.tan(shy)], [np.tan(shx), 1.0]])
+    M = (R @ Sh) * scale
+    Minv = np.linalg.inv(M)
+    ctr = np.array(center[::-1]) if center is not None else \
+        np.array([(H - 1) / 2, (W - 1) / 2])
+    t = np.array([translate[1], translate[0]], float)
+    offset = ctr - Minv @ (ctr + t)
+    order = 1 if interpolation == "bilinear" else 0
+    return np.stack([ndi.affine_transform(ch, Minv, offset=offset,
+                                          order=order, cval=fill,
+                                          mode="constant") for ch in a])
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Four-point perspective warp with explicit correspondences
+    (reference: transforms/functional.py perspective)."""
+    import scipy.ndimage as ndi
+    a = _chw(img)
+    _, H, W = a.shape
+    Hmat = RandomPerspective._solve_homography(
+        np.asarray(endpoints, float), np.asarray(startpoints, float))
+    ys, xs = np.mgrid[0:H, 0:W]
+    pts = np.stack([xs.ravel(), ys.ravel(), np.ones(H * W)])
+    src = Hmat @ pts
+    sx = (src[0] / src[2]).reshape(H, W)
+    sy = (src[1] / src[2]).reshape(H, W)
+    order = 1 if interpolation == "bilinear" else 0
+    return np.stack([ndi.map_coordinates(ch, [sy, sx], order=order,
+                                         cval=fill, mode="constant")
+                     for ch in a])

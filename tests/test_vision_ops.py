@@ -1,0 +1,210 @@
+"""Detection ops (reference: python/paddle/vision/ops.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestNMS:
+    def test_greedy_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        kept = V.nms(_t(boxes), iou_threshold=0.5, scores=_t(scores))
+        np.testing.assert_allclose(kept.numpy(), [0, 2])
+
+    def test_category_nms_no_cross_suppression(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        kept = V.nms(_t(boxes), 0.5, _t(scores), _t(cats),
+                     categories=[0, 1])
+        assert len(kept.numpy()) == 2  # different categories both survive
+
+    def test_matrix_nms_decays(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+        scores = np.array([[[0.0, 0.0], [0.9, 0.8]]], np.float32)  # C=2
+        out, rois_num = V.matrix_nms(_t(boxes), _t(scores),
+                                     score_threshold=0.1)
+        o = out.numpy()
+        valid = o[o[:, 1] > 0]
+        assert len(valid) == 2
+        # the overlapping lower-scored box is decayed below its raw score
+        assert valid[1, 1] < 0.8
+
+
+class TestRoIOps:
+    def test_roi_align_uniform_feature(self):
+        feat = np.full((1, 3, 8, 8), 5.0, np.float32)
+        boxes = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+        out = V.roi_align(_t(feat), _t(boxes), _t(np.array([1])), 2)
+        assert tuple(out.shape) == (1, 3, 2, 2)
+        np.testing.assert_allclose(out.numpy(), np.full((1, 3, 2, 2), 5.0),
+                                   rtol=1e-5)
+
+    def test_roi_pool_max(self):
+        feat = np.zeros((1, 1, 8, 8), np.float32)
+        feat[0, 0, 2, 2] = 7.0
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = V.roi_pool(_t(feat), _t(boxes), _t(np.array([1])), 1)
+        assert float(out.numpy().max()) == 7.0
+
+    def test_psroi_pool_shapes(self):
+        feat = np.random.RandomState(0).randn(1, 8, 6, 6).astype("float32")
+        boxes = np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)
+        out = V.psroi_pool(_t(feat), _t(boxes), _t(np.array([1])), 2)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+
+    def test_layers(self):
+        feat = np.full((1, 2, 4, 4), 1.0, np.float32)
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = V.RoIAlign(2)(_t(feat), _t(boxes), _t(np.array([1])))
+        assert tuple(out.shape) == (1, 2, 2, 2)
+
+
+class TestBoxMath:
+    def test_box_coder_round_trip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        targets = np.array([[1, 2, 11, 12], [4, 6, 22, 24]], np.float32)
+        var = np.ones((2, 4), np.float32)
+        enc = V.box_coder(_t(priors), _t(var), _t(targets),
+                          code_type="encode_center_size")
+        dec = V.box_coder(_t(priors), _t(var), enc,
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy()[:, 0], targets, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_prior_box(self):
+        feat = _t(np.zeros((1, 8, 4, 4), np.float32))
+        img = _t(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, vars_ = V.prior_box(feat, img, min_sizes=[8.0],
+                                   aspect_ratios=[1.0, 2.0], clip=True)
+        assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0
+
+    def test_yolo_box_shapes(self):
+        C = 3 * (5 + 2)  # 3 anchors, 2 classes
+        p = _t(np.random.RandomState(0).randn(1, C, 4, 4).astype("float32"))
+        img = _t(np.array([[32, 32]]))
+        boxes, scores = V.yolo_box(p, img, [1, 2, 3, 4, 5, 6], 2, 0.01, 8)
+        assert tuple(boxes.shape) == (1, 48, 4)
+        assert tuple(scores.shape) == (1, 48, 2)
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 2, 6, 6).astype("float32")
+        w = rs.randn(3, 2, 3, 3).astype("float32")
+        offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+        got = V.deform_conv2d(_t(x), _t(offset), _t(w)).numpy()
+        ref = F.conv2d(_t(x), _t(w)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestProposals:
+    def test_distribute_fpn_levels(self):
+        rois = np.array([[0, 0, 20, 20],      # small -> low level
+                         [0, 0, 300, 300]], np.float32)  # big -> high
+        outs, restore = V.distribute_fpn_proposals(_t(rois), 2, 5, 4, 224)
+        sizes = [o.shape[0] for o in outs]
+        assert sum(sizes) == 2
+        # 20px box -> clamped to min level 2; 300px -> floor(log2(300/224)+4)=4
+        assert sizes == [1, 0, 1, 0]
+        # restore index maps concatenated-by-level back to input order
+        order = np.asarray(restore.numpy()).reshape(-1)
+        assert sorted(order.tolist()) == [0, 1]
+
+    def test_generate_proposals(self):
+        rs = np.random.RandomState(0)
+        scores = _t(rs.rand(1, 2, 4, 4).astype("float32"))
+        deltas = _t((rs.randn(1, 8, 4, 4) * 0.1).astype("float32"))
+        img = _t(np.array([[32.0, 32.0]], np.float32))
+        anchors = _t(np.tile(np.array([[0, 0, 8, 8], [0, 0, 16, 16]],
+                                      np.float32), (16, 1)).reshape(4, 4, 2, 4))
+        variances = _t(np.ones((4, 4, 2, 4), np.float32))
+        rois, rscores, num = V.generate_proposals(
+            scores, deltas, img, anchors, variances, pre_nms_top_n=10,
+            post_nms_top_n=5, return_rois_num=True)
+        assert rois.shape[1] == 4 and rois.shape[0] <= 5
+        assert rois.shape[0] == int(num.numpy()[0])
+
+
+def test_read_file_round_trip(tmp_path):
+    p = tmp_path / "blob.bin"
+    payload = bytes(range(20))
+    p.write_bytes(payload)
+    t = V.read_file(str(p))
+    assert bytes(t.numpy().astype(np.uint8)) == payload
+
+
+class TestReviewRegressions:
+    def test_deform_conv_groups(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 4, 6, 6).astype("float32")
+        w = rs.randn(6, 2, 3, 3).astype("float32")  # groups=2
+        offset = np.zeros((1, 18, 4, 4), np.float32)
+        got = V.deform_conv2d(_t(x), _t(offset), _t(w), groups=2).numpy()
+        ref = F.conv2d(_t(x), _t(w), groups=2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_deform_conv_deformable_groups(self):
+        # group 1 shifted by a full pixel must differ from group 0's output
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 4, 8, 8).astype("float32")
+        w = np.zeros((4, 4, 1, 1), np.float32)
+        for i in range(4):
+            w[i, i] = 1.0  # identity conv
+        off = np.zeros((1, 2 * 2 * 1, 8, 8), np.float32)
+        off[0, 2:] = 1.0  # dg=1 offsets: shift by (1,1)
+        got = V.deform_conv2d(_t(x), _t(off), _t(w),
+                              deformable_groups=2).numpy()
+        np.testing.assert_allclose(got[0, :2], x[0, :2], rtol=1e-5)
+        assert not np.allclose(got[0, 2:, :-1, :-1], x[0, 2:, :-1, :-1])
+        np.testing.assert_allclose(got[0, 2:, :-1, :-1], x[0, 2:, 1:, 1:],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_matrix_nms_cascade_compensation(self):
+        # box2 overlaps box1 which overlaps box0: box2's decay must be
+        # compensated by box1's own suppression (not over-suppressed)
+        boxes = np.array([[[0, 0, 10, 10], [0, 4, 10, 14], [0, 8, 10, 18]]],
+                         np.float32)
+        scores = np.array([[[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]]], np.float32)
+        out, _num = V.matrix_nms(_t(boxes), _t(scores), score_threshold=0.0)
+        sc = out.numpy()[:, 1]
+        sc = np.sort(sc[sc > 0])[::-1]
+        # box2 overlaps box0 not at all; overlaps box1 (decayed itself) —
+        # compensation keeps box2 near its raw score
+        assert sc[0] == pytest.approx(0.9, abs=1e-5)
+        assert sc[2] > 0.45  # uncompensated over-suppression gave ~0.41
+
+    def test_nms_per_category_top_k(self):
+        boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                          [40, 40, 50, 50], [60, 60, 70, 70]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+        cats = np.array([0, 0, 0, 1])
+        kept = V.nms(_t(boxes), 0.5, _t(scores), _t(cats),
+                     categories=[0, 1], top_k=2)
+        k = kept.numpy().tolist()
+        # 2 from category 0 and up to 2 from category 1 (only one exists)
+        assert 3 in k and len([i for i in k if i < 3]) == 2
+
+    def test_base_transform_passthrough(self):
+        import paddle_tpu.vision.transforms as T
+
+        class CropOnly(T.BaseTransform):
+            def _apply_image(self, im):
+                return T.center_crop(im, 4)
+
+        img = np.random.rand(3, 8, 8).astype("float32")
+        out_img, label = CropOnly()((img, 7))
+        assert out_img.shape == (3, 4, 4) and label == 7
