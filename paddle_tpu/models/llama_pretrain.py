@@ -35,8 +35,8 @@ from jax.ad_checkpoint import checkpoint_name as _ckpt_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["LlamaPretrainConfig", "init_params", "make_train_step",
-           "make_forward", "init_adamw_state", "param_specs",
-           "build_mesh", "MESH_AXES"]
+           "make_forward", "init_adamw_state", "init_adafactor_state",
+           "adafactor_update", "param_specs", "build_mesh", "MESH_AXES"]
 
 MESH_AXES = ("dp", "pp", "sharding", "sep", "mp")
 
@@ -403,10 +403,19 @@ def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
 # fused AdamW (sharded states = ZeRO-1/2)
 # ---------------------------------------------------------------------------
 def init_adamw_state(params, mesh: Optional[Mesh] = None,
-                     zero_axis: Optional[str] = "sharding"):
+                     zero_axis: Optional[str] = "sharding",
+                     moment_dtype: Any = None):
+    """AdamW state.  ``moment_dtype`` (e.g. ``jnp.bfloat16``) stores the
+    moments quantized — halves optimizer HBM, the compute stays fp32
+    (read -> upcast -> update -> store).  Same trade as the reference's
+    multi-precision / low-precision optimizer paths
+    (/root/reference/python/paddle/optimizer/adamw.py multi_precision)."""
     def make(p):
-        m = jnp.zeros_like(p)
-        v = jnp.zeros_like(p)
+        dt = moment_dtype or p.dtype
+        # zeros_like inherits the param's NamedSharding (mp/pp layouts);
+        # the zero_axis branch below then re-lays-out for ZeRO placement
+        m = jnp.zeros_like(p, dtype=dt)
+        v = jnp.zeros_like(p, dtype=dt)
         if mesh is not None and zero_axis and \
                 mesh.shape.get(zero_axis, 1) > 1 and p.ndim >= 1 and \
                 p.shape[0] % mesh.shape[zero_axis] == 0:
@@ -429,16 +438,18 @@ def adamw_update(params, grads, state, lr=3e-4, b1=0.9, b2=0.95,
         from ..ops.dispatch import get_op_impl
         impl = get_op_impl("fused_adamw", None)
         g = g.astype(jnp.float32)
-        if impl is not None:
+        mdt = mo["m"].dtype
+        if impl is not None and mdt == jnp.float32:
             return impl(p, g, mo["m"], mo["v"], tf, lr, b1, b2, eps,
                         weight_decay)
-        m = b1 * mo["m"] + (1 - b1) * g
-        v = b2 * mo["v"] + (1 - b2) * g * g
+        m = b1 * mo["m"].astype(jnp.float32) + (1 - b1) * g
+        v = b2 * mo["v"].astype(jnp.float32) + (1 - b2) * g * g
         mhat = m / (1 - b1 ** tf)
         vhat = v / (1 - b2 ** tf)
         new_p = p * (1 - lr * weight_decay) - lr * mhat / (
             jnp.sqrt(vhat) + eps)
-        return new_p.astype(p.dtype), {"m": m, "v": v}
+        return new_p.astype(p.dtype), {"m": m.astype(mdt),
+                                       "v": v.astype(mdt)}
 
     flat_p, tree = jax.tree_util.tree_flatten(params)
     flat_g = jax.tree_util.tree_leaves(grads)
@@ -453,10 +464,120 @@ def adamw_update(params, grads, state, lr=3e-4, b1=0.9, b2=0.95,
                                                              new_m)})
 
 
+# ---------------------------------------------------------------------------
+# Adafactor (factored second moment) — the TPU-native memory-efficient
+# optimizer (Shazeer & Stern 2018; how T5/PaLM pretrained on TPU pods).
+# For a [.., A, B] matrix the second moment is stored as a row EMA [.., A]
+# plus a column EMA [.., B] instead of [.., A, B]: optimizer HBM drops
+# from 2x params (AdamW fp32) to ~per-row/col vectors, which is what lets
+# a >1B-param model train on one 16GB v5e chip.  The reference has no
+# Adafactor; its answer to optimizer memory is sharding/offload
+# (group_sharded_stage3.py) which needs multiple devices — on a single
+# chip factoring is the only move, and it is a TPU-lineage one.
+# ---------------------------------------------------------------------------
+def _factored(p) -> bool:
+    return p.ndim >= 2 and p.shape[-1] >= 128 and p.shape[-2] >= 128
+
+
+def init_adafactor_state(params, mesh: Optional[Mesh] = None,
+                         zero_axis: Optional[str] = "sharding",
+                         beta1: float = 0.0,
+                         moment_dtype: Any = jnp.bfloat16):
+    """Adafactor state: factored second moment for matrices, full vector
+    for 1-D params; optional first moment (``beta1 > 0``) stored in
+    ``moment_dtype``."""
+    def make(p):
+        st = {}
+        if _factored(p):
+            # vr/vc are per-row/col vectors (KBs) — left replicated
+            st["vr"] = jnp.zeros(p.shape[:-1], jnp.float32)
+            st["vc"] = jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
+        else:
+            # full copy for small params: inherit the param's sharding
+            st["v"] = jnp.zeros_like(p, dtype=jnp.float32)
+        if beta1 > 0.0:
+            m = jnp.zeros_like(p, dtype=moment_dtype)
+            if mesh is not None and zero_axis and \
+                    mesh.shape.get(zero_axis, 1) > 1 and p.ndim >= 1 and \
+                    p.shape[0] % mesh.shape[zero_axis] == 0:
+                m = jax.device_put(m, NamedSharding(
+                    mesh, P(*([zero_axis] + [None] * (p.ndim - 1)))))
+            st["m"] = m
+        return st
+
+    return {"t": jnp.zeros((), jnp.int32),
+            "moments": jax.tree_util.tree_map(make, params)}
+
+
+def _rms(x):
+    return jnp.sqrt(jnp.mean(jnp.square(x)) + 1e-30)
+
+
+def adafactor_update(params, grads, state, lr=1e-2, weight_decay=0.0,
+                     beta1: float = 0.0, clip_threshold=1.0, eps1=1e-30,
+                     eps2=1e-3, decay_pow=0.8):
+    """One Adafactor step.  ``lr`` is the relative step size: the actual
+    update is ``lr * max(eps2, rms(p)) * u_clipped`` (scale_parameter
+    semantics), with beta2_t = 1 - t**-decay_pow (built-in warmup).
+    ``beta1`` must match the ``init_adafactor_state`` value (momentum is
+    used iff the state carries an ``m`` slot)."""
+    t = state["t"] + 1
+    tf = t.astype(jnp.float32)
+    beta2 = 1.0 - tf ** (-decay_pow)
+
+    def upd(p, g, st):
+        if ("m" in st) != (beta1 > 0.0):
+            raise ValueError(
+                f"beta1={beta1} disagrees with the optimizer state "
+                f"({'has' if 'm' in st else 'no'} momentum slot) — pass "
+                f"the same beta1 to init_adafactor_state and "
+                f"adafactor_update/make_train_step")
+        g = g.astype(jnp.float32)
+        g2 = g * g + eps1
+        new_st = {}
+        if "vr" in st:
+            vr = beta2 * st["vr"] + (1 - beta2) * jnp.mean(g2, axis=-1)
+            vc = beta2 * st["vc"] + (1 - beta2) * jnp.mean(g2, axis=-2)
+            new_st["vr"], new_st["vc"] = vr, vc
+            # vhat = outer(vr, vc) / mean(vr) — the rank-1 reconstruction
+            r = vr / jnp.mean(vr, axis=-1, keepdims=True)
+            u = g * jax.lax.rsqrt(r[..., :, None] * vc[..., None, :])
+        else:
+            v = beta2 * st["v"] + (1 - beta2) * g2
+            new_st["v"] = v
+            u = g * jax.lax.rsqrt(v)
+        u = u / jnp.maximum(1.0, _rms(u) / clip_threshold)
+        alpha = lr * jnp.maximum(eps2, _rms(p.astype(jnp.float32)))
+        step_ = alpha * u
+        if "m" in st:
+            m = beta1 * st["m"].astype(jnp.float32) + (1 - beta1) * step_
+            new_st["m"] = m.astype(st["m"].dtype)
+            step_ = m
+        new_p = p.astype(jnp.float32) * (1 - alpha * weight_decay) - step_
+        return new_p.astype(p.dtype), new_st
+
+    flat_p, tree = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_leaves(grads)
+    flat_s = tree.flatten_up_to(state["moments"])
+    new_p, new_s = [], []
+    for p, g, st in zip(flat_p, flat_g, flat_s):
+        np_, ns = upd(p, g, st)
+        new_p.append(np_)
+        new_s.append(ns)
+    return (jax.tree_util.tree_unflatten(tree, new_p),
+            {"t": t,
+             "moments": jax.tree_util.tree_unflatten(tree, new_s)})
+
+
 def make_train_step(cfg: LlamaPretrainConfig, mesh: Mesh, pp: int = 1,
                     microbatches: int = 1, lr: float = 3e-4,
-                    weight_decay: float = 0.1, accum_steps: int = 1):
-    """One donated, jitted XLA program: fwd + bwd + AdamW.
+                    weight_decay: float = 0.1, accum_steps: int = 1,
+                    optimizer: str = "adamw", beta1: float = 0.0):
+    """One donated, jitted XLA program: fwd + bwd + optimizer.
+
+    ``optimizer``: "adamw" (opt_state from ``init_adamw_state``) or
+    "adafactor" (``init_adafactor_state``; ``lr`` becomes the relative
+    step size and ``beta1`` the optional momentum).
 
     ``accum_steps > 1`` runs gradient accumulation over microbatches via
     ``lax.scan``.  On TPU this is the preferred memory/FLOPs trade: each
@@ -466,6 +587,9 @@ def make_train_step(cfg: LlamaPretrainConfig, mesh: Mesh, pp: int = 1,
     its HBM traffic also amortise over the larger global batch).
     """
     fwd = make_forward(cfg, mesh, pp, microbatches)
+    if optimizer not in ("adamw", "adafactor"):
+        raise ValueError(f"optimizer must be adamw/adafactor, "
+                         f"got {optimizer!r}")
 
     def step(params, opt_state, tokens):
         if accum_steps == 1:
@@ -485,9 +609,14 @@ def make_train_step(cfg: LlamaPretrainConfig, mesh: Mesh, pp: int = 1,
             grads = jax.tree_util.tree_map(
                 lambda g: g / accum_steps, grads)
             loss = jnp.mean(losses)
-        params, opt_state = adamw_update(params, grads, opt_state,
-                                         lr=lr,
-                                         weight_decay=weight_decay)
+        if optimizer == "adafactor":
+            params, opt_state = adafactor_update(
+                params, grads, opt_state, lr=lr,
+                weight_decay=weight_decay, beta1=beta1)
+        else:
+            params, opt_state = adamw_update(params, grads, opt_state,
+                                             lr=lr,
+                                             weight_decay=weight_decay)
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
