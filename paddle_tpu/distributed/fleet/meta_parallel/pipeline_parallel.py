@@ -32,6 +32,43 @@ from .pp_layers import PipelineLayer
 __all__ = ["PipelineParallel"]
 
 
+def _functional_call_any(fn, sub, x):
+    """Functional call of one pipeline layer entry: plain callable,
+    Layer, or Layer with a ``_pp_forward_override`` (SharedLayerDesc
+    forward_func — e.g. embedding reused as unembedding)."""
+    from ....autograd import tape as _tape
+    if not isinstance(fn, Layer):
+        return fn(*x) if isinstance(x, tuple) else fn(x)
+    override = getattr(fn, "_pp_forward_override", None)
+    if override is None:
+        return fn._functional_call(sub, *x) if isinstance(x, tuple) \
+            else fn._functional_call(sub, x)
+    named = dict(fn.named_parameters())
+    saved = {}
+    try:
+        for name, arr in sub.items():
+            t = named[name]
+            saved[id(t)] = (t, t._data)
+            t._data = arr if not isinstance(arr, Tensor) else arr._data
+        with _tape.functional_trace_guard():
+            return override(fn, *x) if isinstance(x, tuple) else \
+                override(fn, x)
+    finally:
+        for t, old in saved.values():
+            t._data = old
+
+
+def _run_chain(layers, tree, x):
+    """Run a list of pipeline layers functionally with params from
+    ``tree`` (keys ``{idx}.{param_name}``); returns a raw array."""
+    z = x
+    for j, fn in enumerate(layers):
+        sub = {k[len(f"{j}."):]: v for k, v in tree.items()
+               if k.startswith(f"{j}.")}
+        z = _functional_call_any(fn, sub, z)
+    return z._data if isinstance(z, Tensor) else z
+
+
 class FakeMicroDataset:
     """Reference: pipeline_parallel.py:63 — slices a batch into
     microbatches."""
@@ -91,11 +128,14 @@ class PipelineParallel(Layer):
         return self.stage_id == self.num_stages - 1
 
     def _forward_step(self, micro_input, micro_label):
-        """Reference: pipeline_parallel.py:801 — runs every stage in order;
-        stage boundaries are device boundaries under the pp mesh axis."""
+        """Reference: pipeline_parallel.py:801 — runs every logical stage
+        in order (chunk-major under vpp interleaving); stage boundaries
+        are device boundaries under the pp mesh axis."""
         x = micro_input
-        for s in range(self.num_stages):
-            x = self._layers.forward_stage(x, s)
+        n_logical = self.num_stages * self._layers.get_num_virtual_stages()
+        for ls in range(n_logical):
+            for fn in self._layers.logical_stage_layers(ls):
+                x = self._layers._call_one(fn, x)
         if self._layers._loss_fn is not None and micro_label is not None:
             if isinstance(micro_label, (tuple, list)):
                 return self._layers._loss_fn(x, *micro_label)
@@ -119,48 +159,82 @@ class PipelineParallel(Layer):
         mesh = _mesh_mod.get_global_mesh()
         if (mesh is None or "pp" not in mesh.axis_names
                 or mesh.shape["pp"] != self.num_stages):
+            self._warn_eager_fallback(
+                "no global mesh with a matching 'pp' axis")
             return False
-        if self._layers._shared:
-            return False        # cross-stage aliasing is not uniform
         import jax
+
+        vpp = self._layers.get_num_virtual_stages()
+
+        # tied/shared boundary layers (reference: SharedLayerDesc,
+        # pp_layers.py:56): supported on the compiled path when the
+        # sharing is a first-stage prefix (embedding) + last-stage
+        # suffix (unembedding head) around a uniform trunk.  The prefix
+        # runs before the pipeline (its vjp consumes the engine's dxs),
+        # the suffix is folded into the engine's last-stage loss via
+        # head_params; aliased Parameters receive both gradient
+        # contributions through _accumulate_grad — the allreduce of
+        # shared grads in the reference.
+        self._shared_plan = None
+        if self._layers._shared:
+            if vpp > 1:
+                self._warn_eager_fallback(
+                    "shared (tied) layers with num_virtual_pipeline_"
+                    "stages > 1 run on the eager pipeline path")
+                return False
+            plan = self._plan_shared_boundary()
+            if plan is None:
+                self._warn_eager_fallback(
+                    "shared layers not in first-stage-prefix/last-stage-"
+                    "suffix form run on the eager pipeline path")
+                return False
+            self._shared_plan = plan
+        prefix_n, suffix_n = self._shared_plan or (0, 0)
+
+        def core(s, c):
+            ls = self._layers.chunk_layers(s, c)
+            if s == 0 and c == 0 and prefix_n:
+                ls = ls[prefix_n:]
+            if s == self.num_stages - 1 and c == vpp - 1 and suffix_n:
+                ls = ls[:len(ls) - suffix_n]
+            return ls
 
         # uniformity: identical parameter structure AND identical
         # compute structure (layer types / the same plain callables) —
-        # the engine replays stage 0's layer objects with each stage's
-        # arrays, so differing activations would silently diverge
-        def stage_sig(s):
+        # the engine replays chunk (0,0)'s layer objects with each
+        # chunk's arrays, so differing activations would silently diverge
+        def chunk_sig(s, c):
             sig = []
-            for fn in self._layers.stage_layers(s):
+            for fn in core(s, c):
                 sig.append(type(fn).__name__ if isinstance(fn, Layer)
                            else fn)
             return tuple(sig)
 
-        sig0 = stage_sig(0)
-        if any(stage_sig(s) != sig0 for s in range(1, self.num_stages)):
+        sig0 = chunk_sig(0, 0)
+        if any(chunk_sig(s, c) != sig0
+               for s in range(self.num_stages) for c in range(vpp)
+               if (s, c) != (0, 0)):
+            if self._shared_plan:
+                self._warn_eager_fallback(
+                    "non-uniform trunk around shared boundary layers")
             return False
-        stage_trees = self._collect_stage_trees()
+        self._core_layers_fn = core
+        chunk_trees = self._collect_chunk_trees(core)
         struct0 = {k: (v.shape, str(v.dtype))
-                   for k, v in stage_trees[0].items()}
-        for tree in stage_trees[1:]:
-            if {k: (v.shape, str(v.dtype))
-                    for k, v in tree.items()} != struct0:
-                return False
+                   for k, v in chunk_trees[0][0].items()}
+        for per_rank in chunk_trees:
+            for tree in per_rank:
+                if {k: (v.shape, str(v.dtype))
+                        for k, v in tree.items()} != struct0:
+                    return False
         if not struct0:
             return False
 
-        layers0 = self._layers.stage_layers(0)
+        layers0 = core(0, 0)
         loss_layer = self._layers._loss_fn
 
         def stage_fn(sp, x):
-            from ....tensor.tensor import Tensor as _T
-            for j, fn in enumerate(layers0):
-                if isinstance(fn, Layer):
-                    sub = {k[len(f"{j}."):]: v for k, v in sp.items()
-                           if k.startswith(f"{j}.")}
-                    x = fn._functional_call(sub, x)
-                else:
-                    x = fn(x)
-            return x._data if isinstance(x, _T) else x
+            return _run_chain(layers0, sp, x)
 
         def loss_fn(out, y):
             from ....tensor.tensor import Tensor as _T
@@ -170,31 +244,119 @@ class PipelineParallel(Layer):
             return res._data if isinstance(res, _T) else res
 
         from ....distributed.parallel.pipeline import (
-            pipeline_value_and_grad)
+            interleaved_value_and_grad, pipeline_value_and_grad)
         remat = self._layers._recompute_interval > 0
         pp = self.num_stages
 
-        @jax.jit
-        def step(stacked, x_mb, y_mb):
-            return pipeline_value_and_grad(
-                stage_fn, loss_fn, stacked, x_mb, y_mb, mesh, pp,
-                schedule="1f1b", remat_stage=remat)
+        if self._shared_plan:
+            prefix_layers = self._layers.chunk_layers(0, 0)[:prefix_n]
+            last_ls = self._layers.chunk_layers(pp - 1, vpp - 1)
+            suffix_layers = last_ls[len(last_ls) - suffix_n:] \
+                if suffix_n else []
+            self._prefix_layers = prefix_layers
+            self._suffix_layers = suffix_layers
 
+            def head_loss(hp, out, y):
+                z = _run_chain(suffix_layers, hp, out)
+                return loss_fn(z, y)
+
+            @jax.jit
+            def step(pre_t, stacked, suf_t, x_mb, y_mb):
+                def embed_all(pt):
+                    return jax.vmap(
+                        lambda x: _run_chain(prefix_layers, pt, x))(x_mb)
+                xs, embed_vjp = jax.vjp(embed_all, pre_t)
+                loss, grads, hgrads, dxs = pipeline_value_and_grad(
+                    stage_fn, head_loss, stacked, xs, y_mb, mesh, pp,
+                    schedule="1f1b", remat_stage=remat,
+                    head_params=suf_t)
+                (pre_g,) = embed_vjp(dxs)
+                return loss, grads, hgrads, pre_g
+        elif vpp > 1:
+            @jax.jit
+            def step(stacked, x_mb, y_mb):
+                return interleaved_value_and_grad(
+                    stage_fn, loss_fn, stacked, x_mb, y_mb, mesh, pp,
+                    vpp, remat_stage=remat)
+        else:
+            @jax.jit
+            def step(stacked, x_mb, y_mb):
+                return pipeline_value_and_grad(
+                    stage_fn, loss_fn, stacked, x_mb, y_mb, mesh, pp,
+                    schedule="1f1b", remat_stage=remat)
+
+        self._compiled_vpp = vpp
         self._compiled_stacked_keys = list(struct0)
         self._compiled_step = step
         return True
 
-    def _collect_stage_trees(self):
-        """Per-stage {param_name: array} trees (live views — re-read each
-        batch because the optimizer mutates the tensors)."""
+    def _plan_shared_boundary(self):
+        """Locate SharedLayerDesc layers as a stage-0 prefix and/or
+        last-stage suffix; None when the sharing has any other shape."""
+        pp = self.num_stages
+        stage_ls = [self._layers.chunk_layers(s, 0) for s in range(pp)]
+
+        def is_shared(fn):
+            return getattr(fn, "_shared_key", None) is not None
+
+        prefix_n = 0
+        for fn in stage_ls[0]:
+            if is_shared(fn):
+                prefix_n += 1
+            else:
+                break
+        last = stage_ls[-1]
+        suffix_n = 0
+        for fn in reversed(last):
+            if is_shared(fn):
+                suffix_n += 1
+            else:
+                break
+        if prefix_n == 0 and suffix_n == 0:
+            return None
+        for s, ls in enumerate(stage_ls):
+            for j, fn in enumerate(ls):
+                if is_shared(fn):
+                    ok = (s == 0 and j < prefix_n) or \
+                        (s == pp - 1 and j >= len(ls) - suffix_n)
+                    if not ok:
+                        return None
+        return (prefix_n, suffix_n)
+
+    def _warn_eager_fallback(self, msg: str):
+        import warnings
+        warned = getattr(self, "_eager_warned", None)
+        if warned is None:
+            warned = self._eager_warned = set()
+        if msg not in warned:       # once per distinct reason
+            warned.add(msg)
+            warnings.warn(
+                f"PipelineParallel: {msg}; falling back to the eager "
+                f"microbatch loop (numerics identical, no spatial "
+                f"pipelining)", RuntimeWarning, stacklevel=3)
+
+    def _collect_tree(self, layers):
+        tree = {}
+        for j, fn in enumerate(layers):
+            if isinstance(fn, Layer):
+                for n, p in fn.named_parameters():
+                    tree[f"{j}.{n}"] = p._data
+        return tree
+
+    def _collect_chunk_trees(self, core_fn=None):
+        """Per-(rank, chunk) {param_name: array} trees (live views —
+        re-read each batch because the optimizer mutates the tensors).
+        ``core_fn(s, c)`` overrides the layer list (shared boundaries
+        stripped)."""
+        vpp = self._layers.get_num_virtual_stages()
         trees = []
         for s in range(self.num_stages):
-            tree = {}
-            for j, fn in enumerate(self._layers.stage_layers(s)):
-                if isinstance(fn, Layer):
-                    for n, p in fn.named_parameters():
-                        tree[f"{j}.{n}"] = p._data
-            trees.append(tree)
+            per_rank = []
+            for c in range(vpp):
+                layers = core_fn(s, c) if core_fn is not None else \
+                    self._layers.chunk_layers(s, c)
+                per_rank.append(self._collect_tree(layers))
+            trees.append(per_rank)
         return trees
 
     def _run_compiled(self, data):
@@ -209,6 +371,12 @@ class PipelineParallel(Layer):
                 return None
             labels = labels[0]
         M = self.accumulate_steps
+        vpp = self._compiled_vpp
+        if vpp > 1 and M % self.num_stages:
+            self._warn_eager_fallback(
+                f"interleaved schedule needs accumulate_steps ({M}) "
+                f"divisible by pp ({self.num_stages})")
+            return None
         x = inputs._data if isinstance(inputs, Tensor) else \
             jnp.asarray(inputs)
         y = labels._data if isinstance(labels, Tensor) else \
@@ -217,17 +385,45 @@ class PipelineParallel(Layer):
             return None
         x_mb = x.reshape(M, self.micro_batch_size, *x.shape[1:])
         y_mb = y.reshape(M, self.micro_batch_size, *y.shape[1:])
-        stage_trees = self._collect_stage_trees()
-        stacked = {k: jnp.stack([t[k] for t in stage_trees])
-                   for k in self._compiled_stacked_keys}
-        loss, grads, _ = self._compiled_step(stacked, x_mb, y_mb)
-        # scatter gradients back onto the parameter tensors
+        core_fn = getattr(self, "_core_layers_fn", None)
+        chunk_trees = self._collect_chunk_trees(core_fn)
+        if vpp > 1:
+            stacked = {k: jnp.stack(
+                [jnp.stack([c[k] for c in per_rank])
+                 for per_rank in chunk_trees])
+                for k in self._compiled_stacked_keys}       # [pp, v, ..]
+        else:
+            stacked = {k: jnp.stack([pr[0][k] for pr in chunk_trees])
+                       for k in self._compiled_stacked_keys}  # [pp, ..]
+        if self._shared_plan:
+            pre_t = self._collect_tree(self._prefix_layers)
+            suf_t = self._collect_tree(self._suffix_layers)
+            loss, grads, hgrads, pre_g = self._compiled_step(
+                pre_t, stacked, suf_t, x_mb, y_mb)
+            # boundary grads: aliased Parameters receive BOTH the
+            # prefix (embedding) and suffix (head) contributions via
+            # accumulation — the reference's shared-grad allreduce
+            for layers, gtree in ((self._prefix_layers, pre_g),
+                                  (self._suffix_layers, hgrads)):
+                for j, fn in enumerate(layers):
+                    if isinstance(fn, Layer):
+                        for n, p in fn.named_parameters():
+                            if not p.stop_gradient:
+                                p._accumulate_grad(gtree[f"{j}.{n}"])
+        else:
+            loss, grads, _ = self._compiled_step(stacked, x_mb, y_mb)
+        # scatter trunk gradients back onto the parameter tensors
         for s in range(self.num_stages):
-            for j, fn in enumerate(self._layers.stage_layers(s)):
-                if isinstance(fn, Layer):
-                    for n, p in fn.named_parameters():
-                        if not p.stop_gradient:
-                            p._accumulate_grad(grads[f"{j}.{n}"][s])
+            for c in range(vpp):
+                layers = core_fn(s, c) if core_fn is not None else \
+                    self._layers.chunk_layers(s, c)
+                for j, fn in enumerate(layers):
+                    if isinstance(fn, Layer):
+                        for n, p in fn.named_parameters():
+                            if not p.stop_gradient:
+                                g = grads[f"{j}.{n}"]
+                                p._accumulate_grad(
+                                    g[s, c] if vpp > 1 else g[s])
         return to_tensor(loss)
 
     def forward_backward_pipeline(self, data, scaler=None):
@@ -237,6 +433,9 @@ class PipelineParallel(Layer):
         eager microbatch loop with grad accumulation (identical numerics,
         schedule is an optimisation)."""
         self.scaler = scaler
+        if scaler is not None:
+            self._warn_eager_fallback(
+                "GradScaler is attached (scaled backward needs the tape)")
         if scaler is None and self._try_build_compiled():
             out = self._run_compiled(data)
             if out is not None:

@@ -118,9 +118,19 @@ class PipelineLayer(Layer):
         self._num_stages = num_stages or 1
         self._layers_desc = list(layers)
         self._recompute_interval = recompute_interval
+        # Interleaved virtual pipeline (reference: pp_layers.py
+        # _num_virtual_pipeline_stages + WithInterleave schedule): the
+        # layer list is segmented into num_stages * vpp chunks; rank r
+        # executes chunks c*num_stages + r.  Logical (execution) order is
+        # chunk-major: all ranks' chunk 0, then chunk 1, ...
+        self._vpp = int(num_virtual_pipeline_stages or 1)
+        if self._vpp > 1 and self._num_stages > 1:
+            n_seg = self._num_stages * self._vpp
+        else:
+            self._vpp = 1
+            n_seg = self._num_stages
 
-        seg = SegmentLayers(self._layers_desc, self._num_stages,
-                            seg_method)
+        seg = SegmentLayers(self._layers_desc, n_seg, seg_method)
         self.segment_parts = seg.do_segment()
 
         # build all layers; shared descs alias parameters by key
@@ -137,6 +147,7 @@ class PipelineLayer(Layer):
                 else:
                     layer = desc.build_layer()
                     self._shared[desc.layer_name] = layer
+                layer._shared_key = desc.layer_name
                 if desc.forward_func is not None:
                     fwd = desc.forward_func
                     layer._pp_forward_override = fwd
@@ -157,19 +168,41 @@ class PipelineLayer(Layer):
     def get_num_stages(self):
         return self._num_stages
 
+    def get_num_virtual_stages(self):
+        return self._vpp
+
     def get_stage_from_index(self, layer_idx) -> int:
-        for s in range(self._num_stages):
+        """Rank owning ``layer_idx``; with vpp > 1 logical segment s is
+        executed by rank ``s % num_stages`` (interleaved assignment)."""
+        n_seg = self._num_stages * self._vpp
+        for s in range(n_seg):
             if self._stage_bounds[s] <= layer_idx < \
                     self._stage_bounds[s + 1]:
-                return s
+                return s % self._num_stages
         return self._num_stages - 1
 
-    def stage_layers(self, stage_id: int) -> List:
-        lo, hi = (self._stage_bounds[stage_id],
-                  self._stage_bounds[stage_id + 1])
+    def logical_stage_layers(self, ls: int) -> List:
+        """Layers of logical segment ``ls`` (= chunk ls//pp of rank
+        ls%pp); segments cover consecutive layers in execution order."""
+        lo, hi = self._stage_bounds[ls], self._stage_bounds[ls + 1]
         return self.run_function[lo:hi]
 
+    def chunk_layers(self, stage_id: int, chunk: int) -> List:
+        return self.logical_stage_layers(chunk * self._num_stages +
+                                         stage_id)
+
+    def stage_layers(self, stage_id: int) -> List:
+        """ALL layers held by rank ``stage_id`` (its chunks, in chunk
+        order) — the parameter-ownership view."""
+        out = []
+        for c in range(self._vpp):
+            out.extend(self.chunk_layers(stage_id, c))
+        return out
+
     def forward_stage(self, x, stage_id: int):
+        """Runs rank ``stage_id``'s layers.  Only meaningful as part of a
+        logical-order sweep when vpp == 1 (the eager scheduler iterates
+        logical stages itself for vpp > 1)."""
         for fn in self.stage_layers(stage_id):
             x = self._call_one(fn, x)
         return x

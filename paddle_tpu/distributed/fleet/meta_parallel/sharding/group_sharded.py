@@ -49,6 +49,11 @@ def _sharding_axis(axis_candidates=("sharding", "dp")) -> Optional[str]:
     return None
 
 
+def _offload_device():
+    """Host (CPU backend) device for offloaded optimizer states."""
+    return jax.devices("cpu")[0]
+
+
 def _shard0(arr, axis: str):
     """Place an array sharded on dim 0 over ``axis`` (replicate if the dim
     doesn't divide)."""
@@ -63,31 +68,72 @@ def _shard0(arr, axis: str):
 
 class _ShardedStateOptimizer:
     """Mixin wrapping an optimizer so its states are sharded on creation
-    and gradients (stage≥2) are resharded before the update."""
+    and gradients (stage>=2) are resharded before the update.
 
-    def __init__(self, optimizer: Optimizer, axis: str, shard_grads: bool):
+    ``offload=True`` pins the optimizer states to HOST memory (the
+    reference's group_sharded_stage3.py:85 offload): states are created
+    committed to the CPU backend and the update math runs there — only
+    the gradient (device->host) and the updated parameter (host->device)
+    cross the interconnect; moment HBM drops to zero."""
+
+    def __init__(self, optimizer: Optimizer, axis: str, shard_grads: bool,
+                 offload: bool = False):
         self._inner = optimizer
         self._axis = axis
         self._shard_grads = shard_grads
+        self._offload = offload
         orig_init = optimizer._init_state
 
         def sharded_init(p):
             st = orig_init(p)
             for k, v in st.items():
                 if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1:
-                    st[k] = _shard0(v, axis)
+                    if offload:
+                        st[k] = jax.device_put(v, _offload_device())
+                    else:
+                        st[k] = _shard0(v, axis)
             return st
 
         optimizer._init_state = sharded_init
+
+        if offload:
+            orig_update = optimizer._update
+
+            def offload_update(param, g, state, lr):
+                host = _offload_device()
+                dev_sharding = param.sharding
+                new_p, new_st = orig_update(
+                    jax.device_put(param, host),
+                    jax.device_put(g, host), state, lr)
+                return jax.device_put(new_p, dev_sharding), new_st
+
+            optimizer._update = offload_update
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
     def step(self):
-        if self._shard_grads:
-            for p in self._inner._params():
-                if p._grad is not None and p._grad.ndim >= 1:
-                    p._grad = _shard0(p._grad, self._axis)
+        if self._shard_grads and not self._offload:
+            # one batched relayout for ALL grads (a per-param
+            # device_put loop serializes dispatch at thousands of
+            # params — the round-2 review finding).  Skipped under
+            # offload: grads go straight device->host in the update,
+            # a device-mesh relayout first would be wasted traffic.
+            ps = [p for p in self._inner._params()
+                  if p._grad is not None and p._grad.ndim >= 1]
+            if ps:
+                mesh = get_global_mesh()
+                n = mesh.shape[self._axis]
+                shardings = [
+                    NamedSharding(mesh, P(*([self._axis] + [None] *
+                                            (p._grad.ndim - 1))))
+                    if p._grad.shape[0] % n == 0
+                    else NamedSharding(mesh, P())
+                    for p in ps]
+                new_grads = jax.device_put([p._grad for p in ps],
+                                           shardings)
+                for p, g in zip(ps, new_grads):
+                    p._grad = g
         self._inner.step()
 
     def clear_grad(self, *a, **kw):
@@ -111,12 +157,13 @@ class ShardingOptimizerStage1(_ShardedStateOptimizer):
 
 
 class GroupShardedOptimizerStage2(_ShardedStateOptimizer):
-    """Reference: group_sharded_optimizer_stage2.py:53."""
+    """Reference: group_sharded_optimizer_stage2.py:53.
+    ``offload=True`` = host-pinned optimizer states (see mixin)."""
 
     def __init__(self, params, optim, group=None, offload=False,
                  device="tpu", **kw):
         axis = _sharding_axis() or "dp"
-        super().__init__(optim, axis, shard_grads=True)
+        super().__init__(optim, axis, shard_grads=True, offload=offload)
 
 
 class _ShardedModelWrapper(Layer):
@@ -146,22 +193,41 @@ class _ShardedModelWrapper(Layer):
             return getattr(self._sub_layers["_layers_holder"], name)
 
 
+def _warn_noop_kwarg(cls_name: str, **kwargs):
+    """One-time notice for reference knobs that are no-ops here: they
+    tune NCCL bucketing/segmenting, which XLA fusion owns on TPU."""
+    import warnings
+    for k, (v, default) in kwargs.items():
+        if v != default:
+            warnings.warn(
+                f"{cls_name}: `{k}={v}` is a no-op on the TPU backend — "
+                f"communication bucketing/segmenting is handled by XLA "
+                f"fusion, not a runtime buffer", RuntimeWarning,
+                stacklevel=3)
+
+
 class GroupShardedStage2(_ShardedModelWrapper):
     """Reference: group_sharded_stage2.py:46 — params stay replicated."""
 
     def __init__(self, layer, sharding_optimizer=None, group=None,
                  sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+        _warn_noop_kwarg("GroupShardedStage2",
+                         buffer_max_size=(buffer_max_size, 2 ** 23))
         super().__init__(layer, _sharding_axis() or "dp",
                          shard_params=False)
 
 
 class GroupShardedStage3(_ShardedModelWrapper):
     """Reference: group_sharded_stage3.py:85 — params sharded; XLA
-    all-gathers on use and frees after (remat policies can trade more)."""
+    all-gathers on use and frees after (remat policies can trade more).
+    ``offload`` is honored by the paired optimizer (host-pinned states);
+    pass it via ``group_sharded_parallel(..., offload=True)``."""
 
     def __init__(self, layer, optimizer=None, group=None,
                  sync_buffers=False, segment_size=2 ** 20, offload=False,
                  **kw):
+        _warn_noop_kwarg("GroupShardedStage3",
+                         segment_size=(segment_size, 2 ** 20))
         super().__init__(layer, _sharding_axis() or "dp",
                          shard_params=True)
 
@@ -187,11 +253,16 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
         opt = ShardingOptimizerStage1(optimizer)
         wrapped = _ShardedModelWrapper(model, axis, shard_params=False)
     elif level == "os_g":
-        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer)
-        wrapped = GroupShardedStage2(model, opt)
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                          offload=offload)
+        wrapped = GroupShardedStage2(model, opt,
+                                     buffer_max_size=buffer_max_size)
     else:
-        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer)
-        wrapped = GroupShardedStage3(model, opt)
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                          offload=offload)
+        wrapped = GroupShardedStage3(model, opt,
+                                     segment_size=segment_size,
+                                     offload=offload)
     return wrapped, opt, scaler
 
 

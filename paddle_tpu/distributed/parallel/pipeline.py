@@ -32,10 +32,11 @@ does).
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -99,10 +100,455 @@ def gpipe_forward(stage_fn: Callable, stacked_params, x_mb, mesh: Mesh,
     return stacked[pp - 1]
 
 
+# ---------------------------------------------------------------------------
+# Interleaved virtual-pipeline (VPP) schedule
+# ---------------------------------------------------------------------------
+def _vpp_orders(pp: int, v: int, M: int, reverse_chunks: bool = False):
+    """Per-rank (chunk, microbatch) op order: Megatron chunk-major in
+    groups of ``pp`` microbatches per chunk (reversed chunk order for
+    the backward stream)."""
+    S = pp * v
+    out = []
+    for r in range(pp):
+        ops = []
+        for k in range(M * v):
+            c = (k // pp) % v
+            if reverse_chunks:
+                c = v - 1 - c
+            m = (k // S) * pp + (k % pp)
+            ops.append((c, m))
+        out.append(ops)
+    return out
+
+
+def _min_slots(interval_groups, M: int) -> int:
+    """Smallest K such that ``m % K`` never collides for microbatches
+    whose live intervals overlap within any one group (a group = one
+    physical buffer on one (rank, chunk))."""
+    K = 1
+    for spans in interval_groups:
+        for ta, tb, m in spans:
+            live = {m2 for ta2, tb2, m2 in spans
+                    if ta2 <= tb and tb2 >= ta}
+            K = max(K, len(live))
+    while K < M:
+        ok = all(
+            len({m2 % K for m2 in {m2 for ta2, tb2, m2 in spans
+                                   if ta2 <= tb and tb2 >= ta}})
+            == len({m2 for ta2, tb2, m2 in spans
+                    if ta2 <= tb and tb2 >= ta})
+            for spans in interval_groups for ta, tb, m in spans)
+        if ok:
+            return K
+        K += 1
+    return M
+
+
+def vpp_schedule(pp: int, v: int, M: int):
+    """Static interleaved-1F1B schedule for ``v`` model chunks per rank.
+
+    Reference: WithInterleave
+    (/root/reference/python/paddle/distributed/fleet/meta_parallel/
+    pipeline_parallel.py:1010) — rank ``r`` owns logical stages
+    ``c*pp + r`` for chunks ``c in [0, v)``; microbatches are injected in
+    groups of ``pp`` per chunk so a rank's idle gap between its chunks is
+    one *chunk* time (T/v), not one full stage time — the Megatron
+    interleave bubble reduction.
+
+    Produced by greedy list scheduling over the true dependencies
+    (activation/grad hops take one tick; per-rank in-flight capped at the
+    Megatron warmup count), which both *is* the schedule executed on
+    device and lets tests assert the tick count.
+
+    Returns ``(F, B)`` int32 arrays of shape [ticks, pp, 2] holding
+    (chunk, microbatch) per rank per tick, -1 when idle.  Requires
+    ``M % pp == 0`` for v > 1 (the Megatron constraint).
+    """
+    if v > 1 and M % pp:
+        raise ValueError(f"interleaved schedule needs microbatches ({M}) "
+                         f"divisible by pp ({pp})")
+    S = pp * v
+    INF = 1 << 30
+    f_ord = _vpp_orders(pp, v, M, reverse_chunks=False)
+    b_ord = _vpp_orders(pp, v, M, reverse_chunks=True)
+    # Megatron warmup bound on in-flight microbatches per rank (+1 slack);
+    # adaptively relaxed if the greedy scheduler ever stalls.
+    cap = [min(M * v, 2 * (pp - r - 1) + (v - 1) * pp + 1) + 1
+           for r in range(pp)]
+    F_done: dict = {}
+    B_done: dict = {}
+    fi = [0] * pp
+    bi = [0] * pp
+    F_rows, B_rows = [], []
+    t = 0
+    while any(b < M * v for b in bi):
+        frow = [(-1, -1)] * pp
+        brow = [(-1, -1)] * pp
+        progressed = False
+        for r in range(pp):
+            if fi[r] < M * v and fi[r] - bi[r] < cap[r]:
+                c, m = f_ord[r][fi[r]]
+                s = c * pp + r
+                if s == 0 or F_done.get((s - 1, m), INF) < t:
+                    frow[r] = (c, m)
+        # commit F phase before evaluating B (F runs first within a tick)
+        for r in range(pp):
+            if frow[r][0] >= 0:
+                c, m = frow[r]
+                F_done[(c * pp + r, m)] = t
+                fi[r] += 1
+                progressed = True
+        for r in range(pp):
+            if bi[r] < M * v:
+                c, m = b_ord[r][bi[r]]
+                s = c * pp + r
+                ready = (B_done.get((s + 1, m), INF) < t) if s < S - 1 \
+                    else (F_done.get((s, m), INF) <= t)
+                if ready:
+                    brow[r] = (c, m)
+                    B_done[(s, m)] = t
+                    bi[r] += 1
+                    progressed = True
+        if not progressed:
+            # greedy stall: relax the in-flight caps and retry this tick
+            stalled = [r for r in range(pp) if fi[r] < M * v]
+            if not stalled:
+                raise AssertionError("vpp scheduler deadlock")
+            for r in stalled:
+                cap[r] += 1
+            continue
+        F_rows.append(frow)
+        B_rows.append(brow)
+        t += 1
+    return (np.asarray(F_rows, np.int32), np.asarray(B_rows, np.int32))
+
+
+def vpp_buffer_slots(F_tab, B_tab, pp: int, v: int,
+                     M: int) -> Tuple[int, int]:
+    """Per-buffer minimal slot counts ``(K_act, K_grad)`` such that
+    ``m % K`` never collides for simultaneously-live microbatches.  The
+    activation buffer (in_buf: stage input, live from arrival to its
+    backward) and the incoming-grad buffer (g_buf: live from the
+    downstream backward to this stage's backward) are separate physical
+    arrays, so they get separate collision domains — merging them
+    overestimates K and inflates both buffers."""
+    S = pp * v
+    F_done, B_done = {}, {}
+    for t in range(F_tab.shape[0]):
+        for r in range(pp):
+            c, m = int(F_tab[t, r, 0]), int(F_tab[t, r, 1])
+            if c >= 0:
+                F_done[(c * pp + r, m)] = t
+            c, m = int(B_tab[t, r, 0]), int(B_tab[t, r, 1])
+            if c >= 0:
+                B_done[(c * pp + r, m)] = t
+    act: dict = {}
+    grd: dict = {}
+    for (s, m), tb in B_done.items():
+        r, c = s % pp, s // pp
+        ta = F_done[(s - 1, m)] + 1 if s > 0 else F_done[(s, m)]
+        act.setdefault((r, c), []).append((ta, tb, m))
+        if s < S - 1:
+            tg = B_done[(s + 1, m)] + 1
+            grd.setdefault((r, c), []).append((tg, tb, m))
+    return (_min_slots(act.values(), M), _min_slots(grd.values(), M))
+
+
+def _chunk_slice(stacked_v, c):
+    """Dynamic chunk selection from a [v, ...]-stacked per-rank tree."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(
+            a, jnp.clip(c, 0, a.shape[0] - 1), 0, keepdims=False),
+        stacked_v)
+
+
+def vpp_forward_schedule(pp: int, v: int, M: int):
+    """F-only greedy schedule for the differentiable interleaved forward
+    (ticks ~= M*v + pp*v - 1).  Returns (F_tab [ticks, pp, 2], K)."""
+    if v > 1 and M % pp:
+        raise ValueError(f"interleaved schedule needs microbatches ({M}) "
+                         f"divisible by pp ({pp})")
+    INF = 1 << 30
+    orders = _vpp_orders(pp, v, M)
+    F_done: dict = {}
+    fi = [0] * pp
+    rows = []
+    t = 0
+    while any(f < M * v for f in fi):
+        row = [(-1, -1)] * pp
+        for r in range(pp):
+            if fi[r] < M * v:
+                c, m = orders[r][fi[r]]
+                s = c * pp + r
+                if s == 0 or F_done.get((s - 1, m), INF) < t:
+                    row[r] = (c, m)
+        prog = False
+        for r in range(pp):
+            if row[r][0] >= 0:
+                c, m = row[r]
+                F_done[(c * pp + r, m)] = t
+                fi[r] += 1
+                prog = True
+        assert prog, "forward schedule stalled"
+        rows.append(row)
+        t += 1
+    F_tab = np.asarray(rows, np.int32)
+    # buffer slots: input (s, m) lives from arrival to its own F tick
+    intervals: dict = {}
+    for (s, m), tf in F_done.items():
+        r, c = s % pp, s // pp
+        ta = F_done[(s - 1, m)] + 1 if s > 0 else tf
+        intervals.setdefault((r, c), []).append((ta, tf, m))
+    return F_tab, _min_slots(intervals.values(), M)
+
+
+def interleaved_forward(stage_fn: Callable, stacked_params, x_mb,
+                        mesh: Mesh, pp: int, vpp: int,
+                        axis: str = "pp"):
+    """Differentiable interleaved-VPP trunk forward: [M, mb, ...]
+    microbatches through ``pp * vpp`` logical stages ([pp, vpp]-stacked
+    params, element [r, c] = logical stage ``c*pp + r``); JAX transposes
+    the scan for the backward (reverse interleaved pipeline).  The
+    vpp > 1 counterpart of ``gpipe_forward``."""
+    M = x_mb.shape[0]
+    F_tab, K = vpp_forward_schedule(pp, vpp, M)
+    ticks = F_tab.shape[0]
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    # the schedule table is an explicit replicated argument (NOT a
+    # closure constant: shard_map transposition cannot assign specs to
+    # lifted constants, which would break jax.grad through this forward)
+    def body(stacked, xs, F_jt):
+        sp_v = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        r = jax.lax.axis_index(axis)
+        prev_r = (r - 1) % pp
+
+        def tick(carry, t):
+            fwd_st, in_buf, outs = carry
+            pf_c = F_jt[t - 1, prev_r, 0]
+            pf_m = F_jt[t - 1, prev_r, 1]
+            rcv_c = jnp.where(prev_r == pp - 1, pf_c + 1, pf_c)
+            rcv_ok = jnp.logical_and(
+                t > 0, jnp.logical_and(pf_c >= 0, rcv_c < vpp))
+            arriving = jax.lax.ppermute(fwd_st, axis, fwd_perm)
+            in_buf = jnp.where(
+                rcv_ok, _buf_set(in_buf, arriving, rcv_c, pf_m % K),
+                in_buf)
+
+            my_c = F_jt[t, r, 0]
+            my_m = F_jt[t, r, 1]
+            act = my_c >= 0
+            s_f = my_c * pp + r
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(my_m, 0, M - 1), 0, keepdims=False)
+            stored = _buf_get(in_buf, my_c, my_m % K)
+            inp = jnp.where(s_f == 0, feed, stored)
+            out = stage_fn(_chunk_slice(sp_v, my_c), inp)
+            is_final = jnp.logical_and(
+                act, jnp.logical_and(my_c == vpp - 1, r == pp - 1))
+            outs = jnp.where(
+                is_final,
+                jax.lax.dynamic_update_index_in_dim(
+                    outs, out, jnp.clip(my_m, 0, M - 1), 0),
+                outs)
+            send = jnp.where(act, out, jnp.zeros_like(out))
+            return (send, in_buf, outs), None
+
+        in_buf0 = jnp.zeros((vpp, K) + xs.shape[1:], xs.dtype)
+        outs0 = jnp.zeros_like(xs)
+        (fin, _) = jax.lax.scan(
+            tick, (jnp.zeros_like(xs[0]), in_buf0, outs0),
+            jnp.arange(ticks))
+        _, _, outs = fin
+        return outs[None]
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                         stacked_params), P(), P()),
+        out_specs=P(axis), axis_names={axis}, check_vma=False)
+    stacked = f(stacked_params, x_mb, jnp.asarray(F_tab))  # [pp, M, ...]
+    return stacked[pp - 1]
+
+
+def interleaved_value_and_grad(stage_fn: Callable, loss_fn: Callable,
+                               stacked_params, x_mb, y_mb, mesh: Mesh,
+                               pp: int, vpp: int, axis: str = "pp",
+                               remat_stage: bool = False):
+    """Interleaved-VPP analogue of ``pipeline_value_and_grad``.
+
+    ``stacked_params``: leading [pp, vpp] axes — element [r, c] is the
+    parameters of logical stage ``c*pp + r`` (``stage_fn(chunk_params, x)
+    -> x`` runs ONE chunk).  Returns ``(loss, grads, dxs)`` with grads
+    [pp, vpp]-stacked.  Activations/grads hop rank r -> r+1 (mod pp) /
+    reverse each tick via ``lax.ppermute`` ring; per-(chunk, microbatch)
+    input and incoming-grad buffers are indexed from the static
+    ``vpp_schedule`` table.
+    """
+    M = x_mb.shape[0]
+    S = pp * vpp
+    F_tab, B_tab = vpp_schedule(pp, vpp, M)
+    ticks = F_tab.shape[0]
+    Ka, Kb = vpp_buffer_slots(F_tab, B_tab, pp, vpp, M)
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+    F_jt = jnp.asarray(F_tab)          # [ticks, pp, 2]
+    B_jt = jnp.asarray(B_tab)
+
+    def body(stacked, xs, ys):
+        sp_v = jax.tree_util.tree_map(lambda a: a[0], stacked)  # [v, ...]
+        r = jax.lax.axis_index(axis)
+        prev_r = (r - 1) % pp
+        next_r = (r + 1) % pp
+        sfn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+
+        def stage_loss(p, x, y):
+            out = sfn(p, x)
+            return loss_fn(out, y), out
+
+        def tick(carry, t):
+            (fwd_st, bwd_st, in_buf, g_buf, gacc, lacc, dxs) = carry
+            # schedule entries for this tick
+            fc = F_jt[t, :, 0]
+            fm = F_jt[t, :, 1]
+            bc = B_jt[t, :, 0]
+            bm = B_jt[t, :, 1]
+            my_fc, my_fm = fc[r], fm[r]
+            my_bc, my_bm = bc[r], bm[r]
+
+            # ---- receive activation produced by prev rank last tick ----
+            # what prev rank forwarded at t-1 targets logical stage s+1 =
+            # (their c)*pp + prev_r + 1; for prev_r == pp-1 the hop crosses
+            # a chunk boundary into our chunk c+1.
+            pf_c = F_jt[t - 1, prev_r, 0]
+            pf_m = F_jt[t - 1, prev_r, 1]
+            rcv_c = jnp.where(prev_r == pp - 1, pf_c + 1, pf_c)
+            rcv_ok = jnp.logical_and(
+                t > 0, jnp.logical_and(pf_c >= 0, rcv_c < vpp))
+            arriving = jax.lax.ppermute(fwd_st, axis, fwd_perm)
+            in_buf = jnp.where(
+                rcv_ok,
+                _buf_set(in_buf, arriving, rcv_c, pf_m % Ka),
+                in_buf)
+
+            # ---- receive grad produced by next rank last tick ----------
+            nb_c = B_jt[t - 1, next_r, 0]
+            nb_m = B_jt[t - 1, next_r, 1]
+            # their backward of s' = nb_c*pp + next_r sends dL/dx of s'-1
+            # = our (rank r) chunk nb_c (same chunk) unless next_r == 0,
+            # where s'-1 lands in our chunk nb_c - 1.
+            g_c = jnp.where(next_r == 0, nb_c - 1, nb_c)
+            g_ok = jnp.logical_and(
+                t > 0, jnp.logical_and(nb_c >= 0, g_c >= 0))
+            g_arriving = jax.lax.ppermute(bwd_st, axis, bwd_perm)
+            g_buf = jnp.where(
+                g_ok,
+                _buf_set(g_buf, g_arriving, g_c, nb_m % Kb),
+                g_buf)
+
+            # ---- F phase ----------------------------------------------
+            act_f = my_fc >= 0
+            s_f = my_fc * pp + r
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(my_fm, 0, M - 1), 0, keepdims=False)
+            stored = _buf_get(in_buf, my_fc, my_fm % Ka)
+            inp = jnp.where(s_f == 0, feed, stored)
+            # first logical stage's input also goes through in_buf so the
+            # B phase can recompute from it
+            in_buf = jnp.where(
+                jnp.logical_and(act_f, s_f == 0),
+                _buf_set(in_buf, inp, my_fc, my_fm % Ka),
+                in_buf)
+            fwd_out = sfn(_chunk_slice(sp_v, my_fc), inp)
+
+            # ---- B phase ----------------------------------------------
+            act_b = my_bc >= 0
+            s_b = my_bc * pp + r
+            is_last_b = s_b == S - 1
+            saved = _buf_get(in_buf, my_bc, my_bm % Ka)
+            y_b = jax.lax.dynamic_index_in_dim(
+                ys, jnp.clip(my_bm, 0, M - 1), 0, keepdims=False)
+            sp_b = _chunk_slice(sp_v, my_bc)
+            (loss_val, out_b), pull = jax.vjp(
+                lambda p, x: stage_loss(p, x, y_b), sp_b, saved)
+            seed_loss = jnp.where(is_last_b, jnp.float32(1.0 / M), 0.0)
+            seed_out = jnp.where(is_last_b, jnp.zeros_like(out_b),
+                                 _buf_get(g_buf, my_bc, my_bm % Kb))
+            dp, dx = pull((seed_loss.astype(loss_val.dtype), seed_out))
+
+            gacc = jax.tree_util.tree_map(
+                lambda a, d: a + _chunk_scatter_add(
+                    jnp.zeros_like(a), d, my_bc, act_b).astype(a.dtype),
+                gacc, dp)
+            lacc = lacc + jnp.where(
+                jnp.logical_and(act_b, is_last_b), loss_val,
+                jnp.zeros_like(loss_val)).astype(jnp.float32)
+            dxs = jnp.where(
+                jnp.logical_and(act_b, s_b == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dxs, dx, jnp.clip(my_bm, 0, M - 1), 0),
+                dxs)
+            # rotate this tick's products next tick
+            fwd_send = jnp.where(act_f, fwd_out, jnp.zeros_like(fwd_out))
+            bwd_send = jnp.where(act_b, dx, jnp.zeros_like(dx))
+            return (fwd_send, bwd_send, in_buf, g_buf, gacc, lacc,
+                    dxs), None
+
+        in_buf0 = jnp.zeros((vpp, Ka) + xs.shape[1:], xs.dtype)
+        g_buf0 = jnp.zeros((vpp, Kb) + xs.shape[1:], xs.dtype)
+        gacc0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), sp_v)
+        carry0 = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]),
+                  in_buf0, g_buf0, gacc0, jnp.float32(0.0),
+                  jnp.zeros_like(xs))
+        (fin, _) = jax.lax.scan(tick, carry0, jnp.arange(ticks))
+        _, _, _, _, gacc, lacc, dxs = fin
+        gacc = jax.tree_util.tree_map(lambda a: a[None], gacc)
+        return (gacc, lacc[None], dxs[None])
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                         stacked_params), P(), P()),
+        out_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                          stacked_params),
+                   P(axis), P(axis)),
+        axis_names={axis}, check_vma=False)
+    grads, losses, dxs_all = f(stacked_params, x_mb, y_mb)
+    loss = losses[pp - 1] / M
+    dxs = dxs_all[0]
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, stacked_params)
+    return loss, grads, dxs
+
+
+def _buf_set(buf, val, c, slot):
+    """buf: [v, K, ...]; write val at [c, slot] (traced indices)."""
+    c = jnp.clip(c, 0, buf.shape[0] - 1)
+    row = jax.lax.dynamic_index_in_dim(buf, c, 0, keepdims=False)
+    row = jax.lax.dynamic_update_index_in_dim(row, val, slot, 0)
+    return jax.lax.dynamic_update_index_in_dim(buf, row, c, 0)
+
+
+def _buf_get(buf, c, slot):
+    c = jnp.clip(c, 0, buf.shape[0] - 1)
+    row = jax.lax.dynamic_index_in_dim(buf, c, 0, keepdims=False)
+    return jax.lax.dynamic_index_in_dim(row, slot, 0, keepdims=False)
+
+
+def _chunk_scatter_add(zeros_v, d, c, active):
+    """Add ``d`` into the [v, ...]-stacked ``zeros_v`` at chunk c."""
+    c = jnp.clip(c, 0, zeros_v.shape[0] - 1)
+    row = jax.lax.dynamic_index_in_dim(zeros_v, c, 0, keepdims=False)
+    upd = row + jnp.where(active, d, jnp.zeros_like(d)).astype(row.dtype)
+    return jax.lax.dynamic_update_index_in_dim(zeros_v, upd, c, 0)
+
+
 def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
                             stacked_params, x_mb, y_mb, mesh: Mesh,
                             pp: int, schedule: str = "1f1b",
-                            axis: str = "pp", remat_stage: bool = False):
+                            axis: str = "pp", remat_stage: bool = False,
+                            head_params=None):
     """Compute mean microbatch loss and parameter gradients through the
     pipelined trunk.
 
@@ -112,6 +558,12 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
     real only for its own stage — exactly what an optimizer sharded the
     same way needs) and ``dxs`` is dL/dx_mb (feed it to the vjp of
     whatever produced the trunk inputs, e.g. the embedding).
+
+    ``head_params``: optional extra parameter pytree for a last-stage
+    head folded into the loss — ``loss_fn(head_params, out, y)`` — the
+    tied-unembedding case (reference: pp_layers.py:56 shared_weight_attr
+    + allreduce of shared grads).  Adds ``head_grads`` to the return:
+    ``(loss, grads, head_grads, dxs)``.
     """
     if schedule not in ("1f1b", "fthenb"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
@@ -120,19 +572,29 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
     if schedule == "fthenb":
         sfn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
 
-        def total_loss(stacked, xs, ys):
+        if head_params is None:
+            def total_loss(stacked, xs, ys):
+                outs = gpipe_forward(sfn, stacked, xs, mesh, pp, axis)
+                return jnp.mean(jax.vmap(loss_fn)(outs, ys))
+
+            loss, (grads, dxs) = jax.value_and_grad(
+                total_loss, argnums=(0, 1))(stacked_params, x_mb, y_mb)
+            return loss, grads, dxs
+
+        def total_loss_h(stacked, hp, xs, ys):
             outs = gpipe_forward(sfn, stacked, xs, mesh, pp, axis)
-            losses = jax.vmap(loss_fn)(outs, ys)
+            losses = jax.vmap(lambda o, y: loss_fn(hp, o, y))(outs, ys)
             return jnp.mean(losses)
 
-        loss, (grads, dxs) = jax.value_and_grad(
-            total_loss, argnums=(0, 1))(stacked_params, x_mb, y_mb)
-        return loss, grads, dxs
+        loss, (grads, hgrads, dxs) = jax.value_and_grad(
+            total_loss_h, argnums=(0, 1, 2))(stacked_params,
+                                             head_params, x_mb, y_mb)
+        return loss, grads, hgrads, dxs
 
     # ---- explicit interleaved 1F1B -----------------------------------
     buf_slots = 2 * pp   # >= max in-flight (2(pp - r) - 1 at rank r)
 
-    def body(stacked, xs, ys):
+    def body(stacked, hp, xs, ys):
         sp = jax.tree_util.tree_map(lambda a: a[0], stacked)
         r = jax.lax.axis_index(axis)
         ticks = M + 2 * (pp - 1)
@@ -143,12 +605,14 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
 
         sfn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
 
-        def stage_loss(p, x, y):
+        def stage_loss(p, h, x, y):
             out = sfn(p, x)
-            return loss_fn(out, y), out
+            if head_params is None:
+                return loss_fn(out, y), out
+            return loss_fn(h, out, y), out
 
         def tick(carry, t):
-            (fwd_st, bwd_st, in_buf, gacc, lacc, dxs) = carry
+            (fwd_st, bwd_st, in_buf, gacc, hacc, lacc, dxs) = carry
 
             # ---- F phase: rank r forwards microbatch m_f = t - r ----
             prev = jax.lax.ppermute(fwd_st, axis, fwd_perm)
@@ -180,48 +644,66 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
             # and inner ranks (seeded through the activation output with
             # the incoming grad)
             (loss_val, out_b), pull = jax.vjp(
-                lambda p, x: stage_loss(p, x, y_mb_b), sp, saved)
+                lambda p, h, x: stage_loss(p, h, x, y_mb_b), sp, hp,
+                saved)
             seed_loss = jnp.where(is_last, jnp.float32(1.0 / M), 0.0)
             seed_out = jnp.where(is_last, jnp.zeros_like(out_b), nxt)
-            dp, dx = pull((seed_loss.astype(loss_val.dtype), seed_out))
+            dp, dh, dx = pull((seed_loss.astype(loss_val.dtype),
+                               seed_out))
 
             gacc = jax.tree_util.tree_map(
                 lambda a, d: a + jnp.where(act_b, d, 0).astype(a.dtype),
                 gacc, dp)
-            lacc = lacc + jnp.where(
-                jnp.logical_and(act_b, is_last), loss_val, 0.0)
+            on_last_b = jnp.logical_and(act_b, is_last)
+            hacc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(on_last_b, d,
+                                           0).astype(a.dtype),
+                hacc, dh)
+            lacc = lacc + jnp.where(on_last_b, loss_val, 0.0)
             # rank 0's input-grad is dL/dx for the embedding chain
             dxs = jnp.where(
                 jnp.logical_and(act_b, is_first),
                 jax.lax.dynamic_update_index_in_dim(
                     dxs, dx, jnp.clip(m_b, 0, M - 1), 0),
                 dxs)
-            return (fwd_out, dx, in_buf, gacc, lacc, dxs), None
+            return (fwd_out, dx, in_buf, gacc, hacc, lacc, dxs), None
 
         in_buf0 = jnp.zeros((buf_slots,) + xs.shape[1:], xs.dtype)
         gacc0 = jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, jnp.float32), sp)
+        hacc0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), hp)
         carry0 = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]), in_buf0,
-                  gacc0, jnp.float32(0.0), jnp.zeros_like(xs))
+                  gacc0, hacc0, jnp.float32(0.0), jnp.zeros_like(xs))
         (singles, _) = jax.lax.scan(tick, carry0, jnp.arange(ticks))
-        _, _, _, gacc, lacc, dxs = singles
+        _, _, _, gacc, hacc, lacc, dxs = singles
         # leading [1] axes so the P('pp') out_specs stack per-rank values
         # (loss lives on the last rank, dxs on rank 0); slicing outside
         # avoids an activation AllReduce
         gacc = jax.tree_util.tree_map(lambda a: a[None], gacc)
-        return (gacc, lacc[None], dxs[None])
+        hacc = jax.tree_util.tree_map(lambda a: a[None], hacc)
+        return (gacc, hacc, lacc[None], dxs[None])
 
+    hp_in = head_params if head_params is not None else {}
     f = jax.shard_map(
         body, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
-                                         stacked_params), P(), P()),
+                                         stacked_params),
+                  jax.tree_util.tree_map(lambda _: P(), hp_in),
+                  P(), P()),
         out_specs=(jax.tree_util.tree_map(lambda _: P(axis),
                                           stacked_params),
+                   jax.tree_util.tree_map(lambda _: P(axis), hp_in),
                    P(axis), P(axis)),
         axis_names={axis}, check_vma=False)
-    grads, losses, dxs_all = f(stacked_params, x_mb, y_mb)
+    grads, hgrads, losses, dxs_all = f(stacked_params, hp_in, x_mb, y_mb)
     loss = losses[pp - 1] / M
     dxs = dxs_all[0]
     grads = jax.tree_util.tree_map(
         lambda g, p: g.astype(p.dtype), grads, stacked_params)
-    return loss, grads, dxs
+    if head_params is None:
+        return loss, grads, dxs
+    # head grads are real on the last rank only
+    hgrads = jax.tree_util.tree_map(
+        lambda g, p: g[pp - 1].astype(p.dtype), hgrads, head_params)
+    return loss, grads, hgrads, dxs

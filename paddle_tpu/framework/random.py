@@ -22,16 +22,25 @@ __all__ = ["seed", "get_rng_state", "set_rng_state", "next_key",
 
 
 class Generator:
-    """A splittable PRNG key chain."""
+    """A splittable PRNG key chain.
+
+    Key creation is LAZY (first use, not construction): materialising a
+    PRNGKey initialises the XLA backend, and ``import paddle_tpu`` must
+    stay backend-free so ``jax.distributed.initialize`` (multi-process
+    rendezvous in ``init_parallel_env``) can run after the import."""
 
     def __init__(self, seed_val: int = 0) -> None:
         self._lock = threading.Lock()
-        self._key = jax.random.PRNGKey(seed_val)
+        self._key = None
         self._seed = seed_val
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
 
     def manual_seed(self, seed_val: int) -> "Generator":
         with self._lock:
-            self._key = jax.random.PRNGKey(int(seed_val))
+            self._key = None
             self._seed = int(seed_val)
         return self
 
@@ -40,11 +49,14 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            self._ensure()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return np.asarray(self._key)
+        with self._lock:
+            self._ensure()
+            return np.asarray(self._key)
 
     def set_state(self, state) -> None:
         with self._lock:

@@ -125,8 +125,11 @@ def _block_specs(cfg, stacked_dims: Tuple[str, ...]) -> Dict[str, P]:
     }
 
 
-def param_specs(cfg: LlamaPretrainConfig, pp: int) -> Dict[str, Any]:
-    if pp > 1:
+def param_specs(cfg: LlamaPretrainConfig, pp: int,
+                vpp: int = 1) -> Dict[str, Any]:
+    if pp > 1 and vpp > 1:
+        stacked = ("pp", None, None)  # [pp, vpp, layers_per_chunk, ...]
+    elif pp > 1:
         stacked = ("pp", None)  # [pp, layers_per_stage, ...]
     else:
         stacked = (None,)       # [layers, ...]
@@ -139,7 +142,10 @@ def param_specs(cfg: LlamaPretrainConfig, pp: int) -> Dict[str, Any]:
 
 
 def init_params(cfg: LlamaPretrainConfig, key, mesh: Mesh,
-                pp: int = 1) -> Dict[str, Any]:
+                pp: int = 1, vpp: int = 1) -> Dict[str, Any]:
+    """``vpp > 1`` stacks blocks [pp, vpp, L/(pp*vpp), ...] for the
+    interleaved virtual pipeline: element [r, c] holds the layers of
+    logical stage ``c*pp + r`` (consecutive layers within a chunk)."""
     h = cfg.hidden_size
     L = cfg.num_hidden_layers
     shapes = _block_shapes(cfg)
@@ -147,6 +153,8 @@ def init_params(cfg: LlamaPretrainConfig, key, mesh: Mesh,
     std = 1.0 / math.sqrt(h)
 
     def stacked_shape(shape):
+        if pp > 1 and vpp > 1:
+            return (pp, vpp, L // (pp * vpp)) + shape
         if pp > 1:
             return (pp, L // pp) + shape
         return (L,) + shape
@@ -167,7 +175,7 @@ def init_params(cfg: LlamaPretrainConfig, key, mesh: Mesh,
         "lm_head": jax.random.normal(keys[-1], (h, cfg.vocab_size),
                                      cfg.param_dtype) * std,
     }
-    specs = param_specs(cfg, pp)
+    specs = param_specs(cfg, pp, vpp)
     return jax.tree_util.tree_map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         params, specs,
@@ -334,14 +342,17 @@ def _trunk_scan(blocks, x, cfg, mesh):
     return x
 
 
-def _trunk_pipeline(blocks, x_mb, cfg, mesh, pp: int):
-    """pp > 1: the reusable GPipe engine from distributed/parallel/
+def _trunk_pipeline(blocks, x_mb, cfg, mesh, pp: int, vpp: int = 1):
+    """pp > 1: the reusable pipeline engines from distributed/parallel/
     pipeline.py — hybrid shard_map, manual over 'pp', auto over dp/mp.
+    GPipe rotation for vpp == 1, interleaved virtual pipeline for
+    vpp > 1 (blocks stacked [pp, vpp, Lc, ...]).
 
     ``x_mb``: [M, mb, s, h] microbatches (replicated over pp); each
-    stage scans its own [Lp]-stacked blocks.
+    stage scans its own layer-stacked blocks.
     """
-    from ..distributed.parallel.pipeline import gpipe_forward
+    from ..distributed.parallel.pipeline import (gpipe_forward,
+                                                 interleaved_forward)
 
     fwd = _remat_wrap(_block_forward, cfg)
 
@@ -351,11 +362,13 @@ def _trunk_pipeline(blocks, x_mb, cfg, mesh, pp: int):
         out, _ = jax.lax.scan(step, x, stage_bp)
         return out
 
+    if vpp > 1:
+        return interleaved_forward(stage_fn, blocks, x_mb, mesh, pp, vpp)
     return gpipe_forward(stage_fn, blocks, x_mb, mesh, pp)
 
 
 def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
-                 pp: int = 1, microbatches: int = 1):
+                 pp: int = 1, microbatches: int = 1, vpp: int = 1):
     """Returns pure fn(params, tokens[B,S]) -> logits or loss parts."""
 
     def forward_loss(params, tokens):
@@ -382,7 +395,8 @@ def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
             B = x.shape[0]
             mb = B // microbatches
             x_mb = x.reshape(microbatches, mb, *x.shape[1:])
-            x = _trunk_pipeline(params["blocks"], x_mb, cfg, mesh, pp)
+            x = _trunk_pipeline(params["blocks"], x_mb, cfg, mesh, pp,
+                                vpp)
             x = x.reshape(B, *x.shape[2:])
         else:
             x = _trunk_scan(params["blocks"], x, cfg, mesh)
@@ -572,7 +586,8 @@ def adafactor_update(params, grads, state, lr=1e-2, weight_decay=0.0,
 def make_train_step(cfg: LlamaPretrainConfig, mesh: Mesh, pp: int = 1,
                     microbatches: int = 1, lr: float = 3e-4,
                     weight_decay: float = 0.1, accum_steps: int = 1,
-                    optimizer: str = "adamw", beta1: float = 0.0):
+                    optimizer: str = "adamw", beta1: float = 0.0,
+                    vpp: int = 1):
     """One donated, jitted XLA program: fwd + bwd + optimizer.
 
     ``optimizer``: "adamw" (opt_state from ``init_adamw_state``) or
@@ -586,7 +601,7 @@ def make_train_step(cfg: LlamaPretrainConfig, mesh: Mesh, pp: int = 1,
     extra trunk FLOPs, while accumulation costs none (the optimizer and
     its HBM traffic also amortise over the larger global batch).
     """
-    fwd = make_forward(cfg, mesh, pp, microbatches)
+    fwd = make_forward(cfg, mesh, pp, microbatches, vpp)
     if optimizer not in ("adamw", "adafactor"):
         raise ValueError(f"optimizer must be adamw/adafactor, "
                          f"got {optimizer!r}")

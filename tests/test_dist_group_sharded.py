@@ -106,3 +106,62 @@ def test_stage3_param_bytes_shrink(_mesh):
     for p in big:
         assert _max_local_bytes(p._data) == \
             p._data.size * p._data.dtype.itemsize
+
+
+def test_stage3_offload_states_on_host(_mesh):
+    """offload=True pins optimizer states to the host device
+    (reference: group_sharded_stage3.py:85 offload) — states are
+    committed to cpu:0 while params keep their own placement, parity
+    with the non-offloaded run holds, and moment bytes on the param
+    devices are zero."""
+    ref_net, x, y = _make(7)
+    ref_opt = paddle.optimizer.AdamW(
+        learning_rate=0.05, parameters=ref_net.parameters())
+    ref_losses = _train(ref_net, ref_opt, x, y)
+
+    net, x2, y2 = _make(7)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.05, parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, "p_g_os",
+                                           offload=True)
+    losses = _train(model, opt, x2, y2)
+    np.testing.assert_allclose(losses, ref_losses, atol=1e-5)
+
+    host = jax.devices("cpu")[0]
+    n_states = 0
+    for st in opt._inner._states.values():
+        for k, v in st.items():
+            if hasattr(v, "devices") and getattr(v, "ndim", 0) >= 1:
+                assert v.devices() == {host}, (k, v.devices())
+                n_states += 1
+    assert n_states > 0
+
+
+def test_stage2_grad_reshard_is_batched(_mesh):
+    """Stage-2 grad resharding goes through ONE batched device_put per
+    step, not a per-param loop (round-2 weak item 4)."""
+    net, x, y = _make(8)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.05, parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, "os_g")
+    calls = []
+    orig = jax.device_put
+
+    def counting_device_put(*a, **kw):
+        calls.append(a)
+        return orig(*a, **kw)
+
+    loss = ((model(x) - y) ** 2).mean()
+    loss.backward()
+    jax.device_put = counting_device_put
+    try:
+        opt.step()
+    finally:
+        jax.device_put = orig
+    opt.clear_grad()
+    # one batched call for the grads; the update itself does no
+    # device_put in the non-offload path
+    batched = [a for a in calls if isinstance(a[0], (list, tuple))]
+    assert len(batched) == 1, len(calls)
+    assert len(batched[0][0]) == len(
+        [p for p in net.parameters() if not p.stop_gradient])

@@ -184,3 +184,51 @@ def test_adafactor_and_bf16_moment_lanes():
             if first is None:
                 first = float(loss)
         assert float(loss) < first - 0.5, (first, float(loss))
+
+
+def test_flagship_vpp_matches_flat():
+    """Interleaved virtual pipeline (vpp=2) on the flagship trunk: loss
+    and grads equal the flat pp=1 stack on identical weights (reference:
+    WithInterleave, pipeline_parallel.py:1010)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama_pretrain import (
+        LlamaPretrainConfig, build_mesh, init_params, make_forward)
+
+    cfg = LlamaPretrainConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=8, num_attention_heads=4,
+        num_key_value_heads=4, max_seq_len=16,
+        use_pallas_attention=False, sequence_parallel=False,
+        remat=False, dtype=jnp.float32)
+    mesh = build_mesh(dp=2, pp=2, sharding=1, sep=1, mp=2)
+    toks = jnp.asarray(np.random.RandomState(1).randint(0, 64, (4, 16)))
+    with mesh:
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh, pp=2,
+                             vpp=2)
+        loss_vpp = jax.jit(make_forward(cfg, mesh, pp=2, microbatches=2,
+                                        vpp=2))(params, toks)
+        # same weights in logical-stage order: [pp, v, Lc] -> [v, pp, Lc]
+        # -> flat [L] (logical stage s = c*pp + r holds consecutive
+        # layers)
+        flat = jax.tree_util.tree_map(
+            lambda a: a.transpose(1, 0, *range(2, a.ndim)).reshape(
+                (-1,) + a.shape[3:]),
+            params["blocks"])
+        pf = dict(params)
+        pf["blocks"] = flat
+        loss_flat = jax.jit(make_forward(cfg, mesh, pp=1))(pf, toks)
+        np.testing.assert_allclose(float(loss_vpp), float(loss_flat),
+                                   rtol=2e-5)
+        g_vpp = jax.jit(jax.grad(make_forward(
+            cfg, mesh, pp=2, microbatches=2, vpp=2)))(params, toks)
+        g_flat = jax.jit(jax.grad(make_forward(cfg, mesh, pp=1)))(
+            pf, toks)
+        gv = jax.tree_util.tree_map(
+            lambda a: a.transpose(1, 0, *range(2, a.ndim)).reshape(
+                (-1,) + a.shape[3:]),
+            g_vpp["blocks"])
+        for a, b in zip(jax.tree_util.tree_leaves(gv),
+                        jax.tree_util.tree_leaves(g_flat["blocks"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
