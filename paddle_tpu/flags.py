@@ -157,6 +157,17 @@ define_flag("FLAGS_low_precision_op_list", 0,
             "Collect per-op AMP statistics (paddle.amp.debugging).")
 define_flag("FLAGS_pallas_flash_attention", True,
             "Use the Pallas flash-attention kernel when applicable.")
+define_flag("FLAGS_pallas_rope", True,
+            "Use the Pallas fused-rope kernel in the flagship trunk "
+            "(measured +2.7% on the 1.3B bench: the composite form's "
+            "split/concat + fp32 broadcasts cost more than the kernel "
+            "boundary — see PERF.md).")
+define_flag("FLAGS_pallas_swiglu", False,
+            "Use the Pallas swiglu kernel in the flagship trunk "
+            "(default off: measured -3.8% on the 1.3B bench — XLA "
+            "fuses silu*up into the surrounding matmuls and the kernel "
+            "boundary forces an HBM round-trip; kept for the incubate "
+            "fused-op API — see PERF.md).")
 define_flag("FLAGS_pallas_interpret", False,
             "Run Pallas kernels in interpret mode (CPU testing).")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity for paddle_tpu.")

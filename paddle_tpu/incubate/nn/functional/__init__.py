@@ -54,12 +54,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     q = as_tensor(q)
 
     def make_sincos(s, d, dtype):
-        inv = 1.0 / (rotary_emb_base ** (
-            jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-        t = jnp.arange(s, dtype=jnp.float32)
-        freqs = jnp.outer(t, inv)
-        emb = jnp.concatenate([freqs, freqs], axis=-1)
-        return jnp.sin(emb).astype(dtype), jnp.cos(emb).astype(dtype)
+        # single source of the table math: ops/pallas/rope.rope_tables
+        from ....ops.pallas.rope import rope_tables
+        cos_h, sin_h = rope_tables(s, d, float(rotary_emb_base))
+        return (jnp.concatenate([sin_h, sin_h], -1).astype(dtype),
+                jnp.concatenate([cos_h, cos_h], -1).astype(dtype))
 
     def rope_one(x, sin_e, cos_e):
         # x: [b, s, h, d]
@@ -78,6 +77,17 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
     def fn(*arrs):
         s, d = arrs[0].shape[1], arrs[0].shape[-1]
+        # Pallas fused-rope kernel lane (rotate-half == neox style with
+        # [s, d/2] tables); measured +2.7% on the 1.3B bench (PERF.md)
+        from ....flags import flags as _flags
+        from ....ops.dispatch import get_op_impl
+        impl = get_op_impl("fused_rope", None)
+        if (impl is not None and _flags.FLAGS_pallas_rope and
+                use_neox_rotary_style and position_ids is None and
+                sin is None and d % 128 == 0):
+            from ....ops.pallas.rope import rope_tables
+            cos_t, sin_t = rope_tables(s, d, float(rotary_emb_base))
+            return tuple(impl(a, cos_t, sin_t) for a in arrs)
         if sin is None:
             sin_e, cos_e = make_sincos(s, d, arrs[0].dtype)
         else:
@@ -97,12 +107,24 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
 
 def swiglu(x, y=None, name=None):
-    """Reference: incubate swiglu — silu(x) * y (or split last dim)."""
+    """Reference: incubate swiglu — silu(x) * y (or split last dim).
+    Routes to the Pallas kernel under FLAGS_pallas_swiglu (off by
+    default: measured slower than XLA's fusion on the 1.3B bench,
+    PERF.md)."""
+    from ....flags import flags as _flags
+    from ....ops.dispatch import get_op_impl
+    impl = get_op_impl("swiglu", None)
+    use_kernel = impl is not None and _flags.FLAGS_pallas_swiglu
+
     if y is None:
         def fn(a):
             a1, a2 = jnp.split(a, 2, axis=-1)
+            if use_kernel:
+                return impl(a1, a2)
             return jax.nn.silu(a1) * a2
         return apply("swiglu", fn, as_tensor(x))
+    if use_kernel:
+        return apply("swiglu", impl, as_tensor(x), as_tensor(y))
     return apply("swiglu", lambda a, b: jax.nn.silu(a) * b,
                  as_tensor(x), as_tensor(y))
 

@@ -60,14 +60,31 @@ class StaticFunction:
     """The compiled wrapper around a Layer or function."""
 
     def __init__(self, obj, input_spec=None, build_strategy=None,
-                 full_graph=True, backend=None):
+                 full_graph=False, backend=None):
         self._obj = obj
         self._input_spec = input_spec
         self._full_graph = full_graph
         self._jitted: Dict[Any, Callable] = {}
         self._out_tree = [None]
+        self._fallback_warned = False
         functools.update_wrapper(
             self, obj.forward if isinstance(obj, Layer) else obj)
+        # dy2static AST pass: rewrite Python if/while whose predicates
+        # are traced into lax.cond / lax.while_loop dispatchers
+        # (reference: jit/dy2static AST transforms).  Conversion is
+        # best-effort; the original stays the eager-fallback target.
+        from .dy2static import ast_transform
+        self._fallback_keys: set = set()
+        if isinstance(obj, Layer):
+            conv = ast_transform(type(obj).forward)
+            # the converted forward is swapped in ONLY while tracing
+            # (see pure()); the original stays the eager target so a
+            # conversion bug can never poison plain eager use
+            self._converted_method = conv
+            self._converted = None
+        else:
+            self._converted_method = None
+            self._converted = ast_transform(obj)
 
     @property
     def _layer(self) -> Optional[Layer]:
@@ -108,8 +125,13 @@ class StaticFunction:
         kw_names = sorted(tensor_kwargs)
         out_tree = self._out_tree
 
-        key = self._cache_key(kwargs) + (tuple(arg_spec.count(None)
-                                               for _ in [0]),)
+        # non-Tensor positional values are baked into the trace as
+        # statics, so they must be part of the cache key
+        key = self._cache_key(kwargs) + (
+            tuple("·" if s is None else repr(s) for s in arg_spec),)
+        if key in self._fallback_keys:
+            # known graph break: skip re-tracing straight to eager
+            return self._obj(*args, **kwargs)
 
         jfn = self._jitted.get(key)
         if jfn is None:
@@ -135,12 +157,28 @@ class StaticFunction:
                             bufs[nname[5:]] = arr
                         else:
                             params[nname] = arr
-                    out = layer._functional_call(params, *call_args,
-                                                 buffers=bufs,
-                                                 **call_kwargs)
+                    conv = self._converted_method
+                    if conv is not None:
+                        import types
+                        orig_fwd = layer.__dict__.get("forward")
+                        layer.forward = types.MethodType(conv, layer)
+                        try:
+                            out = layer._functional_call(
+                                params, *call_args, buffers=bufs,
+                                **call_kwargs)
+                        finally:
+                            if orig_fwd is None:
+                                del layer.forward
+                            else:
+                                layer.forward = orig_fwd
+                    else:
+                        out = layer._functional_call(
+                            params, *call_args, buffers=bufs,
+                            **call_kwargs)
                 else:
+                    fn = self._converted or obj
                     with tape.functional_trace_guard():
-                        out = obj(*call_args, **call_kwargs)
+                        out = fn(*call_args, **call_kwargs)
                 flat, treedef = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))
                 out_tree[0] = treedef
@@ -154,9 +192,25 @@ class StaticFunction:
             outs = apply("to_static", jfn, *p_tensors, *tensor_args,
                          *[tensor_kwargs[k] for k in kw_names],
                          n_outputs=-1)
-        except Exception:
+        except Exception as e:
             if not self._full_graph:
-                # graph break: eager fallback (SOT-style)
+                # graph break: eager fallback (SOT-style), announced
+                # once so silent de-optimisation is visible; the key is
+                # memoised so later calls skip the doomed re-trace
+                self._jitted.pop(key, None)
+                self._fallback_keys.add(key)
+                if not self._fallback_warned:
+                    self._fallback_warned = True
+                    import warnings
+                    warnings.warn(
+                        f"to_static({getattr(self, '__name__', '?')}): "
+                        f"whole-graph tracing failed "
+                        f"({type(e).__name__}: {str(e)[:200]}); running "
+                        f"eagerly.  Data-dependent Python control flow "
+                        f"that the dy2static pass could not convert to "
+                        f"lax.cond/lax.while_loop is the usual cause — "
+                        f"pass full_graph=True to make this an error",
+                        RuntimeWarning, stacklevel=2)
                 return self._obj(*args, **kwargs)
             raise
         if not isinstance(outs, tuple):
@@ -173,8 +227,15 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """Mirror of ``paddle.jit.to_static`` (api.py:171)."""
+              backend=None, full_graph=False, **kwargs):
+    """Mirror of ``paddle.jit.to_static`` (api.py:171).
+
+    ``full_graph=False`` (default, the reference's SOT mode): Python
+    ``if``/``while`` on traced values are converted to ``lax.cond`` /
+    ``lax.while_loop`` by the dy2static AST pass; anything it cannot
+    convert falls back to eager with one structured warning (the graph
+    break).  ``full_graph=True`` turns conversion failures into errors
+    (the reference's AST-only strict mode)."""
 
     def decorate(obj):
         if isinstance(obj, Layer):

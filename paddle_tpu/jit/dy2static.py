@@ -1,0 +1,400 @@
+"""Dynamic-to-static control-flow conversion.
+
+Reference architecture: the dy2static AST transformer + SOT
+(/root/reference/python/paddle/jit/dy2static/, jit/api.py:171) rewrites
+Python ``if``/``while`` whose predicates are Tensors into
+``convert_ifelse``/``convert_while_loop`` calls that build static-graph
+control-flow ops, and falls back (graph break) where conversion cannot
+apply.
+
+TPU-native realisation: the same two-level design, but the converted
+ops are XLA's structured control flow —
+
+* ``convert_ifelse``    -> ``jax.lax.cond``  (both branches traced once,
+                           predicate evaluated on device)
+* ``convert_while_loop``-> ``jax.lax.while_loop`` (body compiled once,
+                           shape-invariant carry)
+
+and the runtime dispatch keeps plain-Python semantics when the
+predicate is a concrete bool/number (eager mode, or static values under
+trace).  The AST pass (:func:`ast_transform`) rewrites every ``if`` /
+``while`` statement into these calls; unsupported shapes (early
+``return``/``break``, non-name assignment targets) are left as plain
+Python — if such a statement then trips on a traced predicate, the
+``to_static`` wrapper emits ONE structured warning and re-runs the
+function eagerly (the SOT graph-break analog).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import warnings
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, wrap_array
+
+__all__ = ["convert_ifelse", "convert_while_loop", "ast_transform",
+           "UNDEF", "capture"]
+
+
+class _Undefined:
+    """Sentinel for names not yet bound when a branch captures scope."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def capture(local_vars: dict, names):
+    """Snapshot ``names`` out of ``locals()`` (UNDEF when absent)."""
+    return {n: local_vars.get(n, UNDEF) for n in names}
+
+
+def _is_traced(x) -> bool:
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_value(pred):
+    if isinstance(pred, Tensor):
+        return pred._data
+    return pred
+
+
+def _flatten(vals):
+    """Split a tuple of branch results into (array leaves, rebuild fn).
+    Tensors unwrap to arrays; non-array values must match between
+    branches and ride along statically."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        vals, is_leaf=lambda x: isinstance(x, Tensor))
+    arrs = [t._data if isinstance(t, Tensor) else t for t in leaves]
+    return arrs, treedef
+
+
+def _rewrap(arrs, treedef):
+    out = []
+    for a in arrs:
+        out.append(wrap_array(a) if hasattr(a, "dtype") and
+                   not isinstance(a, Tensor) else a)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable):
+    """Runtime dispatch for a rewritten ``if``:
+
+    * concrete predicate -> plain Python branch (eager semantics, tape
+      records only the taken branch);
+    * traced predicate   -> ``jax.lax.cond``: both branches traced
+      inside the cond, only the selected one executes on device.
+    """
+    pv = _pred_value(pred)
+    if not _is_traced(pv):
+        return true_fn() if bool(pv) else false_fn()
+
+    tree_box = [None]
+
+    def mk(fn):
+        def thunk(_):
+            arrs, treedef = _flatten(fn())
+            if tree_box[0] is None:
+                tree_box[0] = treedef
+            elif treedef != tree_box[0]:
+                raise TypeError(
+                    f"convert_ifelse: branches produce different "
+                    f"structures ({treedef} vs {tree_box[0]})")
+            return tuple(jnp.asarray(a) for a in arrs)
+        return thunk
+
+    out = jax.lax.cond(jnp.asarray(pv).astype(bool),
+                       mk(true_fn), mk(false_fn), None)
+    return _rewrap(list(out), tree_box[0])
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable, carry):
+    """Runtime dispatch for a rewritten ``while``:
+
+    * concrete first predicate and no tracing -> plain Python loop;
+    * traced predicate or carry -> ``jax.lax.while_loop`` (carry must be
+      shape-invariant across iterations; XLA compiles the body once).
+    """
+    first = _pred_value(cond_fn(*carry))
+    carry_traced = any(_is_traced(c) for c in carry)
+    if not _is_traced(first) and not carry_traced:
+        vals = carry
+        while bool(cond_fn(*vals)):
+            vals = body_fn(*vals)
+        return vals
+
+    arrs, treedef = _flatten(tuple(carry))
+    arrs = [jnp.asarray(a) for a in arrs]
+
+    def c_fn(flat):
+        vals = _rewrap(list(flat), treedef)
+        return jnp.asarray(_pred_value(cond_fn(*vals))).astype(bool)
+
+    def b_fn(flat):
+        vals = _rewrap(list(flat), treedef)
+        out = body_fn(*vals)
+        out_arrs, out_tree = _flatten(tuple(out))
+        if out_tree != treedef:
+            raise TypeError(
+                "convert_while_loop: body changes the carry structure")
+        return tuple(jnp.asarray(a) for a in out_arrs)
+
+    final = jax.lax.while_loop(c_fn, b_fn, tuple(arrs))
+    return _rewrap(list(final), treedef)
+
+
+# ---------------------------------------------------------------------------
+# AST pass
+# ---------------------------------------------------------------------------
+class _Unsupported(Exception):
+    pass
+
+
+def _assigned_names(nodes) -> Optional[set]:
+    """Names bound by simple assignments in a statement list (recursing
+    into nested if/while); None when an unsupported construct appears."""
+    names: set = set()
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Return, ast.Break, ast.Continue,
+                                ast.Raise, ast.Try, ast.With,
+                                ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef, ast.Global, ast.Nonlocal,
+                                ast.Import, ast.ImportFrom,
+                                ast.Delete)):
+                return None
+            if isinstance(sub, ast.NamedExpr):
+                names.add(sub.target.id)
+            if isinstance(sub, ast.For):
+                if isinstance(sub.target, ast.Name):
+                    names.add(sub.target.id)
+                elif isinstance(sub.target, (ast.Tuple, ast.List)) and \
+                        all(isinstance(e, ast.Name)
+                            for e in sub.target.elts):
+                    names.update(e.id for e in sub.target.elts)
+                else:
+                    return None
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            if isinstance(el, ast.Name):
+                                names.add(el.id)
+                            else:
+                                return None
+                    elif isinstance(t, (ast.Subscript, ast.Attribute)):
+                        return None
+                    else:
+                        return None
+    return names
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _CFTransformer(ast.NodeTransformer):
+    """Rewrite ``if``/``while`` statements into convert_* calls.
+
+    ``local_names``: names local to the function being transformed —
+    predicate names are intersected with it so builtins/globals
+    appearing in a test (``len``, module names) are NOT captured into
+    branch parameters (capturing them would shadow them with UNDEF)."""
+
+    def __init__(self, local_names=frozenset()):
+        self._n = 0
+        self._locals = set(local_names)
+
+    def _uid(self) -> int:
+        self._n += 1
+        return self._n
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        assigned_t = _assigned_names(node.body)
+        assigned_f = _assigned_names(node.orelse)
+        if assigned_t is None or assigned_f is None:
+            return node         # unsupported shape: leave as Python
+        assigned = sorted(set(assigned_t) | set(assigned_f))
+        if not assigned:
+            return node         # side-effect-only branches: leave
+        uid = self._uid()
+        live = sorted(set(assigned) |
+                      (_names_in(node.test) & self._locals))
+        cap_name = f"__dy2st_live_{uid}"
+        args = [ast.arg(arg=n) for n in live]
+        defaults = [ast.Subscript(
+            value=ast.Name(id=cap_name, ctx=ast.Load()),
+            slice=ast.Constant(value=n), ctx=ast.Load()) for n in live]
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load()))
+
+        def branch(name, body):
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(posonlyargs=[], args=args,
+                                   vararg=None, kwonlyargs=[],
+                                   kw_defaults=[], kwarg=None,
+                                   defaults=defaults),
+                body=(body or [ast.Pass()]) + [ret],
+                decorator_list=[], type_params=[])
+
+        cap = ast.Assign(
+            targets=[ast.Name(id=cap_name, ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="__dy2st__", ctx=ast.Load()),
+                    attr="capture", ctx=ast.Load()),
+                args=[ast.Call(func=ast.Name(id="locals",
+                                             ctx=ast.Load()),
+                               args=[], keywords=[]),
+                      ast.Constant(value=live)],
+                keywords=[]))
+        t_name, f_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in assigned], ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="__dy2st__", ctx=ast.Load()),
+                    attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=t_name, ctx=ast.Load()),
+                      ast.Name(id=f_name, ctx=ast.Load())],
+                keywords=[]))
+        return [cap, branch(t_name, node.body),
+                branch(f_name, node.orelse), call]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        assigned = _assigned_names(node.body)
+        if assigned is None or not assigned:
+            return node
+        uid = self._uid()
+        loop_vars = sorted(set(assigned) |
+                           (_names_in(node.test) & self._locals))
+        cap_name = f"__dy2st_live_{uid}"
+        args = [ast.arg(arg=n) for n in loop_vars]
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
+            ctx=ast.Load()))
+        c_name, b_name = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        cond_def = ast.FunctionDef(
+            name=c_name,
+            args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                               kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            type_params=[])
+        body_def = ast.FunctionDef(
+            name=b_name,
+            args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                               kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=list(node.body) + [ret], decorator_list=[],
+            type_params=[])
+        cap = ast.Assign(
+            targets=[ast.Name(id=cap_name, ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="__dy2st__", ctx=ast.Load()),
+                    attr="capture", ctx=ast.Load()),
+                args=[ast.Call(func=ast.Name(id="locals",
+                                             ctx=ast.Load()),
+                               args=[], keywords=[]),
+                      ast.Constant(value=loop_vars)],
+                keywords=[]))
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in loop_vars], ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="__dy2st__", ctx=ast.Load()),
+                    attr="convert_while_loop", ctx=ast.Load()),
+                args=[ast.Name(id=c_name, ctx=ast.Load()),
+                      ast.Name(id=b_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[
+                          ast.Subscript(
+                              value=ast.Name(id=cap_name,
+                                             ctx=ast.Load()),
+                              slice=ast.Constant(value=n),
+                              ctx=ast.Load()) for n in loop_vars],
+                          ctx=ast.Load())],
+                keywords=[]))
+        return [cap, cond_def, body_def, call]
+
+
+class _Dy2StModule:
+    """The ``__dy2st__`` name injected into transformed functions."""
+    capture = staticmethod(capture)
+    convert_ifelse = staticmethod(convert_ifelse)
+    convert_while_loop = staticmethod(convert_while_loop)
+
+
+def ast_transform(func: Callable) -> Optional[Callable]:
+    """Rewrite ``func``'s if/while statements into convert_* calls.
+    Returns the transformed function, or None when the source is
+    unavailable / the rewrite fails (caller keeps the original)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        fdef.decorator_list = []
+        # function-local names: parameters + every name assigned
+        # anywhere in the body (predicates are intersected with this so
+        # builtins/globals never become captured branch parameters)
+        local_names = {a.arg for a in (
+            fdef.args.posonlyargs + fdef.args.args +
+            fdef.args.kwonlyargs)}
+        for va in (fdef.args.vararg, fdef.args.kwarg):
+            if va is not None:
+                local_names.add(va.arg)
+        for sub in ast.walk(fdef):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Store):
+                local_names.add(sub.id)
+            elif isinstance(sub, ast.NamedExpr):
+                local_names.add(sub.target.id)
+        new = _CFTransformer(local_names).visit(fdef)
+        ast.fix_missing_locations(tree)
+        code_globals = dict(func.__globals__)
+        code_globals["__dy2st__"] = _Dy2StModule
+        freevars = func.__code__.co_freevars
+        if freevars:
+            outer = (f"def __dy2st_outer__({', '.join(freevars)}):\n"
+                     + textwrap.indent(ast.unparse(tree), "    ")
+                     + f"\n    return {fdef.name}")
+            exec(compile(outer, f"<dy2static {func.__qualname__}>",
+                         "exec"), code_globals)
+            cells = [c.cell_contents for c in (func.__closure__ or ())]
+            out = code_globals["__dy2st_outer__"](*cells)
+        else:
+            exec(compile(ast.unparse(tree),
+                         f"<dy2static {func.__qualname__}>", "exec"),
+                 code_globals)
+            out = code_globals[fdef.name]
+        out.__dy2static_transformed__ = True
+        return out
+    except Exception:
+        return None

@@ -193,13 +193,17 @@ def _rms_norm(x, w, eps):
 
 def _rope(q, k, theta):
     # q/k: [b, s, n, d]
+    from ..flags import flags
+    from ..ops.dispatch import get_op_impl
     d = q.shape[-1]
     s = q.shape[1]
-    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    t = jnp.arange(s, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv)                       # [s, d/2]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    from ..ops.pallas.rope import rope_tables
+    impl = get_op_impl("fused_rope", None)
+    cos_t, sin_t = rope_tables(s, d, theta)         # [s, d/2]
+    if impl is not None and flags.FLAGS_pallas_rope and d % 128 == 0:
+        return impl(q, cos_t, sin_t), impl(k, cos_t, sin_t)
+    cos = cos_t[None, :, None, :]
+    sin = sin_t[None, :, None, :]
 
     def rot(x):
         x1, x2 = jnp.split(x, 2, axis=-1)
@@ -260,12 +264,19 @@ def _block_pre_attn(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig):
 def _block_post_attn(bp: Dict[str, Any], x, attn,
                      cfg: LlamaPretrainConfig):
     """Output projection + residual + FFN."""
+    from ..flags import flags
+    from ..ops.dispatch import get_op_impl
     b, s, h = x.shape
     dt = cfg.dtype
     attn = _ckpt_name(attn.reshape(b, s, h), "attn_out")
     x = x + attn @ bp["wo"].astype(dt)
     res = x
     y = _rms_norm(x, bp["ln2"], cfg.rms_norm_eps)
+    sw = get_op_impl("swiglu", None)
+    if sw is not None and flags.FLAGS_pallas_swiglu:
+        act = _ckpt_name(sw(y @ bp["w_gate"].astype(dt),
+                            y @ bp["w_up"].astype(dt)), "ffn_gate")
+        return res + act @ bp["w_down"].astype(dt)
     gate = _ckpt_name(jax.nn.silu(y @ bp["w_gate"].astype(dt)), "ffn_gate")
     up = _ckpt_name(y @ bp["w_up"].astype(dt), "ffn_up")
     return res + (gate * up) @ bp["w_down"].astype(dt)

@@ -10,9 +10,11 @@ from ..dispatch import register_op_impl
 from .flash_attention import flash_attention
 from .rms_norm import rms_norm
 from .fused_adamw import fused_adamw
+from .rope import fused_rope, rope_tables
+from .swiglu import swiglu
 
-__all__ = ["flash_attention", "rms_norm", "fused_adamw",
-           "register_pallas_ops"]
+__all__ = ["flash_attention", "rms_norm", "fused_adamw", "fused_rope",
+           "rope_tables", "swiglu", "register_pallas_ops"]
 
 
 def register_pallas_ops() -> None:
@@ -24,6 +26,8 @@ def register_pallas_ops() -> None:
                      lambda p, g, m, v, t, lr, b1, b2, eps, wd:
                      fused_adamw(p, g, m, v, t, lr, b1, b2, eps, wd))
     register_op_impl("rms_norm", rms_norm)
+    register_op_impl("fused_rope", fused_rope)
+    register_op_impl("swiglu", swiglu)
 
 
 register_pallas_ops()

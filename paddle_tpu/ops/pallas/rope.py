@@ -1,0 +1,107 @@
+"""Rotary position embedding Pallas kernel (fwd + bwd).
+
+Replacement for the reference's fused rotary CUDA op
+(/root/reference/python/paddle/incubate/nn/functional/
+fused_rotary_position_embedding.py, phi/kernels/fusion/gpu/
+fused_rope_*.cu).  Applies the rotate-half form to q and k in one VMEM
+pass per (batch, head) tile:
+
+    out[..., :d/2] = x1 * cos - x2 * sin
+    out[..., d/2:] = x2 * cos + x1 * sin
+
+cos/sin are [S, d/2] tables computed once outside (tiny).  The backward
+is the inverse rotation (sin -> -sin) — no residuals beyond the tables.
+Like swiglu, XLA usually fuses the composite form into the surrounding
+projections; the kernel is kept for fusion-boundary sites and for API
+parity, and the bench keeps whichever path measures faster (PERF.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import idx32
+
+__all__ = ["fused_rope", "rope_tables"]
+
+
+def rope_tables(seq_len: int, head_dim: int, theta: float = 10000.0,
+                dtype=jnp.float32):
+    """cos/sin tables [S, d/2] for :func:`fused_rope`."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, neg_sin: bool):
+    # x: [1, S_blk, N, d]; cos/sin: [S_blk, d/2] broadcast over heads
+    x = x_ref[0].astype(jnp.float32)            # [S_blk, N, d]
+    d = x.shape[-1]
+    h = d // 2
+    cos = cos_ref[:].astype(jnp.float32)[:, None, :]   # [S_blk, 1, d/2]
+    sin = sin_ref[:].astype(jnp.float32)[:, None, :]
+    if neg_sin:
+        sin = -sin
+    x1 = x[..., :h]
+    x2 = x[..., h:]
+    lo = x1 * cos - x2 * sin
+    hi = x2 * cos + x1 * sin
+    o_ref[0] = jnp.concatenate([lo, hi], axis=-1).astype(o_ref.dtype)
+
+
+def _interpret() -> bool:
+    from ...flags import flags
+    if flags.FLAGS_pallas_interpret:
+        return True
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def _apply(x, cos, sin, neg_sin: bool):
+    b, s, n, d = x.shape
+    bs = s
+    # budget: the kernel holds ~5 f32 copies of the block (cast, halves,
+    # rotated halves) double-buffered; keep the raw block under 1 MiB
+    for cand in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if s % cand == 0 and cand * n * d * 4 <= (1 << 20):
+            bs = cand
+            break
+    grid = (b, s // bs)
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, neg_sin=neg_sin),
+        out_shape=jax.ShapeDtypeStruct((b, s, n, d), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bs, n, d),
+                         lambda bi, si: idx32(bi, si, 0, 0)),
+            pl.BlockSpec((bs, d // 2), lambda bi, si: idx32(si, 0)),
+            pl.BlockSpec((bs, d // 2), lambda bi, si: idx32(si, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bs, n, d),
+                               lambda bi, si: idx32(bi, si, 0, 0)),
+        interpret=_interpret(),
+    )(x, cos, sin)
+
+
+@jax.custom_vjp
+def fused_rope(x, cos, sin):
+    """Rotate-half RoPE on [B, S, N, D] with [S, D/2] tables."""
+    return _apply(x, cos, sin, neg_sin=False)
+
+
+def _vjp_fwd(x, cos, sin):
+    return _apply(x, cos, sin, neg_sin=False), (cos, sin)
+
+
+def _vjp_bwd(res, dout):
+    cos, sin = res
+    # rotation is orthonormal: the vjp is the inverse rotation
+    return _apply(dout, cos, sin, neg_sin=True), None, None
+
+
+fused_rope.defvjp(_vjp_fwd, _vjp_bwd)

@@ -121,3 +121,103 @@ def check_grad(paddle_fn: Callable, inputs: Sequence[np.ndarray],
         np.testing.assert_allclose(
             analytic.numpy(), numeric, atol=atol, rtol=rtol,
             err_msg=f"gradient mismatch for input {i}")
+
+
+# ---------------------------------------------------------------------------
+# Per-dtype lanes (reference: op_test.py check_output :2762 runs per-place
+# AND per-dtype with bf16/fp16 tolerances; check_grad :2964 likewise)
+# ---------------------------------------------------------------------------
+LOW_PRECISION_DTYPES = ("bfloat16", "float16")
+
+GRAD_TOL = {
+    "bfloat16": {"atol": 8e-2, "rtol": 8e-2},
+    "float16": {"atol": 2e-2, "rtol": 2e-2},
+    "float32": {"atol": 5e-3, "rtol": 5e-3},
+}
+
+
+def _quantize(a, dtype: str):
+    """Round-trip a float array through ``dtype``: the low-precision
+    tensor AND the fp32 view the numpy reference should see (the
+    reference compares the low-precision op against an fp32 reference
+    computed on identically-quantized inputs)."""
+    a = np.asarray(a)
+    if a.dtype.kind in "iub":
+        return paddle.to_tensor(a), a
+    t = paddle.to_tensor(a.astype("float32")).astype(dtype)
+    return t, t.astype("float32").numpy()
+
+
+def check_output_dtypes(paddle_fn: Callable, numpy_fn: Callable,
+                        inputs: Sequence[np.ndarray],
+                        dtypes: Sequence[str] = ("float32",) +
+                        LOW_PRECISION_DTYPES,
+                        kwargs: Optional[dict] = None,
+                        atol=None, rtol=None) -> None:
+    """check_output across dtype lanes with per-dtype tolerances."""
+    kwargs = kwargs or {}
+    for dt in dtypes:
+        tensors, quants = [], []
+        for a in inputs:
+            t, q = _quantize(a, dt)
+            tensors.append(t)
+            quants.append(q)
+        got = paddle_fn(*tensors, **kwargs)
+        want = numpy_fn(*quants, **kwargs)
+        if not isinstance(got, (tuple, list)):
+            got, want = [got], [want]
+        a_, r_ = _tol(dt, atol, rtol)
+        for g, w in zip(got, want):
+            gn = _to_np(g)
+            np.testing.assert_allclose(
+                gn.astype(np.float64) if gn.dtype != np.bool_ else gn,
+                np.asarray(w).astype(np.float64)
+                if np.asarray(w).dtype != np.bool_ else np.asarray(w),
+                atol=a_, rtol=r_,
+                err_msg=f"op output mismatch in dtype lane {dt}")
+
+
+def check_grad_dtypes(paddle_fn: Callable,
+                      inputs: Sequence[np.ndarray],
+                      dtypes: Sequence[str] = LOW_PRECISION_DTYPES,
+                      kwargs: Optional[dict] = None,
+                      atol=None, rtol=None) -> None:
+    """Low-precision analytic gradients vs the float64 analytic tape
+    gradient (finite differences are meaningless at bf16 resolution —
+    the reference's bf16 check_grad likewise compares against
+    user-defined fp32 grads, op_test.py:2964)."""
+    kwargs = kwargs or {}
+    rng = np.random.RandomState(11)
+
+    def run(dt):
+        rng.seed(11)  # same cotangent in every lane
+        tensors = []
+        for a in inputs:
+            t, _ = _quantize(a, dt)
+            t.stop_gradient = False
+            tensors.append(t)
+        out = paddle_fn(*tensors, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        ct = rng.uniform(0.5, 1.5, size=tuple(out.shape))
+        loss = (out.astype("float32") *
+                paddle.to_tensor(ct.astype("float32"))).sum()
+        loss.backward()
+        return [t.grad.numpy().astype(np.float64)
+                if t.grad is not None else None for t in tensors]
+
+    ref = run("float64")
+    for dt in dtypes:
+        got = run(dt)
+        tol = GRAD_TOL.get(dt, GRAD_TOL["float32"])
+        a_ = atol if atol is not None else tol["atol"]
+        r_ = rtol if rtol is not None else tol["rtol"]
+        for i, (g, w) in enumerate(zip(got, ref)):
+            if w is None:
+                continue
+            assert g is not None, f"no {dt} grad for input {i}"
+            # relative to the reference grad's scale
+            scale = np.maximum(np.abs(w), 1.0)
+            np.testing.assert_allclose(
+                g / scale, w / scale, atol=a_ + r_,
+                err_msg=f"grad mismatch for input {i} in lane {dt}")

@@ -1,0 +1,218 @@
+"""dy2static control-flow conversion (round-3 item 8).
+
+Reference patterns: /root/reference/test/dygraph_to_static/
+(test_ifelse.py, test_loop.py) — data-dependent branches and loops over
+tensors must survive to_static; unconvertible shapes gracefully fall
+back with a warning instead of exploding.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (ast_transform, convert_ifelse,
+                                      convert_while_loop)
+
+
+def test_convert_ifelse_concrete():
+    assert convert_ifelse(True, lambda: 1, lambda: 2) == 1
+    assert convert_ifelse(False, lambda: 1, lambda: 2) == 2
+    t = paddle.to_tensor(3.0)
+    assert convert_ifelse(t > 0, lambda: "pos", lambda: "neg") == "pos"
+
+
+def test_convert_while_concrete():
+    out = convert_while_loop(lambda i, s: i < 5,
+                             lambda i, s: (i + 1, s + i), (0, 0))
+    assert out == (5, 10)
+
+
+def test_ast_transform_branch():
+    def f(x, flag):
+        if flag > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    g = ast_transform(f)
+    assert g is not None and g.__dy2static_transformed__
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(
+        g(x, paddle.to_tensor(1.0)).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(
+        g(x, paddle.to_tensor(-1.0)).numpy(), [0.0, 1.0])
+
+
+def test_to_static_data_dependent_branch():
+    """The reference test_ifelse pattern: branch on a traced value
+    inside a jitted function becomes lax.cond."""
+    @paddle.jit.to_static
+    def f(x):
+        if (x.sum() > 0):
+            y = x * 2
+        else:
+            y = -x
+        return y
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(f(x).numpy(), [2.0, 4.0])
+    x2 = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(x2).numpy(), [1.0, 2.0])
+
+
+def test_to_static_loop_over_tensor():
+    """The reference test_loop pattern: while on a traced scalar becomes
+    lax.while_loop."""
+    @paddle.jit.to_static
+    def f(n):
+        i = paddle.to_tensor(0.0)
+        s = paddle.to_tensor(0.0)
+        while i < n:
+            s = s + i
+            i = i + 1
+        return s
+
+    out = f(paddle.to_tensor(5.0))
+    assert float(out) == 10.0
+    out = f(paddle.to_tensor(3.0))
+    assert float(out) == 3.0
+
+
+def test_to_static_layer_with_branch():
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:
+                out = h * 2
+            else:
+                out = h
+            return out
+
+    net = paddle.jit.to_static(Net())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    out = net(x)
+    assert out.shape == [2, 4]
+
+
+def test_to_static_unconvertible_falls_back_with_warning():
+    """Early return inside a traced branch cannot become lax.cond —
+    one structured warning, eager execution, correct result."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2        # early return: not convertible
+        return -x
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = f(x)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        msgs = [str(w.message) for w in rec
+                if issubclass(w.category, RuntimeWarning)]
+        assert any("running eagerly" in m for m in msgs), msgs
+        # second call: no new warning (warned once)
+        n_before = len([m for m in msgs if "running eagerly" in m])
+    with warnings.catch_warnings(record=True) as rec2:
+        warnings.simplefilter("always")
+        f(x)
+        msgs2 = [str(w.message) for w in rec2
+                 if "running eagerly" in str(w.message)]
+        assert not msgs2
+
+
+def test_to_static_full_graph_raises():
+    @paddle.jit.to_static(full_graph=True)
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return -x
+
+    with pytest.raises(Exception):
+        f(paddle.to_tensor(np.array([1.0], np.float32)))
+
+
+def test_to_static_static_arg_in_cache_key():
+    """Non-Tensor positional values are trace statics: changing them
+    must not reuse a stale compiled graph (round-3 review finding)."""
+    @paddle.jit.to_static
+    def f(x, scale):
+        return x * scale
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(f(x, 2.0).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(x, 5.0).numpy(), [5.0, 10.0])
+
+
+def test_builtin_in_predicate_not_shadowed():
+    """Builtins/globals in a converted predicate must not be captured
+    as branch parameters (they would become UNDEF)."""
+    @paddle.jit.to_static
+    def f(x):
+        if len(x.shape) > 1:
+            y = x * len(x.shape)
+        else:
+            y = x - 1
+        return y
+
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(f(x).numpy(), np.full((2, 3), 2.0))
+
+
+def test_layer_eager_path_untouched_by_conversion():
+    """to_static(Layer) must not mutate the instance's eager forward —
+    the original is the fallback and plain eager use must be intact."""
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if len(x.shape) > 1:
+                out = h * len(x.shape)
+            else:
+                out = h
+            return out
+
+    net = Net()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    eager_before = net(x).numpy()
+    static = paddle.jit.to_static(net)
+    static_out = static(x).numpy()
+    eager_after = net(x).numpy()
+    np.testing.assert_allclose(eager_after, eager_before, atol=1e-6)
+    np.testing.assert_allclose(static_out, eager_before, atol=1e-5)
+
+
+def test_for_loop_binding_in_branch():
+    """for-target names bound inside a converted branch leak out like
+    plain Python (reference dy2static loop-variable semantics)."""
+    @paddle.jit.to_static
+    def f(x, flag):
+        if flag:
+            y = x * 0
+            for j in range(3):
+                y = y + j
+        else:
+            y = x - 1
+            j = -1
+        return y, j
+
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    y, j = f(x, True)
+    np.testing.assert_allclose(y.numpy(), [3.0, 3.0])
+    assert int(j) == 2

@@ -123,3 +123,75 @@ def test_fused_adamw_indivisible_size():
     np.testing.assert_allclose(np.asarray(p2), np.asarray(ref), rtol=1e-4,
                                atol=1e-7)
     assert p2.shape == (n,) and st["m"].shape == (n,) and st["v"].shape == (n,)
+
+
+def test_swiglu_parity(_interpret_mode):
+    from paddle_tpu.ops.pallas import swiglu
+    rng = np.random.RandomState(3)
+    g = jnp.asarray(rng.randn(6, 256).astype(np.float32))
+    u = jnp.asarray(rng.randn(6, 256).astype(np.float32))
+    ref = np.asarray(jax.nn.silu(g) * u)
+    np.testing.assert_allclose(np.asarray(swiglu(g, u)), ref, atol=1e-5)
+    gr = jax.grad(lambda g, u: jnp.sum(jax.nn.silu(g) * u * 0.37),
+                  argnums=(0, 1))(g, u)
+    gk = jax.grad(lambda g, u: jnp.sum(swiglu(g, u) * 0.37),
+                  argnums=(0, 1))(g, u)
+    for a, b in zip(gr, gk):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+def test_fused_rope_parity(_interpret_mode):
+    from paddle_tpu.ops.pallas import fused_rope, rope_tables
+    rng = np.random.RandomState(4)
+    b, s, n, d = 2, 16, 4, 128
+    x = jnp.asarray(rng.randn(b, s, n, d).astype(np.float32))
+    cos, sin = rope_tables(s, d)
+
+    def ref_rope(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        c = cos[None, :, None, :]
+        s_ = sin[None, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_], -1)
+
+    np.testing.assert_allclose(np.asarray(fused_rope(x, cos, sin)),
+                               np.asarray(ref_rope(x)), atol=1e-5)
+    gr = jax.grad(lambda x: jnp.sum(ref_rope(x) * 0.2))(x)
+    gk = jax.grad(lambda x: jnp.sum(fused_rope(x, cos, sin) * 0.2))(x)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-5)
+
+
+def test_incubate_swiglu_kernel_route(_interpret_mode):
+    """incubate.nn.functional.swiglu uses the Pallas kernel when
+    FLAGS_pallas_swiglu is on; numerics match the composite."""
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.flags import set_flags
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(4, 64).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(4, 64).astype(np.float32))
+    base = IF.swiglu(x, y).numpy()
+    set_flags({"FLAGS_pallas_swiglu": True})
+    try:
+        kern = IF.swiglu(x, y).numpy()
+    finally:
+        set_flags({"FLAGS_pallas_swiglu": False})
+    np.testing.assert_allclose(kern, base, atol=1e-5)
+
+
+def test_incubate_fused_rope_kernel_route(_interpret_mode):
+    """fused_rotary_position_embedding routes to the kernel under
+    FLAGS_pallas_rope (neox style, default tables) with identical
+    numerics."""
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.flags import set_flags
+    rng = np.random.RandomState(6)
+    q = paddle.to_tensor(rng.randn(2, 16, 4, 128).astype(np.float32))
+    set_flags({"FLAGS_pallas_rope": False})
+    try:
+        base = IF.fused_rotary_position_embedding(q)[0].numpy()
+    finally:
+        set_flags({"FLAGS_pallas_rope": True})
+    kern = IF.fused_rotary_position_embedding(q)[0].numpy()
+    np.testing.assert_allclose(kern, base, atol=1e-5)

@@ -124,3 +124,34 @@ def test_rms_norm_compiled(dtype, tol):
             xm = x.at[idx].add(-eps)
             num = (f(xp) - f(xm)) / (2 * eps)
             assert abs(num - float(gx[idx])) < 1e-2
+
+
+def test_swiglu_compiled():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import swiglu
+    kk = jax.random.PRNGKey
+    g = jax.random.normal(kk(0), (64, 512), jnp.bfloat16)
+    u = jax.random.normal(kk(1), (64, 512), jnp.bfloat16)
+    out = jax.jit(swiglu)(g, u)
+    gf = np.asarray(g, np.float32)
+    uf = np.asarray(u, np.float32)
+    ref = gf / (1 + np.exp(-gf)) * uf
+    assert np.abs(np.asarray(out, np.float32) - ref).max() < 3e-2
+
+
+def test_fused_rope_compiled():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import fused_rope, rope_tables
+    kk = jax.random.PRNGKey
+    b, s, n, d = 2, 256, 4, 128
+    x = jax.random.normal(kk(0), (b, s, n, d), jnp.bfloat16)
+    cos, sin = rope_tables(s, d)
+    out = jax.jit(fused_rope)(x, cos, sin)
+    xf = np.asarray(x, np.float32)
+    x1, x2 = xf[..., :64], xf[..., 64:]
+    c = np.asarray(cos)[None, :, None, :]
+    s_ = np.asarray(sin)[None, :, None, :]
+    ref = np.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_], -1)
+    assert np.abs(np.asarray(out, np.float32) - ref).max() < 3e-2
