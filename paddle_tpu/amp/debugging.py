@@ -1,9 +1,24 @@
 """paddle.amp.debugging (reference: python/paddle/amp/debugging.py:156,
-:455, :628) — tensor checking + per-op dtype statistics."""
+:455, :628) — tensor checking + per-op dtype statistics.
+
+Depth parity with the reference checker:
+
+* :class:`TensorCheckerConfig` honors ``checked_op_list`` /
+  ``skipped_op_list`` (per-op filters on the dispatch NaN sweep),
+  ``debug_step`` (a [start, end) step window driven by
+  :meth:`update_and_check_step_id`) and ``output_dir`` (findings are
+  appended to ``<output_dir>/checker.log`` instead of printed).
+* :func:`check_layer_numerics` decorates a Layer ``forward`` and checks
+  every Tensor input/output (reference debugging.py:63).
+* :func:`compare_accuracy` is a real comparator over two dump
+  directories of .npy/.npz files (reference :569 compares two run logs).
+"""
 
 from __future__ import annotations
 
 import contextlib
+import functools
+import os
 from enum import Enum
 from typing import List, Optional
 
@@ -15,7 +30,8 @@ from ..tensor.tensor import Tensor
 
 __all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
            "disable_tensor_checker", "check_numerics",
-           "enable_operator_stats_collection",
+           "check_layer_numerics", "set_checked_op_list",
+           "set_skipped_op_list", "enable_operator_stats_collection",
            "disable_operator_stats_collection",
            "collect_operator_stats", "compare_accuracy"]
 
@@ -27,41 +43,161 @@ class DebugMode(Enum):
     CHECK_ALL = 3
 
 
+# per-op filters consulted by the dispatch sweep (reference :136, :146)
+_checked_ops: Optional[set] = None      # None = all ops
+_skipped_ops: set = set()
+
+
+def set_checked_op_list(checked_op_list) -> None:
+    """Restrict the NaN/Inf sweep to these op names (reference :136)."""
+    global _checked_ops
+    if checked_op_list is None:
+        _checked_ops = None
+    else:
+        if isinstance(checked_op_list, str):
+            checked_op_list = checked_op_list.split(",")
+        _checked_ops = {s.strip() for s in checked_op_list if s.strip()}
+
+
+def set_skipped_op_list(skipped_op_list) -> None:
+    """Exempt these op names from the sweep (reference :146)."""
+    global _skipped_ops
+    if skipped_op_list is None:
+        _skipped_ops = set()
+    else:
+        if isinstance(skipped_op_list, str):
+            skipped_op_list = skipped_op_list.split(",")
+        _skipped_ops = {s.strip() for s in skipped_op_list if s.strip()}
+
+
+def op_check_enabled(name: str) -> bool:
+    """Consulted by ops.dispatch for each swept op."""
+    if name in _skipped_ops:
+        return False
+    if _checked_ops is not None and name not in _checked_ops:
+        return False
+    return True
+
+
 class TensorCheckerConfig:
-    def __init__(self, enable=True, debug_mode=DebugMode.
-                 CHECK_NAN_INF_AND_ABORT, output_dir=None,
-                 checked_op_list=None, skipped_op_list=None,
-                 debug_step=None, stack_height_limit=1):
+    """Reference: debugging.py:156."""
+
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1):
         self.enable = enable
         self.debug_mode = debug_mode
         self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        # [start, end) step window; None = always
+        self.debug_step = tuple(debug_step) if debug_step else None
+        self.stack_height_limit = stack_height_limit
+        self._step_id = 0
+
+    def update_and_check_step_id(self) -> bool:
+        """Returns whether checking is active for the CURRENT (0-based)
+        step, then advances the counter — reference :317 compares
+        before incrementing, so ``debug_step=(0, 5)`` covers the first
+        five steps including step 0."""
+        step = self._step_id
+        self._step_id += 1
+        if not self.enable:
+            return False
+        if self.debug_step is None:
+            active = True
+        else:
+            lo, hi = self.debug_step
+            active = lo <= step < hi
+        if active:
+            self.start_check_nan_inf()
+        else:
+            self.stop_check_nan_inf()
+        return active
+
+    def start_check_nan_inf(self):
+        set_flags({"FLAGS_check_nan_inf": True,
+                   "FLAGS_check_nan_inf_level":
+                   0 if self.debug_mode ==
+                   DebugMode.CHECK_NAN_INF_AND_ABORT else 1})
+        set_checked_op_list(self.checked_op_list)
+        set_skipped_op_list(self.skipped_op_list)
+
+    def stop_check_nan_inf(self):
+        set_flags({"FLAGS_check_nan_inf": False})
 
 
 def enable_tensor_checker(config: TensorCheckerConfig) -> None:
-    set_flags({"FLAGS_check_nan_inf": config.enable,
-               "FLAGS_check_nan_inf_level":
-               0 if config.debug_mode ==
-               DebugMode.CHECK_NAN_INF_AND_ABORT else 1})
+    """Reference: :628 — installs the config and starts the sweep."""
+    global _active_config
+    _active_config = config
+    if config.enable:
+        config.start_check_nan_inf()
 
 
 def disable_tensor_checker() -> None:
+    global _active_config
+    _active_config = None
     set_flags({"FLAGS_check_nan_inf": False})
+    set_checked_op_list(None)
+    set_skipped_op_list(None)
+
+
+_active_config: Optional[TensorCheckerConfig] = None
+
+
+def _report(msg: str, abort: bool):
+    # output_dir redirects the LOG; ABORT mode still aborts (the mode
+    # name is a promise — matching the reference's behavior)
+    cfg = _active_config
+    if cfg is not None and cfg.output_dir:
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        with open(os.path.join(cfg.output_dir, "checker.log"), "a") as f:
+            f.write(msg + "\n")
+    elif not abort:
+        print(msg)
+    if abort:
+        raise FloatingPointError(msg)
 
 
 def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-    arr = tensor._data
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(
+        tensor)
     n_nan = int(jnp.sum(jnp.isnan(arr)))
     n_inf = int(jnp.sum(jnp.isinf(arr)))
     n_zero = int(jnp.sum(arr == 0))
     if n_nan or n_inf:
         msg = (f"[check_numerics] op={op_type} var={var_name}: "
                f"{n_nan} nan, {n_inf} inf")
-        if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT):
-            raise FloatingPointError(msg)
-        print(msg)
+        _report(msg, abort=debug_mode in
+                (None, DebugMode.CHECK_NAN_INF_AND_ABORT))
     from ..tensor.tensor import wrap_array
     return (wrap_array(jnp.asarray(n_nan)), wrap_array(jnp.asarray(n_inf)),
             wrap_array(jnp.asarray(n_zero)))
+
+
+def check_layer_numerics(func):
+    """Decorator for a Layer ``forward``: checks every Tensor argument
+    and every Tensor output for nan/inf (reference :63)."""
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        name = type(self).__name__
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, op_type=f"{name}.forward",
+                               var_name=f"input[{i}]")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                check_numerics(o, op_type=f"{name}.forward",
+                               var_name=f"output[{i}]")
+        return out
+
+    return wrapper
 
 
 _op_stats: Optional[dict] = None
@@ -110,6 +246,41 @@ def collect_operator_stats():
 
 def compare_accuracy(dump_path, another_dump_path, output_filename,
                      loss_scale=1, dump_all_tensors=False):
-    raise NotImplementedError(
-        "compare_accuracy requires dumped tensor files; use "
-        "check_numerics/collect_operator_stats for online checking")
+    """Compare two directories of dumped tensors (.npy / .npz, matched
+    by filename) and write a CSV report of per-tensor max abs/rel error
+    (reference :569 compares two run dumps, e.g. an fp32 run against an
+    amp run whose grads carry ``loss_scale``)."""
+    rows = []
+    names = sorted(set(os.listdir(dump_path)) &
+                   set(os.listdir(another_dump_path)))
+    for fname in names:
+        if not fname.endswith((".npy", ".npz")):
+            continue
+
+        def load(base):
+            p = os.path.join(base, fname)
+            if fname.endswith(".npy"):
+                return {"": np.load(p)}
+            return dict(np.load(p))
+
+        a_d, b_d = load(dump_path), load(another_dump_path)
+        for key in sorted(set(a_d) & set(b_d)):
+            a = np.asarray(a_d[key], np.float64)
+            b = np.asarray(b_d[key], np.float64) / float(loss_scale)
+            if a.shape != b.shape:
+                rows.append((fname, key, "shape-mismatch",
+                             str(a.shape), str(b.shape)))
+                continue
+            diff = np.abs(a - b)
+            denom = np.maximum(np.abs(a), 1e-12)
+            rows.append((fname, key,
+                         f"{diff.max():.6e}",
+                         f"{(diff / denom).max():.6e}",
+                         f"{int(np.isnan(b).sum())}"))
+    import csv
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)       # quotes fields containing commas
+        w.writerow(["file", "tensor", "max_abs_err", "max_rel_err",
+                    "nan_count"])
+        w.writerows(rows)
+    return output_filename

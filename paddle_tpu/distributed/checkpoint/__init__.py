@@ -204,6 +204,20 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             continue
         sharding = getattr(t._data, "sharding", None)
         tgt_dtype = t._data.dtype
+        if offload:
+            # reference offload semantics: the loaded value stays in
+            # host memory (committed to the CPU backend) until the
+            # caller moves it.  The cast happens on the NUMPY block —
+            # jnp.asarray first would materialise the full tensor on
+            # the default (TPU) device, the exact OOM offload avoids.
+            full = _assemble_block(
+                tuple(slice(0, g) for g in gshape), gshape, shard_metas,
+                payloads, dtype)
+            import ml_dtypes  # noqa: F401  (registers bf16 for numpy)
+            t._data = jax.device_put(
+                np.asarray(full).astype(tgt_dtype),
+                jax.devices("cpu")[0])
+            continue
         if sharding is None or not hasattr(t._data, "addressable_shards"):
             full = _assemble_block(
                 tuple(slice(0, g) for g in gshape), gshape, shard_metas,

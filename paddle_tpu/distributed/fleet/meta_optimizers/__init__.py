@@ -1,2 +1,5 @@
 from .hybrid_parallel_optimizer import (  # noqa: F401
     HybridParallelOptimizer, HybridParallelGradScaler, DistributedScaler)
+from .dgc_optimizer import DGCMomentumOptimizer  # noqa: F401
+from .localsgd_optimizer import (  # noqa: F401
+    AdaptiveLocalSGDOptimizer, LocalSGDOptimizer)

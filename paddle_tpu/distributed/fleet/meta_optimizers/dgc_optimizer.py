@@ -1,0 +1,79 @@
+"""DGC (deep gradient compression) momentum optimizer.
+
+Reference: fleet/meta_optimizers/dgc_optimizer.py:32
+(DGCMomentumOptimizer) — top-k gradient sparsification with momentum
+correction and error feedback (Lin et al., 2018).  The reference
+restricts DGC to static-graph CUDA; here the same math runs eagerly on
+any backend (the sparsification itself is a jnp.top_k + masking
+program).
+
+On a TPU pod the bandwidth DGC saves is ICI allreduce traffic; under
+XLA the gradients this optimizer sees are already reduced, so the
+numerics (what the reference calls local grad clipping + momentum
+correction + error accumulation) are the parity surface, and the
+sparsified update is applied exactly as the reference applies it after
+its allreduce of the sparse blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ["DGCMomentumOptimizer"]
+
+
+class DGCMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), parameter_list=None,
+                 parameters=None, use_nesterov=False, num_trainers=None,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate,
+                         parameters if parameters is not None
+                         else parameter_list,
+                         regularization, grad_clip, False, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = list(sparsity)
+
+    def _init_state(self, p):
+        z = jnp.zeros_like(p._data, jnp.float32)
+        return {"u": z, "v": z, "t": 0}
+
+    def _current_sparsity(self, t: int) -> float:
+        if t < self._rampup_begin:
+            return 0.0
+        k = min((t - self._rampup_begin) *
+                len(self._sparsity) // self._rampup_step,
+                len(self._sparsity) - 1)
+        return float(self._sparsity[k])
+
+    def _update(self, param, grad, state, lr):
+        g = grad.astype(jnp.float32)
+        t = state["t"]
+        s = self._current_sparsity(t)
+        if s <= 0.0 or param.size < 2:
+            # warmup: plain momentum SGD
+            u = self._momentum * state["u"] + g
+            step = (g + self._momentum * u) if self._nesterov else u
+            return ((param.astype(jnp.float32) - lr * step)
+                    .astype(param.dtype),
+                    {"u": u, "v": state["v"], "t": t + 1})
+        # momentum correction + error feedback (DGC eq. 4-5)
+        u = self._momentum * state["u"] + g
+        v = state["v"] + u
+        flat = v.reshape(-1)
+        k = max(1, int(flat.size * (1.0 - s)))
+        thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+        mask = (jnp.abs(v) >= thresh).astype(jnp.float32)
+        sparse_step = v * mask
+        # error feedback: masked-out residuals stay in u and v
+        new_v = v * (1.0 - mask)
+        new_u = u * (1.0 - mask)
+        new_p = param.astype(jnp.float32) - lr * sparse_step
+        return new_p.astype(param.dtype), \
+            {"u": new_u, "v": new_v, "t": t + 1}

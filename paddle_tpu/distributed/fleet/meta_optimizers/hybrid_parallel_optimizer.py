@@ -28,8 +28,19 @@ class HybridParallelOptimizer:
         self._strategy = strategy
         self._use_sharding = hcg.get_sharding_parallel_world_size() > 1
         if self._use_sharding:
-            from ..sharding.group_sharded import ShardingOptimizerStage1
-            self._inner_opt = ShardingOptimizerStage1(optimizer, hcg)
+            # honor the strategy's sharding stage + offload (round-2
+            # review: these knobs were accepted and ignored)
+            sc = dict(getattr(strategy, "sharding_configs", {}) or {})
+            stage = int(sc.get("stage", 1))
+            offload = bool(sc.get("offload", False))
+            from ..meta_parallel.sharding.group_sharded import (
+                GroupShardedOptimizerStage2, ShardingOptimizerStage1)
+            if stage >= 2:
+                self._inner_opt = GroupShardedOptimizerStage2(
+                    [], optimizer, offload=offload)
+            else:
+                self._inner_opt = ShardingOptimizerStage1(
+                    optimizer, hcg, offload=offload)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)

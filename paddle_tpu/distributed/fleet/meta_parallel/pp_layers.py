@@ -53,7 +53,10 @@ class SegmentLayers:
                  num_virtual_pipeline_stage=None):
         self._layers_desc = layers_desc
         self.method = method
-        self.num_parts = num_parts
+        # reference semantics: virtual stages multiply the segment count
+        # (pp_layers.py:92); PipelineLayer pre-multiplies and does not
+        # pass the kwarg, so direct SegmentLayers users get it honored
+        self.num_parts = num_parts * (num_virtual_pipeline_stage or 1)
         self.num_items = len(layers_desc)
         assert self.num_items >= self.num_parts, (
             "layer number should be greater than number of segments")

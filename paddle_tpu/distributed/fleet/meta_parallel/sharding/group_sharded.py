@@ -151,9 +151,10 @@ class _ShardedStateOptimizer:
 class ShardingOptimizerStage1(_ShardedStateOptimizer):
     """Reference: dygraph_sharding_optimizer.py:44 (stage 1)."""
 
-    def __init__(self, optimizer, hcg=None):
+    def __init__(self, optimizer, hcg=None, offload: bool = False):
         axis = _sharding_axis() or "dp"
-        super().__init__(optimizer, axis, shard_grads=False)
+        super().__init__(optimizer, axis, shard_grads=False,
+                         offload=offload)
 
 
 class GroupShardedOptimizerStage2(_ShardedStateOptimizer):

@@ -235,7 +235,11 @@ class Model:
                     ins, labels = self._split_data(data)
                     accum += 1
                     update = accum % accumulate_grad_batches == 0
-                    out = self.train_batch(ins, labels, update=update)
+                    from ..utils.logging import step_statistics
+                    with step_statistics.timer("train_batch"):
+                        out = self.train_batch(ins, labels,
+                                               update=update)
+                    step_statistics.bump("train_batches")
                     logs = self._make_logs(out)
                     # actual per-batch sample count (last batch may be short;
                     # a user-supplied DataLoader ignores the batch_size arg)

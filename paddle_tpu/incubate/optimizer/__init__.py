@@ -1,0 +1,66 @@
+"""Incubate optimizers.
+
+Reference: /root/reference/python/paddle/incubate/optimizer/ —
+LarsMomentumOptimizer (lars_momentum.py:22) and friends.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LarsMomentumOptimizer"]
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """LARS (layer-wise adaptive rate scaling) momentum.
+
+    Reference: incubate/optimizer/lars_momentum.py:22 — the update is
+
+        local_lr = lr * lars_coeff * ||p|| /
+                   (||g|| + lars_weight_decay * ||p|| + eps)
+        v        = momentum * v + local_lr * (g + lars_weight_decay * p)
+        p        = p - v
+
+    One fused XLA program per parameter (norms + update); large-batch
+    SGD training (the LARS paper's regime) is where it matters.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameter_list=None, parameters=None,
+                 regularization=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate,
+                         parameters if parameters is not None
+                         else parameter_list,
+                         regularization, grad_clip, multi_precision,
+                         name)
+        self._momentum = momentum
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._rescale = float(rescale_grad)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data, jnp.float32)}
+
+    def _update(self, param, grad, state, lr):
+        g = grad.astype(jnp.float32) * self._rescale
+        p32 = param.astype(jnp.float32)
+        wd = self._lars_wd
+        name = getattr(self._current_param, "name", "") or ""
+        if any(tag in name for tag in self._exclude):
+            wd = 0.0
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm /
+            (g_norm + wd * p_norm + self._eps),
+            jnp.asarray(lr, jnp.float32))
+        v = self._momentum * state["velocity"] + local_lr * (g + wd * p32)
+        return (p32 - v).astype(param.dtype), {"velocity": v}

@@ -115,10 +115,20 @@ class Config:
     def use_gpu(self):
         return self._use_device == "gpu-compat"
 
+    def _log_noop(self, knob: str):
+        # reference knobs that tune the IR/memory passes of the Paddle
+        # inference runtime; on this backend XLA owns both — say so
+        # instead of silently accepting (round-2 review item)
+        from ..utils.logging import vlog
+        vlog(1, f"inference.Config.{knob}: no-op on the TPU backend "
+                f"(XLA's fusion/buffer passes own this)")
+
     def enable_memory_optim(self, x=True):
+        self._log_noop("enable_memory_optim")
         self._memory_optim = x
 
     def switch_ir_optim(self, x=True):
+        self._log_noop("switch_ir_optim")
         self._ir_optim = x
 
     def set_cpu_math_library_num_threads(self, n):

@@ -127,8 +127,42 @@ class MultiprocessBatchIterator:
         self._collate = collate_fn or np_collate
         self._timeout = timeout or None
         self._to_device = to_device or (lambda x: x)
-        ctx = mp.get_context(
-            mp_context or os.environ.get("PADDLE_TPU_MP_CONTEXT", "fork"))
+        # default start method is SPAWN: fork() of a process whose jax
+        # runtime already started worker threads can deadlock in the
+        # child (the suite's "os.fork() incompatible with JAX threads"
+        # warnings).  Workers run pure-Python dataset code, so the only
+        # spawn cost is startup latency; fork remains available via
+        # mp_context="fork" / PADDLE_TPU_MP_CONTEXT for fork-safe hosts.
+        env_method = os.environ.get("PADDLE_TPU_MP_CONTEXT")
+        method = mp_context or env_method or "spawn"
+        explicit = mp_context is not None or env_method is not None
+        if method == "spawn" and not explicit:
+            # spawn needs picklable worker payloads; closure-defined
+            # datasets get the (riskier) fork path with a notice rather
+            # than a crash deep inside Process.start.  An EXPLICIT
+            # spawn request is honored as-is (and will raise there).
+            # The probe discards bytes as they are produced — no full
+            # serialized copy of a large in-memory dataset.
+            import pickle
+
+            class _Null:
+                def write(self, _):
+                    return None
+
+            try:
+                pickle.Pickler(_Null(), protocol=pickle.HIGHEST_PROTOCOL
+                               ).dump((dataset, self._collate,
+                                       worker_init_fn))
+            except Exception:
+                import warnings
+                warnings.warn(
+                    "DataLoader: dataset/collate_fn/worker_init_fn is "
+                    "not picklable, so worker processes fall back to "
+                    "fork() (unsafe if the jax runtime already started "
+                    "threads).  Define them at module level to use the "
+                    "spawn default.", RuntimeWarning, stacklevel=3)
+                method = "fork"
+        ctx = mp.get_context(method)
         self._num_workers = max(1, num_workers)
         self._data_queue = ctx.Queue()
         self._index_queues = []

@@ -125,6 +125,10 @@ def unwrap(x: Any):
 
 
 def _check_nan_inf(name: str, arrays) -> None:
+    # per-op checked/skipped filters (amp.debugging.set_checked_op_list)
+    from ..amp import debugging as _dbg
+    if not _dbg.op_check_enabled(name):
+        return
     for a in arrays:
         if jnp.issubdtype(a.dtype, jnp.floating):
             if not bool(jnp.isfinite(a).all()):

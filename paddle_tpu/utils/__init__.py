@@ -2,8 +2,14 @@
 
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from . import logging  # noqa: F401
+from .logging import get_logger, step_statistics, vlog  # noqa: F401
 
-__all__ = ["dlpack", "cpp_extension", "try_import", "run_check", "deprecated", "require_version"]
+# NOTE: the `logging` submodule is importable but deliberately NOT in
+# __all__ — star-imports must not shadow the stdlib logging module
+__all__ = ["dlpack", "cpp_extension", "get_logger", "vlog",
+           "step_statistics", "try_import", "run_check", "deprecated",
+           "require_version"]
 
 
 def try_import(module_name, err_msg=None):

@@ -1,0 +1,140 @@
+"""Leveled logging + executor/step statistics.
+
+Reference: ``python/paddle/base/log_helper.py`` (get_logger) and the
+VLOG conventions of the C++ core (GLOG_v levels), plus the executor
+statistics dump (``paddle/fluid/framework/new_executor/
+executor_statistics.cc`` — per-run timing summaries behind a flag).
+
+TPU-native realisation: one stdlib logger per subsystem with a shared
+formatter; ``vlog(level, msg)`` gated on ``FLAGS_log_level`` (the
+GLOG_v analog, also settable via env PADDLE_TPU_LOG_LEVEL); and a
+process-global :class:`StepStatistics` that any runtime component can
+feed (hapi fit, the flagship train loop, DataLoader workers) and dump
+as the executor-statistics analog.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["get_logger", "vlog", "log_level", "StepStatistics",
+           "step_statistics"]
+
+_FORMAT = ("%(asctime)s [%(levelname)s] %(name)s: %(message)s")
+_loggers: Dict[str, logging.Logger] = {}
+_lock = threading.Lock()
+
+
+def get_logger(name: str = "paddle_tpu", level: Optional[int] = None,
+               fmt: str = _FORMAT) -> logging.Logger:
+    """Reference: log_helper.get_logger — a configured, non-propagating
+    logger with one stream handler."""
+    with _lock:
+        lg = _loggers.get(name)
+        if lg is None:
+            lg = logging.getLogger(name)
+            lg.propagate = False
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter(fmt))
+            lg.addHandler(handler)
+            lg.setLevel(logging.INFO if level is None else level)
+            _loggers[name] = lg
+        elif level is not None:
+            lg.setLevel(level)
+        return lg
+
+
+def log_level() -> int:
+    """Effective VLOG verbosity: FLAGS_log_level, overridable by the
+    PADDLE_TPU_LOG_LEVEL env var (the GLOG_v analog)."""
+    env = os.environ.get("PADDLE_TPU_LOG_LEVEL")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    from ..flags import flags
+    return int(flags.FLAGS_log_level)
+
+
+def vlog(level: int, msg: str, name: str = "paddle_tpu") -> None:
+    """VLOG(level): emitted when ``log_level() >= level``."""
+    if log_level() >= level:
+        get_logger(name).info("[v%d] %s", level, msg)
+
+
+class StepStatistics:
+    """Executor-statistics analog: accumulate named phase timings and
+    counters across steps, dump a summary (executor_statistics.cc's
+    role, minus the IR-specific event classes)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phases: Dict[str, list] = {}
+        self._counters: Dict[str, float] = {}
+
+    def record(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self._phases.setdefault(phase, []).append(float(seconds))
+
+    def bump(self, counter: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self._counters[counter] = self._counters.get(counter, 0.0) \
+                + amount
+
+    class _Timer:
+        def __init__(self, stats, phase):
+            self._stats = stats
+            self._phase = phase
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._stats.record(self._phase,
+                               time.perf_counter() - self._t0)
+            return False
+
+    def timer(self, phase: str) -> "_Timer":
+        return self._Timer(self, phase)
+
+    def summary(self) -> dict:
+        with self._lock:
+            out = {"phases": {}, "counters": dict(self._counters)}
+            for k, v in self._phases.items():
+                if not v:
+                    continue
+                out["phases"][k] = {
+                    "count": len(v),
+                    "total_s": round(sum(v), 6),
+                    "mean_ms": round(sum(v) / len(v) * 1e3, 3),
+                    "max_ms": round(max(v) * 1e3, 3),
+                }
+            return out
+
+    def dump(self, path: Optional[str] = None) -> str:
+        """Write the summary as JSON (to ``path`` or stderr via the
+        logger); returns the JSON string."""
+        text = json.dumps(self.summary(), indent=2, sort_keys=True)
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        else:
+            get_logger("paddle_tpu.stats").info("step statistics:\n%s",
+                                                text)
+        return text
+
+    def reset(self) -> None:
+        with self._lock:
+            self._phases.clear()
+            self._counters.clear()
+
+
+step_statistics = StepStatistics()

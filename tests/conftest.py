@@ -17,6 +17,11 @@ import jax  # noqa: E402
 # PADDLE_TPU_TESTS_ON_TPU=1 runs the suite on the real chip so the
 # Pallas compiled-path lane (tests/test_pallas_tpu.py) actually
 # exercises Mosaic; default is the fast 8-device virtual CPU mesh.
+#
+# POLICY (round-1 failure mode): any change to ops/pallas/* MUST run
+#   PADDLE_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_pallas_tpu.py
+# on the real chip before committing — the default suite's interpret
+# lane cannot catch Mosaic lowering regressions.
 if os.environ.get("PADDLE_TPU_TESTS_ON_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
 
