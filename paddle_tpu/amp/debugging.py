@@ -130,11 +130,14 @@ class TensorCheckerConfig:
 
 
 def enable_tensor_checker(config: TensorCheckerConfig) -> None:
-    """Reference: :628 — installs the config and starts the sweep."""
+    """Reference: :628 — installs the config and starts (or, with
+    ``enable=False``, stops) the sweep."""
     global _active_config
     _active_config = config
     if config.enable:
         config.start_check_nan_inf()
+    else:
+        config.stop_check_nan_inf()
 
 
 def disable_tensor_checker() -> None:

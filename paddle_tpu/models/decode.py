@@ -1,0 +1,202 @@
+"""Compiled autoregressive decoding for the flagship model.
+
+Reference role: the fused decode path the reference serves LLMs with —
+incubate block_multihead_attention + fused decode kernels
+(/root/reference/python/paddle/incubate/nn/functional/
+block_multihead_attention.py) and PaddleNLP's generation loops.
+
+TPU-native design (the shape-stability rules XLA demands):
+
+* ONE jitted program for the whole generation: prefill + a
+  ``lax.scan`` over decode steps.  No per-step retracing, no dynamic
+  shapes — the reference's per-step CUDA-graph/paged-cache machinery
+  becomes "keep every shape static and let XLA pipeline".
+* The KV cache is pre-allocated ``[L, B, max_len, n_kv, d]``
+  (kept at num_key_value_heads — GQA's memory saving — with the
+  head-group broadcast done inside attention) and written
+  in place with ``lax.dynamic_update_slice`` (donated across steps by
+  the scan carry); attention masks positions ``> pos`` instead of
+  shrinking/growing tensors.
+* RoPE at decode applies the rotation for the SINGLE traced position
+  (same tables math as ops/pallas/rope.rope_tables).
+
+Weights are the ``llama_pretrain`` parameter pytree (stacked [L, ...]
+blocks), so a trained checkpoint decodes without conversion.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn,
+                             _rms_norm)
+
+__all__ = ["make_generate"]
+
+
+def _rope_single(x, theta, pos):
+    """Rotate-half RoPE for one traced position; x [b, 1, n, d]."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos.astype(jnp.float32) * inv              # [d/2]
+    cos = jnp.cos(freqs)[None, None, None, :]
+    sin = jnp.sin(freqs)[None, None, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    return jnp.concatenate([x1f * cos - x2f * sin,
+                            x2f * cos + x1f * sin], -1).astype(x.dtype)
+
+
+def _pre_attn_at(bp, x, cfg: LlamaPretrainConfig, pos):
+    """_block_pre_attn for a single decode position ``pos`` (traced):
+    same ln1/QKV math, RoPE applied at the absolute position.  K/V stay
+    at ``num_key_value_heads`` — the GQA repeat happens as a broadcast
+    inside attention, never in the cache (the cache is THE HBM-binding
+    serving resource; inflating it n/nkv-fold defeats GQA)."""
+    b, s, h = x.shape
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+    y = _rms_norm(x, bp["ln1"], cfg.rms_norm_eps)
+    q = (y @ bp["wq"].astype(dt)).reshape(b, 1, n, d)
+    k = (y @ bp["wk"].astype(dt)).reshape(b, 1, nkv, d)
+    v = (y @ bp["wv"].astype(dt)).reshape(b, 1, nkv, d)
+    q = _rope_single(q, cfg.rope_theta, pos)
+    k = _rope_single(k, cfg.rope_theta, pos)
+    return q, k, v
+
+
+def _prefill_kv(bp, y_normed, cfg: LlamaPretrainConfig, b, s):
+    """Prompt-phase K/V at ``num_key_value_heads`` (pre-GQA-repeat),
+    RoPE over positions 0..s-1 — mirrors _block_pre_attn's table."""
+    nkv, d = cfg.num_key_value_heads, cfg.head_dim
+    dt = cfg.dtype
+    k = (y_normed @ bp["wk"].astype(dt)).reshape(b, s, nkv, d)
+    v = (y_normed @ bp["wv"].astype(dt)).reshape(b, s, nkv, d)
+    return k, v
+
+
+def _grouped_attn(q, ck, cv, mask):
+    """q [b,sq,n,d] against a [b,S,nkv,d] cache (GQA broadcast inside
+    the einsum); ``mask`` must broadcast to [b,nkv,g,sq,S]."""
+    b, sq, n, d = q.shape
+    nkv = ck.shape[2]
+    g = n // nkv
+    scale = 1.0 / math.sqrt(d)
+    q5 = q.reshape(b, sq, nkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q5, ck) * scale
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(cv.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv)
+    return out.reshape(b, sq, n, d)
+
+
+def _cached_attn(q, ck, cv, pos):
+    """q [b,1,n,d] against the cache [b,S,nkv,d]; attends to <= pos."""
+    S = ck.shape[1]
+    mask = (jnp.arange(S) <= pos)[None, None, None, None, :]
+    return _grouped_attn(q, ck, cv, mask)
+
+
+def make_generate(cfg: LlamaPretrainConfig, prompt_len: int,
+                  max_new_tokens: int, max_len: Optional[int] = None,
+                  temperature: float = 0.0):
+    """Build a jitted ``generate(params, prompt[B, prompt_len], key)
+    -> tokens [B, max_new_tokens]``.
+
+    ``temperature == 0`` is greedy; otherwise categorical sampling with
+    the supplied PRNG key.  All shapes static: one compile serves any
+    batch of ``prompt_len`` prompts for up to ``max_new_tokens``.
+    """
+    S_max = max_len or (prompt_len + max_new_tokens)
+    if S_max < prompt_len + max_new_tokens:
+        raise ValueError("max_len too small for prompt + new tokens")
+
+    def head_logits(params, x_last):
+        h = _rms_norm(x_last, params["final_norm"], cfg.rms_norm_eps)
+        return (h @ params["lm_head"].astype(cfg.dtype)).astype(
+            jnp.float32)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    def generate(params, prompt, key):
+        B = prompt.shape[0]
+        n, d = cfg.num_attention_heads, cfg.head_dim
+        dt = cfg.dtype
+
+        # ---- prefill: full causal forward, collecting per-layer K/V
+        # at num_key_value_heads (pre-GQA-repeat: the cache must keep
+        # the GQA memory saving) -----------------------------------
+        from .llama_pretrain import _rope
+        x = jnp.take(params["embed"], prompt, axis=0).astype(dt)
+        nkv = cfg.num_key_value_heads
+        causal = jnp.tril(jnp.ones((prompt_len, prompt_len), bool))
+
+        def prefill_layer(carry, bp):
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = (y @ bp["wq"].astype(dt)).reshape(
+                B, prompt_len, n, d)
+            k, v = _prefill_kv(bp, y, cfg, B, prompt_len)
+            q, k = _rope(q, k, cfg.rope_theta)
+            attn = _grouped_attn(q, k, v,
+                                 causal[None, None, None, :, :])
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(prefill_layer, x, params["blocks"])
+        L = ks.shape[0]
+        cache_k = jnp.zeros((L, B, S_max, nkv, d), dt)
+        cache_v = jnp.zeros((L, B, S_max, nkv, d), dt)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, ks.astype(dt), (0, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, vs.astype(dt), (0, 0, 0, 0, 0))
+
+        logits0 = head_logits(params, x[:, -1])
+        key, sub = jax.random.split(key)
+        tok0 = pick(logits0, sub)
+
+        # ---- decode: one scan step per new token ---------------------
+        def dec_step(carry, _):
+            cache_k, cache_v, tok, pos, key = carry
+            xt = jnp.take(params["embed"], tok[:, None],
+                          axis=0).astype(dt)
+
+            def layer(carry2, inputs):
+                xc = carry2
+                bp, ck, cv = inputs
+                q, k, v = _pre_attn_at(bp, xc, cfg, pos)
+                zero = jnp.asarray(0, pos.dtype)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (zero, pos, zero, zero))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (zero, pos, zero, zero))
+                attn = _cached_attn(q, ck, cv, pos)
+                out = _block_post_attn(bp, xc, attn, cfg)
+                return out, (ck, cv)
+
+            xt, (cache_k, cache_v) = jax.lax.scan(
+                layer, xt, (params["blocks"], cache_k, cache_v))
+            logits = head_logits(params, xt[:, 0])
+            key, sub = jax.random.split(key)
+            nxt = pick(logits, sub)
+            return (cache_k, cache_v, nxt, pos + 1, key), nxt
+
+        carry0 = (cache_k, cache_v, tok0,
+                  jnp.asarray(prompt_len, jnp.int32), key)
+        (_, _, _, _, _), toks = jax.lax.scan(
+            dec_step, carry0, None, length=max_new_tokens - 1)
+        # toks: [max_new-1, B]; prepend tok0
+        all_new = jnp.concatenate([tok0[None], toks], axis=0)
+        return jnp.transpose(all_new)           # [B, max_new]
+
+    return jax.jit(generate)

@@ -48,6 +48,15 @@ _op_table: Dict[str, Callable] = {}
 # -- cross-cutting hooks (AMP autocast, op statistics) -----------------------
 _amp_hook: Optional[Callable] = None
 _stats_hook: Optional[Callable] = None
+_capture_hook: Optional[Callable] = None
+
+
+def set_capture_hook(hook: Optional[Callable]) -> None:
+    """Install a static-graph capture hook: called as
+    ``hook(name, jfn, inputs, out_tensors)`` after every dispatched op
+    (paddle_tpu.static.program_guard records the op graph this way)."""
+    global _capture_hook
+    _capture_hook = hook
 
 
 def set_amp_hook(hook: Optional[Callable]) -> None:
@@ -178,6 +187,8 @@ def apply(name: str, jfn: Callable, *inputs: Tensor,
     if need_grad:
         tape.record(name, vjp_fn, inputs, out_tensors, fwd_fn=jfn,
                     out_is_tuple=not single)
+    if _capture_hook is not None and not tape.in_functional_trace():
+        _capture_hook(name, jfn, inputs, out_tensors)
     if flags.FLAGS_benchmark and not tape.in_functional_trace():
         for o in outs_t:
             if hasattr(o, "block_until_ready"):

@@ -33,12 +33,70 @@ class Variable(Tensor):
 
 
 class Program:
-    """A deferred computation: list of (fn, input_names, output_names)."""
+    """A captured computation graph.
+
+    Reference: Program/ProgramDesc (static/program.py; executor runs it
+    per feed).  TPU-native capture: while this program is active under
+    ``program_guard``, every dispatched op whose inputs derive from a
+    ``data()`` placeholder is recorded as ``(jfn, input slots, output
+    slots)``.  ``Executor.run`` replays the slots graph as ONE jitted
+    XLA program with the feed substituted for the placeholders — the
+    same build-once / run-many-feeds contract as the reference (and
+    parameters are read live at each run, so optimizer updates between
+    runs are visible, like scope variables)."""
 
     def __init__(self):
-        self.ops: List = []
+        self.ops: List = []                  # (jfn, in_slots, out_slots)
         self._feed_targets: Dict[str, Any] = {}
+        self._feed_slots: Dict[str, int] = {}     # name -> slot id
+        self._slot_of: Dict[int, int] = {}        # id(Tensor) -> slot
+        self._slot_const: Dict[int, Any] = {}     # slot -> live Tensor
+        self._keepalive: List = []   # pin captured tensors: id() reuse
+        self._next_slot = 0
+        self._version = 0
         self.random_seed = 0
+
+    # -- capture ---------------------------------------------------------
+    def _slot_for(self, t) -> int:
+        key = id(t)
+        slot = self._slot_of.get(key)
+        if slot is None:
+            slot = self._next_slot
+            self._next_slot += 1
+            self._slot_of[key] = slot
+            # an input not produced by a recorded op: a live constant
+            # (parameter/buffer) re-read at each Executor.run
+            self._slot_const[slot] = t
+        return slot
+
+    def _tracked(self, t) -> bool:
+        return id(t) in self._slot_of
+
+    def _record(self, name, jfn, inputs, outputs) -> None:
+        if not any(self._tracked(i) for i in inputs):
+            return
+        in_slots = [self._slot_for(i) for i in inputs]
+        out_slots = []
+        for o in outputs:
+            slot = self._next_slot
+            self._next_slot += 1
+            self._slot_of[id(o)] = slot
+            out_slots.append(slot)
+        self._keepalive.extend(inputs)
+        self._keepalive.extend(outputs)
+        self.ops.append((jfn, in_slots, out_slots))
+        self._version += 1
+
+    def _register_feed(self, name: str, placeholder) -> None:
+        slot = self._next_slot
+        self._next_slot += 1
+        self._slot_of[id(placeholder)] = slot
+        self._feed_slots[name] = slot
+        # keep the placeholder alive so ids stay unique
+        self._slot_const[slot] = placeholder
+        # a new feed changes the replay signature (feed names are
+        # zipped positionally) — invalidate compiled replays
+        self._version += 1
 
     def global_block(self):
         return self
@@ -65,14 +123,26 @@ def default_startup_program() -> Program:
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
+    """Route op capture into ``main_program`` (reference
+    program_guard): ops touching ``data()`` placeholders are recorded
+    for Executor replay; everything still executes eagerly too, so
+    mixed eager/static code behaves."""
     global _main_program, _startup_program
+    from ..ops import dispatch as _dispatch
     prev = (_main_program, _startup_program)
     _main_program = main_program
     if startup_program is not None:
         _startup_program = startup_program
+
+    def hook(name, jfn, inputs, outputs):
+        main_program._record(name, jfn, inputs, outputs)
+
+    prev_hook = _dispatch._capture_hook
+    _dispatch.set_capture_hook(hook)
     try:
         yield
     finally:
+        _dispatch.set_capture_hook(prev_hook)
         _main_program, _startup_program = prev
 
 
@@ -111,7 +181,10 @@ def device_guard(device=None):
 
 
 def data(name: str, shape, dtype="float32", lod_level=0):
-    """Declare a feed placeholder in the current program."""
+    """Declare a feed placeholder in the current program.  The returned
+    Tensor carries zeros of the (None -> 1) example shape for eager
+    probing; under ``program_guard`` it is registered as a feed slot so
+    ``Executor.run(feed={name: ...})`` substitutes real values."""
     prog = default_main_program()
     spec = InputSpec([s if s is not None else -1 for s in shape], dtype,
                      name)
@@ -119,16 +192,70 @@ def data(name: str, shape, dtype="float32", lod_level=0):
     t = to_tensor(np.zeros([1 if (s is None or s < 0) else s
                             for s in shape], dtype=str(dtype)))
     t.name = name
+    prog._register_feed(name, t)
     return t
 
 
 class Executor:
-    """Reference: base/executor.py:1182.  In this framework programs are
-    python callables over jax — Run = call the jitted entry with feeds."""
+    """Reference: base/executor.py:1182 — runs a captured Program with
+    a feed dict and fetch list.
+
+    The recorded slots graph is replayed as ONE jitted XLA program per
+    (program version, fetch set): placeholder slots take the feed,
+    constant slots (parameters) are passed live each run so in-place
+    optimizer updates between runs are observed — the reference's
+    scope-variable semantics."""
 
     def __init__(self, place: Optional[Place] = None):
         self.place = place or CPUPlace()
         self._compiled = {}
+
+    def _replay(self, program: Program, feed: Dict[str, Any],
+                fetch_list) -> List[Any]:
+        import jax
+
+        fetch_slots = []
+        for target in fetch_list:
+            slot = program._slot_of.get(id(target))
+            if slot is None:
+                raise KeyError(
+                    f"fetch target {getattr(target, 'name', target)!r} "
+                    f"was not captured by this program — build it "
+                    f"under program_guard from static.data inputs")
+            fetch_slots.append(slot)
+
+        const_slots = sorted(
+            s for s in program._slot_const
+            if s not in program._feed_slots.values())
+        feed_names = sorted(program._feed_slots)
+        key = (id(program), program._version, tuple(fetch_slots))
+        fn = self._compiled.get(key)
+        if fn is None:
+            ops = list(program.ops)
+            feed_slot_ids = [program._feed_slots[n] for n in feed_names]
+
+            def replay(feed_vals, const_vals):
+                env = dict(zip(feed_slot_ids, feed_vals))
+                env.update(zip(const_slots, const_vals))
+                for jfn, in_slots, out_slots in ops:
+                    args = [env[s] for s in in_slots]
+                    outs = jfn(*args)
+                    if not isinstance(outs, (tuple, list)):
+                        outs = (outs,)
+                    for s, o in zip(out_slots, outs):
+                        env[s] = o
+                return [env[s] for s in fetch_slots]
+
+            fn = jax.jit(replay)
+            self._compiled[key] = fn
+
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feed entries: {missing}")
+        feed_vals = [jnp_asarray(feed[n], program._feed_targets[n])
+                     for n in feed_names]
+        const_vals = [program._slot_const[s]._data for s in const_slots]
+        return fn(feed_vals, const_vals)
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
@@ -141,16 +268,30 @@ class Executor:
             ordered = [np.asarray(feed[n]) for n in names] if feed else []
             outs = program.run(ordered)
             return outs if return_numpy else [to_tensor(o) for o in outs]
+        prog = program if isinstance(program, Program) else \
+            default_main_program()
+        tensor_fetches = [t for t in fetch_list
+                          if isinstance(t, Tensor) and
+                          prog._slot_of.get(id(t)) is not None]
+        replayed: Dict[int, Any] = {}
+        if tensor_fetches and prog.ops:
+            outs = self._replay(prog, feed, tensor_fetches)
+            replayed = {id(t): o for t, o in zip(tensor_fetches, outs)}
         results = []
         for target in fetch_list:
-            if callable(target):
+            if id(target) in replayed:
+                out = replayed[id(target)]
+                results.append(np.asarray(out) if return_numpy
+                               else to_tensor(out))
+                continue
+            if callable(target) and not isinstance(target, Tensor):
                 out = target(**{k: to_tensor(v) for k, v in feed.items()})
             elif isinstance(target, Tensor):
-                out = target
+                out = target     # eager value (not captured)
             else:
                 raise TypeError(
-                    f"cannot fetch {target!r}: the TPU static shim "
-                    "fetches Tensors or callables")
+                    f"cannot fetch {target!r}: fetch Tensors built "
+                    "under program_guard, or callables")
             if return_numpy and isinstance(out, Tensor):
                 out = out.numpy()
             results.append(out)
@@ -158,6 +299,15 @@ class Executor:
 
     def close(self):
         pass
+
+
+def jnp_asarray(value, spec):
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.asarray(value))
+    want = str(getattr(spec, "dtype", "") or "")
+    if want and str(arr.dtype) != want:
+        arr = arr.astype(want)
+    return arr
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,

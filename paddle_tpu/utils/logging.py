@@ -76,12 +76,18 @@ class StepStatistics:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._phases: Dict[str, list] = {}
+        # O(1) running aggregates per phase — fed every train batch, so
+        # an unbounded sample list would grow for the process lifetime
+        self._phases: Dict[str, list] = {}   # [count, total, max]
         self._counters: Dict[str, float] = {}
 
     def record(self, phase: str, seconds: float) -> None:
+        s = float(seconds)
         with self._lock:
-            self._phases.setdefault(phase, []).append(float(seconds))
+            agg = self._phases.setdefault(phase, [0, 0.0, 0.0])
+            agg[0] += 1
+            agg[1] += s
+            agg[2] = max(agg[2], s)
 
     def bump(self, counter: str, amount: float = 1.0) -> None:
         with self._lock:
@@ -108,14 +114,14 @@ class StepStatistics:
     def summary(self) -> dict:
         with self._lock:
             out = {"phases": {}, "counters": dict(self._counters)}
-            for k, v in self._phases.items():
-                if not v:
+            for k, (count, total, mx) in self._phases.items():
+                if not count:
                     continue
                 out["phases"][k] = {
-                    "count": len(v),
-                    "total_s": round(sum(v), 6),
-                    "mean_ms": round(sum(v) / len(v) * 1e3, 3),
-                    "max_ms": round(max(v) * 1e3, 3),
+                    "count": count,
+                    "total_s": round(total, 6),
+                    "mean_ms": round(total / count * 1e3, 3),
+                    "max_ms": round(mx * 1e3, 3),
                 }
             return out
 

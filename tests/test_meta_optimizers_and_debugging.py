@@ -60,10 +60,12 @@ def test_dgc_momentum_trains_and_sparsifies():
                                parameters=net.parameters())
     losses = _run(net, opt, x, y, steps=20)
     assert losses[-1] < losses[0] * 0.8, losses
-    # after rampup the error-feedback buffers must be non-trivial
-    st = next(iter(opt._states.values()))
-    assert float(np.abs(np.asarray(st["v"])).sum()) >= 0.0
-    assert st["t"] >= 20
+    # after rampup the error-feedback residual must actually hold the
+    # masked-out gradient mass (all-zeros would mean sparsification
+    # never ran)
+    big = max(opt._states.values(), key=lambda s: np.asarray(s["v"]).size)
+    assert float(np.abs(np.asarray(big["v"])).sum()) > 0.0
+    assert big["t"] >= 20
 
 
 def test_localsgd_single_process_is_inner():

@@ -1,0 +1,77 @@
+"""Captured Program + Executor replay (round-3: weak item 7 — the
+round-2 Program/Executor were eager veneers where re-running with new
+feeds 'worked by accident of closure capture').
+
+Reference contract: static/program.py + base/executor.py:1182 — build a
+program once under program_guard, run it many times with different
+feeds; parameters behave like scope variables (updates between runs are
+seen).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+def _build():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        lin = nn.Linear(4, 3)
+        y = paddle.tanh(lin(x)) * 2.0
+    return main, lin, y
+
+
+def test_run_twice_with_different_feeds():
+    main, lin, y = _build()
+    exe = static.Executor()
+    f1 = np.ones((2, 4), np.float32)
+    f2 = np.full((5, 4), 0.5, np.float32)     # new batch AND values
+    out1, = exe.run(main, feed={"x": f1}, fetch_list=[y])
+    out2, = exe.run(main, feed={"x": f2}, fetch_list=[y])
+    W, b = lin.weight.numpy(), lin.bias.numpy()
+    np.testing.assert_allclose(out1, np.tanh(f1 @ W + b) * 2, atol=1e-5)
+    np.testing.assert_allclose(out2, np.tanh(f2 @ W + b) * 2, atol=1e-5)
+
+
+def test_parameter_updates_visible_between_runs():
+    main, lin, y = _build()
+    exe = static.Executor()
+    f = np.ones((2, 4), np.float32)
+    out_a, = exe.run(main, feed={"x": f}, fetch_list=[y])
+    lin.weight.set_value(paddle.to_tensor(lin.weight.numpy() * 2))
+    out_b, = exe.run(main, feed={"x": f}, fetch_list=[y])
+    W, b = lin.weight.numpy(), lin.bias.numpy()
+    np.testing.assert_allclose(out_b, np.tanh(f @ W + b) * 2, atol=1e-5)
+    assert not np.allclose(out_a, out_b)
+
+
+def test_multiple_fetches_and_missing_feed():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        a = x * 2.0
+        b = a + 1.0
+    exe = static.Executor()
+    f = np.arange(6, dtype=np.float32).reshape(2, 3)
+    oa, ob = exe.run(main, feed={"x": f}, fetch_list=[a, b])
+    np.testing.assert_allclose(oa, f * 2)
+    np.testing.assert_allclose(ob, f * 2 + 1)
+    with pytest.raises(KeyError):
+        exe.run(main, feed={}, fetch_list=[a])
+
+
+def test_uncaptured_fetch_raises_clearly():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2], "float32")
+        _ = x + 1.0
+    stray = paddle.to_tensor(np.zeros((2, 2), np.float32)) * 3.0
+    exe = static.Executor()
+    # eager tensors not built from placeholders fetch their eager value
+    out, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                   fetch_list=[stray])
+    np.testing.assert_allclose(out, np.zeros((2, 2)))
