@@ -32,10 +32,29 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn,
-                             _rms_norm)
+from .llama_pretrain import (LlamaPretrainConfig,
+                             _block_post_attn, _mm, _rms_norm)
 
-__all__ = ["make_generate"]
+__all__ = ["make_generate", "quantize_params_int8"]
+
+
+def quantize_params_int8(params):
+    """Weight-only int8 quantisation of a llama_pretrain checkpoint for
+    decoding: every trunk/head matmul weight becomes {"q", "s"} with
+    per-output-channel scales; norms and the embedding stay as-is.
+    Reference analog: nn/quant/weight_quantize + the cutlass
+    weight-only GEMMs it feeds."""
+    from ..ops.pallas.int8_matmul import quantize_int8
+    out = dict(params)
+    blocks = {}
+    for name, warr in params["blocks"].items():
+        if name.startswith("ln"):
+            blocks[name] = warr
+        else:
+            blocks[name] = jax.vmap(quantize_int8)(warr)
+    out["blocks"] = blocks
+    out["lm_head"] = quantize_int8(params["lm_head"])
+    return out
 
 
 def _rope_single(x, theta, pos):
@@ -63,9 +82,9 @@ def _pre_attn_at(bp, x, cfg: LlamaPretrainConfig, pos):
     nkv = cfg.num_key_value_heads
     dt = cfg.dtype
     y = _rms_norm(x, bp["ln1"], cfg.rms_norm_eps)
-    q = (y @ bp["wq"].astype(dt)).reshape(b, 1, n, d)
-    k = (y @ bp["wk"].astype(dt)).reshape(b, 1, nkv, d)
-    v = (y @ bp["wv"].astype(dt)).reshape(b, 1, nkv, d)
+    q = _mm(y, bp["wq"], dt).reshape(b, 1, n, d)
+    k = _mm(y, bp["wk"], dt).reshape(b, 1, nkv, d)
+    v = _mm(y, bp["wv"], dt).reshape(b, 1, nkv, d)
     q = _rope_single(q, cfg.rope_theta, pos)
     k = _rope_single(k, cfg.rope_theta, pos)
     return q, k, v
@@ -76,8 +95,8 @@ def _prefill_kv(bp, y_normed, cfg: LlamaPretrainConfig, b, s):
     RoPE over positions 0..s-1 — mirrors _block_pre_attn's table."""
     nkv, d = cfg.num_key_value_heads, cfg.head_dim
     dt = cfg.dtype
-    k = (y_normed @ bp["wk"].astype(dt)).reshape(b, s, nkv, d)
-    v = (y_normed @ bp["wv"].astype(dt)).reshape(b, s, nkv, d)
+    k = _mm(y_normed, bp["wk"], dt).reshape(b, s, nkv, d)
+    v = _mm(y_normed, bp["wv"], dt).reshape(b, s, nkv, d)
     return k, v
 
 
@@ -119,8 +138,7 @@ def make_generate(cfg: LlamaPretrainConfig, prompt_len: int,
 
     def head_logits(params, x_last):
         h = _rms_norm(x_last, params["final_norm"], cfg.rms_norm_eps)
-        return (h @ params["lm_head"].astype(cfg.dtype)).astype(
-            jnp.float32)
+        return _mm(h, params["lm_head"], cfg.dtype).astype(jnp.float32)
 
     def pick(logits, key):
         if temperature <= 0.0:
@@ -143,8 +161,7 @@ def make_generate(cfg: LlamaPretrainConfig, prompt_len: int,
         def prefill_layer(carry, bp):
             xc = carry
             y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
-            q = (y @ bp["wq"].astype(dt)).reshape(
-                B, prompt_len, n, d)
+            q = _mm(y, bp["wq"], dt).reshape(B, prompt_len, n, d)
             k, v = _prefill_kv(bp, y, cfg, B, prompt_len)
             q, k = _rope(q, k, cfg.rope_theta)
             attn = _grouped_attn(q, k, v,

@@ -191,6 +191,29 @@ def _rms_norm(x, w, eps):
         x.dtype) * w.astype(x.dtype)
 
 
+def _mm(x, w, dt):
+    """Matmul against a weight that is either a plain array or a
+    weight-only int8 dict {"q": int8 [K,N], "s": f32 [N]} produced by
+    ``models.decode.quantize_params_int8`` (serving path).  The Pallas
+    kernel (ops/pallas/int8_matmul) is used when the dims are
+    lane-aligned and FLAGS_pallas_int8_matmul is on; otherwise an XLA
+    dequant-then-matmul keeps the numerics (without the HBM saving)."""
+    if isinstance(w, dict):
+        from ..flags import flags
+        from ..ops.dispatch import get_op_impl
+        impl = get_op_impl("int8_matmul", None)
+        K, N = w["q"].shape
+        x2 = x.reshape(-1, x.shape[-1])
+        if impl is not None and flags.FLAGS_pallas_int8_matmul and \
+                K % 128 == 0 and N % 128 == 0:
+            out = impl(x2, w["q"], w["s"], out_dtype=dt)
+        else:
+            out = (x2.astype(dt) @ w["q"].astype(dt)) * \
+                w["s"].astype(dt)[None, :]
+        return out.reshape(*x.shape[:-1], out.shape[-1])
+    return x @ w.astype(dt)
+
+
 def _rope(q, k, theta):
     # q/k: [b, s, n, d]
     from ..flags import flags
@@ -263,23 +286,25 @@ def _block_pre_attn(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig):
 
 def _block_post_attn(bp: Dict[str, Any], x, attn,
                      cfg: LlamaPretrainConfig):
-    """Output projection + residual + FFN."""
+    """Output projection + residual + FFN.  Weight entries may be plain
+    arrays (training) or weight-only int8 dicts (the decode serving
+    path) — see :func:`_mm`."""
     from ..flags import flags
     from ..ops.dispatch import get_op_impl
     b, s, h = x.shape
     dt = cfg.dtype
     attn = _ckpt_name(attn.reshape(b, s, h), "attn_out")
-    x = x + attn @ bp["wo"].astype(dt)
+    x = x + _mm(attn, bp["wo"], dt)
     res = x
     y = _rms_norm(x, bp["ln2"], cfg.rms_norm_eps)
     sw = get_op_impl("swiglu", None)
     if sw is not None and flags.FLAGS_pallas_swiglu:
-        act = _ckpt_name(sw(y @ bp["w_gate"].astype(dt),
-                            y @ bp["w_up"].astype(dt)), "ffn_gate")
-        return res + act @ bp["w_down"].astype(dt)
-    gate = _ckpt_name(jax.nn.silu(y @ bp["w_gate"].astype(dt)), "ffn_gate")
-    up = _ckpt_name(y @ bp["w_up"].astype(dt), "ffn_up")
-    return res + (gate * up) @ bp["w_down"].astype(dt)
+        act = _ckpt_name(sw(_mm(y, bp["w_gate"], dt),
+                            _mm(y, bp["w_up"], dt)), "ffn_gate")
+        return res + _mm(act, bp["w_down"], dt)
+    gate = _ckpt_name(jax.nn.silu(_mm(y, bp["w_gate"], dt)), "ffn_gate")
+    up = _ckpt_name(_mm(y, bp["w_up"], dt), "ffn_up")
+    return res + _mm(gate * up, bp["w_down"], dt)
 
 
 def _block_forward(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig,

@@ -155,3 +155,18 @@ def test_fused_rope_compiled():
     s_ = np.asarray(sin)[None, :, None, :]
     ref = np.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_], -1)
     assert np.abs(np.asarray(out, np.float32) - ref).max() < 3e-2
+
+
+def test_int8_matmul_compiled():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import int8_matmul, quantize_int8
+    kk = jax.random.PRNGKey
+    x = jax.random.normal(kk(0), (8, 512), jnp.bfloat16)
+    w = jax.random.normal(kk(1), (512, 1024), jnp.float32) * 0.1
+    qd = quantize_int8(w)
+    out = jax.jit(lambda x: int8_matmul(x, qd["q"], qd["s"]))(x)
+    ref = np.asarray(x, np.float32) @ np.asarray(w)
+    rel = np.abs(np.asarray(out, np.float32) - ref).max() / \
+        np.abs(ref).max()
+    assert rel < 0.05, rel
