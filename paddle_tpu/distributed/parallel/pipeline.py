@@ -28,6 +28,21 @@ leading-axis-stacked parameter pytree (one slice per stage — uniform
 stage structure, the same constraint GSPMD-era pipelining has; put
 non-uniform embedding/head layers outside the trunk as the flagship
 does).
+
+On zero-bubble (ZB-H1/H2) schedules — the reference's
+``pipeline_scheduler_pass`` family: deliberately NOT implemented here,
+as a design trade rather than an omission.  ZB fills the drain bubble
+by splitting each backward into an input-grad pass (on the critical
+path) and a weight-grad pass (deferred into bubble ticks).  On GPU that
+split is natural: dX and dW are separate GEMM launches.  Under XLA the
+block backward is ONE fused vjp whose dX and dW share the recomputed
+activations in registers/VMEM; splitting them into separate programs
+forces the activations to be materialised to HBM and read twice —
+the bandwidth cost exceeds the 1F1B bubble it recovers at the depths a
+TPU pod runs (pp <= 8, where bubble fraction is 2(pp-1)/(2M + 2(pp-1)),
+~12% at pp=4/M=24, and the interleaved vpp schedule above already
+divides it).  Revisit only if profiling a real >=pp=8 pod shows the
+bubble dominating the splitting cost.
 """
 
 from __future__ import annotations

@@ -61,7 +61,7 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 jnp.concatenate([cos_h, cos_h], -1).astype(dtype))
 
     def rope_one(x, sin_e, cos_e):
-        # x: [b, s, h, d]
+        # x: [b, s, h, d]; tables [s, d] (shared) or [b, s, d]
         d = x.shape[-1]
         if use_neox_rotary_style:
             x1, x2 = x[..., : d // 2], x[..., d // 2:]
@@ -70,7 +70,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             x1 = x[..., ::2]
             x2 = x[..., 1::2]
             rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
-        return x * cos_e[None, :, None, :] + rot * sin_e[None, :, None, :]
+
+        def expand(t):
+            return t[None, :, None, :] if t.ndim == 2 else \
+                t[:, :, None, :]
+
+        return x * expand(cos_e) + rot * expand(sin_e)
 
     outs = []
     tensors = [t for t in (q, k, v) if t is not None]
@@ -89,7 +94,22 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             cos_t, sin_t = rope_tables(s, d, float(rotary_emb_base))
             return tuple(impl(a, cos_t, sin_t) for a in arrs)
         if sin is None:
-            sin_e, cos_e = make_sincos(s, d, arrs[0].dtype)
+            if position_ids is not None:
+                # tables at the given absolute positions (decode with a
+                # KV cache: the appended token sits at cache_len, not 0
+                # — reference fused_rope position_ids semantics).
+                # Shapes: [s] (shared across batch) or [b, s] per the
+                # reference API.  Computed directly from the positions
+                # (trace-safe), frequencies from the single source.
+                from ....ops.pallas.rope import rope_inv_freq
+                pos = as_tensor(position_ids)._data
+                inv = rope_inv_freq(d, float(rotary_emb_base))
+                freqs = pos.astype(jnp.float32)[..., None] * inv
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+                sin_e = jnp.sin(emb).astype(arrs[0].dtype)
+                cos_e = jnp.cos(emb).astype(arrs[0].dtype)
+            else:
+                sin_e, cos_e = make_sincos(s, d, arrs[0].dtype)
         else:
             sin_e = as_tensor(sin)._data.reshape(s, d)
             cos_e = as_tensor(cos)._data.reshape(s, d)

@@ -75,8 +75,18 @@ class LlamaAttention(Layer):
                                      cfg.head_dim])
         v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads,
                                      cfg.head_dim])
-        q, k, _ = fused_rotary_position_embedding(
-            q, k, rotary_emb_base=cfg.rope_theta)
+        if cache is not None and cache[0].shape[1] > 0:
+            # decode with a KV cache: the incoming tokens sit at
+            # absolute positions cache_len..cache_len+s-1, so RoPE
+            # must rotate at those positions (position 0 would repeat
+            # the first token's rotation for every generated token)
+            offset = cache[0].shape[1]
+            pos = np.arange(offset, offset + s)
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+        else:
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, rotary_emb_base=cfg.rope_theta)
         if cache is not None:
             pk, pv = cache
             k = concat([pk, k], axis=1)
@@ -87,8 +97,12 @@ class LlamaAttention(Layer):
             rep = cfg.num_attention_heads // cfg.num_key_value_heads
             k = repeat_interleave(k, rep, axis=2)
             v = repeat_interleave(v, rep, axis=2)
+        # causal masking is about the QUERY span, not cache presence:
+        # a multi-token segment (prefill, even with an empty cache
+        # passed in) must be causal; a single decode token attends to
+        # everything cached before it
         out = F.scaled_dot_product_attention(q, k, v,
-                                             is_causal=cache is None)
+                                             is_causal=(s > 1))
         out = reshape(out, [b, s, h])
         out = self.o_proj(out)
         if cache is not None:
@@ -189,9 +203,87 @@ class LlamaForCausalLM(Layer):
             return logits, new_caches
         return logits
 
+    def _pretrain_params(self):
+        """Map this Layer model's parameters onto the llama_pretrain
+        functional pytree (stacked [L, ...] blocks) so the compiled
+        KV-cache decode (models/decode.py) can serve it."""
+        import jax.numpy as jnp
+        names = {"ln1": lambda l: l.input_layernorm.weight,
+                 "wq": lambda l: l.self_attn.q_proj.weight,
+                 "wk": lambda l: l.self_attn.k_proj.weight,
+                 "wv": lambda l: l.self_attn.v_proj.weight,
+                 "wo": lambda l: l.self_attn.o_proj.weight,
+                 "ln2": lambda l: l.post_attention_layernorm.weight,
+                 "w_gate": lambda l: l.mlp.gate_proj.weight,
+                 "w_up": lambda l: l.mlp.up_proj.weight,
+                 "w_down": lambda l: l.mlp.down_proj.weight}
+        blocks = {k: jnp.stack([get(layer)._data
+                                for layer in self.llama.layers])
+                  for k, get in names.items()}
+        embed = self.llama.embed_tokens.weight._data
+        lm_head = embed.T if self.lm_head is None else \
+            self.lm_head.weight._data
+        return {"embed": embed, "blocks": blocks,
+                "final_norm": self.llama.norm.weight._data,
+                "lm_head": lm_head}
+
+    def generate_compiled(self, input_ids, max_new_tokens=32,
+                          temperature=0.0, quantize_int8=False,
+                          seed=0):
+        """ONE jitted XLA program for the whole generation (prefill +
+        lax.scan token loop over a preallocated KV cache) — the serving
+        path; see models/decode.py.  Compiled functions are cached per
+        (prompt_len, max_new_tokens, temperature); ``seed`` varies the
+        sampling key when ``temperature > 0``."""
+        import jax
+        import jax.numpy as jnp
+        from .decode import make_generate, quantize_params_int8
+        from .llama_pretrain import LlamaPretrainConfig
+        cfg = self.cfg
+        ids = input_ids._data if isinstance(input_ids, Tensor) else \
+            jnp.asarray(input_ids)
+        pl_ = int(ids.shape[1])
+        pcfg = LlamaPretrainConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            max_seq_len=cfg.max_position_embeddings,
+            rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_norm_eps,
+            use_pallas_attention=False, sequence_parallel=False,
+            remat=False, dtype=jnp.float32, param_dtype=jnp.float32)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        key = (pl_, int(max_new_tokens), float(temperature))
+        gen = cache.get(key)
+        if gen is None:
+            gen = cache[key] = make_generate(
+                pcfg, prompt_len=pl_, max_new_tokens=max_new_tokens,
+                temperature=temperature)
+        # the stacked pytree is an O(model-size) copy: cache it on the
+        # instance, invalidated whenever any parameter array identity
+        # changed (optimizer steps swap p._data)
+        sig = tuple(id(p._data) for p in self.parameters())
+        cached = getattr(self, "_gen_params", None)
+        if cached is None or cached[0] != sig or \
+                cached[1] != quantize_int8:
+            params = self._pretrain_params()
+            if quantize_int8:
+                params = quantize_params_int8(params)
+            self._gen_params = cached = (sig, quantize_int8, params)
+        params = cached[2]
+        toks = gen(params, ids, jax.random.PRNGKey(seed))
+        from ..tensor.manipulation import concat as tconcat
+        from ..tensor.tensor import wrap_array
+        return tconcat([wrap_array(ids), wrap_array(toks)], axis=1)
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_p=None):
-        """Greedy / top-p decode (eager, with kv cache)."""
+        """Greedy / top-p decode (eager, with kv cache).  For the
+        compiled single-program serving path use
+        :meth:`generate_compiled`."""
         from ..autograd import tape
         from ..tensor.creation import zeros
         from ..tensor.manipulation import concat as tconcat

@@ -26,14 +26,21 @@ from jax.experimental import pallas as pl
 
 from ._common import idx32
 
-__all__ = ["fused_rope", "rope_tables"]
+__all__ = ["fused_rope", "rope_tables", "rope_inv_freq"]
+
+
+def rope_inv_freq(head_dim: int, theta: float = 10000.0):
+    """RoPE inverse frequencies [d/2] — the ONE source of the formula
+    (rope_tables, the position_ids lane of incubate fused_rope, and
+    decode's single-position rotation all derive from this)."""
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                       dtype=jnp.float32) / head_dim))
 
 
 def rope_tables(seq_len: int, head_dim: int, theta: float = 10000.0,
                 dtype=jnp.float32):
     """cos/sin tables [S, d/2] for :func:`fused_rope`."""
-    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
-                                      dtype=jnp.float32) / head_dim))
+    inv = rope_inv_freq(head_dim, theta)
     t = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
