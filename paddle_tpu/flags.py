@@ -168,6 +168,11 @@ define_flag("FLAGS_pallas_swiglu", False,
             "fuses silu*up into the surrounding matmuls and the kernel "
             "boundary forces an HBM round-trip; kept for the incubate "
             "fused-op API — see PERF.md).")
+define_flag("FLAGS_pallas_rms_norm", False,
+            "Route the flagship trunk's rms_norm through the Pallas "
+            "kernel (default off: measured -11% on the 1.3B bench — "
+            "XLA fuses the composite norm into the adjacent matmul, "
+            "the kernel boundary breaks that; see PERF.md).")
 define_flag("FLAGS_pallas_int8_matmul", True,
             "Use the Pallas weight-only int8 matmul in the decode "
             "serving path (dims must be lane-aligned; measured +23% "

@@ -186,6 +186,13 @@ def init_params(cfg: LlamaPretrainConfig, key, mesh: Mesh,
 # model math (pure, bf16 compute)
 # ---------------------------------------------------------------------------
 def _rms_norm(x, w, eps):
+    from ..flags import flags
+    if flags.FLAGS_pallas_rms_norm:
+        from ..ops.dispatch import get_op_impl
+        impl = get_op_impl("rms_norm", None)
+        if impl is not None and x.shape[-1] % 128 == 0 and \
+                not isinstance(w, dict):
+            return impl(x, w.astype(x.dtype), eps)
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(
         x.dtype) * w.astype(x.dtype)

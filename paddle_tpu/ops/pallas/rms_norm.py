@@ -26,19 +26,31 @@ def _fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps: float):
     rstd_ref[:] = rstd
 
 
-def _bwd_kernel(x_ref, w_ref, rstd_ref, do_ref, dx_ref, dwp_ref, *,
-                eps: float):
+def _bwd_kernel(x_ref, w_ref, rstd_ref, do_ref, dx_ref, dwp_ref):
+    from jax.experimental import pallas as pl
     x = x_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
     rstd = rstd_ref[:]
     do = do_ref[:].astype(jnp.float32)
     xhat = x * rstd
     wdo = w * do
-    h = x.shape[-1]
     c = jnp.mean(xhat * wdo, axis=-1, keepdims=True)
     dx = (wdo - xhat * c) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    dwp_ref[:] = jnp.sum(xhat * do, axis=0, keepdims=True)
+    # dw accumulates in ONE (8, h) output block (constant index map:
+    # TPU grids run sequentially, so the block stays resident in VMEM
+    # across iterations).  A (1, h) per-block output would violate
+    # Mosaic's (8, 128) tiling whenever the grid has >1 block.
+    rowsum = jnp.sum(xhat * do, axis=0, keepdims=True)       # [1, h]
+    slab = jnp.pad(rowsum, ((0, 7), (0, 0)))
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dwp_ref[:] = slab
+
+    @pl.when(pl.program_id(0) != 0)
+    def _accum():
+        dwp_ref[:] = dwp_ref[:] + slab
 
 
 def _interpret() -> bool:
@@ -98,16 +110,16 @@ def _bwd_vjp(eps, res, dout):
     br = _block_rows(n)
     do = dout.reshape(n, h)
     dx, dw_partial = pl.pallas_call(
-        functools.partial(_bwd_kernel, eps=eps),
+        _bwd_kernel,
         out_shape=(jax.ShapeDtypeStruct((n, h), xr.dtype),
-                   jax.ShapeDtypeStruct((n // br, h), jnp.float32)),
+                   jax.ShapeDtypeStruct((8, h), jnp.float32)),
         grid=(n // br,),
         in_specs=[pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
                   pl.BlockSpec((1, h), lambda i: idx32(0, 0)),
                   pl.BlockSpec((br, 1), lambda i: idx32(i, 0)),
                   pl.BlockSpec((br, h), lambda i: idx32(i, 0))],
         out_specs=(pl.BlockSpec((br, h), lambda i: idx32(i, 0)),
-                   pl.BlockSpec((1, h), lambda i: idx32(i, 0))),
+                   pl.BlockSpec((8, h), lambda i: idx32(0, 0))),
         interpret=_interpret(),
     )(xr, w.reshape(1, -1), rstd, do)
     dw = jnp.sum(dw_partial, axis=0).astype(w.dtype)
