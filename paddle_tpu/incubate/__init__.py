@@ -14,6 +14,7 @@ from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from .jit_train import jit_train_step  # noqa: F401
 from .optimizer import LarsMomentumOptimizer  # noqa: F401
 from ..optimizer.optimizer import LBFGS  # noqa: F401
 
