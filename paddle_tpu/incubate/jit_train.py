@@ -29,6 +29,7 @@ Bounds (documented, loud):
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -42,10 +43,17 @@ from ..tensor.tensor import Tensor, wrap_array
 __all__ = ["jit_train_step"]
 
 
-def jit_train_step(model: Layer, loss_fn: Callable, optimizer):
+def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
+                   amp_level: str = "O0", amp_dtype: str = "bfloat16"):
     """Compile ``loss_fn(model(x), y)`` + backward + ``optimizer`` into
     one jitted step.  Returns ``step(x, y) -> loss Tensor``; parameters
     and optimizer state live on device between calls.
+
+    ``amp_level``: "O0" (off) or "O1" — the eager autocast hook applies
+    per-op inside the traced program (white/black lists identical to
+    eager AMP), so the compiled step runs mixed bf16/fp16 with fp32
+    master params and fp32 gradients.  No GradScaler is needed for
+    bfloat16 (the TPU default).
     """
     clip = getattr(optimizer, "_grad_clip", None)
     if clip is not None and not isinstance(clip, ClipGradByGlobalNorm):
@@ -53,17 +61,53 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer):
             "jit_train_step supports grad_clip=None or "
             "ClipGradByGlobalNorm; other clips need the eager path")
 
-    param_items = [(n, p) for n, p in model.named_parameters()
-                   if not p.stop_gradient]
+    # the model's full parameter set feeds the functional call; ONLY
+    # the optimizer's own parameter list is updated (eager step()
+    # touches optimizer._params() — a fine-tune that hands the
+    # optimizer just the head must not decay the backbone)
+    all_items = list(model.named_parameters())
+    opt_ids = {id(p) for p in optimizer._params()}
+    param_items = [(n, p) for n, p in all_items
+                   if not p.stop_gradient and id(p) in opt_ids]
+    frozen_items = [(n, p) for n, p in all_items
+                    if (n, p) not in param_items]
     names = [n for n, _ in param_items]
     param_objs = {n: p for n, p in param_items}
+    frozen_objs = {n: p for n, p in frozen_items}
     buf_objs = dict(model.named_buffers())
 
-    def loss_of(pvals, bvals, x, y):
+    if amp_level not in ("O0", "O1"):
+        raise NotImplementedError(
+            "jit_train_step amp_level must be O0 or O1 (O2 master-"
+            "weight decoration belongs to amp.decorate + the eager "
+            "loop)")
+    if amp_level == "O1" and amp_dtype == "float16":
+        raise NotImplementedError(
+            "float16 autocast needs GradScaler loss scaling, which the "
+            "compiled step does not integrate — use bfloat16 (the TPU "
+            "default, no scaling needed) or the eager loop with "
+            "amp.GradScaler")
+
+    # RNG-consuming layers (Dropout etc.) draw their key on the HOST at
+    # trace time — inside jit that would bake ONE mask into the program
+    # and reuse it every step.  Refuse rather than silently de-randomise.
+    for _, sub in model.named_sublayers():
+        if type(sub).__name__.startswith("Dropout") and \
+                getattr(sub, "p", 0) and sub.training:
+            raise NotImplementedError(
+                "jit_train_step cannot thread per-step RNG into traced "
+                "Dropout layers yet — call model.eval() on the dropout "
+                "layers, set p=0, or use the eager loop")
+
+    def loss_of(pvals, fvals, bvals, x, y):
+        from ..amp import auto_cast
         with tape.functional_trace_guard():
-            out = model._functional_call(pvals, wrap_array(x),
-                                         buffers=bvals)
-            loss = loss_fn(out, wrap_array(y))
+            with auto_cast(enable=(amp_level == "O1"), level="O1",
+                           dtype=amp_dtype):
+                out = model._functional_call({**pvals, **fvals},
+                                             wrap_array(x),
+                                             buffers=bvals)
+                loss = loss_fn(out, wrap_array(y))
         return loss._data if isinstance(loss, Tensor) else loss
 
     # optimizer states via _get_state: honors a prior set_state_dict
@@ -106,9 +150,16 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer):
         optimizer._current_param = None
         return new_p, new_s
 
-    @jax.jit
-    def compiled(pvals, svals, bvals, x, y, lr):
-        loss, grads = jax.value_and_grad(loss_of)(pvals, bvals, x, y)
+    # donate params + optimizer state: the old buffers are dead after
+    # the step (replaced on the Parameter objects / state_box), and at
+    # README-scale models an undonated copy is the difference between
+    # fitting and OOM.  NOTE: external aliases of a Parameter's old
+    # device buffer become invalid after a step (same as eager updates
+    # replacing p._data).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def compiled(pvals, svals, fvals, bvals, x, y, lr):
+        loss, grads = jax.value_and_grad(loss_of)(pvals, fvals, bvals,
+                                                  x, y)
         new_p, new_s = update_all(pvals, svals, grads, lr)
         return new_p, new_s, loss
 
@@ -118,10 +169,11 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer):
         xv = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
         pvals = {n: param_objs[n]._data for n in names}
+        fvals = {n: p._data for n, p in frozen_objs.items()}
         bvals = {n: b._data for n, b in buf_objs.items()}  # live reads
         lr = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
-        new_p, new_s, loss = compiled(pvals, state_box["s"], bvals,
-                                      xv, yv, lr)
+        new_p, new_s, loss = compiled(pvals, state_box["s"], fvals,
+                                      bvals, xv, yv, lr)
         for n in names:
             param_objs[n]._data = new_p[n]
         state_box["s"] = new_s
