@@ -69,8 +69,11 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
     opt_ids = {id(p) for p in optimizer._params()}
     param_items = [(n, p) for n, p in all_items
                    if not p.stop_gradient and id(p) in opt_ids]
+    # membership by id(): a `(n, p) not in list` test would fall through
+    # to Tensor.__eq__ (elementwise) when two parameters share a name
+    trained_ids = {id(p) for _, p in param_items}
     frozen_items = [(n, p) for n, p in all_items
-                    if (n, p) not in param_items]
+                    if id(p) not in trained_ids]
     names = [n for n, _ in param_items]
     param_objs = {n: p for n, p in param_items}
     frozen_objs = {n: p for n, p in frozen_items}

@@ -42,6 +42,67 @@ def enable_to_static(flag: bool) -> None:
     _to_static_enabled[0] = bool(flag)
 
 
+_ADDR_REPR_WARNED: set = set()
+# ndarray content digests are O(bytes) to compute; memoise per live
+# object (identity checked via weakref — a dead entry can never alias a
+# live array) so a static array passed every call is hashed once
+_DIGEST_MEMO: Dict[int, Tuple[Any, str]] = {}
+
+
+def _ndarray_digest(v: np.ndarray) -> str:
+    import hashlib
+    import weakref
+    hit = _DIGEST_MEMO.get(id(v))
+    if hit is not None and hit[0]() is v:
+        return hit[1]
+    d = hashlib.sha1(np.ascontiguousarray(v).tobytes()).hexdigest()
+    try:
+        _DIGEST_MEMO[id(v)] = (weakref.ref(v), d)
+    except TypeError:
+        pass
+    if len(_DIGEST_MEMO) > 4096:    # drop dead entries, bound growth
+        for k in [k for k, (w, _) in _DIGEST_MEMO.items() if w() is None]:
+            del _DIGEST_MEMO[k]
+    return d
+
+
+def _static_key_of(v) -> Any:
+    """Value-stable hashable key for a non-Tensor static argument.
+
+    ``repr()`` alone is wrong twice over: a large ndarray's repr is
+    truncated (two different arrays — bare or inside a list/dict —
+    collide and silently reuse a trace with the wrong baked constant),
+    and a default object repr carries the address (a fresh key every
+    call — unbounded cache growth plus a recompile per call).  Recurse
+    into containers, hash array content (memoised per live object), and
+    warn once per type on address-bearing reprs, keying by identity so
+    at least the growth is visible.
+    """
+    if isinstance(v, np.ndarray):
+        return ("ndarray", str(v.dtype), v.shape, _ndarray_digest(v))
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__,) + tuple(_static_key_of(e) for e in v)
+    if isinstance(v, dict):
+        return ("dict",) + tuple(sorted(
+            (repr(k), _static_key_of(e)) for k, e in v.items()))
+    if isinstance(v, (set, frozenset)):
+        return (type(v).__name__,
+                tuple(sorted(repr(e) for e in v)))
+    r = repr(v)
+    if " at 0x" in r:
+        tname = type(v).__name__
+        if tname not in _ADDR_REPR_WARNED:
+            _ADDR_REPR_WARNED.add(tname)
+            import warnings
+            warnings.warn(
+                f"to_static: static argument of type {tname!r} has an "
+                "address-bearing repr; it is keyed by identity, so every "
+                "new instance re-traces.  Pass a value-stable object (or "
+                "a Tensor) instead.", stacklevel=3)
+        return ("id", tname, id(v))
+    return r
+
+
 class InputSpec:
     """Reference: paddle.static.InputSpec."""
 
@@ -93,7 +154,7 @@ class StaticFunction:
     def _cache_key(self, kwargs) -> Any:
         layer = self._layer
         static_kw = tuple(sorted(
-            (k, repr(v)) for k, v in kwargs.items()
+            (k, _static_key_of(v)) for k, v in kwargs.items()
             if not isinstance(v, Tensor)))
         return (layer.training if layer is not None else None, static_kw)
 
@@ -128,7 +189,8 @@ class StaticFunction:
         # non-Tensor positional values are baked into the trace as
         # statics, so they must be part of the cache key
         key = self._cache_key(kwargs) + (
-            tuple("·" if s is None else repr(s) for s in arg_spec),)
+            tuple("·" if s is None else _static_key_of(s)
+                  for s in arg_spec),)
         if key in self._fallback_keys:
             # known graph break: skip re-tracing straight to eager
             return self._obj(*args, **kwargs)

@@ -264,14 +264,20 @@ class LlamaForCausalLM(Layer):
                 temperature=temperature)
         # the stacked pytree is an O(model-size) copy: cache it on the
         # instance, invalidated whenever any parameter array identity
-        # changed (optimizer steps swap p._data)
-        sig = tuple(id(p._data) for p in self.parameters())
+        # changed (optimizer steps swap p._data).  Weakrefs, not id():
+        # after a step frees the old arrays, CPython can hand the new
+        # ones the same addresses, so an id() tuple can falsely match —
+        # a dead weakref can never compare `is` to a live array.
+        import weakref
+        plist = list(self.parameters())
         cached = getattr(self, "_gen_params", None)
-        if cached is None or cached[0] != sig or \
-                cached[1] != quantize_int8:
+        if cached is None or cached[1] != quantize_int8 or \
+                len(cached[0]) != len(plist) or \
+                any(w() is not p._data for w, p in zip(cached[0], plist)):
             params = self._pretrain_params()
             if quantize_int8:
                 params = quantize_params_int8(params)
+            sig = tuple(weakref.ref(p._data) for p in plist)
             self._gen_params = cached = (sig, quantize_int8, params)
         params = cached[2]
         toks = gen(params, ids, jax.random.PRNGKey(seed))

@@ -50,15 +50,26 @@ def _interpret() -> bool:
     return jax.devices()[0].platform not in ("tpu", "axon")
 
 
-def _block_n(K, N):
+def _block_n(K, N, enforce_vmem=True):
     # whole-K weight blocks; <= 2 MiB int8 per block (4 MiB measured
     # no faster on the 1.3B decode and squeezes VMEM)
     for bn in (512, 256, 128):
         if N % bn == 0 and K * bn <= (1 << 21):
             return bn
+    # fallback keeps a hard cap: the int8 block plus its bf16 dequant
+    # copy (3x the int8 bytes) must stay inside scoped VMEM, or Mosaic
+    # fails at run time with an opaque OOM.  4 MiB int8 (12 MiB total)
+    # is the ceiling; beyond that the kernel needs a K-split it does
+    # not have, so refuse loudly — except in interpret mode, where
+    # there is no VMEM to blow.
     for bn in (512, 256, 128):
-        if N % bn == 0:
+        if N % bn == 0 and (not enforce_vmem or K * bn <= (1 << 22)):
             return bn
+    if enforce_vmem and K * N > (1 << 22):  # no divisor -> whole-N block
+        raise ValueError(
+            f"int8_matmul: no weight block fits VMEM for K={K}, N={N} "
+            "(whole-K blocks only).  Split K on the caller side or use "
+            "the XLA dequant-then-matmul path.")
     return N
 
 
@@ -85,7 +96,8 @@ def int8_matmul(x, wq, scale, out_dtype=None):
     if pad_m:
         x = jnp.pad(x, ((0, pad_m), (0, 0)))
     Mp = M + pad_m
-    bn = _block_n(K, N)
+    interp = _interpret()
+    bn = _block_n(K, N, enforce_vmem=not interp)
     bm = _block_m(Mp, K)
     out = pl.pallas_call(
         _kernel,
@@ -100,7 +112,7 @@ def int8_matmul(x, wq, scale, out_dtype=None):
             pl.BlockSpec((1, bn), lambda i, j: idx32(0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: idx32(i, j)),
-        interpret=_interpret(),
+        interpret=interp,
     )(x.astype(jnp.bfloat16), wq,
       scale.astype(jnp.float32).reshape(1, -1))
     return out[:M] if pad_m else out
