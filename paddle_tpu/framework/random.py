@@ -18,7 +18,7 @@ import numpy as np
 
 __all__ = ["seed", "get_rng_state", "set_rng_state", "next_key",
            "Generator", "default_generator", "get_cuda_rng_state",
-           "set_cuda_rng_state"]
+           "set_cuda_rng_state", "traced_key_guard", "make_step_key"]
 
 
 class Generator:
@@ -72,7 +72,64 @@ def seed(value: int) -> Generator:
     return default_generator.manual_seed(int(value))
 
 
+_traced = threading.local()
+
+
+class traced_key_guard:
+    """While active on this thread, :func:`next_key` derives keys from a
+    TRACED base key — ``jax.random.fold_in(base, site_counter)`` —
+    instead of advancing the host-side generator chain.
+
+    This is how RNG ops (dropout, rrelu, multinomial sampling, …) stay
+    random inside a jitted program: a host-side ``next_key()`` at trace
+    time would bake ONE mask into the compiled executable and replay it
+    every step (the reference threads a seed+offset into each cuRAND
+    kernel for the same reason —
+    /root/reference/python/paddle/nn/functional/common.py:989 dropout's
+    seed plumbing).  The base key is a per-execution argument of the
+    traced program; each RNG call site gets a distinct ``fold_in``
+    counter, fixed by trace order.
+    """
+
+    def __init__(self, base):
+        self._base = base
+        self.count = 0
+
+    def __enter__(self):
+        stack = getattr(_traced, "stack", None)
+        if stack is None:
+            stack = _traced.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _traced.stack.pop()
+        return False
+
+    def _next(self):
+        self.count += 1
+        return jax.random.fold_in(self._base, self.count)
+
+
+def draw_step_root() -> int:
+    """Draw a 32-bit per-program RNG root from the global chain (so
+    ``paddle.seed`` reproduces it); pair with :func:`make_step_key`."""
+    return int(np.asarray(default_generator.next_key()).ravel()[-1])
+
+
+def make_step_key(root: int, step: int):
+    """Pack (root, step) into raw uint32[2] key data — a valid threefry
+    key (the PRF decorrelates any distinct key pairs) constructed on the
+    HOST with no device ops, so a compiled train step pays zero extra
+    dispatches for per-step randomness."""
+    return np.array([np.uint32(root & 0xFFFFFFFF),
+                     np.uint32(step & 0xFFFFFFFF)], dtype=np.uint32)
+
+
 def next_key():
+    stack = getattr(_traced, "stack", None)
+    if stack:
+        return stack[-1]._next()
     return default_generator.next_key()
 
 

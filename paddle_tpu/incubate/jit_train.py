@@ -34,6 +34,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..autograd import tape
 from ..nn.clip import ClipGradByGlobalNorm
@@ -47,7 +48,8 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
                    amp_level: str = "O0", amp_dtype: str = "bfloat16"):
     """Compile ``loss_fn(model(x), y)`` + backward + ``optimizer`` into
     one jitted step.  Returns ``step(x, y) -> loss Tensor``; parameters
-    and optimizer state live on device between calls.
+    and optimizer state live on device between calls.  ``x`` / ``y``
+    may be tuples: ``model(*x)`` and ``loss_fn(out, y_tuple)``.
 
     ``amp_level``: "O0" (off) or "O1" — the eager autocast hook applies
     per-op inside the traced program (white/black lists identical to
@@ -91,26 +93,31 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
             "default, no scaling needed) or the eager loop with "
             "amp.GradScaler")
 
-    # RNG-consuming layers (Dropout etc.) draw their key on the HOST at
-    # trace time — inside jit that would bake ONE mask into the program
-    # and reuse it every step.  Refuse rather than silently de-randomise.
-    for _, sub in model.named_sublayers():
-        if type(sub).__name__.startswith("Dropout") and \
-                getattr(sub, "p", 0) and sub.training:
-            raise NotImplementedError(
-                "jit_train_step cannot thread per-step RNG into traced "
-                "Dropout layers yet — call model.eval() on the dropout "
-                "layers, set p=0, or use the eager loop")
+    # RNG-consuming layers (Dropout etc.): a host-side key draw at trace
+    # time would bake ONE mask into the program.  Instead each step
+    # passes fresh uint32[2] key data (host-constructed, zero device
+    # dispatches) and every RNG call site fold_ins a distinct counter —
+    # see framework.random.traced_key_guard.  Reproducible via
+    # paddle.seed() before building the step (the root is drawn from
+    # the global chain here).
+    from ..framework import random as framework_random
+    rng_root = framework_random.draw_step_root()
 
-    def loss_of(pvals, fvals, bvals, x, y):
+    def loss_of(pvals, fvals, bvals, x, y, rng):
         from ..amp import auto_cast
+        # x / y may be tuples of arrays (multi-input models: BERT takes
+        # ids+token_types+mask; QA labels are (start, end))
+        xs = tuple(wrap_array(a) for a in x) if isinstance(x, tuple) \
+            else (wrap_array(x),)
+        yt = tuple(wrap_array(a) for a in y) if isinstance(y, tuple) \
+            else wrap_array(y)
         with tape.functional_trace_guard():
-            with auto_cast(enable=(amp_level == "O1"), level="O1",
-                           dtype=amp_dtype):
-                out = model._functional_call({**pvals, **fvals},
-                                             wrap_array(x),
-                                             buffers=bvals)
-                loss = loss_fn(out, wrap_array(y))
+            with framework_random.traced_key_guard(rng):
+                with auto_cast(enable=(amp_level == "O1"), level="O1",
+                               dtype=amp_dtype):
+                    out = model._functional_call({**pvals, **fvals},
+                                                 *xs, buffers=bvals)
+                    loss = loss_fn(out, yt)
         return loss._data if isinstance(loss, Tensor) else loss
 
     # optimizer states via _get_state: honors a prior set_state_dict
@@ -160,23 +167,30 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
     # device buffer become invalid after a step (same as eager updates
     # replacing p._data).
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def compiled(pvals, svals, fvals, bvals, x, y, lr):
+    def compiled(pvals, svals, fvals, bvals, x, y, lr, rng):
         loss, grads = jax.value_and_grad(loss_of)(pvals, fvals, bvals,
-                                                  x, y)
+                                                  x, y, rng)
         new_p, new_s = update_all(pvals, svals, grads, lr)
         return new_p, new_s, loss
 
-    state_box = {"s": states}
+    state_box = {"s": states, "t": 0}
+
+    def _arr(v):
+        if isinstance(v, (tuple, list)):
+            return tuple(_arr(e) for e in v)
+        return v._data if isinstance(v, Tensor) else jnp.asarray(v)
 
     def step(x, y):
-        xv = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        xv = _arr(x)
+        yv = _arr(y)
         pvals = {n: param_objs[n]._data for n in names}
         fvals = {n: p._data for n, p in frozen_objs.items()}
         bvals = {n: b._data for n, b in buf_objs.items()}  # live reads
         lr = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
+        rng = framework_random.make_step_key(rng_root, state_box["t"])
+        state_box["t"] += 1
         new_p, new_s, loss = compiled(pvals, state_box["s"], fvals,
-                                      bvals, xv, yv, lr)
+                                      bvals, xv, yv, lr, rng)
         for n in names:
             param_objs[n]._data = new_p[n]
         state_box["s"] = new_s

@@ -49,19 +49,30 @@ _ADDR_REPR_WARNED: set = set()
 _DIGEST_MEMO: Dict[int, Tuple[Any, str]] = {}
 
 
+def _ndarray_sample(v: np.ndarray) -> bytes:
+    """O(1)-ish content fingerprint: a 64-point stride sample.  Guards
+    the digest memo against in-place mutation of a memoised array (the
+    common mutations — fill, slice assignment, += — perturb it)."""
+    flat = v.reshape(-1)
+    if flat.size == 0:
+        return b""
+    return np.ascontiguousarray(
+        flat[::max(1, flat.size // 64)]).tobytes()
+
+
 def _ndarray_digest(v: np.ndarray) -> str:
     import hashlib
     import weakref
     hit = _DIGEST_MEMO.get(id(v))
-    if hit is not None and hit[0]() is v:
+    if hit is not None and hit[0]() is v and hit[2] == _ndarray_sample(v):
         return hit[1]
     d = hashlib.sha1(np.ascontiguousarray(v).tobytes()).hexdigest()
     try:
-        _DIGEST_MEMO[id(v)] = (weakref.ref(v), d)
+        _DIGEST_MEMO[id(v)] = (weakref.ref(v), d, _ndarray_sample(v))
     except TypeError:
         pass
     if len(_DIGEST_MEMO) > 4096:    # drop dead entries, bound growth
-        for k in [k for k, (w, _) in _DIGEST_MEMO.items() if w() is None]:
+        for k in [k for k, e in _DIGEST_MEMO.items() if e[0]() is None]:
             del _DIGEST_MEMO[k]
     return d
 
@@ -84,10 +95,11 @@ def _static_key_of(v) -> Any:
         return (type(v).__name__,) + tuple(_static_key_of(e) for e in v)
     if isinstance(v, dict):
         return ("dict",) + tuple(sorted(
-            (repr(k), _static_key_of(e)) for k, e in v.items()))
+            ((_static_key_of(k), _static_key_of(e))
+             for k, e in v.items()), key=repr))
     if isinstance(v, (set, frozenset)):
-        return (type(v).__name__,
-                tuple(sorted(repr(e) for e in v)))
+        return (type(v).__name__, tuple(sorted(
+            (_static_key_of(e) for e in v), key=repr)))
     r = repr(v)
     if " at 0x" in r:
         tname = type(v).__name__
@@ -136,6 +148,13 @@ class StaticFunction:
         # best-effort; the original stays the eager-fallback target.
         from .dy2static import ast_transform
         self._fallback_keys: set = set()
+        # per-call RNG threading: without it a trace-time next_key()
+        # bakes ONE dropout mask into the program and replays it every
+        # call (silent de-randomisation).  Root drawn lazily from the
+        # global chain (paddle.seed reproducible); each call passes
+        # (root, counter) as raw uint32[2] key data.
+        self._rng_root: Optional[int] = None
+        self._rng_count = 0
         if isinstance(obj, Layer):
             conv = ast_transform(type(obj).forward)
             # the converted forward is swapped in ONLY while tracing
@@ -202,6 +221,9 @@ class StaticFunction:
             n_pos = len(tensor_args)
 
             def pure(*arrs):
+                from ..framework import random as framework_random
+                rng = arrs[-1]
+                arrs = arrs[:-1]
                 p_arrs = arrs[:n_p]
                 pos_arrs = arrs[n_p:n_p + n_pos]
                 kw_arrs = arrs[n_p + n_pos:]
@@ -211,6 +233,7 @@ class StaticFunction:
                 call_kwargs = dict(static_kwargs)
                 for kname, arr in zip(kw_names, kw_arrs):
                     call_kwargs[kname] = wrap_array(arr)
+                rng_guard = framework_random.traced_key_guard(rng)
                 if layer is not None:
                     params = {}
                     bufs = {}
@@ -225,21 +248,23 @@ class StaticFunction:
                         orig_fwd = layer.__dict__.get("forward")
                         layer.forward = types.MethodType(conv, layer)
                         try:
-                            out = layer._functional_call(
-                                params, *call_args, buffers=bufs,
-                                **call_kwargs)
+                            with rng_guard:
+                                out = layer._functional_call(
+                                    params, *call_args, buffers=bufs,
+                                    **call_kwargs)
                         finally:
                             if orig_fwd is None:
                                 del layer.forward
                             else:
                                 layer.forward = orig_fwd
                     else:
-                        out = layer._functional_call(
-                            params, *call_args, buffers=bufs,
-                            **call_kwargs)
+                        with rng_guard:
+                            out = layer._functional_call(
+                                params, *call_args, buffers=bufs,
+                                **call_kwargs)
                 else:
                     fn = self._converted or obj
-                    with tape.functional_trace_guard():
+                    with tape.functional_trace_guard(), rng_guard:
                         out = fn(*call_args, **call_kwargs)
                 flat, treedef = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))
@@ -250,9 +275,16 @@ class StaticFunction:
             jfn = jax.jit(pure)
             self._jitted[key] = jfn
 
+        if self._rng_root is None:
+            from ..framework import random as framework_random
+            self._rng_root = framework_random.draw_step_root()
+        from ..framework.random import make_step_key
+        rng_t = wrap_array(jnp.asarray(
+            make_step_key(self._rng_root, self._rng_count)))
+        self._rng_count += 1
         try:
             outs = apply("to_static", jfn, *p_tensors, *tensor_args,
-                         *[tensor_kwargs[k] for k in kw_names],
+                         *[tensor_kwargs[k] for k in kw_names], rng_t,
                          n_outputs=-1)
         except Exception as e:
             if not self._full_graph:

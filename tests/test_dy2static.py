@@ -216,3 +216,63 @@ def test_for_loop_binding_in_branch():
     y, j = f(x, True)
     np.testing.assert_allclose(y.numpy(), [3.0, 3.0])
     assert int(j) == 2
+
+
+def test_to_static_dropout_resamples_per_call():
+    """A trace-time next_key() would bake ONE mask into the jitted
+    program; the per-call rng argument (StaticFunction._rng_count +
+    framework.random.traced_key_guard) keeps train-mode dropout random
+    across calls of the SAME compiled function."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    layer = nn.Dropout(0.5)
+    layer.train()
+    fn = to_static(layer)
+    x = paddle.to_tensor(np.ones((8, 128), np.float32))
+    a = np.asarray(fn(x)._data)
+    b = np.asarray(fn(x)._data)
+    assert not np.array_equal(a, b), "dropout mask baked into the trace"
+    # both are valid upscale_in_train outputs
+    for o in (a, b):
+        assert set(np.unique(o.round(4))) <= {0.0, 2.0}
+
+    layer.eval()
+    np.testing.assert_array_equal(np.asarray(fn(x)._data),
+                                  np.ones((8, 128), np.float32))
+
+
+def test_to_static_dropout_backward_masks_grad():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    layer = nn.Dropout(0.5)
+    layer.train()
+    fn = to_static(layer)
+    x = paddle.to_tensor(np.ones((4, 64), np.float32))
+    x.stop_gradient = False
+    out = fn(x)
+    out.sum().backward()
+    g = np.asarray(x.grad._data)
+    o = np.asarray(out._data)
+    # gradient is exactly the mask scaling: 2 where kept, 0 where dropped
+    np.testing.assert_array_equal((g != 0), (o != 0))
+    assert set(np.unique(g.round(4))) <= {0.0, 2.0}
+
+
+def test_to_static_seed_reproducible_rng():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    x = paddle.to_tensor(np.ones((4, 64), np.float32))
+
+    def run():
+        paddle.seed(123)
+        layer = nn.Dropout(0.5)
+        layer.train()
+        fn = to_static(layer)
+        return [np.asarray(fn(x)._data) for _ in range(2)]
+
+    r1, r2 = run(), run()
+    np.testing.assert_array_equal(r1[0], r2[0])
+    np.testing.assert_array_equal(r1[1], r2[1])

@@ -174,10 +174,131 @@ def test_jit_train_step_respects_optimizer_param_list():
     assert not np.allclose(head.weight.numpy(), w_head)
 
 
-def test_jit_train_step_rejects_train_dropout():
-    net = nn.Sequential(nn.Linear(6, 8), nn.Dropout(0.5), nn.Linear(8, 3))
+def test_jit_train_step_dropout_resamples_per_step():
+    """Train-mode Dropout inside the compiled step draws a FRESH mask
+    every step (PRNG key threaded as a per-step argument, fold_in per
+    call site — framework.random.traced_key_guard), instead of baking
+    one mask at trace time.  Reference threads seed+offset into the
+    cuRAND dropout kernel the same way
+    (/root/reference/python/paddle/nn/functional/common.py:989)."""
+    paddle.seed(21)
+    net = nn.Sequential(nn.Linear(6, 64), nn.Dropout(0.5), nn.Linear(64, 3))
     net.train()
-    opt = paddle.optimizer.SGD(learning_rate=0.1,
+    # lr=0 freezes weights: any loss variation across steps is the mask
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
                                parameters=net.parameters())
-    with pytest.raises(NotImplementedError):
-        jit_train_step(net, paddle.nn.CrossEntropyLoss(), opt)
+    step = jit_train_step(net, paddle.nn.CrossEntropyLoss(), opt)
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(16, 6).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 3, (16,)).astype(np.int64))
+    losses = [float(step(x, y)) for _ in range(4)]
+    assert len({round(v, 8) for v in losses}) > 1, \
+        f"identical losses every step — dropout mask was baked: {losses}"
+
+
+def test_jit_train_step_dropout_seed_deterministic():
+    rng = np.random.RandomState(6)
+    x = paddle.to_tensor(rng.randn(8, 6).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 3, (8,)).astype(np.int64))
+
+    def run():
+        paddle.seed(99)
+        net = nn.Sequential(nn.Linear(6, 32), nn.Dropout(0.5),
+                            nn.Linear(32, 3))
+        net.train()
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters())
+        step = jit_train_step(net, paddle.nn.CrossEntropyLoss(), opt)
+        return [float(step(x, y)) for _ in range(3)]
+
+    assert run() == run()
+
+
+def test_jit_train_step_tuple_inputs_and_labels():
+    """Multi-input models: step((ids, mask), (y1, y2)) runs model(*x)
+    and hands loss_fn the label tuple."""
+    paddle.seed(31)
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(6, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) + self.fc(b)
+
+    net = TwoIn()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+
+    def loss_fn(out, ys):
+        y1, y2 = ys
+        return ((out - y1) ** 2).mean() + ((out - y2) ** 2).mean()
+
+    step = jit_train_step(net, loss_fn, opt)
+    rng = np.random.RandomState(7)
+    a = paddle.to_tensor(rng.randn(8, 6).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(8, 6).astype(np.float32))
+    y1 = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y2 = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    l0 = float(step((a, b), (y1, y2)))
+    for _ in range(10):
+        l1 = float(step((a, b), (y1, y2)))
+    assert l1 < l0
+
+
+def test_jit_train_step_bert_qa_finetune_compiled():
+    """BASELINE config 3 lane: BERT (tiny dims, real dropout) SQuAD-style
+    QA fine-tune runs entirely through the compiled step with AMP O1 and
+    the loss trajectory tracks the eager loop (dropout-off lane compared
+    exactly; dropout-on lane must keep training)."""
+    from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64, dropout_prob=0.1)
+    rng = np.random.RandomState(8)
+    ids = paddle.to_tensor(rng.randint(0, 128, (4, 16)).astype(np.int64))
+    tt = paddle.to_tensor(np.zeros((4, 16), np.int64))
+    mask = paddle.to_tensor(np.ones((4, 16), np.float32))
+    start = paddle.to_tensor(rng.randint(0, 16, (4,)).astype(np.int64))
+    end = paddle.to_tensor(rng.randint(0, 16, (4,)).astype(np.int64))
+    ce = paddle.nn.CrossEntropyLoss()
+
+    def qa_loss(out, ys):
+        s_logits, e_logits = out
+        s_y, e_y = ys
+        return (ce(s_logits, s_y) + ce(e_logits, e_y)) * 0.5
+
+    paddle.seed(55)
+    net = BertForQuestionAnswering(cfg)
+    net.train()
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=net.parameters())
+    step = jit_train_step(net, qa_loss, opt, amp_level="O1")
+    losses = [float(step((ids, tt, mask), (start, end))) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+    # dropout-off: compiled matches the eager loop closely (fp32 lane)
+    cfg0 = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=64,
+                      max_position_embeddings=64, dropout_prob=0.0)
+    paddle.seed(56)
+    net_c = BertForQuestionAnswering(cfg0)
+    paddle.seed(56)
+    net_e = BertForQuestionAnswering(cfg0)
+    _sync(net_c, net_e)
+    opt_c = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net_c.parameters())
+    opt_e = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net_e.parameters())
+    step_c = jit_train_step(net_c, qa_loss, opt_c)
+    for i in range(3):
+        lc = float(step_c((ids, tt, mask), (start, end)))
+        s_log, e_log = net_e(ids, tt, mask)
+        le_t = qa_loss((s_log, e_log), (start, end))
+        le = float(le_t)
+        le_t.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        assert abs(lc - le) < 5e-4, (i, lc, le)
