@@ -49,6 +49,25 @@ def init_parallel_env(*args, **kwargs):
             coordinator_address=addr,
             num_processes=n_procs,
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        # Replicated-parameter contract: every process must draw the
+        # SAME initial values (device_put of a host array to a
+        # process-spanning sharding verifies replication).  Each
+        # process boots with independent entropy, so align the chains
+        # on rank 0's seed — the analog of the reference's
+        # seed-broadcast in its hybrid-parallel bootstrap
+        # (fleet/meta_parallel/__init__.py RNG tracker seeding).
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        from ..framework import random as _random
+        # broadcast rank 0's CURRENT chain state (not its seed): a
+        # manual_seed here would rewind rank 0 and replay the keys its
+        # weight inits already consumed — correlated randomness
+        state0 = _np.asarray(
+            _random.default_generator.get_state(), _np.uint32)
+        shared = _np.asarray(
+            multihost_utils.broadcast_one_to_all(state0))
+        _random.default_generator.set_state(shared)
+        _np.random.seed(int(shared.ravel()[-1]) % (2 ** 32))
     _initialized[0] = True
     return ParallelEnv()
 

@@ -118,12 +118,21 @@ class HybridCommunicateGroup:
         self._mp_degree = self._topo.get_dim("model")
 
         # Build/install the global device mesh with matching axis order.
+        # Multi-process (one jax process per pod host): the DCN/ICI-
+        # aware layout keeps mp/sep inside a host; dp/pp/sharding carry
+        # the cross-host factors (mesh.build_pod_mesh).
         axis_dims = {}
         for name in names:
             axis_dims[_AXIS_ALIAS[name]] = self._topo.get_dim(name)
         try:
-            self._mesh = _mesh.build_global_mesh(axis_dims)
+            self._mesh = _mesh.build_pod_mesh(axis_dims)
         except ValueError:
+            import jax
+            if jax.process_count() > 1:
+                # in a REAL multi-process run a mesh that cannot be
+                # assembled is a misconfiguration; swallowing it would
+                # let every process train a disconnected local copy
+                raise
             # topology larger than local devices (multi-host declared but
             # running locally): fall back to a virtual mesh over what we
             # have for the axes that fit

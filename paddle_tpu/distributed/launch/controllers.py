@@ -112,40 +112,89 @@ class Controller:
 
 
 class CollectiveController(Controller):
-    """One container driving all local TPU chips; multi-node wires the
-    jax.distributed coordination env (reference collective.py:31)."""
+    """One container per local worker process; multi-node wires the
+    jax.distributed coordination env (reference collective.py:31).
+
+    Pod topology (reference launch/controllers/collective.py — the
+    trainer-rank/endpoint assembly): the global world is
+    ``nnodes × nproc_per_node`` processes; this host's node rank comes
+    from ``--rank`` (or PADDLE_TRAINER_ID), each local worker ``j``
+    gets global rank ``node_rank * nproc_per_node + j``.  The
+    coordinator address is ``--master``, or derived from the first
+    entry of ``--ips`` — the reference's "first trainer is the master"
+    convention.  On a TPU pod the normal shape is one process per host
+    (``nproc_per_node=1``, SPMD over all local chips);
+    ``nproc_per_node>1`` is the CPU-hosts / test shape.
+    """
+
+    def _master(self, world: int):
+        args = self.args
+        if args.master:
+            return args.master
+        if args.ips:
+            first = args.ips.split(",")[0].strip()
+            return first if ":" in first else f"{first}:8701"
+        if world > 1 and self.job.replicas_min == 1:
+            # single node, several local workers: rendezvous locally.
+            # Bind-then-close has a TOCTOU window before worker rank
+            # 0's coordinator rebinds the port; acceptable for the
+            # local-test shape (real pods pass --master explicitly)
+            import socket
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return f"127.0.0.1:{s.getsockname()[1]}"
+        return None
 
     def build_pod(self):
         args = self.args
         self.pod = Pod(name=f"{self.job.id}-pod")
         self.pod.restart_count = self.restart_count
         nnodes = self._world
-        env = {
-            # operator-preset coordination env wins in the single-node
-            # path (per-host launches with external coordination)
-            "PADDLE_TRAINERS_NUM": os.environ.get(
-                "PADDLE_TRAINERS_NUM", str(nnodes))
-            if nnodes == 1 else str(nnodes),
+        nproc = args.nproc_per_node or 1
+        world = nnodes * nproc
+        node_rank = args.rank if args.rank >= 0 else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        master = self._master(world)
+        if world > 1 and not master:
+            raise SystemExit(
+                "--master host:port (or --ips) is required for "
+                "multi-node")
+        endpoints = None
+        if args.ips:
+            hosts = [h.strip().split(":")[0]
+                     for h in args.ips.split(",")]
+            endpoints = ",".join(
+                f"{h}:{6170 + j}" for h in hosts for j in range(nproc))
+        base = {
             "PADDLE_JOB_ID": self.job.id,
             "PADDLE_RESTART_COUNT": str(self.restart_count),
+            "PADDLE_NNODES": str(nnodes),
+            "PADDLE_LOCAL_SIZE": str(nproc),
         }
-        if nnodes > 1:
-            if not args.master:
-                raise SystemExit(
-                    "--master host:port is required for multi-node")
-            rank = args.rank if args.rank >= 0 else int(
-                os.environ.get("PADDLE_TRAINER_ID", "0"))
-            # distributed/env.py's init_parallel_env reads
-            # PADDLE_MASTER / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID
-            # and feeds them to jax.distributed.initialize
-            env["PADDLE_MASTER"] = args.master
-            env["PADDLE_TRAINER_ID"] = str(rank)
-        else:
-            env["PADDLE_TRAINER_ID"] = os.environ.get(
-                "PADDLE_TRAINER_ID", "0")
-        out = os.path.join(args.log_dir, f"workerlog.0")
-        self.pod.add_container(
-            [sys.executable, args.training_script,
-             *args.training_script_args],
-            env=env, out=out if getattr(args, "log_to_file", False)
-            else None)
+        if endpoints:
+            base["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+        for j in range(nproc):
+            env = dict(base)
+            if world > 1:
+                # distributed/env.py's init_parallel_env reads
+                # PADDLE_MASTER / PADDLE_TRAINERS_NUM /
+                # PADDLE_TRAINER_ID and feeds them to
+                # jax.distributed.initialize
+                env["PADDLE_TRAINERS_NUM"] = str(world)
+                env["PADDLE_MASTER"] = master
+                env["PADDLE_TRAINER_ID"] = str(node_rank * nproc + j)
+            else:
+                # operator-preset coordination env wins in the
+                # single-worker path (per-host launches with external
+                # coordination)
+                env["PADDLE_TRAINERS_NUM"] = os.environ.get(
+                    "PADDLE_TRAINERS_NUM", "1")
+                env["PADDLE_TRAINER_ID"] = os.environ.get(
+                    "PADDLE_TRAINER_ID", "0")
+            env["PADDLE_RANK_IN_NODE"] = str(j)
+            out = os.path.join(args.log_dir, f"workerlog.{j}")
+            self.pod.add_container(
+                [sys.executable, args.training_script,
+                 *args.training_script_args],
+                env=env, out=out if getattr(args, "log_to_file", False)
+                else None)

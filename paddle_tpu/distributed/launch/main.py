@@ -42,11 +42,39 @@ def _parse(argv=None):
 
 def launch(argv=None):
     args = _parse(argv)
+    # reference convention: `--ips h1,h2,...` alone declares the node
+    # set — the world size is len(ips), the master is ips[0], and this
+    # host's node rank is its position in the list (matched against
+    # local addresses; --rank / PADDLE_TRAINER_ID override)
+    if args.ips and args.nnodes == "1":
+        args.nnodes = str(len(args.ips.split(",")))
+    if args.ips and args.rank < 0 and \
+            "PADDLE_TRAINER_ID" not in os.environ:
+        rank = _infer_node_rank(args.ips)
+        if rank is not None:
+            args.rank = rank
     os.makedirs(args.log_dir, exist_ok=True)
     controller = CollectiveController(args)
     rc = controller.run()
     if rc != 0:
         raise SystemExit(rc)
+
+
+def _infer_node_rank(ips: str):
+    """Best-effort: find this host in the --ips list."""
+    import socket
+    hosts = [h.strip().split(":")[0] for h in ips.split(",")]
+    local = {"127.0.0.1", "localhost"}
+    try:
+        name = socket.gethostname()
+        local.add(name)
+        local.update(socket.gethostbyname_ex(name)[2])
+    except OSError:
+        pass
+    for i, h in enumerate(hosts):
+        if h in local:
+            return i
+    return None
 
 
 def main():

@@ -279,8 +279,11 @@ class StaticFunction:
             from ..framework import random as framework_random
             self._rng_root = framework_random.draw_step_root()
         from ..framework.random import make_step_key
-        rng_t = wrap_array(jnp.asarray(
-            make_step_key(self._rng_root, self._rng_count)))
+        # the raw uint32[2] host array goes straight into the jitted
+        # call (device_put happens at dispatch with the other args) —
+        # no eager H2D transfer on the hot path
+        rng_t = wrap_array(make_step_key(self._rng_root,
+                                         self._rng_count))
         self._rng_count += 1
         try:
             outs = apply("to_static", jfn, *p_tensors, *tensor_args,

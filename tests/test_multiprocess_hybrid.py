@@ -1,0 +1,171 @@
+"""Hybrid parallelism across REAL process boundaries (round-3 verdict
+item 1): two OS processes launched via ``paddle_tpu.distributed.launch``
+rendezvous through jax.distributed and run the actual fleet APIs — TP
+(Column/RowParallelLinear + distributed_optimizer), ZeRO stage-2
+(group_sharded_parallel "os_g"), and the compiled 1F1B pipeline (pp=2,
+one stage per process) — over a process-spanning global mesh.  Rank 0's
+loss trajectories must match single-process references computed here.
+
+Reference model: test/collective/fleet/hybrid_parallel_mp_layers.py,
+hybrid_parallel_pp_embedding.py, dygraph_group_sharded_stage2.py, all
+driven by test_dist_base.py:952-style spawned parity runs.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "workers", "hybrid_axes_worker.py")
+
+STEPS = 4
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _tp_reference():
+    """Dense single-process run of the worker's mp=2 model."""
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(8, 16).astype(np.float32) * 0.3
+    b1 = rng.randn(16).astype(np.float32) * 0.1
+    w2 = rng.randn(16, 4).astype(np.float32) * 0.3
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randn(4, 4).astype(np.float32)
+    lin1 = nn.Linear(8, 16)
+    lin2 = nn.Linear(16, 4, bias_attr=False)
+    lin1.weight.set_value(paddle.to_tensor(w1))
+    lin1.bias.set_value(paddle.to_tensor(b1))
+    lin2.weight.set_value(paddle.to_tensor(w2))
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=list(lin1.parameters()) + list(lin2.parameters()))
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    losses = []
+    for _ in range(STEPS):
+        loss = ((lin2(lin1(xt)) - yt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _zero2_reference():
+    rng = np.random.RandomState(1)
+    net = nn.Sequential(nn.Linear(16, 16), nn.Tanh(), nn.Linear(16, 1))
+    for _, p in net.named_parameters():
+        p.set_value(paddle.to_tensor(
+            (rng.randn(*p.shape) * 0.2).astype(np.float32)))
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 1).astype(np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    losses = []
+    for _ in range(STEPS):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _pp_reference():
+    """Eager microbatched run with the worker's seed-400 weights."""
+    import paddle_tpu.nn.functional as F
+
+    H, B, MB = 8, 8, 2
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(H, H)
+
+        def forward(self, t):
+            return F.tanh(self.fc(t))
+
+    paddle.seed(400)
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        LayerDesc, PipelineLayer)
+    descs = [LayerDesc(Block) for _ in range(4)]
+    pipe = PipelineLayer(descs, num_stages=2,
+                         loss_fn=lambda o, y: ((o - y) ** 2).mean())
+    blocks = list(pipe.run_function)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=[p for b in blocks for p in b.parameters()])
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(B, H).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(B, H).astype(np.float32))
+    n_mb = B // MB
+    losses = []
+    for _ in range(STEPS):
+        mbs = []
+        for i in range(n_mb):
+            h = x[i * MB:(i + 1) * MB]
+            for b in blocks:
+                h = b(h)
+            l = ((h - y[i * MB:(i + 1) * MB]) ** 2).mean()
+            (l / n_mb).backward()
+            mbs.append(float(l))
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.mean(mbs)))
+    return losses
+
+
+@pytest.mark.timeout(420)
+def test_fleet_tp_pp_zero2_across_process_boundaries(tmp_path):
+    port = _free_port()
+    out = tmp_path / "rank0.json"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # one CPU device per process
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = []
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+             "--rank", str(rank), "--job_id", "hybrid2p",
+             "--max_restart", "0", "--log_dir", str(tmp_path),
+             WORKER, str(out)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=360)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(stdout.decode(errors="replace"))
+    for p, text in zip(procs, outputs):
+        assert p.returncode == 0, text[-3000:]
+
+    data = json.loads(out.read_text())
+
+    # TP: fleet mp=2 over two processes == dense single-process
+    np.testing.assert_allclose(data["tp"], _tp_reference(), atol=1e-4)
+    # ZeRO-2: states+grads sharded cross-process == plain AdamW
+    np.testing.assert_allclose(data["zero2"], _zero2_reference(),
+                               atol=1e-4)
+    # PP: compiled 1F1B with one stage per process == eager microbatch
+    np.testing.assert_allclose(data["pp"], _pp_reference(), atol=1e-4)
+    # and the pipeline genuinely spanned both processes
+    assert data["pp_procs"] == [0, 1]

@@ -1,0 +1,120 @@
+"""Worker for the multi-host pod-launch test: TWO launch controllers
+(emulated hosts) × --nproc_per_node 2 → a 4-process world.  Verifies
+the launcher's rank/env assembly and the DCN/ICI-aware pod mesh:
+fleet.init(dp=2, mp=2) must put the mp axis WITHIN a node (processes
+{0,1} and {2,3}) and dp across nodes, then a dp×mp hybrid train step
+over the process-spanning mesh must match the dense single-process
+run.
+
+Reference: launch/controllers/collective.py (trainer rank/endpoint
+assembly), fleet/base/topology.py:65 (rank topology).
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.fleet.base.distributed_strategy import (  # noqa: E402
+    DistributedStrategy)
+
+STEPS = 3
+B, IN, HID, OUT = 8, 8, 16, 4
+
+
+def main():
+    out_path = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    assert jax.process_count() == 4, jax.process_count()
+    assert dist.get_world_size() == 4
+    # launcher env assembly
+    assert os.environ["PADDLE_LOCAL_SIZE"] == "2"
+    assert os.environ["PADDLE_NNODES"] == "2"
+    node_rank = rank // 2
+    assert int(os.environ["PADDLE_RANK_IN_NODE"]) == rank % 2
+    assert rank == jax.process_index()
+
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+
+    from paddle_tpu.distributed import mesh as mesh_mod
+    mesh = mesh_mod.get_global_mesh()
+    assert mesh.shape["dp"] == 2 and mesh.shape["mp"] == 2
+    dev = mesh.devices.reshape(2, 2)    # [dp, mp]
+    mp_groups = [sorted(d.process_index for d in row) for row in dev]
+    dp_groups = [sorted(d.process_index for d in dev[:, j])
+                 for j in range(2)]
+
+    # the hybrid step: weights mp-sharded, batch dp-sharded
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+    from paddle_tpu.tensor.tensor import wrap_array
+
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(IN, HID).astype(np.float32) * 0.3
+    b1 = rng.randn(HID).astype(np.float32) * 0.1
+    w2 = rng.randn(HID, OUT).astype(np.float32) * 0.3
+    x = rng.randn(B, IN).astype(np.float32)
+    y = rng.randn(B, OUT).astype(np.float32)
+
+    col = ColumnParallelLinear(IN, HID, gather_output=False)
+    row = RowParallelLinear(HID, OUT, input_is_parallel=True,
+                            has_bias=False)
+    col.weight.set_value(paddle.to_tensor(w1))
+    col.bias.set_value(paddle.to_tensor(b1))
+    row.weight.set_value(paddle.to_tensor(w2))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(
+            learning_rate=0.1,
+            parameters=list(col.parameters()) + list(row.parameters())))
+
+    # this process's dp shard of the global batch (procs sharing a dp
+    # index feed identical rows; jax assembles the global array)
+    spec = P("dp")
+    dp_idx = None
+    # find my dp coordinate from the mesh layout
+    for i in range(2):
+        for j in range(2):
+            if dev[i, j].process_index == rank:
+                dp_idx = i
+    half = B // 2
+    loc = x[dp_idx * half:(dp_idx + 1) * half]
+    locy = y[dp_idx * half:(dp_idx + 1) * half]
+    gx = multihost_utils.host_local_array_to_global_array(loc, mesh, spec)
+    gy = multihost_utils.host_local_array_to_global_array(locy, mesh,
+                                                          spec)
+    xt, yt = wrap_array(gx), wrap_array(gy)
+
+    losses = []
+    for _ in range(STEPS):
+        loss = ((row(col(xt)) - yt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"losses": losses, "mp_groups": mp_groups,
+                       "dp_groups": dp_groups,
+                       "node_rank": node_rank}, f)
+
+
+if __name__ == "__main__":
+    main()
