@@ -67,7 +67,9 @@ def init_parallel_env(*args, **kwargs):
         shared = _np.asarray(
             multihost_utils.broadcast_one_to_all(state0))
         _random.default_generator.set_state(shared)
-        _np.random.seed(int(shared.ravel()[-1]) % (2 ** 32))
+        # np.random is deliberately NOT reseeded: per-rank numpy streams
+        # carry data-pipeline diversity (augmentation, sampling); only
+        # the framework chain must agree for replicated param init
     _initialized[0] = True
     return ParallelEnv()
 

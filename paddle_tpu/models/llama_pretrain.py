@@ -246,19 +246,35 @@ def _rope(q, k, theta):
     return rot(q), rot(k)
 
 
-def _attention(q, k, v, cfg, mesh=None):
+def _attention(q, k, v, cfg, mesh=None, seg=None):
     """Causal attention [b, s, n, d].  Routes to context-parallel
     attention over the sep axis when configured, else the Pallas flash
-    kernel when registered (ops/pallas), else the fused XLA composite."""
+    kernel when registered (ops/pallas), else the fused XLA composite.
+
+    ``seg`` [b, s] int32 enables PACKED-pretrain attention: sequences
+    concatenated along s attend only within their own segment, via the
+    block-skipping segmented flash kernel (ops/pallas/flash_varlen.py
+    — the reference's flash_attn_unpadded/varlen path)."""
     from ..ops.dispatch import get_op_impl
     from ..flags import flags
     if cfg.context_parallel and mesh is not None and \
             mesh.shape.get("sep", 1) > 1:
+        if seg is not None:
+            raise NotImplementedError(
+                "packed segment attention with context parallelism is "
+                "not supported; use sep for single long sequences")
         from ..distributed.parallel.context_parallel import (
             ring_attention, ulysses_attention)
         cp = ring_attention if cfg.context_parallel == "ring" \
             else ulysses_attention
         return cp(q, k, v, mesh, axis="sep", causal=True)
+    if seg is not None:
+        from ..ops.pallas.flash_varlen import (
+            flash_attention_segmented, xla_segmented_sdpa)
+        if cfg.use_pallas_attention and flags.FLAGS_pallas_flash_attention:
+            return flash_attention_segmented(q, k, v, seg, causal=True)
+        return xla_segmented_sdpa(q, k, v, jnp.asarray(seg, jnp.int32),
+                                  True)
     impl = get_op_impl("flash_attention", None)
     if impl is not None and cfg.use_pallas_attention and \
             flags.FLAGS_pallas_flash_attention:
@@ -315,16 +331,16 @@ def _block_post_attn(bp: Dict[str, Any], x, attn,
 
 
 def _block_forward(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig,
-                   mesh: Optional[Mesh] = None):
+                   mesh: Optional[Mesh] = None, seg=None):
     """One transformer block; x [b, s, h] in compute dtype."""
     q, k, v = _block_pre_attn(bp, x, cfg)
-    attn = _attention(q, k, v, cfg, mesh)
+    attn = _attention(q, k, v, cfg, mesh, seg)
     return _block_post_attn(bp, x, attn, cfg)
 
 
 def _block_forward_flash_saved(bp: Dict[str, Any], x,
                                cfg: LlamaPretrainConfig,
-                               mesh: Optional[Mesh] = None):
+                               mesh: Optional[Mesh] = None, seg=None):
     """Block forward where only the projections/FFN are rematerialised.
 
     The flash-attention call sits OUTSIDE the two checkpoint regions, so
@@ -340,7 +356,7 @@ def _block_forward_flash_saved(bp: Dict[str, Any], x,
     post = jax.checkpoint(
         lambda bp, x, attn: _block_post_attn(bp, x, attn, cfg))
     q, k, v = pre(bp, x)
-    attn = _attention(q, k, v, cfg, mesh)
+    attn = _attention(q, k, v, cfg, mesh, seg)
     return post(bp, x, attn)
 
 
@@ -363,7 +379,7 @@ def _remat_wrap(fwd, cfg):
     return jax.checkpoint(fwd, static_argnums=(2, 3))
 
 
-def _trunk_scan(blocks, x, cfg, mesh):
+def _trunk_scan(blocks, x, cfg, mesh, seg=None):
     """pp == 1: scan over the layer-stacked block params with remat."""
     fwd = _remat_wrap(_block_forward, cfg)
     # Megatron-SP activation constraints are a TPU optimisation; XLA:CPU's
@@ -375,7 +391,7 @@ def _trunk_scan(blocks, x, cfg, mesh):
              jax.default_backend() != "cpu")
 
     def step(carry, bp):
-        out = fwd(bp, carry, cfg, mesh)
+        out = fwd(bp, carry, cfg, mesh, seg)
         if sp_on:
             out = jax.lax.with_sharding_constraint(
                 out, NamedSharding(mesh, P("dp", "mp", None)))
@@ -414,10 +430,25 @@ def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
                  pp: int = 1, microbatches: int = 1, vpp: int = 1):
     """Returns pure fn(params, tokens[B,S]) -> logits or loss parts."""
 
-    def forward_loss(params, tokens):
+    def forward_loss(params, tokens, segment_ids=None):
+        """``segment_ids`` [B, S] enables packed pretraining: attention
+        stays within segments (segmented flash kernel) and the loss
+        masks the cross-segment boundary targets — the last token of a
+        packed sequence must not be trained to predict the next
+        sequence's first token (reference: packed/varlen pretrain over
+        flash_attn_unpadded)."""
         dt = cfg.dtype
         inputs = tokens[:, :-1]
         targets = tokens[:, 1:]
+        seg_in = seg_tg = None
+        if segment_ids is not None:
+            if pp > 1:
+                raise NotImplementedError(
+                    "packed segment pretraining with pp > 1 is not "
+                    "supported yet")
+            seg_all = jnp.asarray(segment_ids, jnp.int32)
+            seg_in = seg_all[:, :-1]
+            seg_tg = seg_all[:, 1:]
         x = jnp.take(params["embed"], inputs, axis=0).astype(dt)
         cp_on = False
         if mesh is not None:
@@ -442,15 +473,27 @@ def make_forward(cfg: LlamaPretrainConfig, mesh: Optional[Mesh] = None,
                                 vpp)
             x = x.reshape(B, *x.shape[2:])
         else:
-            x = _trunk_scan(params["blocks"], x, cfg, mesh)
+            x = _trunk_scan(params["blocks"], x, cfg, mesh, seg_in)
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-        if cfg.loss_chunks > 1:
+        if cfg.loss_chunks > 1 and seg_in is not None:
+            import warnings
+            warnings.warn(
+                "packed segment pretraining uses the unchunked loss "
+                "head (masked chunked CE not implemented); at large "
+                "vocab this materialises full [B,S,V] logits",
+                stacklevel=2)
+        if cfg.loss_chunks > 1 and seg_in is None:
             from ..ops.chunked_loss import chunked_softmax_cross_entropy
             return chunked_softmax_cross_entropy(
                 x, params["lm_head"], targets, cfg.loss_chunks, dt)
         logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, -1)
         ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        if seg_in is not None:
+            # mask boundary targets AND padding (negative segment ids)
+            valid = jnp.logical_and(seg_in == seg_tg, seg_tg >= 0)
+            valid = valid.astype(jnp.float32)
+            return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
         return -jnp.mean(ll)
 
     return forward_loss

@@ -761,12 +761,18 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
                                 dropout=0.0, causal=False,
                                 return_softmax=False, name=None):
     """Variable-length packed flash attention over concatenated sequences
-    (reference: flash_attn_unpadded / flash_attn_varlen_qkvpacked).  The
-    ragged batch is processed per sequence via the dense kernel — correct
-    and simple; the padded+masked route is preferable for TPU batching.
-    QKV-packed means q and k share segment boundaries: mismatched
-    cu_seqlens are rejected rather than silently mis-segmented."""
-    from . import scaled_dot_product_attention
+    (reference: flash_attn_unpadded / flash_attn_varlen_qkvpacked,
+    nn/functional/flash_attention.py:455 → CUDA varlen kernels).
+
+    TPU-native: the whole ragged batch runs as ONE segment-aware Pallas
+    flash program (ops/pallas/flash_varlen.py) — cu_seqlens become
+    segment ids, the kernel skips k blocks outside each q block's
+    segments, and padding rows (to reach a blockable length) carry a
+    sentinel id and are sliced off.  ``dropout > 0`` falls back to a
+    per-sequence dense loop (attention-prob dropout is incompatible
+    with the online-softmax kernel).  QKV-packed means q and k share
+    segment boundaries: mismatched cu_seqlens are rejected rather than
+    silently mis-segmented."""
     qkv = as_tensor(qkv)
     cu = np.asarray(as_tensor(cu_seqlens_q).numpy()).astype(np.int64)
     cu_k = np.asarray(as_tensor(cu_seqlens_k).numpy()).astype(np.int64)
@@ -775,19 +781,45 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
             "qkv-packed varlen attention requires cu_seqlens_q == "
             "cu_seqlens_k (q/k come from the same packed tensor)")
     D = qkv.shape[-1]
-    outs = []
-    for i in range(len(cu) - 1):
-        seg = qkv[int(cu[i]):int(cu[i + 1])]
-        q, k, v = seg[:, 0][None], seg[:, 1][None], seg[:, 2][None]
+    if dropout:
+        from . import scaled_dot_product_attention
+        outs = []
+        for i in range(len(cu) - 1):
+            seg = qkv[int(cu[i]):int(cu[i + 1])]
+            q, k, v = seg[:, 0][None], seg[:, 1][None], seg[:, 2][None]
+            if scale is not None:
+                q = q * (scale * math.sqrt(D))
+            outs.append(scaled_dot_product_attention(
+                q, k, v, is_causal=causal, dropout_p=dropout)[0])
+        from ...tensor.manipulation import concat
+        return (concat(outs, axis=0), None) if return_softmax \
+            else concat(outs, axis=0)
+
+    from ...ops.pallas.flash_varlen import (
+        flash_attention_segmented, segment_ids_from_cu_seqlens)
+
+    total = int(cu[-1])
+    # pad to a kernel-blockable length with a sentinel segment
+    pad = (-total) % 128 if total >= 128 else (128 - total)
+    seg_np = np.asarray(segment_ids_from_cu_seqlens(
+        jnp.asarray(cu, jnp.int32), total))
+    seg_full = np.concatenate(
+        [seg_np, np.full((pad,), -1, np.int32)])[None]
+
+    def fn(packed):
+        p = packed
         if scale is not None:
-            # sdpa applies 1/sqrt(D); pre-scale q so the effective
-            # softmax scale is the caller's
-            q = q * (scale * math.sqrt(D))
-        outs.append(scaled_dot_product_attention(
-            q, k, v, is_causal=causal, dropout_p=dropout)[0])
-    from ...tensor.manipulation import concat
-    return (concat(outs, axis=0), None) if return_softmax \
-        else concat(outs, axis=0)
+            # the kernel applies 1/sqrt(D); pre-scale q for caller scale
+            p = p.at[:, 0].multiply(scale * math.sqrt(D))
+        if pad:
+            p = jnp.pad(p, ((0, pad), (0, 0), (0, 0), (0, 0)))
+        q, k, v = p[None, :, 0], p[None, :, 1], p[None, :, 2]
+        out = flash_attention_segmented(
+            q, k, v, jnp.asarray(seg_full), causal=causal)
+        return out[0, :total]
+
+    out = apply("flash_attn_varlen", fn, qkv)
+    return (out, None) if return_softmax else out
 
 
 def flash_attention_with_sparse_mask(query, key, value,

@@ -1,0 +1,173 @@
+"""Segment-aware (varlen/ragged) flash attention (round-3 verdict item
+4): the block-skipping Pallas kernel must match the dense-mask XLA
+oracle forward AND backward on ragged packed batches, and the public
+``flash_attn_varlen_qkvpacked`` must run the whole ragged batch as one
+fused program (no per-sequence Python loop) while agreeing with the
+loop's math.  Packed pretraining through the flagship forward is
+checked against independently-computed per-sequence losses.
+
+Reference: python/paddle/nn/functional/flash_attention.py:455
+(flash_attn_unpadded → CUDA varlen kernels).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.pallas.flash_varlen import (
+    flash_attention_segmented, segment_ids_from_cu_seqlens,
+    xla_segmented_sdpa)
+
+
+def _ragged_seg(lens, S):
+    cu = np.cumsum([0] + list(lens))
+    assert cu[-1] <= S
+    seg = np.asarray(segment_ids_from_cu_seqlens(
+        jnp.asarray(cu, jnp.int32), int(cu[-1])))
+    return np.concatenate([seg, np.full(S - cu[-1], -1, np.int32)])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("lens", [
+    [40, 24, 8, 56],            # exactly fills S=128
+    [100, 28],                  # two long
+    [8] * 16,                   # many short: block skip regime
+])
+def test_segmented_kernel_parity(causal, lens):
+    B, S, H, D = 1, 128, 2, 16
+    rng = np.random.RandomState(hash((causal, tuple(lens))) % 2**31)
+    seg = _ragged_seg(lens, S)[None]
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    segj = jnp.asarray(seg)
+
+    out = flash_attention_segmented(q, k, v, segj, causal=causal)
+    ref = xla_segmented_sdpa(q, k, v, segj, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+    g = jax.grad(lambda *a: (flash_attention_segmented(
+        *a, segj, causal=causal) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (xla_segmented_sdpa(
+        *a, segj, causal) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4)
+
+
+def test_segmented_kernel_batched_rows():
+    """Segment layouts differing per batch row."""
+    B, S, H, D = 2, 64, 2, 8
+    rng = np.random.RandomState(3)
+    seg = np.stack([_ragged_seg([20, 30, 14], S),
+                    _ragged_seg([64], S)])
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    out = flash_attention_segmented(q, k, v, jnp.asarray(seg),
+                                    causal=True)
+    ref = xla_segmented_sdpa(q, k, v, jnp.asarray(seg), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_varlen_qkvpacked_matches_per_sequence_dense():
+    """The fused segmented program == per-sequence dense attention,
+    forward and backward through the tape, including an odd total that
+    needs padding and a caller-supplied scale."""
+    rng = np.random.RandomState(0)
+    lens = [10, 27, 5, 33]        # total 75: exercises padding to 128
+    total = sum(lens)
+    H, D = 4, 8
+    qkv_np = rng.randn(total, 3, H, D).astype(np.float32)
+    cu = paddle.to_tensor(np.cumsum([0] + lens).astype(np.int64))
+
+    qkv = paddle.to_tensor(qkv_np)
+    qkv.stop_gradient = False
+    out = F.flash_attn_varlen_qkvpacked(qkv, cu, cu, max(lens),
+                                        max(lens), causal=True)
+    assert tuple(out.shape) == (total, H, D)
+    out.sum().backward()
+    grad = qkv.grad.numpy()
+
+    # oracle: each sequence separately through sdpa + autodiff
+    off = 0
+    for ln in lens:
+        seg = qkv_np[off:off + ln]
+        st = paddle.to_tensor(seg)
+        st.stop_gradient = False
+        o = F.scaled_dot_product_attention(
+            st[:, 0][None], st[:, 1][None], st[:, 2][None],
+            is_causal=True)[0]
+        np.testing.assert_allclose(out.numpy()[off:off + ln],
+                                   o.numpy(), atol=2e-5)
+        o.sum().backward()
+        np.testing.assert_allclose(grad[off:off + ln],
+                                   st.grad.numpy(), atol=5e-4)
+        off += ln
+
+    # caller scale: equals pre-scaling q by scale*sqrt(D)
+    s = 0.5
+    out_s = F.flash_attn_varlen_qkvpacked(
+        paddle.to_tensor(qkv_np), cu, cu, max(lens), max(lens),
+        scale=s, causal=True)
+    qkv2 = qkv_np.copy()
+    qkv2[:, 0] *= s * np.sqrt(D)
+    out_ref = F.flash_attn_varlen_qkvpacked(
+        paddle.to_tensor(qkv2), cu, cu, max(lens), max(lens),
+        causal=True)
+    np.testing.assert_allclose(out_s.numpy(), out_ref.numpy(), atol=2e-5)
+
+
+def test_varlen_qkvpacked_rejects_mismatched_cu():
+    rng = np.random.RandomState(1)
+    qkv = paddle.to_tensor(rng.randn(16, 3, 2, 8).astype(np.float32))
+    cu_q = paddle.to_tensor(np.array([0, 8, 16], np.int64))
+    cu_k = paddle.to_tensor(np.array([0, 10, 16], np.int64))
+    with pytest.raises(ValueError):
+        F.flash_attn_varlen_qkvpacked(qkv, cu_q, cu_k, 8, 8)
+
+
+def test_packed_pretrain_loss_matches_separate_sequences():
+    """Flagship packed pretraining: one packed row with two sequences
+    (+padding) produces the token-weighted mean of the two separate
+    runs — proof that attention is segment-isolated and boundary/pad
+    targets are masked."""
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params,
+                                                  make_forward)
+    cfg = LlamaPretrainConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=2, max_seq_len=64, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1)
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    fwd = make_forward(cfg)
+
+    rng = np.random.RandomState(7)
+    la, lb = 20, 35
+    seq_a = rng.randint(0, 64, (la + 1,))
+    seq_b = rng.randint(0, 64, (lb + 1,))
+    S = 64
+    packed = np.zeros((1, S + 1), np.int64)
+    packed[0, :la + 1] = seq_a
+    packed[0, la + 1:la + lb + 2] = seq_b
+    seg = np.full((1, S + 1), -1, np.int32)
+    seg[0, :la + 1] = 0
+    seg[0, la + 1:la + lb + 2] = 1
+
+    loss_packed = float(fwd(params, jnp.asarray(packed),
+                            jnp.asarray(seg)))
+    # oracle: each sequence alone (loss = mean over its la/lb targets)
+    loss_a = float(fwd(params, jnp.asarray(seq_a[None])))
+    loss_b = float(fwd(params, jnp.asarray(seq_b[None])))
+    expect = (loss_a * la + loss_b * lb) / (la + lb)
+    np.testing.assert_allclose(loss_packed, expect, rtol=2e-5)

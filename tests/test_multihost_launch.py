@@ -72,8 +72,11 @@ def test_two_node_pod_launch_hybrid_dp_mp(tmp_path):
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
-    procs = []
+    # files not pipes: a filled 64KB pipe deadlocks ranks mid-collective
+    procs, logs = [], []
     for node in range(2):
+        lf = open(tmp_path / f"node{node}.log", "wb")
+        logs.append(lf)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              "--nnodes", "2", "--nproc_per_node", "2",
@@ -81,18 +84,19 @@ def test_two_node_pod_launch_hybrid_dp_mp(tmp_path):
              "--rank", str(node), "--job_id", "podtest",
              "--max_restart", "0", "--log_dir", str(tmp_path),
              WORKER, str(out)],
-            env=env, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    outputs = []
-    for p in procs:
-        try:
-            stdout, _ = p.communicate(timeout=360)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outputs.append(stdout.decode(errors="replace"))
-    for p, text in zip(procs, outputs):
+            env=env, cwd=REPO, stdout=lf, stderr=subprocess.STDOUT))
+    try:
+        for p in procs:
+            p.wait(timeout=360)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    finally:
+        for lf in logs:
+            lf.close()
+    for node, p in enumerate(procs):
+        text = (tmp_path / f"node{node}.log").read_text(errors="replace")
         assert p.returncode == 0, text[-3000:]
 
     data = json.loads(out.read_text())

@@ -136,26 +136,31 @@ def test_fleet_tp_pp_zero2_across_process_boundaries(tmp_path):
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
-    procs = []
+    # worker stdout goes to FILES, not pipes: a filled 64KB pipe blocks
+    # the writer mid-collective and deadlocks both ranks until timeout
+    procs, logs = [], []
     for rank in range(2):
+        lf = open(tmp_path / f"proc{rank}.log", "wb")
+        logs.append(lf)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              "--nnodes", "2", "--master", f"127.0.0.1:{port}",
              "--rank", str(rank), "--job_id", "hybrid2p",
              "--max_restart", "0", "--log_dir", str(tmp_path),
              WORKER, str(out)],
-            env=env, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    outputs = []
-    for p in procs:
-        try:
-            stdout, _ = p.communicate(timeout=360)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outputs.append(stdout.decode(errors="replace"))
-    for p, text in zip(procs, outputs):
+            env=env, cwd=REPO, stdout=lf, stderr=subprocess.STDOUT))
+    try:
+        for p in procs:
+            p.wait(timeout=360)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    finally:
+        for lf in logs:
+            lf.close()
+    for rank, p in enumerate(procs):
+        text = (tmp_path / f"proc{rank}.log").read_text(errors="replace")
         assert p.returncode == 0, text[-3000:]
 
     data = json.loads(out.read_text())
