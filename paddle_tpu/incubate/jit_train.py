@@ -16,10 +16,9 @@ Parameter objects so the model stays authoritative.
 Bounds (documented, loud):
 
 * ``grad_clip`` other than None/ClipGradByGlobalNorm is rejected.
-* Buffers (BatchNorm running stats) are passed in LIVE each step (so
-  eager refreshes are picked up) but their in-trace updates are not
-  written back — run periodic eager forwards when serving-quality
-  running stats matter.
+* Buffers (BatchNorm running stats) are passed in LIVE each step and
+  their in-trace updates are written back after it (round-4: the
+  compiled step now matches the eager loop's buffer semantics).
 * EVERY trainable parameter handed to the optimizer is updated every
   step.  A parameter unreached by ``loss_fn`` gets zero gradients
   (still decayed by AdamW etc.) — exclude it from the optimizer's
@@ -45,11 +44,17 @@ __all__ = ["jit_train_step"]
 
 
 def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
-                   amp_level: str = "O0", amp_dtype: str = "bfloat16"):
+                   amp_level: str = "O0", amp_dtype: str = "bfloat16",
+                   return_outputs: bool = False):
     """Compile ``loss_fn(model(x), y)`` + backward + ``optimizer`` into
     one jitted step.  Returns ``step(x, y) -> loss Tensor``; parameters
     and optimizer state live on device between calls.  ``x`` / ``y``
     may be tuples: ``model(*x)`` and ``loss_fn(out, y_tuple)``.
+    ``return_outputs=True`` makes the step return ``(loss, outputs)``
+    (the forward outputs, for metric computation — hapi's fit loop).
+    Buffer updates that happen inside the forward (BatchNorm running
+    stats) are carried out of the trace and written back onto the
+    Layer's buffers every step, matching the eager loop.
 
     ``amp_level``: "O0" (off) or "O1" — the eager autocast hook applies
     per-op inside the traced program (white/black lists identical to
@@ -115,10 +120,15 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
             with framework_random.traced_key_guard(rng):
                 with auto_cast(enable=(amp_level == "O1"), level="O1",
                                dtype=amp_dtype):
-                    out = model._functional_call({**pvals, **fvals},
-                                                 *xs, buffers=bvals)
+                    out, new_bufs = model._functional_call(
+                        {**pvals, **fvals}, *xs, buffers=bvals,
+                        return_buffers=True)
                     loss = loss_fn(out, yt)
-        return loss._data if isinstance(loss, Tensor) else loss
+        loss_arr = loss._data if isinstance(loss, Tensor) else loss
+        out_arrs = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        return loss_arr, (out_arrs, new_bufs)
 
     # optimizer states via _get_state: honors a prior set_state_dict
     # AND the multi_precision master-weight slot; leaves normalised to
@@ -168,10 +178,10 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
     # replacing p._data).
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def compiled(pvals, svals, fvals, bvals, x, y, lr, rng):
-        loss, grads = jax.value_and_grad(loss_of)(pvals, fvals, bvals,
-                                                  x, y, rng)
+        (loss, (outs, new_bufs)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(pvals, fvals, bvals, x, y, rng)
         new_p, new_s = update_all(pvals, svals, grads, lr)
-        return new_p, new_s, loss
+        return new_p, new_s, loss, outs, new_bufs
 
     state_box = {"s": states, "t": 0}
 
@@ -189,8 +199,8 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
         lr = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
         rng = framework_random.make_step_key(rng_root, state_box["t"])
         state_box["t"] += 1
-        new_p, new_s, loss = compiled(pvals, state_box["s"], fvals,
-                                      bvals, xv, yv, lr, rng)
+        new_p, new_s, loss, outs, new_bufs = compiled(
+            pvals, state_box["s"], fvals, bvals, xv, yv, lr, rng)
         for n in names:
             param_objs[n]._data = new_p[n]
         state_box["s"] = new_s
@@ -198,7 +208,14 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
         # checkpoints the jitted moments
         for n in names:
             optimizer._states[id(param_objs[n])] = new_s[n]
+        # write buffer updates (BatchNorm running stats) back — the
+        # eager loop refreshes them every forward, so must we
+        for n, arr in new_bufs.items():
+            buf_objs[n]._data = arr
         optimizer._step_count = getattr(optimizer, "_step_count", 0) + 1
+        if return_outputs:
+            return wrap_array(loss), jax.tree_util.tree_map(
+                wrap_array, outs)
         return wrap_array(loss)
 
     return step

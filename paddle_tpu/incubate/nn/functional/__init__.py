@@ -438,13 +438,130 @@ def fused_multi_transformer(
     return out
 
 
-def block_multihead_attention(*args, **kwargs):
-    """Paged/blocked KV-cache attention (reference:
-    incubate/nn/functional/block_multihead_attention.py — the vLLM-style
-    serving kernel).  The TPU serving path uses contiguous caches inside
-    jitted decode loops (models/ kv-cache attention); a paged-block table
-    has no benefit without the CUDA allocator it was built around."""
-    raise NotImplementedError(
-        "block_multihead_attention: use the contiguous kv-cache decode in "
-        "paddle_tpu.models / scaled_dot_product_attention — paged block "
-        "tables are a CUDA-allocator workaround with no TPU analog")
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets=None, cum_offsets=None,
+        cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
+        pre_key_cache=None, pre_value_cache=None,
+        cache_k_quant_scales=None, cache_v_quant_scales=None,
+        cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+        qkv_out_scale=None, qkv_bias=None, out_shift=None,
+        out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None,
+        tgt_mask=None, max_seq_len=-1, block_size=64,
+        use_neox_style=False, **kwargs):
+    """Paged (block-table) KV-cache attention — reference:
+    incubate/nn/functional/block_multihead_attention.py:19 (the
+    vLLM-style serving op over CUDA block-cache kernels).
+
+    TPU-native: the caches are page POOLS ``[num_pages, kv_heads,
+    block_size, head_dim]`` and the decode phase runs the
+    block-table-indexed Pallas kernel
+    (ops/pallas/paged_attention.paged_decode_attention) — HBM traffic
+    per row scales with its real context length.  The prefill (encoder)
+    phase runs the segmented varlen flash program over the packed
+    tokens (ops/pallas/flash_varlen).  See models/paged_decode.py for
+    the allocator + full generation loop.
+
+    Supported surface: ``qkv [T, 3, n, d]`` (or ``[T, 3*n*d]``), a
+    uniform phase per call — all-encoder (prefill) or all-decoder
+    (one token per row).  Quant scales / pre-caches / shift-smooth are
+    rejected loudly.  Returns ``(out [T, n, d], qkv, key_cache,
+    value_cache)`` like the reference.
+    """
+    for name, v in (("cache_k_quant_scales", cache_k_quant_scales),
+                    ("cache_v_quant_scales", cache_v_quant_scales),
+                    ("cache_k_dequant_scales", cache_k_dequant_scales),
+                    ("cache_v_dequant_scales", cache_v_dequant_scales),
+                    ("pre_key_cache", pre_key_cache),
+                    ("pre_value_cache", pre_value_cache),
+                    ("qkv_out_scale", qkv_out_scale),
+                    ("qkv_bias", qkv_bias),
+                    ("out_shift", out_shift),
+                    ("out_smooth", out_smooth),
+                    ("rope_emb", rope_emb), ("mask", mask),
+                    ("tgt_mask", tgt_mask)):
+        if v is not None:
+            raise NotImplementedError(
+                f"block_multihead_attention: {name} is not supported "
+                "on the TPU paged path")
+    import numpy as np
+    from ....ops.pallas.paged_attention import paged_decode_attention
+    from ....ops.pallas.flash_varlen import flash_attention_segmented
+    from ....tensor.tensor import wrap_array
+
+    qkv_t = as_tensor(qkv)
+    kc = as_tensor(key_cache)._data
+    vc = as_tensor(value_cache)._data
+    tables = jnp.asarray(as_tensor(block_tables)._data, jnp.int32)
+    enc = np.asarray(as_tensor(seq_lens_encoder).numpy()).astype(np.int64)
+    dec = np.asarray(as_tensor(seq_lens_decoder).numpy()).astype(np.int64)
+    this = np.asarray(
+        as_tensor(seq_lens_this_time).numpy()).astype(np.int64)
+    num_pages, nkv, page, d = kc.shape
+    arr = qkv_t._data
+    T = arr.shape[0]
+    if arr.ndim == 2:
+        n = arr.shape[1] // (3 * d)
+        arr = arr.reshape(T, 3, n, d)
+    else:
+        n = arr.shape[2]
+
+    if np.all(this == 1):                      # ---- decode phase ----
+        B = T
+        q = arr[:, 0]                           # [B, n, d]
+        k = arr[:, 1].reshape(B, n, d)[:, :nkv]
+        v = arr[:, 2].reshape(B, n, d)[:, :nkv]
+        lens = jnp.asarray(dec.copy(), jnp.int32)
+        page_ids = tables[jnp.arange(B), lens // page]
+        slots = lens % page
+        kc = kc.at[page_ids, :, slots, :].set(k.astype(kc.dtype))
+        vc = vc.at[page_ids, :, slots, :].set(v.astype(vc.dtype))
+        out = paged_decode_attention(q, kc, vc, tables, lens + 1)
+        return (wrap_array(out), qkv_t, wrap_array(kc), wrap_array(vc))
+
+    if np.any(dec > 0):
+        raise NotImplementedError(
+            "block_multihead_attention: mixed encoder/decoder batches "
+            "are not supported — issue prefill and decode as separate "
+            "calls")
+    # ---- prefill (encoder) phase: packed varlen over segments ----
+    from ....ops.pallas.flash_varlen import segment_ids_from_cu_seqlens
+    cu = np.cumsum(np.concatenate([[0], this]))
+    assert cu[-1] == T, (cu, T)
+    seg = np.asarray(segment_ids_from_cu_seqlens(
+        jnp.asarray(cu, jnp.int32), T))
+    pad = (-T) % 128 if T >= 128 else 128 - T
+    seg_full = jnp.asarray(np.concatenate(
+        [seg, np.full(pad, -1, np.int32)])[None])
+    ap = jnp.pad(arr, ((0, pad), (0, 0), (0, 0), (0, 0)))
+    # GQA consistency with the decode phase: ONLY the first nkv head
+    # slots carry k/v; repeat them across the query-head groups for the
+    # prefill attention (decode's kernel does the same grouping)
+    g = n // nkv
+    kk = ap[:, 1, :nkv]
+    vv = ap[:, 2, :nkv]
+    if g > 1:
+        kk = jnp.repeat(kk, g, axis=1)
+        vv = jnp.repeat(vv, g, axis=1)
+    out = flash_attention_segmented(
+        ap[None, :, 0], kk[None], vv[None], seg_full,
+        causal=True)[0, :T]
+    # write each row's K/V pages (ragged npg per row; ONE host read of
+    # the tables, one scatter per row over distinct pages)
+    tables_np = np.asarray(tables)
+    for b in range(len(this)):
+        L = int(this[b])
+        if L == 0:
+            continue
+        o = int(cu[b])
+        npg = (L + page - 1) // page
+        Lp = npg * page
+        kb = jnp.pad(arr[o:o + L, 1, :nkv], ((0, Lp - L), (0, 0), (0, 0)))
+        vb = jnp.pad(arr[o:o + L, 2, :nkv], ((0, Lp - L), (0, 0), (0, 0)))
+        kb = kb.reshape(npg, page, nkv, d).transpose(0, 2, 1, 3)
+        vb = vb.reshape(npg, page, nkv, d).transpose(0, 2, 1, 3)
+        ids = tables_np[b, :npg].copy()
+        kc = kc.at[ids].set(kb.astype(kc.dtype))
+        vc = vc.at[ids].set(vb.astype(vc.dtype))
+    return (wrap_array(out), qkv_t, wrap_array(kc), wrap_array(vc))

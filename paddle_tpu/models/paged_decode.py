@@ -1,0 +1,406 @@
+"""Paged-KV-cache decoding (block tables + continuous batching).
+
+Reference role: the reference's block cache serving stack —
+``incubate.nn.functional.block_multihead_attention``
+(/root/reference/python/paddle/incubate/nn/functional/
+block_multihead_attention.py) and the fleet serving loops above it.
+
+Why paged beats the dense cache (models/decode.py) for serving:
+
+* The dense cache allocates ``[L, B, S_max, nkv, d]`` — every row pays
+  the batch-wide maximum.  The POOL allocates pages of ``page`` tokens
+  and a row owns ``ceil(len/page)`` of them: HBM scales with the sum of
+  ACTUAL lengths (continuous batching's whole point).
+* Decode attention reads only a row's own pages (block-table indexed
+  DMA in ops/pallas/paged_attention.py), so the cache-traffic-bound
+  batch-32 regime (PERF.md) pays for real context, not for S_max.
+* Rows advance INDEPENDENTLY: per-row positions/lengths, so requests
+  of different ages batch together — the dense ``make_generate`` locks
+  the whole batch to one position.
+
+Host side, :class:`PagedKVCache` is a free-list page allocator (the
+role vLLM's block manager plays); device side, one jitted step embeds
+the batch's next tokens, RoPEs at per-row positions, appends K/V into
+pages, and runs the paged-attention kernel per layer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn, _mm,
+                             _rms_norm)
+
+__all__ = ["PagedKVCache", "make_paged_decode_step", "generate_paged"]
+
+
+class PagedKVCache:
+    """Free-list page allocator + device page pools for all layers.
+
+    Pools: ``[L, num_pages, nkv, page, d]`` (page layout matches the
+    reference's ``[max_block_num, kv_num_head, block_size, head_dim]``).
+    Page 0 is reserved as the junk page unused table slots point at —
+    the kernel skips them, but their ids must stay DMA-valid.
+    """
+
+    def __init__(self, cfg: LlamaPretrainConfig, num_pages: int,
+                 pages_max: int, batch: int, page: int = 64,
+                 dtype=None):
+        self.cfg = cfg
+        self.page = page
+        self.pages_max = pages_max
+        self.num_pages = num_pages
+        dt = dtype or cfg.dtype
+        L = cfg.num_hidden_layers
+        nkv, d = cfg.num_key_value_heads, cfg.head_dim
+        self.kpool = jnp.zeros((L, num_pages, nkv, page, d), dt)
+        self.vpool = jnp.zeros((L, num_pages, nkv, page, d), dt)
+        self._free = list(range(num_pages - 1, 0, -1))   # page 0 reserved
+        self.tables = np.zeros((batch, pages_max), np.int32)
+        self.lens = np.zeros((batch,), np.int32)
+        self._owned = [[] for _ in range(batch)]
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc_row(self, b: int, length: int) -> None:
+        """Claim pages for ``length`` tokens on row ``b`` (prefill)."""
+        need = (length + self.page - 1) // self.page
+        if need > self.pages_max:
+            raise ValueError(f"length {length} exceeds pages_max")
+        if need > len(self._free):
+            raise RuntimeError("KV page pool exhausted")
+        self.release_row(b)
+        for j in range(need):
+            pid = self._free.pop()
+            self._owned[b].append(pid)
+            self.tables[b, j] = pid
+        self.lens[b] = length
+
+    def ensure_capacity(self, b: int) -> None:
+        """Grow row ``b`` so slot ``lens[b]`` (the next write) exists."""
+        need = int(self.lens[b]) // self.page + 1
+        if need > self.pages_max:
+            raise ValueError("row exceeded pages_max")
+        while len(self._owned[b]) < need:
+            if not self._free:
+                raise RuntimeError("KV page pool exhausted")
+            pid = self._free.pop()
+            self.tables[b, len(self._owned[b])] = pid
+            self._owned[b].append(pid)
+
+    def release_row(self, b: int) -> None:
+        for pid in self._owned[b]:
+            self._free.append(pid)
+        self._owned[b] = []
+        self.tables[b] = 0
+        self.lens[b] = 0
+
+
+def _rope_rows(x, theta, pos):
+    """RoPE for one token per row at per-row positions ``pos [B]``;
+    x [B, 1, n, d]."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None]     # [B, d/2]
+    cos = jnp.cos(freqs)[:, None, None, :]
+    sin = jnp.sin(freqs)[:, None, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate([x1f * cos - x2f * sin,
+                            x2f * cos + x1f * sin], -1).astype(x.dtype)
+
+
+def _cfg_key(cfg) -> str:
+    import dataclasses
+    return repr(sorted(dataclasses.asdict(cfg).items(), key=repr))
+
+
+_step_cache: dict = {}
+_gen_cache: dict = {}
+
+
+def make_paged_decode_step(cfg: LlamaPretrainConfig,
+                           temperature: float = 0.0):
+    """Jitted ``step(params, kpool, vpool, tables, lens, tok, key)
+    -> (kpool, vpool, next_tok)``.
+
+    ``lens [B]`` = cached context per row BEFORE this token (per-row —
+    continuous batching).  ``tok [B]`` = this step's input token.  The
+    new K/V land at per-row slot ``lens[b]``; callers bump ``lens`` and
+    the page tables on the host (PagedKVCache).
+    """
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    hit = _step_cache.get((_cfg_key(cfg), temperature))
+    if hit is not None:
+        return hit
+
+    def step(params, kpool, vpool, tables, lens, tok, key):
+        B = tok.shape[0]
+        page = kpool.shape[3]
+        x = jnp.take(params["embed"], tok[:, None], axis=0).astype(dt)
+        page_ids = tables[jnp.arange(B), lens // page]       # [B]
+        slots = lens % page                                  # [B]
+
+        # pools ride the scan xs->ys (per-layer slices update in place
+        # under donation — a carry formulation was measured to copy the
+        # full pool per layer, 10x slower); the append is one batched
+        # scatter
+        def layer(carry, inp):
+            xc = carry
+            bp, kp, vp = inp
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, 1, n, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, 1, nkv, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, 1, nkv, d)
+            q = _rope_rows(q, cfg.rope_theta, lens)
+            k = _rope_rows(k, cfg.rope_theta, lens)
+            kp = kp.at[page_ids, :, slots, :].set(
+                k[:, 0].astype(kp.dtype))
+            vp = vp.at[page_ids, :, slots, :].set(
+                v[:, 0].astype(vp.dtype))
+            attn = paged_decode_attention(q[:, 0], kp, vp, tables,
+                                          lens + 1)
+            out = _block_post_attn(bp, xc, attn[:, None], cfg)
+            return out, (kp, vp)
+
+        x, (kpool, vpool) = jax.lax.scan(
+            layer, x, (params["blocks"], kpool, vpool))
+        h = _rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
+        logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            nxt = jax.random.categorical(key, logits / temperature, -1)
+        return kpool, vpool, nxt
+
+    # memoised per (cfg, temperature): jax.jit caches by function
+    # identity, so returning a fresh closure every call would recompile
+    # every generate
+    fn = jax.jit(step, donate_argnums=(1, 2))
+    _step_cache[(_cfg_key(cfg), temperature)] = fn
+    return fn
+
+
+def make_paged_generate_fused(cfg: LlamaPretrainConfig,
+                              max_new_tokens: int,
+                              temperature: float = 0.0):
+    """ONE jitted program for the whole paged generation tail: pages
+    for ``lens + max_new_tokens`` are pre-allocated so the block tables
+    are CONSTANT across steps, and a ``lax.scan`` advances every row at
+    its own position.  This is the shape-static TPU form of continuous
+    batching — the per-token :func:`make_paged_decode_step` exists for
+    serving loops that admit/evict requests between steps; this fused
+    form is for generation (one dispatch instead of max_new)."""
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    hit = _gen_cache.get((_cfg_key(cfg), max_new_tokens, temperature))
+    if hit is not None:
+        return hit
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    def generate(params, kpool, vpool, tables, lens0, tok0, key):
+        B = tok0.shape[0]
+        page = kpool.shape[3]
+
+        def dec_step(carry, _):
+            kpool, vpool, tok, lens, key = carry
+            x = jnp.take(params["embed"], tok[:, None],
+                         axis=0).astype(dt)
+            page_ids = tables[jnp.arange(B), lens // page]
+            slots = lens % page
+
+            def layer(carry2, inp):
+                xc = carry2
+                bp, kp, vp = inp
+                y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+                q = _mm(y, bp["wq"], dt).reshape(B, 1, n, d)
+                k = _mm(y, bp["wk"], dt).reshape(B, 1, nkv, d)
+                v = _mm(y, bp["wv"], dt).reshape(B, 1, nkv, d)
+                q = _rope_rows(q, cfg.rope_theta, lens)
+                k = _rope_rows(k, cfg.rope_theta, lens)
+                kp = kp.at[page_ids, :, slots, :].set(
+                    k[:, 0].astype(kp.dtype))
+                vp = vp.at[page_ids, :, slots, :].set(
+                    v[:, 0].astype(vp.dtype))
+                attn = paged_decode_attention(q[:, 0], kp, vp, tables,
+                                              lens + 1)
+                out = _block_post_attn(bp, xc, attn[:, None], cfg)
+                return out, (kp, vp)
+
+            x, (kpool, vpool) = jax.lax.scan(
+                layer, x, (params["blocks"], kpool, vpool))
+            h = _rms_norm(x[:, 0], params["final_norm"],
+                          cfg.rms_norm_eps)
+            logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1)
+            else:
+                nxt = jax.random.categorical(sub, logits / temperature,
+                                             -1)
+            return (kpool, vpool, nxt, lens + 1, key), nxt
+
+        carry0 = (kpool, vpool, tok0, jnp.asarray(lens0, jnp.int32),
+                  key)
+        (kpool, vpool, _, _, _), toks = jax.lax.scan(
+            dec_step, carry0, None, length=max_new_tokens - 1)
+        return kpool, vpool, jnp.concatenate(
+            [tok0[None], toks], axis=0)
+
+    fn = jax.jit(generate, donate_argnums=(1, 2))
+    _gen_cache[(_cfg_key(cfg), max_new_tokens, temperature)] = fn
+    return fn
+
+
+_prefill_cache: dict = {}
+
+
+def _prefill(cfg: LlamaPretrainConfig):
+    """Memoised jitted dense prefill: causal forward collecting per-
+    layer K/V (shapes come from the traced prompt, so one cache entry
+    per cfg serves every batch/length)."""
+    hit = _prefill_cache.get(_cfg_key(cfg))
+    if hit is not None:
+        return hit
+    from .llama_pretrain import _rope
+    from .decode import _grouped_attn
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    @jax.jit
+    def prefill(params, prompt):
+        B, S = prompt.shape
+        x = jnp.take(params["embed"], prompt, axis=0).astype(dt)
+        causal = jnp.tril(jnp.ones((S, S), bool))
+
+        def pre_layer(carry, bp):
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, S, n, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, S, nkv, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, S, nkv, d)
+            q, k = _rope(q, k, cfg.rope_theta)
+            attn = _grouped_attn(q, k, v, causal[None, None, None])
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(pre_layer, x, params["blocks"])
+        return x, ks, vs
+
+    _prefill_cache[_cfg_key(cfg)] = prefill
+    return prefill
+
+
+def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
+                   max_new_tokens: int, cache: PagedKVCache,
+                   temperature: float = 0.0, seed: int = 0,
+                   fused: bool = True):
+    """Generate with the paged cache: dense prefill (one jitted causal
+    forward collecting K/V, written into each row's pages), then the
+    paged decode tail — by default ONE fused scan program with
+    pre-allocated pages (``fused=True``); ``fused=False`` drives the
+    per-token step from the host (the continuous-batching serving
+    loop).  Rows keep INDEPENDENT lengths — mixed-length prompts do not
+    round up to the batch max."""
+    B, S = prompt.shape
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+    page = cache.page
+    prompt = jnp.asarray(prompt)
+    lens_np = cache.lens.copy()      # caller pre-allocated via alloc_row
+
+    x, ks, vs = _prefill(cfg)(params, prompt)
+    # write prompt K/V into pages: [L, B, S, nkv, d] -> per-row pages
+    S_pad = ((S + page - 1) // page) * page
+    ks = jnp.pad(ks, ((0, 0), (0, 0), (0, S_pad - S), (0, 0), (0, 0)))
+    vs = jnp.pad(vs, ((0, 0), (0, 0), (0, S_pad - S), (0, 0), (0, 0)))
+    npg = S_pad // page
+    # [L, B, npg, page, nkv, d] -> [L, B, npg, nkv, page, d]
+    ks = ks.reshape(ks.shape[0], B, npg, page, nkv, d).transpose(
+        0, 1, 2, 4, 3, 5)
+    vs = vs.reshape(vs.shape[0], B, npg, page, nkv, d).transpose(
+        0, 1, 2, 4, 3, 5)
+    # .copy(): cache.tables is mutated by ensure_capacity while this
+    # eager scatter may still be in flight (numpy -> jax is zero-copy
+    # on CPU; see the loop below)
+    used = cache.tables[:, :npg].copy()              # [B, npg]
+    kpool = cache.kpool.at[:, used].set(ks.astype(cache.kpool.dtype))
+    vpool = cache.vpool.at[:, used].set(vs.astype(cache.vpool.dtype))
+
+    # per-row last REAL token's logits (rows may be shorter than S)
+    last_idx = jnp.asarray(lens_np - 1)
+    h = _rms_norm(x[jnp.arange(B), last_idx], params["final_norm"],
+                  cfg.rms_norm_eps)
+    logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    if temperature <= 0.0:
+        tok = jnp.argmax(logits, axis=-1)
+    else:
+        key, sub = jax.random.split(key)
+        tok = jax.random.categorical(sub, logits / temperature, -1)
+
+    if fused:
+        # pre-allocate every page the tail will touch -> tables are
+        # constant -> the whole tail is one scan program
+        saved_lens = cache.lens.copy()
+        for b in range(B):
+            need = (int(cache.lens[b]) + max_new_tokens + page - 1) \
+                // page
+            if need > cache.pages_max:
+                raise ValueError(
+                    f"row {b}: prompt {int(cache.lens[b])} + "
+                    f"{max_new_tokens} new tokens needs {need} pages "
+                    f"> pages_max {cache.pages_max} — silently "
+                    f"clamping would corrupt the last page")
+            while len(cache._owned[b]) < need:
+                if not cache._free:
+                    raise RuntimeError("KV page pool exhausted")
+                pid = cache._free.pop()
+                cache.tables[b, len(cache._owned[b])] = pid
+                cache._owned[b].append(pid)
+        gen = make_paged_generate_fused(cfg, max_new_tokens,
+                                        temperature)
+        key, sub = jax.random.split(key)
+        kpool, vpool, toks = gen(params, kpool, vpool,
+                                 jnp.asarray(cache.tables.copy()),
+                                 jnp.asarray(saved_lens), tok, sub)
+        cache.kpool, cache.vpool = kpool, vpool
+        cache.lens = saved_lens + max_new_tokens - 1
+        return jnp.transpose(toks)                   # [B, max_new]
+
+    step = make_paged_decode_step(cfg, temperature)
+    out_toks = [tok]
+    for _ in range(max_new_tokens - 1):
+        for b in range(B):
+            cache.ensure_capacity(b)
+        # COPIES, not views: jnp.asarray of a numpy array is zero-copy
+        # on CPU, and the step consumes it asynchronously — mutating
+        # cache.lens/tables on the host while the previous step is
+        # still in flight corrupts its inputs (observed as a ~20%
+        # per-process wrong-decode flake before the copy)
+        tables = jnp.asarray(cache.tables.copy())
+        lens = jnp.asarray(cache.lens.copy())
+        key, sub = jax.random.split(key)
+        kpool, vpool, tok = step(params, kpool, vpool, tables, lens,
+                                 tok, sub)
+        cache.lens = cache.lens + 1     # rebind, never mutate in place
+        out_toks.append(tok)
+    cache.kpool, cache.vpool = kpool, vpool
+    return jnp.stack(out_toks, axis=1)               # [B, max_new]

@@ -637,20 +637,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         args.append(as_tensor(bias))
     out = apply("batch_norm", fn, *args)
 
-    if training and running_mean is not None:
+    update_stats = training and running_mean is not None
+    if update_stats:
         from ...autograd import tape as _tape
-        if not _tape.in_functional_trace():
-            m_new = jnp.mean(x._data, axis=reduce_axes)
-            v_new = jnp.var(x._data, axis=reduce_axes)
-            n = x._data.size / x._data.shape[ch_axis]
-            unbiased = v_new * n / max(n - 1, 1)
-            rm, rv = as_tensor(running_mean), as_tensor(running_var)
-            running_mean._data = (momentum * rm._data +
-                                  (1 - momentum) * m_new).astype(
-                rm._data.dtype)
-            running_var._data = (momentum * rv._data +
-                                 (1 - momentum) * unbiased).astype(
-                rv._data.dtype)
+        if _tape.in_functional_trace():
+            # under a functional trace, rebind ONLY when the buffer was
+            # swapped in by Layer._functional_call (its _data is a
+            # tracer) — then return_buffers captures the update and the
+            # finally-restore unwinds the live layer.  A trace that did
+            # NOT manage this buffer (static_engine / pipeline partial
+            # calls) must not have a tracer leaked onto it.
+            update_stats = isinstance(as_tensor(running_mean)._data,
+                                      jax.core.Tracer)
+    if update_stats:
+        m_new = jnp.mean(x._data, axis=reduce_axes)
+        v_new = jnp.var(x._data, axis=reduce_axes)
+        n = x._data.size / x._data.shape[ch_axis]
+        unbiased = v_new * n / max(n - 1, 1)
+        rm, rv = as_tensor(running_mean), as_tensor(running_var)
+        running_mean._data = (momentum * rm._data +
+                              (1 - momentum) * m_new).astype(
+            rm._data.dtype)
+        running_var._data = (momentum * rv._data +
+                             (1 - momentum) * unbiased).astype(
+            rv._data.dtype)
     return out
 
 

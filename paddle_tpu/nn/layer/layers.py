@@ -346,10 +346,16 @@ class Layer:
     # -- functional bridge (TPU-native) -------------------------------------
     def _functional_call(self, param_arrays: Dict[str, Any], *inputs,
                          buffers: Optional[Dict[str, Any]] = None,
+                         return_buffers: bool = False,
                          **kwargs):
         """Run forward with parameter (and optionally buffer) data swapped
         for caller-provided arrays; restore after.  jit/grad trace through
-        this — the whole Layer becomes one XLA program."""
+        this — the whole Layer becomes one XLA program.
+
+        ``return_buffers=True`` additionally returns ``{name: array}``
+        of the buffers' POST-forward values (captured before restore) —
+        how a compiled training step carries BatchNorm running-stat
+        updates out of the trace."""
         named = dict(self.named_parameters())
         named_buf = dict(self.named_buffers())
         saved = {}
@@ -366,7 +372,12 @@ class Layer:
                     t._data = arr if not isinstance(arr, Tensor) \
                         else arr._data
             with tape.functional_trace_guard():
-                return self(*inputs, **kwargs)
+                out = self(*inputs, **kwargs)
+            if return_buffers:
+                new_bufs = {name: named_buf[name]._data
+                            for name in (buffers or {})}
+                return out, new_bufs
+            return out
         finally:
             for t, old in saved.values():
                 t._data = old

@@ -69,15 +69,40 @@ def _interpret() -> bool:
     return jax.devices()[0].platform not in ("tpu", "axon")
 
 
+def _composite(x, cos, sin, neg_sin: bool):
+    """Plain-XLA rotate-half (the fallback for shapes the kernel's
+    blocking cannot tile — e.g. odd sequence lengths where no 8-aligned
+    block divides S; Mosaic requires sublane blocks divisible by 8)."""
+    d = x.shape[-1]
+    h = d // 2
+    c = cos.astype(jnp.float32)[None, :, None, :]
+    sn = sin.astype(jnp.float32)[None, :, None, :]
+    if neg_sin:
+        sn = -sn
+    x1 = x[..., :h].astype(jnp.float32)
+    x2 = x[..., h:].astype(jnp.float32)
+    return jnp.concatenate([x1 * c - x2 * sn, x2 * c + x1 * sn],
+                           -1).astype(x.dtype)
+
+
+def _pick_block(s, n, d):
+    # budget: the kernel holds ~5 f32 copies of the block (cast, halves,
+    # rotated halves) double-buffered; keep the raw block under 1 MiB.
+    # Blocks must be 8-aligned on the sublane dim (or equal to S) for
+    # the [S, d/2] table operand.
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if s % cand == 0 and cand * n * d * 4 <= (1 << 20):
+            return cand
+    if s * n * d * 4 <= (1 << 20):
+        return s
+    return None
+
+
 def _apply(x, cos, sin, neg_sin: bool):
     b, s, n, d = x.shape
-    bs = s
-    # budget: the kernel holds ~5 f32 copies of the block (cast, halves,
-    # rotated halves) double-buffered; keep the raw block under 1 MiB
-    for cand in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if s % cand == 0 and cand * n * d * 4 <= (1 << 20):
-            bs = cand
-            break
+    bs = _pick_block(s, n, d)
+    if bs is None:
+        return _composite(x, cos, sin, neg_sin)
     grid = (b, s // bs)
     return pl.pallas_call(
         functools.partial(_rope_kernel, neg_sin=neg_sin),

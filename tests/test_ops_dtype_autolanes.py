@@ -1,0 +1,166 @@
+"""Auto-derived full-surface bf16/fp16 dtype lanes (round-3 verdict
+item 8).
+
+Instead of a hand-picked op list, this WALKS the registered op surface
+(``paddle_tpu.tensor.math.__all__`` + ``nn.functional.__all__``) and,
+for every op that accepts generic float-tensor inputs, runs bf16 and
+fp16 lanes against the op's own fp32 result (fp32 numerics are pinned
+by the dedicated fp32 suites).  A coverage report asserts the auto lane
+set is at least as large as the hand-written fp32 math/nn sets — the
+reference runs per-dtype checks on essentially every op
+(test/legacy_test/op_test.py:2762, :2964).
+
+Ops needing non-float / structured arguments are probed with a few
+generic signatures and otherwise listed in the coverage report, so
+shrinkage is visible in review rather than silent.  Lanes run as ONE
+sweep per (namespace, dtype) collecting every failure — a per-op
+parametrize would pay pytest/compile overhead ~600 times.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor import math as _math_mod
+
+LOW = ("bfloat16", "float16")
+# loose by design: the oracle is fp32-on-fp32 (not requantized), so the
+# bound covers input rounding + accumulation differences
+TOL = {"bfloat16": 8e-2, "float16": 1.6e-2}
+
+RNG = np.random.RandomState(7)
+
+EXCLUDED = {
+    # host/integer/bool semantics — low-precision lanes meaningless
+    "isfinite", "isinf", "isnan", "isclose", "allclose", "equal",
+    "equal_all", "not_equal", "greater_equal", "greater_than",
+    "less_equal", "less_than", "logical_and", "logical_or",
+    "logical_not", "logical_xor", "sign", "heaviside", "count_nonzero",
+    # randomness — value comparison meaningless
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout", "rrelu",
+    "feature_alpha_dropout", "npair_loss", "gumbel_softmax",
+    # discontinuous ops: input quantization legitimately flips the
+    # branch (x % y jumps by |y| when bf16 rounding crosses a multiple)
+    "remainder", "mod", "fmod", "floor_divide", "floor_mod", "floor",
+    "ceil", "round", "trunc", "frac",
+    # interprets a float tensor as indices; unbounded host loop on
+    # garbage values (found by the hang scan)
+    "multiplex",
+}
+
+# ops whose domain is positive (poles/logs near 0 make signed probes
+# measure conditioning, not dtype support)
+PREFER_POSITIVE = {"digamma", "lgamma", "polygamma", "kl_div",
+                   "gaussian_nll_loss"}
+
+
+def _args_for(nargs, positive):
+    lo, hi = (0.3, 1.5) if positive else (-1.2, 1.2)
+    return [RNG.uniform(lo, hi, (4, 8)).astype(np.float32)
+            for _ in range(nargs)]
+
+
+def _call(fn, arrs, dtype):
+    ts = [paddle.to_tensor(a).astype(dtype) for a in arrs]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = fn(*ts)
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    vals = []
+    for o in leaves:
+        if hasattr(o, "astype") and hasattr(o, "numpy"):
+            a = np.asarray(o.astype("float32").numpy())
+            if np.issubdtype(a.dtype, np.floating):
+                vals.append(a)
+    if not vals:
+        raise TypeError("no float outputs")
+    return vals
+
+
+def _discover(names, module):
+    found, skipped = [], []
+    for name in sorted(set(names)):
+        if name.startswith("_") or name.endswith("_") or \
+                name in EXCLUDED:
+            continue
+        fn = getattr(module, name, None)
+        if not callable(fn):
+            continue
+        sig = None
+        order = ((1, True), (2, True), (3, True), (1, False),
+                 (2, False), (3, False)) if name in PREFER_POSITIVE \
+            else ((1, False), (1, True), (2, False), (3, False))
+        for nargs, positive in order:
+            try:
+                _call(fn, _args_for(nargs, positive), "float32")
+                sig = (nargs, positive)
+                break
+            except Exception:
+                continue
+        (found if sig else skipped).append((name, sig) if sig else name)
+    return found, skipped
+
+
+@pytest.fixture(scope="module")
+def surfaces():
+    math_ops, math_skipped = _discover(
+        list(getattr(_math_mod, "__all__", [])), paddle)
+    nn_ops, nn_skipped = _discover(
+        list(getattr(F, "__all__", [])), F)
+    return {"math": (math_ops, math_skipped),
+            "nn": (nn_ops, nn_skipped)}
+
+
+@pytest.mark.parametrize("space", ["math", "nn"])
+@pytest.mark.parametrize("dt", LOW)
+def test_surface_low_precision_sweep(surfaces, space, dt):
+    ops, _ = surfaces[space]
+    module = paddle if space == "math" else F
+    tol = TOL[dt]
+    failures = []
+    for name, (nargs, positive) in ops:
+        fn = getattr(module, name)
+        RNG.seed(abs(hash(name)) % 2 ** 31)
+        arrs = _args_for(nargs, positive)
+        try:
+            ref = _call(fn, arrs, "float32")
+            got = _call(fn, arrs, dt)
+        except Exception as e:
+            failures.append(f"{name}: {type(e).__name__}: "
+                            f"{str(e)[:80]}")
+            continue
+        for g, r in zip(got, ref):
+            if g.shape != r.shape:
+                failures.append(f"{name}: shape {g.shape} vs {r.shape}")
+                break
+            scale = np.maximum(np.abs(r), 1.0)
+            err = float(np.max(np.abs(g - r) / scale)) if g.size else 0.0
+            if not np.isfinite(g).all() and np.isfinite(r).all():
+                failures.append(f"{name}: non-finite in {dt}")
+                break
+            if err > tol:
+                failures.append(f"{name}: rel err {err:.3g} > {tol}")
+                break
+    assert not failures, (
+        f"{len(failures)}/{len(ops)} {space} ops fail the {dt} lane:\n"
+        + "\n".join(failures))
+
+
+def test_autolane_coverage_report(surfaces):
+    """The auto-derived lane set must cover at least as many ops as the
+    hand-written fp32 math/nn suites; skipped names are printed so
+    shrinkage is reviewable."""
+    math_ops, math_skipped = surfaces["math"]
+    nn_ops, nn_skipped = surfaces["nn"]
+    report = (f"auto dtype lanes: {len(math_ops)} tensor.math ops + "
+              f"{len(nn_ops)} nn.functional ops; skipped "
+              f"{len(math_skipped)} math ({', '.join(math_skipped)}) "
+              f"and {len(nn_skipped)} nn ({', '.join(nn_skipped)})")
+    print(report)
+    # the hand-written fp32 suites pin ~60 math ops and ~40 nn
+    # functionals; the derived surface must not regress below them
+    assert len(math_ops) >= 60, report
+    assert len(nn_ops) >= 40, report
