@@ -51,6 +51,12 @@ class _DynamicGraphAdapter:
         self._jit_unavailable = False
         self._loss_arity = None
 
+    def reset_jit_eligibility(self) -> None:
+        """Called at the top of each fit()/evaluate run: an earlier
+        accumulation run must not PERMANENTLY pin this Model to the
+        eager loop (the compiled step is rebuilt lazily)."""
+        self._jit_unavailable = False
+
     def _compiled_step(self):
         """Build (once) the whole-program compiled train step when the
         prepared configuration qualifies — this is what lifts Model.fit
@@ -109,7 +115,14 @@ class _DynamicGraphAdapter:
         if not update:
             # gradient accumulation interleaves update=False eager
             # backward passes — the compiled step would ignore those
-            # accumulated grads, so disable it for this run
+            # accumulated grads, so disable it until the next fit()
+            # (reset_jit_eligibility) and say so once
+            if not self._jit_unavailable:
+                import warnings
+                warnings.warn(
+                    "Model.fit: gradient accumulation runs the eager "
+                    "loop (the compiled step cannot consume eager-"
+                    "accumulated grads)", stacklevel=2)
             self._jit_unavailable = True
         if update:
             step = self._compiled_step()
@@ -279,6 +292,7 @@ class Model:
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
         """Reference: model.py:1750."""
+        self._adapter.reset_jit_eligibility()
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,

@@ -528,7 +528,10 @@ def block_multihead_attention(
     # ---- prefill (encoder) phase: packed varlen over segments ----
     from ....ops.pallas.flash_varlen import segment_ids_from_cu_seqlens
     cu = np.cumsum(np.concatenate([[0], this]))
-    assert cu[-1] == T, (cu, T)
+    if cu[-1] != T:
+        raise ValueError(
+            f"block_multihead_attention: seq_lens_this_time sums to "
+            f"{int(cu[-1])} but qkv has {T} tokens")
     seg = np.asarray(segment_ids_from_cu_seqlens(
         jnp.asarray(cu, jnp.int32), T))
     pad = (-T) % 128 if T >= 128 else 128 - T
@@ -547,9 +550,10 @@ def block_multihead_attention(
     out = flash_attention_segmented(
         ap[None, :, 0], kk[None], vv[None], seg_full,
         causal=True)[0, :T]
-    # write each row's K/V pages (ragged npg per row; ONE host read of
-    # the tables, one scatter per row over distinct pages)
+    # write every row's K/V pages in ONE batched scatter (per-row
+    # .at[].set calls would copy the whole multi-GB pool per row)
     tables_np = np.asarray(tables)
+    all_ids, all_kb, all_vb = [], [], []
     for b in range(len(this)):
         L = int(this[b])
         if L == 0:
@@ -559,9 +563,13 @@ def block_multihead_attention(
         Lp = npg * page
         kb = jnp.pad(arr[o:o + L, 1, :nkv], ((0, Lp - L), (0, 0), (0, 0)))
         vb = jnp.pad(arr[o:o + L, 2, :nkv], ((0, Lp - L), (0, 0), (0, 0)))
-        kb = kb.reshape(npg, page, nkv, d).transpose(0, 2, 1, 3)
-        vb = vb.reshape(npg, page, nkv, d).transpose(0, 2, 1, 3)
-        ids = tables_np[b, :npg].copy()
-        kc = kc.at[ids].set(kb.astype(kc.dtype))
-        vc = vc.at[ids].set(vb.astype(vc.dtype))
+        all_kb.append(kb.reshape(npg, page, nkv, d).transpose(0, 2, 1, 3))
+        all_vb.append(vb.reshape(npg, page, nkv, d).transpose(0, 2, 1, 3))
+        all_ids.append(tables_np[b, :npg])
+    if all_ids:
+        ids = np.concatenate(all_ids).copy()
+        kc = kc.at[ids].set(
+            jnp.concatenate(all_kb, axis=0).astype(kc.dtype))
+        vc = vc.at[ids].set(
+            jnp.concatenate(all_vb, axis=0).astype(vc.dtype))
     return (wrap_array(out), qkv_t, wrap_array(kc), wrap_array(vc))

@@ -75,10 +75,11 @@ class LlamaPretrainConfig:
     def __post_init__(self):
         if self.num_key_value_heads is None:
             self.num_key_value_heads = self.num_attention_heads
-        if self.remat_policy not in ("full", "flash", "dots", "names"):
+        if self.remat_policy not in ("full", "flash", "dots", "names",
+                                     "cheap"):
             raise ValueError(
-                f"remat_policy must be one of full/flash/dots/names, "
-                f"got {self.remat_policy!r}")
+                f"remat_policy must be one of full/flash/dots/names/"
+                f"cheap, got {self.remat_policy!r}")
         if self.context_parallel not in (None, "ring", "ulysses"):
             raise ValueError(
                 f"context_parallel must be None, 'ring' or 'ulysses', "
@@ -194,7 +195,11 @@ def _rms_norm(x, w, eps):
                 not isinstance(w, dict):
             return impl(x, w.astype(x.dtype), eps)
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(
+    # named so the "cheap" remat policy can save ONLY the [B,S,1] rstd
+    # (the backward then skips the variance reduction re-compute while
+    # re-materialising everything O(H)-sized)
+    rstd = _ckpt_name(jax.lax.rsqrt(var + eps), "rms_rstd")
+    return (x.astype(jnp.float32) * rstd).astype(
         x.dtype) * w.astype(x.dtype)
 
 
@@ -375,6 +380,11 @@ def _remat_wrap(fwd, cfg):
     if cfg.remat_policy == "names":
         pol = jax.checkpoint_policies.save_only_these_names(
             "attn_out", "ffn_gate", "ffn_up")
+        return jax.checkpoint(fwd, static_argnums=(2, 3), policy=pol)
+    if cfg.remat_policy == "cheap":
+        # save ONLY tiny per-row stats ([B,S,1] rms rstd) — near-zero
+        # HBM cost; backward skips the norm reductions during recompute
+        pol = jax.checkpoint_policies.save_only_these_names("rms_rstd")
         return jax.checkpoint(fwd, static_argnums=(2, 3), policy=pol)
     return jax.checkpoint(fwd, static_argnums=(2, 3))
 

@@ -82,11 +82,14 @@ class PagedKVCache:
             self.tables[b, j] = pid
         self.lens[b] = length
 
-    def ensure_capacity(self, b: int) -> None:
-        """Grow row ``b`` so slot ``lens[b]`` (the next write) exists."""
-        need = int(self.lens[b]) // self.page + 1
+    def ensure_capacity(self, b: int, new_tokens: int = 1) -> None:
+        """Grow row ``b`` so the next ``new_tokens`` writes (slots
+        ``lens[b] .. lens[b]+new_tokens-1``) have pages."""
+        need = (int(self.lens[b]) + new_tokens - 1) // self.page + 1
         if need > self.pages_max:
-            raise ValueError("row exceeded pages_max")
+            raise ValueError(
+                f"row {b}: {int(self.lens[b])} + {new_tokens} tokens "
+                f"needs {need} pages > pages_max {self.pages_max}")
         while len(self._owned[b]) < need:
             if not self._free:
                 raise RuntimeError("KV page pool exhausted")
@@ -114,6 +117,36 @@ def _rope_rows(x, theta, pos):
     x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate([x1f * cos - x2f * sin,
                             x2f * cos + x1f * sin], -1).astype(x.dtype)
+
+
+def _decode_layer(cfg, bp, kp, vp, xc, tables, lens, page_ids, slots):
+    """One transformer layer of a paged decode step: append this
+    token's K/V into the layer's pool pages, then paged attention +
+    block FFN.  Shared by the per-token serving step and the fused
+    generation scan (single source of the decode math)."""
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+    B = xc.shape[0]
+    y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+    q = _mm(y, bp["wq"], dt).reshape(B, 1, n, d)
+    k = _mm(y, bp["wk"], dt).reshape(B, 1, nkv, d)
+    v = _mm(y, bp["wv"], dt).reshape(B, 1, nkv, d)
+    q = _rope_rows(q, cfg.rope_theta, lens)
+    k = _rope_rows(k, cfg.rope_theta, lens)
+    kp = kp.at[page_ids, :, slots, :].set(k[:, 0].astype(kp.dtype))
+    vp = vp.at[page_ids, :, slots, :].set(v[:, 0].astype(vp.dtype))
+    attn = paged_decode_attention(q[:, 0], kp, vp, tables, lens + 1)
+    out = _block_post_attn(bp, xc, attn[:, None], cfg)
+    return out, kp, vp
+
+
+def _pick_token(logits, temperature, key):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(key, logits / temperature, -1)
 
 
 def _cfg_key(cfg) -> str:
@@ -157,31 +190,16 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
         # full pool per layer, 10x slower); the append is one batched
         # scatter
         def layer(carry, inp):
-            xc = carry
             bp, kp, vp = inp
-            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
-            q = _mm(y, bp["wq"], dt).reshape(B, 1, n, d)
-            k = _mm(y, bp["wk"], dt).reshape(B, 1, nkv, d)
-            v = _mm(y, bp["wv"], dt).reshape(B, 1, nkv, d)
-            q = _rope_rows(q, cfg.rope_theta, lens)
-            k = _rope_rows(k, cfg.rope_theta, lens)
-            kp = kp.at[page_ids, :, slots, :].set(
-                k[:, 0].astype(kp.dtype))
-            vp = vp.at[page_ids, :, slots, :].set(
-                v[:, 0].astype(vp.dtype))
-            attn = paged_decode_attention(q[:, 0], kp, vp, tables,
-                                          lens + 1)
-            out = _block_post_attn(bp, xc, attn[:, None], cfg)
+            out, kp, vp = _decode_layer(cfg, bp, kp, vp, carry, tables,
+                                        lens, page_ids, slots)
             return out, (kp, vp)
 
         x, (kpool, vpool) = jax.lax.scan(
             layer, x, (params["blocks"], kpool, vpool))
         h = _rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
         logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
-        if temperature <= 0.0:
-            nxt = jnp.argmax(logits, axis=-1)
-        else:
-            nxt = jax.random.categorical(key, logits / temperature, -1)
+        nxt = _pick_token(logits, temperature, key)
         return kpool, vpool, nxt
 
     # memoised per (cfg, temperature): jax.jit caches by function
@@ -224,21 +242,10 @@ def make_paged_generate_fused(cfg: LlamaPretrainConfig,
             slots = lens % page
 
             def layer(carry2, inp):
-                xc = carry2
                 bp, kp, vp = inp
-                y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
-                q = _mm(y, bp["wq"], dt).reshape(B, 1, n, d)
-                k = _mm(y, bp["wk"], dt).reshape(B, 1, nkv, d)
-                v = _mm(y, bp["wv"], dt).reshape(B, 1, nkv, d)
-                q = _rope_rows(q, cfg.rope_theta, lens)
-                k = _rope_rows(k, cfg.rope_theta, lens)
-                kp = kp.at[page_ids, :, slots, :].set(
-                    k[:, 0].astype(kp.dtype))
-                vp = vp.at[page_ids, :, slots, :].set(
-                    v[:, 0].astype(vp.dtype))
-                attn = paged_decode_attention(q[:, 0], kp, vp, tables,
-                                              lens + 1)
-                out = _block_post_attn(bp, xc, attn[:, None], cfg)
+                out, kp, vp = _decode_layer(cfg, bp, kp, vp, carry2,
+                                            tables, lens, page_ids,
+                                            slots)
                 return out, (kp, vp)
 
             x, (kpool, vpool) = jax.lax.scan(
@@ -247,11 +254,7 @@ def make_paged_generate_fused(cfg: LlamaPretrainConfig,
                           cfg.rms_norm_eps)
             logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
             key, sub = jax.random.split(key)
-            if temperature <= 0.0:
-                nxt = jnp.argmax(logits, axis=-1)
-            else:
-                nxt = jax.random.categorical(sub, logits / temperature,
-                                             -1)
+            nxt = _pick_token(logits, temperature, sub)
             return (kpool, vpool, nxt, lens + 1, key), nxt
 
         carry0 = (kpool, vpool, tok0, jnp.asarray(lens0, jnp.int32),
@@ -361,20 +364,7 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
         # constant -> the whole tail is one scan program
         saved_lens = cache.lens.copy()
         for b in range(B):
-            need = (int(cache.lens[b]) + max_new_tokens + page - 1) \
-                // page
-            if need > cache.pages_max:
-                raise ValueError(
-                    f"row {b}: prompt {int(cache.lens[b])} + "
-                    f"{max_new_tokens} new tokens needs {need} pages "
-                    f"> pages_max {cache.pages_max} — silently "
-                    f"clamping would corrupt the last page")
-            while len(cache._owned[b]) < need:
-                if not cache._free:
-                    raise RuntimeError("KV page pool exhausted")
-                pid = cache._free.pop()
-                cache.tables[b, len(cache._owned[b])] = pid
-                cache._owned[b].append(pid)
+            cache.ensure_capacity(b, new_tokens=max_new_tokens)
         gen = make_paged_generate_fused(cfg, max_new_tokens,
                                         temperature)
         key, sub = jax.random.split(key)

@@ -252,6 +252,12 @@ class Executor:
         missing = [n for n in feed_names if n not in feed]
         if missing:
             raise KeyError(f"missing feed entries: {missing}")
+        unknown = [n for n in feed if n not in program._feed_slots]
+        if unknown:
+            raise KeyError(
+                f"unknown feed entries {unknown} — this program's "
+                f"feeds are {feed_names} (a typo here would silently "
+                f"train on stale values)")
         feed_vals = [jnp_asarray(feed[n], program._feed_targets[n])
                      for n in feed_names]
         const_vals = [program._slot_const[s]._data for s in const_slots]

@@ -156,22 +156,26 @@ def _whoami():
     return rpc.get_current_worker_info().name
 
 
+def _rpc_worker1(master_ep, q):
+    # module level: picklable for the spawn context (no fork of the
+    # threaded jax runtime)
+    from paddle_tpu.distributed import rpc as r
+    r.init_rpc("worker1", rank=1, world_size=2,
+               master_endpoint=master_ep)
+    # serve until worker0 posts the stop result
+    q.put(r.rpc_sync("worker0", _add, args=(40, 2)))
+    import time
+    time.sleep(2)
+    r.shutdown()
+
+
 def test_rpc_two_workers_cross_process():
     import multiprocessing as mp
     from paddle_tpu.distributed import rpc
 
-    ctx = mp.get_context("fork")
+    ctx = mp.get_context("spawn")
     q = ctx.Queue()
-
-    def worker1(master_ep, q):
-        from paddle_tpu.distributed import rpc as r
-        r.init_rpc("worker1", rank=1, world_size=2,
-                   master_endpoint=master_ep)
-        # serve until worker0 posts the stop result
-        q.put(r.rpc_sync("worker0", _add, args=(40, 2)))
-        import time
-        time.sleep(2)
-        r.shutdown()
+    worker1 = _rpc_worker1
 
     rpc.init_rpc("worker0", rank=0, world_size=1,
                  master_endpoint="127.0.0.1:0")

@@ -172,7 +172,7 @@ def test_shmring_cross_process_fifo():
         pytest.skip("no native toolchain")
     name, cap, n = "/pt_test_fifo", 1 << 16, 40   # forces wraparound
     ring = ShmRing(name, cap, owner=True)
-    p = mp.get_context("fork").Process(
+    p = mp.get_context("spawn").Process(
         target=_ring_producer, args=(name, cap, n))
     p.start()
     for i in range(n):

@@ -75,3 +75,26 @@ def test_uncaptured_fetch_raises_clearly():
     out, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
                    fetch_list=[stray])
     np.testing.assert_allclose(out, np.zeros((2, 2)))
+
+
+def test_executor_rejects_unknown_feed_names():
+    """Weak-item regression: a typo'd feed key must raise, not be
+    silently dropped (the value would never reach the program)."""
+    import pytest
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            y = (x * 2.0).sum()
+        exe = static.Executor()
+        feed_ok = {"x": np.ones((2, 3), np.float32)}
+        out = exe.run(main, feed=feed_ok, fetch_list=[y])[0]
+        np.testing.assert_allclose(out, 12.0)
+        with pytest.raises(KeyError):
+            exe.run(main, feed={"x": feed_ok["x"],
+                                "x_typo": feed_ok["x"]},
+                    fetch_list=[y])
+    finally:
+        paddle.disable_static()
