@@ -49,12 +49,14 @@ class DevicePool:
 
     def __init__(self, config: Config, devices: Optional[List] = None):
         import jax
+        from . import PredictorPool
         self._devices = list(devices) if devices is not None \
             else list(jax.local_devices())
-        first = Predictor(config)
-        self._replicas = [first] + [
-            Predictor(config, _shared_from=first)
-            for _ in range(len(self._devices) - 1)]
+        # reuse the library's shared-replica construction (first loads,
+        # rest share artifacts) rather than re-encoding it here
+        self._pool = PredictorPool(config, size=len(self._devices))
+        self._replicas = [self._pool.retrieve(i)
+                          for i in range(len(self._devices))]
         self._rr = 0
         self._lock = threading.Lock()
 
@@ -63,18 +65,20 @@ class DevicePool:
         return [str(d) for d in self._devices]
 
     def run(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
-        import jax
         with self._lock:
             i = self._rr
             self._rr = (self._rr + 1) % len(self._replicas)
-        with jax.default_device(self._devices[i]):
-            return self._replicas[i].run(inputs)
+        return self.run_on(i, inputs)
 
     def run_on(self, idx: int,
                inputs: List[np.ndarray]) -> List[np.ndarray]:
         import jax
         with jax.default_device(self._devices[idx]):
-            return self._replicas[idx].run(inputs)
+            # _execute is the STATELESS form: Predictor.run stages its
+            # result on self._outputs, which concurrent server threads
+            # sharing a replica would race (cross-request output leak)
+            outs = self._replicas[idx]._execute(inputs)
+        return [np.asarray(o) for o in outs]
 
 
 def _pack_npz(arrays: List[np.ndarray]) -> bytes:
@@ -123,10 +127,20 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(n)
         try:
             inputs = _unpack_npz(body)
-            outs = srv.pool.run(inputs)
         except Exception as e:
-            self._reply(400, f"{type(e).__name__}: {e}".encode(),
+            self._reply(400, f"bad payload: {type(e).__name__}".encode(),
                         "text/plain")
+            return
+        try:
+            outs = srv.pool.run(inputs)
+        except ValueError as e:
+            # arity/shape mismatch: the caller's fault
+            self._reply(400, f"bad request: {e}".encode(), "text/plain")
+            return
+        except Exception as e:
+            # device/executable failures are SERVER errors: 500 so load
+            # balancers retry elsewhere; no internal detail in the body
+            self._reply(500, b"inference failed", "text/plain")
             return
         with srv._count_lock:
             srv.request_count += 1

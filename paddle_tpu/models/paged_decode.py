@@ -50,16 +50,29 @@ class PagedKVCache:
 
     def __init__(self, cfg: LlamaPretrainConfig, num_pages: int,
                  pages_max: int, batch: int, page: int = 64,
-                 dtype=None):
+                 dtype=None, kv_quant: Optional[str] = None):
+        if kv_quant not in (None, "int8"):
+            raise ValueError("kv_quant must be None or 'int8'")
         self.cfg = cfg
         self.page = page
         self.pages_max = pages_max
         self.num_pages = num_pages
+        self.kv_quant = kv_quant
         dt = dtype or cfg.dtype
         L = cfg.num_hidden_layers
         nkv, d = cfg.num_key_value_heads, cfg.head_dim
-        self.kpool = jnp.zeros((L, num_pages, nkv, page, d), dt)
-        self.vpool = jnp.zeros((L, num_pages, nkv, page, d), dt)
+        pool_dt = jnp.int8 if kv_quant == "int8" else dt
+        self.kpool = jnp.zeros((L, num_pages, nkv, page, d), pool_dt)
+        self.vpool = jnp.zeros((L, num_pages, nkv, page, d), pool_dt)
+        if kv_quant == "int8":
+            # per-(head, slot) f32 scales — halves cache HBM traffic in
+            # the large-batch decode regime (PERF.md round-4 lever)
+            self.kscale = jnp.ones((L, num_pages, nkv, page),
+                                   jnp.float32)
+            self.vscale = jnp.ones((L, num_pages, nkv, page),
+                                   jnp.float32)
+        else:
+            self.kscale = self.vscale = None
         self._free = list(range(num_pages - 1, 0, -1))   # page 0 reserved
         self.tables = np.zeros((batch, pages_max), np.int32)
         self.lens = np.zeros((batch,), np.int32)
@@ -119,12 +132,17 @@ def _rope_rows(x, theta, pos):
                             x2f * cos + x1f * sin], -1).astype(x.dtype)
 
 
-def _decode_layer(cfg, bp, kp, vp, xc, tables, lens, page_ids, slots):
+def _decode_layer(cfg, bp, kp, vp, xc, tables, lens, page_ids, slots,
+                  ks=None, vs=None):
     """One transformer layer of a paged decode step: append this
     token's K/V into the layer's pool pages, then paged attention +
     block FFN.  Shared by the per-token serving step and the fused
-    generation scan (single source of the decode math)."""
-    from ..ops.pallas.paged_attention import paged_decode_attention
+    generation scan (single source of the decode math).  With
+    ``ks``/``vs`` (scale pools) the pages are int8 and the append
+    quantises per (row, head)."""
+    from ..ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_q8,
+        quantize_kv_token)
 
     n, d = cfg.num_attention_heads, cfg.head_dim
     nkv = cfg.num_key_value_heads
@@ -136,11 +154,21 @@ def _decode_layer(cfg, bp, kp, vp, xc, tables, lens, page_ids, slots):
     v = _mm(y, bp["wv"], dt).reshape(B, 1, nkv, d)
     q = _rope_rows(q, cfg.rope_theta, lens)
     k = _rope_rows(k, cfg.rope_theta, lens)
-    kp = kp.at[page_ids, :, slots, :].set(k[:, 0].astype(kp.dtype))
-    vp = vp.at[page_ids, :, slots, :].set(v[:, 0].astype(vp.dtype))
-    attn = paged_decode_attention(q[:, 0], kp, vp, tables, lens + 1)
+    if ks is not None:
+        kq, kss = quantize_kv_token(k[:, 0])
+        vq, vss = quantize_kv_token(v[:, 0])
+        kp = kp.at[page_ids, :, slots, :].set(kq)
+        vp = vp.at[page_ids, :, slots, :].set(vq)
+        ks = ks.at[page_ids, :, slots].set(kss)
+        vs = vs.at[page_ids, :, slots].set(vss)
+        attn = paged_decode_attention_q8(q[:, 0], kp, vp, ks, vs,
+                                         tables, lens + 1)
+    else:
+        kp = kp.at[page_ids, :, slots, :].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[page_ids, :, slots, :].set(v[:, 0].astype(vp.dtype))
+        attn = paged_decode_attention(q[:, 0], kp, vp, tables, lens + 1)
     out = _block_post_attn(bp, xc, attn[:, None], cfg)
-    return out, kp, vp
+    return out, kp, vp, ks, vs
 
 
 def _pick_token(logits, temperature, key):
@@ -159,25 +187,32 @@ _gen_cache: dict = {}
 
 
 def make_paged_decode_step(cfg: LlamaPretrainConfig,
-                           temperature: float = 0.0):
+                           temperature: float = 0.0,
+                           kv_quant: Optional[str] = None):
     """Jitted ``step(params, kpool, vpool, tables, lens, tok, key)
-    -> (kpool, vpool, next_tok)``.
+    -> (kpool, vpool, next_tok)`` — or, with ``kv_quant="int8"``,
+    ``step(params, kpool, vpool, kscale, vscale, tables, lens, tok,
+    key) -> (kpool, vpool, kscale, vscale, next_tok)``.
 
     ``lens [B]`` = cached context per row BEFORE this token (per-row —
     continuous batching).  ``tok [B]`` = this step's input token.  The
     new K/V land at per-row slot ``lens[b]``; callers bump ``lens`` and
     the page tables on the host (PagedKVCache).
     """
-    from ..ops.pallas.paged_attention import paged_decode_attention
-
-    n, d = cfg.num_attention_heads, cfg.head_dim
-    nkv = cfg.num_key_value_heads
     dt = cfg.dtype
 
-    hit = _step_cache.get((_cfg_key(cfg), temperature))
+    hit = _step_cache.get((_cfg_key(cfg), temperature, kv_quant))
     if hit is not None:
         return hit
 
+    def tail(x, params):
+        h = _rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
+        return _mm(h, params["lm_head"], dt).astype(jnp.float32)
+
+    # pools ride the scan xs->ys (per-layer slices update in place
+    # under donation — a carry formulation was measured to copy the
+    # full pool per layer, 10x slower); the append is one batched
+    # scatter
     def step(params, kpool, vpool, tables, lens, tok, key):
         B = tok.shape[0]
         page = kpool.shape[3]
@@ -185,34 +220,52 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
         page_ids = tables[jnp.arange(B), lens // page]       # [B]
         slots = lens % page                                  # [B]
 
-        # pools ride the scan xs->ys (per-layer slices update in place
-        # under donation — a carry formulation was measured to copy the
-        # full pool per layer, 10x slower); the append is one batched
-        # scatter
         def layer(carry, inp):
             bp, kp, vp = inp
-            out, kp, vp = _decode_layer(cfg, bp, kp, vp, carry, tables,
-                                        lens, page_ids, slots)
+            out, kp, vp, _, _ = _decode_layer(
+                cfg, bp, kp, vp, carry, tables, lens, page_ids, slots)
             return out, (kp, vp)
 
         x, (kpool, vpool) = jax.lax.scan(
             layer, x, (params["blocks"], kpool, vpool))
-        h = _rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
-        logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
-        nxt = _pick_token(logits, temperature, key)
+        nxt = _pick_token(tail(x, params), temperature, key)
         return kpool, vpool, nxt
 
-    # memoised per (cfg, temperature): jax.jit caches by function
+    def step_q8(params, kpool, vpool, kscale, vscale, tables, lens,
+                tok, key):
+        B = tok.shape[0]
+        page = kpool.shape[3]
+        x = jnp.take(params["embed"], tok[:, None], axis=0).astype(dt)
+        page_ids = tables[jnp.arange(B), lens // page]
+        slots = lens % page
+
+        def layer(carry, inp):
+            bp, kp, vp, ks, vs = inp
+            out, kp, vp, ks, vs = _decode_layer(
+                cfg, bp, kp, vp, carry, tables, lens, page_ids, slots,
+                ks, vs)
+            return out, (kp, vp, ks, vs)
+
+        x, (kpool, vpool, kscale, vscale) = jax.lax.scan(
+            layer, x, (params["blocks"], kpool, vpool, kscale, vscale))
+        nxt = _pick_token(tail(x, params), temperature, key)
+        return kpool, vpool, kscale, vscale, nxt
+
+    # memoised per (cfg, temperature, quant): jax.jit caches by function
     # identity, so returning a fresh closure every call would recompile
     # every generate
-    fn = jax.jit(step, donate_argnums=(1, 2))
-    _step_cache[(_cfg_key(cfg), temperature)] = fn
+    if kv_quant == "int8":
+        fn = jax.jit(step_q8, donate_argnums=(1, 2, 3, 4))
+    else:
+        fn = jax.jit(step, donate_argnums=(1, 2))
+    _step_cache[(_cfg_key(cfg), temperature, kv_quant)] = fn
     return fn
 
 
 def make_paged_generate_fused(cfg: LlamaPretrainConfig,
                               max_new_tokens: int,
-                              temperature: float = 0.0):
+                              temperature: float = 0.0,
+                              kv_quant: Optional[str] = None):
     """ONE jitted program for the whole paged generation tail: pages
     for ``lens + max_new_tokens`` are pre-allocated so the block tables
     are CONSTANT across steps, and a ``lax.scan`` advances every row at
@@ -220,52 +273,65 @@ def make_paged_generate_fused(cfg: LlamaPretrainConfig,
     batching — the per-token :func:`make_paged_decode_step` exists for
     serving loops that admit/evict requests between steps; this fused
     form is for generation (one dispatch instead of max_new)."""
-    from ..ops.pallas.paged_attention import paged_decode_attention
-
-    hit = _gen_cache.get((_cfg_key(cfg), max_new_tokens, temperature))
+    hit = _gen_cache.get((_cfg_key(cfg), max_new_tokens, temperature,
+                          kv_quant))
     if hit is not None:
         return hit
 
-    n, d = cfg.num_attention_heads, cfg.head_dim
-    nkv = cfg.num_key_value_heads
     dt = cfg.dtype
+    q8 = kv_quant == "int8"
 
-    def generate(params, kpool, vpool, tables, lens0, tok0, key):
+    def generate(params, kpool, vpool, kscale, vscale, tables, lens0,
+                 tok0, key):
         B = tok0.shape[0]
         page = kpool.shape[3]
 
         def dec_step(carry, _):
-            kpool, vpool, tok, lens, key = carry
+            kpool, vpool, kscale, vscale, tok, lens, key = carry
             x = jnp.take(params["embed"], tok[:, None],
                          axis=0).astype(dt)
             page_ids = tables[jnp.arange(B), lens // page]
             slots = lens % page
 
-            def layer(carry2, inp):
-                bp, kp, vp = inp
-                out, kp, vp = _decode_layer(cfg, bp, kp, vp, carry2,
-                                            tables, lens, page_ids,
-                                            slots)
-                return out, (kp, vp)
+            if q8:
+                def layer(carry2, inp):
+                    bp, kp, vp, ks, vs = inp
+                    out, kp, vp, ks, vs = _decode_layer(
+                        cfg, bp, kp, vp, carry2, tables, lens,
+                        page_ids, slots, ks, vs)
+                    return out, (kp, vp, ks, vs)
 
-            x, (kpool, vpool) = jax.lax.scan(
-                layer, x, (params["blocks"], kpool, vpool))
-            h = _rms_norm(x[:, 0], params["final_norm"],
+                x2, (kpool, vpool, kscale, vscale) = jax.lax.scan(
+                    layer, x,
+                    (params["blocks"], kpool, vpool, kscale, vscale))
+            else:
+                def layer(carry2, inp):
+                    bp, kp, vp = inp
+                    out, kp, vp, _, _ = _decode_layer(
+                        cfg, bp, kp, vp, carry2, tables, lens,
+                        page_ids, slots)
+                    return out, (kp, vp)
+
+                x2, (kpool, vpool) = jax.lax.scan(
+                    layer, x, (params["blocks"], kpool, vpool))
+            h = _rms_norm(x2[:, 0], params["final_norm"],
                           cfg.rms_norm_eps)
             logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
             key, sub = jax.random.split(key)
             nxt = _pick_token(logits, temperature, sub)
-            return (kpool, vpool, nxt, lens + 1, key), nxt
+            return (kpool, vpool, kscale, vscale, nxt, lens + 1,
+                    key), nxt
 
-        carry0 = (kpool, vpool, tok0, jnp.asarray(lens0, jnp.int32),
-                  key)
-        (kpool, vpool, _, _, _), toks = jax.lax.scan(
+        carry0 = (kpool, vpool, kscale, vscale, tok0,
+                  jnp.asarray(lens0, jnp.int32), key)
+        (kpool, vpool, kscale, vscale, _, _, _), toks = jax.lax.scan(
             dec_step, carry0, None, length=max_new_tokens - 1)
-        return kpool, vpool, jnp.concatenate(
+        return kpool, vpool, kscale, vscale, jnp.concatenate(
             [tok0[None], toks], axis=0)
 
-    fn = jax.jit(generate, donate_argnums=(1, 2))
-    _gen_cache[(_cfg_key(cfg), max_new_tokens, temperature)] = fn
+    fn = jax.jit(generate, donate_argnums=(1, 2, 3, 4))
+    _gen_cache[(_cfg_key(cfg), max_new_tokens, temperature,
+                kv_quant)] = fn
     return fn
 
 
@@ -331,6 +397,12 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
 
     x, ks, vs = _prefill(cfg)(params, prompt)
     # write prompt K/V into pages: [L, B, S, nkv, d] -> per-row pages
+    q8 = cache.kv_quant == "int8"
+    kscale_pool = vscale_pool = None
+    if q8:
+        from ..ops.pallas.paged_attention import quantize_kv_token
+        ks, ks_s = quantize_kv_token(ks)     # scales [L, B, S, nkv]
+        vs, vs_s = quantize_kv_token(vs)
     S_pad = ((S + page - 1) // page) * page
     ks = jnp.pad(ks, ((0, 0), (0, 0), (0, S_pad - S), (0, 0), (0, 0)))
     vs = jnp.pad(vs, ((0, 0), (0, 0), (0, S_pad - S), (0, 0), (0, 0)))
@@ -346,6 +418,17 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
     used = cache.tables[:, :npg].copy()              # [B, npg]
     kpool = cache.kpool.at[:, used].set(ks.astype(cache.kpool.dtype))
     vpool = cache.vpool.at[:, used].set(vs.astype(cache.vpool.dtype))
+    if q8:
+        ks_s = jnp.pad(ks_s, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)),
+                       constant_values=1.0)
+        vs_s = jnp.pad(vs_s, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)),
+                       constant_values=1.0)
+        ks_s = ks_s.reshape(ks_s.shape[0], B, npg, page,
+                            nkv).transpose(0, 1, 2, 4, 3)
+        vs_s = vs_s.reshape(vs_s.shape[0], B, npg, page,
+                            nkv).transpose(0, 1, 2, 4, 3)
+        kscale_pool = cache.kscale.at[:, used].set(ks_s)
+        vscale_pool = cache.vscale.at[:, used].set(vs_s)
 
     # per-row last REAL token's logits (rows may be shorter than S)
     last_idx = jnp.asarray(lens_np - 1)
@@ -366,17 +449,27 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
         for b in range(B):
             cache.ensure_capacity(b, new_tokens=max_new_tokens)
         gen = make_paged_generate_fused(cfg, max_new_tokens,
-                                        temperature)
+                                        temperature,
+                                        kv_quant=cache.kv_quant)
         key, sub = jax.random.split(key)
-        kpool, vpool, toks = gen(params, kpool, vpool,
-                                 jnp.asarray(cache.tables.copy()),
-                                 jnp.asarray(saved_lens), tok, sub)
+        # two DISTINCT dummies: both args are donated and donating one
+        # buffer twice is an error
+        kpool, vpool, ksp, vsp, toks = gen(
+            params, kpool, vpool,
+            kscale_pool if q8 else jnp.zeros((1,), jnp.float32),
+            vscale_pool if q8 else jnp.zeros((1,), jnp.float32),
+            jnp.asarray(cache.tables.copy()),
+            jnp.asarray(saved_lens), tok, sub)
         cache.kpool, cache.vpool = kpool, vpool
+        if q8:
+            cache.kscale, cache.vscale = ksp, vsp
         cache.lens = saved_lens + max_new_tokens - 1
         return jnp.transpose(toks)                   # [B, max_new]
 
-    step = make_paged_decode_step(cfg, temperature)
+    step = make_paged_decode_step(cfg, temperature,
+                                  kv_quant=cache.kv_quant)
     out_toks = [tok]
+    ksp, vsp = (kscale_pool, vscale_pool) if q8 else (None, None)
     for _ in range(max_new_tokens - 1):
         for b in range(B):
             cache.ensure_capacity(b)
@@ -388,9 +481,15 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
         tables = jnp.asarray(cache.tables.copy())
         lens = jnp.asarray(cache.lens.copy())
         key, sub = jax.random.split(key)
-        kpool, vpool, tok = step(params, kpool, vpool, tables, lens,
-                                 tok, sub)
+        if q8:
+            kpool, vpool, ksp, vsp, tok = step(
+                params, kpool, vpool, ksp, vsp, tables, lens, tok, sub)
+        else:
+            kpool, vpool, tok = step(params, kpool, vpool, tables,
+                                     lens, tok, sub)
         cache.lens = cache.lens + 1     # rebind, never mutate in place
         out_toks.append(tok)
     cache.kpool, cache.vpool = kpool, vpool
+    if q8:
+        cache.kscale, cache.vscale = ksp, vsp
     return jnp.stack(out_toks, axis=1)               # [B, max_new]

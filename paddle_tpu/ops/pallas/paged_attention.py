@@ -34,11 +34,72 @@ from jax.experimental.pallas import tpu as pltpu
 from ._common import idx32
 from .flash_attention import NEG_INF, _interpret
 
-__all__ = ["paged_decode_attention", "paged_decode_attention_xla"]
+__all__ = ["paged_decode_attention", "paged_decode_attention_xla",
+           "paged_decode_attention_q8", "quantize_kv_token"]
 
 
 def _i32(x):
     return jnp.int32(x)
+
+
+def _kernel_q8(tables_ref, lens_ref, q_ref, kp_ref, vp_ref, ks_ref,
+               vs_ref, o_ref, m_ref, l_ref, acc_ref, *, page: int,
+               nkv: int, pages_max: int, sm_scale: float):
+    """int8-KV variant: pages carry int8 K/V plus per-(head, slot) f32
+    scales — HALF the cache HBM traffic of bf16 pages, which is the
+    binding resource in the large-batch decode regime (PERF.md).
+    Dequant happens in VMEM after the DMA (the bf16 copy never exists
+    in HBM — same trade as the weight-only int8 matmul kernel)."""
+    b = pl.program_id(0).astype(jnp.int32)
+    j = pl.program_id(1).astype(jnp.int32)
+    n, d = q_ref.shape
+    g = n // nkv
+    ln = lens_ref[b]
+    used = (ln + _i32(page) - _i32(1)) // _i32(page)
+
+    @pl.when(j == _i32(0))
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < used)
+    def _page():
+        q = q_ref[:].reshape(nkv, g, d)
+        ks = ks_ref[:]                          # [nkv, page] f32
+        vs = vs_ref[:]
+        # the int8 pages feed the MXU directly as bf16 (the
+        # int8_matmul pattern); the per-(head, slot) scales fold into
+        # the LOGITS and the PROBABILITIES instead — both are [.., page]
+        # with page on the minor dim, so no d-axis dequant broadcast:
+        #   q·(k_q·ks) == (q·k_q)·ks   and   Σ p·(v_q·vs) == Σ (p·vs)·v_q
+        k = kp_ref[:].astype(jnp.bfloat16)
+        v = vp_ref[:].astype(jnp.bfloat16)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        s = s * ks[:, None, :] * jnp.float32(sm_scale)
+        pos = j * _i32(page) + jax.lax.broadcasted_iota(
+            jnp.int32, (nkv, g, page), 2)
+        valid = pos < ln
+        s = jnp.where(valid, s, jnp.float32(NEG_INF))
+        m_prev = m_ref[:].reshape(nkv, g, 128)[:, :, :1]
+        l_prev = l_ref[:].reshape(nkv, g, 128)[:, :, :1]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.where(valid, jnp.exp(s - m_new), jnp.float32(0.0))
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=2, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_new, (nkv, g, 128)).reshape(n, 128)
+        m_ref[:] = jnp.broadcast_to(m_new, (nkv, g, 128)).reshape(n, 128)
+        pv = jax.lax.dot_general(
+            (p * vs[:, None, :]).astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha.reshape(n, 1) + pv.reshape(n, d)
+
+    l_safe = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+    o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
 def _kernel(tables_ref, lens_ref, q_ref, kp_ref, vp_ref, o_ref,
@@ -180,4 +241,93 @@ def paged_decode_attention(q, kpool, vpool, block_tables, context_lens,
         out_shape=jax.ShapeDtypeStruct((B, n, d), q.dtype),
         interpret=_interpret(),
     )(tables, lens, q, kpool, vpool)
+    return out
+
+
+def quantize_kv_token(k):
+    """Per-(row, head) symmetric int8 quantisation of one token's K or
+    V [B, nkv, d] -> (int8 [B, nkv, d], f32 scale [B, nkv])."""
+    kf = k.astype(jnp.float32)
+    s = jnp.max(jnp.abs(kf), axis=-1) / 127.0
+    s = jnp.where(s == 0, 1.0, s)
+    q = jnp.clip(jnp.round(kf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def paged_decode_attention_q8_xla(q, kpool, vpool, kscale, vscale,
+                                  block_tables, context_lens,
+                                  sm_scale=None):
+    """XLA oracle/off-TPU path for the int8-KV pools: dequantise the
+    gathered pages and reuse the fp reference."""
+    tables = jnp.asarray(block_tables, jnp.int32)
+    kg = jnp.take(kpool, tables, axis=0).astype(jnp.float32)
+    vg = jnp.take(vpool, tables, axis=0).astype(jnp.float32)
+    ksg = jnp.take(kscale, tables, axis=0)      # [B, pm, nkv, page]
+    vsg = jnp.take(vscale, tables, axis=0)
+    kg = (kg * ksg[..., None]).astype(q.dtype)
+    vg = (vg * vsg[..., None]).astype(q.dtype)
+    B, pm, nkv, page, d = kg.shape
+    # re-pack as bf16 pools indexed by identity tables
+    ident = jnp.arange(B * pm, dtype=jnp.int32).reshape(B, pm)
+    return paged_decode_attention_xla(
+        q, kg.reshape(B * pm, nkv, page, d),
+        vg.reshape(B * pm, nkv, page, d), ident, context_lens, sm_scale)
+
+
+def paged_decode_attention_q8(q, kpool, vpool, kscale, vscale,
+                              block_tables, context_lens,
+                              sm_scale=None, force_kernel=False):
+    """int8-KV paged decode attention.
+
+    kpool/vpool:    [num_pages, nkv, page, d] int8
+    kscale/vscale:  [num_pages, nkv, page] f32 (per head x slot)
+    Other args/semantics as :func:`paged_decode_attention`.
+    """
+    B, n, d = q.shape
+    num_pages, nkv, page, _ = kpool.shape
+    pages_max = block_tables.shape[1]
+    sm_scale = sm_scale or (1.0 / math.sqrt(d))
+    if _interpret() and not force_kernel:
+        return paged_decode_attention_q8_xla(
+            q, kpool, vpool, kscale, vscale, block_tables,
+            context_lens, sm_scale)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(context_lens, jnp.int32)
+    out = pl.pallas_call(
+        functools.partial(_kernel_q8, page=page, nkv=nkv,
+                          pages_max=pages_max, sm_scale=sm_scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, pages_max),
+            in_specs=[
+                pl.BlockSpec((None, n, d),
+                             lambda b, j, *_: idx32(b, 0, 0)),
+                pl.BlockSpec(
+                    (None, nkv, page, d),
+                    lambda b, j, tables, lens: idx32(
+                        tables[b, j], 0, 0, 0)),
+                pl.BlockSpec(
+                    (None, nkv, page, d),
+                    lambda b, j, tables, lens: idx32(
+                        tables[b, j], 0, 0, 0)),
+                pl.BlockSpec(
+                    (None, nkv, page),
+                    lambda b, j, tables, lens: idx32(
+                        tables[b, j], 0, 0)),
+                pl.BlockSpec(
+                    (None, nkv, page),
+                    lambda b, j, tables, lens: idx32(
+                        tables[b, j], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, n, d),
+                                   lambda b, j, *_: idx32(b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n, 128), jnp.float32),     # m
+                pltpu.VMEM((n, 128), jnp.float32),     # l
+                pltpu.VMEM((n, d), jnp.float32),       # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, n, d), q.dtype),
+        interpret=_interpret(),
+    )(tables, lens, q, kpool, vpool, kscale, vscale)
     return out

@@ -80,3 +80,29 @@ def test_http_server_round_trip(artifact):
                                    rtol=1e-5, atol=1e-6)
     finally:
         srv.stop()
+
+
+def test_concurrent_requests_no_cross_leak(artifact):
+    """Review regression: concurrent callers sharing ONE replica must
+    each get their own outputs (Predictor.run's staged self._outputs
+    would race; the pool uses the stateless _execute form)."""
+    import threading
+    prog, x, y = artifact
+    pool = DevicePool(Config(prog_file=prog),
+                      devices=jax.local_devices()[:1])
+    errs = []
+
+    def worker(i):
+        xi = (x + i).astype(np.float32)
+        want = pool.run_on(0, [xi])[0]          # sequential reference
+        for _ in range(10):
+            got = pool.run([xi])[0]
+            if not np.allclose(got, want, atol=1e-5):
+                errs.append(i)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, f"cross-request leaks from threads {errs}"

@@ -244,3 +244,64 @@ def test_block_multihead_attention_prefill_then_decode():
                 np.testing.assert_allclose(
                     out_d.numpy()[b, h * g + gg], ref, atol=2e-4)
         off += L
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_generate_paged_int8_kv_close_to_fp(fused):
+    """int8 KV pages (the round-4 cache-traffic lever): greedy decode
+    with quantized cache must track the fp cache closely — identical
+    early tokens, bounded divergence later (PTQ noise compounds)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(3)
+    B, PL, NEW = 2, 16, 10
+    prompt = rng.randint(0, 128, (B, PL))
+
+    def run(kv_quant):
+        cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=B,
+                             page=16, kv_quant=kv_quant)
+        for b in range(B):
+            cache.alloc_row(b, PL)
+        return np.asarray(generate_paged(cfg, params, prompt, NEW,
+                                         cache, fused=fused))
+
+    fp = run(None)
+    q8 = run("int8")
+    # first tokens identical; total agreement high (greedy + 8-bit KV)
+    np.testing.assert_array_equal(fp[:, 0], q8[:, 0])
+    agree = float((fp == q8).mean())
+    assert agree >= 0.7, (agree, fp, q8)
+
+
+def test_paged_attention_q8_kernel_parity():
+    """int8-KV kernel (logits/probability scale folding — no d-axis
+    dequant) vs the f32 dequant oracle; bf16-dot rounding bounds the
+    error."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_q8, paged_decode_attention_q8_xla)
+    rng = np.random.RandomState(0)
+    B, n, nkv, d, P = 2, 4, 2, 32, 16
+    pages_max, num_pages = 4, 12
+    kq = jnp.asarray(rng.randint(-127, 128, (num_pages, nkv, P, d)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128, (num_pages, nkv, P, d)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.rand(num_pages, nkv, P) * 0.02 + 0.001,
+                     jnp.float32)
+    vs = jnp.asarray(rng.rand(num_pages, nkv, P) * 0.02 + 0.001,
+                     jnp.float32)
+    q = jnp.asarray(rng.randn(B, n, d).astype(np.float32))
+    lens = np.array([37, 20], np.int32)
+    tables = np.zeros((B, pages_max), np.int32)
+    nf = 1
+    for b in range(B):
+        for j in range((lens[b] + P - 1) // P):
+            tables[b, j] = nf
+            nf += 1
+    out_k = paged_decode_attention_q8(
+        q, kq, vq, ks, vs, jnp.asarray(tables), jnp.asarray(lens),
+        force_kernel=True)
+    out_x = paged_decode_attention_q8_xla(
+        q, kq, vq, ks, vs, jnp.asarray(tables), jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                               atol=5e-3)
