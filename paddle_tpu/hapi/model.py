@@ -54,8 +54,43 @@ class _DynamicGraphAdapter:
     def reset_jit_eligibility(self) -> None:
         """Called at the top of each fit()/evaluate run: an earlier
         accumulation run must not PERMANENTLY pin this Model to the
-        eager loop (the compiled step is rebuilt lazily)."""
+        eager loop (the compiled step is rebuilt lazily); a transient
+        eval-side failure likewise must not pin evaluate/predict."""
         self._jit_unavailable = False
+        self._jit_eval_unavailable = False
+
+    def _compiled_eval(self):
+        """Lazy jitted forward for evaluate/predict (same per-op
+        dispatch cliff as training; see jit_eval_step)."""
+        if getattr(self, "_jit_eval_unavailable", False):
+            return None
+        from ..jit import StaticFunction
+        if isinstance(self.model.network, StaticFunction):
+            # prepare(jit=True) already compiled the forward; nesting
+            # jit_eval_step around it would re-trace the proxy's
+            # machinery (and bake its per-call rng key as a constant)
+            return None
+        fwd = getattr(self, "_jit_eval", None)
+        if fwd is None:
+            from ..incubate.jit_train import jit_eval_step
+            fwd = self._jit_eval = jit_eval_step(self.model.network)
+        return fwd
+
+    def _eval_outputs(self, inputs):
+        """Forward through the compiled path with warned fallback."""
+        fwd = self._compiled_eval()
+        if fwd is not None:
+            try:
+                return _to_list(fwd(tuple(inputs)))
+            except Exception as e:
+                self._jit_eval_unavailable = True
+                self._jit_eval = None
+                import warnings
+                warnings.warn(
+                    f"Model.evaluate/predict: compiled forward rejected "
+                    f"this model ({type(e).__name__}: {str(e)[:120]}); "
+                    f"running eagerly", stacklevel=3)
+        return _to_list(self.model.network(*inputs))
 
     def _compiled_step(self):
         """Build (once) the whole-program compiled train step when the
@@ -210,7 +245,7 @@ class _DynamicGraphAdapter:
                   for i in _to_list(inputs)]
         labels = [to_tensor(l) if not isinstance(l, Tensor) else l
                   for l in _to_list(labels)]
-        outputs = _to_list(net(*inputs))
+        outputs = self._eval_outputs(inputs)
         metrics = []
         loss_vals = None
         if m._loss:
@@ -231,7 +266,7 @@ class _DynamicGraphAdapter:
         net.eval()
         inputs = [to_tensor(i) if not isinstance(i, Tensor) else i
                   for i in _to_list(inputs)]
-        outputs = _to_list(net(*inputs))
+        outputs = self._eval_outputs(inputs)
         return [o.numpy() for o in outputs]
 
 
@@ -373,6 +408,7 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
+        self._adapter.reset_jit_eligibility()
         if isinstance(eval_data, Dataset):
             loader = DataLoader(eval_data, batch_size=batch_size,
                                 num_workers=num_workers)
@@ -408,6 +444,7 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):
+        self._adapter.reset_jit_eligibility()
         if isinstance(test_data, Dataset):
             loader = DataLoader(test_data, batch_size=batch_size,
                                 num_workers=num_workers)

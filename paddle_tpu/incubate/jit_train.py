@@ -40,7 +40,7 @@ from ..nn.clip import ClipGradByGlobalNorm
 from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor, wrap_array
 
-__all__ = ["jit_train_step"]
+__all__ = ["jit_train_step", "jit_eval_step"]
 
 
 def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
@@ -219,3 +219,48 @@ def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
         return wrap_array(loss)
 
     return step
+
+
+def jit_eval_step(model: Layer):
+    """Compile ``model(*x)`` (eval mode, no grads) into one jitted
+    program — the inference-side counterpart of :func:`jit_train_step`
+    (hapi's evaluate/predict loops pay the same per-op dispatch cliff
+    the fit loop did).  Returns ``fwd(x) -> outputs`` where ``x`` may
+    be a Tensor or tuple of Tensors; parameters/buffers are read live
+    each call, so it stays correct across training steps.  RNG ops in
+    the forward (sampling heads, MC-dropout-style layers) get a fresh
+    per-call key via the same traced-key threading as the train step —
+    a host draw at trace time would bake ONE sample into the program."""
+    from ..framework import random as framework_random
+
+    p_objs = dict(model.named_parameters())
+    buf_objs = dict(model.named_buffers())
+    rng_root = framework_random.draw_step_root()
+    counter = [0]
+
+    # _functional_call enters the functional-trace guard itself
+    def fwd_of(pvals, bvals, x, rng):
+        xs = tuple(wrap_array(a) for a in x) if isinstance(x, tuple) \
+            else (wrap_array(x),)
+        with framework_random.traced_key_guard(rng):
+            out = model._functional_call(pvals, *xs, buffers=bvals)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    compiled = jax.jit(fwd_of)
+
+    def _arr(v):
+        if isinstance(v, (tuple, list)):
+            return tuple(_arr(e) for e in v)
+        return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    def fwd(x):
+        pvals = {n: p._data for n, p in p_objs.items()}
+        bvals = {n: b._data for n, b in buf_objs.items()}
+        rng = framework_random.make_step_key(rng_root, counter[0])
+        counter[0] += 1
+        outs = compiled(pvals, bvals, _arr(x), rng)
+        return jax.tree_util.tree_map(wrap_array, outs)
+
+    return fwd

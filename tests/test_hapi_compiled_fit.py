@@ -124,3 +124,31 @@ def test_fit_grad_accumulation_stays_eager():
     model.fit(_DS(16), batch_size=4, epochs=1, verbose=0,
               accumulate_grad_batches=2)
     assert model._adapter._jit_step is None
+
+
+def test_evaluate_and_predict_use_compiled_forward():
+    """evaluate/predict ride the jitted forward (jit_eval_step) —
+    results match the eager network exactly, including after training
+    steps mutate the parameters (live reads)."""
+    net = _net(13)
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(), metrics=Accuracy())
+    ds = _DS()
+    r1 = model.evaluate(ds, batch_size=8, verbose=0)
+    assert model._adapter._jit_eval is not None
+    # eager oracle for the same data
+    xs = paddle.to_tensor(ds.x)
+    eager = net(xs).numpy()
+    pred = np.concatenate(model.predict(ds, batch_size=8,
+                                        verbose=0)[0])
+    np.testing.assert_allclose(pred, eager, atol=1e-5)
+    # train, then evaluate again: compiled forward sees NEW params
+    model.fit(ds, batch_size=8, epochs=1, verbose=0)
+    pred2 = np.concatenate(model.predict(ds, batch_size=8,
+                                         verbose=0)[0])
+    eager2 = net(xs).numpy()
+    np.testing.assert_allclose(pred2, eager2, atol=1e-5)
+    assert not np.allclose(pred, pred2)
