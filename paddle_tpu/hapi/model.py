@@ -49,6 +49,8 @@ class _DynamicGraphAdapter:
         self.model = model
         self._jit_step = None
         self._jit_unavailable = False
+        self._jit_eval = None
+        self._jit_eval_unavailable = False
         self._loss_arity = None
 
     def reset_jit_eligibility(self) -> None:
@@ -62,7 +64,7 @@ class _DynamicGraphAdapter:
     def _compiled_eval(self):
         """Lazy jitted forward for evaluate/predict (same per-op
         dispatch cliff as training; see jit_eval_step)."""
-        if getattr(self, "_jit_eval_unavailable", False):
+        if self._jit_eval_unavailable:
             return None
         from ..jit import StaticFunction
         if isinstance(self.model.network, StaticFunction):
@@ -70,7 +72,7 @@ class _DynamicGraphAdapter:
             # jit_eval_step around it would re-trace the proxy's
             # machinery (and bake its per-call rng key as a constant)
             return None
-        fwd = getattr(self, "_jit_eval", None)
+        fwd = self._jit_eval
         if fwd is None:
             from ..incubate.jit_train import jit_eval_step
             fwd = self._jit_eval = jit_eval_step(self.model.network)

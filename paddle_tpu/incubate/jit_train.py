@@ -42,6 +42,8 @@ from ..tensor.tensor import Tensor, wrap_array
 
 __all__ = ["jit_train_step", "jit_eval_step"]
 
+_EVAL_ROOT_SEQ = 0
+
 
 def jit_train_step(model: Layer, loss_fn: Callable, optimizer,
                    amp_level: str = "O0", amp_dtype: str = "bfloat16",
@@ -235,8 +237,18 @@ def jit_eval_step(model: Layer):
 
     p_objs = dict(model.named_parameters())
     buf_objs = dict(model.named_buffers())
-    rng_root = framework_random.draw_step_root()
+    # root derived WITHOUT advancing the global chain: evaluate() must
+    # not perturb the random stream of a seeded training script the way
+    # a chain draw here would (deterministic under paddle.seed via
+    # initial_seed; a per-build counter separates instances)
+    global _EVAL_ROOT_SEQ
+    _EVAL_ROOT_SEQ += 1
+    rng_root = (framework_random.default_generator.initial_seed()
+                ^ (0xA5EDC0DE + _EVAL_ROOT_SEQ)) & 0xFFFFFFFF
     counter = [0]
+    # the forward's train/eval mode is BAKED at trace time; flipping it
+    # later must be loud, not silently ignored
+    mode_snapshot = model.training
 
     # _functional_call enters the functional-trace guard itself
     def fwd_of(pvals, bvals, x, rng):
@@ -256,6 +268,13 @@ def jit_eval_step(model: Layer):
         return v._data if isinstance(v, Tensor) else jnp.asarray(v)
 
     def fwd(x):
+        if model.training != mode_snapshot:
+            raise RuntimeError(
+                "jit_eval_step compiled this model in "
+                f"{'train' if mode_snapshot else 'eval'} mode but it "
+                "is now in the other mode — rebuild the step after "
+                "train()/eval() flips (the traced program bakes the "
+                "mode)")
         pvals = {n: p._data for n, p in p_objs.items()}
         bvals = {n: b._data for n, b in buf_objs.items()}
         rng = framework_random.make_step_key(rng_root, counter[0])

@@ -110,6 +110,37 @@ class PagedKVCache:
             self.tables[b, len(self._owned[b])] = pid
             self._owned[b].append(pid)
 
+    def write_row_pages(self, slot: int, ks, vs, L: int) -> None:
+        """Write one row's prefill K/V (``[Lyr, S>=L, nkv, d]``, layer-
+        major) into its allocated pages, quantising when the cache is
+        int8.  Single source of the page-layout transpose — the engine
+        admission path uses this; generate_paged's batched multi-row
+        write mirrors it for local (donation-managed) pool variables."""
+        page = self.page
+        npg = (L + page - 1) // page
+        Wp = npg * page
+        if ks.shape[1] < Wp:
+            raise ValueError(
+                f"prefill output covers {ks.shape[1]} slots but the "
+                f"row needs {Wp} (pad the prefill to a page multiple)")
+        ks = ks[:, :Wp]
+        vs = vs[:, :Wp]
+        if self.kv_quant == "int8":
+            from ..ops.pallas.paged_attention import quantize_kv_token
+            ks, ks_s = quantize_kv_token(ks)
+            vs, vs_s = quantize_kv_token(vs)
+        Lyr, nkv, d = ks.shape[0], ks.shape[2], ks.shape[3]
+        kb = ks.reshape(Lyr, npg, page, nkv, d).transpose(0, 1, 3, 2, 4)
+        vb = vs.reshape(Lyr, npg, page, nkv, d).transpose(0, 1, 3, 2, 4)
+        ids = self.tables[slot, :npg].copy()
+        self.kpool = self.kpool.at[:, ids].set(kb.astype(self.kpool.dtype))
+        self.vpool = self.vpool.at[:, ids].set(vb.astype(self.vpool.dtype))
+        if self.kv_quant == "int8":
+            ks_s = ks_s.reshape(Lyr, npg, page, nkv).transpose(0, 1, 3, 2)
+            vs_s = vs_s.reshape(Lyr, npg, page, nkv).transpose(0, 1, 3, 2)
+            self.kscale = self.kscale.at[:, ids].set(ks_s)
+            self.vscale = self.vscale.at[:, ids].set(vs_s)
+
     def release_row(self, b: int) -> None:
         for pid in self._owned[b]:
             self._free.append(pid)

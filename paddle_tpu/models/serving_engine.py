@@ -1,0 +1,192 @@
+"""Continuous-batching LLM serving engine over the paged KV cache.
+
+Reference role: the serving loop the reference's block-cache op exists
+for — admit requests into a fixed decode batch as slots free up,
+prefill newcomers, decode everyone in lockstep, evict on finish
+(PaddleNLP's dynamic-batching inference server over
+block_multihead_attention; fleet_executor dist_model serving).
+
+TPU-native shape: the decode batch is FIXED SIZE (one compiled step
+serves forever — no retracing as requests come and go); per-row block
+tables + lengths make rows independent, so a slot is just (table row,
+lens entry).  Admission prefills the new request alone (one jitted
+prefill per distinct prompt-length bucket) and writes its pages; the
+shared per-token step then advances every active slot.  Inactive slots
+carry ``lens = 0`` and attend nothing (the kernel visits zero pages).
+
+The engine is deliberately host-simple: a queue, a free-slot list, and
+numpy bookkeeping — the device work is the two jitted programs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
+from .paged_decode import (PagedKVCache, _prefill, _pick_token,
+                           make_paged_decode_step)
+
+__all__ = ["ContinuousBatchingEngine", "Request"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                    # [len] int64
+    max_new_tokens: int
+    generated: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    done: bool = False
+
+
+class ContinuousBatchingEngine:
+    """``submit()`` requests, call ``step()`` in a loop; finished
+    requests appear in ``finished()``.
+
+    ``eos_id``: generation stops at this token (or at the request's
+    ``max_new_tokens``).  The decode step compiles ONCE for the engine's
+    batch size; prefill compiles once per prompt-length bucket
+    (lengths are padded up to ``prefill_bucket``).
+    """
+
+    def __init__(self, cfg: LlamaPretrainConfig, params,
+                 cache: PagedKVCache, eos_id: Optional[int] = None,
+                 temperature: float = 0.0, seed: int = 0,
+                 prefill_bucket: int = 64):
+        self.cfg = cfg
+        self.params = params
+        self.cache = cache
+        self.eos_id = eos_id
+        self.temperature = temperature
+        # bucket lengths must be page-aligned or the page write would
+        # slice/reshape inconsistently (loud here, confusing there)
+        page = cache.page
+        self.prefill_bucket = ((max(prefill_bucket, page) + page - 1)
+                               // page) * page
+        self.B = cache.tables.shape[0]
+        self._free_slots = list(range(self.B))
+        self._queue: deque = deque()
+        self._active: Dict[int, Request] = {}       # slot -> request
+        self._finished: List[Request] = []
+        self._next_rid = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._step = make_paged_decode_step(cfg, temperature,
+                                            kv_quant=cache.kv_quant)
+        self._next_tok = np.zeros((self.B,), np.int64)
+        self._remaining = np.zeros((self.B,), np.int64)
+
+    # -- client side ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 64) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(Request(rid, np.asarray(prompt, np.int64),
+                                   max_new_tokens))
+        return rid
+
+    def finished(self) -> List[Request]:
+        out, self._finished = self._finished, []
+        return out
+
+    def has_work(self) -> bool:
+        return bool(self._queue or self._active)
+
+    # -- engine side ------------------------------------------------------
+    def _admit(self, req: Request) -> None:
+        slot = self._free_slots.pop()
+        L = len(req.prompt)
+        self.cache.alloc_row(slot, L)
+        # bucketed single-row prefill: one compile per (bucket) length
+        Lp = ((L + self.prefill_bucket - 1) //
+              self.prefill_bucket) * self.prefill_bucket
+        padded = np.zeros((1, Lp), np.int64)
+        padded[0, :L] = req.prompt
+        x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
+        self.cache.write_row_pages(slot, ks[:, 0], vs[:, 0], L)
+        # first token from the last REAL position's logits
+        h = _rms_norm(x[0, L - 1], self.params["final_norm"],
+                      self.cfg.rms_norm_eps)
+        logits = _mm(h, self.params["lm_head"],
+                     self.cfg.dtype).astype(jnp.float32)
+        self._key, sub = jax.random.split(self._key)
+        tok = int(_pick_token(logits[None], self.temperature, sub)[0])
+        req.slot = slot
+        req.generated.append(tok)
+        self._active[slot] = req
+        self._next_tok[slot] = tok
+        self._remaining[slot] = req.max_new_tokens - 1
+        if (self.eos_id is not None and tok == self.eos_id) or \
+                req.max_new_tokens <= 1:
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        req = self._active.pop(slot)
+        req.done = True
+        self.cache.release_row(slot)
+        self._free_slots.append(slot)
+        self._remaining[slot] = 0
+        self._finished.append(req)
+
+    def step(self) -> int:
+        """Admit + one decode token for every active slot.  Returns the
+        number of active requests after the step."""
+        while self._queue and self._free_slots:
+            # admit only when the POOL can hold the prompt: a failed
+            # alloc mid-loop would crash the engine and lose every
+            # in-flight generation.  Head-of-line waiting is fine —
+            # decode steps free pages as requests retire.
+            nxt_req = self._queue[0]
+            need = (len(nxt_req.prompt) + self.cache.page - 1) \
+                // self.cache.page
+            if need > self.cache.free_pages():
+                break
+            self._admit(self._queue.popleft())
+        if not self._active:
+            return 0
+        cache = self.cache
+        for slot in list(self._active):
+            cache.ensure_capacity(slot)
+        tables = jnp.asarray(cache.tables.copy())
+        lens = jnp.asarray(cache.lens.copy())
+        tok = jnp.asarray(self._next_tok.copy())
+        self._key, sub = jax.random.split(self._key)
+        if cache.kv_quant == "int8":
+            (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
+             nxt) = self._step(self.params, cache.kpool, cache.vpool,
+                               cache.kscale, cache.vscale, tables,
+                               lens, tok, sub)
+        else:
+            cache.kpool, cache.vpool, nxt = self._step(
+                self.params, cache.kpool, cache.vpool, tables, lens,
+                tok, sub)
+        cache.lens = cache.lens + (np.asarray(
+            [1 if s in self._active else 0 for s in range(self.B)],
+            np.int32))
+        nxt = np.asarray(nxt)
+        for slot, req in list(self._active.items()):
+            t = int(nxt[slot])
+            req.generated.append(t)
+            self._next_tok[slot] = t
+            self._remaining[slot] -= 1
+            if (self.eos_id is not None and t == self.eos_id) or \
+                    self._remaining[slot] <= 0:
+                self._retire(slot)
+        return len(self._active)
+
+    def run_to_completion(self, max_steps: int = 10_000):
+        """Drive until the queue drains; returns all finished requests
+        in completion order."""
+        out = []
+        steps = 0
+        while self.has_work():
+            self.step()
+            out.extend(self.finished())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("serving loop exceeded max_steps")
+        return out
