@@ -1,17 +1,27 @@
 """Benchmark driver: flagship training throughput on one TPU chip.
 
-Prints TWO JSON lines (one metric each):
+Prints THREE JSON lines (one metric each):
   1. LLaMA 1.345B pretrain tokens/s/chip — fed through the REAL input
      pipeline (paddle_tpu.io.DataLoader, 2 spawned workers, shared
      memory) instead of device-resident buffers, so the number includes
      host batch production + H2D transfer (round-3 verdict item 6).
   2. ResNet50 ``incubate.jit_train_step`` images/s (BASELINE config 2)
      with bf16 AMP O1.
+  3. BERT-base SQuAD-style fine-tune samples/s (BASELINE config 3):
+     12 layers, hidden 768, REAL dropout 0.1, AdamW, AMP O1, b32 s384.
 
 ``vs_baseline`` for line 1 is model-FLOPs-utilisation against the 45%
 MFU a well-tuned A100 LLaMA pretrain achieves; for line 2 it is img/s
-against the ~1,700 img/s A100 mixed-precision ResNet50 bar
-(BASELINE.md; the reference publishes no absolute numbers in-tree).
+against the ~1,700 img/s A100 mixed-precision ResNet50 bar; for line 3
+it is samples/s against the ~180 samples/s top of the A100
+mixed-precision BERT-base fine-tune band (BASELINE.md; the reference
+publishes no absolute numbers in-tree).
+
+Robustness (round-4 verdict item 1): backend init is retried with
+exponential backoff — the axon TPU tunnel can be transiently down —
+and every failure path emits a structured JSON line instead of a raw
+traceback.  Exit code is 0 iff at least one metric line carries a real
+measurement.
 
 What makes the 1.345B fit one 16GB v5e chip (see PERF.md):
   * Adafactor (factored second moment) — optimizer state drops from
@@ -23,6 +33,7 @@ What makes the 1.345B fit one 16GB v5e chip (see PERF.md):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -49,6 +60,60 @@ def _peak_flops(platform: str) -> float:
     if platform in ("tpu", "axon"):
         return 197e12  # v5e; v5p would be 459e12
     return 1e12  # CPU fallback (value is only used for the ratio)
+
+
+def _clear_backends() -> None:
+    """Drop any cached (failed) backend state so a retry actually
+    re-initialises the PjRt client instead of replaying the error."""
+    try:
+        from jax.extend import backend as _eb
+        _eb.clear_backends()
+        return
+    except Exception:
+        pass
+    try:
+        import jax
+        jax.clear_backends()
+    except Exception:
+        pass
+
+
+def _init_devices(max_tries: int = 4, base_delay: float = 15.0):
+    """jax.devices() with retry/backoff.
+
+    The axon tunnel to the TPU can be transiently down ("UNAVAILABLE:
+    TPU backend setup/compile error") — round 4 lost its entire bench
+    capture to exactly that.  Returns (devices, None) on success or
+    (None, error_string) after exhausting retries.
+    """
+    import jax
+    max_tries = int(os.environ.get("PADDLE_TPU_BENCH_INIT_TRIES",
+                                   max_tries))
+    base_delay = float(os.environ.get("PADDLE_TPU_BENCH_INIT_BACKOFF",
+                                      base_delay))
+    last_err = None
+    for attempt in range(max_tries):
+        try:
+            devs = jax.devices()
+            if devs:
+                return devs, None
+            last_err = "jax.devices() returned an empty list"
+        except Exception as e:  # backend init failure
+            last_err = f"{type(e).__name__}: {str(e)[:300]}"
+        if attempt < max_tries - 1:
+            delay = base_delay * (2 ** attempt)
+            print(json.dumps({
+                "event": "backend_init_retry", "attempt": attempt + 1,
+                "of": max_tries, "sleep_s": delay, "error": last_err,
+            }), file=sys.stderr, flush=True)
+            _clear_backends()
+            time.sleep(delay)
+    return None, last_err
+
+
+def _error_line(metric: str, unit: str, err: str) -> dict:
+    return {"metric": metric, "value": 0, "unit": unit,
+            "vs_baseline": 0, "extra": {"error": err[:300]}}
 
 
 def _llama_line() -> dict:
@@ -153,7 +218,6 @@ def _resnet_line() -> dict:
     import numpy as np
 
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
     from paddle_tpu.incubate import jit_train_step
     from paddle_tpu.vision import models as vmodels
 
@@ -199,17 +263,107 @@ def _resnet_line() -> dict:
     }
 
 
+def _bert_line() -> dict:
+    """BASELINE config 3: BERT-base SQuAD-style QA fine-tune through
+    ``incubate.jit_train_step`` — AdamW, AMP O1 bf16, REAL dropout 0.1
+    (per-step PRNG threaded into the trace).  Loss-trajectory parity vs
+    the eager loop is pinned by tests/test_jit_train_step.py::
+    test_jit_train_step_bert_qa_finetune_compiled; this line makes the
+    throughput driver-capturable (round-4 verdict weak item 7)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import jit_train_step
+    from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = BertConfig(dropout_prob=0.1)     # dataclass defaults ARE base
+        batch, seq, steps = 32, 384, 5
+        metric = "bert_base_squad_finetune_samples_per_sec"
+        baseline = 180.0   # top of the A100 mixed-precision band
+    else:
+        cfg = BertConfig(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=2,
+                         intermediate_size=64,
+                         max_position_embeddings=64, dropout_prob=0.1)
+        batch, seq, steps = 4, 16, 2
+        metric = "bert_tiny_cpu_smoke_samples_per_sec"
+        baseline = 1.0
+
+    paddle.seed(55)
+    net = BertForQuestionAnswering(cfg)
+    net.train()
+    opt = paddle.optimizer.AdamW(learning_rate=5e-5,
+                                 parameters=net.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+
+    def qa_loss(out, ys):
+        s_logits, e_logits = out
+        s_y, e_y = ys
+        return (ce(s_logits, s_y) + ce(e_logits, e_y)) * 0.5
+
+    step = jit_train_step(net, qa_loss, opt, amp_level="O1")
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    tt = paddle.to_tensor(np.zeros((batch, seq), np.int64))
+    mask = paddle.to_tensor(np.ones((batch, seq), np.float32))
+    start = paddle.to_tensor(rng.randint(0, seq, (batch,)).astype(np.int64))
+    end = paddle.to_tensor(rng.randint(0, seq, (batch,)).astype(np.int64))
+
+    float(step((ids, tt, mask), (start, end)))   # compile + fence
+    float(step((ids, tt, mask), (start, end)))
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = step((ids, tt, mask), (start, end))
+    loss_val = float(loss)                        # fence
+    dt = time.perf_counter() - t0
+    sps = batch * steps / dt
+    return {
+        "metric": metric,
+        "value": round(sps, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(sps / baseline, 4),
+        "extra": {"platform": platform, "batch": batch, "seq": seq,
+                  "amp": "O1-bf16", "dropout": cfg.dropout_prob,
+                  "optimizer": "adamw", "loss": loss_val,
+                  "step_ms": round(dt / steps * 1000, 1)},
+    }
+
+
 def main() -> None:
-    print(json.dumps(_llama_line()))
-    sys.stdout.flush()
-    try:
-        print(json.dumps(_resnet_line()))
-    except Exception as e:   # the vision line must never kill line 1
-        print(json.dumps({"metric": "resnet50_train_images_per_sec",
-                          "value": 0, "unit": "images/s",
-                          "vs_baseline": 0,
-                          "extra": {"error": f"{type(e).__name__}: "
-                                             f"{str(e)[:200]}"}}))
+    lines = [
+        ("llama_1.3b_pretrain_tokens_per_sec_per_chip", "tokens/s/chip",
+         _llama_line),
+        ("resnet50_train_images_per_sec", "images/s", _resnet_line),
+        ("bert_base_squad_finetune_samples_per_sec", "samples/s",
+         _bert_line),
+    ]
+
+    devs, err = _init_devices()
+    if devs is None:
+        # Structured failure: one parseable error line per metric, no
+        # traceback.  rc=1 tells the driver nothing was measured.
+        for metric, unit, _ in lines:
+            print(json.dumps(_error_line(
+                metric, unit, f"backend init failed after retries: {err}")))
+        sys.stdout.flush()
+        sys.exit(1)
+
+    captured = 0
+    for metric, unit, fn in lines:
+        try:
+            print(json.dumps(fn()))
+            captured += 1
+        except Exception as e:   # one line must never kill the others
+            print(json.dumps(_error_line(
+                metric, unit, f"{type(e).__name__}: {str(e)[:250]}")))
+        sys.stdout.flush()
+    sys.exit(0 if captured else 1)
 
 
 if __name__ == "__main__":

@@ -493,6 +493,15 @@ def block_multihead_attention(
     qkv_t = as_tensor(qkv)
     kc = as_tensor(key_cache)._data
     vc = as_tensor(value_cache)._data
+    for name, c in (("key_cache", kc), ("value_cache", vc)):
+        if not jnp.issubdtype(c.dtype, jnp.floating):
+            # an int8 pool here (quant-scale args already rejected
+            # above) would silently truncate bf16 K/V to garbage via
+            # .astype on the cache write — fail loudly instead
+            raise NotImplementedError(
+                f"block_multihead_attention: {name} dtype {c.dtype} — "
+                "quantised caches are not supported on this op; use "
+                "models.paged_decode.PagedKVCache(kv_quant='int8')")
     tables = jnp.asarray(as_tensor(block_tables)._data, jnp.int32)
     enc = np.asarray(as_tensor(seq_lens_encoder).numpy()).astype(np.int64)
     dec = np.asarray(as_tensor(seq_lens_decoder).numpy()).astype(np.int64)

@@ -50,14 +50,28 @@ _DIGEST_MEMO: Dict[int, Tuple[Any, str]] = {}
 
 
 def _ndarray_sample(v: np.ndarray) -> bytes:
-    """O(1)-ish content fingerprint: a 64-point stride sample.  Guards
-    the digest memo against in-place mutation of a memoised array (the
-    common mutations — fill, slice assignment, += — perturb it)."""
+    """Content fingerprint guarding the digest memo against in-place
+    mutation of a memoised array.  Small arrays (<=64KB) use the FULL
+    bytes — exact, still cheap.  Large arrays combine a 64-point stride
+    sample with a whole-array sum: the sum catches single-element /
+    small-slice writes that fall between the sampled strides (the
+    stride sample alone silently reused a stale digest for those)."""
     flat = v.reshape(-1)
     if flat.size == 0:
         return b""
-    return np.ascontiguousarray(
+    if v.nbytes <= 65536:
+        return np.ascontiguousarray(flat).tobytes()
+    sample = np.ascontiguousarray(
         flat[::max(1, flat.size // 64)]).tobytes()
+    try:
+        # adler32 over the raw bytes: byte-exact (an arithmetic sum is
+        # blind to non-finite overflow and to sum-preserving swaps) and
+        # several times cheaper than re-running sha1
+        import zlib
+        chk = zlib.adler32(np.ascontiguousarray(v)).to_bytes(4, "little")
+    except (TypeError, ValueError, BufferError):    # object arrays
+        chk = b""
+    return sample + chk
 
 
 def _ndarray_digest(v: np.ndarray) -> str:

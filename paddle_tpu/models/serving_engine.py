@@ -43,6 +43,8 @@ class Request:
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
+    admit_seq: int = -1                   # admission order (preemption)
+    preempted: int = 0                    # times evicted + requeued
 
 
 class ContinuousBatchingEngine:
@@ -75,6 +77,7 @@ class ContinuousBatchingEngine:
         self._active: Dict[int, Request] = {}       # slot -> request
         self._finished: List[Request] = []
         self._next_rid = 0
+        self._admit_seq = 0
         self._key = jax.random.PRNGKey(seed)
         self._step = make_paged_decode_step(cfg, temperature,
                                             kv_quant=cache.kv_quant)
@@ -83,10 +86,28 @@ class ContinuousBatchingEngine:
 
     # -- client side ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64) -> int:
+        """Queue a request.  Oversized requests fail HERE with
+        ``ValueError`` — one bad request must never surface mid
+        ``step()`` and kill every in-flight generation (a row's
+        worst-case footprint is bounded by its table width)."""
+        prompt = np.asarray(prompt, np.int64)
+        # bound by BOTH the row's table width and the whole pool (page
+        # 0 is reserved): a request the pool can never hold even alone
+        # would wedge the engine — preemption has no victim to free
+        row_cap = min(self.cache.pages_max,
+                      self.cache.num_pages - 1) * self.cache.page
+        worst = len(prompt) + max_new_tokens
+        if worst > row_cap:
+            raise ValueError(
+                f"request needs up to {worst} cache slots "
+                f"(prompt {len(prompt)} + max_new_tokens "
+                f"{max_new_tokens}) > row capacity {row_cap} "
+                f"(min(pages_max {self.cache.pages_max}, usable pages "
+                f"{self.cache.num_pages - 1}) x page "
+                f"{self.cache.page})")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, np.asarray(prompt, np.int64),
-                                   max_new_tokens))
+        self._queue.append(Request(rid, prompt, max_new_tokens))
         return rid
 
     def finished(self) -> List[Request]:
@@ -98,31 +119,69 @@ class ContinuousBatchingEngine:
 
     # -- engine side ------------------------------------------------------
     def _admit(self, req: Request) -> None:
+        """Prefill ``req`` into a free slot.  A fresh request prefills
+        its prompt and samples the first token; a PREEMPTED request
+        (``req.generated`` non-empty) re-prefills prompt + already-
+        generated context and resumes at its saved next token —
+        recompute-style preemption, the vLLM scheduler's recovery
+        path."""
         slot = self._free_slots.pop()
-        L = len(req.prompt)
+        resume = bool(req.generated)
+        if resume:
+            # cached context on eviction was prompt + generated[:-1];
+            # generated[-1] is the not-yet-fed next input token
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.generated[:-1], np.int64)])
+        else:
+            ctx = req.prompt
+        L = len(ctx)
         self.cache.alloc_row(slot, L)
         # bucketed single-row prefill: one compile per (bucket) length
         Lp = ((L + self.prefill_bucket - 1) //
               self.prefill_bucket) * self.prefill_bucket
         padded = np.zeros((1, Lp), np.int64)
-        padded[0, :L] = req.prompt
+        padded[0, :L] = ctx
         x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
         self.cache.write_row_pages(slot, ks[:, 0], vs[:, 0], L)
-        # first token from the last REAL position's logits
-        h = _rms_norm(x[0, L - 1], self.params["final_norm"],
-                      self.cfg.rms_norm_eps)
-        logits = _mm(h, self.params["lm_head"],
-                     self.cfg.dtype).astype(jnp.float32)
-        self._key, sub = jax.random.split(self._key)
-        tok = int(_pick_token(logits[None], self.temperature, sub)[0])
         req.slot = slot
-        req.generated.append(tok)
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        if resume:
+            tok = req.generated[-1]
+        else:
+            # first token from the last REAL position's logits
+            h = _rms_norm(x[0, L - 1], self.params["final_norm"],
+                          self.cfg.rms_norm_eps)
+            logits = _mm(h, self.params["lm_head"],
+                         self.cfg.dtype).astype(jnp.float32)
+            self._key, sub = jax.random.split(self._key)
+            tok = int(_pick_token(logits[None], self.temperature,
+                                  sub)[0])
+            req.generated.append(tok)
         self._active[slot] = req
         self._next_tok[slot] = tok
-        self._remaining[slot] = req.max_new_tokens - 1
+        self._remaining[slot] = req.max_new_tokens - len(req.generated)
         if (self.eos_id is not None and tok == self.eos_id) or \
-                req.max_new_tokens <= 1:
+                self._remaining[slot] <= 0:
             self._retire(slot)
+
+    def _preempt(self, keep: int) -> bool:
+        """Evict the most recently admitted active request (except slot
+        ``keep``), release its pages, and requeue it at the FRONT of
+        the queue for recompute-style resumption.  Returns False when
+        there is no eligible victim (pool genuinely too small)."""
+        victims = [s for s in self._active if s != keep]
+        if not victims:
+            return False
+        slot = max(victims, key=lambda s: self._active[s].admit_seq)
+        req = self._active.pop(slot)
+        req.slot = None
+        req.preempted += 1
+        self.cache.release_row(slot)
+        self._free_slots.append(slot)
+        self._remaining[slot] = 0
+        self._queue.appendleft(req)
+        return True
 
     def _retire(self, slot: int) -> None:
         req = self._active.pop(slot)
@@ -141,8 +200,10 @@ class ContinuousBatchingEngine:
             # in-flight generation.  Head-of-line waiting is fine —
             # decode steps free pages as requests retire.
             nxt_req = self._queue[0]
-            need = (len(nxt_req.prompt) + self.cache.page - 1) \
-                // self.cache.page
+            # a preempted request re-prefills prompt + generated[:-1]
+            ctx_len = len(nxt_req.prompt) + max(
+                len(nxt_req.generated) - 1, 0)
+            need = (ctx_len + self.cache.page - 1) // self.cache.page
             if need > self.cache.free_pages():
                 break
             self._admit(self._queue.popleft())
@@ -150,7 +211,22 @@ class ContinuousBatchingEngine:
             return 0
         cache = self.cache
         for slot in list(self._active):
-            cache.ensure_capacity(slot)
+            if slot not in self._active:     # evicted by an earlier turn
+                continue
+            while True:
+                try:
+                    cache.ensure_capacity(slot)
+                    break
+                except RuntimeError:
+                    # pool exhausted mid-flight: preempt the youngest
+                    # other request (pages freed, request requeued)
+                    # instead of crashing the engine and losing every
+                    # in-flight generation
+                    if not self._preempt(keep=slot):
+                        raise RuntimeError(
+                            "KV page pool exhausted and no preemption "
+                            "victim remains; the pool is too small for "
+                            "a single request of this length")
         tables = jnp.asarray(cache.tables.copy())
         lens = jnp.asarray(cache.lens.copy())
         tok = jnp.asarray(self._next_tok.copy())

@@ -154,6 +154,27 @@ def test_to_static_static_arg_in_cache_key():
     np.testing.assert_allclose(f(x, 5.0).numpy(), [5.0, 10.0])
 
 
+def test_to_static_ndarray_static_mutation_invalidates_memo():
+    """In-place single-element writes to a LARGE memoised ndarray
+    static must invalidate the digest memo: the old 64-point stride
+    sample could miss them and silently reuse a trace with the wrong
+    baked constant (round-4 advisor finding)."""
+    @paddle.jit.to_static
+    def f(x, table):
+        return x * float(table[100_001])
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    # large enough (>64KB) to take the sampled-digest path
+    table = np.ones((200_000,), np.float32)
+    np.testing.assert_allclose(f(x, table).numpy(), [1.0, 2.0])
+    table[100_001] = 3.0          # a write between sampled strides
+    np.testing.assert_allclose(f(x, table).numpy(), [3.0, 6.0])
+    # sum-preserving swap between strides (an arithmetic checksum is
+    # blind to this; the byte-exact one is not)
+    table[100_001], table[100_003] = 1.0, 3.0
+    np.testing.assert_allclose(f(x, table).numpy(), [1.0, 2.0])
+
+
 def test_builtin_in_predicate_not_shadowed():
     """Builtins/globals in a converted predicate must not be captured
     as branch parameters (they would become UNDEF)."""

@@ -169,6 +169,29 @@ def test_page_allocator_reuse_and_exhaustion():
     assert cache.lens[1] == 40
 
 
+def test_block_multihead_attention_rejects_int8_cache():
+    """A non-float cache dtype must fail loudly: the op's cache write
+    casts K/V to the cache dtype, so an int8 pool would silently
+    truncate bf16 values to garbage (round-4 advisor finding).  The
+    supported int8 path is PagedKVCache(kv_quant='int8')."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.RandomState(7)
+    n, nkv, d, P = 2, 2, 8, 16
+    qkv = rng.randn(4, 3, n, d).astype(np.float32)
+    kc = np.zeros((4, nkv, P, d), np.int8)
+    vc = np.zeros((4, nkv, P, d), np.int8)
+    tables = np.zeros((1, 2), np.int32)
+    with pytest.raises(NotImplementedError, match="dtype"):
+        IF.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc),
+            paddle.to_tensor(vc),
+            paddle.to_tensor(np.asarray([4])),
+            paddle.to_tensor(np.zeros(1, np.int64)),
+            paddle.to_tensor(np.asarray([4])),
+            block_tables=paddle.to_tensor(tables), block_size=P)
+
+
 def test_block_multihead_attention_prefill_then_decode():
     """The incubate API: prefill writes pages + returns packed varlen
     attention; a follow-up decode call appends and attends; both match

@@ -90,6 +90,67 @@ def test_engine_eos_stops_early():
     assert len(done[0].generated) == 2      # stopped at eos, not 10
 
 
+def test_engine_preempts_instead_of_crashing_on_pool_exhaustion():
+    """Two long generations outgrow a pool that admitted both (admission
+    only reserves prompt pages): the engine must preempt the younger
+    request — release its pages, requeue it, resume via recompute — and
+    every request still matches its solo greedy run.  Before the
+    preemption path this deterministically raised 'KV page pool
+    exhausted' mid-step and lost all in-flight requests."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(3)
+    # 4 usable pages (page 0 reserved) of 16 slots; two requests of
+    # prompt 16 + 20 new tokens each want 3 pages at peak => 6 > 4
+    cache = PagedKVCache(cfg, num_pages=5, pages_max=4, batch=2,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=20)
+    done = eng.run_to_completion()
+    assert len(done) == 2
+    assert any(r.preempted > 0 for r in done), \
+        "pool was sized to force preemption"
+    by_rid = sorted(done, key=lambda r: r.rid)
+    for req, prompt in zip(by_rid, prompts):
+        assert len(req.generated) == 20
+        g = make_generate(cfg, prompt_len=len(prompt),
+                          max_new_tokens=20)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref)
+    assert cache.free_pages() == cache.num_pages - 1
+
+
+def test_engine_rejects_oversized_request_at_submit():
+    """A request whose worst case cannot fit one row fails in submit()
+    with ValueError; the engine keeps serving everything else."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(4)
+    cache = PagedKVCache(cfg, num_pages=32, pages_max=4, batch=2,
+                         page=16)          # row capacity 64 slots
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    with pytest.raises(ValueError, match="row capacity"):
+        eng.submit(rng.randint(1, 128, (70,)), max_new_tokens=4)
+    with pytest.raises(ValueError, match="row capacity"):
+        eng.submit(rng.randint(1, 128, (40,)), max_new_tokens=30)
+    prompt = rng.randint(1, 128, (8,))
+    eng.submit(prompt, max_new_tokens=4)
+    done = eng.run_to_completion()
+    assert len(done) == 1 and len(done[0].generated) == 4
+
+    # a request the POOL can never hold even alone (row table is wide
+    # enough, usable pages are not) must also fail at submit — admitted
+    # it would wedge the engine: preemption has no victim to free
+    cache2 = PagedKVCache(cfg, num_pages=3, pages_max=8, batch=1,
+                          page=16)          # 2 usable pages = 32 slots
+    eng2 = ContinuousBatchingEngine(cfg, params, cache2)
+    with pytest.raises(ValueError, match="row capacity"):
+        eng2.submit(rng.randint(1, 128, (16,)), max_new_tokens=40)
+
+
 def test_engine_interleaved_admission():
     """A late submit joins while earlier requests are mid-decode and
     still matches its solo run (slots are truly independent)."""

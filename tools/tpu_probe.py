@@ -1,0 +1,14 @@
+"""30s TPU-tunnel liveness control (memory: run this BEFORE blaming a
+kernel for a hang).  Prints one line: OK <secs> or appends to stderr."""
+import sys, time
+t = time.time()
+import jax, jax.numpy as jnp
+try:
+    d = jax.devices()
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    s = float((x @ x).sum())
+    print(f"OK {time.time()-t:.1f}s platform={d[0].platform} sum={s}",
+          flush=True)
+except Exception as e:
+    print(f"DOWN {type(e).__name__}: {str(e)[:160]}", flush=True)
+    sys.exit(1)
