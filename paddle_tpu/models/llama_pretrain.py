@@ -262,6 +262,15 @@ def _attention(q, k, v, cfg, mesh=None, seg=None):
     — the reference's flash_attn_unpadded/varlen path)."""
     from ..ops.dispatch import get_op_impl
     from ..flags import flags
+
+    def full_heads(k, v):
+        # paths that cannot group natively repeat K/V up to q heads
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return k, v
+
     if cfg.context_parallel and mesh is not None and \
             mesh.shape.get("sep", 1) > 1:
         if seg is not None:
@@ -272,8 +281,11 @@ def _attention(q, k, v, cfg, mesh=None, seg=None):
             ring_attention, ulysses_attention)
         cp = ring_attention if cfg.context_parallel == "ring" \
             else ulysses_attention
+        k, v = full_heads(k, v)
         return cp(q, k, v, mesh, axis="sep", causal=True)
     if seg is not None:
+        # GQA-NATIVE: both the segmented kernel and the oracle take
+        # nkv < n heads directly — no repeated K/V is materialised
         from ..ops.pallas.flash_varlen import (
             flash_attention_segmented, xla_segmented_sdpa)
         if cfg.use_pallas_attention and flags.FLAGS_pallas_flash_attention:
@@ -283,7 +295,9 @@ def _attention(q, k, v, cfg, mesh=None, seg=None):
     impl = get_op_impl("flash_attention", None)
     if impl is not None and cfg.use_pallas_attention and \
             flags.FLAGS_pallas_flash_attention:
+        k, v = full_heads(k, v)
         return impl(q, k, v, causal=True)
+    k, v = full_heads(k, v)
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
     s = logits.shape[-1]
@@ -300,15 +314,30 @@ def _block_pre_attn(bp: Dict[str, Any], x, cfg: LlamaPretrainConfig):
     n, d = cfg.num_attention_heads, cfg.head_dim
     nkv = cfg.num_key_value_heads
     dt = cfg.dtype
-    y = _rms_norm(x, bp["ln1"], cfg.rms_norm_eps)
-    q = (y @ bp["wq"].astype(dt)).reshape(b, s, n, d)
-    k = (y @ bp["wk"].astype(dt)).reshape(b, s, nkv, d)
-    v = (y @ bp["wv"].astype(dt)).reshape(b, s, nkv, d)
+    from ..flags import flags
+    from ..ops.dispatch import get_op_impl
+    rmm = get_op_impl("rmsnorm_matmul", None) \
+        if flags.FLAGS_pallas_rmsnorm_matmul and \
+        not isinstance(bp["wq"], dict) else None
+    if rmm is not None:
+        # block-entry fusion (PERF.md remaining lever): norm computed
+        # inside each matmul kernel, normalised y never hits HBM
+        q = rmm(x, bp["ln1"], bp["wq"].astype(dt),
+                cfg.rms_norm_eps).reshape(b, s, n, d)
+        k = rmm(x, bp["ln1"], bp["wk"].astype(dt),
+                cfg.rms_norm_eps).reshape(b, s, nkv, d)
+        v = rmm(x, bp["ln1"], bp["wv"].astype(dt),
+                cfg.rms_norm_eps).reshape(b, s, nkv, d)
+    else:
+        y = _rms_norm(x, bp["ln1"], cfg.rms_norm_eps)
+        q = (y @ bp["wq"].astype(dt)).reshape(b, s, n, d)
+        k = (y @ bp["wk"].astype(dt)).reshape(b, s, nkv, d)
+        v = (y @ bp["wv"].astype(dt)).reshape(b, s, nkv, d)
     q, k = _rope(q, k, cfg.rope_theta)
-    if nkv != n:
-        rep = n // nkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA stays UN-repeated here: _attention's segmented flash kernel
+    # indexes kv heads by group natively (the whole point of GQA — nkv
+    # heads of K/V HBM traffic, not n); paths that need full heads
+    # repeat at their own entry
     return q, k, v
 
 
@@ -324,6 +353,18 @@ def _block_post_attn(bp: Dict[str, Any], x, attn,
     attn = _ckpt_name(attn.reshape(b, s, h), "attn_out")
     x = x + _mm(attn, bp["wo"], dt)
     res = x
+    rmm = get_op_impl("rmsnorm_matmul", None) \
+        if flags.FLAGS_pallas_rmsnorm_matmul and \
+        not isinstance(bp["w_gate"], dict) else None
+    if rmm is not None:
+        # FFN-entry fusion (PERF.md remaining lever) — int8 weight
+        # dicts keep the _mm path
+        gate = _ckpt_name(jax.nn.silu(rmm(
+            x, bp["ln2"], bp["w_gate"].astype(dt),
+            cfg.rms_norm_eps)), "ffn_gate")
+        up = _ckpt_name(rmm(x, bp["ln2"], bp["w_up"].astype(dt),
+                            cfg.rms_norm_eps), "ffn_up")
+        return res + _mm(gate * up, bp["w_down"], dt)
     y = _rms_norm(x, bp["ln2"], cfg.rms_norm_eps)
     sw = get_op_impl("swiglu", None)
     if sw is not None and flags.FLAGS_pallas_swiglu:

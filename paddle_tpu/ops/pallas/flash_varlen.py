@@ -24,6 +24,13 @@ Design (FlashAttention-2 + block skipping):
 * segments must be contiguous runs (packed layout).  Padding rows get
   a sentinel id; they only attend each other and the caller slices
   them off.
+* GQA is NATIVE: k/v may carry ``nkv < h`` heads (h % nkv == 0, like
+  the reference's varlen kernels taking a separate kv head count).
+  The kernels never materialise repeated K/V — each q head's block
+  specs index its kv GROUP's rows, so cache/HBM traffic stays at nkv
+  heads; the dkv backward accumulates a group's q heads into the
+  shared kv block on an innermost grid axis (TPU grids are
+  sequential, so consecutive revisits accumulate in VMEM).
 """
 
 from __future__ import annotations
@@ -180,10 +187,11 @@ def _bwd_dq_kernel(kmin_ref, kmax_ref, q_ref, k_ref, v_ref, sq_ref,
 
 def _bwd_dkv_kernel(qmin_ref, qmax_ref, q_ref, k_ref, v_ref, sq_ref,
                     sk_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    *, causal, sm_scale, block_q, nheads):
-    i = pl.program_id(0).astype(jnp.int32)
-    ki = pl.program_id(1).astype(jnp.int32)
-    b = i // jnp.int32(nheads)
+                    *, causal, sm_scale, block_q, nkv_heads):
+    i = pl.program_id(0).astype(jnp.int32)     # batch*kv-heads
+    ki = pl.program_id(1).astype(jnp.int32)    # k block
+    g = pl.program_id(2).astype(jnp.int32)     # q head within group
+    b = i // jnp.int32(nkv_heads)
     Bk, d = k_ref.shape
     k = k_ref[:]
     v = v_ref[:]
@@ -221,14 +229,31 @@ def _bwd_dkv_kernel(qmin_ref, qmax_ref, q_ref, k_ref, v_ref, sq_ref,
     dk0 = jnp.zeros((Bk, d), jnp.float32)
     dv0 = jnp.zeros((Bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo_blk, hi_blk, body, (dk0, dv0))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+    # GQA: the group axis g is INNERMOST, so every q head of this kv
+    # head revisits the same (f32) output block consecutively —
+    # initialise on the first member, accumulate on the rest
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[:] = dk
+        dv_ref[:] = dv
+
+    @pl.when(g > 0)
+    def _accum():
+        dk_ref[:] += dk
+        dv_ref[:] += dv
 
 
 def xla_segmented_sdpa(q, k, v, seg, causal):
     """Dense-mask XLA reference (fallback for indivisible shapes; also
-    the parity oracle in tests).  q/k/v [b, s, h, d], seg [b, s]."""
+    the parity oracle in tests).  q [b, s, h, d], k/v [b, s, nkv, d]
+    with nkv dividing h (GQA repeats here — this is the oracle, not
+    the fast path), seg [b, s]."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qf = q.astype(jnp.float32) / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
     m = seg[:, :, None] == seg[:, None, :]          # [b, q, k]
@@ -252,13 +277,18 @@ def _reshape_out(x, b, h):
 
 
 def flash_attention_segmented(q, k, v, segment_ids, causal=False):
-    """Ragged/varlen flash attention: q/k/v [b, s, h, d] PACKED along s,
-    segment_ids [b, s] int32 contiguous runs; attention stays within a
-    segment.  Block-skipping Pallas kernel when a block divides s; XLA
-    dense-mask fallback otherwise."""
+    """Ragged/varlen flash attention: q [b, s, h, d] PACKED along s,
+    k/v [b, s, nkv, d] with nkv dividing h (GQA-native — no K/V
+    repeat is ever materialised), segment_ids [b, s] int32 contiguous
+    runs; attention stays within a segment.  Block-skipping Pallas
+    kernel when a block divides s; XLA dense-mask fallback otherwise."""
     seg = jnp.asarray(segment_ids, jnp.int32)
     if seg.ndim == 1:
         seg = seg[None]
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"q heads {q.shape[2]} must be a multiple of kv heads "
+            f"{k.shape[2]}")
     if _pick_blocks(q.shape[1]) is None:
         return xla_segmented_sdpa(q, k, v, seg, causal)
     return _flash_seg(q, k, v, seg, causal)
@@ -270,8 +300,17 @@ def _flash_seg(q, k, v, seg, causal):
     return out
 
 
+def _kv_row(i, h, nkv):
+    """Grid index i over b*h q-head rows -> the kv-pool row (of b*nkv)
+    holding that head's GROUP.  int32 throughout (x64 trap)."""
+    group = h // nkv
+    return (_div32(i, h) * jnp.int32(nkv)
+            + _div32(jnp.int32(i) % jnp.int32(h), group))
+
+
 def _seg_fwd(q, k, v, seg, causal):
     b, s, h, d = q.shape
+    nkv = k.shape[2]
     sm_scale = 1.0 / math.sqrt(d)
     qr, kr, vr = _reshape_in(q), _reshape_in(k), _reshape_in(v)
     bq, bk = _pick_blocks(s)
@@ -289,9 +328,11 @@ def _seg_fwd(q, k, v, seg, causal):
                 pl.BlockSpec((None, bq, d),
                              lambda i, j, *_: idx32(i, j, 0)),
                 pl.BlockSpec((None, s, d),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, *_, nh=h, nk=nkv:
+                             idx32(_kv_row(i, nh, nk), 0, 0)),
                 pl.BlockSpec((None, s, d),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, *_, nh=h, nk=nkv:
+                             idx32(_kv_row(i, nh, nk), 0, 0)),
                 pl.BlockSpec((None, bq, 1),
                              lambda i, j, *_, nh=h: idx32(_div32(i, nh), j, 0)),
                 pl.BlockSpec((None, 1, s),
@@ -321,6 +362,8 @@ def _seg_bwd_vjp(causal, res, dout):
     bh, s, d = qr.shape
     b = seg.shape[0]
     h = bh // b
+    nkv = kr.shape[0] // b
+    group = h // nkv
     sm_scale = 1.0 / math.sqrt(d)
     do = _reshape_in(dout)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -342,9 +385,11 @@ def _seg_bwd_vjp(causal, res, dout):
                 pl.BlockSpec((None, bq, d),
                              lambda i, j, *_: idx32(i, j, 0)),
                 pl.BlockSpec((None, s, d),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, *_, nh=h, nk=nkv:
+                             idx32(_kv_row(i, nh, nk), 0, 0)),
                 pl.BlockSpec((None, s, d),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, *_, nh=h, nk=nkv:
+                             idx32(_kv_row(i, nh, nk), 0, 0)),
                 pl.BlockSpec((None, bq, 1),
                              lambda i, j, *_, nh=h: idx32(_div32(i, nh), j, 0)),
                 pl.BlockSpec((None, 1, s),
@@ -363,44 +408,59 @@ def _seg_bwd_vjp(causal, res, dout):
         interpret=interp,
     )(kmin, kmax, qr, kr, vr, seg_q, seg_k, do, lse, delta)
 
+    # q-head ROW of the member g of kv head i's group (int32 — x64 trap)
+    def _q_row(i, g):
+        return (_div32(i, nkv) * jnp.int32(h)
+                + (jnp.int32(i) % jnp.int32(nkv)) * jnp.int32(group)
+                + jnp.int32(g))
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal,
-                          sm_scale=sm_scale, block_q=bq, nheads=h),
+                          sm_scale=sm_scale, block_q=bq,
+                          nkv_heads=nkv),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b * h, s // bk),
+            # group INNERMOST: members of a kv group revisit the same
+            # output block on consecutive steps (accumulation contract
+            # of _bwd_dkv_kernel)
+            grid=(b * nkv, s // bk, group),
             in_specs=[
                 pl.BlockSpec((None, s, d),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, g, *_: idx32(_q_row(i, g), 0, 0)),
                 pl.BlockSpec((None, bk, d),
-                             lambda i, j, *_: idx32(i, j, 0)),
+                             lambda i, j, g, *_: idx32(i, j, 0)),
                 pl.BlockSpec((None, bk, d),
-                             lambda i, j, *_: idx32(i, j, 0)),
+                             lambda i, j, g, *_: idx32(i, j, 0)),
                 pl.BlockSpec((None, s, 1),
-                             lambda i, j, *_, nh=h: idx32(_div32(i, nh), 0, 0)),
+                             lambda i, j, g, *_, nk=nkv:
+                             idx32(_div32(i, nk), 0, 0)),
                 pl.BlockSpec((None, 1, bk),
-                             lambda i, j, *_, nh=h: idx32(_div32(i, nh), 0, j)),
+                             lambda i, j, g, *_, nk=nkv:
+                             idx32(_div32(i, nk), 0, j)),
                 pl.BlockSpec((None, s, d),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, g, *_: idx32(_q_row(i, g), 0, 0)),
                 pl.BlockSpec((None, s, 1),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, g, *_: idx32(_q_row(i, g), 0, 0)),
                 pl.BlockSpec((None, s, 1),
-                             lambda i, j, *_: idx32(i, 0, 0)),
+                             lambda i, j, g, *_: idx32(_q_row(i, g), 0, 0)),
             ],
             out_specs=(
                 pl.BlockSpec((None, bk, d),
-                             lambda i, j, *_: idx32(i, j, 0)),
+                             lambda i, j, g, *_: idx32(i, j, 0)),
                 pl.BlockSpec((None, bk, d),
-                             lambda i, j, *_: idx32(i, j, 0)),
+                             lambda i, j, g, *_: idx32(i, j, 0)),
             ),
         ),
-        out_shape=(jax.ShapeDtypeStruct((b * h, s, d), kr.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), vr.dtype)),
+        # f32 accumulators: group members add into the block; cast to
+        # the param dtype only after the whole group has landed
+        out_shape=(jax.ShapeDtypeStruct((b * nkv, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b * nkv, s, d), jnp.float32)),
         interpret=interp,
     )(qmin, qmax, qr, kr, vr, seg_q, seg_k, do, lse, delta)
 
-    return (_reshape_out(dq, b, h), _reshape_out(dk, b, h),
-            _reshape_out(dv, b, h), None)
+    return (_reshape_out(dq, b, h),
+            _reshape_out(dk.astype(kr.dtype), b, nkv),
+            _reshape_out(dv.astype(vr.dtype), b, nkv), None)
 
 
 _flash_seg.defvjp(_seg_fwd_vjp, _seg_bwd_vjp)

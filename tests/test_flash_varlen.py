@@ -60,6 +60,48 @@ def test_segmented_kernel_parity(causal, lens):
                                    atol=5e-4)
 
 
+@pytest.mark.parametrize("hkv", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_segmented_kernel_gqa_native_parity(causal, hkv):
+    """GQA-native kernels: k/v carry nkv < h heads and are NEVER
+    repeated (round-4 verdict item 4 — the reference's varlen kernels
+    take a separate kv head count).  Forward and all three grads must
+    match the repeat-based oracle; dk/dv come back at nkv heads (the
+    group-summed cotangent)."""
+    B, S, H, D = 2, 128, 4, 16
+    rng = np.random.RandomState(hash((causal, hkv)) % 2**31)
+    seg = np.stack([_ragged_seg([40, 24, 8, 56], S),
+                    _ragged_seg([100, 20], S)])
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, hkv, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, hkv, D).astype(np.float32))
+    segj = jnp.asarray(seg)
+
+    out = flash_attention_segmented(q, k, v, segj, causal=causal)
+    ref = xla_segmented_sdpa(q, k, v, segj, causal)
+    assert out.shape == (B, S, H, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+    g = jax.grad(lambda *a: (flash_attention_segmented(
+        *a, segj, causal=causal) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (xla_segmented_sdpa(
+        *a, segj, causal) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    assert g[1].shape == (B, S, hkv, D)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4)
+
+
+def test_segmented_kernel_gqa_rejects_indivisible_heads():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 128, 4, 16).astype(np.float32))
+    kv = jnp.asarray(rng.randn(1, 128, 3, 16).astype(np.float32))
+    seg = jnp.asarray(_ragged_seg([128], 128)[None])
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention_segmented(q, kv, kv, seg, causal=True)
+
+
 def test_segmented_kernel_batched_rows():
     """Segment layouts differing per batch row."""
     B, S, H, D = 2, 64, 2, 8
@@ -167,6 +209,45 @@ def test_packed_pretrain_loss_matches_separate_sequences():
     loss_packed = float(fwd(params, jnp.asarray(packed),
                             jnp.asarray(seg)))
     # oracle: each sequence alone (loss = mean over its la/lb targets)
+    loss_a = float(fwd(params, jnp.asarray(seq_a[None])))
+    loss_b = float(fwd(params, jnp.asarray(seq_b[None])))
+    expect = (loss_a * la + loss_b * lb) / (la + lb)
+    np.testing.assert_allclose(loss_packed, expect, rtol=2e-5)
+
+
+def test_packed_pretrain_gqa_runs_without_repeat():
+    """Packed pretrain at a GQA config (4q/2kv): the segmented path
+    feeds nkv-head K/V straight to the kernel.  Loss must match the
+    per-sequence oracle (which routes through the repeat-based dense
+    path) — same math, kv-head-group indexing instead of repeat."""
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params,
+                                                  make_forward)
+    cfg = LlamaPretrainConfig(
+        vocab_size=64, hidden_size=64, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=128, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1)
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(1), mesh)
+    fwd = make_forward(cfg)
+
+    rng = np.random.RandomState(11)
+    la, lb = 50, 70
+    seq_a = rng.randint(0, 64, (la + 1,))
+    seq_b = rng.randint(0, 64, (lb + 1,))
+    S = 128
+    packed = np.zeros((1, S + 1), np.int64)
+    packed[0, :la + 1] = seq_a
+    packed[0, la + 1:la + lb + 2] = seq_b
+    seg = np.full((1, S + 1), -1, np.int32)
+    seg[0, :la + 1] = 0
+    seg[0, la + 1:la + lb + 2] = 1
+
+    loss_packed = float(fwd(params, jnp.asarray(packed),
+                            jnp.asarray(seg)))
     loss_a = float(fwd(params, jnp.asarray(seq_a[None])))
     loss_b = float(fwd(params, jnp.asarray(seq_b[None])))
     expect = (loss_a * la + loss_b * lb) / (la + lb)
