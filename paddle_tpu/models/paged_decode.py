@@ -36,7 +36,8 @@ import numpy as np
 from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn, _mm,
                              _rms_norm)
 
-__all__ = ["PagedKVCache", "make_paged_decode_step", "generate_paged"]
+__all__ = ["PagedKVCache", "make_paged_decode_step", "generate_paged",
+           "generate_auto"]
 
 
 class PagedKVCache:
@@ -46,11 +47,18 @@ class PagedKVCache:
     reference's ``[max_block_num, kv_num_head, block_size, head_dim]``).
     Page 0 is reserved as the junk page unused table slots point at —
     the kernel skips them, but their ids must stay DMA-valid.
+
+    With ``mesh`` (an mp>1 device mesh) the pools are SHARDED on the
+    kv-head axis — each model-parallel rank stores only its own heads'
+    pages, so a model wider than one chip serves with per-chip cache
+    HBM of nkv/mp heads (the fleet-executor dist-model serving case,
+    reference: fluid/distributed/fleet_executor/dist_model.h:57).
     """
 
     def __init__(self, cfg: LlamaPretrainConfig, num_pages: int,
                  pages_max: int, batch: int, page: int = 64,
-                 dtype=None, kv_quant: Optional[str] = None):
+                 dtype=None, kv_quant: Optional[str] = None,
+                 mesh=None):
         if kv_quant not in (None, "int8"):
             raise ValueError("kv_quant must be None or 'int8'")
         self.cfg = cfg
@@ -58,19 +66,38 @@ class PagedKVCache:
         self.pages_max = pages_max
         self.num_pages = num_pages
         self.kv_quant = kv_quant
+        self.mesh = mesh
         dt = dtype or cfg.dtype
         L = cfg.num_hidden_layers
         nkv, d = cfg.num_key_value_heads, cfg.head_dim
         pool_dt = jnp.int8 if kv_quant == "int8" else dt
-        self.kpool = jnp.zeros((L, num_pages, nkv, page, d), pool_dt)
-        self.vpool = jnp.zeros((L, num_pages, nkv, page, d), pool_dt)
+
+        def _put(x, spec):
+            if mesh is None or mesh.shape.get("mp", 1) == 1:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.device_put(
+                x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+        if mesh is not None and nkv % mesh.shape.get("mp", 1) != 0:
+            raise ValueError(
+                f"kv heads {nkv} must divide over mp="
+                f"{mesh.shape.get('mp', 1)}")
+        self.kpool = _put(jnp.zeros((L, num_pages, nkv, page, d),
+                                    pool_dt),
+                          (None, None, "mp", None, None))
+        self.vpool = _put(jnp.zeros((L, num_pages, nkv, page, d),
+                                    pool_dt),
+                          (None, None, "mp", None, None))
         if kv_quant == "int8":
             # per-(head, slot) f32 scales — halves cache HBM traffic in
             # the large-batch decode regime (PERF.md round-4 lever)
-            self.kscale = jnp.ones((L, num_pages, nkv, page),
-                                   jnp.float32)
-            self.vscale = jnp.ones((L, num_pages, nkv, page),
-                                   jnp.float32)
+            self.kscale = _put(jnp.ones((L, num_pages, nkv, page),
+                                        jnp.float32),
+                               (None, None, "mp", None))
+            self.vscale = _put(jnp.ones((L, num_pages, nkv, page),
+                                        jnp.float32),
+                               (None, None, "mp", None))
         else:
             self.kscale = self.vscale = None
         self._free = list(range(num_pages - 1, 0, -1))   # page 0 reserved
@@ -110,12 +137,15 @@ class PagedKVCache:
             self.tables[b, len(self._owned[b])] = pid
             self._owned[b].append(pid)
 
-    def write_row_pages(self, slot: int, ks, vs, L: int) -> None:
+    def write_row_pages(self, slot: int, ks, vs, L: int,
+                        first_page: int = 0) -> None:
         """Write one row's prefill K/V (``[Lyr, S>=L, nkv, d]``, layer-
         major) into its allocated pages, quantising when the cache is
-        int8.  Single source of the page-layout transpose — the engine
-        admission path uses this; generate_paged's batched multi-row
-        write mirrors it for local (donation-managed) pool variables."""
+        int8.  ``first_page`` offsets into the row's table (chunked
+        prefill appends chunk c at page c*chunk/page).  Single source
+        of the page-layout transpose — the engine admission path uses
+        this; generate_paged's batched multi-row write mirrors it for
+        local (donation-managed) pool variables."""
         page = self.page
         npg = (L + page - 1) // page
         Wp = npg * page
@@ -132,7 +162,7 @@ class PagedKVCache:
         Lyr, nkv, d = ks.shape[0], ks.shape[2], ks.shape[3]
         kb = ks.reshape(Lyr, npg, page, nkv, d).transpose(0, 1, 3, 2, 4)
         vb = vs.reshape(Lyr, npg, page, nkv, d).transpose(0, 1, 3, 2, 4)
-        ids = self.tables[slot, :npg].copy()
+        ids = self.tables[slot, first_page:first_page + npg].copy()
         self.kpool = self.kpool.at[:, ids].set(kb.astype(self.kpool.dtype))
         self.vpool = self.vpool.at[:, ids].set(vb.astype(self.vpool.dtype))
         if self.kv_quant == "int8":
@@ -219,7 +249,8 @@ _gen_cache: dict = {}
 
 def make_paged_decode_step(cfg: LlamaPretrainConfig,
                            temperature: float = 0.0,
-                           kv_quant: Optional[str] = None):
+                           kv_quant: Optional[str] = None,
+                           with_logits: bool = False):
     """Jitted ``step(params, kpool, vpool, tables, lens, tok, key)
     -> (kpool, vpool, next_tok)`` — or, with ``kv_quant="int8"``,
     ``step(params, kpool, vpool, kscale, vscale, tables, lens, tok,
@@ -229,10 +260,16 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
     continuous batching).  ``tok [B]`` = this step's input token.  The
     new K/V land at per-row slot ``lens[b]``; callers bump ``lens`` and
     the page tables on the host (PagedKVCache).
+
+    ``with_logits=True`` appends the f32 ``[B, V]`` logits to the
+    return tuple — the cache-quantisation acceptance harness bounds
+    int8-vs-fp LOGIT error directly instead of counting greedy token
+    agreement (round-4 verdict item 9).
     """
     dt = cfg.dtype
 
-    hit = _step_cache.get((_cfg_key(cfg), temperature, kv_quant))
+    hit = _step_cache.get((_cfg_key(cfg), temperature, kv_quant,
+                           with_logits))
     if hit is not None:
         return hit
 
@@ -259,7 +296,10 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
 
         x, (kpool, vpool) = jax.lax.scan(
             layer, x, (params["blocks"], kpool, vpool))
-        nxt = _pick_token(tail(x, params), temperature, key)
+        logits = tail(x, params)
+        nxt = _pick_token(logits, temperature, key)
+        if with_logits:
+            return kpool, vpool, nxt, logits
         return kpool, vpool, nxt
 
     def step_q8(params, kpool, vpool, kscale, vscale, tables, lens,
@@ -279,7 +319,10 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
 
         x, (kpool, vpool, kscale, vscale) = jax.lax.scan(
             layer, x, (params["blocks"], kpool, vpool, kscale, vscale))
-        nxt = _pick_token(tail(x, params), temperature, key)
+        logits = tail(x, params)
+        nxt = _pick_token(logits, temperature, key)
+        if with_logits:
+            return kpool, vpool, kscale, vscale, nxt, logits
         return kpool, vpool, kscale, vscale, nxt
 
     # memoised per (cfg, temperature, quant): jax.jit caches by function
@@ -289,7 +332,112 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
         fn = jax.jit(step_q8, donate_argnums=(1, 2, 3, 4))
     else:
         fn = jax.jit(step, donate_argnums=(1, 2))
-    _step_cache[(_cfg_key(cfg), temperature, kv_quant)] = fn
+    _step_cache[(_cfg_key(cfg), temperature, kv_quant, with_logits)] = fn
+    return fn
+
+
+_step_tp_cache: dict = {}
+
+
+def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
+                              temperature: float = 0.0,
+                              kv_quant: Optional[str] = None):
+    """TENSOR-PARALLEL paged decode step: the whole per-token program is
+    ONE jitted shard_map over the mesh's ``mp`` axis — Megatron-sharded
+    weights (column q/k/v + gate/up, row wo/w_down with psum),
+    kv-head-sharded page pools, vocab-parallel embed/unembed with an
+    all-gather only on the final [B, V/mp] logits.  This is how a model
+    wider than one chip serves over the paged cache — the TPU-native
+    answer to the reference's fleet-executor DistModel::Run
+    (fluid/distributed/fleet_executor/dist_model.h:61).
+
+    The Pallas paged-attention kernel runs PER SHARD on local heads
+    (heads are embarrassingly parallel in attention), which is why this
+    is shard_map and not GSPMD auto-partitioning — XLA cannot split a
+    pallas_call.  Same signature/caller contract as
+    :func:`make_paged_decode_step`.
+    """
+    if kv_quant == "int8":
+        raise NotImplementedError(
+            "int8 KV pages over a TP mesh: quantize per local head "
+            "shard — not wired yet; serve int8 single-device or bf16 "
+            "sharded")
+    mp = mesh.shape["mp"]
+    hit = _step_tp_cache.get((_cfg_key(cfg), temperature, mesh))
+    if hit is not None:
+        return hit
+
+    from jax.sharding import PartitionSpec as P
+    from .llama_pretrain import param_specs
+    shard_map = jax.shard_map
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    if n % mp or nkv % mp:
+        raise ValueError(f"heads {n}/{nkv} must divide over mp={mp}")
+    n_l, nkv_l = n // mp, nkv // mp
+    dt = cfg.dtype
+    ax = "mp"
+
+    def embed_vp(embed_l, tok):
+        """Vocab-parallel embedding lookup: mask + psum (Megatron
+        VocabParallelEmbedding)."""
+        V_l = embed_l.shape[0]
+        start = jax.lax.axis_index(ax) * V_l
+        local = tok - start
+        ok = (local >= 0) & (local < V_l)
+        x = jnp.take(embed_l, jnp.clip(local, 0, V_l - 1), axis=0)
+        x = jnp.where(ok[..., None], x, 0).astype(dt)
+        return jax.lax.psum(x, ax)
+
+    def step_local(params, kpool, vpool, tables, lens, tok, key):
+        B = tok.shape[0]
+        page = kpool.shape[3]
+        x = embed_vp(params["embed"], tok)            # [B, H] replicated
+        page_ids = tables[jnp.arange(B), lens // page]
+        slots = lens % page
+
+        def layer(carry, inp):
+            bp, kp, vp = inp
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, n_l, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, 1, nkv_l, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, nkv_l, d)
+            q = _rope_rows(q[:, None], cfg.rope_theta, lens)[:, 0]
+            k = _rope_rows(k, cfg.rope_theta, lens)[:, 0]
+            kp = kp.at[page_ids, :, slots, :].set(k.astype(kp.dtype))
+            vp = vp.at[page_ids, :, slots, :].set(v.astype(vp.dtype))
+            attn = paged_decode_attention(q, kp, vp, tables, lens + 1)
+            o = _mm(attn.reshape(B, n_l * d), bp["wo"], dt)
+            xc = xc + jax.lax.psum(o, ax)             # row-parallel
+            res = xc
+            y2 = _rms_norm(xc, bp["ln2"], cfg.rms_norm_eps)
+            act = (jax.nn.silu(_mm(y2, bp["w_gate"], dt))
+                   * _mm(y2, bp["w_up"], dt))
+            ffn = _mm(act, bp["w_down"], dt)
+            return res + jax.lax.psum(ffn, ax), (kp, vp)
+
+        x, (kpool, vpool) = jax.lax.scan(
+            layer, x, (params["blocks"], kpool, vpool))
+        h = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits_l = _mm(h, params["lm_head"], dt).astype(jnp.float32)
+        logits = jax.lax.all_gather(logits_l, ax, axis=1,
+                                    tiled=True)       # [B, V]
+        nxt = _pick_token(logits, temperature, key)
+        return kpool, vpool, nxt
+
+    pool_spec = P(None, None, "mp", None, None)
+    fn = jax.jit(
+        shard_map(
+            step_local, mesh=mesh,
+            in_specs=(param_specs(cfg, pp=1), pool_spec, pool_spec,
+                      P(), P(), P(), P()),
+            out_specs=(pool_spec, pool_spec, P()),
+            check_vma=False),
+        donate_argnums=(1, 2))
+    _step_tp_cache[(_cfg_key(cfg), temperature, mesh)] = fn
     return fn
 
 
@@ -405,6 +553,102 @@ def _prefill(cfg: LlamaPretrainConfig):
 
     _prefill_cache[_cfg_key(cfg)] = prefill
     return prefill
+
+
+def _rope_at(x, theta, pos):
+    """RoPE at explicit positions ``pos [S]`` (chunked prefill: chunk
+    tokens sit at ctx_len + arange(C)); x [B, S, n, d].  Same split-
+    half convention as llama_pretrain._rope (the cached pages were
+    written by it)."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None]     # [S, d/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate([x1f * cos - x2f * sin,
+                            x2f * cos + x1f * sin], -1).astype(x.dtype)
+
+
+_chunk_prefill_cache: dict = {}
+
+
+def _prefill_chunk(cfg: LlamaPretrainConfig, q8: bool):
+    """Memoised jitted CHUNKED prefill-with-history: advance ONE row's
+    prefill by a chunk of tokens, attending to the row's already-cached
+    pages plus causally within the chunk.  The serving engine drives
+    this for prompts longer than a prefill bucket — prefill cost stays
+    bounded per dispatch instead of one giant O(S^2) program (the
+    reference serves long prompts the same way via its block-cache op's
+    encoder phase).
+
+    ``run(params, toks [1, C], kpool, vpool, kscale, vscale,
+    table [pages_max], ctx_len) -> (x [1, C, H], ks, vs [Lyr, C, nkv,
+    d])`` — shapes are static per (C, pool, table) so one compile
+    serves every chunk index; ``ctx_len`` is traced.  Chunk K/V are
+    returned unquantised; the host write path quantises."""
+    hit = _chunk_prefill_cache.get((_cfg_key(cfg), q8))
+    if hit is not None:
+        return hit
+    from .llama_pretrain import _rope  # noqa: F401  (convention ref)
+    from .decode import _grouped_attn
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    @jax.jit
+    def run(params, toks, kpool, vpool, kscale, vscale, table, ctx_len):
+        B, C = toks.shape                      # B == 1
+        P = table.shape[0]
+        page = kpool.shape[3]
+        S_ctx = P * page
+        x = jnp.take(params["embed"], toks, axis=0).astype(dt)
+        pos = ctx_len + jnp.arange(C, dtype=jnp.int32)
+        # visibility: cached slots < ctx_len, then causal within chunk
+        ctx_vis = jnp.arange(S_ctx, dtype=jnp.int32) < ctx_len
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(ctx_vis[None], (C, S_ctx)),
+             jnp.tril(jnp.ones((C, C), bool))], axis=1)
+        mask = mask[None, None, None]          # [1, 1, 1, C, S_ctx+C]
+
+        def gather_ctx(pool, scale):
+            # [P, nkv, page, d] pages -> [1, S_ctx, nkv, d] context
+            pages = pool[table]
+            if q8:
+                pages = (pages.astype(jnp.float32) *
+                         scale[table][..., None])
+            return pages.transpose(0, 2, 1, 3).reshape(
+                1, S_ctx, nkv, d).astype(dt)
+
+        def layer(carry, inp):
+            if q8:
+                bp, kp_l, vp_l, ks_l, vs_l = inp
+            else:
+                bp, kp_l, vp_l = inp
+                ks_l = vs_l = None
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, C, n, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, C, nkv, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, C, nkv, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            ck = jnp.concatenate([gather_ctx(kp_l, ks_l), k], axis=1)
+            cv = jnp.concatenate([gather_ctx(vp_l, vs_l), v], axis=1)
+            attn = _grouped_attn(q, ck, cv, mask)
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k[0], v[0])
+
+        xs = (params["blocks"], kpool, vpool)
+        if q8:
+            xs = xs + (kscale, vscale)
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
+        return x, ks, vs
+
+    _chunk_prefill_cache[(_cfg_key(cfg), q8)] = run
+    return run
 
 
 def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
@@ -524,3 +768,49 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
     if q8:
         cache.kscale, cache.vscale = ksp, vsp
     return jnp.stack(out_toks, axis=1)               # [B, max_new]
+
+
+def generate_auto(cfg: LlamaPretrainConfig, params, prompts,
+                  max_new_tokens: int, temperature: float = 0.0,
+                  seed: int = 0, page: int = 64,
+                  cache: Optional[PagedKVCache] = None):
+    """ADAPTIVE decode routing (round-4 verdict item 5): one entry
+    point serves both regimes the way the reference's
+    ``block_multihead_attention`` does (incubate/nn/functional/
+    block_multihead_attention.py:19).
+
+    * EQUAL-length batch, no pre-existing pool -> the dense
+      single-program cache (measured 1,717 vs 1,260 tok/s at b=32
+      equal lengths, PERF.md "Paged KV cache decode": the paged grid/
+      page overhead buys nothing when no row pads).
+    * RAGGED lengths (or a caller-managed pool) -> the paged path
+      (HBM ∝ sum of real lengths; 2.2x on long-tail mixes).
+
+    ``prompts``: a list of 1-D int arrays (possibly ragged) or an
+    ``[B, S]`` array (uniform).  Returns ``[B, max_new_tokens]``.
+    """
+    lens = [len(p) for p in prompts] if isinstance(prompts,
+                                                   (list, tuple)) \
+        else [prompts.shape[1]] * prompts.shape[0]
+    if cache is None and len(set(lens)) == 1:
+        arr = np.stack([np.asarray(p) for p in prompts])
+        from .decode import make_generate
+        gen = make_generate(cfg, prompt_len=int(lens[0]),
+                            max_new_tokens=max_new_tokens,
+                            temperature=temperature)
+        return gen(params, jnp.asarray(arr), jax.random.PRNGKey(seed))
+    B = len(lens)
+    S = max(lens)
+    padded = np.zeros((B, S), np.int64)
+    for b, p in enumerate(prompts):
+        padded[b, :lens[b]] = np.asarray(p)
+    if cache is None:
+        pages_max = (S + max_new_tokens + page - 1) // page
+        total = sum((L + max_new_tokens + page - 1) // page
+                    for L in lens) + 1
+        cache = PagedKVCache(cfg, num_pages=total, pages_max=pages_max,
+                             batch=B, page=page)
+    for b, L in enumerate(lens):
+        cache.alloc_row(b, L)
+    return generate_paged(cfg, params, padded, max_new_tokens, cache,
+                          temperature=temperature, seed=seed)

@@ -29,8 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
-from .paged_decode import (PagedKVCache, _prefill, _pick_token,
-                           make_paged_decode_step)
+from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
+                           _pick_token, make_paged_decode_step,
+                           make_paged_decode_step_tp)
 
 __all__ = ["ContinuousBatchingEngine", "Request"]
 
@@ -60,10 +61,19 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg: LlamaPretrainConfig, params,
                  cache: PagedKVCache, eos_id: Optional[int] = None,
                  temperature: float = 0.0, seed: int = 0,
-                 prefill_bucket: int = 64):
+                 prefill_bucket: int = 64,
+                 prefill_chunk: Optional[int] = None,
+                 mesh=None):
+        """``mesh`` (an mp>1 device mesh, with ``params`` initialised
+        on it and ``cache`` built with the same mesh) serves a
+        TENSOR-PARALLEL model: the decode step is one sharded jitted
+        shard_map program (make_paged_decode_step_tp); prefill rides
+        GSPMD over the same sharded params.  A model wider than one
+        chip serves through the identical engine API."""
         self.cfg = cfg
         self.params = params
         self.cache = cache
+        self.mesh = mesh
         self.eos_id = eos_id
         self.temperature = temperature
         # bucket lengths must be page-aligned or the page write would
@@ -71,6 +81,15 @@ class ContinuousBatchingEngine:
         page = cache.page
         self.prefill_bucket = ((max(prefill_bucket, page) + page - 1)
                                // page) * page
+        # prompts longer than prefill_chunk prefill in CHUNKS (bounded
+        # per-dispatch cost; one compile serves every chunk index)
+        if prefill_chunk is not None:
+            prefill_chunk = ((max(prefill_chunk, page) + page - 1)
+                             // page) * page
+        self.prefill_chunk = prefill_chunk
+        # program dispatches for admission, observable for the
+        # sublinearity contract (K same-bucket admits = ONE dispatch)
+        self.prefill_calls = 0
         self.B = cache.tables.shape[0]
         self._free_slots = list(range(self.B))
         self._queue: deque = deque()
@@ -78,9 +97,14 @@ class ContinuousBatchingEngine:
         self._finished: List[Request] = []
         self._next_rid = 0
         self._admit_seq = 0
+        self._stream: List = []     # (rid, token) in emission order
         self._key = jax.random.PRNGKey(seed)
-        self._step = make_paged_decode_step(cfg, temperature,
-                                            kv_quant=cache.kv_quant)
+        if mesh is not None and mesh.shape.get("mp", 1) > 1:
+            self._step = make_paged_decode_step_tp(
+                cfg, mesh, temperature, kv_quant=cache.kv_quant)
+        else:
+            self._step = make_paged_decode_step(cfg, temperature,
+                                                kv_quant=cache.kv_quant)
         self._next_tok = np.zeros((self.B,), np.int64)
         self._remaining = np.zeros((self.B,), np.int64)
 
@@ -114,43 +138,129 @@ class ContinuousBatchingEngine:
         out, self._finished = self._finished, []
         return out
 
+    def drain_stream(self) -> List:
+        """Per-token STREAMING: all ``(rid, token)`` pairs emitted since
+        the last drain, in emission order.  Tokens appear here the step
+        they are produced — callers forward them to clients without
+        waiting for the request to finish."""
+        out, self._stream = self._stream, []
+        return out
+
     def has_work(self) -> bool:
         return bool(self._queue or self._active)
 
     # -- engine side ------------------------------------------------------
-    def _admit(self, req: Request) -> None:
-        """Prefill ``req`` into a free slot.  A fresh request prefills
-        its prompt and samples the first token; a PREEMPTED request
-        (``req.generated`` non-empty) re-prefills prompt + already-
-        generated context and resumes at its saved next token —
-        recompute-style preemption, the vLLM scheduler's recovery
-        path."""
-        slot = self._free_slots.pop()
-        resume = bool(req.generated)
-        if resume:
-            # cached context on eviction was prompt + generated[:-1];
-            # generated[-1] is the not-yet-fed next input token
-            ctx = np.concatenate(
+    @staticmethod
+    def _ctx_of(req: Request) -> np.ndarray:
+        """The tokens a (re-)prefill must cache: the prompt, plus — for
+        a PREEMPTED request — everything generated except the last
+        token (generated[-1] is the not-yet-fed next input)."""
+        if req.generated:
+            return np.concatenate(
                 [req.prompt, np.asarray(req.generated[:-1], np.int64)])
-        else:
-            ctx = req.prompt
-        L = len(ctx)
-        self.cache.alloc_row(slot, L)
-        # bucketed single-row prefill: one compile per (bucket) length
-        Lp = ((L + self.prefill_bucket - 1) //
-              self.prefill_bucket) * self.prefill_bucket
-        padded = np.zeros((1, Lp), np.int64)
-        padded[0, :L] = ctx
-        x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
-        self.cache.write_row_pages(slot, ks[:, 0], vs[:, 0], L)
+        return req.prompt
+
+    def _finish_admit(self, req: Request, slot: int, tok: int) -> None:
+        """Shared bookkeeping tail of every admission path."""
         req.slot = slot
         req.admit_seq = self._admit_seq
         self._admit_seq += 1
-        if resume:
+        self._active[slot] = req
+        self._next_tok[slot] = tok
+        self._remaining[slot] = req.max_new_tokens - len(req.generated)
+        if (self.eos_id is not None and tok == self.eos_id) or \
+                self._remaining[slot] <= 0:
+            self._retire(slot)
+
+    def _admit_batch(self, group: List) -> None:
+        """BATCHED admission: K same-bucket requests prefill as ONE
+        jitted program of shape [K_pow2, bucket] — admission cost is
+        sublinear in arrivals (one dispatch instead of K).  A fresh
+        request samples its first token from its last real position's
+        logits (batched); a preempted one resumes at its saved token
+        (recompute-style preemption, the vLLM scheduler's recovery
+        path).  ``group`` carries (request, context) pairs — the
+        context was already built during reservation."""
+        reqs = [r for r, _ in group]
+        ctxs = [c for _, c in group]
+        K = len(reqs)
+        Ls = [len(c) for c in ctxs]
+        Lp = ((max(Ls) + self.prefill_bucket - 1) //
+              self.prefill_bucket) * self.prefill_bucket
+        # pad the batch to a power of two: compile count stays
+        # O(log B x buckets), padding rows are ignored
+        Kp = 1 << (K - 1).bit_length()
+        slots = []
+        for req, ctx, L in zip(reqs, ctxs, Ls):
+            slot = self._free_slots.pop()
+            self.cache.alloc_row(slot, L)
+            slots.append(slot)
+        padded = np.zeros((Kp, Lp), np.int64)
+        for i, ctx in enumerate(ctxs):
+            padded[i, :Ls[i]] = ctx
+        x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
+        self.prefill_calls += 1
+        for i, (req, slot, L) in enumerate(zip(reqs, slots, Ls)):
+            self.cache.write_row_pages(slot, ks[:, i], vs[:, i], L)
+        toks = None
+        if any(not r.generated for r in reqs):
+            # batched first tokens from each row's LAST REAL position —
+            # skipped for an all-resume group (their next token is
+            # saved; sampling would also burn a PRNG split for nothing)
+            last = jnp.asarray(np.asarray(Ls, np.int64) - 1)
+            h = _rms_norm(x[jnp.arange(K), last],
+                          self.params["final_norm"],
+                          self.cfg.rms_norm_eps)
+            logits = _mm(h, self.params["lm_head"],
+                         self.cfg.dtype).astype(jnp.float32)
+            self._key, sub = jax.random.split(self._key)
+            toks = np.asarray(_pick_token(logits, self.temperature,
+                                          sub))
+        for i, (req, slot) in enumerate(zip(reqs, slots)):
+            if req.generated:                    # resume after preempt
+                tok = req.generated[-1]
+            else:
+                tok = int(toks[i])
+                req.generated.append(tok)
+                self._stream.append((req.rid, tok))
+            self._finish_admit(req, slot, tok)
+
+    def _admit_chunked(self, req: Request, ctx: np.ndarray) -> None:
+        """CHUNKED admission for prompts longer than ``prefill_chunk``:
+        the prompt advances chunk by chunk through the prefill-with-
+        history program (attends cached pages + causal within chunk) —
+        per-dispatch cost is bounded by the chunk, not the prompt."""
+        L = len(ctx)
+        chunk = self.prefill_chunk
+        page = self.cache.page
+        slot = self._free_slots.pop()
+        self.cache.alloc_row(slot, L)
+        q8 = self.cache.kv_quant == "int8"
+        run = _prefill_chunk(self.cfg, q8)
+        dummy = jnp.zeros((1,), jnp.float32)
+        x = None
+        pos = 0
+        while pos < L:
+            C_real = min(chunk, L - pos)
+            toks = np.zeros((1, chunk), np.int64)
+            toks[0, :C_real] = ctx[pos:pos + C_real]
+            table = jnp.asarray(self.cache.tables[slot].copy())
+            x, ks, vs = run(
+                self.params, jnp.asarray(toks), self.cache.kpool,
+                self.cache.vpool,
+                self.cache.kscale if q8 else dummy,
+                self.cache.vscale if q8 else dummy,
+                table, np.int32(pos))
+            self.prefill_calls += 1
+            self.cache.write_row_pages(slot, ks, vs, C_real,
+                                       first_page=pos // page)
+            last_real = C_real
+            pos += C_real
+        if req.generated:                        # resume after preempt
             tok = req.generated[-1]
         else:
-            # first token from the last REAL position's logits
-            h = _rms_norm(x[0, L - 1], self.params["final_norm"],
+            h = _rms_norm(x[0, last_real - 1],
+                          self.params["final_norm"],
                           self.cfg.rms_norm_eps)
             logits = _mm(h, self.params["lm_head"],
                          self.cfg.dtype).astype(jnp.float32)
@@ -158,12 +268,8 @@ class ContinuousBatchingEngine:
             tok = int(_pick_token(logits[None], self.temperature,
                                   sub)[0])
             req.generated.append(tok)
-        self._active[slot] = req
-        self._next_tok[slot] = tok
-        self._remaining[slot] = req.max_new_tokens - len(req.generated)
-        if (self.eos_id is not None and tok == self.eos_id) or \
-                self._remaining[slot] <= 0:
-            self._retire(slot)
+            self._stream.append((req.rid, tok))
+        self._finish_admit(req, slot, tok)
 
     def _preempt(self, keep: int) -> bool:
         """Evict the most recently admitted active request (except slot
@@ -194,19 +300,30 @@ class ContinuousBatchingEngine:
     def step(self) -> int:
         """Admit + one decode token for every active slot.  Returns the
         number of active requests after the step."""
-        while self._queue and self._free_slots:
-            # admit only when the POOL can hold the prompt: a failed
-            # alloc mid-loop would crash the engine and lose every
-            # in-flight generation.  Head-of-line waiting is fine —
-            # decode steps free pages as requests retire.
-            nxt_req = self._queue[0]
-            # a preempted request re-prefills prompt + generated[:-1]
-            ctx_len = len(nxt_req.prompt) + max(
-                len(nxt_req.generated) - 1, 0)
-            need = (ctx_len + self.cache.page - 1) // self.cache.page
-            if need > self.cache.free_pages():
+        # collect every request that fits (slots + pool pages), then
+        # admit same-bucket groups with ONE batched prefill each.
+        # Head-of-line FIFO: stop at the first that doesn't fit — a
+        # failed alloc mid-loop would crash the engine.
+        admits: List = []                    # (request, context) pairs
+        reserved = 0
+        while self._queue and len(self._free_slots) > len(admits):
+            ctx = self._ctx_of(self._queue[0])
+            need = (len(ctx) + self.cache.page - 1) // self.cache.page
+            if reserved + need > self.cache.free_pages():
                 break
-            self._admit(self._queue.popleft())
+            reserved += need
+            admits.append((self._queue.popleft(), ctx))
+        buckets: Dict[int, List] = {}
+        for req, ctx in admits:
+            L = len(ctx)
+            if self.prefill_chunk is not None and L > self.prefill_chunk:
+                self._admit_chunked(req, ctx)
+                continue
+            Lp = ((L + self.prefill_bucket - 1) //
+                  self.prefill_bucket) * self.prefill_bucket
+            buckets.setdefault(Lp, []).append((req, ctx))
+        for group in buckets.values():
+            self._admit_batch(group)
         if not self._active:
             return 0
         cache = self.cache
@@ -247,6 +364,7 @@ class ContinuousBatchingEngine:
         for slot, req in list(self._active.items()):
             t = int(nxt[slot])
             req.generated.append(t)
+            self._stream.append((req.rid, t))
             self._next_tok[slot] = t
             self._remaining[slot] -= 1
             if (self.eos_id is not None and t == self.eos_id) or \

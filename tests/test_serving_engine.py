@@ -151,6 +151,121 @@ def test_engine_rejects_oversized_request_at_submit():
         eng2.submit(rng.randint(1, 128, (16,)), max_new_tokens=40)
 
 
+def test_engine_batched_admission_one_prefill_for_k_arrivals():
+    """K same-bucket arrivals admit with ONE batched prefill dispatch
+    (round-4 verdict item 7: admission cost sublinear in K), and every
+    request still matches its solo greedy run."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(5)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=4,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    prompts = [rng.randint(1, 128, (int(rng.randint(5, 16)),))
+               for _ in range(4)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=5)
+    eng.step()
+    assert eng.prefill_calls == 1, \
+        "4 same-bucket arrivals must be ONE prefill dispatch"
+    done = eng.run_to_completion()
+    by_rid = sorted(done, key=lambda r: r.rid)
+    for req, prompt in zip(by_rid, prompts):
+        g = make_generate(cfg, prompt_len=len(prompt), max_new_tokens=5)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref)
+
+
+def test_engine_chunked_prefill_long_prompt_parity():
+    """A prompt longer than prefill_chunk admits through the chunked
+    prefill-with-history program (bounded per-dispatch cost) and the
+    generation still matches the solo run token-exactly."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(1, 128, (80,))       # > chunk of 32
+    cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   prefill_chunk=32)
+    eng.submit(prompt, max_new_tokens=6)
+    eng.step()
+    # 80 tokens / 32-chunk = 3 chunk dispatches (32+32+16)
+    assert eng.prefill_calls == 3
+    done = eng.run_to_completion()
+    g = make_generate(cfg, prompt_len=80, max_new_tokens=6)
+    ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(np.asarray(done[0].generated), ref)
+
+
+def test_engine_streams_tokens_incrementally():
+    """drain_stream() yields (rid, token) pairs the step they are
+    produced; per-rid concatenation equals the finished generation and
+    tokens from interleaved admissions arrive interleaved."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(7)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    r1 = eng.submit(rng.randint(1, 128, (10,)), max_new_tokens=6)
+    eng.step()
+    first = eng.drain_stream()
+    # admission emits the first token; the decode in the same step the
+    # second
+    assert [rid for rid, _ in first] == [r1, r1]
+    r2 = eng.submit(rng.randint(1, 128, (7,)), max_new_tokens=4)
+    streamed = {r1: [t for _, t in first], r2: []}
+    interleaved = False
+    while eng.has_work():
+        eng.step()
+        ev = eng.drain_stream()
+        rids = {rid for rid, _ in ev}
+        if r1 in rids and r2 in rids:
+            interleaved = True
+        for rid, t in ev:
+            streamed[rid].append(t)
+    assert interleaved, "both requests must stream within one step"
+    by_rid = {r.rid: r for r in eng.finished()}
+    for rid, toks in streamed.items():
+        assert toks == by_rid[rid].generated
+
+
+def test_engine_tp_sharded_paged_serving_parity():
+    """TP-SHARDED SERVING (round-4 verdict item 2): Megatron-sharded
+    params + kv-head-sharded page pools + the SAME engine API serve
+    over an mp=2 mesh; the decode step is one sharded jitted shard_map
+    program and every request's output is token-exact vs the
+    single-device engine.  Reference: fleet_executor DistModel::Run
+    (dist_model.h:61) — auto-parallel model serving."""
+    from paddle_tpu.models.llama_pretrain import build_mesh
+
+    cfg = _cfg()
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, 128, (int(rng.randint(4, 20)),))
+               for _ in range(4)]
+
+    def run(mesh, mp):
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+        cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                             page=16, mesh=mesh if mp > 1 else None)
+        eng = ContinuousBatchingEngine(
+            cfg, params, cache, mesh=mesh if mp > 1 else None)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=6)
+        done = eng.run_to_completion()
+        return {r.rid: list(r.generated) for r in done}
+
+    mesh_tp = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=2,
+                         devices=jax.devices()[:2])
+    got_tp = run(mesh_tp, mp=2)
+    mesh_1 = build_mesh(devices=jax.devices()[:1])
+    got_1 = run(mesh_1, mp=1)
+    assert got_tp == got_1
+
+
 def test_engine_interleaved_admission():
     """A late submit joins while earlier requests are mid-decode and
     still matches its solo run (slots are truly independent)."""
