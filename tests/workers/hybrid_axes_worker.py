@@ -7,7 +7,7 @@ process; this worker drives the SAME fleet APIs over a process-spanning
 mesh — the programming model a v5p pod uses (one jax process per host,
 global mesh over all chips, XLA collectives across DCN/ICI).
 
-Three phases, one rendezvous:
+Default mode ("axes2", 2 processes) — five phases, one rendezvous:
   tp    — fleet.init(mp=2) + Column/RowParallelLinear +
           fleet.distributed_optimizer; weights sharded across the two
           processes; loss must match the dense single-process run.
@@ -17,6 +17,17 @@ Three phases, one rendezvous:
   pp    — PipelineLayer/PipelineParallel pp=2: stage 0's parameters
           live on process 0's device, stage 1's on process 1's; the
           compiled 1F1B step is one jitted program spanning both.
+  sep   — ring attention (context parallel) with the sequence split
+          across the two processes; flagship train-step losses must
+          match the dense single-process run (round-4 item 6).
+  moe   — expert parallelism ep=2: one expert per process, all-to-all
+          token dispatch crossing the process boundary (round-4
+          item 6).
+
+Mode "combined4" (4 processes) — ONE phase: a dp=2 x mp=2 hybrid
+flagship train step at BENCH-ISH dims (head_dim 128, vocab 8192 —
+round-4 weak item 5: toy dims can't catch layout/donation bugs) over a
+4-process mesh; losses must match the in-process 4-device run.
 
 Reference parity model: test/collective/fleet/hybrid_parallel_mp_layers
 / hybrid_parallel_pp_embedding / dygraph_group_sharded_* (spawned
@@ -187,19 +198,138 @@ def phase_pp():
     return losses, sorted(devs)
 
 
+def sep_losses(mesh_devices=None):
+    """Ring-attention (context-parallel) flagship train losses with the
+    sequence split over ``sep=2``.  Shared by the 2-process worker
+    (devices span both processes) and the in-process reference (2
+    local devices) — identical code, only the mesh differs."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama_pretrain import (
+        LlamaPretrainConfig, build_mesh, init_params, init_adamw_state,
+        make_train_step)
+
+    cfg = LlamaPretrainConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_seq_len=32,
+        use_pallas_attention=False, sequence_parallel=False,
+        remat=False, dtype=jnp.float32, param_dtype=jnp.float32,
+        context_parallel="ring", loss_chunks=1)
+    mesh = build_mesh(dp=1, pp=1, sharding=1, sep=2, mp=1,
+                      devices=mesh_devices)
+    with mesh:
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+        opt_state = init_adamw_state(params, mesh, zero_axis=None)
+        step = make_train_step(cfg, mesh, pp=1, lr=1e-3)
+        tokens = np.random.RandomState(3).randint(0, 64, (2, 33))
+        losses = []
+        for _ in range(STEPS):
+            params, opt_state, loss = step(
+                params, opt_state, jax.numpy.asarray(tokens))
+            losses.append(float(loss))
+    return losses
+
+
+def moe_losses(mesh_devices=None):
+    """ep=2 MoE layer (one expert per device, all-to-all dispatch)
+    trained for STEPS; shared by worker and in-process reference."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.parallel.expert_parallel import (
+        moe_layer_ep)
+
+    devs = mesh_devices if mesh_devices is not None \
+        else jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("ep",))
+    E, h, f_dim, T = 2, 16, 32, 16
+    rng = np.random.RandomState(4)
+    gate_w = jnp.asarray(rng.randn(h, E) * 0.1, jnp.float32)
+    experts = {
+        "w_gate": jnp.asarray(rng.randn(E, h, f_dim) * 0.1, jnp.float32),
+        "w_up": jnp.asarray(rng.randn(E, h, f_dim) * 0.1, jnp.float32),
+        "w_down": jnp.asarray(rng.randn(E, f_dim, h) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(T, h), jnp.float32)
+    y = jnp.asarray(rng.randn(T, h), jnp.float32)
+
+    def moe_loss(params, x, y):
+        gw, ep = params
+        out, l_aux = moe_layer_ep(x, gw, ep, mesh, axis="ep",
+                                  num_expert=E, top_k=2)
+        return ((out - y) ** 2).mean() + 0.01 * l_aux
+
+    @jax.jit
+    def moe_step(params, x, y):
+        loss, grads = jax.value_and_grad(moe_loss)(params, x, y)
+        return jax.tree_util.tree_map(
+            lambda p, g: p - 0.1 * g, params, grads), loss
+
+    params = (gate_w, experts)
+    losses = []
+    for _ in range(STEPS):
+        params, loss = moe_step(params, x, y)
+        losses.append(float(loss))
+    return losses
+
+
+def combined_losses(mesh_devices=None):
+    """dp=2 x mp=2 hybrid flagship train step at BENCH-ISH dims
+    (head_dim 128, vocab 8192) — the 4-process mode's single phase,
+    shared with the in-process 4-device reference (round-4 weak item
+    5: toy dims can't catch layout/donation bugs)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama_pretrain import (
+        LlamaPretrainConfig, build_mesh, init_params, init_adamw_state,
+        make_train_step)
+
+    cfg = LlamaPretrainConfig(
+        vocab_size=8192, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=2, max_seq_len=64,
+        use_pallas_attention=False, sequence_parallel=False,
+        remat=True, dtype=jnp.float32, param_dtype=jnp.float32,
+        loss_chunks=1)
+    assert cfg.head_dim == 128
+    mesh = build_mesh(dp=2, pp=1, sharding=1, sep=1, mp=2,
+                      devices=mesh_devices)
+    with mesh:
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+        opt_state = init_adamw_state(params, mesh, zero_axis=None)
+        step = make_train_step(cfg, mesh, pp=1, lr=1e-3)
+        tokens = np.random.RandomState(5).randint(0, 8192, (4, 65))
+        losses = []
+        for _ in range(STEPS):
+            params, opt_state, loss = step(
+                params, opt_state, jax.numpy.asarray(tokens))
+            losses.append(float(loss))
+    return losses
+
+
 def main():
     out_path = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "axes2"
     dist.init_parallel_env()
     rank = dist.get_rank()
+
+    if mode == "combined4":
+        assert jax.device_count() == 4
+        losses = combined_losses()
+        if rank == 0:
+            with open(out_path, "w") as f:
+                json.dump({"combined": losses}, f)
+        return
 
     tp_losses = phase_tp()
     zero_losses = phase_zero2()
     pp_losses, pp_procs = phase_pp()
+    s_losses = sep_losses()
+    m_losses = moe_losses()
 
     if rank == 0:
         with open(out_path, "w") as f:
             json.dump({"tp": tp_losses, "zero2": zero_losses,
-                       "pp": pp_losses, "pp_procs": pp_procs}, f)
+                       "pp": pp_losses, "pp_procs": pp_procs,
+                       "sep": s_losses, "moe": m_losses}, f)
 
 
 if __name__ == "__main__":
