@@ -782,9 +782,34 @@ def _expand_bool_masks(idx):
     return tuple(out)
 
 
+def _check_int_bounds(idx, shape):
+    """Raise IndexError for out-of-range PYTHON-int components (the
+    reference/torch contract).  jax silently CLAMPS integer gathers —
+    without this check `t[10**9]` returns the last row and the legacy
+    __getitem__-until-IndexError iteration protocol never stops.
+    Positional accounting walks ints/slices only; anything fancier
+    (None/Ellipsis/arrays) ends the walk — jax handles those."""
+    import builtins
+    comps = idx if isinstance(idx, tuple) else (idx,)
+    for dim, c in enumerate(comps):
+        if isinstance(c, builtins.slice):
+            continue
+        if isinstance(c, (int, np.integer)) and \
+                not isinstance(c, builtins.bool):
+            if dim >= len(shape):
+                break                      # too many indices: jax errors
+            if not (-shape[dim] <= c < shape[dim]):
+                raise IndexError(
+                    f"index {c} is out of bounds for axis {dim} with "
+                    f"size {shape[dim]}")
+        else:
+            break
+
+
 def getitem(x, item):
     x = as_tensor(x)
     idx = _normalize_index(item)
+    _check_int_bounds(idx, x._data.shape)
     if _has_bool_mask(idx):
         idx = _expand_bool_masks(idx)
 
@@ -796,6 +821,7 @@ def getitem(x, item):
 
 def setitem(x, item, value):
     idx = _normalize_index(item)
+    _check_int_bounds(idx, as_tensor(x)._data.shape)
     if _has_bool_mask(idx):
         idx = _expand_bool_masks(idx)
     if isinstance(value, Tensor):

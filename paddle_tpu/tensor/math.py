@@ -448,8 +448,21 @@ def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
 
 @_export
 def multiplex(inputs, index, name=None):
+    # reference contract (python/paddle/tensor/math.py multiplex):
+    # inputs is a LIST of >=2 same-shape tensors, index an integer
+    # column.  Validate loudly — a bare tensor used to fall into row
+    # iteration and a float index into a garbage gather.
+    if not isinstance(inputs, (list, tuple)):
+        raise TypeError(
+            "multiplex expects a list/tuple of tensors, got "
+            f"{type(inputs).__name__}")
+    if len(inputs) < 2:
+        raise ValueError("multiplex needs at least 2 input tensors")
     ts = [as_tensor(t) for t in inputs]
     idx = as_tensor(index)
+    if not jnp.issubdtype(idx._data.dtype, jnp.integer):
+        raise TypeError(
+            f"multiplex index must be integer, got {idx.dtype}")
 
     def fn(i, *arrs):
         stacked = jnp.stack(arrs, axis=0)

@@ -175,6 +175,14 @@ class Tensor:
             raise TypeError("len() of a 0-D tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        # MUST exist: jax CLAMPS out-of-bounds integer indexing, so
+        # Python's legacy iteration protocol (__getitem__(0), (1), ...
+        # until IndexError) never terminates on a Tensor — `for row in
+        # t` spun forever (the round-4 `multiplex` hang's root cause)
+        for i in range(len(self)):
+            yield self[i]
+
     def __int__(self):
         return int(self.item())
 

@@ -275,3 +275,46 @@ def test_random_reproducibility():
     np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))
     u = paddle.uniform([1000], min=2.0, max=3.0)
     assert 2.0 <= float(u.min()) and float(u.max()) < 3.0
+
+
+def test_tensor_iteration_terminates_and_oob_raises():
+    """Round-4 multiplex-hang root cause: jax clamps out-of-bounds int
+    indexing, and without __iter__ the legacy __getitem__-until-
+    IndexError protocol never stopped.  Iteration must yield exactly
+    the rows; python-int OOB indexing must raise IndexError (the
+    reference/torch contract), incl. through __setitem__."""
+    import numpy as np
+    import pytest
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    rows = list(t)
+    assert len(rows) == 4
+    np.testing.assert_allclose(rows[2].numpy(), [6.0, 7.0, 8.0])
+    with pytest.raises(IndexError):
+        t[4]
+    with pytest.raises(IndexError):
+        t[-5]
+    with pytest.raises(IndexError):
+        t[1, 3]
+    with pytest.raises(IndexError):
+        t[4] = 0.0                  # OOB write must not silently drop
+    # negative in-range still fine
+    np.testing.assert_allclose(t[-1].numpy(), [9.0, 10.0, 11.0])
+
+
+def test_multiplex_contract_validation():
+    """multiplex validates the reference contract loudly: list of >=2
+    tensors + integer index (a bare tensor used to spin the iteration
+    protocol; a float index gathered garbage)."""
+    import numpy as np
+    import pytest
+    a = paddle.to_tensor(np.ones((4, 3), np.float32))
+    b = paddle.to_tensor(np.zeros((4, 3), np.float32))
+    idx = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+    out = paddle.multiplex([a, b], idx)
+    np.testing.assert_allclose(out.numpy()[:, 0], [1.0, 0.0, 1.0, 0.0])
+    with pytest.raises(TypeError):
+        paddle.multiplex(a, idx)
+    with pytest.raises(ValueError):
+        paddle.multiplex([a], idx)
+    with pytest.raises(TypeError):
+        paddle.multiplex([a, b], a)          # float index
