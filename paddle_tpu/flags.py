@@ -173,6 +173,12 @@ define_flag("FLAGS_pallas_rms_norm", False,
             "kernel (default off: measured -11% on the 1.3B bench — "
             "XLA fuses the composite norm into the adjacent matmul, "
             "the kernel boundary breaks that; see PERF.md).")
+define_flag("FLAGS_pallas_rmsnorm_matmul", False,
+            "Fuse the flagship block-entry rms_norm INTO the q/k/v and "
+            "gate/up matmul kernels (one pass over x, no normalised-"
+            "activation HBM round trip — the PERF.md 'remaining "
+            "levers' fusion).  Default off until measured on chip vs "
+            "XLA's own norm-into-matmul fusion.")
 define_flag("FLAGS_pallas_int8_matmul", True,
             "Use the Pallas weight-only int8 matmul in the decode "
             "serving path (dims must be lane-aligned; measured +23% "

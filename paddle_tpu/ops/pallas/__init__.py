@@ -13,10 +13,11 @@ from .fused_adamw import fused_adamw
 from .rope import fused_rope, rope_tables
 from .swiglu import swiglu
 from .int8_matmul import int8_matmul, quantize_int8
+from .rmsnorm_matmul import rmsnorm_matmul
 
 __all__ = ["flash_attention", "rms_norm", "fused_adamw", "fused_rope",
            "rope_tables", "swiglu", "int8_matmul", "quantize_int8",
-           "register_pallas_ops"]
+           "rmsnorm_matmul", "register_pallas_ops"]
 
 
 def register_pallas_ops() -> None:
@@ -31,6 +32,7 @@ def register_pallas_ops() -> None:
     register_op_impl("fused_rope", fused_rope)
     register_op_impl("swiglu", swiglu)
     register_op_impl("int8_matmul", int8_matmul)
+    register_op_impl("rmsnorm_matmul", rmsnorm_matmul)
 
 
 register_pallas_ops()

@@ -234,3 +234,27 @@ def test_paged_decode_attention_compiled():
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
                                 ref.astype(jnp.float32))))
     assert err < 3e-2, err
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-3),
+                                       (jnp.bfloat16, 3e-2)])
+def test_rmsnorm_matmul_compiled(dtype, tol):
+    """Fused block-entry rms_norm->matmul (round-5 lever) through the
+    real Mosaic compiler: fwd parity vs the f32 composite, plus grads
+    on the f32 lane."""
+    from paddle_tpu.ops.pallas.rmsnorm_matmul import rmsnorm_matmul
+    kk = jax.random.PRNGKey
+    x = jax.random.normal(kk(0), (64, 512), dtype)
+    wl = (jax.random.normal(kk(1), (512,), jnp.float32) * 0.1 + 1.0)
+    w = jax.random.normal(kk(2), (512, 256), dtype) * 0.05
+    out = np.asarray(rmsnorm_matmul(x, wl.astype(dtype),
+                                    w), np.float32)
+    xf = np.asarray(x, np.float32)
+    y = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) \
+        * np.asarray(wl)
+    ref = y @ np.asarray(w, np.float32)
+    assert np.abs(out - ref).max() < tol * max(1.0, np.abs(ref).max())
+    if dtype == jnp.float32:
+        g = jax.grad(lambda *a: (rmsnorm_matmul(*a) ** 2).sum(),
+                     argnums=(0, 1, 2))(x, wl, w)
+        assert all(np.isfinite(np.asarray(t)).all() for t in g)
