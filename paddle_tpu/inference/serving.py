@@ -19,6 +19,12 @@ library only; this module adds:
   device placement.  npz keeps the wire format zero-parse on both
   sides (numpy memory-maps the buffers).
 * :func:`predict_http` — the matching client helper.
+* :class:`GenerationServer` — the LLM serving PRODUCT: HTTP
+  ``/generate`` + streaming ``/generate_stream`` over the
+  continuous-batching engine (paged KV cache; pass ``mesh`` for a
+  TP-sharded model wider than one chip — the DistModel multi-device
+  serving case).  :func:`generate_http` / :func:`generate_http_stream`
+  are the clients.
 
 Nothing here imports beyond the standard library + numpy + jax.
 """
@@ -35,7 +41,9 @@ import numpy as np
 
 from . import Config, Predictor
 
-__all__ = ["DevicePool", "InferenceServer", "predict_http"]
+__all__ = ["DevicePool", "InferenceServer", "predict_http",
+           "GenerationServer", "generate_http",
+           "generate_http_stream"]
 
 
 class DevicePool:
@@ -192,3 +200,190 @@ def predict_http(url: str, inputs: List[np.ndarray],
         headers={"Content-Type": "application/octet-stream"})
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return _unpack_npz(r.read())
+
+
+# ---------------------------------------------------------------------------
+# LLM generation serving: HTTP front over the continuous-batching
+# engine (paged KV cache, optionally TP-sharded over a device mesh)
+# ---------------------------------------------------------------------------
+class _GenHandler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu-genserving/0.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, body, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: "GenerationServer" = self.server.owner
+        if self.path.rstrip("/") in ("", "/health"):
+            self._reply(200, json.dumps(
+                {"status": "ok",
+                 "active": len(srv.engine._active),
+                 "queued": len(srv.engine._queue)}).encode())
+        else:
+            self._reply(404, b"not found", "text/plain")
+
+    def do_POST(self):
+        srv: "GenerationServer" = self.server.owner
+        path = self.path.rstrip("/")
+        if path not in ("/generate", "/generate_stream"):
+            self._reply(404, b"not found", "text/plain")
+            return
+        n = int(self.headers.get("Content-Length", "0"))
+        try:
+            req = json.loads(self.rfile.read(n))
+            prompt = [int(t) for t in req["prompt"]]
+            max_new = int(req.get("max_new_tokens", 64))
+        except Exception as e:
+            self._reply(400, f"bad payload: {type(e).__name__}".encode(),
+                        "text/plain")
+            return
+        try:
+            rid, q = srv.submit(prompt, max_new)
+        except ValueError as e:           # oversized for the pool
+            self._reply(400, f"rejected: {e}".encode(), "text/plain")
+            return
+        if path == "/generate":
+            toks = []
+            while True:
+                kind, payload = q.get()
+                if kind == "tok":
+                    toks.append(payload)
+                else:
+                    self._reply(200, json.dumps(
+                        {"rid": rid, "tokens": payload}).encode())
+                    return
+        # STREAMING: one JSON line per token as the engine produces it
+        # (chunked transfer — the client reads lines incrementally)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: bytes):
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data
+                             + b"\r\n")
+            self.wfile.flush()
+
+        while True:
+            kind, payload = q.get()
+            if kind == "tok":
+                chunk(json.dumps({"rid": rid,
+                                  "token": payload}).encode() + b"\n")
+            else:
+                chunk(json.dumps({"rid": rid, "done": True,
+                                  "tokens": payload}).encode() + b"\n")
+                chunk(b"")                      # terminal chunk: 0\r\n\r\n
+                return
+
+
+class GenerationServer:
+    """Continuous-batching LLM serving over HTTP — the serving-product
+    composition of the paged KV cache, the batching engine, and
+    (optionally) a TP device mesh: requests arriving concurrently batch
+    into the engine's fixed decode step; ``/generate`` blocks for the
+    full completion, ``/generate_stream`` streams one JSON line per
+    token the step it is produced.
+
+    Reference analog: the dynamic-batching inference servers the
+    reference's block_multihead_attention op exists for, and — with
+    ``mesh`` — fleet_executor DistModel multi-device serving
+    (fluid/distributed/fleet_executor/dist_model.h:57).
+    """
+
+    def __init__(self, cfg, params, cache, mesh=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 poll_s: float = 0.002, **engine_kw):
+        from ..models.serving_engine import ContinuousBatchingEngine
+        self.engine = ContinuousBatchingEngine(cfg, params, cache,
+                                               mesh=mesh, **engine_kw)
+        self._host, self._port = host, port
+        self._poll_s = poll_s
+        self._lock = threading.Lock()
+        self._queues = {}
+        self._httpd = None
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def submit(self, prompt, max_new_tokens):
+        import queue as _queue
+        with self._lock:
+            rid = self.engine.submit(prompt,
+                                     max_new_tokens=max_new_tokens)
+            q = _queue.Queue()
+            self._queues[rid] = q
+        return rid, q
+
+    def _drive(self):
+        """Engine thread: step while there is work, fan tokens out to
+        each request's queue.  All engine access is under the lock —
+        the HTTP handlers only touch submit() and their own queue."""
+        import time as _time
+        while not self._stop.is_set():
+            with self._lock:
+                worked = self.engine.has_work()
+                if worked:
+                    self.engine.step()
+                    for rid, tok in self.engine.drain_stream():
+                        self._queues[rid].put(("tok", tok))
+                    for req in self.engine.finished():
+                        q = self._queues.pop(req.rid, None)
+                        if q is not None:
+                            q.put(("done", list(req.generated)))
+            if not worked:
+                _time.sleep(self._poll_s)
+
+    def start(self) -> int:
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          _GenHandler)
+        self._httpd.owner = self
+        for target in (self._httpd.serve_forever, self._drive):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def generate_http(url: str, prompt, max_new_tokens: int = 64,
+                  timeout: float = 120.0):
+    """Blocking client for :class:`GenerationServer` ``/generate``."""
+    import urllib.request
+    body = json.dumps({"prompt": [int(t) for t in prompt],
+                       "max_new_tokens": max_new_tokens}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())["tokens"]
+
+
+def generate_http_stream(url: str, prompt, max_new_tokens: int = 64,
+                         timeout: float = 120.0):
+    """Streaming client: yields tokens as the server emits them."""
+    import urllib.request
+    body = json.dumps({"prompt": [int(t) for t in prompt],
+                       "max_new_tokens": max_new_tokens}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/generate_stream", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for line in r:
+            if not line.strip():
+                continue
+            msg = json.loads(line)
+            if msg.get("done"):
+                return
+            yield msg["token"]
