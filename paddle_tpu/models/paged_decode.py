@@ -357,20 +357,19 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
     pallas_call.  Same signature/caller contract as
     :func:`make_paged_decode_step`.
     """
-    if kv_quant == "int8":
-        raise NotImplementedError(
-            "int8 KV pages over a TP mesh: quantize per local head "
-            "shard — not wired yet; serve int8 single-device or bf16 "
-            "sharded")
     mp = mesh.shape["mp"]
-    hit = _step_tp_cache.get((_cfg_key(cfg), temperature, mesh))
+    hit = _step_tp_cache.get((_cfg_key(cfg), temperature, kv_quant,
+                              mesh))
     if hit is not None:
         return hit
 
     from jax.sharding import PartitionSpec as P
     from .llama_pretrain import param_specs
     shard_map = jax.shard_map
-    from ..ops.pallas.paged_attention import paged_decode_attention
+    from ..ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_q8,
+        quantize_kv_token)
+    q8 = kv_quant == "int8"
 
     n, d = cfg.num_attention_heads, cfg.head_dim
     nkv = cfg.num_key_value_heads
@@ -391,7 +390,8 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
         x = jnp.where(ok[..., None], x, 0).astype(dt)
         return jax.lax.psum(x, ax)
 
-    def step_local(params, kpool, vpool, tables, lens, tok, key):
+    def step_local(params, kpool, vpool, kscale, vscale, tables, lens,
+                   tok, key):
         B = tok.shape[0]
         page = kpool.shape[3]
         x = embed_vp(params["embed"], tok)            # [B, H] replicated
@@ -399,7 +399,11 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
         slots = lens % page
 
         def layer(carry, inp):
-            bp, kp, vp = inp
+            if q8:
+                bp, kp, vp, ks, vs = inp
+            else:
+                bp, kp, vp = inp
+                ks = vs = None
             xc = carry
             y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
             q = _mm(y, bp["wq"], dt).reshape(B, n_l, d)
@@ -407,9 +411,22 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
             v = _mm(y, bp["wv"], dt).reshape(B, nkv_l, d)
             q = _rope_rows(q[:, None], cfg.rope_theta, lens)[:, 0]
             k = _rope_rows(k, cfg.rope_theta, lens)[:, 0]
-            kp = kp.at[page_ids, :, slots, :].set(k.astype(kp.dtype))
-            vp = vp.at[page_ids, :, slots, :].set(v.astype(vp.dtype))
-            attn = paged_decode_attention(q, kp, vp, tables, lens + 1)
+            if q8:
+                # per LOCAL head quantisation — scales shard with the
+                # heads, nothing crosses the mp axis
+                kq, kss = quantize_kv_token(k)
+                vq, vss = quantize_kv_token(v)
+                kp = kp.at[page_ids, :, slots, :].set(kq)
+                vp = vp.at[page_ids, :, slots, :].set(vq)
+                ks = ks.at[page_ids, :, slots].set(kss)
+                vs = vs.at[page_ids, :, slots].set(vss)
+                attn = paged_decode_attention_q8(q, kp, vp, ks, vs,
+                                                 tables, lens + 1)
+            else:
+                kp = kp.at[page_ids, :, slots, :].set(k.astype(kp.dtype))
+                vp = vp.at[page_ids, :, slots, :].set(v.astype(vp.dtype))
+                attn = paged_decode_attention(q, kp, vp, tables,
+                                              lens + 1)
             o = _mm(attn.reshape(B, n_l * d), bp["wo"], dt)
             xc = xc + jax.lax.psum(o, ax)             # row-parallel
             res = xc
@@ -417,27 +434,48 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
             act = (jax.nn.silu(_mm(y2, bp["w_gate"], dt))
                    * _mm(y2, bp["w_up"], dt))
             ffn = _mm(act, bp["w_down"], dt)
-            return res + jax.lax.psum(ffn, ax), (kp, vp)
+            return res + jax.lax.psum(ffn, ax), \
+                ((kp, vp, ks, vs) if q8 else (kp, vp))
 
-        x, (kpool, vpool) = jax.lax.scan(
-            layer, x, (params["blocks"], kpool, vpool))
+        xs = (params["blocks"], kpool, vpool)
+        if q8:
+            xs = xs + (kscale, vscale)
+        x, pools = jax.lax.scan(layer, x, xs)
         h = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         logits_l = _mm(h, params["lm_head"], dt).astype(jnp.float32)
         logits = jax.lax.all_gather(logits_l, ax, axis=1,
                                     tiled=True)       # [B, V]
         nxt = _pick_token(logits, temperature, key)
+        if q8:
+            kpool, vpool, kscale, vscale = pools
+            return kpool, vpool, kscale, vscale, nxt
+        kpool, vpool = pools
         return kpool, vpool, nxt
 
     pool_spec = P(None, None, "mp", None, None)
-    fn = jax.jit(
-        shard_map(
+    scale_spec = P(None, None, "mp", None)
+    if q8:
+        inner = shard_map(
             step_local, mesh=mesh,
+            in_specs=(param_specs(cfg, pp=1), pool_spec, pool_spec,
+                      scale_spec, scale_spec, P(), P(), P(), P()),
+            out_specs=(pool_spec, pool_spec, scale_spec, scale_spec,
+                       P()),
+            check_vma=False)
+        fn = jax.jit(inner, donate_argnums=(1, 2, 3, 4))
+    else:
+        def without_scales(params, kpool, vpool, tables, lens, tok,
+                           key):
+            return step_local(params, kpool, vpool, None, None,
+                              tables, lens, tok, key)
+        inner = shard_map(
+            without_scales, mesh=mesh,
             in_specs=(param_specs(cfg, pp=1), pool_spec, pool_spec,
                       P(), P(), P(), P()),
             out_specs=(pool_spec, pool_spec, P()),
-            check_vma=False),
-        donate_argnums=(1, 2))
-    _step_tp_cache[(_cfg_key(cfg), temperature, mesh)] = fn
+            check_vma=False)
+        fn = jax.jit(inner, donate_argnums=(1, 2))
+    _step_tp_cache[(_cfg_key(cfg), temperature, kv_quant, mesh)] = fn
     return fn
 
 
