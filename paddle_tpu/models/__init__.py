@@ -14,3 +14,8 @@ from . import llama_pretrain  # noqa: F401
 from .llama_pretrain import (  # noqa: F401
     LlamaPretrainConfig, make_train_step, init_params, init_adamw_state,
     build_mesh)
+from .paged_decode import (  # noqa: F401
+    PagedKVCache, generate_paged, generate_auto,
+    make_paged_decode_step, make_paged_decode_step_tp)
+from .serving_engine import (  # noqa: F401
+    ContinuousBatchingEngine, Request)
