@@ -49,6 +49,11 @@ from .flash_attention import NEG_INF, _interpret, _pick_blocks
 __all__ = ["flash_attention_segmented", "segment_ids_from_cu_seqlens",
            "xla_segmented_sdpa"]
 
+# observable count of dense-O(S^2) fallback dispatches (round-4 weak
+# item 8: the fallback used to be silent); warned once per seq length
+dense_fallback_count = 0
+_FALLBACK_WARNED: set = set()
+
 
 def segment_ids_from_cu_seqlens(cu, total):
     """cu_seqlens [n+1] (monotone, cu[0]=0, cu[-1]=total) -> int32
@@ -290,6 +295,22 @@ def flash_attention_segmented(q, k, v, segment_ids, causal=False):
             f"q heads {q.shape[2]} must be a multiple of kv heads "
             f"{k.shape[2]}")
     if _pick_blocks(q.shape[1]) is None:
+        # NOT silent (round-4 weak item 8): the dense-mask path is
+        # O(S_total^2) with no block skipping — a packed batch of many
+        # short sequences pays quadratically.  Counted + warned once
+        # per shape so the perf cliff is visible in logs and probes.
+        global dense_fallback_count
+        dense_fallback_count += 1
+        key = (q.shape[1],)
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            import warnings
+            warnings.warn(
+                f"flash_attention_segmented: seq len {q.shape[1]} has "
+                f"no divisible block size — falling back to the DENSE "
+                f"O(S^2) masked path (no block skipping). Pad the "
+                f"packed batch to a multiple of 128 to use the "
+                f"kernel.", stacklevel=2)
         return xla_segmented_sdpa(q, k, v, seg, causal)
     return _flash_seg(q, k, v, seg, causal)
 

@@ -93,6 +93,28 @@ def test_segmented_kernel_gqa_native_parity(causal, hkv):
                                    atol=5e-4)
 
 
+def test_segmented_dense_fallback_warns_and_counts():
+    """Indivisible sequence lengths fall back to the dense O(S^2)
+    path NOT silently: one warning per shape, every dispatch counted
+    (round-4 weak item 8)."""
+    import warnings
+    from paddle_tpu.ops.pallas import flash_varlen as fv
+
+    rng = np.random.RandomState(2)
+    S = 100                                 # no divisible block
+    q = jnp.asarray(rng.randn(1, S, 2, 16).astype(np.float32))
+    seg = jnp.asarray(_ragged_seg([S], S)[None])
+    before = fv.dense_fallback_count
+    fv._FALLBACK_WARNED.discard((S,))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        flash_attention_segmented(q, q, q, seg, causal=True)
+        flash_attention_segmented(q, q, q, seg, causal=True)
+    assert fv.dense_fallback_count == before + 2
+    msgs = [str(x.message) for x in w if "DENSE" in str(x.message)]
+    assert len(msgs) == 1, msgs             # once per shape
+
+
 def test_segmented_kernel_gqa_rejects_indivisible_heads():
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(1, 128, 4, 16).astype(np.float32))
