@@ -249,12 +249,18 @@ class _GenHandler(BaseHTTPRequestHandler):
         except ValueError as e:           # oversized for the pool
             self._reply(400, f"rejected: {e}".encode(), "text/plain")
             return
+        except RuntimeError:              # engine died: retry elsewhere
+            self._reply(503, b"engine unavailable", "text/plain")
+            return
         if path == "/generate":
             toks = []
             while True:
                 kind, payload = q.get()
                 if kind == "tok":
                     toks.append(payload)
+                elif payload is None:     # engine crashed mid-request
+                    self._reply(500, b"generation failed", "text/plain")
+                    return
                 else:
                     self._reply(200, json.dumps(
                         {"rid": rid, "tokens": payload}).encode())
@@ -276,6 +282,12 @@ class _GenHandler(BaseHTTPRequestHandler):
             if kind == "tok":
                 chunk(json.dumps({"rid": rid,
                                   "token": payload}).encode() + b"\n")
+            elif payload is None:               # engine crashed
+                chunk(json.dumps({"rid": rid, "done": True,
+                                  "error": "generation failed"})
+                      .encode() + b"\n")
+                chunk(b"")
+                return
             else:
                 chunk(json.dumps({"rid": rid, "done": True,
                                   "tokens": payload}).encode() + b"\n")
@@ -310,10 +322,13 @@ class GenerationServer:
         self._httpd = None
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._fatal: Optional[str] = None
 
     def submit(self, prompt, max_new_tokens):
         import queue as _queue
         with self._lock:
+            if self._fatal is not None:
+                raise RuntimeError(f"engine died: {self._fatal}")
             rid = self.engine.submit(prompt,
                                      max_new_tokens=max_new_tokens)
             q = _queue.Queue()
@@ -323,19 +338,30 @@ class GenerationServer:
     def _drive(self):
         """Engine thread: step while there is work, fan tokens out to
         each request's queue.  All engine access is under the lock —
-        the HTTP handlers only touch submit() and their own queue."""
+        the HTTP handlers only touch submit() and their own queue.
+        A crashed step fails every pending request LOUDLY (a silent
+        thread death would leave HTTP clients blocked on their queues
+        until timeout)."""
         import time as _time
         while not self._stop.is_set():
-            with self._lock:
-                worked = self.engine.has_work()
-                if worked:
-                    self.engine.step()
-                    for rid, tok in self.engine.drain_stream():
-                        self._queues[rid].put(("tok", tok))
-                    for req in self.engine.finished():
-                        q = self._queues.pop(req.rid, None)
-                        if q is not None:
-                            q.put(("done", list(req.generated)))
+            try:
+                with self._lock:
+                    worked = self.engine.has_work()
+                    if worked:
+                        self.engine.step()
+                        for rid, tok in self.engine.drain_stream():
+                            self._queues[rid].put(("tok", tok))
+                        for req in self.engine.finished():
+                            q = self._queues.pop(req.rid, None)
+                            if q is not None:
+                                q.put(("done", list(req.generated)))
+            except Exception as e:                # engine wedged
+                with self._lock:
+                    dead, self._queues = self._queues, {}
+                    self._fatal = f"{type(e).__name__}: {e}"
+                for q in dead.values():
+                    q.put(("done", None))         # handlers -> 500
+                return
             if not worked:
                 _time.sleep(self._poll_s)
 

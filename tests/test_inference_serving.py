@@ -212,3 +212,32 @@ def test_generation_server_tp_mesh_parity():
         np.testing.assert_array_equal(np.asarray(got), ref)
     finally:
         srv.stop()
+
+
+def test_generation_server_engine_crash_fails_pending_loudly():
+    """A crashed engine step must 500 the pending requests and 503 new
+    submits — never leave HTTP clients blocked on silent queues."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+
+    cfg, params, cache = _gen_setup()
+    srv = GenerationServer(cfg, params, cache)
+
+    def boom():
+        raise RuntimeError("induced engine failure")
+
+    srv.engine.step = boom          # crash on first drive iteration
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        rng = np.random.RandomState(30)
+        with pytest.raises(urllib.request.HTTPError) as ei:
+            generate_http(url, rng.randint(1, 128, (6,)),
+                          max_new_tokens=4, timeout=30)
+        assert ei.value.code == 500
+        with pytest.raises(urllib.request.HTTPError) as ei2:
+            generate_http(url, rng.randint(1, 128, (6,)),
+                          max_new_tokens=4, timeout=30)
+        assert ei2.value.code == 503
+    finally:
+        srv.stop()
