@@ -60,7 +60,7 @@ __all__ = [
     "gather_tree", "rnnt_loss", "temporal_shift", "class_center_sample",
     "sparse_attention", "adaptive_log_softmax_with_loss",
     "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
-    "flash_attention_with_sparse_mask",
+    "flash_attn_unpadded", "flash_attention_with_sparse_mask",
     # in-place aliases
     "elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
     "thresholded_relu_",

@@ -28,7 +28,8 @@ __all__ = [
     "gaussian_nll_loss", "gather_tree", "rnnt_loss",
     "temporal_shift", "class_center_sample", "sparse_attention",
     "adaptive_log_softmax_with_loss", "flash_attn_qkvpacked",
-    "flash_attn_varlen_qkvpacked", "flash_attention_with_sparse_mask",
+    "flash_attn_varlen_qkvpacked", "flash_attn_unpadded",
+    "flash_attention_with_sparse_mask",
 ]
 
 
@@ -819,6 +820,77 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
         return out[0, :total]
 
     out = apply("flash_attn_varlen", fn, qkv)
+    return (out, None) if return_softmax else out
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None,
+                        scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, fixed_seed_offset=None,
+                        rng_name="", training=True, name=None):
+    """Separate-tensor varlen flash attention over packed sequences
+    (reference: flash_attn_unpadded, nn/functional/flash_attention.py:455
+    — the varlen CUDA entry that takes a distinct kv head count).
+
+    TPU-native AND GQA-NATIVE: ``query [T, n, d]``, ``key``/``value``
+    ``[T, nkv, d]`` with nkv dividing n run as ONE segment-aware Pallas
+    program — the kernel indexes kv heads by group, so K/V are never
+    repeated to full heads (ops/pallas/flash_varlen.py).  q and k must
+    share segment boundaries (self-attention packing);
+    cross-shaped batches and ``dropout > 0`` take a per-sequence dense
+    loop.
+    """
+    query = as_tensor(query)
+    key = as_tensor(key)
+    value = as_tensor(value)
+    cu = np.asarray(as_tensor(cu_seqlens_q).numpy()).astype(np.int64)
+    cu_k = np.asarray(as_tensor(cu_seqlens_k).numpy()).astype(np.int64)
+    D = query.shape[-1]
+    if dropout or not np.array_equal(cu, cu_k):
+        # per-sequence dense loop (cross-attention packing or prob
+        # dropout — both incompatible with the online-softmax kernel)
+        from . import scaled_dot_product_attention
+        outs = []
+        n, nkv = query.shape[1], key.shape[1]
+        for i in range(len(cu) - 1):
+            q = query[int(cu[i]):int(cu[i + 1])][None]
+            k = key[int(cu_k[i]):int(cu_k[i + 1])][None]
+            v = value[int(cu_k[i]):int(cu_k[i + 1])][None]
+            if nkv != n:
+                from ...tensor.manipulation import repeat_interleave
+                k = repeat_interleave(k, n // nkv, axis=2)
+                v = repeat_interleave(v, n // nkv, axis=2)
+            if scale is not None:
+                q = q * (scale * math.sqrt(D))
+            outs.append(scaled_dot_product_attention(
+                q, k, v, is_causal=causal, dropout_p=dropout)[0])
+        from ...tensor.manipulation import concat
+        out = concat(outs, axis=0)
+        return (out, None) if return_softmax else out
+
+    from ...ops.pallas.flash_varlen import (
+        flash_attention_segmented, segment_ids_from_cu_seqlens)
+
+    total = int(cu[-1])
+    pad = (-total) % 128 if total >= 128 else (128 - total)
+    seg_np = np.asarray(segment_ids_from_cu_seqlens(
+        jnp.asarray(cu, jnp.int32), total))
+    seg_full = np.concatenate(
+        [seg_np, np.full((pad,), -1, np.int32)])[None]
+
+    def fn(q, k, v):
+        if scale is not None:
+            q = q * (scale * math.sqrt(D))
+        if pad:
+            q = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+            k = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+        out = flash_attention_segmented(
+            q[None], k[None], v[None], jnp.asarray(seg_full),
+            causal=causal)
+        return out[0, :total]
+
+    out = apply("flash_attn_unpadded", fn, query, key, value)
     return (out, None) if return_softmax else out
 
 

@@ -188,6 +188,61 @@ def test_varlen_qkvpacked_matches_per_sequence_dense():
     np.testing.assert_allclose(out_s.numpy(), out_ref.numpy(), atol=2e-5)
 
 
+def test_flash_attn_unpadded_gqa_matches_per_sequence_dense():
+    """The public separate-tensor varlen entry (reference:
+    flash_attn_unpadded at flash_attention.py:455): k/v carry nkv < n
+    heads straight through the GQA-native kernel; every packed
+    sequence's slice matches its own dense GQA attention."""
+    rng = np.random.RandomState(6)
+    lens = [24, 40, 16]
+    T = sum(lens)
+    n, nkv, d = 4, 2, 16
+    q = rng.randn(T, n, d).astype(np.float32)
+    k = rng.randn(T, nkv, d).astype(np.float32)
+    v = rng.randn(T, nkv, d).astype(np.float32)
+    cu = np.cumsum([0] + lens).astype(np.int64)
+
+    out = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max(lens), max(lens), causal=True)
+    got = out.numpy()
+    assert got.shape == (T, n, d)
+
+    g_rep = n // nkv
+    for i in range(len(lens)):
+        a, b = int(cu[i]), int(cu[i + 1])
+        qq = q[a:b]
+        kk = np.repeat(k[a:b], g_rep, axis=1)
+        vv = np.repeat(v[a:b], g_rep, axis=1)
+        s = np.einsum("qhd,khd->hqk", qq, kk) / np.sqrt(d)
+        L = b - a
+        mask = np.tril(np.ones((L, L), bool))
+        s = np.where(mask[None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hqk,khd->qhd", p, vv)
+        np.testing.assert_allclose(got[a:b], ref, atol=3e-5)
+
+    # grads flow through the tape
+    qt = paddle.to_tensor(q)
+    qt.stop_gradient = False
+    out2 = F.flash_attn_unpadded(
+        qt, paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max(lens), max(lens), causal=True)
+    (out2 ** 2).sum().backward()
+    assert qt.grad is not None
+
+    # mismatched cu_seqlens -> the dense per-sequence (cross) loop
+    cu_k = np.cumsum([0, 20, 44, 16]).astype(np.int64)
+    out3 = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu_k),
+        max(lens), 44, causal=False)
+    assert tuple(out3.shape) == (T, n, d)
+
+
 def test_varlen_qkvpacked_rejects_mismatched_cu():
     rng = np.random.RandomState(1)
     qkv = paddle.to_tensor(rng.randn(16, 3, 2, 8).astype(np.float32))
