@@ -222,10 +222,18 @@ class _GenHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv: "GenerationServer" = self.server.owner
         if self.path.rstrip("/") in ("", "/health"):
+            eng = srv.engine
             self._reply(200, json.dumps(
-                {"status": "ok",
-                 "active": len(srv.engine._active),
-                 "queued": len(srv.engine._queue)}).encode())
+                {"status": "ok" if srv._fatal is None else "failed",
+                 "error": srv._fatal,
+                 "active": len(eng._active),
+                 "queued": len(eng._queue),
+                 "free_pages": eng.cache.free_pages(),
+                 "decode_steps": eng.decode_steps,
+                 "tokens_generated": eng.tokens_generated,
+                 "prefill_calls": eng.prefill_calls,
+                 "preemptions": eng.preemptions,
+                 "requests_finished": eng.requests_finished}).encode())
         else:
             self._reply(404, b"not found", "text/plain")
 

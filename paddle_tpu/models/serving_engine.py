@@ -90,6 +90,11 @@ class ContinuousBatchingEngine:
         # program dispatches for admission, observable for the
         # sublinearity contract (K same-bucket admits = ONE dispatch)
         self.prefill_calls = 0
+        # serving counters (surfaced by GenerationServer /health)
+        self.decode_steps = 0
+        self.tokens_generated = 0
+        self.preemptions = 0
+        self.requests_finished = 0
         self.B = cache.tables.shape[0]
         self._free_slots = list(range(self.B))
         self._queue: deque = deque()
@@ -283,6 +288,7 @@ class ContinuousBatchingEngine:
         req = self._active.pop(slot)
         req.slot = None
         req.preempted += 1
+        self.preemptions += 1
         self.cache.release_row(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
@@ -295,6 +301,7 @@ class ContinuousBatchingEngine:
         self.cache.release_row(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
+        self.requests_finished += 1
         self._finished.append(req)
 
     def step(self) -> int:
@@ -360,10 +367,12 @@ class ContinuousBatchingEngine:
         cache.lens = cache.lens + (np.asarray(
             [1 if s in self._active else 0 for s in range(self.B)],
             np.int32))
+        self.decode_steps += 1
         nxt = np.asarray(nxt)
         for slot, req in list(self._active.items()):
             t = int(nxt[slot])
             req.generated.append(t)
+            self.tokens_generated += 1
             self._stream.append((req.rid, t))
             self._next_tok[slot] = t
             self._remaining[slot] -= 1

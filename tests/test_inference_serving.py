@@ -241,3 +241,27 @@ def test_generation_server_engine_crash_fails_pending_loudly():
         assert ei2.value.code == 503
     finally:
         srv.stop()
+
+
+def test_generation_server_health_metrics():
+    """/health reports live serving counters (tokens, steps, prefill
+    dispatches, preemptions, pool occupancy)."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+
+    cfg, params, cache = _gen_setup()
+    srv = GenerationServer(cfg, params, cache)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        rng = np.random.RandomState(31)
+        generate_http(url, rng.randint(1, 128, (8,)), max_new_tokens=4)
+        with urllib.request.urlopen(url + "/health", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok"
+        assert h["requests_finished"] == 1
+        assert h["tokens_generated"] >= 3     # admission token + steps
+        assert h["prefill_calls"] == 1
+        assert h["active"] == 0 and h["queued"] == 0
+    finally:
+        srv.stop()
