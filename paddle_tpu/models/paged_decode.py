@@ -232,10 +232,31 @@ def _decode_layer(cfg, bp, kp, vp, xc, tables, lens, page_ids, slots,
     return out, kp, vp, ks, vs
 
 
-def _pick_token(logits, temperature, key):
+def _pick_token(logits, temperature, key, top_k: int = 0,
+                top_p: float = 1.0):
+    """Greedy / temperature / top-k / nucleus sampling, all as static
+    lax ops (the sampler compiles into the decode step — reference:
+    the sampling ops the generation ops feed,
+    incubate top_p_sampling).  ``top_k=0`` disables k-filtering;
+    ``top_p=1.0`` disables nucleus filtering; both compose."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1)
-    return jax.random.categorical(key, logits / temperature, -1)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (the
+        # first token is always kept: cum shifted right by one)
+        keep = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, -1)
 
 
 def _cfg_key(cfg) -> str:
@@ -250,7 +271,8 @@ _gen_cache: dict = {}
 def make_paged_decode_step(cfg: LlamaPretrainConfig,
                            temperature: float = 0.0,
                            kv_quant: Optional[str] = None,
-                           with_logits: bool = False):
+                           with_logits: bool = False,
+                           top_k: int = 0, top_p: float = 1.0):
     """Jitted ``step(params, kpool, vpool, tables, lens, tok, key)
     -> (kpool, vpool, next_tok)`` — or, with ``kv_quant="int8"``,
     ``step(params, kpool, vpool, kscale, vscale, tables, lens, tok,
@@ -269,7 +291,7 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
     dt = cfg.dtype
 
     hit = _step_cache.get((_cfg_key(cfg), temperature, kv_quant,
-                           with_logits))
+                           with_logits, top_k, top_p))
     if hit is not None:
         return hit
 
@@ -297,7 +319,7 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
         x, (kpool, vpool) = jax.lax.scan(
             layer, x, (params["blocks"], kpool, vpool))
         logits = tail(x, params)
-        nxt = _pick_token(logits, temperature, key)
+        nxt = _pick_token(logits, temperature, key, top_k, top_p)
         if with_logits:
             return kpool, vpool, nxt, logits
         return kpool, vpool, nxt
@@ -320,7 +342,7 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
         x, (kpool, vpool, kscale, vscale) = jax.lax.scan(
             layer, x, (params["blocks"], kpool, vpool, kscale, vscale))
         logits = tail(x, params)
-        nxt = _pick_token(logits, temperature, key)
+        nxt = _pick_token(logits, temperature, key, top_k, top_p)
         if with_logits:
             return kpool, vpool, kscale, vscale, nxt, logits
         return kpool, vpool, kscale, vscale, nxt
@@ -332,7 +354,8 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
         fn = jax.jit(step_q8, donate_argnums=(1, 2, 3, 4))
     else:
         fn = jax.jit(step, donate_argnums=(1, 2))
-    _step_cache[(_cfg_key(cfg), temperature, kv_quant, with_logits)] = fn
+    _step_cache[(_cfg_key(cfg), temperature, kv_quant, with_logits,
+                 top_k, top_p)] = fn
     return fn
 
 
@@ -341,7 +364,8 @@ _step_tp_cache: dict = {}
 
 def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
                               temperature: float = 0.0,
-                              kv_quant: Optional[str] = None):
+                              kv_quant: Optional[str] = None,
+                              top_k: int = 0, top_p: float = 1.0):
     """TENSOR-PARALLEL paged decode step: the whole per-token program is
     ONE jitted shard_map over the mesh's ``mp`` axis — Megatron-sharded
     weights (column q/k/v + gate/up, row wo/w_down with psum),
@@ -359,7 +383,7 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
     """
     mp = mesh.shape["mp"]
     hit = _step_tp_cache.get((_cfg_key(cfg), temperature, kv_quant,
-                              mesh))
+                              mesh, top_k, top_p))
     if hit is not None:
         return hit
 
@@ -445,7 +469,7 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
         logits_l = _mm(h, params["lm_head"], dt).astype(jnp.float32)
         logits = jax.lax.all_gather(logits_l, ax, axis=1,
                                     tiled=True)       # [B, V]
-        nxt = _pick_token(logits, temperature, key)
+        nxt = _pick_token(logits, temperature, key, top_k, top_p)
         if q8:
             kpool, vpool, kscale, vscale = pools
             return kpool, vpool, kscale, vscale, nxt
@@ -475,14 +499,16 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
             out_specs=(pool_spec, pool_spec, P()),
             check_vma=False)
         fn = jax.jit(inner, donate_argnums=(1, 2))
-    _step_tp_cache[(_cfg_key(cfg), temperature, kv_quant, mesh)] = fn
+    _step_tp_cache[(_cfg_key(cfg), temperature, kv_quant, mesh,
+                    top_k, top_p)] = fn
     return fn
 
 
 def make_paged_generate_fused(cfg: LlamaPretrainConfig,
                               max_new_tokens: int,
                               temperature: float = 0.0,
-                              kv_quant: Optional[str] = None):
+                              kv_quant: Optional[str] = None,
+                              top_k: int = 0, top_p: float = 1.0):
     """ONE jitted program for the whole paged generation tail: pages
     for ``lens + max_new_tokens`` are pre-allocated so the block tables
     are CONSTANT across steps, and a ``lax.scan`` advances every row at
@@ -491,7 +517,7 @@ def make_paged_generate_fused(cfg: LlamaPretrainConfig,
     serving loops that admit/evict requests between steps; this fused
     form is for generation (one dispatch instead of max_new)."""
     hit = _gen_cache.get((_cfg_key(cfg), max_new_tokens, temperature,
-                          kv_quant))
+                          kv_quant, top_k, top_p))
     if hit is not None:
         return hit
 
@@ -535,7 +561,7 @@ def make_paged_generate_fused(cfg: LlamaPretrainConfig,
                           cfg.rms_norm_eps)
             logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
             key, sub = jax.random.split(key)
-            nxt = _pick_token(logits, temperature, sub)
+            nxt = _pick_token(logits, temperature, sub, top_k, top_p)
             return (kpool, vpool, kscale, vscale, nxt, lens + 1,
                     key), nxt
 
@@ -548,7 +574,7 @@ def make_paged_generate_fused(cfg: LlamaPretrainConfig,
 
     fn = jax.jit(generate, donate_argnums=(1, 2, 3, 4))
     _gen_cache[(_cfg_key(cfg), max_new_tokens, temperature,
-                kv_quant)] = fn
+                kv_quant, top_k, top_p)] = fn
     return fn
 
 
@@ -692,7 +718,8 @@ def _prefill_chunk(cfg: LlamaPretrainConfig, q8: bool):
 def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
                    max_new_tokens: int, cache: PagedKVCache,
                    temperature: float = 0.0, seed: int = 0,
-                   fused: bool = True):
+                   fused: bool = True, top_k: int = 0,
+                   top_p: float = 1.0):
     """Generate with the paged cache: dense prefill (one jitted causal
     forward collecting K/V, written into each row's pages), then the
     paged decode tail — by default ONE fused scan program with
@@ -749,11 +776,8 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
                   cfg.rms_norm_eps)
     logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
     key = jax.random.PRNGKey(seed)
-    if temperature <= 0.0:
-        tok = jnp.argmax(logits, axis=-1)
-    else:
-        key, sub = jax.random.split(key)
-        tok = jax.random.categorical(sub, logits / temperature, -1)
+    key, sub = jax.random.split(key)
+    tok = _pick_token(logits, temperature, sub, top_k, top_p)
 
     if fused:
         # pre-allocate every page the tail will touch -> tables are
@@ -763,7 +787,8 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
             cache.ensure_capacity(b, new_tokens=max_new_tokens)
         gen = make_paged_generate_fused(cfg, max_new_tokens,
                                         temperature,
-                                        kv_quant=cache.kv_quant)
+                                        kv_quant=cache.kv_quant,
+                                        top_k=top_k, top_p=top_p)
         key, sub = jax.random.split(key)
         # two DISTINCT dummies: both args are donated and donating one
         # buffer twice is an error
@@ -780,7 +805,8 @@ def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
         return jnp.transpose(toks)                   # [B, max_new]
 
     step = make_paged_decode_step(cfg, temperature,
-                                  kv_quant=cache.kv_quant)
+                                  kv_quant=cache.kv_quant,
+                                  top_k=top_k, top_p=top_p)
     out_toks = [tok]
     ksp, vsp = (kscale_pool, vscale_pool) if q8 else (None, None)
     for _ in range(max_new_tokens - 1):

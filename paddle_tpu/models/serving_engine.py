@@ -63,7 +63,7 @@ class ContinuousBatchingEngine:
                  temperature: float = 0.0, seed: int = 0,
                  prefill_bucket: int = 64,
                  prefill_chunk: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, top_k: int = 0, top_p: float = 1.0):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -76,6 +76,7 @@ class ContinuousBatchingEngine:
         self.mesh = mesh
         self.eos_id = eos_id
         self.temperature = temperature
+        self.top_k, self.top_p = top_k, top_p
         # bucket lengths must be page-aligned or the page write would
         # slice/reshape inconsistently (loud here, confusing there)
         page = cache.page
@@ -106,10 +107,12 @@ class ContinuousBatchingEngine:
         self._key = jax.random.PRNGKey(seed)
         if mesh is not None and mesh.shape.get("mp", 1) > 1:
             self._step = make_paged_decode_step_tp(
-                cfg, mesh, temperature, kv_quant=cache.kv_quant)
+                cfg, mesh, temperature, kv_quant=cache.kv_quant,
+                top_k=top_k, top_p=top_p)
         else:
-            self._step = make_paged_decode_step(cfg, temperature,
-                                                kv_quant=cache.kv_quant)
+            self._step = make_paged_decode_step(
+                cfg, temperature, kv_quant=cache.kv_quant,
+                top_k=top_k, top_p=top_p)
         self._next_tok = np.zeros((self.B,), np.int64)
         self._remaining = np.zeros((self.B,), np.int64)
 
@@ -220,7 +223,8 @@ class ContinuousBatchingEngine:
                          self.cfg.dtype).astype(jnp.float32)
             self._key, sub = jax.random.split(self._key)
             toks = np.asarray(_pick_token(logits, self.temperature,
-                                          sub))
+                                          sub, self.top_k,
+                                          self.top_p))
         for i, (req, slot) in enumerate(zip(reqs, slots)):
             if req.generated:                    # resume after preempt
                 tok = req.generated[-1]
@@ -271,7 +275,7 @@ class ContinuousBatchingEngine:
                          self.cfg.dtype).astype(jnp.float32)
             self._key, sub = jax.random.split(self._key)
             tok = int(_pick_token(logits[None], self.temperature,
-                                  sub)[0])
+                                  sub, self.top_k, self.top_p)[0])
             req.generated.append(tok)
             self._stream.append((req.rid, tok))
         self._finish_admit(req, slot, tok)
