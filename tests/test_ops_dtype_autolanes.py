@@ -175,11 +175,14 @@ def test_surface_low_precision_sweep(surfaces, space, dt):
             if g.shape != r.shape:
                 failures.append(f"{name}: shape {g.shape} vs {r.shape}")
                 break
-            scale = np.maximum(np.abs(r), 1.0)
-            err = float(np.max(np.abs(g - r) / scale)) if g.size else 0.0
             if not np.isfinite(g).all() and np.isfinite(r).all():
                 failures.append(f"{name}: non-finite in {dt}")
                 break
+            scale = np.maximum(np.abs(r), 1.0)
+            with np.errstate(invalid="ignore"):  # inf-inf where BOTH
+                diff = np.abs(g - r) / scale     # are inf is agreement
+            diff = np.where(g == r, 0.0, diff)
+            err = float(np.nanmax(diff)) if g.size else 0.0
             if err > tol:
                 failures.append(f"{name}: rel err {err:.3g} > {tol}")
                 break
