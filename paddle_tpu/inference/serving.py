@@ -233,6 +233,7 @@ class _GenHandler(BaseHTTPRequestHandler):
                  "tokens_generated": eng.tokens_generated,
                  "prefill_calls": eng.prefill_calls,
                  "preemptions": eng.preemptions,
+                 "prefix_hits": eng.cache.prefix_hits,
                  "requests_finished": eng.requests_finished}).encode())
         else:
             self._reply(404, b"not found", "text/plain")

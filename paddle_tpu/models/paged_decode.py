@@ -104,22 +104,123 @@ class PagedKVCache:
         self.tables = np.zeros((batch, pages_max), np.int32)
         self.lens = np.zeros((batch,), np.int32)
         self._owned = [[] for _ in range(batch)]
+        # PREFIX CACHING (vLLM-style, the sharing the reference's block
+        # tables exist for): refcounted pages + an LRU index mapping a
+        # full page's token-CHAIN key -> page id.  Only FULL pages are
+        # ever shared, so shared pages are immutable — decode writes
+        # land at lens >= the shared region, in private pages; no
+        # copy-on-write needed.  The index holds one ref per cached
+        # page; rows holding it add theirs.
+        self.refs = np.zeros(num_pages, np.int64)
+        from collections import OrderedDict
+        self._prefix_index: "OrderedDict" = OrderedDict()
+        self.prefix_hits = 0              # pages reused via the index
 
     def free_pages(self) -> int:
         return len(self._free)
+
+    # -- prefix caching ---------------------------------------------------
+    @staticmethod
+    def _chain_keys(ctx: np.ndarray, page: int):
+        """Chain key per FULL page: key_i covers tokens [0, (i+1)*page)
+        — position-sensitive by construction (each key hashes the whole
+        prefix, not just its own page)."""
+        import hashlib
+        keys = []
+        h = hashlib.sha1()
+        for i in range(len(ctx) // page):
+            h.update(np.ascontiguousarray(
+                ctx[i * page:(i + 1) * page]).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def _page_alloc(self) -> int:
+        """Pop a free page, evicting LRU zero-ref cached prefixes when
+        the free list is dry."""
+        if not self._free:
+            for key in list(self._prefix_index):
+                pid = self._prefix_index[key]
+                if self.refs[pid] == 1:          # only the index holds it
+                    del self._prefix_index[key]
+                    self.refs[pid] = 0
+                    self._free.append(pid)
+                    break
+        if not self._free:
+            raise RuntimeError("KV page pool exhausted")
+        return self._free.pop()
+
+    def alloc_row_prefix(self, b: int, ctx: np.ndarray) -> int:
+        """Like :meth:`alloc_row` but REUSES cached prefix pages: the
+        longest chain-key run found in the index is shared (increfed),
+        only the remainder gets fresh pages.  Returns the number of
+        reused TOKENS (a page multiple) — the caller prefills from
+        there."""
+        page = self.page
+        L = len(ctx)
+        need = (L + page - 1) // page
+        if need > self.pages_max:
+            raise ValueError(f"length {L} exceeds pages_max")
+        self.release_row(b)
+        keys = self._chain_keys(ctx, page)
+        shared = []
+        for key in keys:
+            pid = self._prefix_index.get(key)
+            if pid is None:
+                break
+            self._prefix_index.move_to_end(key)      # LRU touch
+            shared.append(pid)
+        # a fully-cached page-aligned context would leave nothing to
+        # prefill — the engine needs the LAST page's K/V computed to
+        # produce next-token logits anyway, so keep >=1 page private
+        if L % page == 0 and len(shared) == len(keys) and shared:
+            shared.pop()
+        try:
+            for j, pid in enumerate(shared):
+                self.refs[pid] += 1
+                self.tables[b, j] = pid
+                self._owned[b].append(pid)
+            self.prefix_hits += len(shared)
+            for j in range(len(shared), need):
+                pid = self._page_alloc()
+                self.refs[pid] += 1
+                self.tables[b, j] = pid
+                self._owned[b].append(pid)
+        except RuntimeError:
+            self.release_row(b)     # roll back the partial claim
+            raise
+        self.lens[b] = L
+        return len(shared) * page
+
+    def register_prefix(self, b: int, ctx: np.ndarray) -> None:
+        """Insert row ``b``'s FULL pages into the prefix index (one
+        index ref each) so later admissions sharing the prefix reuse
+        them."""
+        page = self.page
+        keys = self._chain_keys(ctx, page)
+        for j, key in enumerate(keys):
+            if key in self._prefix_index:
+                continue
+            pid = int(self.tables[b, j])
+            self._prefix_index[key] = pid
+            self.refs[pid] += 1
 
     def alloc_row(self, b: int, length: int) -> None:
         """Claim pages for ``length`` tokens on row ``b`` (prefill)."""
         need = (length + self.page - 1) // self.page
         if need > self.pages_max:
             raise ValueError(f"length {length} exceeds pages_max")
-        if need > len(self._free):
+        if need > len(self._free) and not self._prefix_index:
             raise RuntimeError("KV page pool exhausted")
         self.release_row(b)
-        for j in range(need):
-            pid = self._free.pop()
-            self._owned[b].append(pid)
-            self.tables[b, j] = pid
+        try:
+            for j in range(need):
+                pid = self._page_alloc()
+                self.refs[pid] += 1
+                self._owned[b].append(pid)
+                self.tables[b, j] = pid
+        except RuntimeError:
+            self.release_row(b)     # roll back the partial claim
+            raise
         self.lens[b] = length
 
     def ensure_capacity(self, b: int, new_tokens: int = 1) -> None:
@@ -131,9 +232,8 @@ class PagedKVCache:
                 f"row {b}: {int(self.lens[b])} + {new_tokens} tokens "
                 f"needs {need} pages > pages_max {self.pages_max}")
         while len(self._owned[b]) < need:
-            if not self._free:
-                raise RuntimeError("KV page pool exhausted")
-            pid = self._free.pop()
+            pid = self._page_alloc()
+            self.refs[pid] += 1
             self.tables[b, len(self._owned[b])] = pid
             self._owned[b].append(pid)
 
@@ -173,7 +273,9 @@ class PagedKVCache:
 
     def release_row(self, b: int) -> None:
         for pid in self._owned[b]:
-            self._free.append(pid)
+            self.refs[pid] -= 1
+            if self.refs[pid] == 0:     # cached/shared pages stay put
+                self._free.append(pid)
         self._owned[b] = []
         self.tables[b] = 0
         self.lens[b] = 0

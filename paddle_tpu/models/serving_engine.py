@@ -63,7 +63,8 @@ class ContinuousBatchingEngine:
                  temperature: float = 0.0, seed: int = 0,
                  prefill_bucket: int = 64,
                  prefill_chunk: Optional[int] = None,
-                 mesh=None, top_k: int = 0, top_p: float = 1.0):
+                 mesh=None, top_k: int = 0, top_p: float = 1.0,
+                 enable_prefix_caching: bool = False):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -88,6 +89,11 @@ class ContinuousBatchingEngine:
             prefill_chunk = ((max(prefill_chunk, page) + page - 1)
                              // page) * page
         self.prefill_chunk = prefill_chunk
+        # PREFIX CACHING: admissions share cached full pages of equal
+        # prompt prefixes and prefill only the suffix (through the
+        # prefill-with-history program); every admission routes through
+        # the chunked path so rows can start at a reused offset
+        self.enable_prefix_caching = enable_prefix_caching
         # program dispatches for admission, observable for the
         # sublinearity contract (K same-bucket admits = ONE dispatch)
         self.prefill_calls = 0
@@ -235,20 +241,27 @@ class ContinuousBatchingEngine:
             self._finish_admit(req, slot, tok)
 
     def _admit_chunked(self, req: Request, ctx: np.ndarray) -> None:
-        """CHUNKED admission for prompts longer than ``prefill_chunk``:
-        the prompt advances chunk by chunk through the prefill-with-
-        history program (attends cached pages + causal within chunk) —
-        per-dispatch cost is bounded by the chunk, not the prompt."""
+        """CHUNKED admission for prompts longer than ``prefill_chunk``
+        (and, with prefix caching, for EVERY admission — a reused
+        prefix means the row starts mid-context): the context advances
+        chunk by chunk through the prefill-with-history program
+        (attends cached pages + causal within chunk) — per-dispatch
+        cost is bounded by the chunk, not the prompt, and cached
+        prefix pages are never recomputed."""
         L = len(ctx)
-        chunk = self.prefill_chunk
+        chunk = self.prefill_chunk or self.prefill_bucket
         page = self.cache.page
         slot = self._free_slots.pop()
-        self.cache.alloc_row(slot, L)
+        if self.enable_prefix_caching:
+            start = self.cache.alloc_row_prefix(slot, ctx)
+        else:
+            self.cache.alloc_row(slot, L)
+            start = 0
         q8 = self.cache.kv_quant == "int8"
         run = _prefill_chunk(self.cfg, q8)
         dummy = jnp.zeros((1,), jnp.float32)
         x = None
-        pos = 0
+        pos = start
         while pos < L:
             C_real = min(chunk, L - pos)
             toks = np.zeros((1, chunk), np.int64)
@@ -278,6 +291,11 @@ class ContinuousBatchingEngine:
                                   sub, self.top_k, self.top_p)[0])
             req.generated.append(tok)
             self._stream.append((req.rid, tok))
+        if self.enable_prefix_caching:
+            # cache the PROMPT's full pages for future admissions
+            # (generated context stays private — chains over sampled
+            # tokens would pollute the index)
+            self.cache.register_prefix(slot, req.prompt)
         self._finish_admit(req, slot, tok)
 
     def _preempt(self, keep: int) -> bool:
@@ -327,7 +345,9 @@ class ContinuousBatchingEngine:
         buckets: Dict[int, List] = {}
         for req, ctx in admits:
             L = len(ctx)
-            if self.prefill_chunk is not None and L > self.prefill_chunk:
+            if self.enable_prefix_caching or (
+                    self.prefill_chunk is not None
+                    and L > self.prefill_chunk):
                 self._admit_chunked(req, ctx)
                 continue
             Lp = ((L + self.prefill_bucket - 1) //

@@ -1,0 +1,168 @@
+"""Draft-model SPECULATIVE DECODING over the paged KV cache.
+
+Reference role: the speculative-decoding serving path (reference-world:
+PaddleNLP speculate_decoding / draft-model inference ops) — a small
+draft model proposes ``gamma`` tokens autoregressively, the target
+model scores them ALL in one forward, and the longest greedy-matching
+prefix is accepted plus one target correction token.  With exact
+(greedy) verification the output is PROVABLY the target model's own
+greedy sequence — the draft affects speed, never content.
+
+TPU-native composition — no new device programs:
+* drafting rides the existing per-token paged decode step
+  (`make_paged_decode_step`) on the draft's own cache;
+* verification rides the prefill-with-history program
+  (`_prefill_chunk`): the candidate block (last committed token + the
+  gamma drafts, re-aligned to a page boundary) is one fixed-shape
+  chunk over the target's cached pages — one compile serves every
+  round;
+* rollback is FREE: pages are committed by ``lens`` bookkeeping only —
+  rejected drafts' K/V are simply left beyond ``lens`` and overwritten
+  by the next round's chunk (the paged design's per-row independence
+  doing the work).
+
+Greedy (temperature 0) only: exact-match verification.  The
+rejection-sampling extension for stochastic decoding changes the
+acceptance rule, not this structure.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
+from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
+                           make_paged_decode_step)
+
+__all__ = ["generate_speculative"]
+
+
+def _last_logits(cfg, params, x_last):
+    h = _rms_norm(x_last, params["final_norm"], cfg.rms_norm_eps)
+    return _mm(h, params["lm_head"], cfg.dtype).astype(jnp.float32)
+
+
+def _prefill_into(cfg, params, cache: PagedKVCache, prompt: np.ndarray):
+    """Dense prefill of ``prompt`` into row 0; returns the greedy next
+    token.  Sets lens = len(prompt)."""
+    L = len(prompt)
+    cache.alloc_row(0, L)
+    page = cache.page
+    Lp = ((L + page - 1) // page) * page
+    padded = np.zeros((1, Lp), np.int64)
+    padded[0, :L] = prompt
+    x, ks, vs = _prefill(cfg)(params, jnp.asarray(padded))
+    cache.write_row_pages(0, ks[:, 0], vs[:, 0], L)
+    return int(jnp.argmax(_last_logits(cfg, params, x[0, L - 1])))
+
+
+def generate_speculative(cfg: LlamaPretrainConfig, params,
+                         draft_cfg: LlamaPretrainConfig, draft_params,
+                         prompt, max_new_tokens: int, gamma: int = 4,
+                         page: int = 64
+                         ) -> Tuple[np.ndarray, Dict[str, float]]:
+    """Greedy speculative decoding for ONE sequence (the
+    latency-dominated serving case).  Returns ``(tokens [max_new],
+    stats)`` where stats report rounds and the acceptance histogram.
+
+    Output is token-identical to the target model's plain greedy
+    decode for ANY draft model (exact verification).
+    """
+    prompt = np.asarray(prompt, np.int64).reshape(-1)
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    if gamma >= page:
+        raise ValueError(f"gamma {gamma} must stay below page {page} "
+                         "(the verify chunk is 2 pages)")
+    S = len(prompt)
+    cap_pages = (S + max_new_tokens + gamma + 2 * page) // page + 2
+
+    tcache = PagedKVCache(cfg, num_pages=cap_pages + 1,
+                          pages_max=cap_pages, batch=1, page=page)
+    dcache = PagedKVCache(draft_cfg, num_pages=cap_pages + 1,
+                          pages_max=cap_pages, batch=1, page=page)
+
+    # prefill both models; the target's first greedy token is output #1
+    t0 = _prefill_into(cfg, params, tcache, prompt)
+    _prefill_into(draft_cfg, draft_params, dcache, prompt)
+
+    seq = list(prompt) + [t0]       # committed: target-greedy by
+    d_len = S                       # construction, invariantly
+    dstep = make_paged_decode_step(draft_cfg, temperature=0.0)
+    verify = _prefill_chunk(cfg, q8=False)
+    Cp = 2 * page                   # chunk: <=page realign + gamma+1
+    dummy = jnp.zeros((1,), jnp.float32)
+
+    rounds = 0
+    accept_hist = [0] * (gamma + 1)
+    while len(seq) - S - 1 < max_new_tokens:
+        rounds += 1
+        # --- draft phase: sync the draft cache to the committed seq
+        # (1 token per round in steady state), then draft gamma ahead
+        dcache.ensure_capacity(0, new_tokens=gamma + len(seq) - d_len)
+        drafts = []
+        tok = None
+        for pos in range(d_len, len(seq) + gamma - 1):
+            feed = seq[pos] if pos < len(seq) else drafts[-1]
+            dcache.kpool, dcache.vpool, tok = dstep(
+                draft_params, dcache.kpool, dcache.vpool,
+                jnp.asarray(dcache.tables.copy()),
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([feed], jnp.int64), jax.random.PRNGKey(0))
+            if pos >= len(seq) - 1:
+                drafts.append(int(tok[0]))
+        # drafts = [d_1 .. d_gamma]; draft cached through d_{gamma-1}
+
+        # --- verify: ONE target forward over the candidate block,
+        # re-aligned to the last page boundary (write offsets stay
+        # page-aligned; the <page recomputed tokens produce identical
+        # K/V)
+        t_ctx = len(seq) - 1                   # target-cached tokens
+        start = (t_ctx // page) * page
+        block = seq[start:] + drafts           # covers positions
+        Lb = len(block)                        # start .. len(seq)+gamma
+        tcache.ensure_capacity(
+            0, new_tokens=len(seq) + gamma - int(tcache.lens[0]))
+        toks = np.zeros((1, Cp), np.int64)
+        toks[0, :Lb] = block
+        x, ks, vs = verify(
+            params, jnp.asarray(toks), tcache.kpool, tcache.vpool,
+            dummy, dummy, jnp.asarray(tcache.tables[0].copy()),
+            np.int32(start))
+        tcache.write_row_pages(0, ks, vs, Lb, first_page=start // page)
+        # greedy target prediction AFTER each candidate position
+        off = (len(seq) - 1) - start
+        logits = _last_logits(
+            cfg, params, x[0, off:off + gamma + 1])    # [gamma+1, V]
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))
+
+        k = 0
+        while k < gamma and drafts[k] == int(greedy[k]):
+            k += 1
+        accept_hist[k] += 1
+        N = len(seq)                           # pre-extension length
+        seq.extend(drafts[:k])
+        seq.append(int(greedy[k]))             # target's correction
+        # commit by bookkeeping ONLY: stale K/V beyond lens are dead
+        # and get overwritten by the next round's writes
+        tcache.lens[0] = len(seq) - 1
+        # draft validly cached tokens: seq[:N] plus the accepted
+        # drafts it wrote while drafting (it cached d_1..d_{gamma-1},
+        # of which the first k are committed) — min(k, gamma-1) of them
+        d_len = N + min(k, gamma - 1)
+        dcache.lens[0] = d_len
+
+    out = seq[S:S + max_new_tokens]
+    total = sum(accept_hist)
+    stats = {
+        "rounds": rounds,
+        "accept_hist": accept_hist,
+        "mean_accepted": (sum(i * c for i, c in enumerate(accept_hist))
+                          / max(total, 1)),
+        "tokens_per_round": len(out) / max(rounds, 1),
+    }
+    return np.asarray(out, np.int64), stats

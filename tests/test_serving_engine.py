@@ -247,6 +247,72 @@ def test_engine_preemption_composes_with_chunked_prefill():
         np.testing.assert_array_equal(np.asarray(req.generated), ref)
 
 
+def test_engine_prefix_caching_shares_pages_token_exact():
+    """PREFIX CACHING (vLLM-style over the block tables): a second
+    request with the same long prompt prefix reuses the cached full
+    pages (no recompute, no extra pool pages) and still matches its
+    solo greedy run token-exactly; the cached pages survive request
+    retirement and serve later arrivals."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(14)
+    prefix = rng.randint(1, 128, (48,))          # 3 full 16-pages
+    tails = [rng.randint(1, 128, (5,)), rng.randint(1, 128, (9,))]
+    prompts = [np.concatenate([prefix, t]) for t in tails]
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   enable_prefix_caching=True)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=5)
+    done = eng.run_to_completion()
+    assert cache.prefix_hits == 3, cache.prefix_hits
+    for req, prompt in zip(sorted(done, key=lambda r: r.rid), prompts):
+        g = make_generate(cfg, prompt_len=len(prompt),
+                          max_new_tokens=5)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref)
+
+    # pages persist past retirement: the index still holds the prefix
+    # (free = all - junk - 3 cached), and a NEW request reuses it
+    assert cache.free_pages() == cache.num_pages - 1 - 3
+    p3 = np.concatenate([prefix, rng.randint(1, 128, (3,))])
+    eng.submit(p3, max_new_tokens=4)
+    done3 = eng.run_to_completion()
+    assert cache.prefix_hits == 6
+    g = make_generate(cfg, prompt_len=len(p3), max_new_tokens=4)
+    ref = np.asarray(g(params, jnp.asarray(p3[None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(np.asarray(done3[0].generated), ref)
+
+
+def test_engine_prefix_cache_evicts_under_pressure():
+    """Zero-ref cached prefix pages are evicted LRU when the pool runs
+    dry — caching never wedges the allocator."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(15)
+    # 8 usable pages; first request caches 3 prefix pages, second
+    # (unrelated, long) needs 5 fresh + growth -> forces eviction
+    cache = PagedKVCache(cfg, num_pages=9, pages_max=8, batch=1,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   enable_prefix_caching=True)
+    p1 = rng.randint(1, 128, (50,))
+    eng.submit(p1, max_new_tokens=3)
+    eng.run_to_completion()
+    assert len(cache._prefix_index) == 3
+    p2 = rng.randint(1, 128, (70,))              # 5 pages + decode growth
+    eng.submit(p2, max_new_tokens=30)            # grows to 100 -> 7 pages
+    done = eng.run_to_completion()
+    assert len(done) == 1 and len(done[0].generated) == 30
+    g = make_generate(cfg, prompt_len=70, max_new_tokens=30)
+    ref = np.asarray(g(params, jnp.asarray(p2[None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(np.asarray(done[0].generated), ref)
+
+
 def test_engine_streams_tokens_incrementally():
     """drain_stream() yields (rid, token) pairs the step they are
     produced; per-rid concatenation equals the finished generation and

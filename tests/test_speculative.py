@@ -1,0 +1,88 @@
+"""Speculative decoding (draft-model, exact greedy verification) over
+the paged cache: the output must be TOKEN-IDENTICAL to the target
+model's plain greedy decode for any draft model — the draft buys
+speed, never content.  Reference-world analog: PaddleNLP
+speculate_decoding over the block cache ops.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.decode import make_generate
+from paddle_tpu.models.speculative import generate_speculative
+
+
+def _cfg(layers=2, hidden=64):
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=hidden, intermediate_size=2 * hidden,
+        num_hidden_layers=layers, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=512, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+def _params(cfg, seed=0):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(seed), mesh)
+
+
+def test_speculative_matches_target_greedy_any_draft():
+    """A WEAK draft (1 layer, unrelated random weights) still yields
+    the target's exact greedy sequence — acceptance only shapes the
+    round count."""
+    cfg = _cfg()
+    params = _params(cfg, seed=0)
+    dcfg = _cfg(layers=1, hidden=32)
+    dparams = _params(dcfg, seed=99)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 128, (21,))
+    NEW = 14
+
+    out, stats = generate_speculative(cfg, params, dcfg, dparams,
+                                      prompt, NEW, gamma=3, page=16)
+    g = make_generate(cfg, prompt_len=len(prompt), max_new_tokens=NEW)
+    ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(out, ref)
+    assert stats["rounds"] >= 1
+    assert sum(stats["accept_hist"]) == stats["rounds"]
+
+
+def test_speculative_identical_draft_accepts_everything():
+    """Draft == target: every proposal is the target's own greedy
+    token, so every round accepts all gamma drafts (gamma+1 committed
+    tokens per round) and the output still matches plain greedy."""
+    cfg = _cfg()
+    params = _params(cfg, seed=1)
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(1, 128, (10,))
+    NEW = 13
+
+    out, stats = generate_speculative(cfg, params, cfg, params,
+                                      prompt, NEW, gamma=4, page=16)
+    g = make_generate(cfg, prompt_len=len(prompt), max_new_tokens=NEW)
+    ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(out, ref)
+    assert stats["mean_accepted"] == 4.0, stats
+    # gamma+1 tokens per round -> ceil((NEW-1)/5) rounds after the
+    # prefill token
+    assert stats["rounds"] == -(-(NEW - 1) // 5), stats
+
+
+def test_speculative_validates_gamma():
+    cfg = _cfg()
+    params = _params(cfg)
+    with pytest.raises(ValueError, match="gamma"):
+        generate_speculative(cfg, params, cfg, params,
+                             np.arange(1, 6), 4, gamma=16, page=16)
+    with pytest.raises(ValueError, match="gamma"):
+        generate_speculative(cfg, params, cfg, params,
+                             np.arange(1, 6), 4, gamma=0)
