@@ -114,10 +114,25 @@ class PagedKVCache:
         self.refs = np.zeros(num_pages, np.int64)
         from collections import OrderedDict
         self._prefix_index: "OrderedDict" = OrderedDict()
+        # chain structure for LEAF-FIRST eviction: evicting a chain's
+        # head would orphan its tail (lookups break at the missing
+        # head while the tail pages stay pinned)
+        self._prefix_parent: dict = {}
+        self._prefix_nchildren: dict = {}
         self.prefix_hits = 0              # pages reused via the index
 
     def free_pages(self) -> int:
         return len(self._free)
+
+    def available_pages(self) -> int:
+        """Free pages PLUS evictable cached-prefix pages (refs==1 —
+        held only by the index).  Admission gates must budget against
+        this, not :meth:`free_pages`: registered prompt pages leave
+        the free list permanently, and gating on the raw free list
+        livelocks once the index absorbs enough of the pool."""
+        evictable = sum(1 for pid in self._prefix_index.values()
+                        if self.refs[pid] == 1)
+        return len(self._free) + evictable
 
     # -- prefix caching ---------------------------------------------------
     @staticmethod
@@ -134,17 +149,29 @@ class PagedKVCache:
             keys.append(h.digest())
         return keys
 
+    def _evict_one_prefix(self) -> bool:
+        """Evict the oldest LEAF cached-prefix page held only by the
+        index.  Leaf-first keeps chains lookup-able: a head eviction
+        would orphan every dependent tail entry."""
+        for key in list(self._prefix_index):
+            pid = self._prefix_index[key]
+            if self.refs[pid] == 1 and \
+                    self._prefix_nchildren.get(key, 0) == 0:
+                del self._prefix_index[key]
+                parent = self._prefix_parent.pop(key, None)
+                if parent is not None:
+                    self._prefix_nchildren[parent] -= 1
+                self._prefix_nchildren.pop(key, None)
+                self.refs[pid] = 0
+                self._free.append(pid)
+                return True
+        return False
+
     def _page_alloc(self) -> int:
-        """Pop a free page, evicting LRU zero-ref cached prefixes when
-        the free list is dry."""
+        """Pop a free page, evicting cached prefixes (oldest leaf
+        first) when the free list is dry."""
         if not self._free:
-            for key in list(self._prefix_index):
-                pid = self._prefix_index[key]
-                if self.refs[pid] == 1:          # only the index holds it
-                    del self._prefix_index[key]
-                    self.refs[pid] = 0
-                    self._free.append(pid)
-                    break
+            self._evict_one_prefix()
         if not self._free:
             raise RuntimeError("KV page pool exhausted")
         return self._free.pop()
@@ -202,6 +229,11 @@ class PagedKVCache:
                 continue
             pid = int(self.tables[b, j])
             self._prefix_index[key] = pid
+            parent = keys[j - 1] if j else None
+            self._prefix_parent[key] = parent
+            if parent is not None:
+                self._prefix_nchildren[parent] = \
+                    self._prefix_nchildren.get(parent, 0) + 1
             self.refs[pid] += 1
 
     def alloc_row(self, b: int, length: int) -> None:
@@ -209,8 +241,9 @@ class PagedKVCache:
         need = (length + self.page - 1) // self.page
         if need > self.pages_max:
             raise ValueError(f"length {length} exceeds pages_max")
-        if need > len(self._free) and not self._prefix_index:
-            raise RuntimeError("KV page pool exhausted")
+        # uniform failure contract (shared with alloc_row_prefix): on
+        # pool exhaustion the partial claim rolls back and the row is
+        # left EMPTY
         self.release_row(b)
         try:
             for j in range(need):

@@ -338,7 +338,10 @@ class ContinuousBatchingEngine:
         while self._queue and len(self._free_slots) > len(admits):
             ctx = self._ctx_of(self._queue[0])
             need = (len(ctx) + self.cache.page - 1) // self.cache.page
-            if reserved + need > self.cache.free_pages():
+            # budget against free + EVICTABLE cached-prefix pages: the
+            # raw free list shrinks permanently as prompts register,
+            # and gating on it livelocks a prefix-caching engine
+            if reserved + need > self.cache.available_pages():
                 break
             reserved += need
             admits.append((self._queue.popleft(), ctx))

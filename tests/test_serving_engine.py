@@ -313,6 +313,54 @@ def test_engine_prefix_cache_evicts_under_pressure():
     np.testing.assert_array_equal(np.asarray(done[0].generated), ref)
 
 
+def test_engine_prefix_cache_admission_gate_counts_evictable():
+    """Round-5 review regression: the admission gate must budget
+    against free + EVICTABLE cached pages — gating on the raw free
+    list livelocks once retired prompts' registered pages have
+    absorbed the pool (free stays low forever, nothing active ever
+    frees it)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(16)
+    cache = PagedKVCache(cfg, num_pages=9, pages_max=8, batch=1,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   enable_prefix_caching=True)
+    eng.submit(rng.randint(1, 128, (50,)), max_new_tokens=3)
+    eng.run_to_completion()
+    assert len(cache._prefix_index) == 3     # 3 pages off the free list
+    p2 = rng.randint(1, 128, (90,))          # needs 6 > free 5
+    eng.submit(p2, max_new_tokens=8)
+    done = eng.run_to_completion(max_steps=200)
+    assert len(done) == 1 and len(done[0].generated) == 8
+    g = make_generate(cfg, prompt_len=90, max_new_tokens=8)
+    ref = np.asarray(g(params, jnp.asarray(p2[None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(np.asarray(done[0].generated), ref)
+
+
+def test_prefix_cache_evicts_leaf_first_keeps_chain_lookupable():
+    """Round-5 review regression: eviction must take a chain's LEAF,
+    not its head — a missing head key orphans the whole tail (lookups
+    break at key_0 while the tail pages stay pinned)."""
+    cfg = _cfg()
+    from paddle_tpu.models.paged_decode import PagedKVCache as C
+    cache = C(cfg, num_pages=8, pages_max=6, batch=1, page=16)
+    ctx = np.arange(1, 49, dtype=np.int64)         # 3 full pages
+    cache.alloc_row(0, 48)
+    cache.register_prefix(0, ctx)
+    cache.release_row(0)
+    assert len(cache._prefix_index) == 3 and cache.free_pages() == 4
+    # drain the free list, forcing ONE eviction
+    cache.alloc_row(0, 5 * 16)
+    assert len(cache._prefix_index) == 2
+    cache.release_row(0)
+    # the surviving entries must still be a lookup-able PREFIX chain:
+    # a re-admission reuses exactly the 2 remaining pages
+    reused = cache.alloc_row_prefix(0, ctx)
+    assert reused == 32, reused
+
+
 def test_engine_streams_tokens_incrementally():
     """drain_stream() yields (rid, token) pairs the step they are
     produced; per-rid concatenation equals the finished generation and
