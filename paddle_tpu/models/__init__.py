@@ -19,4 +19,5 @@ from .paged_decode import (  # noqa: F401
     make_paged_decode_step, make_paged_decode_step_tp)
 from .serving_engine import (  # noqa: F401
     ContinuousBatchingEngine, Request)
-from .speculative import generate_speculative  # noqa: F401
+from .speculative import (  # noqa: F401
+    generate_speculative, SpeculativeEngine)

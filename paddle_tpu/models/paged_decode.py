@@ -755,15 +755,17 @@ def _prefill(cfg: LlamaPretrainConfig):
 
 
 def _rope_at(x, theta, pos):
-    """RoPE at explicit positions ``pos [S]`` (chunked prefill: chunk
-    tokens sit at ctx_len + arange(C)); x [B, S, n, d].  Same split-
-    half convention as llama_pretrain._rope (the cached pages were
-    written by it)."""
+    """RoPE at explicit positions ``pos [S]`` or PER-ROW ``[B, S]``
+    (chunked prefill: chunk tokens sit at ctx_len + arange(C));
+    x [B, S, n, d].  Same split-half convention as
+    llama_pretrain._rope (the cached pages were written by it)."""
     d = x.shape[-1]
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    freqs = pos.astype(jnp.float32)[:, None] * inv[None]     # [S, d/2]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    freqs = pos.astype(jnp.float32)[..., None] * inv   # [(B,) S, d/2]
+    if freqs.ndim == 2:
+        freqs = freqs[None]                            # [1, S, d/2]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    sin = jnp.sin(freqs)[:, :, None, :]
     x1, x2 = jnp.split(x, 2, axis=-1)
     x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate([x1f * cos - x2f * sin,
@@ -847,6 +849,72 @@ def _prefill_chunk(cfg: LlamaPretrainConfig, q8: bool):
         return x, ks, vs
 
     _chunk_prefill_cache[(_cfg_key(cfg), q8)] = run
+    return run
+
+
+_chunk_b_cache: dict = {}
+
+
+def _prefill_chunk_batched(cfg: LlamaPretrainConfig):
+    """BATCHED prefill-with-history: advance EVERY row's context by a
+    chunk at its own offset — ``run(params, toks [B, C], kpool, vpool,
+    tables [B, P], ctx_len [B]) -> (x [B, C, H], ks, vs
+    [Lyr, B, C, nkv, d])``.  This is the batched speculative-decoding
+    VERIFY program: one target forward scores all rows' candidate
+    blocks over their cached pages (per-row tables, per-row positions,
+    per-row visibility).  bf16/f32 pools only — the speculative engine
+    path keeps quantisation out of the verify trunk."""
+    hit = _chunk_b_cache.get(_cfg_key(cfg))
+    if hit is not None:
+        return hit
+    from .decode import _grouped_attn
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    @jax.jit
+    def run(params, toks, kpool, vpool, tables, ctx_len):
+        B, C = toks.shape
+        P = tables.shape[1]
+        page = kpool.shape[3]
+        S_ctx = P * page
+        x = jnp.take(params["embed"], toks, axis=0).astype(dt)
+        pos = ctx_len[:, None] + jnp.arange(C, dtype=jnp.int32)
+        ctx_vis = (jnp.arange(S_ctx, dtype=jnp.int32)[None]
+                   < ctx_len[:, None])                 # [B, S_ctx]
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(ctx_vis[:, None], (B, C, S_ctx)),
+             jnp.broadcast_to(jnp.tril(jnp.ones((C, C), bool))[None],
+                              (B, C, C))], axis=2)
+        mask = mask[:, None, None]        # [B, 1, 1, C, S_ctx + C]
+
+        def gather_ctx(pool):
+            # [num_pages, nkv, page, d] -> per-row pages [B, P, ...]
+            pages = pool[tables]          # [B, P, nkv, page, d]
+            return pages.transpose(0, 1, 3, 2, 4).reshape(
+                B, S_ctx, nkv, d).astype(dt)
+
+        def layer(carry, inp):
+            bp, kp_l, vp_l = inp
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, C, n, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, C, nkv, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, C, nkv, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            ck = jnp.concatenate([gather_ctx(kp_l), k], axis=1)
+            cv = jnp.concatenate([gather_ctx(vp_l), v], axis=1)
+            attn = _grouped_attn(q, ck, cv, mask)
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(
+            layer, x, (params["blocks"], kpool, vpool))
+        return x, ks, vs
+
+    _chunk_b_cache[_cfg_key(cfg)] = run
     return run
 
 

@@ -174,6 +174,11 @@ class ContinuousBatchingEngine:
                 [req.prompt, np.asarray(req.generated[:-1], np.int64)])
         return req.prompt
 
+    def _release_slot(self, slot: int) -> None:
+        """Free a slot's cache rows (hook: subclasses with auxiliary
+        caches extend this)."""
+        self.cache.release_row(slot)
+
     def _finish_admit(self, req: Request, slot: int, tok: int) -> None:
         """Shared bookkeeping tail of every admission path."""
         req.slot = slot
@@ -311,7 +316,7 @@ class ContinuousBatchingEngine:
         req.slot = None
         req.preempted += 1
         self.preemptions += 1
-        self.cache.release_row(slot)
+        self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
         self._queue.appendleft(req)
@@ -320,7 +325,7 @@ class ContinuousBatchingEngine:
     def _retire(self, slot: int) -> None:
         req = self._active.pop(slot)
         req.done = True
-        self.cache.release_row(slot)
+        self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
         self.requests_finished += 1
@@ -360,13 +365,22 @@ class ContinuousBatchingEngine:
             self._admit_batch(group)
         if not self._active:
             return 0
-        cache = self.cache
+        self._decode_once()
+        return len(self._active)
+
+    def _ensure_or_preempt(self, new_tokens: int = 1,
+                           aux_cache=None, aux_new: int = 0) -> None:
+        """Grow every active row's pages (and optionally an auxiliary
+        cache's), preempting the youngest other request on pool
+        exhaustion instead of crashing the engine."""
         for slot in list(self._active):
             if slot not in self._active:     # evicted by an earlier turn
                 continue
             while True:
                 try:
-                    cache.ensure_capacity(slot)
+                    self.cache.ensure_capacity(slot, new_tokens)
+                    if aux_cache is not None:
+                        aux_cache.ensure_capacity(slot, aux_new)
                     break
                 except RuntimeError:
                     # pool exhausted mid-flight: preempt the youngest
@@ -378,6 +392,13 @@ class ContinuousBatchingEngine:
                             "KV page pool exhausted and no preemption "
                             "victim remains; the pool is too small for "
                             "a single request of this length")
+
+    def _decode_once(self) -> None:
+        """One decode dispatch advancing every active slot by one
+        token (the speculative subclass overrides this with a
+        draft+verify round)."""
+        cache = self.cache
+        self._ensure_or_preempt()
         tables = jnp.asarray(cache.tables.copy())
         lens = jnp.asarray(cache.lens.copy())
         tok = jnp.asarray(self._next_tok.copy())
@@ -406,7 +427,6 @@ class ContinuousBatchingEngine:
             if (self.eos_id is not None and t == self.eos_id) or \
                     self._remaining[slot] <= 0:
                 self._retire(slot)
-        return len(self._active)
 
     def run_to_completion(self, max_steps: int = 10_000):
         """Drive until the queue drains; returns all finished requests

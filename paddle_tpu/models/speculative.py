@@ -36,9 +36,10 @@ import numpy as np
 
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
+                           _prefill_chunk_batched,
                            make_paged_decode_step)
 
-__all__ = ["generate_speculative"]
+__all__ = ["generate_speculative", "SpeculativeEngine"]
 
 
 def _last_logits(cfg, params, x_last):
@@ -166,3 +167,189 @@ def generate_speculative(cfg: LlamaPretrainConfig, params,
         "tokens_per_round": len(out) / max(rounds, 1),
     }
     return np.asarray(out, np.int64), stats
+
+
+from .serving_engine import ContinuousBatchingEngine  # noqa: E402
+
+
+class SpeculativeEngine(ContinuousBatchingEngine):
+    """CONTINUOUS-BATCHING SPECULATIVE SERVING: the engine's decode
+    round becomes draft-gamma + one batched verify — every active
+    request advances by UP TO gamma+1 tokens per round, exactly
+    reproducing greedy outputs (exact verification), while
+    admission/retirement/preemption/streaming/prefix-caching keep
+    working unchanged.
+
+    Per round: (gamma+1) draft-model dispatches over the whole
+    batch (2 sync feeds realign each row's draft cache — rows
+    needing only 1 redundantly rewrite one position, which is
+    idempotent) and ONE target verify over each row's candidate
+    block via the batched prefill-with-history program.  Rollback
+    of rejected drafts is per-row ``lens`` bookkeeping — the paged
+    design's row independence doing the work.
+
+    Greedy only (``temperature`` must stay 0 — exact-match
+    verification).
+    """
+
+    def __init__(self, cfg, params, cache, draft_cfg, draft_params,
+                 draft_cache, gamma: int = 4, **kw):
+        if kw.get("temperature", 0.0) != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only (exact "
+                "verification); temperature must be 0")
+        if cache.kv_quant or draft_cache.kv_quant:
+            raise NotImplementedError(
+                "speculative serving over int8 pools: dequant in "
+                "the batched verify gather is not wired")
+        if gamma < 1 or gamma >= cache.page:
+            raise ValueError(
+                f"gamma must be in [1, page-1], got {gamma}")
+        super().__init__(cfg, params, cache, **kw)
+        self.dcfg, self.dparams = draft_cfg, draft_params
+        self.dcache = draft_cache
+        self.gamma = gamma
+        self._dstep = make_paged_decode_step(draft_cfg,
+                                             temperature=0.0)
+        self._verify = _prefill_chunk_batched(cfg)
+        self._seq: Dict[int, list] = {}     # slot -> committed toks
+        self._d_len = np.zeros(self.B, np.int64)
+        self.spec_rounds = 0
+        self.spec_accepted = 0
+
+    # -- hooks ---------------------------------------------------------
+    def _release_slot(self, slot):
+        super()._release_slot(slot)
+        self.dcache.release_row(slot)
+        self._seq.pop(slot, None)
+
+    def _finish_admit(self, req, slot, tok):
+        # mirror the target admission into the DRAFT cache (dense
+        # prefill of the same committed context) and record the
+        # committed sequence for this slot
+        ctx = self._ctx_of(req)
+        L = len(ctx)
+        self.dcache.alloc_row(slot, L)
+        page = self.dcache.page
+        Lp = ((L + page - 1) // page) * page
+        padded = np.zeros((1, Lp), np.int64)
+        padded[0, :L] = ctx
+        x, ks, vs = _prefill(self.dcfg)(self.dparams,
+                                        jnp.asarray(padded))
+        self.dcache.write_row_pages(slot, ks[:, 0], vs[:, 0], L)
+        self._seq[slot] = list(ctx) + [tok]
+        self._d_len[slot] = L
+        super()._finish_admit(req, slot, tok)
+
+    # -- the speculative round -----------------------------------------
+    def _decode_once(self):
+        gamma = self.gamma
+        page = self.cache.page
+        B = self.B
+        # capacity: target through len(seq)+gamma, draft one less
+        self._ensure_or_preempt(new_tokens=gamma + 1,
+                                aux_cache=self.dcache,
+                                aux_new=gamma + 1)
+        active = sorted(self._active)
+        if not active:
+            return
+        N = {s: len(self._seq[s]) for s in active}
+
+        # ---- draft phase: 2 batched sync feeds + gamma-1 drafts
+        drafts = np.zeros((B, gamma), np.int64)
+        feeds = []
+        for j in (2, 1):                   # positions N-2, N-1
+            pos = np.zeros(B, np.int32)
+            tokv = np.zeros(B, np.int64)
+            for s in active:
+                pos[s] = N[s] - j
+                tokv[s] = self._seq[s][N[s] - j]
+            feeds.append((pos, tokv))
+        out = None
+        for i, (pos, tokv) in enumerate(feeds):
+            self.dcache.kpool, self.dcache.vpool, out = self._dstep(
+                self.dparams, self.dcache.kpool, self.dcache.vpool,
+                jnp.asarray(self.dcache.tables.copy()),
+                jnp.asarray(pos), jnp.asarray(tokv),
+                jax.random.PRNGKey(0))
+        out = np.asarray(out)
+        for s in active:
+            drafts[s, 0] = out[s]
+        for i in range(1, gamma):
+            pos = np.zeros(B, np.int32)
+            tokv = np.zeros(B, np.int64)
+            for s in active:
+                pos[s] = N[s] - 1 + i
+                tokv[s] = drafts[s, i - 1]
+            self.dcache.kpool, self.dcache.vpool, out = self._dstep(
+                self.dparams, self.dcache.kpool, self.dcache.vpool,
+                jnp.asarray(self.dcache.tables.copy()),
+                jnp.asarray(pos), jnp.asarray(tokv),
+                jax.random.PRNGKey(0))
+            out = np.asarray(out)
+            for s in active:
+                drafts[s, i] = out[s]
+
+        # ---- verify: ONE batched target forward over candidate
+        # blocks re-aligned to each row's last page boundary
+        Cp = 2 * page
+        toks = np.zeros((B, Cp), np.int64)
+        starts = np.zeros(B, np.int32)
+        lbs = np.zeros(B, np.int64)
+        for s in active:
+            start = ((N[s] - 1) // page) * page
+            block = self._seq[s][start:] + list(drafts[s])
+            starts[s] = start
+            lbs[s] = len(block)
+            toks[s, :len(block)] = block
+        x, ks, vs = self._verify(
+            self.params, jnp.asarray(toks), self.cache.kpool,
+            self.cache.vpool, jnp.asarray(self.cache.tables.copy()),
+            jnp.asarray(starts))
+        for s in active:
+            self.cache.write_row_pages(
+                s, ks[:, s], vs[:, s], int(lbs[s]),
+                first_page=int(starts[s]) // page)
+        # greedy target predictions after each candidate position
+        offs = np.zeros(B, np.int64)
+        for s in active:
+            offs[s] = (N[s] - 1) - starts[s]
+        idx = offs[:, None] + np.arange(gamma + 1)[None]
+        xg = x[jnp.arange(B)[:, None], jnp.asarray(idx)]
+        h = _rms_norm(xg, self.params["final_norm"],
+                      self.cfg.rms_norm_eps)
+        logits = _mm(h, self.params["lm_head"],
+                     self.cfg.dtype).astype(jnp.float32)
+        greedy = np.asarray(jnp.argmax(logits, -1))   # [B, gamma+1]
+
+        # ---- per-row acceptance + commit (host bookkeeping)
+        self.decode_steps += 1
+        self.spec_rounds += 1
+        for s in active:
+            req = self._active[s]
+            k = 0
+            while k < gamma and drafts[s, k] == greedy[s, k]:
+                k += 1
+            self.spec_accepted += k
+            new_toks = [int(t) for t in drafts[s, :k]] + \
+                [int(greedy[s, k])]
+            n_old = N[s]
+            retire = False
+            committed = 0
+            for t in new_toks:
+                req.generated.append(t)
+                self.tokens_generated += 1
+                self._stream.append((req.rid, t))
+                self._remaining[s] -= 1
+                committed += 1
+                if (self.eos_id is not None and t == self.eos_id) \
+                        or self._remaining[s] <= 0:
+                    retire = True
+                    break
+            self._seq[s] = self._seq[s] + new_toks[:committed]
+            self.cache.lens[s] = len(self._seq[s]) - 1
+            self._d_len[s] = n_old + min(committed - 1, gamma - 1)
+            self.dcache.lens[s] = self._d_len[s]
+            self._next_tok[s] = self._seq[s][-1]
+            if retire:
+                self._retire(s)

@@ -86,3 +86,67 @@ def test_speculative_validates_gamma():
     with pytest.raises(ValueError, match="gamma"):
         generate_speculative(cfg, params, cfg, params,
                              np.arange(1, 6), 4, gamma=0)
+
+
+def test_speculative_engine_serves_batch_token_exact():
+    """Continuous-batching SPECULATIVE serving: mixed-length requests
+    decode in draft+verify rounds, every output token-exact vs its
+    solo greedy run, with interleaved late admission and streaming
+    intact; an identical draft accepts near-everything."""
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    cfg = _cfg()
+    params = _params(cfg, seed=0)
+    dcfg = _cfg(layers=1, hidden=32)
+    dparams = _params(dcfg, seed=7)
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 128, (int(rng.randint(4, 20)),))
+               for _ in range(3)]
+
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    dcache = PagedKVCache(dcfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    eng = SpeculativeEngine(cfg, params, cache, dcfg, dparams, dcache,
+                            gamma=3)
+    for p in prompts[:2]:
+        eng.submit(p, max_new_tokens=9)
+    eng.step()                       # late arrival mid-flight
+    eng.submit(prompts[2], max_new_tokens=7)
+    done = eng.run_to_completion()
+    news = {0: 9, 1: 9, 2: 7}
+    assert sorted(r.rid for r in done) == [0, 1, 2]
+    streamed = {}
+    for rid, t in eng.drain_stream():
+        streamed.setdefault(rid, []).append(t)
+    for req in done:
+        prompt = prompts[req.rid]
+        new = news[req.rid]
+        assert len(req.generated) == new
+        g = make_generate(cfg, prompt_len=len(prompt),
+                          max_new_tokens=new)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref)
+        assert streamed[req.rid] == req.generated
+    assert eng.spec_rounds >= 1
+    # all pages returned (both caches)
+    assert cache.free_pages() == cache.num_pages - 1
+    assert dcache.free_pages() == dcache.num_pages - 1
+
+    # identical draft: every round accepts all gamma drafts
+    cache2 = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    dcache2 = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                           page=16)
+    eng2 = SpeculativeEngine(cfg, params, cache2, cfg, params, dcache2,
+                             gamma=3)
+    eng2.submit(prompts[0], max_new_tokens=9)
+    done2 = eng2.run_to_completion()
+    g = make_generate(cfg, prompt_len=len(prompts[0]),
+                      max_new_tokens=9)
+    ref = np.asarray(g(params, jnp.asarray(prompts[0][None]),
+                       jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(np.asarray(done2[0].generated), ref)
+    assert eng2.spec_accepted == eng2.spec_rounds * 3   # full gamma
