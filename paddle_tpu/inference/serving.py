@@ -318,12 +318,18 @@ class GenerationServer:
     (fluid/distributed/fleet_executor/dist_model.h:57).
     """
 
-    def __init__(self, cfg, params, cache, mesh=None,
+    def __init__(self, cfg=None, params=None, cache=None, mesh=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 poll_s: float = 0.002, **engine_kw):
-        from ..models.serving_engine import ContinuousBatchingEngine
-        self.engine = ContinuousBatchingEngine(cfg, params, cache,
-                                               mesh=mesh, **engine_kw)
+                 poll_s: float = 0.002, engine=None, **engine_kw):
+        if engine is not None:
+            # caller-built engine (e.g. models.speculative.
+            # SpeculativeEngine) — the whole HTTP front works unchanged
+            self.engine = engine
+        else:
+            from ..models.serving_engine import ContinuousBatchingEngine
+            self.engine = ContinuousBatchingEngine(cfg, params, cache,
+                                                   mesh=mesh,
+                                                   **engine_kw)
         self._host, self._port = host, port
         self._poll_s = poll_s
         self._lock = threading.Lock()

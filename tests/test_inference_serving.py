@@ -265,3 +265,35 @@ def test_generation_server_health_metrics():
         assert h["active"] == 0 and h["queued"] == 0
     finally:
         srv.stop()
+
+
+def test_generation_server_over_speculative_engine():
+    """The HTTP front serves a caller-built SpeculativeEngine
+    unchanged — speculative continuous batching behind /generate,
+    token-exact vs plain greedy."""
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.speculative import SpeculativeEngine
+    from paddle_tpu.models.decode import make_generate
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+    import jax.numpy as jnp
+
+    cfg, params, cache = _gen_setup()
+    dcache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    eng = SpeculativeEngine(cfg, params, cache, cfg, params, dcache,
+                            gamma=3)
+    srv = GenerationServer(engine=eng)
+    port = srv.start()
+    try:
+        rng = np.random.RandomState(33)
+        p = rng.randint(1, 128, (11,))
+        got = generate_http(f"http://127.0.0.1:{port}", p,
+                            max_new_tokens=6)
+        g = make_generate(cfg, prompt_len=11, max_new_tokens=6)
+        ref = np.asarray(g(params, jnp.asarray(p[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        assert eng.spec_rounds >= 1
+    finally:
+        srv.stop()
