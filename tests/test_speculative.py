@@ -150,3 +150,66 @@ def test_speculative_engine_serves_batch_token_exact():
                        jax.random.PRNGKey(0)))[0]
     np.testing.assert_array_equal(np.asarray(done2[0].generated), ref)
     assert eng2.spec_accepted == eng2.spec_rounds * 3   # full gamma
+
+
+def test_speculative_engine_composes_with_prefix_caching():
+    """Prefix caching on the TARGET cache under speculative serving:
+    the second same-prefix request reuses cached pages and both
+    outputs stay token-exact."""
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    cfg = _cfg()
+    params = _params(cfg, seed=2)
+    rng = np.random.RandomState(6)
+    prefix = rng.randint(1, 128, (32,))          # 2 full 16-pages
+    prompts = [np.concatenate([prefix, rng.randint(1, 128, (4,))]),
+               np.concatenate([prefix, rng.randint(1, 128, (7,))])]
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    dcache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    eng = SpeculativeEngine(cfg, params, cache, cfg, params, dcache,
+                            gamma=2, enable_prefix_caching=True)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=6)
+    done = eng.run_to_completion()
+    assert cache.prefix_hits == 2
+    for req, prompt in zip(sorted(done, key=lambda r: r.rid), prompts):
+        g = make_generate(cfg, prompt_len=len(prompt),
+                          max_new_tokens=6)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref)
+
+
+def test_speculative_engine_survives_preemption():
+    """Pool pressure mid-speculation preempts a victim (BOTH caches
+    released via the shared hook) and the resumed request still
+    matches its solo greedy run."""
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    cfg = _cfg()
+    params = _params(cfg, seed=3)
+    rng = np.random.RandomState(7)
+    # tight TARGET pool: 2 prompts of 16 + 20 new + gamma slack
+    cache = PagedKVCache(cfg, num_pages=6, pages_max=5, batch=2,
+                         page=16)
+    dcache = PagedKVCache(cfg, num_pages=12, pages_max=5, batch=2,
+                          page=16)
+    eng = SpeculativeEngine(cfg, params, cache, cfg, params, dcache,
+                            gamma=2)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=20)
+    done = eng.run_to_completion()
+    assert len(done) == 2
+    assert any(r.preempted > 0 for r in done)
+    for req, prompt in zip(sorted(done, key=lambda r: r.rid), prompts):
+        g = make_generate(cfg, prompt_len=len(prompt),
+                          max_new_tokens=20)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref)
+    assert dcache.free_pages() == dcache.num_pages - 1
