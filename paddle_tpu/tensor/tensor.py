@@ -183,6 +183,12 @@ class Tensor:
         for i in range(len(self)):
             yield self[i]
 
+    def __array__(self, dtype=None, copy=None):
+        # without this, np.asarray(t) walks the sequence protocol and
+        # builds an OBJECT array of row Tensors
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
     def __int__(self):
         return int(self.item())
 
