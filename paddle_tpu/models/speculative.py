@@ -193,7 +193,9 @@ class SpeculativeEngine(ContinuousBatchingEngine):
     """
 
     def __init__(self, cfg, params, cache, draft_cfg, draft_params,
-                 draft_cache, gamma: int = 4, **kw):
+                 draft_cache, gamma: int = 4,
+                 adaptive_gamma: bool = False, max_gamma: int = 8,
+                 **kw):
         if kw.get("temperature", 0.0) != 0.0:
             raise ValueError(
                 "speculative serving is greedy-only (exact "
@@ -209,6 +211,14 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self.dcfg, self.dparams = draft_cfg, draft_params
         self.dcache = draft_cache
         self.gamma = gamma
+        # ADAPTIVE gamma: gamma is HOST-side (the draft loop is a host
+        # loop; the verify chunk shape is gamma-independent), so it can
+        # retune every round from the measured acceptance EMA with
+        # zero recompilation — shrink when drafts keep missing, grow
+        # when they keep landing
+        self.adaptive_gamma = adaptive_gamma
+        self.max_gamma = min(max_gamma, cache.page - 1)
+        self._accept_ema = float(gamma)
         self._dstep = make_paged_decode_step(draft_cfg,
                                              temperature=0.0)
         self._verify = _prefill_chunk_batched(cfg)
@@ -351,5 +361,16 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             self._d_len[s] = n_old + min(committed - 1, gamma - 1)
             self.dcache.lens[s] = self._d_len[s]
             self._next_tok[s] = self._seq[s][-1]
+            if self.adaptive_gamma:
+                self._accept_ema = 0.8 * self._accept_ema + 0.2 * k
             if retire:
                 self._retire(s)
+        if self.adaptive_gamma:
+            # retune for the NEXT round: gamma is a host-loop count and
+            # the verify chunk shape is gamma-independent, so this
+            # costs zero recompilation
+            if self._accept_ema < 0.4 * self.gamma and self.gamma > 1:
+                self.gamma -= 1
+            elif self._accept_ema > 0.85 * self.gamma and \
+                    self.gamma < self.max_gamma:
+                self.gamma += 1

@@ -213,3 +213,41 @@ def test_speculative_engine_survives_preemption():
                            jax.random.PRNGKey(0)))[0]
         np.testing.assert_array_equal(np.asarray(req.generated), ref)
     assert dcache.free_pages() == dcache.num_pages - 1
+
+
+def test_speculative_engine_adaptive_gamma():
+    """Adaptive gamma (host-side, zero recompilation): an identical
+    draft's full acceptance grows gamma toward max_gamma; a useless
+    draft shrinks it to 1 — outputs stay token-exact either way."""
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    cfg = _cfg()
+    params = _params(cfg, seed=4)
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(1, 128, (8,))
+    NEW = 40
+    g = make_generate(cfg, prompt_len=len(prompt), max_new_tokens=NEW)
+    ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                       jax.random.PRNGKey(0)))[0]
+
+    def run(dcfg, dparams):
+        cache = PagedKVCache(cfg, num_pages=64, pages_max=12, batch=1,
+                             page=16)
+        dcache = PagedKVCache(dcfg, num_pages=64, pages_max=12,
+                              batch=1, page=16)
+        eng = SpeculativeEngine(cfg, params, cache, dcfg, dparams,
+                                dcache, gamma=2, adaptive_gamma=True,
+                                max_gamma=6)
+        eng.submit(prompt, max_new_tokens=NEW)
+        done = eng.run_to_completion()
+        return np.asarray(done[0].generated), eng.gamma
+
+    out_good, gamma_good = run(cfg, params)        # perfect draft
+    np.testing.assert_array_equal(out_good, ref)
+    assert gamma_good > 2, gamma_good              # grew
+
+    dcfg = _cfg(layers=1, hidden=32)
+    out_bad, gamma_bad = run(dcfg, _params(dcfg, seed=77))
+    np.testing.assert_array_equal(out_bad, ref)
+    assert gamma_bad <= 2, gamma_bad               # shrank or held
