@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from .llama_pretrain import (LlamaPretrainConfig,
                              _block_post_attn, _mm, _rms_norm)
 
-__all__ = ["make_generate", "quantize_params_int8"]
+__all__ = ["make_generate", "make_generate_beam",
+           "quantize_params_int8"]
 
 
 def quantize_params_int8(params):
@@ -215,5 +216,129 @@ def make_generate(cfg: LlamaPretrainConfig, prompt_len: int,
         # toks: [max_new-1, B]; prepend tok0
         all_new = jnp.concatenate([tok0[None], toks], axis=0)
         return jnp.transpose(all_new)           # [B, max_new]
+
+    return jax.jit(generate)
+
+
+def make_generate_beam(cfg: LlamaPretrainConfig, prompt_len: int,
+                       max_new_tokens: int, num_beams: int,
+                       max_len: Optional[int] = None,
+                       length_penalty: float = 1.0):
+    """Build a jitted BEAM-SEARCH ``generate(params, prompt[B, PL]) ->
+    (tokens [B, max_new], scores [B])`` — the compiled analog of the
+    reference's ``generate(num_beams=K)`` / BeamSearchDecoder surface,
+    all static shapes: prefill once, replicate the KV cache K-fold,
+    and each scan step expands K x V continuations, keeps the global
+    top-K, and REORDERS the cache rows by beam ancestry (one gather on
+    the batch axis — the TPU-native beam step).
+
+    ``num_beams == 1`` degenerates to greedy.  No eos handling: all
+    beams run the full ``max_new_tokens`` (the serving engine owns
+    early stopping), so ``length_penalty`` cannot change the ranking
+    here and exists for API parity.
+    """
+    S_max = max_len or (prompt_len + max_new_tokens)
+    if S_max < prompt_len + max_new_tokens:
+        raise ValueError("max_len too small for prompt + new tokens")
+    K = num_beams
+    if K < 1:
+        raise ValueError("num_beams must be >= 1")
+
+    def head_logp(params, x_last):
+        h = _rms_norm(x_last, params["final_norm"], cfg.rms_norm_eps)
+        logits = _mm(h, params["lm_head"],
+                     cfg.dtype).astype(jnp.float32)
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def generate(params, prompt):
+        B = prompt.shape[0]
+        n, d = cfg.num_attention_heads, cfg.head_dim
+        nkv = cfg.num_key_value_heads
+        dt = cfg.dtype
+        from .llama_pretrain import _rope
+
+        x = jnp.take(params["embed"], prompt, axis=0).astype(dt)
+        causal = jnp.tril(jnp.ones((prompt_len, prompt_len), bool))
+
+        def prefill_layer(carry, bp):
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, prompt_len, n, d)
+            k, v = _prefill_kv(bp, y, cfg, B, prompt_len)
+            q, k = _rope(q, k, cfg.rope_theta)
+            attn = _grouped_attn(q, k, v,
+                                 causal[None, None, None, :, :])
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(prefill_layer, x, params["blocks"])
+        L = ks.shape[0]
+        # beam-replicated cache rows: [L, B*K, S_max, nkv, d]
+        cache_k = jnp.zeros((L, B * K, S_max, nkv, d), dt)
+        cache_v = jnp.zeros((L, B * K, S_max, nkv, d), dt)
+        rep = lambda a: jnp.repeat(a, K, axis=1)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, rep(ks.astype(dt)), (0, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, rep(vs.astype(dt)), (0, 0, 0, 0, 0))
+
+        logp0 = head_logp(params, x[:, -1])            # [B, V]
+        V = logp0.shape[-1]
+        scores, tok = jax.lax.top_k(logp0, K)          # [B, K] both
+        tok = tok.astype(jnp.int64)
+        toks_acc = jnp.zeros((B, K, max_new_tokens), jnp.int64)
+        toks_acc = toks_acc.at[:, :, 0].set(tok)
+
+        def dec_step(carry, t):
+            cache_k, cache_v, tok, scores, toks_acc, pos = carry
+            xt = jnp.take(params["embed"],
+                          tok.reshape(B * K)[:, None], axis=0).astype(dt)
+
+            def layer(carry2, inputs):
+                xc = carry2
+                bp, ck, cv = inputs
+                q, k, v = _pre_attn_at(bp, xc, cfg, pos)
+                zero = jnp.asarray(0, pos.dtype)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (zero, pos, zero, zero))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (zero, pos, zero, zero))
+                attn = _cached_attn(q, ck, cv, pos)
+                out = _block_post_attn(bp, xc, attn, cfg)
+                return out, (ck, cv)
+
+            xt, (cache_k, cache_v) = jax.lax.scan(
+                layer, xt, (params["blocks"], cache_k, cache_v))
+            logp = head_logp(params, xt[:, 0]).reshape(B, K, V)
+            total = scores[:, :, None] + logp          # [B, K, V]
+            new_scores, idx = jax.lax.top_k(
+                total.reshape(B, K * V), K)            # [B, K]
+            beam_src = idx // V
+            new_tok = (idx % V).astype(jnp.int64)
+            # reorder EVERYTHING beam-wise by ancestry (cache rows
+            # include this step's fresh K/V — written in old order,
+            # gathered into the new one)
+            flat_src = (jnp.arange(B)[:, None] * K
+                        + beam_src).reshape(-1)        # [B*K]
+            cache_k = jnp.take(cache_k, flat_src, axis=1)
+            cache_v = jnp.take(cache_v, flat_src, axis=1)
+            toks_acc = jnp.take_along_axis(
+                toks_acc, beam_src[:, :, None], axis=1)
+            toks_acc = jax.lax.dynamic_update_slice(
+                toks_acc, new_tok[:, :, None],
+                (jnp.asarray(0, t.dtype), jnp.asarray(0, t.dtype),
+                 t + 1))
+            return (cache_k, cache_v, new_tok, new_scores, toks_acc,
+                    pos + 1), None
+
+        carry0 = (cache_k, cache_v, tok, scores, toks_acc,
+                  jnp.asarray(prompt_len, jnp.int32))
+        (_, _, _, scores, toks_acc, _), _ = jax.lax.scan(
+            dec_step, carry0, jnp.arange(max_new_tokens - 1),
+            length=max_new_tokens - 1)
+        norm = scores / (float(max_new_tokens) ** length_penalty)
+        best = jnp.argmax(norm, axis=1)                # [B]
+        tokens = toks_acc[jnp.arange(B), best]
+        return tokens, scores[jnp.arange(B), best]
 
     return jax.jit(generate)

@@ -155,3 +155,57 @@ def test_layer_model_generate_compiled_bridge():
     m2 = LlamaForCausalLM(tied)
     o2 = m2.generate_compiled(ids, max_new_tokens=4)
     assert o2.shape == [2, 10]
+
+
+def test_generate_beam_k1_equals_greedy_and_oracle_k3():
+    """Compiled beam search: num_beams=1 degenerates to greedy
+    (token-exact vs make_generate), and num_beams=3 matches an eager
+    numpy beam-search oracle driven by full-prefix forwards."""
+    from paddle_tpu.models.decode import make_generate_beam
+    from paddle_tpu.models.paged_decode import _prefill
+
+    cfg = _cfg()
+    mesh = build_mesh(devices=jax.devices()[:1])
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(11)
+    B, PL, NEW, K = 2, 7, 5, 3
+    prompt = rng.randint(1, 128, (B, PL))
+
+    gen1 = make_generate_beam(cfg, prompt_len=PL, max_new_tokens=NEW,
+                              num_beams=1)
+    toks1, _ = gen1(params, jnp.asarray(prompt))
+    g = make_generate(cfg, prompt_len=PL, max_new_tokens=NEW)
+    ref = np.asarray(g(params, jnp.asarray(prompt),
+                       jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(np.asarray(toks1), ref)
+
+    def step_logp(prefix):
+        x, _, _ = _prefill(cfg)(params, jnp.asarray(prefix[None]))
+        from paddle_tpu.models.llama_pretrain import _mm, _rms_norm
+        h = _rms_norm(x[0, -1], params["final_norm"], cfg.rms_norm_eps)
+        logits = np.asarray(_mm(h, params["lm_head"],
+                                cfg.dtype).astype(jnp.float32))
+        logits = logits - logits.max()
+        return logits - np.log(np.exp(logits).sum())
+
+    genk = make_generate_beam(cfg, prompt_len=PL, max_new_tokens=NEW,
+                              num_beams=K)
+    toksk, scoresk = genk(params, jnp.asarray(prompt))
+    for b in range(B):
+        # eager numpy beam search, identical algorithm
+        lp0 = step_logp(prompt[b])
+        order = np.argsort(lp0)[::-1][:K]
+        beams = [(float(lp0[t]), [int(t)]) for t in order]
+        for _ in range(NEW - 1):
+            cand = []
+            for sc, seq in beams:
+                lp = step_logp(np.concatenate([prompt[b], seq]))
+                top = np.argsort(lp)[::-1][:K]
+                cand.extend((sc + float(lp[t]), seq + [int(t)])
+                            for t in top)
+            cand.sort(key=lambda c: -c[0])
+            beams = cand[:K]
+        best_score, best_seq = beams[0]
+        np.testing.assert_array_equal(np.asarray(toksk[b]), best_seq)
+        np.testing.assert_allclose(float(scoresk[b]), best_score,
+                                   atol=1e-3)

@@ -181,8 +181,8 @@ def test_surface_low_precision_sweep(surfaces, space, dt):
             scale = np.maximum(np.abs(r), 1.0)
             with np.errstate(invalid="ignore"):  # inf-inf where BOTH
                 diff = np.abs(g - r) / scale     # are inf is agreement
-            diff = np.where(g == r, 0.0, diff)
-            err = float(np.nanmax(diff)) if g.size else 0.0
+            diff = np.where(g == r, 0.0, np.nan_to_num(diff, nan=0.0))
+            err = float(np.max(diff)) if g.size else 0.0
             if err > tol:
                 failures.append(f"{name}: rel err {err:.3g} > {tol}")
                 break
