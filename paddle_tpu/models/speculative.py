@@ -207,6 +207,13 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         if gamma < 1 or gamma >= cache.page:
             raise ValueError(
                 f"gamma must be in [1, page-1], got {gamma}")
+        mesh = kw.get("mesh")
+        if mesh is not None and mesh.shape.get("mp", 1) > 1:
+            raise NotImplementedError(
+                "speculative serving over a TP mesh: the draft step "
+                "and batched verify are single-device programs — "
+                "shard-map them before composing (serve TP models "
+                "through the plain ContinuousBatchingEngine)")
         super().__init__(cfg, params, cache, **kw)
         self.dcfg, self.dparams = draft_cfg, draft_params
         self.dcache = draft_cache
