@@ -223,8 +223,7 @@ class _GenHandler(BaseHTTPRequestHandler):
         srv: "GenerationServer" = self.server.owner
         if self.path.rstrip("/") in ("", "/health"):
             eng = srv.engine
-            self._reply(200, json.dumps(
-                {"status": "ok" if srv._fatal is None else "failed",
+            h = {"status": "ok" if srv._fatal is None else "failed",
                  "error": srv._fatal,
                  "active": len(eng._active),
                  "queued": len(eng._queue),
@@ -234,7 +233,12 @@ class _GenHandler(BaseHTTPRequestHandler):
                  "prefill_calls": eng.prefill_calls,
                  "preemptions": eng.preemptions,
                  "prefix_hits": eng.cache.prefix_hits,
-                 "requests_finished": eng.requests_finished}).encode())
+                 "requests_finished": eng.requests_finished}
+            if hasattr(eng, "spec_rounds"):    # speculative engine
+                h["spec_rounds"] = eng.spec_rounds
+                h["spec_accepted"] = eng.spec_accepted
+                h["gamma"] = eng.gamma
+            self._reply(200, json.dumps(h).encode())
         else:
             self._reply(404, b"not found", "text/plain")
 
