@@ -176,8 +176,12 @@ def test_shmring_cross_process_fifo():
         target=_ring_producer, args=(name, cap, n))
     p.start()
     for i in range(n):
-        blob = ring.pop(timeout=10.0)
-        assert blob is not None
+        # the FIRST pop races the spawned child's interpreter boot
+        # (importing this module pulls in jax + paddle_tpu, ~7s idle
+        # and far more under suite contention) — give it real headroom;
+        # steady-state pops stay tight
+        blob = ring.pop(timeout=120.0 if i == 0 else 10.0)
+        assert blob is not None, f"pop {i} timed out"
         tree = unpack_tree(blob)
         assert tree["i"][0] == i                  # strict FIFO
     p.join(timeout=5.0)
