@@ -44,6 +44,7 @@ class Request:
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
+    stop_sequences: Optional[List[List[int]]] = None
     admit_seq: int = -1                   # admission order (preemption)
     preempted: int = 0                    # times evicted + requeued
 
@@ -123,11 +124,17 @@ class ContinuousBatchingEngine:
         self._remaining = np.zeros((self.B,), np.int64)
 
     # -- client side ------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int = 64) -> int:
+    def submit(self, prompt, max_new_tokens: int = 64,
+               stop_sequences=None) -> int:
         """Queue a request.  Oversized requests fail HERE with
         ``ValueError`` — one bad request must never surface mid
         ``step()`` and kill every in-flight generation (a row's
-        worst-case footprint is bounded by its table width)."""
+        worst-case footprint is bounded by its table width).
+
+        ``stop_sequences``: token-id lists; generation retires as soon
+        as the generated tail equals one of them (multi-token stop
+        strings — the eos_id generalisation every serving product
+        needs; checked on the host, costs nothing compiled)."""
         prompt = np.asarray(prompt, np.int64)
         # bound by BOTH the row's table width and the whole pool (page
         # 0 is reserved): a request the pool can never hold even alone
@@ -145,7 +152,10 @@ class ContinuousBatchingEngine:
                 f"{self.cache.page})")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, prompt, max_new_tokens))
+        self._queue.append(Request(
+            rid, prompt, max_new_tokens,
+            stop_sequences=[list(map(int, q)) for q in stop_sequences]
+            if stop_sequences else None))
         return rid
 
     def finished(self) -> List[Request]:
@@ -179,6 +189,16 @@ class ContinuousBatchingEngine:
         caches extend this)."""
         self.cache.release_row(slot)
 
+    def _hit_stop(self, req: Request, t: int) -> bool:
+        """eos or a completed stop sequence at the generated tail."""
+        if self.eos_id is not None and t == self.eos_id:
+            return True
+        for seq in req.stop_sequences or ():
+            if len(req.generated) >= len(seq) and \
+                    req.generated[-len(seq):] == seq:
+                return True
+        return False
+
     def _finish_admit(self, req: Request, slot: int, tok: int) -> None:
         """Shared bookkeeping tail of every admission path."""
         req.slot = slot
@@ -187,8 +207,7 @@ class ContinuousBatchingEngine:
         self._active[slot] = req
         self._next_tok[slot] = tok
         self._remaining[slot] = req.max_new_tokens - len(req.generated)
-        if (self.eos_id is not None and tok == self.eos_id) or \
-                self._remaining[slot] <= 0:
+        if self._hit_stop(req, tok) or self._remaining[slot] <= 0:
             self._retire(slot)
 
     def _admit_batch(self, group: List) -> None:
@@ -424,8 +443,7 @@ class ContinuousBatchingEngine:
             self._stream.append((req.rid, t))
             self._next_tok[slot] = t
             self._remaining[slot] -= 1
-            if (self.eos_id is not None and t == self.eos_id) or \
-                    self._remaining[slot] <= 0:
+            if self._hit_stop(req, t) or self._remaining[slot] <= 0:
                 self._retire(slot)
 
     def run_to_completion(self, max_steps: int = 10_000):

@@ -359,8 +359,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 self._stream.append((req.rid, t))
                 self._remaining[s] -= 1
                 committed += 1
-                if (self.eos_id is not None and t == self.eos_id) \
-                        or self._remaining[s] <= 0:
+                if self._hit_stop(req, t) or self._remaining[s] <= 0:
                     retire = True
                     break
             self._seq[s] = self._seq[s] + new_toks[:committed]

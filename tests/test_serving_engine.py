@@ -483,3 +483,36 @@ def test_engine_interleaved_admission():
                            jax.random.PRNGKey(0)))[0]
         np.testing.assert_array_equal(
             np.asarray(by_len[new].generated), ref)
+
+
+def test_engine_stop_sequences_truncate_generation():
+    """Multi-token stop sequences (the eos generalisation): generation
+    retires the moment the generated tail equals a stop sequence —
+    in the plain engine AND mid-round in the speculative engine."""
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(18)
+    prompt = rng.randint(1, 128, (9,))
+    g = make_generate(cfg, prompt_len=9, max_new_tokens=12)
+    ref = list(np.asarray(g(params, jnp.asarray(prompt[None]),
+                            jax.random.PRNGKey(0)))[0])
+    stop = ref[3:5]                      # completes at token 5
+
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    eng.submit(prompt, max_new_tokens=12, stop_sequences=[stop])
+    done = eng.run_to_completion()
+    assert done[0].generated == ref[:5], (done[0].generated, ref)
+
+    dcache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    cache2 = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    eng2 = SpeculativeEngine(cfg, params, cache2, cfg, params, dcache,
+                             gamma=3)
+    eng2.submit(prompt, max_new_tokens=12, stop_sequences=[stop])
+    done2 = eng2.run_to_completion()
+    assert done2[0].generated == ref[:5], done2[0].generated
