@@ -150,12 +150,24 @@ class ContinuousBatchingEngine:
                 f"(min(pages_max {self.cache.pages_max}, usable pages "
                 f"{self.cache.num_pages - 1}) x page "
                 f"{self.cache.page})")
+        stops = None
+        if stop_sequences is not None:
+            if not isinstance(stop_sequences, (list, tuple)):
+                raise ValueError(
+                    "stop_sequences must be a list of token-id "
+                    f"sequences, got {type(stop_sequences).__name__}")
+            stops = []
+            for q in stop_sequences:
+                if not isinstance(q, (list, tuple, np.ndarray)) \
+                        or len(q) == 0:
+                    raise ValueError(
+                        "each stop sequence must be a NON-EMPTY list "
+                        f"of token ids, got {q!r}")
+                stops.append([int(t) for t in q])
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(
-            rid, prompt, max_new_tokens,
-            stop_sequences=[list(map(int, q)) for q in stop_sequences]
-            if stop_sequences else None))
+        self._queue.append(Request(rid, prompt, max_new_tokens,
+                                   stop_sequences=stops))
         return rid
 
     def finished(self) -> List[Request]:

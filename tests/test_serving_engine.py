@@ -516,3 +516,18 @@ def test_engine_stop_sequences_truncate_generation():
     eng2.submit(prompt, max_new_tokens=12, stop_sequences=[stop])
     done2 = eng2.run_to_completion()
     assert done2[0].generated == ref[:5], done2[0].generated
+
+    # a stop that matches the ADMISSION-sampled first token retires
+    # the request at length 1 (the _finish_admit path)
+    cache3 = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    eng3 = ContinuousBatchingEngine(cfg, params, cache3)
+    eng3.submit(prompt, max_new_tokens=12, stop_sequences=[[ref[0]]])
+    done3 = eng3.run_to_completion()
+    assert done3[0].generated == ref[:1]
+
+    # malformed stop_sequences fail AT SUBMIT with ValueError
+    with pytest.raises(ValueError, match="NON-EMPTY"):
+        eng3.submit(prompt, max_new_tokens=4, stop_sequences=[[]])
+    with pytest.raises(ValueError, match="NON-EMPTY"):
+        eng3.submit(prompt, max_new_tokens=4, stop_sequences=[7, 8])
