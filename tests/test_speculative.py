@@ -251,3 +251,53 @@ def test_speculative_engine_adaptive_gamma():
     out_bad, gamma_bad = run(dcfg, _params(dcfg, seed=77))
     np.testing.assert_array_equal(out_bad, ref)
     assert gamma_bad <= 2, gamma_bad               # shrank or held
+
+
+def test_speculative_engine_churn_property_parity():
+    """CHURN stress for the speculative engine: randomized staggered
+    requests through 2 slots with preemption pressure and a weak
+    draft; every completion must equal its solo greedy run."""
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    cfg = _cfg()
+    params = _params(cfg, seed=0)
+    dcfg = _cfg(layers=1, hidden=32)
+    dparams = _params(dcfg, seed=50)
+    rng = np.random.RandomState(43)
+    cache = PagedKVCache(cfg, num_pages=20, pages_max=8, batch=2,
+                         page=16)
+    dcache = PagedKVCache(dcfg, num_pages=20, pages_max=8, batch=2,
+                          page=16)
+    eng = SpeculativeEngine(cfg, params, cache, dcfg, dparams, dcache,
+                            gamma=3, adaptive_gamma=True)
+    specs = [(rng.randint(1, 128, (int(rng.randint(3, 30)),)),
+              int(rng.randint(2, 12))) for _ in range(6)]
+    submitted = 0
+    done = []
+    for prompt, new in specs[:2]:
+        eng.submit(prompt, max_new_tokens=new)
+        submitted += 1
+    steps = 0
+    while eng.has_work() or submitted < len(specs):
+        eng.step()
+        done.extend(eng.finished())
+        steps += 1
+        if steps % 2 == 1 and submitted < len(specs):
+            prompt, new = specs[submitted]
+            eng.submit(prompt, max_new_tokens=new)
+            submitted += 1
+        assert steps < 300
+    done.extend(eng.finished())
+    assert len(done) == len(specs)
+    for req in done:
+        prompt, new = specs[req.rid]
+        assert len(req.generated) == new
+        g = make_generate(cfg, prompt_len=len(prompt),
+                          max_new_tokens=new)
+        ref = np.asarray(g(params, jnp.asarray(prompt[None]),
+                           jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(np.asarray(req.generated), ref,
+                                      err_msg=f"rid {req.rid}")
+    assert cache.free_pages() == cache.num_pages - 1
+    assert dcache.free_pages() == dcache.num_pages - 1
