@@ -21,6 +21,10 @@ guards, so this manager wraps the eager collective API and the barrier:
   via ``set_abort_handler``.
 * ``check()`` raises if any task has timed out — surfacing a hang to the
   training loop instead of waiting forever.
+* the manager also reports through ``paddle_tpu.observability``: stall
+  counts (``paddle_tpu_comm_watchdog_timeouts_total``), in-flight and
+  heartbeat-age gauges, and a structured ``comm_timeout`` event in the
+  ring on every flagged task (see docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -71,6 +75,37 @@ class CommTaskManager:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._abort_handler: Callable[[CommTask], None] = self._default_abort
+        # observability routing (bound lazily on first task so an
+        # import of this module costs nothing)
+        self._metrics = None
+        self._ring = None
+        self._last_activity = time.monotonic()
+
+    # -- observability -----------------------------------------------------
+    def bind_metrics(self, registry=None, ring=None):
+        """Publish stall counts / heartbeat age through the
+        observability layer (default: the process-wide registry and
+        event ring).  Idempotent; tests bind a fresh registry.  The
+        gauge callbacks hold only a weakref — a transient manager
+        (tests, per-group) bound to the shared registry is neither
+        pinned alive nor left haunting the gauges after collection."""
+        from ...observability import default_registry, default_ring
+        from ...observability.engine_metrics import _weak_fn
+        r = registry if registry is not None else default_registry()
+        self._ring = ring if ring is not None else default_ring()
+        self._metrics = {
+            "timeouts": r.counter(
+                "paddle_tpu_comm_watchdog_timeouts_total",
+                "Collectives flagged as exceeding FLAGS_comm_timeout_s"),
+        }
+        g = r.gauge("paddle_tpu_comm_watchdog_outstanding_count",
+                    "Comm tasks currently in flight")
+        g.set_function(_weak_fn(self, lambda m: float(len(m._tasks))))
+        g = r.gauge("paddle_tpu_comm_watchdog_heartbeat_age_seconds",
+                    "Time since the watchdog last saw task activity")
+        g.set_function(_weak_fn(
+            self, lambda m: time.monotonic() - m._last_activity))
+        return self._metrics
 
     # -- lifecycle ---------------------------------------------------------
     def _ensure_thread(self):
@@ -86,6 +121,9 @@ class CommTaskManager:
 
     # -- task API ----------------------------------------------------------
     def start_task(self, op: str, group_name: str) -> CommTask:
+        if self._metrics is None:
+            self.bind_metrics()
+        self._last_activity = time.monotonic()
         with self._lock:
             t = CommTask(op, group_name, self._next_id)
             self._next_id += 1
@@ -95,6 +133,7 @@ class CommTaskManager:
 
     def finish_task(self, task: CommTask):
         task.done = True
+        self._last_activity = time.monotonic()
         with self._lock:
             self._tasks.pop(task.task_id, None)
 
@@ -141,6 +180,13 @@ class CommTaskManager:
                     t.timed_out = True
                     with self._lock:
                         self._timed_out.append(t)
+                    if self._metrics is not None:
+                        self._metrics["timeouts"].inc()
+                        self._ring.emit("comm_timeout", op=t.op,
+                                        group=t.group_name,
+                                        task_id=t.task_id,
+                                        elapsed_s=round(t.elapsed(), 3),
+                                        timeout_s=limit)
                     try:
                         self._abort_handler(t)
                     except Exception:

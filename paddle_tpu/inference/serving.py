@@ -19,6 +19,10 @@ library only; this module adds:
   device placement.  npz keeps the wire format zero-parse on both
   sides (numpy memory-maps the buffers).
 * :func:`predict_http` — the matching client helper.
+* Observability (docs/OBSERVABILITY.md): both servers expose
+  ``GET /metrics`` (Prometheus text exposition), ``GET /stats`` (JSON
+  registry snapshot) and ``GET /events`` (structured-event ring tail);
+  ``/health`` is a view over the same registry.
 * :class:`GenerationServer` — the LLM serving PRODUCT: HTTP
   ``/generate`` + streaming ``/generate_stream`` over the
   continuous-batching engine (paged KV cache; pass ``mesh`` for a
@@ -34,16 +38,77 @@ from __future__ import annotations
 import io
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 import numpy as np
 
+from ..observability import default_ring
 from . import Config, Predictor
 
 __all__ = ["DevicePool", "InferenceServer", "predict_http",
            "GenerationServer", "generate_http",
            "generate_http_stream"]
+
+
+def _http_metrics(registry):
+    """HTTP-front counters (single registration site — the
+    observability lint test audits these names)."""
+    return {
+        "predict": registry.counter(
+            "paddle_tpu_http_predict_requests_total",
+            "Successful POST /predict calls"),
+        "generate": registry.counter(
+            "paddle_tpu_http_generate_requests_total",
+            "Accepted POST /generate[_stream] submissions"),
+    }
+
+
+def _snap_val(snap: dict, name: str, default=0):
+    """Read one scalar out of a registry snapshot (gauges may be
+    None when a scrape callback failed)."""
+    m = snap.get(name)
+    if m is None:
+        return default
+    v = m.get("value")
+    return default if v is None else v
+
+
+def _serve_observability(handler, path: str, registry, ring) -> bool:
+    """Shared GET endpoints for both servers: ``/metrics`` (Prometheus
+    text exposition), ``/stats`` (JSON registry snapshot), ``/events``
+    (ring tail; ``?n=`` limit, ``?since=<seq>`` for followers).
+    Returns True when the path was handled."""
+    if path == "/metrics":
+        handler._reply(200, registry.render_prometheus().encode(),
+                       "text/plain; version=0.0.4")
+        return True
+    if path == "/stats":
+        body = {"metrics": registry.snapshot(),
+                "events_buffered": len(ring),
+                "events_dropped": ring.dropped}
+        handler._reply(200, json.dumps(body).encode(),
+                       "application/json")
+        return True
+    if path == "/events":
+        q = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(handler.path).query)
+        try:
+            since = int(q["since"][0]) if "since" in q else 0
+            # a since-follower gets EVERYTHING new by default — an
+            # implicit n-cap would silently drop burst events and
+            # advance the follower's cursor past them
+            n = int(q["n"][0]) if "n" in q \
+                else (None if since else 100)
+        except ValueError:
+            handler._reply(400, b"bad query", "text/plain")
+            return True
+        body = {"events": ring.recent(n=n, since=since)}
+        handler._reply(200, json.dumps(body).encode(),
+                       "application/json")
+        return True
+    return False
 
 
 class DevicePool:
@@ -118,11 +183,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         srv: "InferenceServer" = self.server.owner
-        if self.path.rstrip("/") in ("", "/health"):
+        path = urllib.parse.urlsplit(self.path).path.rstrip("/")
+        if path in ("", "/health"):
             meta = {"status": "ok", "devices": srv.pool.device_names,
                     "requests": srv.request_count}
             self._reply(200, json.dumps(meta).encode(),
                         "application/json")
+        elif _serve_observability(self, path, srv.registry, srv.ring):
+            pass
         else:
             self._reply(404, b"not found", "text/plain")
 
@@ -152,6 +220,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         with srv._count_lock:
             srv.request_count += 1
+        srv._http_counters["predict"].inc()
         self._reply(200, _pack_npz(outs))
 
 
@@ -165,13 +234,22 @@ class InferenceServer:
     """
 
     def __init__(self, config: Config, devices=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics_registry=None):
+        from ..observability import MetricsRegistry
         self.pool = DevicePool(config, devices)
         self._host, self._port = host, port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.request_count = 0
         self._count_lock = threading.Lock()
+        # /metrics + /stats: per-server registry by default (exact
+        # per-server scrapes); pass observability.default_registry()
+        # to publish process-wide
+        self.registry = metrics_registry if metrics_registry \
+            is not None else MetricsRegistry()
+        self.ring = default_ring()
+        self._http_counters = _http_metrics(self.registry)
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer((self._host, self._port),
@@ -221,24 +299,70 @@ class _GenHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         srv: "GenerationServer" = self.server.owner
-        if self.path.rstrip("/") in ("", "/health"):
-            eng = srv.engine
+        path = urllib.parse.urlsplit(self.path).path.rstrip("/")
+        if path in ("", "/health"):
+            # /health is a VIEW over the metrics registry (same keys
+            # as ever; single source of truth is the instrumentation,
+            # not ad-hoc reads of engine attributes).  An engine built
+            # with metrics_registry=False has no instrumentation to
+            # view — fall back to live attribute reads rather than
+            # reporting a healthy server as drained/exhausted.
+            if getattr(srv.engine, "metrics", None) is None:
+                eng = srv.engine
+                h = {"status": "ok" if srv._fatal is None
+                     else "failed",
+                     "error": srv._fatal,
+                     "active": len(eng._active),
+                     "queued": len(eng._queue),
+                     "free_pages": eng.cache.free_pages(),
+                     "decode_steps": eng.decode_steps,
+                     "tokens_generated": eng.tokens_generated,
+                     "prefill_calls": eng.prefill_calls,
+                     "preemptions": eng.preemptions,
+                     "prefix_hits": eng.cache.prefix_hits,
+                     "requests_finished": eng.requests_finished}
+                if hasattr(eng, "spec_rounds"):
+                    h["spec_rounds"] = eng.spec_rounds
+                    h["spec_accepted"] = eng.spec_accepted
+                    h["gamma"] = eng.gamma
+                self._reply(200, json.dumps(h).encode())
+                return
+            snap = srv.registry.snapshot()
+            v = _snap_val
             h = {"status": "ok" if srv._fatal is None else "failed",
                  "error": srv._fatal,
-                 "active": len(eng._active),
-                 "queued": len(eng._queue),
-                 "free_pages": eng.cache.free_pages(),
-                 "decode_steps": eng.decode_steps,
-                 "tokens_generated": eng.tokens_generated,
-                 "prefill_calls": eng.prefill_calls,
-                 "preemptions": eng.preemptions,
-                 "prefix_hits": eng.cache.prefix_hits,
-                 "requests_finished": eng.requests_finished}
-            if hasattr(eng, "spec_rounds"):    # speculative engine
-                h["spec_rounds"] = eng.spec_rounds
-                h["spec_accepted"] = eng.spec_accepted
-                h["gamma"] = eng.gamma
+                 "active": int(v(
+                     snap, "paddle_tpu_engine_active_requests_count")),
+                 "queued": int(v(
+                     snap, "paddle_tpu_engine_queued_requests_count")),
+                 "free_pages": int(v(
+                     snap, "paddle_tpu_kvcache_free_pages_count")),
+                 "occupancy": v(
+                     snap, "paddle_tpu_engine_batch_occupancy_ratio"),
+                 "decode_steps": int(v(
+                     snap, "paddle_tpu_engine_decode_steps_total")),
+                 "tokens_generated": int(v(
+                     snap, "paddle_tpu_engine_tokens_generated_total")),
+                 "prefill_calls": int(v(
+                     snap,
+                     "paddle_tpu_engine_prefill_dispatches_total")),
+                 "preemptions": int(v(
+                     snap, "paddle_tpu_engine_preemptions_total")),
+                 "prefix_hits": int(v(
+                     snap,
+                     "paddle_tpu_kvcache_prefix_hit_pages_total")),
+                 "requests_finished": int(v(
+                     snap,
+                     "paddle_tpu_engine_requests_finished_total"))}
+            if hasattr(srv.engine, "spec_rounds"):  # speculative
+                h["spec_rounds"] = int(v(
+                    snap, "paddle_tpu_spec_rounds_total"))
+                h["spec_accepted"] = int(v(
+                    snap, "paddle_tpu_spec_accepted_tokens_total"))
+                h["gamma"] = srv.engine.gamma
             self._reply(200, json.dumps(h).encode())
+        elif _serve_observability(self, path, srv.registry, srv.ring):
+            pass
         else:
             self._reply(404, b"not found", "text/plain")
 
@@ -342,6 +466,16 @@ class GenerationServer:
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._fatal: Optional[str] = None
+        # observability surface: /metrics, /stats, /events, and
+        # /health all read the ENGINE's registry (an engine built with
+        # metrics_registry=False serves an empty one)
+        m = getattr(self.engine, "metrics", None)
+        if m is not None:
+            self.registry, self.ring = m.registry, m.ring
+        else:
+            from ..observability import MetricsRegistry
+            self.registry, self.ring = MetricsRegistry(), default_ring()
+        self._http_counters = _http_metrics(self.registry)
 
     def submit(self, prompt, max_new_tokens):
         import queue as _queue
@@ -352,6 +486,7 @@ class GenerationServer:
                                      max_new_tokens=max_new_tokens)
             q = _queue.Queue()
             self._queues[rid] = q
+        self._http_counters["generate"].inc()
         return rid, q
 
     def _drive(self):

@@ -120,6 +120,10 @@ class PagedKVCache:
         self._prefix_parent: dict = {}
         self._prefix_nchildren: dict = {}
         self.prefix_hits = 0              # pages reused via the index
+        # observability hookup (an owning engine sets this to its
+        # EngineMetrics; gauges over pool state are scrape-time
+        # callbacks, so only the hit/miss counters touch hot paths)
+        self.metrics = None
 
     def free_pages(self) -> int:
         return len(self._free)
@@ -216,6 +220,9 @@ class PagedKVCache:
             self.release_row(b)     # roll back the partial claim
             raise
         self.lens[b] = L
+        if self.metrics is not None:
+            self.metrics.prefix_hit_pages.inc(len(shared))
+            self.metrics.prefix_miss_pages.inc(need - len(shared))
         return len(shared) * page
 
     def register_prefix(self, b: int, ctx: np.ndarray) -> None:
@@ -524,7 +531,15 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
 
     from jax.sharding import PartitionSpec as P
     from .llama_pretrain import param_specs
-    shard_map = jax.shard_map
+    try:                               # jax >= 0.5 top-level export
+        shard_map = jax.shard_map
+    except AttributeError:             # 0.4.x: experimental namespace,
+        from jax.experimental.shard_map import shard_map as _sm
+
+        def shard_map(*a, **kw):       # ... where check_vma is check_rep
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            return _sm(*a, **kw)
     from ..ops.pallas.paged_attention import (
         paged_decode_attention, paged_decode_attention_q8,
         quantize_kv_token)

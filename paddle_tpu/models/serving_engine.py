@@ -20,6 +20,7 @@ numpy bookkeeping — the device work is the two jitted programs.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -28,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import (EngineMetrics, MetricsRegistry,
+                             bind_engine_gauges)
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            _pick_token, make_paged_decode_step,
@@ -47,6 +50,13 @@ class Request:
     stop_sequences: Optional[List[List[int]]] = None
     admit_seq: int = -1                   # admission order (preemption)
     preempted: int = 0                    # times evicted + requeued
+    # lifecycle timestamps (time.monotonic; 0.0 = not reached).
+    # t_admit/t_first_token survive preemption — a re-admission must
+    # not re-observe queue-wait/TTFT.
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
 
 
 class ContinuousBatchingEngine:
@@ -65,7 +75,8 @@ class ContinuousBatchingEngine:
                  prefill_bucket: int = 64,
                  prefill_chunk: Optional[int] = None,
                  mesh=None, top_k: int = 0, top_p: float = 1.0,
-                 enable_prefix_caching: bool = False):
+                 enable_prefix_caching: bool = False,
+                 metrics_registry=None, metrics_ring=None):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -112,6 +123,24 @@ class ContinuousBatchingEngine:
         self._admit_seq = 0
         self._stream: List = []     # (rid, token) in emission order
         self._key = jax.random.PRNGKey(seed)
+        # OBSERVABILITY (docs/OBSERVABILITY.md): host-side instruments
+        # only — recorded from values already materialized on host,
+        # zero new jitted programs.  Default is a registry private to
+        # this engine (exact per-engine /metrics) and a private event
+        # ring; pass a shared MetricsRegistry / EventRing (e.g.
+        # observability.default_registry() / default_ring()) to
+        # aggregate, or metrics_registry=False to disable
+        # instrumentation entirely.
+        if metrics_registry is False:
+            self.metrics = None
+            cache.metrics = None     # a reused cache must not keep
+            #                          feeding a prior engine's counters
+        else:
+            self.metrics = EngineMetrics(
+                metrics_registry if metrics_registry is not None
+                else MetricsRegistry(), ring=metrics_ring)
+            bind_engine_gauges(self.metrics, self)
+            cache.metrics = self.metrics
         if mesh is not None and mesh.shape.get("mp", 1) > 1:
             self._step = make_paged_decode_step_tp(
                 cfg, mesh, temperature, kv_quant=cache.kv_quant,
@@ -167,7 +196,13 @@ class ContinuousBatchingEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(Request(rid, prompt, max_new_tokens,
-                                   stop_sequences=stops))
+                                   stop_sequences=stops,
+                                   t_submit=time.monotonic()))
+        if self.metrics is not None:
+            self.metrics.requests_submitted.inc()
+            self.metrics.ring.emit("request_submitted", rid=rid,
+                                   prompt_len=len(prompt),
+                                   max_new_tokens=max_new_tokens)
         return rid
 
     def finished(self) -> List[Request]:
@@ -211,8 +246,23 @@ class ContinuousBatchingEngine:
                 return True
         return False
 
+    def _note_first_token(self, req: Request) -> None:
+        """TTFT sample, once per request (the first token lands at
+        admission; preemption resumes must not re-observe)."""
+        if req.t_first_token == 0.0 and req.generated:
+            req.t_first_token = time.monotonic()
+            if self.metrics is not None:
+                self.metrics.ttft.observe(
+                    req.t_first_token - req.t_submit)
+
     def _finish_admit(self, req: Request, slot: int, tok: int) -> None:
         """Shared bookkeeping tail of every admission path."""
+        if req.t_admit == 0.0:
+            req.t_admit = time.monotonic()
+            if self.metrics is not None:
+                self.metrics.queue_wait.observe(
+                    req.t_admit - req.t_submit)
+        self._note_first_token(req)
         req.slot = slot
         req.admit_seq = self._admit_seq
         self._admit_seq += 1
@@ -250,6 +300,8 @@ class ContinuousBatchingEngine:
             padded[i, :Ls[i]] = ctx
         x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
         self.prefill_calls += 1
+        if self.metrics is not None:
+            self.metrics.prefill_dispatches.inc()
         for i, (req, slot, L) in enumerate(zip(reqs, slots, Ls)):
             self.cache.write_row_pages(slot, ks[:, i], vs[:, i], L)
         toks = None
@@ -298,6 +350,7 @@ class ContinuousBatchingEngine:
         dummy = jnp.zeros((1,), jnp.float32)
         x = None
         pos = start
+        nchunks = 0
         while pos < L:
             C_real = min(chunk, L - pos)
             toks = np.zeros((1, chunk), np.int64)
@@ -310,10 +363,14 @@ class ContinuousBatchingEngine:
                 self.cache.vscale if q8 else dummy,
                 table, np.int32(pos))
             self.prefill_calls += 1
+            nchunks += 1
             self.cache.write_row_pages(slot, ks, vs, C_real,
                                        first_page=pos // page)
             last_real = C_real
             pos += C_real
+        if self.metrics is not None and nchunks:
+            self.metrics.prefill_dispatches.inc(nchunks)
+            self.metrics.prefill_chunks.inc(nchunks)
         if req.generated:                        # resume after preempt
             tok = req.generated[-1]
         else:
@@ -347,6 +404,11 @@ class ContinuousBatchingEngine:
         req.slot = None
         req.preempted += 1
         self.preemptions += 1
+        if self.metrics is not None:
+            self.metrics.preemptions.inc()
+            self.metrics.ring.emit("preemption", rid=req.rid,
+                                   slot=slot,
+                                   generated=len(req.generated))
         self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
@@ -356,10 +418,26 @@ class ContinuousBatchingEngine:
     def _retire(self, slot: int) -> None:
         req = self._active.pop(slot)
         req.done = True
+        req.t_finish = time.monotonic()
         self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
         self.requests_finished += 1
+        if self.metrics is not None:
+            m = self.metrics
+            m.requests_finished.inc()
+            n = len(req.generated)
+            if n > 1 and req.t_first_token and not req.preempted:
+                # mean inter-token time over the decode phase (TTFT
+                # excluded — its own histogram).  Preempted requests
+                # are excluded: their first-token→finish window spans
+                # the requeue wait, which would inflate TPOT exactly
+                # when the pool is under the pressure the preemption
+                # counter already reports.
+                m.tpot.observe(
+                    (req.t_finish - req.t_first_token) / (n - 1))
+            m.ring.emit("request_finished", rid=req.rid, tokens=n,
+                        preempted=req.preempted)
         self._finished.append(req)
 
     def step(self) -> int:
@@ -396,7 +474,13 @@ class ContinuousBatchingEngine:
             self._admit_batch(group)
         if not self._active:
             return 0
-        self._decode_once()
+        if self.metrics is None:
+            self._decode_once()
+        else:
+            t0 = time.perf_counter()
+            self._decode_once()
+            self.metrics.decode_seconds.observe(
+                time.perf_counter() - t0)
         return len(self._active)
 
     def _ensure_or_preempt(self, new_tokens: int = 1,
@@ -448,15 +532,21 @@ class ContinuousBatchingEngine:
             np.int32))
         self.decode_steps += 1
         nxt = np.asarray(nxt)
+        advanced = 0
         for slot, req in list(self._active.items()):
             t = int(nxt[slot])
             req.generated.append(t)
             self.tokens_generated += 1
+            advanced += 1
+            self._note_first_token(req)
             self._stream.append((req.rid, t))
             self._next_tok[slot] = t
             self._remaining[slot] -= 1
             if self._hit_stop(req, t) or self._remaining[slot] <= 0:
                 self._retire(slot)
+        if self.metrics is not None:
+            self.metrics.decode_steps.inc()
+            self.metrics.tokens_generated.inc(advanced)
 
     def run_to_completion(self, max_steps: int = 10_000):
         """Drive until the queue drains; returns all finished requests

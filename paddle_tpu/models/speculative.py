@@ -233,6 +233,9 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self._d_len = np.zeros(self.B, np.int64)
         self.spec_rounds = 0
         self.spec_accepted = 0
+        self.spec_drafted = 0       # draft tokens proposed (gamma/row)
+        if self.metrics is not None:
+            self.metrics.spec_gamma.set(self.gamma)
 
     # -- hooks ---------------------------------------------------------
     def _release_slot(self, slot):
@@ -342,12 +345,16 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         # ---- per-row acceptance + commit (host bookkeeping)
         self.decode_steps += 1
         self.spec_rounds += 1
+        self.spec_drafted += gamma * len(active)
+        round_accepted = 0
+        round_tokens = 0
         for s in active:
             req = self._active[s]
             k = 0
             while k < gamma and drafts[s, k] == greedy[s, k]:
                 k += 1
             self.spec_accepted += k
+            round_accepted += k
             new_toks = [int(t) for t in drafts[s, :k]] + \
                 [int(greedy[s, k])]
             n_old = N[s]
@@ -356,6 +363,8 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             for t in new_toks:
                 req.generated.append(t)
                 self.tokens_generated += 1
+                round_tokens += 1
+                self._note_first_token(req)
                 self._stream.append((req.rid, t))
                 self._remaining[s] -= 1
                 committed += 1
@@ -380,3 +389,12 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             elif self._accept_ema > 0.85 * self.gamma and \
                     self.gamma < self.max_gamma:
                 self.gamma += 1
+        if self.metrics is not None:
+            m = self.metrics
+            m.decode_steps.inc()
+            m.tokens_generated.inc(round_tokens)
+            m.spec_rounds.inc()
+            m.spec_accepted_tokens.inc(round_accepted)
+            m.spec_gamma.set(self.gamma)     # post-retune = next round
+            m.spec_acceptance.set(
+                self.spec_accepted / max(self.spec_drafted, 1))

@@ -1,0 +1,31 @@
+"""paddle_tpu.observability — unified metrics + tracing for the
+serving/training stack.
+
+Three pieces (see docs/OBSERVABILITY.md for the metric catalogue and
+scrape/export recipes):
+
+* :mod:`.metrics` — thread-safe process-wide registry of
+  Counter/Gauge/Histogram instruments, Prometheus text exposition
+  (``GET /metrics`` on the servers) and a JSON snapshot API
+  (``GET /stats``).
+* :mod:`.events` — bounded structured-event ring buffer (JSON lines)
+  with chrome-trace export that merges the profiler's RecordEvent
+  spans onto the same timeline.
+* :mod:`.engine_metrics` — the instrument bundle the
+  continuous-batching serving stack records into (single source of
+  truth for the metric catalogue).
+
+Everything is stdlib-only and host-side: instrumentation adds zero
+jitted programs and never forces a device sync — values are recorded
+from numbers the engine already materializes on host.
+"""
+
+from .events import EventRing, default_ring            # noqa: F401
+from .metrics import (Counter, Gauge, Histogram,       # noqa: F401
+                      MetricsRegistry, default_registry)
+from .engine_metrics import (EngineMetrics,            # noqa: F401
+                             bind_engine_gauges)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "default_registry", "EventRing", "default_ring",
+           "EngineMetrics", "bind_engine_gauges"]

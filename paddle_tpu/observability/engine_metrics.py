@@ -1,0 +1,160 @@
+"""Instrument bundle for the serving hot path.
+
+One :class:`EngineMetrics` per engine: every Counter/Gauge/Histogram
+the continuous-batching stack publishes, created against one registry
+(the process-wide default for servers; a fresh registry in tests that
+assert exact counts).  Kept in one place so the metric catalogue is a
+single source of truth — tests/test_observability.py lints every name
+here against the ``paddle_tpu_<subsystem>_<name>_<unit>`` convention
+and docs/OBSERVABILITY.md.
+
+Gauges derivable from engine/cache state use scrape-time callbacks
+(``set_function``) through a weakref — the hot path pays nothing to
+keep them fresh, and a registry outliving its engine reads 0 instead
+of pinning the engine (and its device pools) alive.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+from .events import EventRing
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["EngineMetrics", "bind_engine_gauges"]
+
+# step/decode latencies: 100us .. 10s
+_STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# per-token cadence (TPOT): 100us .. 2.5s
+_TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class EngineMetrics:
+    """All instruments the serving stack records into.
+
+    ``registry=None`` uses the process-wide default registry (several
+    engines then share instruments — counters aggregate, callback
+    gauges track the most recently constructed engine, which is the
+    Prometheus process-wide reading).  Pass a fresh
+    :class:`MetricsRegistry` for per-engine isolation.
+    """
+
+    def __init__(self, registry: MetricsRegistry = None, ring=None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        # the engine's lifecycle events get their own ring by default
+        # (per-engine /events isolation); pass
+        # observability.default_ring() to aggregate process-wide
+        self.ring = ring if ring is not None else EventRing()
+
+        # -- request lifecycle ------------------------------------------
+        self.requests_submitted = r.counter(
+            "paddle_tpu_engine_requests_submitted_total",
+            "Requests accepted by submit()")
+        self.requests_finished = r.counter(
+            "paddle_tpu_engine_requests_finished_total",
+            "Requests retired (eos/stop/max_new_tokens)")
+        self.preemptions = r.counter(
+            "paddle_tpu_engine_preemptions_total",
+            "Active requests evicted + requeued on pool exhaustion")
+        self.queue_wait = r.histogram(
+            "paddle_tpu_request_queue_wait_seconds",
+            "submit() -> first admission")
+        self.ttft = r.histogram(
+            "paddle_tpu_request_ttft_seconds",
+            "submit() -> first generated token")
+        self.tpot = r.histogram(
+            "paddle_tpu_request_tpot_seconds",
+            "Mean inter-token time per finished unpreempted request "
+            "(excludes TTFT and requeue waits)",
+            buckets=_TPOT_BUCKETS)
+
+        # -- decode / prefill dispatches --------------------------------
+        self.decode_steps = r.counter(
+            "paddle_tpu_engine_decode_steps_total",
+            "Decode dispatches (speculative: draft+verify rounds)")
+        self.decode_seconds = r.histogram(
+            "paddle_tpu_engine_decode_step_seconds",
+            "Wall time of one decode dispatch (host-observed)",
+            buckets=_STEP_BUCKETS)
+        self.tokens_generated = r.counter(
+            "paddle_tpu_engine_tokens_generated_total",
+            "Tokens emitted across all requests")
+        self.prefill_dispatches = r.counter(
+            "paddle_tpu_engine_prefill_dispatches_total",
+            "Jitted prefill program dispatches (batched admits "
+            "count once)")
+        self.prefill_chunks = r.counter(
+            "paddle_tpu_engine_prefill_chunks_total",
+            "Chunks processed by chunked-prefill admissions")
+        self.batch_occupancy = r.gauge(
+            "paddle_tpu_engine_batch_occupancy_ratio",
+            "Active slots / decode batch size")
+        self.active_requests = r.gauge(
+            "paddle_tpu_engine_active_requests_count",
+            "Requests holding a decode slot")
+        self.queued_requests = r.gauge(
+            "paddle_tpu_engine_queued_requests_count",
+            "Requests waiting for admission")
+
+        # -- paged KV cache ---------------------------------------------
+        self.prefix_hit_pages = r.counter(
+            "paddle_tpu_kvcache_prefix_hit_pages_total",
+            "Prompt pages reused from the prefix index")
+        self.prefix_miss_pages = r.counter(
+            "paddle_tpu_kvcache_prefix_miss_pages_total",
+            "Prompt pages freshly prefilled on prefix-cached admits")
+        self.kv_free_pages = r.gauge(
+            "paddle_tpu_kvcache_free_pages_count",
+            "Pages on the free list")
+        self.kv_utilization = r.gauge(
+            "paddle_tpu_kvcache_page_utilization_ratio",
+            "Allocated usable pages / usable pool (page 0 reserved)")
+
+        # -- speculative decoding ---------------------------------------
+        self.spec_rounds = r.counter(
+            "paddle_tpu_spec_rounds_total",
+            "Speculative draft+verify rounds")
+        self.spec_accepted_tokens = r.counter(
+            "paddle_tpu_spec_accepted_tokens_total",
+            "Draft tokens accepted by exact verification")
+        self.spec_gamma = r.gauge(
+            "paddle_tpu_spec_gamma_tokens",
+            "Current draft length (adaptive gamma retunes it)")
+        self.spec_acceptance = r.gauge(
+            "paddle_tpu_spec_acceptance_ratio",
+            "Accepted draft tokens / drafted tokens, lifetime")
+
+
+def _weak_fn(obj, fn, default: float = 0.0):
+    """Scrape callback holding only a weakref to its owner: a dead
+    engine reads ``default`` instead of being pinned alive by the
+    process-wide registry."""
+    ref = weakref.ref(obj)
+
+    def call():
+        o = ref()
+        return default if o is None else fn(o)
+
+    return call
+
+
+def bind_engine_gauges(m: EngineMetrics, engine) -> None:
+    """Point the callback gauges at one engine (+ its cache).  Called
+    from the engine constructor; re-binding (a newer engine on the
+    shared default registry) is last-writer-wins by design."""
+    cache = engine.cache
+    m.active_requests.set_function(
+        _weak_fn(engine, lambda e: float(len(e._active))))
+    m.queued_requests.set_function(
+        _weak_fn(engine, lambda e: float(len(e._queue))))
+    m.batch_occupancy.set_function(
+        _weak_fn(engine, lambda e: len(e._active) / e.B))
+    m.kv_free_pages.set_function(
+        _weak_fn(cache, lambda c: float(c.free_pages())))
+    usable = max(cache.num_pages - 1, 1)       # page 0 reserved
+    m.kv_utilization.set_function(
+        _weak_fn(cache,
+                 lambda c: 1.0 - c.free_pages() / usable))

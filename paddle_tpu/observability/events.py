@@ -1,0 +1,167 @@
+"""Bounded structured-event ring buffer + chrome-trace export.
+
+The metrics registry answers "how much / how fast"; this ring answers
+"what happened, in what order" — admission, preemption, watchdog
+timeouts, bench backend-init attempts — without unbounded growth
+(serving runs for days; the ring keeps the last ``capacity`` events
+and drops the oldest).
+
+Events are plain dicts (JSON lines on export).  Timestamps carry BOTH
+clocks: ``ts`` is ``timeit.default_timer()`` (the profiler's clock, so
+ring events and profiler ``RecordEvent`` spans land on ONE chrome
+timeline) and ``wall`` is ``time.time()`` (for humans and cross-host
+correlation).  ``seq`` increments per event so a tailer
+(tools/metrics_dump.py) can poll ``/events?since=<seq>`` without
+duplicates.
+
+``span()`` opens a profiler ``RecordEvent`` (the span shows up in the
+profiler summary/chrome export AND the XLA device trace when a capture
+is live) and additionally emits a ring event with the measured
+duration — one annotation, three sinks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import timeit
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["EventRing", "default_ring"]
+
+
+class _RingSpan:
+    """Context manager: profiler RecordEvent + ring event on exit."""
+
+    def __init__(self, ring: "EventRing", name: str, fields: dict):
+        self._ring = ring
+        self._name = name
+        self._fields = fields
+        self._rec = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        from ..profiler.utils import RecordEvent, TracerEventType
+        self._rec = RecordEvent(self._name,
+                                TracerEventType.UserDefined)
+        self._rec.begin()
+        self._t0 = timeit.default_timer()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = timeit.default_timer() - self._t0
+        if self._rec is not None:
+            self._rec.end()
+        self._ring.emit(self._name, dur_s=dur, **self._fields)
+        return False
+
+
+class EventRing:
+    """Thread-safe bounded ring of structured events."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self.dropped = 0          # events pushed out of the ring
+
+    def emit(self, name: str, **fields) -> dict:
+        ev = {"name": name,
+              "ts": timeit.default_timer(),
+              "wall": time.time(),
+              "tid": threading.get_ident()}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+        return ev
+
+    def span(self, name: str, **fields) -> _RingSpan:
+        return _RingSpan(self, name, fields)
+
+    def recent(self, n: Optional[int] = None,
+               since: int = 0) -> List[dict]:
+        """Last ``n`` events (all by default), optionally only those
+        with ``seq > since`` (the tail-follow protocol)."""
+        with self._lock:
+            evs = list(self._events)
+        if since:
+            evs = [e for e in evs if e["seq"] > since]
+        if n is not None:
+            evs = evs[-n:] if n > 0 else []   # n=0 is "none", not all
+        return evs
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+            self._events.clear()
+        return evs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def to_jsonl(self, n: Optional[int] = None) -> str:
+        return "\n".join(json.dumps(e) for e in self.recent(n))
+
+    def export_chrome_trace(self, path: str,
+                            include_profiler_spans: bool = True
+                            ) -> str:
+        """Write a chrome trace: ring events as instants (spans when
+        they carry ``dur_s``) merged with the profiler's currently
+        buffered host spans — engine events and ``RecordEvent`` spans
+        on one timeline (open in Perfetto / chrome://tracing)."""
+        import os
+        pid = os.getpid()
+        trace_events = []
+        for ev in self.recent():
+            args = {k: v for k, v in ev.items()
+                    if k not in ("name", "ts", "tid", "wall", "seq",
+                                 "dur_s")
+                    and isinstance(v, (str, int, float, bool,
+                                       type(None)))}
+            if "dur_s" in ev:
+                trace_events.append({
+                    "name": ev["name"], "ph": "X", "cat": "event",
+                    "ts": (ev["ts"] - ev["dur_s"]) * 1e6,
+                    "dur": ev["dur_s"] * 1e6,
+                    "pid": pid, "tid": ev["tid"], "args": args})
+            else:
+                trace_events.append({
+                    "name": ev["name"], "ph": "i", "cat": "event",
+                    "ts": ev["ts"] * 1e6, "s": "t",
+                    "pid": pid, "tid": ev["tid"], "args": args})
+        if include_profiler_spans:
+            try:
+                from ..profiler.utils import _peek_spans
+                for name, etype, start, end, tid in _peek_spans():
+                    trace_events.append({
+                        "name": name, "ph": "X", "cat": etype,
+                        "ts": start * 1e6, "dur": (end - start) * 1e6,
+                        "pid": pid, "tid": tid})
+            except Exception:
+                pass              # profiler unavailable: events only
+        trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        import os.path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+
+_default_ring = EventRing()
+
+
+def default_ring() -> EventRing:
+    """The process-wide ring servers and the bench emit into."""
+    return _default_ring

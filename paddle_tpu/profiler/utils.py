@@ -74,6 +74,14 @@ def _drain_spans():
     return _buffer.drain()
 
 
+def _peek_spans():
+    """Non-destructive view of the buffered spans — the observability
+    event ring merges them into its chrome-trace export without
+    stealing them from the profiler's own summary/export."""
+    with _buffer._lock:
+        return list(_buffer._spans)
+
+
 class RecordEvent(ContextDecorator):
     """User-facing interval annotation (reference: profiler/utils.py:40).
 

@@ -297,3 +297,115 @@ def test_generation_server_over_speculative_engine():
         assert eng.spec_rounds >= 1
     finally:
         srv.stop()
+
+
+def test_generation_server_metrics_and_stats_endpoints():
+    """Acceptance: GET /metrics on a live GenerationServer returns
+    valid Prometheus text exposition whose values are consistent with
+    the engine's internal counters; /stats returns the JSON snapshot;
+    /events returns the structured ring tail; /health is a view over
+    the same registry."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+    from paddle_tpu.observability import MetricsRegistry
+
+    cfg, params, cache = _gen_setup()
+    reg = MetricsRegistry()
+    srv = GenerationServer(cfg, params, cache, metrics_registry=reg)
+    assert srv.registry is reg            # server scrapes the engine's
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        rng = np.random.RandomState(41)
+        for _ in range(2):
+            generate_http(url, rng.randint(1, 128, (8,)),
+                          max_new_tokens=5)
+
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        # every sample line is NAME[{le="..."}] VALUE
+        import re
+        line_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? \S+$')
+        samples = {}
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert line_re.match(line), f"malformed: {line!r}"
+            name, val = line.rsplit(" ", 1)
+            samples[name] = float(val)
+
+        eng = srv.engine
+        assert samples[
+            "paddle_tpu_engine_requests_finished_total"] == 2
+        assert samples["paddle_tpu_engine_decode_steps_total"] \
+            == eng.decode_steps
+        assert samples["paddle_tpu_engine_tokens_generated_total"] \
+            == eng.tokens_generated
+        assert samples["paddle_tpu_engine_prefill_dispatches_total"] \
+            == eng.prefill_calls
+        assert samples["paddle_tpu_engine_preemptions_total"] \
+            == eng.preemptions
+        assert samples["paddle_tpu_kvcache_free_pages_count"] \
+            == cache.free_pages()
+        assert samples["paddle_tpu_engine_batch_occupancy_ratio"] == 0
+        assert samples["paddle_tpu_request_ttft_seconds_count"] == 2
+        assert samples["paddle_tpu_request_tpot_seconds_count"] == 2
+        assert samples[
+            "paddle_tpu_request_queue_wait_seconds_count"] == 2
+        assert samples['paddle_tpu_request_ttft_seconds_bucket'
+                       '{le="+Inf"}'] == 2
+        assert samples["paddle_tpu_http_generate_requests_total"] == 2
+
+        # /stats: the JSON snapshot of the same registry
+        with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        m = stats["metrics"]
+        assert m["paddle_tpu_engine_requests_finished_total"][
+            "value"] == 2
+        assert m["paddle_tpu_request_ttft_seconds"]["count"] == 2
+
+        # /events: lifecycle events for both requests, seq-tagged
+        with urllib.request.urlopen(url + "/events?n=50",
+                                    timeout=10) as r:
+            evs = json.loads(r.read())["events"]
+        names = [e["name"] for e in evs]
+        assert names.count("request_submitted") == 2
+        assert names.count("request_finished") == 2
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs)
+        last = max(seqs)
+        with urllib.request.urlopen(
+                url + f"/events?since={last}", timeout=10) as r:
+            assert json.loads(r.read())["events"] == []
+
+        # /health reads the registry (same numbers, legacy keys)
+        with urllib.request.urlopen(url + "/health", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["requests_finished"] == 2
+        assert h["decode_steps"] == eng.decode_steps
+        assert h["free_pages"] == cache.free_pages()
+    finally:
+        srv.stop()
+
+
+def test_inference_server_metrics_endpoint(artifact):
+    """InferenceServer exposes the same observability surface."""
+    prog, x, y = artifact
+    srv = InferenceServer(Config(prog_file=prog),
+                          devices=jax.local_devices()[:1])
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(3):
+            predict_http(url, [x])
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "paddle_tpu_http_predict_requests_total 3" in text
+        with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["metrics"][
+            "paddle_tpu_http_predict_requests_total"]["value"] == 3
+    finally:
+        srv.stop()

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Pretty-print metrics from a running paddle_tpu server, or tail its
+structured-event ring.
+
+Usage:
+  python tools/metrics_dump.py stats   http://127.0.0.1:8000
+  python tools/metrics_dump.py metrics http://127.0.0.1:8000
+  python tools/metrics_dump.py events  http://127.0.0.1:8000 [-n 50] [--follow]
+  python tools/metrics_dump.py snapshot BENCH_r05.json
+
+``stats`` renders ``GET /stats`` (the JSON snapshot) as an aligned
+table; ``metrics`` dumps the raw Prometheus text from ``GET /metrics``;
+``events`` prints the last N ring events as JSON lines and with
+``--follow`` polls ``/events?since=<seq>`` for new ones; ``snapshot``
+pretty-prints a snapshot previously written to a file (e.g. the
+``metrics_snapshot`` line bench.py appends to BENCH_r*.json output).
+
+Stdlib only — usable on any host that can reach the server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _render_snapshot(snap: dict) -> str:
+    """Aligned table: counters/gauges one line; histograms show
+    count/sum/mean plus the occupied buckets."""
+    lines = []
+    width = max((len(n) for n in snap), default=0)
+    for name in sorted(snap):
+        m = snap[name]
+        kind = m.get("type", "?")
+        if kind == "histogram":
+            count, total = m.get("count", 0), m.get("sum", 0.0)
+            mean = total / count if count else 0.0
+            lines.append(f"{name:<{width}}  histogram  count={count} "
+                         f"sum={total:.6g} mean={mean:.6g}")
+            prev = 0
+            for le, c in (m.get("buckets") or {}).items():
+                if c != prev:
+                    lines.append(f"{'':<{width}}    le={le}: {c}")
+                prev = c
+        else:
+            v = m.get("value")
+            vs = "NaN" if v is None else f"{v:.6g}"
+            lines.append(f"{name:<{width}}  {kind:<9}  {vs}")
+    return "\n".join(lines)
+
+
+def cmd_stats(args) -> int:
+    body = json.loads(_get(args.url.rstrip("/") + "/stats"))
+    snap = body.get("metrics", body)     # /stats wraps; a file may not
+    print(_render_snapshot(snap))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    sys.stdout.write(
+        _get(args.url.rstrip("/") + "/metrics").decode())
+    return 0
+
+
+def cmd_events(args) -> int:
+    base = args.url.rstrip("/") + "/events"
+    since = 0
+    while True:
+        q = f"?since={since}" if since else f"?n={args.n}"
+        evs = json.loads(_get(base + q)).get("events", [])
+        for ev in evs:
+            print(json.dumps(ev))
+            since = max(since, ev.get("seq", since))
+        sys.stdout.flush()
+        if not args.follow:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_snapshot(args) -> int:
+    with open(args.path) as f:
+        text = f.read()
+    # accept either a bare JSON document or JSON-lines output (bench):
+    # pick the line carrying a metrics snapshot
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if obj.get("metric") == "metrics_snapshot" or \
+                    "snapshot" in obj.get("extra", {}):
+                doc = obj
+        if doc is None:
+            print("no metrics snapshot found", file=sys.stderr)
+            return 1
+    snap = doc
+    for key in ("extra", "snapshot", "metrics"):
+        if isinstance(snap, dict) and key in snap:
+            snap = snap[key]
+    print(_render_snapshot(snap))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("stats", help="pretty-print GET /stats")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_stats)
+    s = sub.add_parser("metrics", help="dump GET /metrics")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_metrics)
+    s = sub.add_parser("events", help="tail the event ring")
+    s.add_argument("url")
+    s.add_argument("-n", type=int, default=50,
+                   help="initial events to show")
+    s.add_argument("--follow", action="store_true",
+                   help="poll for new events")
+    s.add_argument("--interval", type=float, default=1.0)
+    s.set_defaults(fn=cmd_events)
+    s = sub.add_parser("snapshot",
+                       help="pretty-print a snapshot file")
+    s.add_argument("path")
+    s.set_defaults(fn=cmd_snapshot)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
