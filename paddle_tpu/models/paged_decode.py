@@ -36,7 +36,8 @@ import numpy as np
 from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn, _mm,
                              _rms_norm)
 
-__all__ = ["PagedKVCache", "make_paged_decode_step", "generate_paged",
+__all__ = ["PagedKVCache", "make_paged_decode_step",
+           "make_paged_decode_step_async", "generate_paged",
            "generate_auto"]
 
 
@@ -104,6 +105,10 @@ class PagedKVCache:
         self.tables = np.zeros((batch, pages_max), np.int32)
         self.lens = np.zeros((batch,), np.int32)
         self._owned = [[] for _ in range(batch)]
+        # bumped on every host-side ``tables`` mutation so callers
+        # keeping a device-resident copy (the dispatch-ahead serving
+        # loop) re-upload only when the block tables actually changed
+        self.tables_version = 0
         # PREFIX CACHING (vLLM-style, the sharing the reference's block
         # tables exist for): refcounted pages + an LRU index mapping a
         # full page's token-CHAIN key -> page id.  Only FULL pages are
@@ -219,6 +224,7 @@ class PagedKVCache:
         except RuntimeError:
             self.release_row(b)     # roll back the partial claim
             raise
+        self.tables_version += 1
         self.lens[b] = L
         if self.metrics is not None:
             self.metrics.prefix_hit_pages.inc(len(shared))
@@ -261,6 +267,7 @@ class PagedKVCache:
         except RuntimeError:
             self.release_row(b)     # roll back the partial claim
             raise
+        self.tables_version += 1
         self.lens[b] = length
 
     def ensure_capacity(self, b: int, new_tokens: int = 1) -> None:
@@ -276,6 +283,7 @@ class PagedKVCache:
             self.refs[pid] += 1
             self.tables[b, len(self._owned[b])] = pid
             self._owned[b].append(pid)
+            self.tables_version += 1
 
     def write_row_pages(self, slot: int, ks, vs, L: int,
                         first_page: int = 0) -> None:
@@ -319,6 +327,7 @@ class PagedKVCache:
         self._owned[b] = []
         self.tables[b] = 0
         self.lens[b] = 0
+        self.tables_version += 1
 
 
 def _rope_rows(x, theta, pos):
@@ -410,32 +419,13 @@ _step_cache: dict = {}
 _gen_cache: dict = {}
 
 
-def make_paged_decode_step(cfg: LlamaPretrainConfig,
-                           temperature: float = 0.0,
-                           kv_quant: Optional[str] = None,
-                           with_logits: bool = False,
-                           top_k: int = 0, top_p: float = 1.0):
-    """Jitted ``step(params, kpool, vpool, tables, lens, tok, key)
-    -> (kpool, vpool, next_tok)`` — or, with ``kv_quant="int8"``,
-    ``step(params, kpool, vpool, kscale, vscale, tables, lens, tok,
-    key) -> (kpool, vpool, kscale, vscale, next_tok)``.
-
-    ``lens [B]`` = cached context per row BEFORE this token (per-row —
-    continuous batching).  ``tok [B]`` = this step's input token.  The
-    new K/V land at per-row slot ``lens[b]``; callers bump ``lens`` and
-    the page tables on the host (PagedKVCache).
-
-    ``with_logits=True`` appends the f32 ``[B, V]`` logits to the
-    return tuple — the cache-quantisation acceptance harness bounds
-    int8-vs-fp LOGIT error directly instead of counting greedy token
-    agreement (round-4 verdict item 9).
-    """
+def _build_step_fns(cfg: LlamaPretrainConfig, temperature: float,
+                    with_logits: bool, top_k: int, top_p: float):
+    """Raw (unjitted) per-token step bodies ``(step, step_q8)`` —
+    shared by the synchronous factory below and the dispatch-ahead
+    :func:`make_paged_decode_step_async` wrapper (single source of the
+    decode-step math)."""
     dt = cfg.dtype
-
-    hit = _step_cache.get((_cfg_key(cfg), temperature, kv_quant,
-                           with_logits, top_k, top_p))
-    if hit is not None:
-        return hit
 
     def tail(x, params):
         h = _rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
@@ -489,6 +479,36 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
             return kpool, vpool, kscale, vscale, nxt, logits
         return kpool, vpool, kscale, vscale, nxt
 
+    return step, step_q8
+
+
+def make_paged_decode_step(cfg: LlamaPretrainConfig,
+                           temperature: float = 0.0,
+                           kv_quant: Optional[str] = None,
+                           with_logits: bool = False,
+                           top_k: int = 0, top_p: float = 1.0):
+    """Jitted ``step(params, kpool, vpool, tables, lens, tok, key)
+    -> (kpool, vpool, next_tok)`` — or, with ``kv_quant="int8"``,
+    ``step(params, kpool, vpool, kscale, vscale, tables, lens, tok,
+    key) -> (kpool, vpool, kscale, vscale, next_tok)``.
+
+    ``lens [B]`` = cached context per row BEFORE this token (per-row —
+    continuous batching).  ``tok [B]`` = this step's input token.  The
+    new K/V land at per-row slot ``lens[b]``; callers bump ``lens`` and
+    the page tables on the host (PagedKVCache).
+
+    ``with_logits=True`` appends the f32 ``[B, V]`` logits to the
+    return tuple — the cache-quantisation acceptance harness bounds
+    int8-vs-fp LOGIT error directly instead of counting greedy token
+    agreement (round-4 verdict item 9).
+    """
+    hit = _step_cache.get((_cfg_key(cfg), temperature, kv_quant,
+                           with_logits, top_k, top_p))
+    if hit is not None:
+        return hit
+
+    step, step_q8 = _build_step_fns(cfg, temperature, with_logits,
+                                    top_k, top_p)
     # memoised per (cfg, temperature, quant): jax.jit caches by function
     # identity, so returning a fresh closure every call would recompile
     # every generate
@@ -501,31 +521,105 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
     return fn
 
 
-_step_tp_cache: dict = {}
+_step_async_cache: dict = {}
 
 
-def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
-                              temperature: float = 0.0,
-                              kv_quant: Optional[str] = None,
-                              top_k: int = 0, top_p: float = 1.0):
-    """TENSOR-PARALLEL paged decode step: the whole per-token program is
-    ONE jitted shard_map over the mesh's ``mp`` axis — Megatron-sharded
-    weights (column q/k/v + gate/up, row wo/w_down with psum),
-    kv-head-sharded page pools, vocab-parallel embed/unembed with an
-    all-gather only on the final [B, V/mp] logits.  This is how a model
-    wider than one chip serves over the paged cache — the TPU-native
-    answer to the reference's fleet-executor DistModel::Run
-    (fluid/distributed/fleet_executor/dist_model.h:61).
+def make_paged_decode_step_async(cfg: LlamaPretrainConfig,
+                                 temperature: float = 0.0,
+                                 kv_quant: Optional[str] = None,
+                                 top_k: int = 0, top_p: float = 1.0,
+                                 mesh=None):
+    """Jitted DISPATCH-AHEAD decode step: the per-token program plus a
+    functional advance of the whole serving-loop state, so the engine
+    can chain step k's on-device outputs straight into step k+1's
+    dispatch with zero host round-trips.
 
-    The Pallas paged-attention kernel runs PER SHARD on local heads
-    (heads are embarrassingly parallel in attention), which is why this
-    is shard_map and not GSPMD auto-partitioning — XLA cannot split a
-    pallas_call.  Same signature/caller contract as
-    :func:`make_paged_decode_step`.
+    ``step(params, kpool, vpool, [kscale, vscale,] tables, lens, tok,
+    active, remaining, eos, key) -> (kpool, vpool, [kscale, vscale,]
+    nxt, lens', remaining', active', done)``
+
+    * rows advance only under ``active`` (bool [B]): ``lens``/
+      ``remaining`` update on-device, an inactive row keeps its token
+      (its pool write lands on a dead position — same as the
+      synchronous engine's idle rows);
+    * ``done`` [B] bool marks active rows that just hit ``eos`` (pass
+      -1 for "no eos") or exhausted their remaining-token budget — the
+      stop decision the host used to make after a blocking
+      ``np.asarray``;
+    * ``active' = active & ~done`` feeds the next dispatch, so a
+      finished row stops advancing one step later WITHOUT the host
+      ever having looked.
+
+    With ``mesh`` (mp>1) the inner per-token program is the TP
+    shard_map step; the state advance runs outside the shard_map on
+    replicated [B] vectors.  Multi-token stop SEQUENCES stay host-side
+    (the engine flushes its pipeline when one fires).
     """
+    q8 = kv_quant == "int8"
+    mesh_key = mesh if (mesh is not None
+                        and mesh.shape.get("mp", 1) > 1) else None
+    ckey = (_cfg_key(cfg), temperature, kv_quant, top_k, top_p,
+            mesh_key)
+    hit = _step_async_cache.get(ckey)
+    if hit is not None:
+        return hit
+
+    if mesh_key is not None:
+        base = _build_tp_inner(cfg, mesh, temperature, kv_quant,
+                               top_k, top_p)
+    else:
+        step, step_q8 = _build_step_fns(cfg, temperature, False,
+                                        top_k, top_p)
+        base = step_q8 if q8 else step
+
+    def advance(nxt, tok, lens, active, remaining, eos):
+        nxt = jnp.where(active, nxt, tok)
+        lens2 = lens + active.astype(lens.dtype)
+        rem2 = remaining - active.astype(remaining.dtype)
+        done = active & ((nxt == eos) | (rem2 <= 0))
+        return nxt, lens2, rem2, active & ~done, done
+
+    if q8:
+        def fn(params, kpool, vpool, kscale, vscale, tables, lens,
+               tok, active, remaining, eos, key):
+            kpool, vpool, kscale, vscale, nxt = base(
+                params, kpool, vpool, kscale, vscale, tables, lens,
+                tok, key)
+            nxt, lens2, rem2, act2, done = advance(
+                nxt, tok, lens, active, remaining, eos)
+            return (kpool, vpool, kscale, vscale, nxt, lens2, rem2,
+                    act2, done)
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+    else:
+        def fn(params, kpool, vpool, tables, lens, tok, active,
+               remaining, eos, key):
+            kpool, vpool, nxt = base(params, kpool, vpool, tables,
+                                     lens, tok, key)
+            nxt, lens2, rem2, act2, done = advance(
+                nxt, tok, lens, active, remaining, eos)
+            return kpool, vpool, nxt, lens2, rem2, act2, done
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+    _step_async_cache[ckey] = jitted
+    return jitted
+
+
+_step_tp_cache: dict = {}
+_tp_inner_cache: dict = {}
+
+
+def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
+                    temperature: float, kv_quant: Optional[str],
+                    top_k: int, top_p: float):
+    """Memoised UNJITTED shard_map per-token TP step — the sync
+    factory jits it directly; :func:`make_paged_decode_step_async`
+    composes the loop-state advance around it inside one outer jit.
+    Signature matches the single-device raw step (q8 variant inserts
+    the scale pools after ``vpool``)."""
     mp = mesh.shape["mp"]
-    hit = _step_tp_cache.get((_cfg_key(cfg), temperature, kv_quant,
-                              mesh, top_k, top_p))
+    ckey = (_cfg_key(cfg), temperature, kv_quant, mesh, top_k, top_p)
+    hit = _tp_inner_cache.get(ckey)
     if hit is not None:
         return hit
 
@@ -636,7 +730,6 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
             out_specs=(pool_spec, pool_spec, scale_spec, scale_spec,
                        P()),
             check_vma=False)
-        fn = jax.jit(inner, donate_argnums=(1, 2, 3, 4))
     else:
         def without_scales(params, kpool, vpool, tables, lens, tok,
                            key):
@@ -648,6 +741,39 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
                       P(), P(), P(), P()),
             out_specs=(pool_spec, pool_spec, P()),
             check_vma=False)
+    _tp_inner_cache[ckey] = inner
+    return inner
+
+
+def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
+                              temperature: float = 0.0,
+                              kv_quant: Optional[str] = None,
+                              top_k: int = 0, top_p: float = 1.0):
+    """TENSOR-PARALLEL paged decode step: the whole per-token program is
+    ONE jitted shard_map over the mesh's ``mp`` axis — Megatron-sharded
+    weights (column q/k/v + gate/up, row wo/w_down with psum),
+    kv-head-sharded page pools, vocab-parallel embed/unembed with an
+    all-gather only on the final [B, V/mp] logits.  This is how a model
+    wider than one chip serves over the paged cache — the TPU-native
+    answer to the reference's fleet-executor DistModel::Run
+    (fluid/distributed/fleet_executor/dist_model.h:61).
+
+    The Pallas paged-attention kernel runs PER SHARD on local heads
+    (heads are embarrassingly parallel in attention), which is why this
+    is shard_map and not GSPMD auto-partitioning — XLA cannot split a
+    pallas_call.  Same signature/caller contract as
+    :func:`make_paged_decode_step`.
+    """
+    hit = _step_tp_cache.get((_cfg_key(cfg), temperature, kv_quant,
+                              mesh, top_k, top_p))
+    if hit is not None:
+        return hit
+
+    inner = _build_tp_inner(cfg, mesh, temperature, kv_quant, top_k,
+                            top_p)
+    if kv_quant == "int8":
+        fn = jax.jit(inner, donate_argnums=(1, 2, 3, 4))
+    else:
         fn = jax.jit(inner, donate_argnums=(1, 2))
     _step_tp_cache[(_cfg_key(cfg), temperature, kv_quant, mesh,
                     top_k, top_p)] = fn

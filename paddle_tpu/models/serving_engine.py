@@ -34,6 +34,7 @@ from ..observability import (EngineMetrics, MetricsRegistry,
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            _pick_token, make_paged_decode_step,
+                           make_paged_decode_step_async,
                            make_paged_decode_step_tp)
 
 __all__ = ["ContinuousBatchingEngine", "Request"]
@@ -76,13 +77,28 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  mesh=None, top_k: int = 0, top_p: float = 1.0,
                  enable_prefix_caching: bool = False,
-                 metrics_registry=None, metrics_ring=None):
+                 metrics_registry=None, metrics_ring=None,
+                 overlap: bool = False, lookahead: int = 1):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
         shard_map program (make_paged_decode_step_tp); prefill rides
         GSPMD over the same sharded params.  A model wider than one
-        chip serves through the identical engine API."""
+        chip serves through the identical engine API.
+
+        ``overlap=True`` switches the decode hot loop to the
+        DISPATCH-AHEAD pipeline: loop state (next token, lens, active
+        mask, remaining budget, per-slot done) lives on the device and
+        advances functionally inside the jitted step; step k's
+        on-device outputs feed step k+1's dispatch directly, and the
+        host drains tokens/done masks one step behind (double-buffered
+        fetch), so admission/streaming/retirement bookkeeping overlaps
+        device compute.  Greedy output is token-exact vs the
+        synchronous loop; the pipeline flushes at every scheduler
+        mutation point (admission, preemption, stop-sequence
+        retirement).  ``lookahead`` is the number of dispatches the
+        device may run ahead of the host (1 = classic double
+        buffering)."""
         self.cfg = cfg
         self.params = params
         self.cache = cache
@@ -151,6 +167,28 @@ class ContinuousBatchingEngine:
                 top_k=top_k, top_p=top_p)
         self._next_tok = np.zeros((self.B,), np.int64)
         self._remaining = np.zeros((self.B,), np.int64)
+        # incremental ACTIVE-SLOT mask: maintained at admit / retire /
+        # preempt — the decode hot loop must never rebuild it per token
+        self._active_mask = np.zeros((self.B,), np.int32)
+        # -- dispatch-ahead pipeline (overlap=True) ---------------------
+        self.overlap = bool(overlap)
+        self.lookahead = max(1, int(lookahead))
+        self._step_async = None
+        if self.overlap:
+            self._step_async = make_paged_decode_step_async(
+                cfg, temperature, kv_quant=cache.kv_quant,
+                top_k=top_k, top_p=top_p, mesh=mesh)
+        self._inflight: List[Dict] = []   # oldest-first undrained steps
+        # active mask AT DISPATCH of the oldest undrained step (host
+        # attributes drained tokens against it, then chains done masks)
+        self._drain_active = np.zeros((self.B,), bool)
+        self._dev = None                  # chained device loop state
+        self._dev_tables_version = -1
+        self._needs_flush = False
+        self._eos_dev = jnp.asarray(
+            -1 if eos_id is None else int(eos_id), jnp.int32)
+        self.pipeline_flushes = 0         # mutation-point drains
+        self.host_syncs = 0               # blocking device->host fetches
 
     # -- client side ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
@@ -269,6 +307,7 @@ class ContinuousBatchingEngine:
         self._active[slot] = req
         self._next_tok[slot] = tok
         self._remaining[slot] = req.max_new_tokens - len(req.generated)
+        self._active_mask[slot] = 1
         if self._hit_stop(req, tok) or self._remaining[slot] <= 0:
             self._retire(slot)
 
@@ -412,7 +451,12 @@ class ContinuousBatchingEngine:
         self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
+        self._active_mask[slot] = 0
         self._queue.appendleft(req)
+        if self.overlap:
+            # the device-side active chain still carries the victim;
+            # re-seed loop state before the next dispatch
+            self._needs_flush = True
         return True
 
     def _retire(self, slot: int) -> None:
@@ -422,6 +466,7 @@ class ContinuousBatchingEngine:
         self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
+        self._active_mask[slot] = 0
         self.requests_finished += 1
         if self.metrics is not None:
             m = self.metrics
@@ -459,6 +504,10 @@ class ContinuousBatchingEngine:
                 break
             reserved += need
             admits.append((self._queue.popleft(), ctx))
+        if admits and self.overlap:
+            # admission is a scheduler mutation: drain the lookahead
+            # pipeline before slots/pages move under it
+            self._pipeline_flush()
         buckets: Dict[int, List] = {}
         for req, ctx in admits:
             L = len(ctx)
@@ -491,6 +540,14 @@ class ContinuousBatchingEngine:
         for slot in list(self._active):
             if slot not in self._active:     # evicted by an earlier turn
                 continue
+            if self._inflight and int(self.cache.lens[slot]) \
+                    // self.cache.page >= self.cache.pages_max:
+                # lens MIRROR past the row's table capacity: a live row
+                # can never get here (submit bounds its worst case), so
+                # this is a row that already retired on-device and
+                # whose undrained dispatches over-advanced the mirror —
+                # growing it would spuriously ValueError
+                continue
             while True:
                 try:
                     self.cache.ensure_capacity(slot, new_tokens)
@@ -498,6 +555,16 @@ class ContinuousBatchingEngine:
                         aux_cache.ensure_capacity(slot, aux_new)
                     break
                 except RuntimeError:
+                    if self._inflight:
+                        # drain the pipeline first: a pending on-device
+                        # retirement may free pages without preempting
+                        # anyone (and preempting under an in-flight
+                        # dispatch would hand its pages to the victim's
+                        # successor while stale writes are still queued)
+                        self._pipeline_flush()
+                        if slot not in self._active:
+                            break
+                        continue
                     # pool exhausted mid-flight: preempt the youngest
                     # other request (pages freed, request requeued)
                     # instead of crashing the engine and losing every
@@ -509,9 +576,17 @@ class ContinuousBatchingEngine:
                             "a single request of this length")
 
     def _decode_once(self) -> None:
-        """One decode dispatch advancing every active slot by one
-        token (the speculative subclass overrides this with a
-        draft+verify round)."""
+        """One decode round advancing every active slot (the
+        speculative subclass overrides this with a draft+verify
+        round): the synchronous dispatch-then-sync loop, or — with
+        ``overlap=True`` — one turn of the dispatch-ahead pipeline."""
+        if self.overlap:
+            self._decode_overlap()
+        else:
+            self._decode_sync()
+
+    def _decode_sync(self) -> None:
+        """One decode dispatch + blocking host round-trip."""
         cache = self.cache
         self._ensure_or_preempt()
         tables = jnp.asarray(cache.tables.copy())
@@ -527,11 +602,11 @@ class ContinuousBatchingEngine:
             cache.kpool, cache.vpool, nxt = self._step(
                 self.params, cache.kpool, cache.vpool, tables, lens,
                 tok, sub)
-        cache.lens = cache.lens + (np.asarray(
-            [1 if s in self._active else 0 for s in range(self.B)],
-            np.int32))
+        cache.lens = cache.lens + self._active_mask
         self.decode_steps += 1
         nxt = np.asarray(nxt)
+        self.host_syncs += 1
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
         advanced = 0
         for slot, req in list(self._active.items()):
             t = int(nxt[slot])
@@ -547,6 +622,149 @@ class ContinuousBatchingEngine:
         if self.metrics is not None:
             self.metrics.decode_steps.inc()
             self.metrics.tokens_generated.inc(advanced)
+            self.metrics.host_bookkeeping.observe(
+                time.perf_counter() - t0)
+
+    # -- dispatch-ahead pipeline (overlap=True) ---------------------------
+    def _decode_overlap(self) -> None:
+        """One turn of the one-step-lookahead pipeline: dispatch step
+        k chained off step k-1's ON-DEVICE outputs (no host sync),
+        THEN drain step k-1's token/done arrays while k runs — the
+        admission/streaming/retirement bookkeeping below overlaps
+        device compute instead of serialising with it."""
+        if self._needs_flush:
+            self._pipeline_flush()
+        if self._active:
+            # grow rows for the next write position.  The host lens
+            # mirror is exact for live rows; a row that already
+            # retired on-device but is not yet drained may
+            # over-allocate one page, released at retirement.
+            self._ensure_or_preempt()
+            if self._needs_flush:          # a preemption landed
+                self._pipeline_flush()
+            if self._active:
+                self._dispatch_async()
+        if self._active and len(self._inflight) > self.lookahead:
+            self._drain_one()
+        if not self._active and self._inflight:
+            # the batch just went idle: the lookahead dispatch(es)
+            # carry no live rows — drain them so the engine parks with
+            # an empty pipeline (depth gauge reads 0, the steps'
+            # device arrays unpin) instead of stranding them until the
+            # next admission's flush
+            while self._inflight:
+                self._drain_one()
+            self._dev = None
+
+    def _dispatch_async(self) -> None:
+        """Issue one decode step chained off the device-resident loop
+        state.  Zero blocking host work: uploads happen only when the
+        state was invalidated by a flush (or the block tables grew)."""
+        cache = self.cache
+        if self._dev is None:
+            # (re)seed device loop state from host truth
+            self._dev = {
+                "tables": jnp.asarray(cache.tables.copy()),
+                "lens": jnp.asarray(cache.lens.copy()),
+                "tok": jnp.asarray(self._next_tok.copy()),
+                "active": jnp.asarray(self._active_mask.astype(bool)),
+                "remaining": jnp.asarray(self._remaining.copy()),
+            }
+            self._dev_tables_version = cache.tables_version
+            self._drain_active = self._active_mask.astype(bool)
+        elif self._dev_tables_version != cache.tables_version:
+            # page growth: only the tables re-upload — the chained
+            # lens/tok/active/remaining stay device-resident
+            self._dev["tables"] = jnp.asarray(cache.tables.copy())
+            self._dev_tables_version = cache.tables_version
+        d = self._dev
+        self._key, sub = jax.random.split(self._key)
+        if cache.kv_quant == "int8":
+            (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
+             nxt, lens2, rem2, act2, done) = self._step_async(
+                self.params, cache.kpool, cache.vpool, cache.kscale,
+                cache.vscale, d["tables"], d["lens"], d["tok"],
+                d["active"], d["remaining"], self._eos_dev, sub)
+        else:
+            (cache.kpool, cache.vpool, nxt, lens2, rem2, act2,
+             done) = self._step_async(
+                self.params, cache.kpool, cache.vpool, d["tables"],
+                d["lens"], d["tok"], d["active"], d["remaining"],
+                self._eos_dev, sub)
+        d["lens"], d["tok"] = lens2, nxt
+        d["active"], d["remaining"] = act2, rem2
+        self._inflight.append({"nxt": nxt, "done": done})
+        self.decode_steps += 1
+        if self.metrics is not None:
+            self.metrics.decode_steps.inc()
+        # advance the host lens mirror for the NEXT dispatch's
+        # capacity check (exact for live rows; self-healing for
+        # device-retired rows — their release zeroes the entry)
+        cache.lens = cache.lens + self._active_mask
+
+    def _fetch(self, *arrs):
+        """Blocking device->host fetch — the pipeline's ONLY sync
+        point, one call per drained step (tests count calls and their
+        ordering vs dispatches through this seam)."""
+        self.host_syncs += 1
+        return [np.asarray(a) for a in arrs]
+
+    def _drain_one(self) -> None:
+        """Sync on the OLDEST in-flight step's outputs (by then the
+        next step is already running on-device) and run the per-token
+        host bookkeeping: streaming, lifecycle timestamps, retirement.
+        Multi-token stop sequences are only visible here — hitting one
+        retires the request and schedules a pipeline flush, since the
+        device-side active chain cannot know about it."""
+        e = self._inflight.pop(0)
+        nxt, done = self._fetch(e["nxt"], e["done"])
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
+        mask = self._drain_active
+        advanced = 0
+        for slot in np.nonzero(mask)[0]:
+            slot = int(slot)
+            req = self._active.get(slot)
+            if req is None:
+                # host-retired (stop sequence) after this step was
+                # dispatched: its token is dead, and the scheduled
+                # flush keeps the slot from being reused under it
+                continue
+            t = int(nxt[slot])
+            req.generated.append(t)
+            self.tokens_generated += 1
+            advanced += 1
+            self._note_first_token(req)
+            self._stream.append((req.rid, t))
+            self._next_tok[slot] = t
+            self._remaining[slot] -= 1
+            if done[slot]:
+                self._retire(slot)          # eos / budget (on-device)
+            elif self._hit_stop(req, t):
+                self._retire(slot)          # stop sequence (host-only)
+                self._needs_flush = True
+        # follow the DEVICE active chain: the next undrained step ran
+        # with active & ~done (host-only retirements are excluded by
+        # the _active lookup above until the flush lands)
+        self._drain_active = mask & ~done.astype(bool)
+        if self.metrics is not None:
+            self.metrics.tokens_generated.inc(advanced)
+            self.metrics.host_bookkeeping.observe(
+                time.perf_counter() - t0)
+
+    def _pipeline_flush(self) -> None:
+        """Drain every in-flight dispatch and invalidate the
+        device-resident loop state.  Called at every scheduler
+        mutation point — admission, preemption, stop-sequence
+        retirement — after which the host arrays are authoritative
+        and the next dispatch re-seeds the device from them."""
+        if not self._inflight and self._dev is None \
+                and not self._needs_flush:
+            return
+        while self._inflight:
+            self._drain_one()
+        self._dev = None
+        self._needs_flush = False
+        self.pipeline_flushes += 1
 
     def run_to_completion(self, max_steps: int = 10_000):
         """Drive until the queue drains; returns all finished requests

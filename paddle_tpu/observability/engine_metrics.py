@@ -29,6 +29,12 @@ _STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 # per-token cadence (TPOT): 100us .. 2.5s
 _TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# host bookkeeping per decode step: 10us .. 1s (pure Python work —
+# far below the dispatch buckets; the overlap ratio
+# host_bookkeeping.sum / decode_step.sum needs resolution down here)
+_HOST_BUCKETS = (0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+                 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 1.0)
 
 
 class EngineMetrics:
@@ -89,6 +95,17 @@ class EngineMetrics:
         self.prefill_chunks = r.counter(
             "paddle_tpu_engine_prefill_chunks_total",
             "Chunks processed by chunked-prefill admissions")
+        self.host_bookkeeping = r.histogram(
+            "paddle_tpu_engine_host_bookkeeping_seconds",
+            "Host-side scheduling/streaming bookkeeping per decode "
+            "step (overlap mode hides this behind the in-flight "
+            "dispatch; sum/decode_step_seconds.sum is the host "
+            "overhead fraction)",
+            buckets=_HOST_BUCKETS)
+        self.inflight_dispatches = r.gauge(
+            "paddle_tpu_engine_inflight_dispatches_count",
+            "Decode dispatches issued but not yet drained by the "
+            "host (dispatch-ahead serving pipeline depth)")
         self.batch_occupancy = r.gauge(
             "paddle_tpu_engine_batch_occupancy_ratio",
             "Active slots / decode batch size")
@@ -152,6 +169,9 @@ def bind_engine_gauges(m: EngineMetrics, engine) -> None:
         _weak_fn(engine, lambda e: float(len(e._queue))))
     m.batch_occupancy.set_function(
         _weak_fn(engine, lambda e: len(e._active) / e.B))
+    m.inflight_dispatches.set_function(
+        _weak_fn(engine,
+                 lambda e: float(len(getattr(e, "_inflight", ())))))
     m.kv_free_pages.set_function(
         _weak_fn(cache, lambda c: float(c.free_pages())))
     usable = max(cache.num_pages - 1, 1)       # page 0 reserved

@@ -109,10 +109,17 @@ def cmd_snapshot(args) -> int:
             print("no metrics snapshot found", file=sys.stderr)
             return 1
     snap = doc
+    frac = None
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
+            if isinstance(snap.get("host_overhead_frac"), (int, float)):
+                frac = snap["host_overhead_frac"]
             snap = snap[key]
     print(_render_snapshot(snap))
+    if frac is not None:
+        # host bookkeeping / decode wall — the fraction the
+        # dispatch-ahead serving pipeline overlaps away
+        print(f"host_overhead_frac = {frac:.4g}")
     return 0
 
 
