@@ -522,6 +522,114 @@ def _serving_run(overlap: bool) -> dict:
     }
 
 
+def _admission_line() -> dict:
+    """Packed-vs-batched ADMISSION A/B on a mixed-length arrival
+    trace: the same prompts admit through the batched per-bucket lane
+    (``packed=False`` — one dense [K_pow2, Lp] dispatch per length
+    bucket per wave) and the packed varlen lane (one segmented-flash
+    dispatch per wave, padding only the sub-bucket remainder).  Per
+    side: ``prefill_calls`` for the admission wave,
+    ``padded_token_frac`` (dispatched prefill slots carrying no real
+    context), ``admission_ms`` (wall of the step() that admits the
+    whole wave), and steady-state decode tok/s to pin the
+    no-regression criterion.  ``value`` is the batched/packed
+    admission-wall ratio (>1 = packed faster)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, new, page = 8, 32, 64
+        num_pages, pages_max = 96, 16
+        # mixed-length arrival trace: a long-tail spread across four
+        # length buckets — the batched lane pays one dispatch each
+        trace = [640, 64, 96, 500, 128, 72, 320, 200]
+        metric = "serving_admission_packed_vs_batched"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, new, page = 4, 8, 16
+        num_pages, pages_max = 64, 8
+        trace = [100, 5, 9, 12]
+        metric = "serving_admission_tiny_cpu_smoke_packed_vs_batched"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (L,)) for L in trace]
+
+    def run(packed):
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page)
+        eng = ContinuousBatchingEngine(cfg, params, cache,
+                                       metrics_registry=False,
+                                       packed=packed)
+        # warm every compile the timed wave will hit (same shape mix)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=2)
+        eng.run_to_completion()
+        calls0 = eng.prefill_calls
+        slots0, padded0 = eng.prefill_token_slots, \
+            eng.prefill_padded_tokens
+        for p in prompts:
+            eng.submit(p, max_new_tokens=new)
+        t0 = time.perf_counter()
+        eng.step()                    # the admission wave (+1 decode)
+        admission_ms = (time.perf_counter() - t0) * 1000
+        waves = 1
+        while eng._queue:             # batch smaller than the trace:
+            eng.step()                # later waves admit as slots free
+            waves += 1
+        t1 = time.perf_counter()
+        done = eng.run_to_completion()
+        decode_s = time.perf_counter() - t1
+        slots = eng.prefill_token_slots - slots0
+        return {
+            "prefill_calls": eng.prefill_calls - calls0,
+            "admission_waves": waves,
+            "padded_token_frac": round(
+                (eng.prefill_padded_tokens - padded0) / max(slots, 1),
+                4),
+            "admission_ms": round(admission_ms, 2),
+            "decode_tok_per_s": round(
+                sum(len(r.generated) for r in done)
+                / max(decode_s + admission_ms / 1000, 1e-9), 1),
+        }
+
+    batched = run(False)
+    packed = run(True)
+    speed = batched["admission_ms"] / max(packed["admission_ms"], 1e-9)
+    return {
+        "metric": metric,
+        "value": round(speed, 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {"platform": platform, "trace_lens": trace,
+                  "batch_slots": batch, "batched": batched,
+                  "packed": packed},
+    }
+
+
 def _serving_line() -> dict:
     return _serving_run(overlap=False)
 
@@ -542,10 +650,19 @@ def _snapshot_line() -> dict:
     host = snap.get("paddle_tpu_engine_host_bookkeeping_seconds") or {}
     dec = snap.get("paddle_tpu_engine_decode_step_seconds") or {}
     frac = (host.get("sum", 0.0) / dec["sum"]) if dec.get("sum") else 0.0
+    # padding waste across packed admission waves: wasted prefill
+    # slots / dispatched packed-stream slots (registry-visible engines
+    # admit packed by default; tools/metrics_dump.py prints this)
+    padded = snap.get(
+        "paddle_tpu_engine_prefill_padded_tokens_total") or {}
+    packed = snap.get("paddle_tpu_engine_prefill_packed_tokens") or {}
+    pfrac = ((padded.get("value") or 0.0) / packed["sum"]) \
+        if packed.get("sum") else 0.0
     return {"metric": "metrics_snapshot", "value": len(snap),
             "unit": "metrics", "vs_baseline": 0,
             "extra": {"snapshot": snap,
                       "host_overhead_frac": round(frac, 4),
+                      "prefill_padded_token_frac": round(pfrac, 4),
                       "events": default_ring().recent(50)}}
 
 
@@ -560,6 +677,7 @@ def main() -> None:
          _serving_line),
         ("serving_engine_overlap_decode_tokens_per_sec", "tokens/s",
          _serving_overlap_line),
+        ("serving_admission_packed_vs_batched", "x", _admission_line),
     ]
 
     devs, err = _init_devices()

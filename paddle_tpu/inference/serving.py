@@ -286,6 +286,10 @@ def predict_http(url: str, inputs: List[np.ndarray],
 # ---------------------------------------------------------------------------
 class _GenHandler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu-genserving/0.1"
+    # chunked Transfer-Encoding (the /generate_stream response) only
+    # exists in HTTP/1.1 — the BaseHTTPRequestHandler default of
+    # HTTP/1.0 made curl/proxies treat the raw chunk framing as body
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):
         pass
@@ -552,7 +556,12 @@ def generate_http(url: str, prompt, max_new_tokens: int = 64,
 
 def generate_http_stream(url: str, prompt, max_new_tokens: int = 64,
                          timeout: float = 120.0):
-    """Streaming client: yields tokens as the server emits them."""
+    """Streaming client: yields tokens as the server emits them.
+
+    Raises ``RuntimeError`` when the terminal ``done`` message carries
+    an ``error`` (engine crash mid-request) — a silently truncated
+    generation is indistinguishable from a complete one to the caller.
+    """
     import urllib.request
     body = json.dumps({"prompt": [int(t) for t in prompt],
                        "max_new_tokens": max_new_tokens}).encode()
@@ -565,5 +574,8 @@ def generate_http_stream(url: str, prompt, max_new_tokens: int = 64,
                 continue
             msg = json.loads(line)
             if msg.get("done"):
+                if msg.get("error"):
+                    raise RuntimeError(
+                        f"generation failed mid-stream: {msg['error']}")
                 return
             yield msg["token"]

@@ -993,6 +993,112 @@ def _prefill_chunk(cfg: LlamaPretrainConfig, q8: bool):
     return run
 
 
+_packed_prefill_cache: dict = {}
+
+
+def _prefill_packed(cfg: LlamaPretrainConfig, q8: bool,
+                    with_hist: bool):
+    """Memoised jitted PACKED VARLEN prefill: every waiting context —
+    mixed lengths, prefix-cache suffixes, long prompts — packs into ONE
+    ``[1, T]`` token stream with segment ids and prefills as a single
+    program (the serving-admission form of the segmented flash kernel;
+    FLUX-style dispatch fusion: K per-bucket dispatches become one).
+
+    ``run(params, toks [1, T], seg [1, T], pos [1, T], kpool, vpool,
+    kscale, vscale, hist_page [T], hist_slot [T], pool_hist [T],
+    stream_src [T], stream_hist [T]) -> (x [1, T, H], ks, vs
+    [Lyr, T, nkv, d])``
+
+    * ``seg``: int32 contiguous runs, one id per request (bucket-tail
+      padding rides a sentinel id and attends only itself);
+    * ``pos``: within-segment RoPE positions (a prefix-cache suffix
+      starts at its reused offset);
+    * attention is segment-masked causal: the block-skipping Pallas
+      kernel (ops/pallas/flash_varlen.py) on TPU when a block divides
+      ``T``, an XLA segment-masked ``_grouped_attn`` otherwise
+      (CPU/interpret fallback — same masked-softmax numerics as the
+      dense ``_prefill``, so greedy outputs stay token-exact);
+    * ``with_hist`` compiles the PREFIX-CACHE lane: ``pool_hist`` slots
+      take their K/V from cached pool pages (``hist_page``/``hist_slot``
+      — already RoPE'd at write time; int8 pools dequant via the
+      gathered scales), ``stream_hist`` slots from the stream itself at
+      ``stream_src`` (a page being written by an earlier segment of the
+      SAME wave — its pool copy lands only after this program returns).
+      History slots contribute K/V only; their q rows are dead weight
+      the caller never reads.
+    """
+    hit = _packed_prefill_cache.get((_cfg_key(cfg), q8, with_hist))
+    if hit is not None:
+        return hit
+    from .decode import _grouped_attn
+    from ..ops.pallas.flash_attention import _interpret, _pick_blocks
+    from ..ops.pallas.flash_varlen import flash_attention_segmented
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    @jax.jit
+    def run(params, toks, seg, pos, kpool, vpool, kscale, vscale,
+            hist_page, hist_slot, pool_hist, stream_src, stream_hist):
+        B, T = toks.shape                      # B == 1
+        x = jnp.take(params["embed"], toks, axis=0).astype(dt)
+        # static routing (trace-time): the Pallas kernel's block
+        # skipping needs a dividing block and a real TPU; otherwise the
+        # XLA mask keeps bitwise parity with the dense prefill path
+        use_kernel = (not _interpret()) and _pick_blocks(T) is not None
+        if not use_kernel:
+            idx = jnp.arange(T, dtype=jnp.int32)
+            # segments are contiguous runs, so global causal ==
+            # within-segment causal
+            mask = ((seg[0][:, None] == seg[0][None, :])
+                    & (idx[:, None] >= idx[None, :]))[None, None, None]
+
+        def layer(carry, inp):
+            if q8:
+                bp, kp_l, vp_l, ks_l, vs_l = inp
+            else:
+                bp, kp_l, vp_l = inp
+                ks_l = vs_l = None
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, T, n, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, T, nkv, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, T, nkv, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            if with_hist:
+                kh = kp_l[hist_page, :, hist_slot]     # [T, nkv, d]
+                vh = vp_l[hist_page, :, hist_slot]
+                if q8:
+                    kh = (kh.astype(jnp.float32)
+                          * ks_l[hist_page, :, hist_slot][..., None])
+                    vh = (vh.astype(jnp.float32)
+                          * vs_l[hist_page, :, hist_slot][..., None])
+                sel = pool_hist[None, :, None, None]
+                k = jnp.where(sel, kh.astype(dt)[None], k)
+                v = jnp.where(sel, vh.astype(dt)[None], v)
+                sel2 = stream_hist[None, :, None, None]
+                k = jnp.where(sel2, k[:, stream_src], k)
+                v = jnp.where(sel2, v[:, stream_src], v)
+            if use_kernel:
+                attn = flash_attention_segmented(q, k, v, seg,
+                                                 causal=True)
+            else:
+                attn = _grouped_attn(q, k, v, mask)
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k[0], v[0])
+
+        xs = (params["blocks"], kpool, vpool)
+        if q8:
+            xs = xs + (kscale, vscale)
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
+        return x, ks, vs
+
+    _packed_prefill_cache[(_cfg_key(cfg), q8, with_hist)] = run
+    return run
+
+
 _chunk_b_cache: dict = {}
 
 

@@ -9,10 +9,13 @@ block_multihead_attention; fleet_executor dist_model serving).
 TPU-native shape: the decode batch is FIXED SIZE (one compiled step
 serves forever — no retracing as requests come and go); per-row block
 tables + lengths make rows independent, so a slot is just (table row,
-lens entry).  Admission prefills the new request alone (one jitted
-prefill per distinct prompt-length bucket) and writes its pages; the
-shared per-token step then advances every active slot.  Inactive slots
-carry ``lens = 0`` and attend nothing (the kernel visits zero pages).
+lens entry).  Admission packs every waiting prompt — mixed lengths,
+prefix-cache suffixes — into ONE token stream with segment ids and
+prefills it as a single segmented-flash program (the packed varlen
+lane; the per-bucket batched and per-chunk lanes remain for TP and as
+explicit fallbacks); the shared per-token step then advances every
+active slot.  Inactive slots carry ``lens = 0`` and attend nothing
+(the kernel visits zero pages).
 
 The engine is deliberately host-simple: a queue, a free-slot list, and
 numpy bookkeeping — the device work is the two jitted programs.
@@ -33,7 +36,8 @@ from ..observability import (EngineMetrics, MetricsRegistry,
                              bind_engine_gauges)
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
-                           _pick_token, make_paged_decode_step,
+                           _prefill_packed, _pick_token,
+                           make_paged_decode_step,
                            make_paged_decode_step_async,
                            make_paged_decode_step_tp)
 
@@ -78,7 +82,8 @@ class ContinuousBatchingEngine:
                  mesh=None, top_k: int = 0, top_p: float = 1.0,
                  enable_prefix_caching: bool = False,
                  metrics_registry=None, metrics_ring=None,
-                 overlap: bool = False, lookahead: int = 1):
+                 overlap: bool = False, lookahead: int = 1,
+                 packed: bool = True):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -98,7 +103,17 @@ class ContinuousBatchingEngine:
         mutation point (admission, preemption, stop-sequence
         retirement).  ``lookahead`` is the number of dispatches the
         device may run ahead of the host (1 = classic double
-        buffering)."""
+        buffering).
+
+        ``packed=True`` (default) admits through the PACKED VARLEN
+        prefill lane: every waiting context — any length mix,
+        prefix-cache suffixes included — packs into one ``[T_bucket]``
+        token stream with segment ids and prefills as exactly ONE
+        jitted segmented-flash program per admission wave (compile
+        count O(log total-token-buckets), padded-token waste only the
+        sub-bucket remainder).  TP engines (mp>1) fall back to the
+        batched per-bucket path for now; ``packed=False`` forces the
+        batched/chunked lanes everywhere."""
         self.cfg = cfg
         self.params = params
         self.cache = cache
@@ -123,8 +138,18 @@ class ContinuousBatchingEngine:
         # the chunked path so rows can start at a reused offset
         self.enable_prefix_caching = enable_prefix_caching
         # program dispatches for admission, observable for the
-        # sublinearity contract (K same-bucket admits = ONE dispatch)
+        # sublinearity contract (K same-bucket admits = ONE dispatch;
+        # packed lane: ANY-mix wave = ONE dispatch)
         self.prefill_calls = 0
+        # PACKED VARLEN admission (single-device only: the packed
+        # program is not shard_mapped yet — TP rides the batched path)
+        self._packed = bool(packed) and (
+            mesh is None or mesh.shape.get("mp", 1) == 1)
+        # padding-waste accounting across ALL prefill lanes: dispatched
+        # token slots vs slots that carried no real context token
+        # (bucket/page padding) — bench.py's admission A/B reads these
+        self.prefill_token_slots = 0
+        self.prefill_padded_tokens = 0
         # serving counters (surfaced by GenerationServer /health)
         self.decode_steps = 0
         self.tokens_generated = 0
@@ -203,6 +228,14 @@ class ContinuousBatchingEngine:
         strings — the eos_id generalisation every serving product
         needs; checked on the host, costs nothing compiled)."""
         prompt = np.asarray(prompt, np.int64)
+        if prompt.size == 0:
+            # an empty prompt has no last-position logits to sample a
+            # first token from: admitted, it would corrupt page 0 K/V
+            # (batched path) or kill the engine thread mid-step —
+            # reject HERE so one bad client request costs only itself
+            raise ValueError(
+                "prompt must contain at least one token (empty "
+                "prompts cannot be admitted)")
         # bound by BOTH the row's table width and the whole pool (page
         # 0 is reserved): a request the pool can never hold even alone
         # would wedge the engine — preemption has no victim to free
@@ -339,8 +372,12 @@ class ContinuousBatchingEngine:
             padded[i, :Ls[i]] = ctx
         x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
         self.prefill_calls += 1
+        waste = Kp * Lp - sum(Ls)
+        self.prefill_token_slots += Kp * Lp
+        self.prefill_padded_tokens += waste
         if self.metrics is not None:
             self.metrics.prefill_dispatches.inc()
+            self.metrics.prefill_padded_tokens.inc(waste)
         for i, (req, slot, L) in enumerate(zip(reqs, slots, Ls)):
             self.cache.write_row_pages(slot, ks[:, i], vs[:, i], L)
         toks = None
@@ -407,9 +444,13 @@ class ContinuousBatchingEngine:
                                        first_page=pos // page)
             last_real = C_real
             pos += C_real
+        waste = nchunks * chunk - (L - start)
+        self.prefill_token_slots += nchunks * chunk
+        self.prefill_padded_tokens += waste
         if self.metrics is not None and nchunks:
             self.metrics.prefill_dispatches.inc(nchunks)
             self.metrics.prefill_chunks.inc(nchunks)
+            self.metrics.prefill_padded_tokens.inc(waste)
         if req.generated:                        # resume after preempt
             tok = req.generated[-1]
         else:
@@ -429,6 +470,135 @@ class ContinuousBatchingEngine:
             # tokens would pollute the index)
             self.cache.register_prefix(slot, req.prompt)
         self._finish_admit(req, slot, tok)
+
+    def _packed_bucket(self, T: int) -> int:
+        """Round a packed-stream length up to a power-of-two number of
+        prefill buckets: compile count stays O(log total-token-buckets)
+        and padded-token waste is bounded by the sub-bucket remainder
+        of the LAST doubling, not per-request padding."""
+        n = -(-T // self.prefill_bucket)
+        return self.prefill_bucket * (1 << (n - 1).bit_length())
+
+    def _admit_packed(self, group: List) -> None:
+        """PACKED VARLEN admission: every waiting context — mixed
+        lengths, prefix-cache suffixes, long prompts, preemption
+        resumes — packs into ONE ``[T_bucket]`` token stream with
+        segment ids and prefills as exactly ONE jitted segmented-flash
+        program (``_prefill_packed``), replacing the K per-bucket
+        dense dispatches of :meth:`_admit_batch` and the per-chunk
+        loop of :meth:`_admit_chunked`.  Per-segment K/V scatter into
+        each request's pages lands at page-aligned offsets (suffixes
+        start on a page boundary because reused prefixes are whole
+        pages); int8 caches quantise on write.  Each segment's LAST
+        real position's hidden state feeds one shared logits tail for
+        the first sampled token — same eager tail as the batched path,
+        so greedy outputs are token-exact across lanes."""
+        page = self.cache.page
+        K = len(group)
+        plan = []        # (req, ctx, slot, start, s_real, Wp, off)
+        wave_src: Dict[int, int] = {}   # page id -> stream index of
+        #   its first token, for pages WRITTEN by this wave (a same-
+        #   wave prefix sharer must read them from the stream — their
+        #   pool copy lands only after the program returns)
+        T = 0
+        for req, ctx in group:
+            slot = self._free_slots.pop()
+            L = len(ctx)
+            if self.enable_prefix_caching:
+                start = self.cache.alloc_row_prefix(slot, ctx)
+            else:
+                self.cache.alloc_row(slot, L)
+                start = 0
+            s_real = L - start
+            Wp = -(-s_real // page) * page   # page-pad the suffix so
+            #   write_row_pages sees whole pages
+            off = T
+            T += start + Wp
+            plan.append((req, ctx, slot, start, s_real, Wp, off))
+            for j in range(start // page, (start + Wp) // page):
+                wave_src[int(self.cache.tables[slot, j])] = off + j * page
+            if self.enable_prefix_caching:
+                # register BEFORE later same-wave allocs so equal
+                # prefixes share within one wave (index entries are
+                # valid immediately; page CONTENT lands with this
+                # wave's write — same-wave readers resolve in-stream)
+                self.cache.register_prefix(slot, req.prompt)
+        Tb = self._packed_bucket(T)
+        toks = np.zeros((1, Tb), np.int64)
+        seg = np.full((1, Tb), K, np.int32)      # sentinel tail id
+        pos = np.zeros((1, Tb), np.int32)
+        hist_page = np.zeros((Tb,), np.int32)
+        hist_slot = np.zeros((Tb,), np.int32)
+        pool_hist = np.zeros((Tb,), bool)
+        stream_src = np.zeros((Tb,), np.int32)
+        stream_hist = np.zeros((Tb,), bool)
+        for i, (req, ctx, slot, start, s_real, Wp, off) in \
+                enumerate(plan):
+            W = start + Wp
+            seg[0, off:off + W] = i
+            pos[0, off:off + W] = np.arange(W)
+            toks[0, off + start:off + start + s_real] = ctx[start:]
+            for j in range(start // page):       # reused prefix pages
+                pid = int(self.cache.tables[slot, j])
+                a = off + j * page
+                src = wave_src.get(pid)
+                if src is not None and src < off:
+                    stream_src[a:a + page] = src + np.arange(page)
+                    stream_hist[a:a + page] = True
+                else:
+                    hist_page[a:a + page] = pid
+                    hist_slot[a:a + page] = np.arange(page)
+                    pool_hist[a:a + page] = True
+        q8 = self.cache.kv_quant == "int8"
+        run = _prefill_packed(self.cfg, q8, self.enable_prefix_caching)
+        dummy = jnp.zeros((1,), jnp.float32)
+        x, ks, vs = run(
+            self.params, jnp.asarray(toks), jnp.asarray(seg),
+            jnp.asarray(pos), self.cache.kpool, self.cache.vpool,
+            self.cache.kscale if q8 else dummy,
+            self.cache.vscale if q8 else dummy,
+            jnp.asarray(hist_page), jnp.asarray(hist_slot),
+            jnp.asarray(pool_hist), jnp.asarray(stream_src),
+            jnp.asarray(stream_hist))
+        self.prefill_calls += 1
+        real = sum(start + s_real
+                   for _, _, _, start, s_real, _, _ in plan)
+        self.prefill_token_slots += Tb
+        self.prefill_padded_tokens += Tb - real
+        if self.metrics is not None:
+            self.metrics.prefill_dispatches.inc()
+            self.metrics.prefill_padded_tokens.inc(Tb - real)
+            self.metrics.prefill_packed_tokens.observe(Tb)
+        for req, ctx, slot, start, s_real, Wp, off in plan:
+            a = off + start
+            self.cache.write_row_pages(
+                slot, ks[:, a:a + Wp], vs[:, a:a + Wp], s_real,
+                first_page=start // page)
+        reqs = [p[0] for p in plan]
+        toks_out = None
+        if any(not r.generated for r in reqs):
+            # batched first tokens from each segment's LAST real
+            # position — skipped for an all-resume wave (saved tokens;
+            # sampling would burn a PRNG split for nothing)
+            last = jnp.asarray([off + start + s_real - 1
+                                for _, _, _, start, s_real, _, off
+                                in plan])
+            h = _rms_norm(x[0, last], self.params["final_norm"],
+                          self.cfg.rms_norm_eps)
+            logits = _mm(h, self.params["lm_head"],
+                         self.cfg.dtype).astype(jnp.float32)
+            self._key, sub = jax.random.split(self._key)
+            toks_out = np.asarray(_pick_token(
+                logits, self.temperature, sub, self.top_k, self.top_p))
+        for i, (req, ctx, slot, start, s_real, Wp, off) in \
+                enumerate(plan):
+            if req.generated:                    # resume after preempt
+                tok = req.generated[-1]
+            else:
+                tok = int(toks_out[i])
+                req.generated.append(tok)
+                self._stream.append((req.rid, tok))
+            self._finish_admit(req, slot, tok)
 
     def _preempt(self, keep: int) -> bool:
         """Evict the most recently admitted active request (except slot
@@ -508,19 +678,26 @@ class ContinuousBatchingEngine:
             # admission is a scheduler mutation: drain the lookahead
             # pipeline before slots/pages move under it
             self._pipeline_flush()
-        buckets: Dict[int, List] = {}
-        for req, ctx in admits:
-            L = len(ctx)
-            if self.enable_prefix_caching or (
-                    self.prefill_chunk is not None
-                    and L > self.prefill_chunk):
-                self._admit_chunked(req, ctx)
-                continue
-            Lp = ((L + self.prefill_bucket - 1) //
-                  self.prefill_bucket) * self.prefill_bucket
-            buckets.setdefault(Lp, []).append((req, ctx))
-        for group in buckets.values():
-            self._admit_batch(group)
+        if admits and self._packed:
+            # PACKED VARLEN lane: any length mix (prefix-cache
+            # suffixes, long prompts, resumes) is ONE dispatch per
+            # wave — prefill_chunk is moot here, the per-wave cost is
+            # bounded by the total waiting tokens, not per prompt
+            self._admit_packed(admits)
+        else:
+            buckets: Dict[int, List] = {}
+            for req, ctx in admits:
+                L = len(ctx)
+                if self.enable_prefix_caching or (
+                        self.prefill_chunk is not None
+                        and L > self.prefill_chunk):
+                    self._admit_chunked(req, ctx)
+                    continue
+                Lp = ((L + self.prefill_bucket - 1) //
+                      self.prefill_bucket) * self.prefill_bucket
+                buckets.setdefault(Lp, []).append((req, ctx))
+            for group in buckets.values():
+                self._admit_batch(group)
         if not self._active:
             return 0
         if self.metrics is None:

@@ -29,6 +29,10 @@ _STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 # per-token cadence (TPOT): 100us .. 2.5s
 _TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# packed-prefill stream sizes: one prefill bucket .. long-context
+# admission waves (token counts, powers of two like the bucketing)
+_PACKED_BUCKETS = (64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
+                   8192.0, 16384.0, 32768.0, 65536.0, 131072.0)
 # host bookkeeping per decode step: 10us .. 1s (pure Python work —
 # far below the dispatch buckets; the overlap ratio
 # host_bookkeeping.sum / decode_step.sum needs resolution down here)
@@ -95,6 +99,15 @@ class EngineMetrics:
         self.prefill_chunks = r.counter(
             "paddle_tpu_engine_prefill_chunks_total",
             "Chunks processed by chunked-prefill admissions")
+        self.prefill_padded_tokens = r.counter(
+            "paddle_tpu_engine_prefill_padded_tokens_total",
+            "Dispatched prefill token slots that carried no real "
+            "context token (bucket/page padding waste, all lanes)")
+        self.prefill_packed_tokens = r.histogram(
+            "paddle_tpu_engine_prefill_packed_tokens",
+            "Packed-stream token slots per packed admission wave "
+            "(one sample per packed prefill dispatch)",
+            buckets=_PACKED_BUCKETS)
         self.host_bookkeeping = r.histogram(
             "paddle_tpu_engine_host_bookkeeping_seconds",
             "Host-side scheduling/streaming bookkeeping per decode "

@@ -291,8 +291,12 @@ def test_engine_metrics_preemption_counter():
 
 
 def test_engine_metrics_prefix_cache_hits():
+    # packed=False: this test pins the CHUNKED prefix-caching lane's
+    # instruments (prefill_chunks_total); the packed lane admits in
+    # one dispatch and has its own instrument tests
+    # (tests/test_packed_prefill.py)
     reg = MetricsRegistry()
-    eng = _engine(reg, enable_prefix_caching=True)
+    eng = _engine(reg, enable_prefix_caching=True, packed=False)
     rng = np.random.RandomState(9)
     prefix = rng.randint(1, 128, (32,))        # two full 16-tok pages
     eng.submit(prefix, max_new_tokens=3)

@@ -1,0 +1,412 @@
+"""Packed varlen prefill admission (the serving-admission form of the
+segmented flash kernel): every waiting prompt — ANY length mix,
+prefix-cache suffixes, long prompts, preemption resumes — packs into
+one token stream with segment ids and prefills as exactly ONE jitted
+program per admission wave.
+
+Contract under test:
+* ONE prefill dispatch per admission wave regardless of the length mix
+  (pinned through the ``prefill_calls`` counter — the batched lane
+  pays one per bucket, the chunked lane one per chunk);
+* GREEDY TOKEN-EXACTNESS vs the batched/chunked lanes and solo dense
+  runs across mixed lengths, prefix-cache suffixes (same-wave AND
+  cross-wave sharing), chunked-long-prompt configs, int8 KV pools, and
+  ``overlap=True``;
+* padded-token waste (dispatched slots carrying no context) drops vs
+  the batched lane and is observable (host counters + registry);
+* the serving-front fixes that ride along: empty-prompt rejection,
+  HTTP/1.1 on the generation server, loud mid-stream failures.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.decode import make_generate
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+
+
+def _cfg():
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+def _params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+def _solo_ref(cfg, params, prompt, new):
+    g = make_generate(cfg, prompt_len=len(prompt), max_new_tokens=new)
+    return list(np.asarray(g(params, jnp.asarray(prompt[None]),
+                             jax.random.PRNGKey(0)))[0])
+
+
+def _engine(cfg, params, batch=4, num_pages=64, pages_max=8,
+            kv_quant=None, **kw):
+    cache = PagedKVCache(cfg, num_pages=num_pages, pages_max=pages_max,
+                         batch=batch, page=16, kv_quant=kv_quant)
+    return ContinuousBatchingEngine(cfg, params, cache, **kw), cache
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_packed_matches_batched_mixed_lengths(kv_quant):
+    """Mixed-length arrivals through the packed lane generate the
+    exact tokens the batched-bucket lane does (and, fp-pools, the solo
+    dense runs), with strictly fewer prefill dispatches."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(0)
+    specs = [(rng.randint(1, 128, (int(rng.randint(3, 40)),)),
+              int(rng.randint(2, 8))) for _ in range(4)]
+
+    def run(packed):
+        eng, cache = _engine(cfg, params, kv_quant=kv_quant,
+                             packed=packed)
+        for p, n in specs:
+            eng.submit(p, max_new_tokens=n)
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        assert cache.free_pages() == cache.num_pages - 1
+        return done, eng
+
+    got_b, eng_b = run(False)
+    got_p, eng_p = run(True)
+    assert got_p == got_b
+    assert eng_p.prefill_calls <= eng_b.prefill_calls
+    if kv_quant is None:
+        for rid, (p, n) in enumerate(specs):
+            assert got_p[rid] == _solo_ref(cfg, params, p, n)
+
+
+@pytest.mark.parametrize("mix", [
+    [5, 5, 5, 5],            # uniform — one bucket either way
+    [3, 17, 33, 60],         # four distinct buckets
+    [80, 4, 4],              # long prompt + shorts (chunk config set)
+    [37],                    # single arrival
+])
+def test_packed_exactly_one_dispatch_per_wave(mix):
+    """THE acceptance pin: packed admission performs exactly ONE
+    prefill dispatch per admission wave for ANY mix of prompt lengths
+    — including a prompt longer than ``prefill_chunk``, which the
+    chunked lane would split into multiple dispatches."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(1)
+    eng, _ = _engine(cfg, params, batch=4, prefill_chunk=32)
+    prompts = [rng.randint(1, 128, (L,)) for L in mix]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=4)
+    eng.step()                       # one admission wave
+    assert eng.prefill_calls == 1, \
+        f"mix {mix} must admit as ONE packed dispatch"
+    done = eng.run_to_completion()
+    assert eng.prefill_calls == 1    # decode adds no prefills
+    for req, p in zip(sorted(done, key=lambda r: r.rid), prompts):
+        assert list(req.generated) == _solo_ref(cfg, params, p, 4)
+
+
+def test_packed_two_waves_two_dispatches():
+    """Dispatch count scales with WAVES, not arrivals: 4 slots serve 6
+    requests in two waves — exactly 2 prefill dispatches lifetime."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(2)
+    eng, _ = _engine(cfg, params, batch=4)
+    specs = [(rng.randint(1, 128, (int(rng.randint(3, 25)),)), 3)
+             for _ in range(6)]
+    for p, n in specs:
+        eng.submit(p, max_new_tokens=n)
+    done = eng.run_to_completion()
+    assert len(done) == 6
+    assert eng.prefill_calls == 2, eng.prefill_calls
+
+
+def test_packed_prefix_cache_suffix_prefill_token_exact():
+    """Prefix-cache suffixes ride the packed stream: same-wave sharers
+    resolve the shared pages IN-STREAM (their pool copy lands only
+    after the wave's program), cross-wave sharers gather from the
+    pool — both token-exact, cached-page reuse preserved."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(3)
+    prefix = rng.randint(1, 128, (48,))          # 3 full 16-pages
+    tails = [rng.randint(1, 128, (5,)), rng.randint(1, 128, (9,))]
+    eng, cache = _engine(cfg, params, batch=2,
+                         enable_prefix_caching=True)
+    for t in tails:
+        eng.submit(np.concatenate([prefix, t]), max_new_tokens=5)
+    done = sorted(eng.run_to_completion(), key=lambda r: r.rid)
+    # same-wave sharing: the second admission reused the first's 3
+    # prefix pages inside ONE packed dispatch
+    assert cache.prefix_hits == 3, cache.prefix_hits
+    assert eng.prefill_calls == 1
+    for req, t in zip(done, tails):
+        p = np.concatenate([prefix, t])
+        assert list(req.generated) == _solo_ref(cfg, params, p, 5)
+    # cross-wave: a later arrival reuses the cached pages from the
+    # POOL (the packed program's pool-history gather)
+    p3 = np.concatenate([prefix, rng.randint(1, 128, (3,))])
+    eng.submit(p3, max_new_tokens=4)
+    done3 = eng.run_to_completion()
+    assert cache.prefix_hits == 6
+    assert list(done3[0].generated) == _solo_ref(cfg, params, p3, 4)
+
+
+def test_packed_long_prompt_matches_chunked_lane():
+    """An 80-token prompt in a prefill_chunk=32 engine: the chunked
+    lane pays 3 dispatches, the packed lane 1 — identical greedy
+    output either way."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(1, 128, (80,))
+
+    def run(packed):
+        eng, _ = _engine(cfg, params, batch=2, num_pages=32,
+                         prefill_chunk=32, packed=packed)
+        eng.submit(prompt, max_new_tokens=6)
+        done = eng.run_to_completion()
+        return list(done[0].generated), eng.prefill_calls
+
+    got_c, calls_c = run(False)
+    got_p, calls_p = run(True)
+    assert got_p == got_c == _solo_ref(cfg, params, prompt, 6)
+    assert (calls_p, calls_c) == (1, 3)
+
+
+def test_packed_overlap_token_exact_and_flush_unchanged():
+    """``overlap=True`` composes with packed admission: token-exact vs
+    the synchronous batched engine, admission still flushes the
+    dispatch-ahead pipeline (PR-2 contract), and steady-state decode
+    still never flushes."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(5)
+    specs = [(rng.randint(1, 128, (int(rng.randint(3, 20)),)),
+              int(rng.randint(2, 8))) for _ in range(5)]
+
+    def run(packed, overlap):
+        eng, cache = _engine(cfg, params, batch=2, packed=packed,
+                             overlap=overlap)
+        for p, n in specs:
+            eng.submit(p, max_new_tokens=n)
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        assert cache.free_pages() == cache.num_pages - 1
+        return done, eng
+
+    got_sync, _ = run(False, False)
+    got_over, eng = run(True, True)
+    assert got_over == got_sync
+    # 5 requests through 2 slots = several admission waves, each a
+    # scheduler mutation: the pipeline must have flushed for them
+    assert eng.pipeline_flushes >= 2
+
+    # steady state (single long request, one admission): zero flushes
+    eng2, _ = _engine(cfg, params, batch=1, overlap=True)
+    eng2.submit(rng.randint(1, 128, (10,)), max_new_tokens=20)
+    eng2.run_to_completion()
+    assert eng2.pipeline_flushes == 0
+
+
+def test_packed_resume_after_preemption_token_exact():
+    """A preempted request re-admits through the packed lane (resume
+    context = prompt + generated tokens, saved next token — no fresh
+    sample) and still matches its solo run."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(6)
+    eng, cache = _engine(cfg, params, batch=2, num_pages=5,
+                         pages_max=4)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=20)
+    done = sorted(eng.run_to_completion(), key=lambda r: r.rid)
+    assert any(r.preempted > 0 for r in done), \
+        "pool was sized to force preemption"
+    for req, p in zip(done, prompts):
+        assert list(req.generated) == _solo_ref(cfg, params, p, 20)
+    assert cache.free_pages() == cache.num_pages - 1
+
+
+def test_packed_padding_waste_reduced_and_observable():
+    """The padded-token counters: the packed lane's waste (sub-bucket
+    remainder + per-segment page pad) is strictly below the batched
+    lane's pow2-grid padding on a spread-out length mix, and both the
+    host counters and the registry instruments agree."""
+    from paddle_tpu.observability import MetricsRegistry
+
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(7)
+    # one long + three short: the batched lane pays a [1, 128] grid
+    # for the long prompt PLUS a [4, 64] pow2 grid for the shorts; the
+    # packed stream carries ~160 real-page slots in one 256 bucket
+    lens = [100, 5, 9, 12]
+    prompts = [rng.randint(1, 128, (L,)) for L in lens]
+
+    def run(packed):
+        reg = MetricsRegistry()
+        eng, _ = _engine(cfg, params, batch=4, packed=packed,
+                         metrics_registry=reg)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=3)
+        eng.run_to_completion()
+        return eng, reg
+
+    eng_b, reg_b = run(False)
+    eng_p, reg_p = run(True)
+    frac_b = eng_b.prefill_padded_tokens / eng_b.prefill_token_slots
+    frac_p = eng_p.prefill_padded_tokens / eng_p.prefill_token_slots
+    assert frac_p < frac_b, (frac_p, frac_b)
+    # registry mirrors the host counters; the packed histogram saw
+    # exactly one wave whose stream covered all real tokens
+    for eng, reg in ((eng_b, reg_b), (eng_p, reg_p)):
+        assert reg.get(
+            "paddle_tpu_engine_prefill_padded_tokens_total").value \
+            == eng.prefill_padded_tokens
+    hist = reg_p.get("paddle_tpu_engine_prefill_packed_tokens")
+    assert hist.count == 1
+    assert hist.sum == eng_p.prefill_token_slots >= sum(lens)
+
+
+def test_packed_disabled_for_tp_mesh():
+    """TP engines (mp>1) fall back to the batched lane for now — the
+    packed program is not shard_mapped; the flag must switch off
+    silently rather than dispatch an unsharded program."""
+    from paddle_tpu.models.llama_pretrain import build_mesh
+
+    cfg = _cfg()
+    mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=2,
+                      devices=jax.devices()[:2])
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16, mesh=mesh)
+    eng = ContinuousBatchingEngine(cfg, params, cache, mesh=mesh)
+    assert eng._packed is False
+    eng1 = ContinuousBatchingEngine(
+        cfg, _params(cfg),
+        PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2, page=16))
+    assert eng1._packed is True
+
+
+# ---------------------------------------------------------------------------
+# serving-front ride-alongs (ADVICE round 5)
+# ---------------------------------------------------------------------------
+def test_submit_rejects_empty_prompt():
+    """An empty prompt must fail AT SUBMIT with ValueError — admitted,
+    it would corrupt page 0 K/V (batched lane) or kill the engine
+    thread and every in-flight generation (GenerationServer)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, _ = _engine(cfg, params, batch=2)
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.submit(np.zeros((0,), np.int64), max_new_tokens=4)
+    # the engine keeps serving after the rejection
+    rng = np.random.RandomState(8)
+    p = rng.randint(1, 128, (6,))
+    eng.submit(p, max_new_tokens=3)
+    done = eng.run_to_completion()
+    assert list(done[0].generated) == _solo_ref(cfg, params, p, 3)
+
+
+def test_generation_server_empty_prompt_is_400_not_fatal():
+    """POST /generate with an empty prompt: clean 400, server healthy
+    after — previously the engine thread died and every later request
+    saw 503."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    srv = GenerationServer(cfg, params, cache)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(urllib.request.HTTPError) as ei:
+            generate_http(url, [], max_new_tokens=4)
+        assert ei.value.code == 400
+        rng = np.random.RandomState(9)
+        toks = generate_http(url, rng.randint(1, 128, (6,)),
+                             max_new_tokens=3)
+        assert len(toks) == 3
+        with urllib.request.urlopen(url + "/health", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+    finally:
+        srv.stop()
+
+
+def test_generation_server_speaks_http11():
+    """chunked Transfer-Encoding is only legal on HTTP/1.1: the
+    generation server must negotiate 1.1 (clients/proxies otherwise
+    see raw chunk framing as body bytes)."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http_stream)
+
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    srv = GenerationServer(cfg, params, cache)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(url + "/health", timeout=10) as r:
+            assert r.version == 11, \
+                f"generation server answered HTTP/{r.version / 10}"
+        # the streaming endpoint still round-trips through urllib's
+        # chunked decoder
+        rng = np.random.RandomState(10)
+        prompt = rng.randint(1, 128, (6,))
+        got = list(generate_http_stream(url, prompt, max_new_tokens=4))
+        assert got == _solo_ref(cfg, params, prompt, 4)
+    finally:
+        srv.stop()
+
+
+def test_generate_http_stream_raises_on_midstream_error():
+    """A ``done`` message carrying ``error`` (engine crashed
+    mid-request) must raise RuntimeError in the client — returning a
+    silently truncated generation is indistinguishable from success."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http_stream)
+
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    srv = GenerationServer(cfg, params, cache)
+
+    def boom():
+        raise RuntimeError("induced engine failure")
+
+    srv.engine.step = boom          # crash on first drive iteration
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        rng = np.random.RandomState(11)
+        with pytest.raises(RuntimeError, match="generation failed"):
+            list(generate_http_stream(url, rng.randint(1, 128, (6,)),
+                                      max_new_tokens=4, timeout=30))
+    finally:
+        srv.stop()

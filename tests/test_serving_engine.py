@@ -154,13 +154,16 @@ def test_engine_rejects_oversized_request_at_submit():
 def test_engine_batched_admission_one_prefill_for_k_arrivals():
     """K same-bucket arrivals admit with ONE batched prefill dispatch
     (round-4 verdict item 7: admission cost sublinear in K), and every
-    request still matches its solo greedy run."""
+    request still matches its solo greedy run.  ``packed=False`` pins
+    the BATCHED lane explicitly — the packed varlen lane's stronger
+    one-dispatch-per-wave contract has its own tests
+    (tests/test_packed_prefill.py)."""
     cfg = _cfg()
     params = _params(cfg)
     rng = np.random.RandomState(5)
     cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=4,
                          page=16)
-    eng = ContinuousBatchingEngine(cfg, params, cache)
+    eng = ContinuousBatchingEngine(cfg, params, cache, packed=False)
     prompts = [rng.randint(1, 128, (int(rng.randint(5, 16)),))
                for _ in range(4)]
     for p in prompts:
@@ -180,7 +183,9 @@ def test_engine_batched_admission_one_prefill_for_k_arrivals():
 def test_engine_chunked_prefill_long_prompt_parity():
     """A prompt longer than prefill_chunk admits through the chunked
     prefill-with-history program (bounded per-dispatch cost) and the
-    generation still matches the solo run token-exactly."""
+    generation still matches the solo run token-exactly.
+    ``packed=False``: the chunked lane is the explicit subject (the
+    packed lane admits long prompts in one dispatch)."""
     cfg = _cfg()
     params = _params(cfg)
     rng = np.random.RandomState(6)
@@ -188,7 +193,7 @@ def test_engine_chunked_prefill_long_prompt_parity():
     cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
                          page=16)
     eng = ContinuousBatchingEngine(cfg, params, cache,
-                                   prefill_chunk=32)
+                                   prefill_chunk=32, packed=False)
     eng.submit(prompt, max_new_tokens=6)
     eng.step()
     # 80 tokens / 32-chunk = 3 chunk dispatches (32+32+16)
@@ -213,7 +218,8 @@ def test_engine_chunked_prefill_int8_cache():
         cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=1,
                              page=16, kv_quant="int8")
         eng = ContinuousBatchingEngine(cfg, params, cache,
-                                       prefill_chunk=chunk)
+                                       prefill_chunk=chunk,
+                                       packed=False)
         eng.submit(prompt, max_new_tokens=5)
         return [list(r.generated) for r in eng.run_to_completion()]
 
@@ -233,7 +239,7 @@ def test_engine_preemption_composes_with_chunked_prefill():
     cache = PagedKVCache(cfg, num_pages=5, pages_max=4, batch=2,
                          page=16)
     eng = ContinuousBatchingEngine(cfg, params, cache,
-                                   prefill_chunk=32)
+                                   prefill_chunk=32, packed=False)
     prompts = [rng.randint(1, 128, (24,)) for _ in range(2)]
     for p in prompts:
         eng.submit(p, max_new_tokens=30)

@@ -109,17 +109,33 @@ def cmd_snapshot(args) -> int:
             print("no metrics snapshot found", file=sys.stderr)
             return 1
     snap = doc
-    frac = None
+    frac = pfrac = None
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
             if isinstance(snap.get("host_overhead_frac"), (int, float)):
                 frac = snap["host_overhead_frac"]
+            if isinstance(snap.get("prefill_padded_token_frac"),
+                          (int, float)):
+                pfrac = snap["prefill_padded_token_frac"]
             snap = snap[key]
     print(_render_snapshot(snap))
     if frac is not None:
         # host bookkeeping / decode wall — the fraction the
         # dispatch-ahead serving pipeline overlaps away
         print(f"host_overhead_frac = {frac:.4g}")
+    if pfrac is None and isinstance(snap, dict):
+        # derivable from a raw registry snapshot too: wasted prefill
+        # slots / dispatched packed-stream slots
+        padded = (snap.get(
+            "paddle_tpu_engine_prefill_padded_tokens_total") or {})
+        packed = (snap.get(
+            "paddle_tpu_engine_prefill_packed_tokens") or {})
+        if packed.get("sum"):
+            pfrac = (padded.get("value") or 0.0) / packed["sum"]
+    if pfrac is not None:
+        # padding waste of prefill admission (packed lane: sub-bucket
+        # remainder only; batched lane: the pow2 grid's padding)
+        print(f"prefill_padded_token_frac = {pfrac:.4g}")
     return 0
 
 
