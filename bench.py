@@ -630,6 +630,131 @@ def _admission_line() -> dict:
     }
 
 
+def _preemption_line() -> dict:
+    """Two-tier KV cache A/B under PREEMPTION PRESSURE: the same
+    request trace runs through a pool deliberately too small to hold
+    every active context (forcing evict + requeue churn) with the
+    host-RAM page tier off and on.  Per side: preemption count, how
+    each resume happened (recompute re-prefill vs host-tier page
+    restore), mean resume-admission wall, prefill tokens the offload
+    path avoided, bytes swapped, and end-to-end decode tok/s.
+    ``value`` is the recompute/swap resume-latency ratio (>1 = the
+    restore path resumes faster).  Engines publish to the process-wide
+    registry so the final ``metrics_snapshot`` line carries the swap
+    counters."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_ring
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, page = 4, 64
+        prompt_len, new = 256, 192
+        # 4 requests of up to 7 pages each through 17 usable pages:
+        # two run, admitting a third preempts
+        num_pages, pages_max, host_pages = 18, 8, 64
+        metric = "serving_preemption_offload_resume_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, page = 2, 16
+        prompt_len, new = 16, 20
+        # 4 usable pages; 2 requests peak at 3 pages each -> preempt
+        num_pages, pages_max, host_pages = 5, 4, 16
+        metric = "serving_preemption_tiny_cpu_smoke_offload_resume_ab"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    n_req = batch + 2
+    prompts = [rng.randint(1, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+
+    def run(offload):
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page,
+                             host_pages=host_pages if offload else 0)
+        eng = ContinuousBatchingEngine(
+            cfg, params, cache, metrics_registry=default_registry(),
+            metrics_ring=default_ring())
+        # warm every compile this trace hits — including the
+        # preempt/swap/resume shapes, so the A/B measures steady
+        # state, not jit (a short-budget warmup would never preempt)
+        for p in prompts[:batch + 1]:
+            eng.submit(p, max_new_tokens=new)
+        eng.run_to_completion()
+        # snapshot the lifetime counters so the reported numbers are
+        # timed-window DELTAS — the warmup's first resume pays the
+        # prefill compile and would otherwise dominate resume_ms_mean
+        base = dict(preempt=eng.preemptions,
+                    rec=eng.resumes_recompute,
+                    swp=eng.resumes_swapped,
+                    wall=eng.resume_wall_s, ev=eng.resume_events,
+                    avoided=eng.prefill_tokens_avoided,
+                    out=cache.swap_out_pages, inn=cache.swap_in_pages,
+                    byt=cache.swap_bytes,
+                    slots=eng.prefill_token_slots)
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p, max_new_tokens=new)
+        done = eng.run_to_completion()
+        dt = time.perf_counter() - t0
+        tokens = sum(len(r.generated) for r in done)
+        events = eng.resume_events - base["ev"]
+        return {
+            "preemptions": eng.preemptions - base["preempt"],
+            "resumes_recompute": eng.resumes_recompute - base["rec"],
+            "resumes_swapped": eng.resumes_swapped - base["swp"],
+            "resume_ms_mean": round(
+                (eng.resume_wall_s - base["wall"])
+                / max(events, 1) * 1000, 3),
+            "prefill_tokens_avoided":
+                eng.prefill_tokens_avoided - base["avoided"],
+            "swap_out_pages": cache.swap_out_pages - base["out"],
+            "swap_in_pages": cache.swap_in_pages - base["inn"],
+            "swap_bytes": cache.swap_bytes - base["byt"],
+            "decode_tok_per_s": round(tokens / dt, 1),
+            "prefill_token_slots":
+                eng.prefill_token_slots - base["slots"],
+        }
+
+    off = run(False)
+    on = run(True)
+    speed = (off["resume_ms_mean"]
+             / max(on["resume_ms_mean"], 1e-9)) \
+        if on["resumes_swapped"] else 0.0
+    return {
+        "metric": metric,
+        "value": round(speed, 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {"platform": platform, "requests": n_req,
+                  "batch_slots": batch, "prompt_len": prompt_len,
+                  "max_new_tokens": new, "host_pages": host_pages,
+                  "offload_off": off, "offload_on": on},
+    }
+
+
 def _serving_line() -> dict:
     return _serving_run(overlap=False)
 
@@ -658,11 +783,27 @@ def _snapshot_line() -> dict:
     packed = snap.get("paddle_tpu_engine_prefill_packed_tokens") or {}
     pfrac = ((padded.get("value") or 0.0) / packed["sum"]) \
         if packed.get("sum") else 0.0
+
+    def _cval(name):
+        m = snap.get(name) or {}
+        return m.get("value") or 0.0
+
     return {"metric": "metrics_snapshot", "value": len(snap),
             "unit": "metrics", "vs_baseline": 0,
             "extra": {"snapshot": snap,
                       "host_overhead_frac": round(frac, 4),
                       "prefill_padded_token_frac": round(pfrac, 4),
+                      # two-tier KV cache swap traffic (the preemption
+                      # A/B's engines publish process-wide)
+                      "swap_out_pages_total": _cval(
+                          "paddle_tpu_kvcache_swap_out_pages_total"),
+                      "swap_in_pages_total": _cval(
+                          "paddle_tpu_kvcache_swap_in_pages_total"),
+                      "swap_bytes_total": _cval(
+                          "paddle_tpu_kvcache_swap_bytes_total"),
+                      "prefill_tokens_avoided_total": _cval(
+                          "paddle_tpu_engine_prefill_tokens_avoided"
+                          "_total"),
                       "events": default_ring().recent(50)}}
 
 
@@ -678,6 +819,8 @@ def main() -> None:
         ("serving_engine_overlap_decode_tokens_per_sec", "tokens/s",
          _serving_overlap_line),
         ("serving_admission_packed_vs_batched", "x", _admission_line),
+        ("serving_preemption_offload_resume_ab", "x",
+         _preemption_line),
     ]
 
     devs, err = _init_devices()

@@ -324,6 +324,10 @@ class _GenHandler(BaseHTTPRequestHandler):
                      "prefill_calls": eng.prefill_calls,
                      "preemptions": eng.preemptions,
                      "prefix_hits": eng.cache.prefix_hits,
+                     "swap_out_pages": eng.cache.swap_out_pages,
+                     "swap_in_pages": eng.cache.swap_in_pages,
+                     "prefill_tokens_avoided":
+                         getattr(eng, "prefill_tokens_avoided", 0),
                      "requests_finished": eng.requests_finished}
                 if hasattr(eng, "spec_rounds"):
                     h["spec_rounds"] = eng.spec_rounds
@@ -355,6 +359,13 @@ class _GenHandler(BaseHTTPRequestHandler):
                  "prefix_hits": int(v(
                      snap,
                      "paddle_tpu_kvcache_prefix_hit_pages_total")),
+                 "swap_out_pages": int(v(
+                     snap, "paddle_tpu_kvcache_swap_out_pages_total")),
+                 "swap_in_pages": int(v(
+                     snap, "paddle_tpu_kvcache_swap_in_pages_total")),
+                 "prefill_tokens_avoided": int(v(
+                     snap,
+                     "paddle_tpu_engine_prefill_tokens_avoided_total")),
                  "requests_finished": int(v(
                      snap,
                      "paddle_tpu_engine_requests_finished_total"))}

@@ -1,0 +1,143 @@
+"""Host-RAM page tier for the paged KV cache (two-tier cache).
+
+Reference role: the host-memory KV offload the reference's serving
+products lean on when HBM runs out (PaddleNLP block-cache CPU swap;
+T3-style compute/transfer overlap, PAPERS.md arxiv 2401.16677).
+
+A v5e chip has 16 GB of HBM; the host behind it has 10-100x that.  A
+page swap is a DMA, not a forward pass — so instead of throwing a
+preempted request's K/V away and re-prefilling the whole context on
+resume (recompute-style preemption), the engine GATHERS the victim's
+pages off the device pools in one batched dispatch, parks them in
+this pool's numpy buffers, and restores them with ONE batched
+``.at[ids].set`` when the request re-admits: **zero prefill tokens**
+on resume.  The same tier backs the prefix cache: evicted cached
+prefix pages DEMOTE here instead of dying, and later lookups PROMOTE
+them back — effective prefix-cache capacity scales with host RAM, not
+with the decode pool.
+
+Transfer discipline (T3): the device→host copy is staged
+asynchronously (``copy_to_host_async`` where the backend supports it)
+so it rides under in-flight decode steps; pending copies materialise
+into the numpy buffers only at ``flush()`` — called from the serving
+engine's scheduler-mutation points (the same drain points the
+dispatch-ahead pipeline documents) and, unconditionally, before any
+read (``gather``).  Restores (host→device) are one batched scatter
+per swap-in.
+
+Buffers are ``[L, host_pages, nkv, page, d]`` matching the device
+pool layout exactly (int8 pools carry their per-(head, slot) scale
+buffers too), so swap round-trips are bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["HostPagePool"]
+
+# staged async copies are flushed once this many batches accumulate —
+# bounds the device buffers a lazy reader can keep pinned
+_MAX_PENDING = 16
+
+
+class HostPagePool:
+    """Free-list allocator over host-RAM page buffers mirroring the
+    device pool layout.  Page ids here ("hids") are a separate
+    namespace from device page ids."""
+
+    def __init__(self, cfg, host_pages: int, page: int, dtype,
+                 kv_quant: Optional[str] = None):
+        if host_pages < 1:
+            raise ValueError("host_pages must be >= 1")
+        L = cfg.num_hidden_layers
+        nkv, d = cfg.num_key_value_heads, cfg.head_dim
+        self.num_pages = int(host_pages)
+        self.page = page
+        self.kv_quant = kv_quant
+        # np.dtype of the DEVICE pool (ml_dtypes covers bf16/int8) —
+        # identical layout+dtype makes the swap round-trip bitwise
+        self.kbuf = np.zeros((L, host_pages, nkv, page, d), dtype)
+        self.vbuf = np.zeros((L, host_pages, nkv, page, d), dtype)
+        if kv_quant == "int8":
+            self.kscale = np.zeros((L, host_pages, nkv, page),
+                                   np.float32)
+            self.vscale = np.zeros((L, host_pages, nkv, page),
+                                   np.float32)
+        else:
+            self.kscale = self.vscale = None
+        self._free: List[int] = list(range(host_pages - 1, -1, -1))
+        # staged async device→host copies: (hids, k, v, ks, vs) device
+        # arrays whose host fetch is (maybe) still in flight
+        self._pending: List = []
+
+    # -- allocator --------------------------------------------------------
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("host KV page pool exhausted")
+        return self._free.pop()
+
+    def free(self, hid: int) -> None:
+        # a staged write to this hid must not land after the slot is
+        # recycled (it would clobber the new tenant's data) — but only
+        # batches touching THIS hid get drained; unrelated in-flight
+        # copies keep riding under decode
+        hit = [e for e in self._pending if hid in e[0]]
+        if hit:
+            self._pending = [e for e in self._pending
+                             if hid not in e[0]]
+            self._flush_entries(hit)
+        self._free.append(hid)
+
+    # -- device -> host ---------------------------------------------------
+    def stage(self, hids: List[int], k, v, ks=None, vs=None) -> None:
+        """Stage a batched device→host copy of gathered pages
+        (``k``/``v``: ``[L, len(hids), nkv, page, d]`` device arrays).
+        The fetch starts asynchronously where the backend supports it
+        and overlaps whatever the device runs next; the numpy write
+        happens at :meth:`flush`."""
+        for a in (k, v, ks, vs):
+            if a is None:
+                continue
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass                      # backend without async D2H
+        self._pending.append((list(hids), k, v, ks, vs))
+        if len(self._pending) >= _MAX_PENDING:
+            self.flush()
+
+    def flush(self) -> None:
+        """Materialise every staged copy into the host buffers (the
+        only blocking point of the swap-out path)."""
+        pending, self._pending = self._pending, []
+        self._flush_entries(pending)
+
+    def _flush_entries(self, entries) -> None:
+        for hids, k, v, ks, vs in entries:
+            self.kbuf[:, hids] = np.asarray(k)
+            self.vbuf[:, hids] = np.asarray(v)
+            if self.kscale is not None:
+                self.kscale[:, hids] = np.asarray(ks)
+                self.vscale[:, hids] = np.asarray(vs)
+
+    # -- host -> device (caller scatters) ---------------------------------
+    def gather(self, hids: List[int]):
+        """Numpy page blocks for a batched device restore — flushes
+        pending writes first so reads always see committed data.
+        Returns ``(k, v, kscale, vscale)`` (scales ``None`` for
+        non-int8 pools)."""
+        self.flush()
+        k = self.kbuf[:, hids]
+        v = self.vbuf[:, hids]
+        if self.kscale is None:
+            return k, v, None, None
+        return k, v, self.kscale[:, hids], self.vscale[:, hids]

@@ -54,14 +54,27 @@ class PagedKVCache:
     pages, so a model wider than one chip serves with per-chip cache
     HBM of nkv/mp heads (the fleet-executor dist-model serving case,
     reference: fluid/distributed/fleet_executor/dist_model.h:57).
+
+    With ``host_pages`` > 0 a HOST-RAM page tier (kv_offload.py)
+    backs the pool: preempted rows swap out instead of releasing
+    (``swap_out_row`` / ``swap_in_row`` — resume restores pages with
+    zero prefill tokens) and evicted cached-prefix pages demote to
+    host and promote back on lookup, so prefix-cache depth scales
+    with host RAM rather than the decode pool.
     """
 
     def __init__(self, cfg: LlamaPretrainConfig, num_pages: int,
                  pages_max: int, batch: int, page: int = 64,
                  dtype=None, kv_quant: Optional[str] = None,
-                 mesh=None):
+                 mesh=None, host_pages: int = 0):
         if kv_quant not in (None, "int8"):
             raise ValueError("kv_quant must be None or 'int8'")
+        if host_pages and mesh is not None \
+                and mesh.shape.get("mp", 1) > 1:
+            raise ValueError(
+                "host_pages (the host-RAM page tier) is single-device "
+                "only for now — a kv-head-sharded pool would need "
+                "per-shard host buffers")
         self.cfg = cfg
         self.page = page
         self.pages_max = pages_max
@@ -121,14 +134,54 @@ class PagedKVCache:
         self._prefix_index: "OrderedDict" = OrderedDict()
         # chain structure for LEAF-FIRST eviction: evicting a chain's
         # head would orphan its tail (lookups break at the missing
-        # head while the tail pages stay pinned)
+        # head while the tail pages stay pinned).  The structure spans
+        # BOTH tiers (a key lives in exactly one of _prefix_index /
+        # _host_prefix_index at a time): parent link + live-children
+        # sets, from which HBM-leaf / union-leaf checks derive.
         self._prefix_parent: dict = {}
-        self._prefix_nchildren: dict = {}
+        self._prefix_children: dict = {}
         self.prefix_hits = 0              # pages reused via the index
+        # -- HOST TIER (two-tier cache, kv_offload.py) ----------------
+        # a host_pages>0 pool holds demoted prefix pages and swapped-
+        # out preempted rows in host RAM: 10-100x the device pool for
+        # the cost of a DMA instead of a re-prefill
+        if host_pages:
+            from .kv_offload import HostPagePool
+            self.host = HostPagePool(cfg, host_pages, page,
+                                     self.kpool.dtype,
+                                     kv_quant=kv_quant)
+        else:
+            self.host = None
+        self._host_prefix_index: "OrderedDict" = OrderedDict()
+        self._host_pinned: set = set()    # hids mid-promotion
+        self._demote_pending: list = []   # (pid, hid) gathers to stage
+        self._swapped: dict = {}          # handle -> swapped-row record
+        self._next_swap = 0
+        self.prefix_promotions = 0        # host->HBM page promotions
+        self.swap_out_pages = 0
+        self.swap_in_pages = 0
+        self.swap_bytes = 0
+        # device-dispatch seams, countable by tests: page-write
+        # scatters (one per admission wave) and swap-in restores (one
+        # per swap-in)
+        self.scatter_dispatches = 0
+        self.restore_dispatches = 0
         # observability hookup (an owning engine sets this to its
         # EngineMetrics; gauges over pool state are scrape-time
         # callbacks, so only the hit/miss counters touch hot paths)
         self.metrics = None
+
+    @property
+    def page_bytes(self) -> int:
+        """Bytes one page costs across all layers, K + V (+ the int8
+        scale planes) — the unit of the swap cost model."""
+        per = (self.cfg.num_hidden_layers
+               * self.cfg.num_key_value_heads * self.page
+               * self.cfg.head_dim)
+        b = 2 * per * self.kpool.dtype.itemsize
+        if self.kv_quant == "int8":
+            b += 2 * (per // self.cfg.head_dim) * 4
+        return b
 
     def free_pages(self) -> int:
         return len(self._free)
@@ -158,23 +211,161 @@ class PagedKVCache:
             keys.append(h.digest())
         return keys
 
+    def _link_chain(self, key, parent) -> None:
+        """(Re-)link ``key`` into the two-tier chain structure.
+        Idempotent — called on every index insertion (register,
+        host-refresh, promotion) because a fully-evicted parent that
+        was later re-registered starts with an empty children set and
+        must re-learn surviving children, or leaf-first eviction
+        would take it from under them."""
+        self._prefix_parent[key] = parent
+        self._prefix_children.setdefault(key, set())
+        if parent is not None:
+            self._prefix_children.setdefault(parent, set()).add(key)
+
+    def _drop_chain_entry(self, key) -> None:
+        """Remove ``key`` from the (two-tier) chain structure — the key
+        no longer exists in either index."""
+        parent = self._prefix_parent.pop(key, None)
+        if parent is not None and parent in self._prefix_children:
+            self._prefix_children[parent].discard(key)
+        self._prefix_children.pop(key, None)
+
+    def _host_free(self, hid: int) -> None:
+        """Free a host page, dropping any still-deferred demotion
+        gather targeting it (the content is being discarded — letting
+        the stale gather land later would clobber the slot's next
+        tenant)."""
+        if self._demote_pending:
+            self._demote_pending = [
+                (p, h) for p, h in self._demote_pending if h != hid]
+        self.host.free(hid)
+
+    def _host_evict_one(self) -> bool:
+        """Free the oldest union-leaf host-tier prefix page (hids
+        pinned mid-promotion are skipped).  Leaf-first for the same
+        reason as the device tier: chains must stay lookup-able."""
+        for key in list(self._host_prefix_index):
+            hid = self._host_prefix_index[key]
+            if hid in self._host_pinned:
+                continue
+            if self._prefix_children.get(key):
+                continue                      # has live children
+            del self._host_prefix_index[key]
+            self._drop_chain_entry(key)
+            self._host_free(hid)
+            return True
+        return False
+
+    def _host_alloc(self) -> int:
+        """Pop a host page, evicting host-tier cached prefixes
+        (oldest leaf first) when the host free list is dry."""
+        while not self.host._free:
+            if not self._host_evict_one():
+                break
+        return self.host.alloc()
+
+    def host_available(self) -> int:
+        """Host pages obtainable right now: free + evictable cached
+        host-tier prefix pages (iterated leaf-first eviction can drain
+        every unpinned entry)."""
+        if self.host is None:
+            return 0
+        return (self.host.free_pages()
+                + len(self._host_prefix_index)
+                - len(self._host_pinned))
+
     def _evict_one_prefix(self) -> bool:
-        """Evict the oldest LEAF cached-prefix page held only by the
-        index.  Leaf-first keeps chains lookup-able: a head eviction
-        would orphan every dependent tail entry."""
+        """Take the oldest LEAF cached-prefix page held only by the
+        index out of HBM — DEMOTED to the host tier when one is
+        attached (a later lookup promotes it back: the prefix cache's
+        effective capacity is host RAM), freed outright otherwise.
+        Leaf-first keeps chains lookup-able: a head eviction would
+        orphan every dependent tail entry.  "Leaf" here means no child
+        resident in HBM — children already demoted to the host tier
+        don't pin their parent on-device."""
         for key in list(self._prefix_index):
             pid = self._prefix_index[key]
-            if self.refs[pid] == 1 and \
-                    self._prefix_nchildren.get(key, 0) == 0:
-                del self._prefix_index[key]
-                parent = self._prefix_parent.pop(key, None)
-                if parent is not None:
-                    self._prefix_nchildren[parent] -= 1
-                self._prefix_nchildren.pop(key, None)
-                self.refs[pid] = 0
-                self._free.append(pid)
-                return True
+            if self.refs[pid] != 1:
+                continue
+            if any(c in self._prefix_index
+                   for c in self._prefix_children.get(key, ())):
+                continue
+            del self._prefix_index[key]
+            demoted = False
+            if self.host is not None and self.host_available() > 0:
+                hid = self._host_alloc()
+                # DEFERRED gather: demotions triggered by one
+                # allocator call coalesce into a single batched
+                # dispatch (_flush_demotions) instead of one per page
+                self._demote_pending.append((pid, hid))
+                self._host_prefix_index[key] = hid
+                demoted = True                # chain entry survives
+            else:
+                self._drop_chain_entry(key)
+            self.refs[pid] = 0
+            self._free.append(pid)
+            # traffic is counted at flush time (_flush_demotions): a
+            # deferred demotion dropped before its gather runs (host
+            # eviction of the just-demoted entry) never moved bytes
+            return True
         return False
+
+    def _count_swap(self, n: int, out: bool) -> None:
+        """Single site for swap-traffic bookkeeping (plain counters +
+        registry instruments stay in lockstep)."""
+        nbytes = n * self.page_bytes
+        if out:
+            self.swap_out_pages += n
+        else:
+            self.swap_in_pages += n
+        self.swap_bytes += nbytes
+        if self.metrics is not None:
+            (self.metrics.swap_out_pages if out
+             else self.metrics.swap_in_pages).inc(n)
+            self.metrics.swap_bytes.inc(nbytes)
+
+    def _flush_demotions(self) -> None:
+        """Stage every demotion deferred by ``_evict_one_prefix`` as
+        ONE batched gather.  Must run before any pool WRITE dispatch
+        (a demoted page may already be reallocated — a write landing
+        first would corrupt the host copy), so the write seams call
+        this too; allocator entry points flush on exit."""
+        if not self._demote_pending:
+            return
+        pending, self._demote_pending = self._demote_pending, []
+        self._stage_swap_out([p for p, _ in pending],
+                             [h for _, h in pending])
+        self._count_swap(len(pending), out=True)
+
+    def _stage_swap_out(self, pids, hids) -> None:
+        """ONE batched device gather of ``pids`` staged as an async
+        copy into host pages ``hids`` — the device→HBM→host leg of a
+        swap, overlappable with in-flight decode steps (the engine
+        flushes at its scheduler-mutation points)."""
+        ids = jnp.asarray(np.asarray(pids, np.int32))
+        kg = self.kpool[:, ids]
+        vg = self.vpool[:, ids]
+        if self.kv_quant == "int8":
+            self.host.stage(hids, kg, vg, self.kscale[:, ids],
+                            self.vscale[:, ids])
+        else:
+            self.host.stage(hids, kg, vg)
+
+    def _restore_pages(self, pids, k, v, ks, vs) -> None:
+        """ONE batched ``.at[ids].set`` restore dispatch (per pool
+        tensor) writing host page blocks back into device pages
+        ``pids`` — the host→device leg of a swap-in / promotion."""
+        self._flush_demotions()       # gathers must precede pool writes
+        ids = jnp.asarray(np.asarray(pids, np.int32))
+        self.kpool = self.kpool.at[:, ids].set(
+            jnp.asarray(k).astype(self.kpool.dtype))
+        self.vpool = self.vpool.at[:, ids].set(
+            jnp.asarray(v).astype(self.vpool.dtype))
+        if self.kv_quant == "int8":
+            self.kscale = self.kscale.at[:, ids].set(jnp.asarray(ks))
+            self.vscale = self.vscale.at[:, ids].set(jnp.asarray(vs))
+        self.restore_dispatches += 1
 
     def _page_alloc(self) -> int:
         """Pop a free page, evicting cached prefixes (oldest leaf
@@ -188,9 +379,17 @@ class PagedKVCache:
     def alloc_row_prefix(self, b: int, ctx: np.ndarray) -> int:
         """Like :meth:`alloc_row` but REUSES cached prefix pages: the
         longest chain-key run found in the index is shared (increfed),
-        only the remainder gets fresh pages.  Returns the number of
-        reused TOKENS (a page multiple) — the caller prefills from
-        there."""
+        only the remainder gets fresh pages.  A key that misses in HBM
+        but hits the HOST TIER is PROMOTED: a fresh device page is
+        claimed, its content restored from host RAM (one batched
+        restore dispatch for the whole row), and the key moves back
+        into the HBM index — a cache depth of host-RAM pages at the
+        cost of a DMA.  Returns the number of reused TOKENS (a page
+        multiple) — the caller prefills from there.
+
+        Hit/miss stats are recorded only after the WHOLE claim commits
+        — a pool-exhaustion rollback must not leave hits counted for
+        pages the row never kept."""
         page = self.page
         L = len(ctx)
         need = (L + page - 1) // page
@@ -198,55 +397,114 @@ class PagedKVCache:
             raise ValueError(f"length {L} exceeds pages_max")
         self.release_row(b)
         keys = self._chain_keys(ctx, page)
-        shared = []
+        plan = []                  # chain-ordered ("share"|"promote")
         for key in keys:
             pid = self._prefix_index.get(key)
-            if pid is None:
-                break
-            self._prefix_index.move_to_end(key)      # LRU touch
-            shared.append(pid)
+            if pid is not None:
+                self._prefix_index.move_to_end(key)  # LRU touch
+                plan.append(("share", key, pid))
+                continue
+            hid = self._host_prefix_index.get(key)
+            if hid is not None:
+                self._host_prefix_index.move_to_end(key)
+                plan.append(("promote", key, hid))
+                continue
+            break
         # a fully-cached page-aligned context would leave nothing to
         # prefill — the engine needs the LAST page's K/V computed to
         # produce next-token logits anyway, so keep >=1 page private
-        if L % page == 0 and len(shared) == len(keys) and shared:
-            shared.pop()
+        if L % page == 0 and len(plan) == len(keys) and plan:
+            plan.pop()
+        promos = [(j, key, hid) for j, (kind, key, hid)
+                  in enumerate(plan) if kind == "promote"]
+        # pin promo source pages: allocs below may demote other pages
+        # to the host tier, and host-side eviction must not take the
+        # very pages we are about to read
+        self._host_pinned.update(h for _, _, h in promos)
+        row = [None] * need        # final page id per table position
         try:
-            for j, pid in enumerate(shared):
-                self.refs[pid] += 1
-                self.tables[b, j] = pid
-                self._owned[b].append(pid)
-            self.prefix_hits += len(shared)
-            for j in range(len(shared), need):
-                pid = self._page_alloc()
-                self.refs[pid] += 1
-                self.tables[b, j] = pid
-                self._owned[b].append(pid)
-        except RuntimeError:
-            self.release_row(b)     # roll back the partial claim
-            raise
+            # 1. claim the HBM hits FIRST — an incref lifts them above
+            #    the demotion threshold before any alloc below runs
+            for j, (kind, key, val) in enumerate(plan):
+                if kind == "share":
+                    self.refs[val] += 1
+                    row[j] = val
+            # 2. promotions: claim device pages, then ONE batched
+            #    restore, then move the index entries host -> HBM
+            promo_pids = []
+            try:
+                for _ in promos:
+                    promo_pids.append(self._page_alloc())
+            except RuntimeError:
+                self._free.extend(promo_pids)
+                for j, (kind, key, val) in enumerate(plan):
+                    if kind == "share":
+                        self.refs[val] -= 1   # index ref remains >= 1
+                raise
+            if promos:
+                hids = [h for _, _, h in promos]
+                k, v, ks, vs = self.host.gather(hids)
+                self._restore_pages(promo_pids, k, v, ks, vs)
+                for (j, key, hid), pid in zip(promos, promo_pids):
+                    del self._host_prefix_index[key]
+                    self._host_free(hid)
+                    self._prefix_index[key] = pid
+                    self._link_chain(key, keys[j - 1] if j else None)
+                    self.refs[pid] = 2        # index ref + row ref
+                    row[j] = pid
+                self.prefix_promotions += len(promos)
+                self._count_swap(len(promos), out=False)
+            # 3. fresh pages for the remainder
+            try:
+                for j in range(len(plan), need):
+                    pid = self._page_alloc()
+                    self.refs[pid] += 1
+                    row[j] = pid
+            except RuntimeError:
+                # roll back the row's claim; promoted pages keep their
+                # index ref — they are valid cached pages either way
+                for pid in row:
+                    if pid is None:
+                        continue
+                    self.refs[pid] -= 1
+                    if self.refs[pid] == 0:
+                        self._free.append(pid)
+                raise
+        finally:
+            self._host_pinned.difference_update(
+                h for _, _, h in promos)
+            self._flush_demotions()
+        self._owned[b] = row
+        for j, pid in enumerate(row):
+            self.tables[b, j] = pid
         self.tables_version += 1
         self.lens[b] = L
+        # stats AFTER the claim committed (satellite fix: a rollback
+        # used to leave hits counted for pages the row never kept)
+        self.prefix_hits += len(plan)
         if self.metrics is not None:
-            self.metrics.prefix_hit_pages.inc(len(shared))
-            self.metrics.prefix_miss_pages.inc(need - len(shared))
-        return len(shared) * page
+            self.metrics.prefix_hit_pages.inc(len(plan))
+            self.metrics.prefix_miss_pages.inc(need - len(plan))
+        return len(plan) * page
 
     def register_prefix(self, b: int, ctx: np.ndarray) -> None:
         """Insert row ``b``'s FULL pages into the prefix index (one
         index ref each) so later admissions sharing the prefix reuse
-        them."""
+        them.  A key already demoted to the host tier is REFRESHED:
+        the host copy is dropped in favour of the identical,
+        freshly-written device page (a key lives in exactly one
+        tier)."""
         page = self.page
         keys = self._chain_keys(ctx, page)
         for j, key in enumerate(keys):
             if key in self._prefix_index:
                 continue
             pid = int(self.tables[b, j])
+            hid = self._host_prefix_index.pop(key, None)
+            if hid is not None:
+                self._host_free(hid)      # same content by key
             self._prefix_index[key] = pid
-            parent = keys[j - 1] if j else None
-            self._prefix_parent[key] = parent
-            if parent is not None:
-                self._prefix_nchildren[parent] = \
-                    self._prefix_nchildren.get(parent, 0) + 1
+            self._link_chain(key, keys[j - 1] if j else None)
             self.refs[pid] += 1
 
     def alloc_row(self, b: int, length: int) -> None:
@@ -267,6 +525,8 @@ class PagedKVCache:
         except RuntimeError:
             self.release_row(b)     # roll back the partial claim
             raise
+        finally:
+            self._flush_demotions()
         self.tables_version += 1
         self.lens[b] = length
 
@@ -278,11 +538,20 @@ class PagedKVCache:
             raise ValueError(
                 f"row {b}: {int(self.lens[b])} + {new_tokens} tokens "
                 f"needs {need} pages > pages_max {self.pages_max}")
-        while len(self._owned[b]) < need:
-            pid = self._page_alloc()
-            self.refs[pid] += 1
-            self.tables[b, len(self._owned[b])] = pid
-            self._owned[b].append(pid)
+        grew = False
+        try:
+            while len(self._owned[b]) < need:
+                pid = self._page_alloc()
+                self.refs[pid] += 1
+                self.tables[b, len(self._owned[b])] = pid
+                self._owned[b].append(pid)
+                grew = True
+        finally:
+            self._flush_demotions()
+        if grew:
+            # ONE bump per call, not per page: every bump invalidates
+            # the overlap loop's device-resident tables copy, forcing
+            # a re-upload — per-page bumps bought nothing
             self.tables_version += 1
 
     def write_row_pages(self, slot: int, ks, vs, L: int,
@@ -290,19 +559,39 @@ class PagedKVCache:
         """Write one row's prefill K/V (``[Lyr, S>=L, nkv, d]``, layer-
         major) into its allocated pages, quantising when the cache is
         int8.  ``first_page`` offsets into the row's table (chunked
-        prefill appends chunk c at page c*chunk/page).  Single source
-        of the page-layout transpose — the engine admission path uses
-        this; generate_paged's batched multi-row write mirrors it for
-        local (donation-managed) pool variables."""
+        prefill appends chunk c at page c*chunk/page).  One entry of
+        :meth:`write_pages_batch` — multi-row admission waves use the
+        batch form directly so the whole wave is ONE scatter
+        dispatch."""
+        self.write_pages_batch([(slot, ks, vs, L, first_page)])
+
+    def write_pages_batch(self, entries) -> None:
+        """Coalesced page write for a whole admission wave: every
+        entry's ``(slot, ks, vs, L, first_page)`` K/V lands through
+        ONE batched ``.at[ids].set`` scatter per pool tensor (the
+        packed lane used to pay one device dispatch per segment).
+        Single source of the page-layout transpose — generate_paged's
+        batched multi-row write mirrors it for local
+        (donation-managed) pool variables."""
         page = self.page
-        npg = (L + page - 1) // page
-        Wp = npg * page
-        if ks.shape[1] < Wp:
-            raise ValueError(
-                f"prefill output covers {ks.shape[1]} slots but the "
-                f"row needs {Wp} (pad the prefill to a page multiple)")
-        ks = ks[:, :Wp]
-        vs = vs[:, :Wp]
+        ids_all, kss, vss = [], [], []
+        for slot, ks, vs, L, first_page in entries:
+            npg = (L + page - 1) // page
+            Wp = npg * page
+            if ks.shape[1] < Wp:
+                raise ValueError(
+                    f"prefill output covers {ks.shape[1]} slots but "
+                    f"the row needs {Wp} (pad the prefill to a page "
+                    f"multiple)")
+            kss.append(ks[:, :Wp])
+            vss.append(vs[:, :Wp])
+            ids_all.append(
+                self.tables[slot, first_page:first_page + npg].copy())
+        ks = kss[0] if len(kss) == 1 else jnp.concatenate(kss, axis=1)
+        vs = vss[0] if len(vss) == 1 else jnp.concatenate(vss, axis=1)
+        ids = np.concatenate(ids_all)
+        npg = ids.shape[0]
+        ks_s = vs_s = None
         if self.kv_quant == "int8":
             from ..ops.pallas.paged_attention import quantize_kv_token
             ks, ks_s = quantize_kv_token(ks)
@@ -310,14 +599,21 @@ class PagedKVCache:
         Lyr, nkv, d = ks.shape[0], ks.shape[2], ks.shape[3]
         kb = ks.reshape(Lyr, npg, page, nkv, d).transpose(0, 1, 3, 2, 4)
         vb = vs.reshape(Lyr, npg, page, nkv, d).transpose(0, 1, 3, 2, 4)
-        ids = self.tables[slot, first_page:first_page + npg].copy()
-        self.kpool = self.kpool.at[:, ids].set(kb.astype(self.kpool.dtype))
-        self.vpool = self.vpool.at[:, ids].set(vb.astype(self.vpool.dtype))
         if self.kv_quant == "int8":
             ks_s = ks_s.reshape(Lyr, npg, page, nkv).transpose(0, 1, 3, 2)
             vs_s = vs_s.reshape(Lyr, npg, page, nkv).transpose(0, 1, 3, 2)
+        self._scatter_pages(ids, kb, vb, ks_s, vs_s)
+
+    def _scatter_pages(self, ids, kb, vb, ks_s=None, vs_s=None) -> None:
+        """The page-write device-dispatch seam (tests count calls
+        through it: one per admission wave)."""
+        self._flush_demotions()       # gathers must precede pool writes
+        self.kpool = self.kpool.at[:, ids].set(kb.astype(self.kpool.dtype))
+        self.vpool = self.vpool.at[:, ids].set(vb.astype(self.vpool.dtype))
+        if self.kv_quant == "int8":
             self.kscale = self.kscale.at[:, ids].set(ks_s)
             self.vscale = self.vscale.at[:, ids].set(vs_s)
+        self.scatter_dispatches += 1
 
     def release_row(self, b: int) -> None:
         for pid in self._owned[b]:
@@ -328,6 +624,213 @@ class PagedKVCache:
         self.tables[b] = 0
         self.lens[b] = 0
         self.tables_version += 1
+
+    # -- host-tier row swap (recompute-free preemption) -------------------
+    def private_pages(self, b: int) -> int:
+        """Pages of row ``b``'s written context held ONLY by the row
+        (refs==1) — exactly what a :meth:`swap_out_row` must move to
+        the host tier.  The engine's preemption cost model and the
+        swap precondition both read this so they can never diverge."""
+        L = int(self.lens[b])
+        npg = (L + self.page - 1) // self.page
+        return sum(1 for pid in self._owned[b][:npg]
+                   if self.refs[pid] == 1)
+
+    def swap_out_row(self, b: int) -> int:
+        """Park row ``b``'s cached context in the host tier instead of
+        destroying it: PRIVATE pages (refs==1) ride one batched device
+        gather + async host copy, SHARED pages (prefix-cache pages,
+        refs>1) stay on-device with the row's ref carried by the swap
+        record (the held ref keeps them from being demoted under us).
+        The row itself is released.  Returns a handle for
+        :meth:`swap_in_row`.
+
+        Raises ``RuntimeError`` (before mutating anything) when the
+        host tier cannot hold the private pages — the caller's cost
+        model should have checked :meth:`host_available` and fallen
+        back to recompute-style preemption."""
+        if self.host is None:
+            raise RuntimeError("no host page tier attached")
+        page = self.page
+        L = int(self.lens[b])
+        npg = (L + page - 1) // page
+        data = self._owned[b][:npg]
+        private = self.private_pages(b)
+        if self.host_available() < private:
+            raise RuntimeError(
+                f"host tier full: {private} pages to swap, "
+                f"{self.host_available()} available")
+        entries = []
+        dev_ids, host_ids = [], []
+        for pid in data:
+            if self.refs[pid] > 1:
+                entries.append(("dev", pid))      # carry the row's ref
+            else:
+                hid = self._host_alloc()
+                entries.append(("host", hid))
+                dev_ids.append(pid)
+                host_ids.append(hid)
+        if dev_ids:
+            self._stage_swap_out(dev_ids, host_ids)
+            for pid in dev_ids:
+                self.refs[pid] = 0
+                self._free.append(pid)
+            self._count_swap(len(dev_ids), out=True)
+        for pid in self._owned[b][npg:]:          # unwritten growth
+            self.refs[pid] -= 1
+            if self.refs[pid] == 0:
+                self._free.append(pid)
+        self._owned[b] = []
+        self.tables[b] = 0
+        self.lens[b] = 0
+        self.tables_version += 1
+        handle = self._next_swap
+        self._next_swap += 1
+        self._swapped[handle] = {"entries": entries, "lens": L}
+        return handle
+
+    def swap_pages_needed(self, handle: int) -> int:
+        """Device pages a :meth:`swap_in_row` of this record must
+        claim (its "dev" entries already hold theirs)."""
+        return sum(1 for kind, _ in self._swapped[handle]["entries"]
+                   if kind == "host")
+
+    def swap_ctx_len(self, handle: int) -> int:
+        return int(self._swapped[handle]["lens"])
+
+    def swap_in_row(self, b: int, handle: int) -> int:
+        """Rebuild row ``b`` from a swap record: fresh device pages
+        for the host-tier entries, restored with ONE batched
+        ``.at[ids].set`` dispatch; on-device ("dev") entries slot
+        their held pages straight back into the table.  ZERO prefill
+        tokens.  Returns the restored context length.  On device-pool
+        exhaustion the record is left intact and ``RuntimeError``
+        propagates (the caller falls back to recompute)."""
+        rec = self._swapped[handle]
+        entries = rec["entries"]
+        self.release_row(b)
+        fresh = []
+        try:
+            for _ in range(sum(1 for kind, _ in entries
+                               if kind == "host")):
+                fresh.append(self._page_alloc())
+        except RuntimeError:
+            self._free.extend(fresh)
+            raise
+        finally:
+            self._flush_demotions()
+        del self._swapped[handle]
+        it = iter(fresh)
+        restore_ids, hids = [], []
+        for j, (kind, val) in enumerate(entries):
+            if kind == "host":
+                pid = next(it)
+                self.refs[pid] += 1
+                restore_ids.append(pid)
+                hids.append(val)
+            else:
+                pid = val                 # the record's ref becomes
+                #                           the row's ref
+            self.tables[b, j] = pid
+            self._owned[b].append(pid)
+        if restore_ids:
+            k, v, ks, vs = self.host.gather(hids)
+            self._restore_pages(restore_ids, k, v, ks, vs)
+            for hid in hids:
+                self._host_free(hid)
+            self._count_swap(len(restore_ids), out=False)
+        self.lens[b] = rec["lens"]
+        self.tables_version += 1
+        return int(rec["lens"])
+
+    def discard_swap(self, handle: int) -> None:
+        """Drop a swap record without restoring it (the owning request
+        falls back to recompute): host pages free, held device refs
+        release."""
+        rec = self._swapped.pop(handle)
+        for kind, val in rec["entries"]:
+            if kind == "dev":
+                self.refs[val] -= 1
+                if self.refs[val] == 0:
+                    self._free.append(val)
+            else:
+                self._host_free(val)
+
+    # -- page-accounting audit --------------------------------------------
+    def audit(self) -> dict:
+        """Check every page-accounting invariant and return pool
+        stats; raises ``AssertionError`` on the first violation.  Used
+        by the fuzz test and handy when debugging allocator state:
+
+        * ``refs[pid] == #rows owning + #index entries + #swap-record
+          "dev" holds`` for every page;
+        * the free list is duplicate-free, never contains page 0, and
+          intersects neither owned nor index nor swap-held pages;
+        * a page owned by two rows must be a prefix-index page (the
+          immutability contract sharing relies on);
+        * ``tables[b]`` mirrors ``_owned[b]`` positionally;
+        * host tier: free list + (host index ∪ swap-record "host"
+          pages) partition the pool exactly.
+        """
+        from collections import Counter
+        free = self._free
+        assert len(set(free)) == len(free), "free list has duplicates"
+        assert 0 not in set(free), "reserved page 0 on the free list"
+        owned_cnt: Counter = Counter()
+        for b, row in enumerate(self._owned):
+            assert len(set(row)) == len(row), \
+                f"row {b} owns a page twice"
+            for j, pid in enumerate(row):
+                assert int(self.tables[b, j]) == pid, \
+                    f"tables[{b},{j}]={self.tables[b, j]} != owned {pid}"
+            owned_cnt.update(row)
+        index_cnt = Counter(self._prefix_index.values())
+        swap_cnt = Counter(pid for rec in self._swapped.values()
+                           for kind, pid in rec["entries"]
+                           if kind == "dev")
+        free_set = set(free)
+        for pid in range(self.num_pages):
+            want = owned_cnt[pid] + index_cnt[pid] + swap_cnt[pid]
+            assert int(self.refs[pid]) == want, \
+                (f"page {pid}: refs {int(self.refs[pid])} != owned "
+                 f"{owned_cnt[pid]} + index {index_cnt[pid]} + "
+                 f"swapped {swap_cnt[pid]}")
+            if pid in free_set:
+                assert want == 0, f"page {pid} free while referenced"
+        for pid, c in owned_cnt.items():
+            if c > 1:
+                assert index_cnt[pid] > 0, \
+                    (f"page {pid} owned by {c} rows but not a prefix-"
+                     f"index page (sharing is index-mediated only)")
+        # chain structure: a live key whose parent is also live must
+        # sit in the parent's children set, or leaf-first eviction
+        # could take the parent from under it
+        live = set(self._prefix_index) | set(self._host_prefix_index)
+        for key in live:
+            parent = self._prefix_parent.get(key)
+            if parent is not None and parent in live:
+                assert key in self._prefix_children.get(parent, ()), \
+                    "prefix chain edge missing (parent unaware of " \
+                    "live child)"
+        stats = {"free": len(free), "owned": sum(owned_cnt.values()),
+                 "indexed": len(self._prefix_index),
+                 "swap_records": len(self._swapped)}
+        if self.host is not None:
+            hfree = self.host._free
+            assert len(set(hfree)) == len(hfree), \
+                "host free list has duplicates"
+            used = list(self._host_prefix_index.values()) + [
+                hid for rec in self._swapped.values()
+                for kind, hid in rec["entries"] if kind == "host"]
+            assert len(set(used)) == len(used), \
+                "host page held twice"
+            assert not (set(hfree) & set(used)), \
+                "host page free while in use"
+            assert len(hfree) + len(used) == self.host.num_pages, \
+                "host pages leaked"
+            stats["host_free"] = len(hfree)
+            stats["host_indexed"] = len(self._host_prefix_index)
+        return stats
 
 
 def _rope_rows(x, theta, pos):

@@ -17,6 +17,12 @@ explicit fallbacks); the shared per-token step then advances every
 active slot.  Inactive slots carry ``lens = 0`` and attend nothing
 (the kernel visits zero pages).
 
+With a HOST PAGE TIER on the cache (``PagedKVCache(host_pages=N)``,
+models/kv_offload.py) preemption swaps the victim's pages to host RAM
+and re-admission restores them with ZERO prefill tokens, guarded by a
+bytes-vs-FLOPs cost model; without one (or when the model prices the
+re-prefill below the DMA) preemption stays recompute-style.
+
 The engine is deliberately host-simple: a queue, a free-slot list, and
 numpy bookkeeping — the device work is the two jitted programs.
 """
@@ -155,6 +161,27 @@ class ContinuousBatchingEngine:
         self.tokens_generated = 0
         self.preemptions = 0
         self.requests_finished = 0
+        # -- two-tier KV cache (host-RAM page offload) ----------------
+        # with a host tier attached to the cache, preemption SWAPS the
+        # victim's pages to host RAM instead of releasing them, and
+        # re-admission is a page restore + table rebuild with ZERO
+        # prefill tokens — guarded by the bytes-vs-FLOPs cost model
+        # below (recompute remains the fallback: host tier full, or a
+        # context cheap enough that re-prefilling beats the DMA)
+        self._offload = cache.host is not None and (
+            mesh is None or mesh.shape.get("mp", 1) == 1)
+        self._swap_handles: Dict[int, int] = {}   # rid -> swap handle
+        self.prefill_tokens_avoided = 0
+        self.resumes_swapped = 0
+        self.resumes_recompute = 0
+        self.resume_wall_s = 0.0          # resume-admission wall accum
+        self.resume_events = 0
+        # cost-model knobs (overridable): assumed swap DMA bandwidth
+        # and chip compute rate; None chip_flops = platform default
+        # (v5e bf16 peak on TPU, a conservative CPU figure otherwise)
+        self.offload_swap_gbps = 10.0
+        self.offload_chip_flops = None
+        self._n_params = None             # lazily counted for FLOPs
         self.B = cache.tables.shape[0]
         self._free_slots = list(range(self.B))
         self._queue: deque = deque()
@@ -303,9 +330,16 @@ class ContinuousBatchingEngine:
         return req.prompt
 
     def _release_slot(self, slot: int) -> None:
-        """Free a slot's cache rows (hook: subclasses with auxiliary
-        caches extend this)."""
+        """Free a slot's cache rows, main and auxiliary."""
         self.cache.release_row(slot)
+        self._release_aux(slot)
+
+    def _release_aux(self, slot: int) -> None:
+        """Hook: subclasses with auxiliary caches (the speculative
+        engine's draft cache) release them here.  Split from
+        :meth:`_release_slot` because a swap-out preemption keeps the
+        MAIN cache row (parked in the host tier) while auxiliary state
+        is always rebuilt at re-admission."""
 
     def _hit_stop(self, req: Request, t: int) -> bool:
         """eos or a completed stop sequence at the generated tail."""
@@ -378,8 +412,11 @@ class ContinuousBatchingEngine:
         if self.metrics is not None:
             self.metrics.prefill_dispatches.inc()
             self.metrics.prefill_padded_tokens.inc(waste)
-        for i, (req, slot, L) in enumerate(zip(reqs, slots, Ls)):
-            self.cache.write_row_pages(slot, ks[:, i], vs[:, i], L)
+        # one coalesced scatter dispatch for the whole group (the same
+        # write_pages_batch economy the packed lane gets)
+        self.cache.write_pages_batch(
+            [(slot, ks[:, i], vs[:, i], L, 0)
+             for i, (slot, L) in enumerate(zip(slots, Ls))])
         toks = None
         if any(not r.generated for r in reqs):
             # batched first tokens from each row's LAST REAL position —
@@ -569,11 +606,14 @@ class ContinuousBatchingEngine:
             self.metrics.prefill_dispatches.inc()
             self.metrics.prefill_padded_tokens.inc(Tb - real)
             self.metrics.prefill_packed_tokens.observe(Tb)
-        for req, ctx, slot, start, s_real, Wp, off in plan:
-            a = off + start
-            self.cache.write_row_pages(
-                slot, ks[:, a:a + Wp], vs[:, a:a + Wp], s_real,
-                first_page=start // page)
+        # the whole wave's page writes coalesce into ONE scatter
+        # dispatch (write_pages_batch) — per-segment write_row_pages
+        # calls used to cost one device dispatch per admitted row
+        self.cache.write_pages_batch(
+            [(slot, ks[:, off + start:off + start + Wp],
+              vs[:, off + start:off + start + Wp], s_real,
+              start // page)
+             for req, ctx, slot, start, s_real, Wp, off in plan])
         reqs = [p[0] for p in plan]
         toks_out = None
         if any(not r.generated for r in reqs):
@@ -600,25 +640,111 @@ class ContinuousBatchingEngine:
                 self._stream.append((req.rid, tok))
             self._finish_admit(req, slot, tok)
 
+    def _admit_swapped(self, req: Request) -> bool:
+        """Re-admit a swapped-out request: restore its parked pages
+        (one batched dispatch) and rebuild the table — ZERO prefill
+        tokens, no sampling (the next input token was saved).  On
+        device-pool exhaustion the swapped copy is dropped and False
+        returns — the caller requeues for recompute admission in
+        FIFO order."""
+        t0 = time.perf_counter()
+        handle = self._swap_handles.pop(req.rid)
+        slot = self._free_slots.pop()
+        try:
+            restored = self.cache.swap_in_row(slot, handle)
+        except RuntimeError:
+            self.cache.discard_swap(handle)
+            self._free_slots.append(slot)
+            return False
+        self.prefill_tokens_avoided += restored
+        self.resumes_swapped += 1
+        dt = time.perf_counter() - t0
+        self.resume_wall_s += dt
+        self.resume_events += 1
+        if self.metrics is not None:
+            m = self.metrics
+            m.preempt_resume_swapped.inc()
+            m.prefill_tokens_avoided.inc(restored)
+            m.preempt_resume_seconds.observe(dt)
+            m.ring.emit("swap_resume", rid=req.rid, slot=slot,
+                        tokens=restored)
+        self._finish_admit(req, slot, req.generated[-1])
+        return True
+
+    def _preempt_mode(self, slot: int) -> str:
+        """Bytes-vs-FLOPs preemption cost model: ``"swap"`` when
+        parking the victim's pages in the host tier and restoring them
+        later is cheaper than re-prefilling the context, else
+        ``"recompute"``.  The swap moves the row's PRIVATE pages out
+        and back (2x the bytes) at ``offload_swap_gbps``; recompute
+        pays one forward pass over the context (~2*N_params FLOPs per
+        token) at the chip's rate.  Falls back to recompute when the
+        host tier is absent, full, or the context is cheap."""
+        if not self._offload:
+            return "recompute"
+        cache = self.cache
+        L = int(cache.lens[slot])
+        private = cache.private_pages(slot)
+        if private == 0:
+            return "swap"         # all pages shared: zero transfer,
+            #                       and the resume still skips prefill
+        if cache.host_available() < private:
+            return "recompute"    # host tier full
+        if self._n_params is None:
+            self._n_params = sum(
+                int(np.prod(x.shape))
+                for x in jax.tree_util.tree_leaves(self.params))
+        chip = self.offload_chip_flops
+        if chip is None:
+            chip = (197e12 if jax.devices()[0].platform
+                    in ("tpu", "axon") else 5e10)
+        swap_s = (2.0 * private * cache.page_bytes
+                  / (self.offload_swap_gbps * 1e9))
+        recompute_s = 2.0 * self._n_params * L / chip
+        return "swap" if swap_s < recompute_s else "recompute"
+
+    def _degrade_one_swap(self) -> bool:
+        """Last-resort page reclamation: drop one parked swap record
+        (its request falls back to recompute resumption), releasing
+        the device refs it held on shared pages and its host pages.
+        Keeps the engine at least as live as the pure-recompute one —
+        swap records must never wedge the allocator."""
+        if not self._swap_handles:
+            return False
+        rid = next(iter(self._swap_handles))
+        self.cache.discard_swap(self._swap_handles.pop(rid))
+        return True
+
     def _preempt(self, keep: int) -> bool:
         """Evict the most recently admitted active request (except slot
-        ``keep``), release its pages, and requeue it at the FRONT of
-        the queue for recompute-style resumption.  Returns False when
-        there is no eligible victim (pool genuinely too small)."""
+        ``keep``) and requeue it at the FRONT of the queue.  With a
+        host tier and a favourable cost model the victim's pages SWAP
+        OUT (resume = restore, zero prefill); otherwise they release
+        (recompute-style resumption).  Returns False when there is no
+        eligible victim (pool genuinely too small)."""
         victims = [s for s in self._active if s != keep]
         if not victims:
             return False
         slot = max(victims, key=lambda s: self._active[s].admit_seq)
+        mode = self._preempt_mode(slot)
         req = self._active.pop(slot)
         req.slot = None
         req.preempted += 1
         self.preemptions += 1
+        if mode == "swap":
+            t0 = time.perf_counter()
+            self._swap_handles[req.rid] = self.cache.swap_out_row(slot)
+            self._release_aux(slot)
+            if self.metrics is not None:
+                self.metrics.swap_seconds.observe(
+                    time.perf_counter() - t0)
+        else:
+            self._release_slot(slot)
         if self.metrics is not None:
             self.metrics.preemptions.inc()
             self.metrics.ring.emit("preemption", rid=req.rid,
-                                   slot=slot,
+                                   slot=slot, mode=mode,
                                    generated=len(req.generated))
-        self._release_slot(slot)
         self._free_slots.append(slot)
         self._remaining[slot] = 0
         self._active_mask[slot] = 0
@@ -655,17 +781,28 @@ class ContinuousBatchingEngine:
                         preempted=req.preempted)
         self._finished.append(req)
 
-    def step(self) -> int:
-        """Admit + one decode token for every active slot.  Returns the
-        number of active requests after the step."""
-        # collect every request that fits (slots + pool pages), then
-        # admit same-bucket groups with ONE batched prefill each.
-        # Head-of-line FIFO: stop at the first that doesn't fit — a
-        # failed alloc mid-loop would crash the engine.
+    def _collect_admissions(self):
+        """Pop every queued request that fits (slots + pool pages).
+        Head-of-line FIFO: stop at the first that doesn't fit — a
+        failed alloc mid-loop would crash the engine.  Swapped-out
+        requests gate on the device pages their restore must claim
+        (their on-device shared pages are already held) and bypass the
+        prefill lanes entirely."""
         admits: List = []                    # (request, context) pairs
+        swap_ins: List = []                  # swapped-row restores
         reserved = 0
-        while self._queue and len(self._free_slots) > len(admits):
-            ctx = self._ctx_of(self._queue[0])
+        while self._queue and \
+                len(self._free_slots) > len(admits) + len(swap_ins):
+            head = self._queue[0]
+            handle = self._swap_handles.get(head.rid)
+            if handle is not None:
+                need = self.cache.swap_pages_needed(handle)
+                if reserved + need > self.cache.available_pages():
+                    break
+                reserved += need
+                swap_ins.append(self._queue.popleft())
+                continue
+            ctx = self._ctx_of(head)
             need = (len(ctx) + self.cache.page - 1) // self.cache.page
             # budget against free + EVICTABLE cached-prefix pages: the
             # raw free list shrinks permanently as prompts register,
@@ -673,18 +810,44 @@ class ContinuousBatchingEngine:
             if reserved + need > self.cache.available_pages():
                 break
             reserved += need
+            if head.generated:               # recompute-style resume
+                self.resumes_recompute += 1
+                if self.metrics is not None:
+                    self.metrics.preempt_resume_recompute.inc()
             admits.append((self._queue.popleft(), ctx))
-        if admits and self.overlap:
+        return admits, swap_ins
+
+    def step(self) -> int:
+        """Admit + one decode token for every active slot.  Returns the
+        number of active requests after the step."""
+        admits, swap_ins = self._collect_admissions()
+        while not admits and not swap_ins and not self._active \
+                and self._queue and self._degrade_one_swap():
+            # nothing fits and nothing is running: parked swap records
+            # are the only thing still pinning pages — degrade them to
+            # recompute resumes until the head of the queue fits
+            admits, swap_ins = self._collect_admissions()
+        if (admits or swap_ins) and self.overlap:
             # admission is a scheduler mutation: drain the lookahead
             # pipeline before slots/pages move under it
             self._pipeline_flush()
+        failed_swap_ins = [req for req in swap_ins
+                           if not self._admit_swapped(req)]
+        for req in reversed(failed_swap_ins):
+            # requeue in FIFO order (appendleft reverses, so walk the
+            # failures back-to-front): the oldest failed resume must
+            # stay at the head for its recompute admission
+            self._queue.appendleft(req)
+        all_resumes = bool(admits) and all(r.generated
+                                           for r, _ in admits)
+        t_adm = time.perf_counter() if admits else 0.0
         if admits and self._packed:
             # PACKED VARLEN lane: any length mix (prefix-cache
             # suffixes, long prompts, resumes) is ONE dispatch per
             # wave — prefill_chunk is moot here, the per-wave cost is
             # bounded by the total waiting tokens, not per prompt
             self._admit_packed(admits)
-        else:
+        elif admits:
             buckets: Dict[int, List] = {}
             for req, ctx in admits:
                 L = len(ctx)
@@ -698,6 +861,18 @@ class ContinuousBatchingEngine:
                 buckets.setdefault(Lp, []).append((req, ctx))
             for group in buckets.values():
                 self._admit_batch(group)
+        if all_resumes:
+            # an all-resume recompute wave: its admission wall IS the
+            # resume latency, attributed PER REQUEST so the sample
+            # stays comparable with the per-request swap-in samples
+            # (mixed waves are not attributed — a fresh prompt's
+            # prefill would pollute the sample)
+            dt = time.perf_counter() - t_adm
+            self.resume_wall_s += dt
+            self.resume_events += len(admits)
+            if self.metrics is not None:
+                self.metrics.preempt_resume_seconds.observe(
+                    dt / len(admits))
         if not self._active:
             return 0
         if self.metrics is None:
@@ -743,10 +918,15 @@ class ContinuousBatchingEngine:
                             break
                         continue
                     # pool exhausted mid-flight: preempt the youngest
-                    # other request (pages freed, request requeued)
-                    # instead of crashing the engine and losing every
-                    # in-flight generation
+                    # other request (pages freed or swapped, request
+                    # requeued) instead of crashing the engine and
+                    # losing every in-flight generation
                     if not self._preempt(keep=slot):
+                        # no victim left — parked swap records may
+                        # still hold shared-page refs: degrade them to
+                        # recompute resumes before giving up
+                        if self._degrade_one_swap():
+                            continue
                         raise RuntimeError(
                             "KV page pool exhausted and no preemption "
                             "victim remains; the pool is too small for "
@@ -939,6 +1119,10 @@ class ContinuousBatchingEngine:
             return
         while self._inflight:
             self._drain_one()
+        if self.cache.host is not None:
+            # scheduler-mutation point: commit staged swap-out copies
+            # (they rode under the drained dispatches) into host RAM
+            self.cache.host.flush()
         self._dev = None
         self._needs_flush = False
         self.pipeline_flushes += 1

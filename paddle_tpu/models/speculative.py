@@ -244,8 +244,10 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             self.metrics.spec_gamma.set(self.gamma)
 
     # -- hooks ---------------------------------------------------------
-    def _release_slot(self, slot):
-        super()._release_slot(slot)
+    def _release_aux(self, slot):
+        # called by _release_slot AND by swap-out preemption (which
+        # parks the TARGET cache row in the host tier but always
+        # rebuilds draft state at re-admission)
         self.dcache.release_row(slot)
         self._seq.pop(slot, None)
 

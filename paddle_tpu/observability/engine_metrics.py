@@ -39,6 +39,11 @@ _PACKED_BUCKETS = (64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
 _HOST_BUCKETS = (0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
                  0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                  1.0)
+# KV page swap / preempt-resume latencies: 10us (a few staged pages on
+# CPU) .. 10s (a long context restored over a slow link)
+_SWAP_BUCKETS = (0.00001, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 10.0)
 
 
 class EngineMetrics:
@@ -143,6 +148,52 @@ class EngineMetrics:
             "paddle_tpu_kvcache_page_utilization_ratio",
             "Allocated usable pages / usable pool (page 0 reserved)")
 
+        # -- two-tier KV cache (host-RAM page offload) ------------------
+        self.swap_out_pages = r.counter(
+            "paddle_tpu_kvcache_swap_out_pages_total",
+            "KV pages moved device -> host tier (preemption swap-outs "
+            "+ prefix-cache demotions)")
+        self.swap_in_pages = r.counter(
+            "paddle_tpu_kvcache_swap_in_pages_total",
+            "KV pages restored host -> device (swap-in resumes + "
+            "prefix promotions)")
+        self.swap_bytes = r.counter(
+            "paddle_tpu_kvcache_swap_bytes_total",
+            "Bytes moved between the device pool and the host tier, "
+            "both directions")
+        self.swap_seconds = r.histogram(
+            "paddle_tpu_kvcache_swap_seconds",
+            "Host-observed wall time of one swap-out staging (gather "
+            "dispatch + async-copy setup; the copy itself overlaps "
+            "decode)",
+            buckets=_SWAP_BUCKETS)
+        self.host_pool_pages = r.gauge(
+            "paddle_tpu_kvcache_host_pool_pages",
+            "Host-tier pages in use (swapped rows + demoted prefixes)")
+        self.host_pool_free_pages = r.gauge(
+            "paddle_tpu_kvcache_host_pool_free_pages",
+            "Host-tier pages on the free list (0 when no host tier "
+            "is attached)")
+        self.preempt_resume_swapped = r.counter(
+            "paddle_tpu_engine_preempt_resume_swapped_total",
+            "Preempted requests re-admitted via host-tier page "
+            "restore (zero prefill tokens)")
+        self.preempt_resume_recompute = r.counter(
+            "paddle_tpu_engine_preempt_resume_recompute_total",
+            "Preempted requests re-admitted via context re-prefill "
+            "(no host tier, host tier full, or cost model chose "
+            "recompute)")
+        self.preempt_resume_seconds = r.histogram(
+            "paddle_tpu_engine_preempt_resume_seconds",
+            "Re-admission wall per preempted request (swap-in "
+            "restore, or the admission wall of an all-resume "
+            "recompute wave)",
+            buckets=_SWAP_BUCKETS)
+        self.prefill_tokens_avoided = r.counter(
+            "paddle_tpu_engine_prefill_tokens_avoided_total",
+            "Context tokens restored from the host tier instead of "
+            "being re-prefilled")
+
         # -- speculative decoding ---------------------------------------
         self.spec_rounds = r.counter(
             "paddle_tpu_spec_rounds_total",
@@ -191,3 +242,11 @@ def bind_engine_gauges(m: EngineMetrics, engine) -> None:
     m.kv_utilization.set_function(
         _weak_fn(cache,
                  lambda c: 1.0 - c.free_pages() / usable))
+    m.host_pool_pages.set_function(
+        _weak_fn(cache,
+                 lambda c: float(c.host.used_pages())
+                 if c.host is not None else 0.0))
+    m.host_pool_free_pages.set_function(
+        _weak_fn(cache,
+                 lambda c: float(c.host.free_pages())
+                 if c.host is not None else 0.0))

@@ -1,0 +1,481 @@
+"""Two-tier KV cache: host-RAM page offload (models/kv_offload.py +
+the PagedKVCache/engine plumbing over it).
+
+Contract under test:
+* PREEMPT-RESUME via the host tier is recompute-free — the victim's
+  pages swap out to host RAM and re-admission is a page restore +
+  table rebuild with ZERO prefill tokens (pinned through the
+  ``prefill_calls`` / ``prefill_token_slots`` counters) — and greedy
+  outputs stay token-exact vs the no-offload engine and the solo
+  dense runs, across the packed/batched/chunked admission lanes,
+  int8 KV pools, and ``overlap=True``;
+* the prefix cache DEMOTES evicted pages to the host tier and
+  PROMOTES them back on lookup — effective prefix depth scales with
+  host RAM, outputs stay exact;
+* the bytes-vs-FLOPs cost model falls back to recompute when the
+  host tier is full or the swap is priced above the re-prefill;
+* page accounting stays invariant under churn (the ``audit()``
+  helper + a randomized fuzz over admit/retire/swap/prefix ops).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.decode import make_generate
+from paddle_tpu.models.paged_decode import PagedKVCache, _prefill
+from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+
+
+def _cfg():
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+def _params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+def _solo_ref(cfg, params, prompt, new):
+    g = make_generate(cfg, prompt_len=len(prompt), max_new_tokens=new)
+    return list(np.asarray(g(params, jnp.asarray(prompt[None]),
+                             jax.random.PRNGKey(0)))[0])
+
+
+def _drive(eng, cache, audit=True):
+    """run_to_completion that audits page accounting every step and
+    checks the zero-prefill property of every swapped resume."""
+    done = []
+    zero_prefill_resumes = 0
+    steps = 0
+    while eng.has_work():
+        pre = (eng.prefill_calls, eng.prefill_token_slots,
+               eng.resumes_swapped)
+        eng.step()
+        done.extend(eng.finished())
+        if eng.resumes_swapped > pre[2]:
+            assert eng.prefill_calls == pre[0], \
+                "swapped resume dispatched a prefill"
+            assert eng.prefill_token_slots == pre[1], \
+                "swapped resume consumed prefill token slots"
+            zero_prefill_resumes += eng.resumes_swapped - pre[2]
+        if audit:
+            cache.audit()
+        steps += 1
+        assert steps < 500
+    done.sort(key=lambda r: r.rid)
+    return done, zero_prefill_resumes
+
+
+# pool sized so two 16-token prompts + 20 new tokens each (3 pages
+# peak per row) exceed the 4 usable pages -> forced preemption
+_TIGHT = dict(num_pages=5, pages_max=4, batch=2, page=16)
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+@pytest.mark.parametrize("lane", ["packed", "batched", "chunked"])
+def test_swap_preempt_resume_token_exact(kv_quant, lane):
+    """Recompute-free preemption across all three admission lanes and
+    both pool dtypes: the offload engine preempts, swaps the victim to
+    the host tier, restores it with zero prefill tokens, and its
+    outputs equal the no-offload engine's (and, fp pools, the solo
+    dense runs)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+    kw = {"packed": {},
+          "batched": {"packed": False},
+          "chunked": {"packed": False, "prefill_chunk": 32}}[lane]
+
+    def run(host_pages):
+        cache = PagedKVCache(cfg, kv_quant=kv_quant,
+                             host_pages=host_pages, **_TIGHT)
+        eng = ContinuousBatchingEngine(cfg, params, cache, **kw)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=20)
+        done, zp = _drive(eng, cache)
+        assert cache.free_pages() == cache.num_pages - 1
+        assert cache.host is None or cache.host.used_pages() == 0
+        return done, eng, zp
+
+    done_off, eng_off, _ = run(0)
+    done_on, eng_on, zp = run(16)
+    assert eng_off.preemptions > 0 and eng_on.preemptions > 0
+    assert eng_on.resumes_swapped > 0 and zp > 0
+    assert eng_on.prefill_tokens_avoided > 0
+    assert eng_off.resumes_swapped == 0
+    got_on = [list(r.generated) for r in done_on]
+    assert got_on == [list(r.generated) for r in done_off]
+    if kv_quant is None:
+        for toks, p in zip(got_on, prompts):
+            assert toks == _solo_ref(cfg, params, p, 20)
+
+
+def test_swap_resume_counts_and_bytes():
+    """The swap observability surface: page/byte counters on the cache
+    agree with the registry instruments, and the resume-mode counters
+    split swapped vs recompute."""
+    from paddle_tpu.observability import MetricsRegistry
+
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(6)
+    reg = MetricsRegistry()
+    cache = PagedKVCache(cfg, host_pages=16, **_TIGHT)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=reg)
+    for _ in range(2):
+        eng.submit(rng.randint(1, 128, (16,)), max_new_tokens=20)
+    _drive(eng, cache)
+    assert cache.swap_out_pages > 0
+    assert cache.swap_in_pages == cache.swap_out_pages
+    assert cache.swap_bytes == \
+        (cache.swap_out_pages + cache.swap_in_pages) * cache.page_bytes
+    assert reg.get("paddle_tpu_kvcache_swap_out_pages_total").value \
+        == cache.swap_out_pages
+    assert reg.get("paddle_tpu_kvcache_swap_in_pages_total").value \
+        == cache.swap_in_pages
+    assert reg.get("paddle_tpu_kvcache_swap_bytes_total").value \
+        == cache.swap_bytes
+    assert reg.get(
+        "paddle_tpu_engine_preempt_resume_swapped_total").value \
+        == eng.resumes_swapped > 0
+    assert reg.get(
+        "paddle_tpu_engine_prefill_tokens_avoided_total").value \
+        == eng.prefill_tokens_avoided > 0
+    assert reg.get("paddle_tpu_kvcache_host_pool_pages").value == 0.0
+    assert reg.get(
+        "paddle_tpu_kvcache_host_pool_free_pages").value == 16.0
+
+
+def test_swap_offload_overlap_token_exact():
+    """offload composes with the dispatch-ahead pipeline: preemption
+    under ``overlap=True`` swaps out (after the mandatory flush) and
+    the run stays token-exact vs the synchronous no-offload engine."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+
+    def run(host_pages, overlap):
+        cache = PagedKVCache(cfg, host_pages=host_pages, **_TIGHT)
+        eng = ContinuousBatchingEngine(cfg, params, cache,
+                                       overlap=overlap)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=20)
+        done, _ = _drive(eng, cache)
+        return [list(r.generated) for r in done], eng
+
+    got_sync, _ = run(0, overlap=False)
+    got_over, eng = run(16, overlap=True)
+    assert eng.resumes_swapped > 0
+    assert got_over == got_sync
+
+
+def test_host_prefix_demotion_and_promotion_token_exact():
+    """Pool pressure DEMOTES cached-prefix leaves to the host tier
+    instead of destroying them; a later admission that misses in HBM
+    but hits the host tier PROMOTES the pages back (one batched
+    restore) and reuses them — token-exact, with prefix depth now
+    bounded by host RAM, not the decode pool."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(15)
+    cache = PagedKVCache(cfg, num_pages=9, pages_max=8, batch=1,
+                         page=16, host_pages=8)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   enable_prefix_caching=True)
+    p1 = rng.randint(1, 128, (50,))
+    eng.submit(p1, max_new_tokens=3)
+    _drive(eng, cache)
+    assert len(cache._prefix_index) == 3
+    # a big unrelated request drains the pool: the prefix pages must
+    # demote to host RAM, not die
+    p2 = rng.randint(1, 128, (70,))
+    eng.submit(p2, max_new_tokens=30)
+    done, _ = _drive(eng, cache)
+    assert list(done[0].generated) == _solo_ref(cfg, params, p2, 30)
+    assert len(cache._host_prefix_index) > 0, \
+        "pool pressure should have demoted prefix pages to host"
+    assert cache.swap_out_pages > 0
+    # a p1-sharing admission promotes the host-tier pages back
+    promos0, hits0 = cache.prefix_promotions, cache.prefix_hits
+    p3 = np.concatenate([p1[:48], rng.randint(1, 128, (3,))])
+    eng.submit(p3, max_new_tokens=4)
+    done3, _ = _drive(eng, cache)
+    assert cache.prefix_promotions > promos0
+    assert cache.prefix_hits - hits0 == 3     # all 3 prefix pages hit
+    assert list(done3[0].generated) == _solo_ref(cfg, params, p3, 4)
+
+
+def test_cost_model_falls_back_when_host_tier_full():
+    """A host tier too small for the victim's private pages forces the
+    recompute path — the engine must degrade, not wedge, and outputs
+    stay exact."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+    cache = PagedKVCache(cfg, host_pages=1, **_TIGHT)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=20)
+    done, _ = _drive(eng, cache)
+    assert eng.preemptions > 0
+    assert eng.resumes_swapped == 0, \
+        "a 1-page host tier cannot hold a 2-page victim"
+    assert eng.resumes_recompute > 0
+    for req, p in zip(done, prompts):
+        assert list(req.generated) == _solo_ref(cfg, params, p, 20)
+
+
+def test_cost_model_falls_back_when_recompute_cheaper():
+    """Pricing the swap link absurdly slow flips the bytes-vs-FLOPs
+    decision to recompute even with host space available."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 128, (16,)) for _ in range(2)]
+    cache = PagedKVCache(cfg, host_pages=16, **_TIGHT)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    eng.offload_swap_gbps = 1e-9          # ~1 byte/s: DMA "slower"
+    #                                       than any re-prefill
+    for p in prompts:
+        eng.submit(p, max_new_tokens=20)
+    done, _ = _drive(eng, cache)
+    assert eng.preemptions > 0
+    assert eng.resumes_swapped == 0 and eng.resumes_recompute > 0
+    for req, p in zip(done, prompts):
+        assert list(req.generated) == _solo_ref(cfg, params, p, 20)
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_swap_roundtrip_bitexact_at_cache_level(kv_quant):
+    """swap_out_row -> swap_in_row round-trips page content (and int8
+    scales) BITWISE through the host tier, via exactly one batched
+    restore dispatch."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(3)
+    cache = PagedKVCache(cfg, num_pages=12, pages_max=8, batch=2,
+                         page=16, kv_quant=kv_quant, host_pages=8)
+    cache.alloc_row(0, 40)
+    padded = np.zeros((1, 48), np.int64)
+    padded[0, :40] = rng.randint(1, 128, (40,))
+    x, ks, vs = _prefill(cfg)(params, jnp.asarray(padded))
+    cache.write_row_pages(0, ks[:, 0], vs[:, 0], 40)
+    ids0 = [int(cache.tables[0, j]) for j in range(3)]
+    k0 = np.asarray(cache.kpool[:, ids0]).copy()
+    v0 = np.asarray(cache.vpool[:, ids0]).copy()
+    s0 = np.asarray(cache.kscale[:, ids0]).copy() \
+        if kv_quant == "int8" else None
+    handle = cache.swap_out_row(0)
+    cache.audit()
+    assert cache.swap_pages_needed(handle) == 3
+    assert cache.swap_ctx_len(handle) == 40
+    assert cache.host.used_pages() == 3
+    restores0 = cache.restore_dispatches
+    cache.swap_in_row(1, handle)
+    cache.audit()
+    assert cache.restore_dispatches == restores0 + 1, \
+        "swap-in must be ONE batched restore dispatch"
+    ids1 = [int(cache.tables[1, j]) for j in range(3)]
+    np.testing.assert_array_equal(k0, np.asarray(cache.kpool[:, ids1]))
+    np.testing.assert_array_equal(v0, np.asarray(cache.vpool[:, ids1]))
+    if kv_quant == "int8":
+        np.testing.assert_array_equal(
+            s0, np.asarray(cache.kscale[:, ids1]))
+    assert int(cache.lens[1]) == 40
+    assert cache.host.used_pages() == 0
+
+
+def test_audit_detects_corruption():
+    """audit() is load-bearing for the fuzz below: it must actually
+    trip on broken accounting."""
+    cfg = _cfg()
+    cache = PagedKVCache(cfg, num_pages=8, pages_max=4, batch=2,
+                         page=16)
+    cache.alloc_row(0, 30)
+    cache.audit()
+    cache.refs[int(cache.tables[0, 0])] += 1       # phantom ref
+    with pytest.raises(AssertionError, match="refs"):
+        cache.audit()
+    cache.refs[int(cache.tables[0, 0])] -= 1
+    cache.audit()
+    cache._free.append(int(cache.tables[0, 1]))    # free while owned
+    with pytest.raises(AssertionError):
+        cache.audit()
+
+
+@pytest.mark.parametrize("offload", [False, True])
+def test_page_accounting_fuzz(offload):
+    """Randomized admit / retire / swap-preempt / resume / discard /
+    prefix-register / grow churn with audit() after every op: the
+    ref-count identity (refs == owned + index + swap-held), free-list
+    disjointness, cross-row sharing only through the index, and host
+    pool partitioning must hold at every step."""
+    cfg = _cfg()
+    rng = np.random.RandomState(1234 + int(offload))
+    cache = PagedKVCache(cfg, num_pages=12, pages_max=6, batch=3,
+                         page=16, host_pages=8 if offload else 0)
+    shared_ctx = rng.randint(1, 128, (48,))        # common prefix
+    row_busy = [False] * 3
+    row_ctx = [None] * 3
+    handles = []                                   # swapped records
+
+    def free_row():
+        rows = [b for b in range(3) if not row_busy[b]]
+        return rng.choice(rows) if rows else None
+
+    def busy_row():
+        rows = [b for b in range(3) if row_busy[b]]
+        return rng.choice(rows) if rows else None
+
+    for step in range(300):
+        op = rng.randint(0, 7)
+        try:
+            if op == 0:                            # admit fresh
+                b = free_row()
+                if b is not None:
+                    L = int(rng.randint(1, 80))
+                    cache.alloc_row(b, L)
+                    row_busy[b] = True
+                    row_ctx[b] = rng.randint(1, 128, (L,))
+            elif op == 1:                          # admit via prefix
+                b = free_row()
+                if b is not None:
+                    tail = rng.randint(1, 128,
+                                       (int(rng.randint(1, 20)),))
+                    ctx = np.concatenate([shared_ctx, tail])
+                    cache.alloc_row_prefix(b, ctx)
+                    row_busy[b] = True
+                    row_ctx[b] = ctx
+            elif op == 2:                          # register prefix
+                b = busy_row()
+                if b is not None and row_ctx[b] is not None:
+                    cache.register_prefix(b, row_ctx[b])
+            elif op == 3:                          # retire
+                b = busy_row()
+                if b is not None:
+                    cache.release_row(b)
+                    row_busy[b] = False
+                    row_ctx[b] = None
+            elif op == 4:                          # grow
+                b = busy_row()
+                if b is not None:
+                    cache.ensure_capacity(
+                        b, new_tokens=int(rng.randint(1, 20)))
+            elif op == 5 and offload:              # swap-preempt
+                b = busy_row()
+                if b is not None:
+                    handles.append(cache.swap_out_row(b))
+                    row_busy[b] = False
+                    row_ctx[b] = None
+            elif op == 6 and handles:              # resume / discard
+                h = handles.pop()
+                if rng.randint(0, 4) == 0:
+                    cache.discard_swap(h)
+                else:
+                    b = free_row()
+                    if b is None:
+                        handles.append(h)
+                    else:
+                        try:
+                            cache.swap_in_row(b, h)
+                            row_busy[b] = True
+                        except RuntimeError:
+                            handles.append(h)      # record intact
+        except (RuntimeError, ValueError):
+            pass                                   # pool/row limits
+        cache.audit()
+    # drain everything; the pool must reconcile exactly
+    for b in range(3):
+        cache.release_row(b)
+    for h in handles:
+        cache.discard_swap(h)
+    stats = cache.audit()
+    assert stats["owned"] == 0 and stats["swap_records"] == 0
+    cached = len(cache._prefix_index)
+    assert cache.free_pages() == cache.num_pages - 1 - cached
+    if offload:
+        assert stats["host_free"] + stats["host_indexed"] \
+            == cache.host.num_pages
+
+
+def test_alloc_row_prefix_stats_count_only_committed_claims():
+    """Satellite regression: a pool-exhaustion rollback inside
+    alloc_row_prefix must not leave prefix hits (host counter OR
+    registry instruments) counted for pages the row never kept."""
+    from paddle_tpu.observability import EngineMetrics, MetricsRegistry
+
+    cfg = _cfg()
+    cache = PagedKVCache(cfg, num_pages=5, pages_max=16, batch=1,
+                         page=16)
+    reg = MetricsRegistry()
+    cache.metrics = EngineMetrics(reg)
+    ctx = np.arange(1, 49, dtype=np.int64)         # 3 full pages
+    cache.alloc_row(0, 48)
+    cache.register_prefix(0, ctx)
+    cache.release_row(0)
+    assert cache.free_pages() == 1
+    # shares the 3 cached pages but needs 4 more: only 1 free and the
+    # shares are claimed (refs 2) -> not evictable -> must roll back
+    big = np.concatenate([ctx, np.arange(49, 110, dtype=np.int64)])
+    with pytest.raises(RuntimeError):
+        cache.alloc_row_prefix(0, big)
+    assert cache.prefix_hits == 0, \
+        "rolled-back claim must not count prefix hits"
+    assert reg.get(
+        "paddle_tpu_kvcache_prefix_hit_pages_total").value == 0
+    assert reg.get(
+        "paddle_tpu_kvcache_prefix_miss_pages_total").value == 0
+    cache.audit()
+    # the committed path still counts
+    reused = cache.alloc_row_prefix(0, np.concatenate(
+        [ctx, np.arange(49, 54, dtype=np.int64)]))
+    assert reused == 48 and cache.prefix_hits == 3
+    assert reg.get(
+        "paddle_tpu_kvcache_prefix_hit_pages_total").value == 3
+
+
+def test_ensure_capacity_bumps_tables_version_once():
+    """Satellite regression: growing a row by several pages must bump
+    ``tables_version`` ONCE per call — every bump invalidates the
+    overlap loop's device-resident tables and forces a re-upload."""
+    cfg = _cfg()
+    cache = PagedKVCache(cfg, num_pages=16, pages_max=8, batch=1,
+                         page=16)
+    cache.alloc_row(0, 16)
+    v0 = cache.tables_version
+    cache.ensure_capacity(0, new_tokens=64)        # grows 4 pages
+    assert len(cache._owned[0]) == 5
+    assert cache.tables_version == v0 + 1
+    cache.ensure_capacity(0, new_tokens=1)         # no growth
+    assert cache.tables_version == v0 + 1
+
+
+def test_host_tier_rejects_tensor_parallel_mesh():
+    """The host tier is single-device for now: a kv-head-sharded pool
+    must refuse it loudly at construction."""
+    from paddle_tpu.models.llama_pretrain import build_mesh
+
+    cfg = _cfg()
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=2,
+                      devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="single-device"):
+        PagedKVCache(cfg, num_pages=8, pages_max=4, batch=2, page=16,
+                     mesh=mesh, host_pages=8)

@@ -286,6 +286,30 @@ def test_packed_padding_waste_reduced_and_observable():
     assert hist.sum == eng_p.prefill_token_slots >= sum(lens)
 
 
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_packed_wave_page_writes_are_one_scatter_dispatch(kv_quant):
+    """Satellite pin: a packed admission wave's per-segment K/V page
+    writes coalesce into exactly ONE scatter dispatch
+    (``write_pages_batch`` through the ``_scatter_pages`` seam) — it
+    used to be one device dispatch per admitted row."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(12)
+    eng, cache = _engine(cfg, params, batch=4, kv_quant=kv_quant)
+    prompts = [rng.randint(1, 128, (L,)) for L in (5, 21, 33, 60)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=3)
+    before = cache.scatter_dispatches
+    eng.step()                       # one admission wave, 4 segments
+    assert cache.scatter_dispatches - before == 1, \
+        "4-segment wave must write pages in ONE scatter dispatch"
+    done = eng.run_to_completion()
+    assert cache.scatter_dispatches - before == 1
+    if kv_quant is None:
+        for req, p in zip(sorted(done, key=lambda r: r.rid), prompts):
+            assert list(req.generated) == _solo_ref(cfg, params, p, 3)
+
+
 def test_packed_disabled_for_tp_mesh():
     """TP engines (mp>1) fall back to the batched lane for now — the
     packed program is not shard_mapped; the flag must switch off

@@ -109,21 +109,22 @@ def cmd_snapshot(args) -> int:
             print("no metrics snapshot found", file=sys.stderr)
             return 1
     snap = doc
-    frac = pfrac = None
+    # derived scalars bench.py writes next to the snapshot:
+    # host_overhead_frac (dispatch-ahead pipeline), the prefill
+    # padding-waste fraction, and the two-tier KV cache swap traffic
+    _DERIVED = ("host_overhead_frac", "prefill_padded_token_frac",
+                "swap_out_pages_total", "swap_in_pages_total",
+                "swap_bytes_total", "prefill_tokens_avoided_total")
+    derived = {}
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
-            if isinstance(snap.get("host_overhead_frac"), (int, float)):
-                frac = snap["host_overhead_frac"]
-            if isinstance(snap.get("prefill_padded_token_frac"),
-                          (int, float)):
-                pfrac = snap["prefill_padded_token_frac"]
+            for name in _DERIVED:
+                if isinstance(snap.get(name), (int, float)):
+                    derived[name] = snap[name]
             snap = snap[key]
     print(_render_snapshot(snap))
-    if frac is not None:
-        # host bookkeeping / decode wall — the fraction the
-        # dispatch-ahead serving pipeline overlaps away
-        print(f"host_overhead_frac = {frac:.4g}")
-    if pfrac is None and isinstance(snap, dict):
+    if "prefill_padded_token_frac" not in derived \
+            and isinstance(snap, dict):
         # derivable from a raw registry snapshot too: wasted prefill
         # slots / dispatched packed-stream slots
         padded = (snap.get(
@@ -131,11 +132,15 @@ def cmd_snapshot(args) -> int:
         packed = (snap.get(
             "paddle_tpu_engine_prefill_packed_tokens") or {})
         if packed.get("sum"):
-            pfrac = (padded.get("value") or 0.0) / packed["sum"]
-    if pfrac is not None:
-        # padding waste of prefill admission (packed lane: sub-bucket
-        # remainder only; batched lane: the pow2 grid's padding)
-        print(f"prefill_padded_token_frac = {pfrac:.4g}")
+            derived["prefill_padded_token_frac"] = \
+                (padded.get("value") or 0.0) / packed["sum"]
+    for name in _DERIVED:
+        if name in derived:
+            v = derived[name]
+            if name.endswith("_frac"):
+                print(f"{name} = {v:.4g}")
+            else:                       # exact page/byte/token counts
+                print(f"{name} = {int(v)}")
     return 0
 
 
