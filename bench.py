@@ -755,6 +755,130 @@ def _preemption_line() -> dict:
     }
 
 
+def _fault_recovery_line() -> dict:
+    """Serving under INJECTED FAULTS (testing/faults.py): the same
+    request trace runs fault-free and with a step-dispatch exception
+    injected every K decode dispatches — each fault quarantines the
+    active wave (error done-messages, engine stays up) — plus one
+    consecutive burst that escapes quarantine (engines run
+    ``max_consecutive_faults=1`` so the burst costs one extra wave,
+    not four) into an EngineSupervisor restart (queued requests
+    transplant).  Reports
+    the recovered-request rate, per-request p99 latency added by the
+    fault load, quarantine and restart counts.  ``value`` is the
+    recovered fraction of the faulted window."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import (
+        ContinuousBatchingEngine, EngineSupervisor)
+    from paddle_tpu.observability import default_registry, default_ring
+    from paddle_tpu.testing import faults
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, n_req, prompt_len, new, page = 8, 24, 128, 48, 64
+        num_pages, pages_max = 64, 8
+        fault_every, burst_at = 40, 25
+        metric = "serving_fault_recovery"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, n_req, prompt_len, new, page = 2, 12, 12, 8, 16
+        num_pages, pages_max = 64, 8
+        fault_every, burst_at = 17, 8
+        metric = "serving_fault_recovery_tiny_cpu_smoke"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+
+    def factory():
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page)
+        return ContinuousBatchingEngine(
+            cfg, params, cache, metrics_registry=default_registry(),
+            metrics_ring=default_ring(), max_consecutive_faults=1)
+
+    def run(faulted):
+        sup = EngineSupervisor(factory, max_restarts=4, backoff_s=0.0)
+        # warm every compile the timed window hits, fault-free
+        for p in prompts[:batch]:
+            sup.submit(p, max_new_tokens=4)
+        sup.run_to_completion()
+        restarts0 = sup.restarts
+        fp = faults.install() if faulted else None
+        try:
+            if faulted:
+                fp.inject("step_dispatch",
+                          RuntimeError("bench injected fault"),
+                          every=fault_every)
+                for j in range(2):     # consecutive burst: escapes
+                    #   quarantine (max 1 in a row here) -> supervisor
+                    fp.inject("step_dispatch",
+                              RuntimeError("bench injected burst"),
+                              nth=burst_at + j)
+            t0 = time.perf_counter()
+            for p in prompts:
+                sup.submit(p, max_new_tokens=new)
+            done = sup.run_to_completion()
+            dt = time.perf_counter() - t0
+            quarantines = fp.fired.get("step_dispatch", 0) \
+                if faulted else 0
+        finally:
+            if faulted:
+                faults.uninstall()
+        ok = [r for r in done if r.status == "ok"]
+        lats = sorted((r.t_finish - r.t_submit) * 1000 for r in ok)
+        p99 = (lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+               if lats else 0.0)
+        tokens = sum(len(r.generated) for r in ok)
+        return {"requests": len(done), "recovered": len(ok),
+                "faulted_requests":
+                    sum(1 for r in done if r.status == "error"),
+                "recovered_rate": round(len(ok) / max(len(done), 1),
+                                        4),
+                "p99_ms": round(p99, 2),
+                "decode_tok_per_s": round(tokens / dt, 1),
+                "injected_faults": quarantines,
+                "restarts": sup.restarts - restarts0}
+
+    clean = run(False)
+    faulty = run(True)
+    return {
+        "metric": metric,
+        "value": faulty["recovered_rate"],
+        "unit": "ratio",
+        "vs_baseline": 0,
+        "extra": {"platform": platform, "requests": n_req,
+                  "batch_slots": batch,
+                  "fault_every_k_dispatches": fault_every,
+                  "added_p99_ms": round(
+                      faulty["p99_ms"] - clean["p99_ms"], 2),
+                  "fault_free": clean, "faulted": faulty},
+    }
+
+
 def _serving_line() -> dict:
     return _serving_run(overlap=False)
 
@@ -804,6 +928,14 @@ def _snapshot_line() -> dict:
                       "prefill_tokens_avoided_total": _cval(
                           "paddle_tpu_engine_prefill_tokens_avoided"
                           "_total"),
+                      # fault-tolerance counters (the fault-recovery
+                      # bench line's engines publish process-wide)
+                      "requests_faulted_total": _cval(
+                          "paddle_tpu_engine_requests_faulted_total"),
+                      "engine_restarts_total": _cval(
+                          "paddle_tpu_engine_restarts_total"),
+                      "requests_rejected_total": _cval(
+                          "paddle_tpu_engine_requests_rejected_total"),
                       "events": default_ring().recent(50)}}
 
 
@@ -821,6 +953,7 @@ def main() -> None:
         ("serving_admission_packed_vs_batched", "x", _admission_line),
         ("serving_preemption_offload_resume_ab", "x",
          _preemption_line),
+        ("serving_fault_recovery", "ratio", _fault_recovery_line),
     ]
 
     devs, err = _init_devices()

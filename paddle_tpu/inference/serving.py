@@ -45,6 +45,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..observability import default_ring
+from ..testing import faults
 from . import Config, Predictor
 
 __all__ = ["DevicePool", "InferenceServer", "predict_http",
@@ -304,6 +305,22 @@ class _GenHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv: "GenerationServer" = self.server.owner
         path = urllib.parse.urlsplit(self.path).path.rstrip("/")
+        if path == "/health/live":
+            # LIVENESS: the serving loop thread is running.  False
+            # means restart the process — no request will ever drain.
+            ok = srv.is_live()
+            self._reply(200 if ok else 503,
+                        b'{"live": true}' if ok else b'{"live": false}')
+            return
+        if path == "/health/ready":
+            # READINESS: accepting new work (live, engine healthy,
+            # admission queue below its bound).  False means route
+            # traffic elsewhere, not restart.
+            ok = srv.is_ready()
+            self._reply(200 if ok else 503,
+                        b'{"ready": true}' if ok
+                        else b'{"ready": false}')
+            return
         if path in ("", "/health"):
             # /health is a VIEW over the metrics registry (same keys
             # as ever; single source of truth is the instrumentation,
@@ -316,6 +333,15 @@ class _GenHandler(BaseHTTPRequestHandler):
                 h = {"status": "ok" if srv._fatal is None
                      else "failed",
                      "error": srv._fatal,
+                     "live": srv.is_live(),
+                     "ready": srv.is_ready(),
+                     "restarts": srv.restarts,
+                     "requests_cancelled": eng.requests_cancelled,
+                     "requests_expired": eng.requests_expired,
+                     "requests_rejected": eng.requests_rejected,
+                     "requests_faulted": eng.requests_faulted,
+                     "step_faults": eng.step_faults,
+                     "queued_tokens": eng.queued_tokens(),
                      "active": len(eng._active),
                      "queued": len(eng._queue),
                      "free_pages": eng.cache.free_pages(),
@@ -339,6 +365,24 @@ class _GenHandler(BaseHTTPRequestHandler):
             v = _snap_val
             h = {"status": "ok" if srv._fatal is None else "failed",
                  "error": srv._fatal,
+                 "live": srv.is_live(),
+                 "ready": srv.is_ready(),
+                 "restarts": srv.restarts,
+                 "requests_cancelled": int(v(
+                     snap,
+                     "paddle_tpu_engine_requests_cancelled_total")),
+                 "requests_expired": int(v(
+                     snap,
+                     "paddle_tpu_engine_requests_expired_total")),
+                 "requests_rejected": int(v(
+                     snap,
+                     "paddle_tpu_engine_requests_rejected_total")),
+                 "requests_faulted": int(v(
+                     snap,
+                     "paddle_tpu_engine_requests_faulted_total")),
+                 "step_faults": srv.engine.step_faults,
+                 "queued_tokens": int(v(
+                     snap, "paddle_tpu_engine_queued_tokens_count")),
                  "active": int(v(
                      snap, "paddle_tpu_engine_active_requests_count")),
                  "queued": int(v(
@@ -384,25 +428,54 @@ class _GenHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         srv: "GenerationServer" = self.server.owner
         path = self.path.rstrip("/")
-        if path not in ("/generate", "/generate_stream"):
+        if path not in ("/generate", "/generate_stream", "/cancel"):
             self._reply(404, b"not found", "text/plain")
             return
+        from ..models.serving_engine import QueueFullError
         n = int(self.headers.get("Content-Length", "0"))
+        if path == "/cancel":
+            try:
+                req = json.loads(self.rfile.read(n))
+                rid = int(req["rid"])
+            except Exception as e:
+                self._reply(400,
+                            f"bad payload: {type(e).__name__}".encode(),
+                            "text/plain")
+                return
+            ok = srv.cancel(rid)
+            self._reply(200, json.dumps(
+                {"rid": rid, "cancelled": bool(ok)}).encode())
+            return
         try:
             req = json.loads(self.rfile.read(n))
             prompt = [int(t) for t in req["prompt"]]
             max_new = int(req.get("max_new_tokens", 64))
+            deadline = req.get("deadline_s")
+            deadline = None if deadline is None else float(deadline)
         except Exception as e:
             self._reply(400, f"bad payload: {type(e).__name__}".encode(),
                         "text/plain")
             return
         try:
-            rid, q = srv.submit(prompt, max_new)
+            rid, q = srv.submit(prompt, max_new, deadline_s=deadline)
         except ValueError as e:           # oversized for the pool
             self._reply(400, f"rejected: {e}".encode(), "text/plain")
             return
-        except RuntimeError:              # engine died: retry elsewhere
-            self._reply(503, b"engine unavailable", "text/plain")
+        except QueueFullError as e:       # backpressure: come back later
+            body = f"queue full: {e}".encode()
+            self.send_response(429)
+            self.send_header("Content-Type", "text/plain")
+            # finite, throughput-derived back-off hint (whole seconds,
+            # rounded up — Retry-After takes integers)
+            self.send_header("Retry-After",
+                             str(max(1, int(-(-e.retry_after // 1)))))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        except RuntimeError as e:         # engine died: retry elsewhere
+            self._reply(503, f"engine unavailable: {e}".encode(),
+                        "text/plain")
             return
         if path == "/generate":
             toks = []
@@ -410,8 +483,10 @@ class _GenHandler(BaseHTTPRequestHandler):
                 kind, payload = q.get()
                 if kind == "tok":
                     toks.append(payload)
-                elif payload is None:     # engine crashed mid-request
-                    self._reply(500, b"generation failed", "text/plain")
+                elif kind == "err" or payload is None:
+                    code, text = payload if kind == "err" \
+                        else (500, "generation failed")
+                    self._reply(code, text.encode(), "text/plain")
                     return
                 else:
                     self._reply(200, json.dumps(
@@ -419,32 +494,51 @@ class _GenHandler(BaseHTTPRequestHandler):
                     return
         # STREAMING: one JSON line per token as the engine produces it
         # (chunked transfer — the client reads lines incrementally)
-        self.send_response(200)
-        self.send_header("Content-Type", "application/x-ndjson")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
-
         def chunk(data: bytes):
+            faults.fire("stream_write")   # injected client disconnect
             self.wfile.write(f"{len(data):X}\r\n".encode() + data
                              + b"\r\n")
             self.wfile.flush()
 
-        while True:
-            kind, payload = q.get()
-            if kind == "tok":
-                chunk(json.dumps({"rid": rid,
-                                  "token": payload}).encode() + b"\n")
-            elif payload is None:               # engine crashed
-                chunk(json.dumps({"rid": rid, "done": True,
-                                  "error": "generation failed"})
-                      .encode() + b"\n")
-                chunk(b"")
-                return
-            else:
-                chunk(json.dumps({"rid": rid, "done": True,
-                                  "tokens": payload}).encode() + b"\n")
-                chunk(b"")                      # terminal chunk: 0\r\n\r\n
-                return
+        try:
+            # the status/header writes sit INSIDE the protected block:
+            # wfile is unbuffered, so a client that posted and
+            # immediately vanished raises right here — the request
+            # must still cancel instead of decoding to budget
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            while True:
+                kind, payload = q.get()
+                if kind == "tok":
+                    chunk(json.dumps(
+                        {"rid": rid,
+                         "token": payload}).encode() + b"\n")
+                elif kind == "err" or payload is None:
+                    text = payload[1] if kind == "err" \
+                        else "generation failed"
+                    chunk(json.dumps({"rid": rid, "done": True,
+                                      "error": text})
+                          .encode() + b"\n")
+                    chunk(b"")
+                    return
+                else:
+                    chunk(json.dumps({"rid": rid, "done": True,
+                                      "tokens": payload})
+                          .encode() + b"\n")
+                    chunk(b"")                  # terminal chunk: 0\r\n\r\n
+                    return
+        except (BrokenPipeError, ConnectionResetError):
+            # mid-stream disconnect: the client is gone.  Fall through
+            # to the cancel below — an abandoned stream must stop
+            # burning decode slots and cache pages.
+            pass
+        finally:
+            # release the request whatever happened above: a no-op
+            # after normal completion (the rid already finished), a
+            # cancellation after a disconnect or handler error
+            srv.cancel(rid)
 
 
 class GenerationServer:
@@ -463,22 +557,45 @@ class GenerationServer:
 
     def __init__(self, cfg=None, params=None, cache=None, mesh=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 poll_s: float = 0.002, engine=None, **engine_kw):
-        if engine is not None:
+                 poll_s: float = 0.002, engine=None,
+                 engine_factory=None, max_restarts: int = 3,
+                 restart_window_s: float = 60.0,
+                 restart_backoff_s: float = 0.05, **engine_kw):
+        """``engine_factory`` (a zero-arg callable returning a fresh
+        engine) enables CRASH RECOVERY: the drive loop runs the engine
+        under an :class:`~paddle_tpu.models.serving_engine.
+        EngineSupervisor` — a step exception that escapes the engine's
+        own wave quarantine rebuilds the engine (``max_restarts`` per
+        ``restart_window_s``, exponential ``restart_backoff_s``),
+        re-queues still-live queued requests, and fails only the
+        requests whose pages died.  The factory should share one
+        ``metrics_registry`` across builds so /metrics survives
+        restarts.  Without a factory, the first escaped exception is
+        fatal (pending requests fail loudly, new submits get 503)."""
+        self._supervisor = None
+        if engine_factory is not None:
+            from ..models.serving_engine import EngineSupervisor
+            self._supervisor = EngineSupervisor(
+                engine_factory, max_restarts=max_restarts,
+                window_s=restart_window_s,
+                backoff_s=restart_backoff_s)
+            self._engine = None
+        elif engine is not None:
             # caller-built engine (e.g. models.speculative.
             # SpeculativeEngine) — the whole HTTP front works unchanged
-            self.engine = engine
+            self._engine = engine
         else:
             from ..models.serving_engine import ContinuousBatchingEngine
-            self.engine = ContinuousBatchingEngine(cfg, params, cache,
-                                                   mesh=mesh,
-                                                   **engine_kw)
+            self._engine = ContinuousBatchingEngine(cfg, params, cache,
+                                                    mesh=mesh,
+                                                    **engine_kw)
         self._host, self._port = host, port
         self._poll_s = poll_s
         self._lock = threading.Lock()
         self._queues = {}
         self._httpd = None
         self._threads: List[threading.Thread] = []
+        self._drive_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._fatal: Optional[str] = None
         # observability surface: /metrics, /stats, /events, and
@@ -492,44 +609,126 @@ class GenerationServer:
             self.registry, self.ring = MetricsRegistry(), default_ring()
         self._http_counters = _http_metrics(self.registry)
 
-    def submit(self, prompt, max_new_tokens):
+    @property
+    def engine(self):
+        """The CURRENT engine (after a supervisor restart this is the
+        rebuilt one — rids and lifecycle state carry over)."""
+        return self._supervisor.engine if self._supervisor is not None \
+            else self._engine
+
+    @property
+    def _driver(self):
+        """What the drive loop steps: the supervisor (restart-aware)
+        or the bare engine."""
+        return self._supervisor if self._supervisor is not None \
+            else self._engine
+
+    @property
+    def restarts(self) -> int:
+        return self._supervisor.restarts \
+            if self._supervisor is not None else 0
+
+    def _rebind_observability(self) -> None:
+        """After a supervisor restart, follow the CURRENT engine's
+        registry/ring so /metrics, /stats and /health keep reflecting
+        the engine that is actually serving.  A factory that shares
+        one registry across builds (recommended — counters then
+        survive restarts) makes this a no-op."""
+        m = getattr(self.engine, "metrics", None)
+        if m is not None and m.registry is not self.registry:
+            self.registry, self.ring = m.registry, m.ring
+            self._http_counters = _http_metrics(self.registry)
+
+    def is_live(self) -> bool:
+        """LIVENESS: the serving loop thread is running (a dead loop
+        means no request will ever drain — restart the process)."""
+        t = self._drive_thread
+        return t is not None and t.is_alive()
+
+    def is_ready(self) -> bool:
+        """READINESS: live, engine healthy, and the admission queue
+        below its bound — new work will be accepted right now."""
+        if not self.is_live() or self._fatal is not None:
+            return False
+        eng = self.engine
+        if eng.max_queue_len is not None and \
+                len(eng._queue) >= eng.max_queue_len:
+            return False
+        if eng.max_queued_tokens is not None and \
+                eng.queued_tokens() >= eng.max_queued_tokens:
+            return False
+        return True
+
+    def submit(self, prompt, max_new_tokens, deadline_s=None):
         import queue as _queue
         with self._lock:
             if self._fatal is not None:
                 raise RuntimeError(f"engine died: {self._fatal}")
-            rid = self.engine.submit(prompt,
-                                     max_new_tokens=max_new_tokens)
+            rid = self._driver.submit(prompt,
+                                      max_new_tokens=max_new_tokens,
+                                      deadline_s=deadline_s)
             q = _queue.Queue()
             self._queues[rid] = q
         self._http_counters["generate"].inc()
         return rid, q
 
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request (HTTP disconnects and POST /cancel land
+        here): the engine retires it at its next flush point, and the
+        drive loop delivers the terminal 499 to any still-attached
+        waiter (a disconnected one is simply never read).  No-op on
+        finished rids."""
+        with self._lock:
+            return self._driver.cancel(rid)
+
     def _drive(self):
         """Engine thread: step while there is work, fan tokens out to
         each request's queue.  All engine access is under the lock —
-        the HTTP handlers only touch submit() and their own queue.
-        A crashed step fails every pending request LOUDLY (a silent
+        the HTTP handlers only touch submit()/cancel() and their own
+        queue.  Finished requests fan out BY STATUS: ok → tokens,
+        expired → 504, cancelled → the waiter is already gone (or
+        gets 499), faulted → 500 carrying the engine's stored
+        exception text.  A step exception the supervisor cannot absorb
+        fails every pending request LOUDLY with that text (a silent
         thread death would leave HTTP clients blocked on their queues
         until timeout)."""
         import time as _time
         while not self._stop.is_set():
             try:
                 with self._lock:
-                    worked = self.engine.has_work()
+                    drv = self._driver
+                    worked = drv.has_work()
                     if worked:
-                        self.engine.step()
-                        for rid, tok in self.engine.drain_stream():
-                            self._queues[rid].put(("tok", tok))
-                        for req in self.engine.finished():
+                        drv.step()
+                        if self._supervisor is not None:
+                            self._rebind_observability()
+                        for rid, tok in drv.drain_stream():
+                            q = self._queues.get(rid)
+                            if q is not None:  # cancelled: waiter gone
+                                q.put(("tok", tok))
+                        for req in drv.finished():
                             q = self._queues.pop(req.rid, None)
-                            if q is not None:
+                            if q is None:
+                                continue
+                            if req.status == "ok":
                                 q.put(("done", list(req.generated)))
+                            elif req.status == "expired":
+                                q.put(("err",
+                                       (504, "deadline exceeded")))
+                            elif req.status == "cancelled":
+                                q.put(("err", (499, "cancelled")))
+                            else:
+                                q.put(("err", (500,
+                                       "generation failed: "
+                                       f"{req.error or 'engine fault'}"
+                                       )))
             except Exception as e:                # engine wedged
                 with self._lock:
                     dead, self._queues = self._queues, {}
                     self._fatal = f"{type(e).__name__}: {e}"
                 for q in dead.values():
-                    q.put(("done", None))         # handlers -> 500
+                    q.put(("err", (500, "generation failed: "
+                                   f"{self._fatal}")))
                 return
             if not worked:
                 _time.sleep(self._poll_s)
@@ -542,6 +741,7 @@ class GenerationServer:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        self._drive_thread = self._threads[-1]
         return self._httpd.server_address[1]
 
     def stop(self) -> None:
@@ -552,32 +752,41 @@ class GenerationServer:
             self._httpd = None
 
 
+def _gen_body(prompt, max_new_tokens, deadline_s) -> bytes:
+    body = {"prompt": [int(t) for t in prompt],
+            "max_new_tokens": max_new_tokens}
+    if deadline_s is not None:
+        body["deadline_s"] = float(deadline_s)
+    return json.dumps(body).encode()
+
+
 def generate_http(url: str, prompt, max_new_tokens: int = 64,
-                  timeout: float = 120.0):
-    """Blocking client for :class:`GenerationServer` ``/generate``."""
+                  timeout: float = 120.0, deadline_s=None):
+    """Blocking client for :class:`GenerationServer` ``/generate``.
+    ``deadline_s`` rides in the request body — the server retires the
+    generation (504) when it cannot finish in time."""
     import urllib.request
-    body = json.dumps({"prompt": [int(t) for t in prompt],
-                       "max_new_tokens": max_new_tokens}).encode()
     req = urllib.request.Request(
-        url.rstrip("/") + "/generate", data=body,
+        url.rstrip("/") + "/generate",
+        data=_gen_body(prompt, max_new_tokens, deadline_s),
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())["tokens"]
 
 
 def generate_http_stream(url: str, prompt, max_new_tokens: int = 64,
-                         timeout: float = 120.0):
+                         timeout: float = 120.0, deadline_s=None):
     """Streaming client: yields tokens as the server emits them.
 
     Raises ``RuntimeError`` when the terminal ``done`` message carries
-    an ``error`` (engine crash mid-request) — a silently truncated
-    generation is indistinguishable from a complete one to the caller.
+    an ``error`` (engine crash mid-request, deadline expiry) — a
+    silently truncated generation is indistinguishable from a complete
+    one to the caller.
     """
     import urllib.request
-    body = json.dumps({"prompt": [int(t) for t in prompt],
-                       "max_new_tokens": max_new_tokens}).encode()
     req = urllib.request.Request(
-        url.rstrip("/") + "/generate_stream", data=body,
+        url.rstrip("/") + "/generate_stream",
+        data=_gen_body(prompt, max_new_tokens, deadline_s),
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as r:
         for line in r:

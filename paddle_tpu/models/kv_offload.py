@@ -36,6 +36,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..testing import faults
+
 __all__ = ["HostPagePool"]
 
 # staged async copies are flushed once this many batches accumulate —
@@ -81,6 +83,11 @@ class HostPagePool:
         return self.num_pages - len(self._free)
 
     def alloc(self) -> int:
+        # fault seam: an exception rule on "host_pool_full" makes the
+        # allocator itself fail hard (the graceful variant — a
+        # condition rule — zeroes PagedKVCache.host_available so cost
+        # models degrade before ever reaching here)
+        faults.fire("host_pool_full")
         if not self._free:
             raise RuntimeError("host KV page pool exhausted")
         return self._free.pop()

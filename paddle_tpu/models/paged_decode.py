@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..testing import faults
 from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn, _mm,
                              _rms_norm)
 
@@ -270,6 +271,11 @@ class PagedKVCache:
         host-tier prefix pages (iterated leaf-first eviction can drain
         every unpinned entry)."""
         if self.host is None:
+            return 0
+        if faults.active("host_pool_full"):
+            # injected exhaustion: the cost model and swap-out
+            # preconditions read zero capacity and degrade to
+            # recompute-style preemption (testing/faults.py)
             return 0
         return (self.host.free_pages()
                 + len(self._host_prefix_index)
@@ -651,6 +657,7 @@ class PagedKVCache:
         back to recompute-style preemption."""
         if self.host is None:
             raise RuntimeError("no host page tier attached")
+        faults.fire("swap_out")       # injected: raises before mutation
         page = self.page
         L = int(self.lens[b])
         npg = (L + page - 1) // page
@@ -706,6 +713,7 @@ class PagedKVCache:
         tokens.  Returns the restored context length.  On device-pool
         exhaustion the record is left intact and ``RuntimeError``
         propagates (the caller falls back to recompute)."""
+        faults.fire("swap_in")        # injected: raises before mutation
         rec = self._swapped[handle]
         entries = rec["entries"]
         self.release_row(b)

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..observability import (EngineMetrics, MetricsRegistry,
                              bind_engine_gauges)
+from ..testing import faults
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            _prefill_packed, _pick_token,
@@ -47,7 +48,40 @@ from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            make_paged_decode_step_async,
                            make_paged_decode_step_tp)
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "EngineDeadError",
+           "EngineSupervisor", "QueueFullError", "Request"]
+
+
+class QueueFullError(RuntimeError):
+    """``submit()`` refused by the bounded admission queue
+    (``max_queue_len`` / ``max_queued_tokens``).  Carries a finite
+    ``retry_after`` hint (seconds) priced off the engine's observed
+    throughput — the HTTP front maps this to ``429`` +
+    ``Retry-After``."""
+
+    def __init__(self, why: str, retry_after: float = 1.0):
+        super().__init__(why)
+        self.retry_after = float(retry_after)
+
+
+class EngineDeadError(RuntimeError):
+    """:class:`EngineSupervisor`'s restart budget is exhausted: the
+    engine is genuinely unrecoverable and the serving front should
+    fail pending requests loudly instead of retrying forever."""
+
+
+def _drive_to_completion(driver, max_steps: int):
+    """Step ``driver`` (an engine or a supervisor) until its queue
+    drains; returns all finished requests in completion order."""
+    out = []
+    steps = 0
+    while driver.has_work():
+        driver.step()
+        out.extend(driver.finished())
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError("serving loop exceeded max_steps")
+    return out
 
 
 @dataclass
@@ -68,6 +102,13 @@ class Request:
     t_admit: float = 0.0
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    # fault tolerance: absolute monotonic deadline (0.0 = none) and
+    # how the request ended — "ok" (eos/stop/budget), "cancelled",
+    # "expired" (deadline), or "error" (its decode wave faulted);
+    # ``error`` carries the fault text for non-"ok" endings
+    deadline: float = 0.0
+    status: str = "ok"
+    error: Optional[str] = None
 
 
 class ContinuousBatchingEngine:
@@ -89,7 +130,11 @@ class ContinuousBatchingEngine:
                  enable_prefix_caching: bool = False,
                  metrics_registry=None, metrics_ring=None,
                  overlap: bool = False, lookahead: int = 1,
-                 packed: bool = True):
+                 packed: bool = True,
+                 max_queue_len: Optional[int] = None,
+                 max_queued_tokens: Optional[int] = None,
+                 quarantine_faults: bool = True,
+                 max_consecutive_faults: int = 3):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -161,6 +206,31 @@ class ContinuousBatchingEngine:
         self.tokens_generated = 0
         self.preemptions = 0
         self.requests_finished = 0
+        self.decode_wall_s = 0.0          # decode dispatch wall accum
+        # -- fault tolerance (docs/FAULT_TOLERANCE.md) ----------------
+        # bounded admission queue: submit() past either bound raises
+        # QueueFullError (backpressure — the HTTP front answers 429)
+        # instead of growing host memory without limit
+        self.max_queue_len = max_queue_len
+        self.max_queued_tokens = max_queued_tokens
+        # per-step exception handling: quarantine the poisoned wave
+        # (retire its slots with an error done-message, stay alive) up
+        # to max_consecutive_faults faults in a row, then escalate —
+        # a persistent fault means the engine itself is broken and
+        # only an EngineSupervisor rebuild can help
+        self.quarantine_faults = bool(quarantine_faults)
+        self.max_consecutive_faults = int(max_consecutive_faults)
+        self._consecutive_faults = 0
+        self._cancelled: set = set()      # rids awaiting cancellation
+        self._admitting: List[Request] = []   # popped, not yet active
+        self._has_deadlines = False       # any deadline ever submitted
+        self._now = time.monotonic        # seam: tests pin the clock
+        self.requests_cancelled = 0
+        self.requests_expired = 0
+        self.requests_rejected = 0
+        self.requests_faulted = 0
+        self.step_faults = 0              # quarantined wave faults
+        self.last_fault: Optional[str] = None
         # -- two-tier KV cache (host-RAM page offload) ----------------
         # with a host tier attached to the cache, preemption SWAPS the
         # victim's pages to host RAM instead of releasing them, and
@@ -244,16 +314,26 @@ class ContinuousBatchingEngine:
 
     # -- client side ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
-               stop_sequences=None) -> int:
+               stop_sequences=None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue a request.  Oversized requests fail HERE with
         ``ValueError`` — one bad request must never surface mid
         ``step()`` and kill every in-flight generation (a row's
-        worst-case footprint is bounded by its table width).
+        worst-case footprint is bounded by its table width).  A full
+        admission queue (``max_queue_len`` / ``max_queued_tokens``)
+        fails here too, with :class:`QueueFullError` carrying a finite
+        ``retry_after`` — backpressure, not unbounded memory growth.
 
         ``stop_sequences``: token-id lists; generation retires as soon
         as the generated tail equals one of them (multi-token stop
         strings — the eos_id generalisation every serving product
-        needs; checked on the host, costs nothing compiled)."""
+        needs; checked on the host, costs nothing compiled).
+
+        ``deadline_s``: seconds from now after which the request is
+        EXPIRED — retired at the next flush point whether queued or
+        mid-decode, resources freed, surfaced in ``finished()`` with
+        ``status == "expired"`` (a request whose client stopped
+        waiting must stop burning decode slots)."""
         prompt = np.asarray(prompt, np.int64)
         if prompt.size == 0:
             # an empty prompt has no last-position logits to sample a
@@ -291,17 +371,74 @@ class ContinuousBatchingEngine:
                         "each stop sequence must be a NON-EMPTY list "
                         f"of token ids, got {q!r}")
                 stops.append([int(t) for t in q])
+        if self.max_queue_len is not None and \
+                len(self._queue) >= self.max_queue_len:
+            self._reject(f"admission queue full: {len(self._queue)} "
+                         f"waiting >= max_queue_len "
+                         f"{self.max_queue_len}")
+        if self.max_queued_tokens is not None:
+            waiting = self.queued_tokens()
+            if waiting + len(prompt) > self.max_queued_tokens:
+                self._reject(
+                    f"queued tokens {waiting} + prompt {len(prompt)} "
+                    f"> max_queued_tokens {self.max_queued_tokens}")
+        deadline = 0.0
+        if deadline_s is not None:
+            deadline = self._now() + float(deadline_s)
+            self._has_deadlines = True
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(Request(rid, prompt, max_new_tokens,
                                    stop_sequences=stops,
-                                   t_submit=time.monotonic()))
+                                   t_submit=time.monotonic(),
+                                   deadline=deadline))
         if self.metrics is not None:
             self.metrics.requests_submitted.inc()
             self.metrics.ring.emit("request_submitted", rid=rid,
                                    prompt_len=len(prompt),
                                    max_new_tokens=max_new_tokens)
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Mark a queued or active request for cancellation; the
+        engine retires it at the next flush point (start of
+        ``step()``), freeing its device pages, host-tier swap record,
+        and prefix refs through the same seams normal retirement uses
+        (``PagedKVCache.audit()`` stays clean).  The request surfaces
+        in ``finished()`` with ``status == "cancelled"``.  Returns
+        False when the rid is unknown or already finished — cancelling
+        a completed request is a harmless no-op."""
+        if any(r.rid == rid for r in self._queue) or \
+                any(r.rid == rid for r in self._active.values()):
+            self._cancelled.add(rid)
+            return True
+        return False
+
+    def queued_tokens(self) -> int:
+        """Context tokens waiting for (re-)admission — the prefill
+        work the queue represents (preempted requests count their
+        regenerated context too)."""
+        return sum(len(r.prompt) + len(r.generated)
+                   for r in self._queue)
+
+    def retry_after_s(self) -> float:
+        """Finite back-off hint for a rejected client: the queue's
+        waiting tokens priced at the engine's observed decode
+        throughput, clamped to [0.1, 60] s (a cold engine answers 1 s
+        — a finite guess beats an honest infinity)."""
+        if self.decode_wall_s > 0 and self.tokens_generated > 0:
+            rate = self.tokens_generated / self.decode_wall_s
+            est = self.queued_tokens() / max(rate, 1e-6)
+        else:
+            est = 1.0
+        return float(min(max(est, 0.1), 60.0))
+
+    def _reject(self, why: str) -> None:
+        self.requests_rejected += 1
+        if self.metrics is not None:
+            self.metrics.requests_rejected.inc()
+            self.metrics.ring.emit("request_rejected", reason=why)
+        raise QueueFullError(why, retry_after=self.retry_after_s())
 
     def finished(self) -> List[Request]:
         out, self._finished = self._finished, []
@@ -404,6 +541,7 @@ class ContinuousBatchingEngine:
         padded = np.zeros((Kp, Lp), np.int64)
         for i, ctx in enumerate(ctxs):
             padded[i, :Ls[i]] = ctx
+        faults.fire("prefill_dispatch")
         x, ks, vs = _prefill(self.cfg)(self.params, jnp.asarray(padded))
         self.prefill_calls += 1
         waste = Kp * Lp - sum(Ls)
@@ -469,6 +607,7 @@ class ContinuousBatchingEngine:
             toks = np.zeros((1, chunk), np.int64)
             toks[0, :C_real] = ctx[pos:pos + C_real]
             table = jnp.asarray(self.cache.tables[slot].copy())
+            faults.fire("prefill_dispatch")
             x, ks, vs = run(
                 self.params, jnp.asarray(toks), self.cache.kpool,
                 self.cache.vpool,
@@ -589,6 +728,7 @@ class ContinuousBatchingEngine:
         q8 = self.cache.kv_quant == "int8"
         run = _prefill_packed(self.cfg, q8, self.enable_prefix_caching)
         dummy = jnp.zeros((1,), jnp.float32)
+        faults.fire("prefill_dispatch")
         x, ks, vs = run(
             self.params, jnp.asarray(toks), jnp.asarray(seg),
             jnp.asarray(pos), self.cache.kpool, self.cache.vpool,
@@ -648,14 +788,23 @@ class ContinuousBatchingEngine:
         returns — the caller requeues for recompute admission in
         FIFO order."""
         t0 = time.perf_counter()
-        handle = self._swap_handles.pop(req.rid)
+        handle = self._swap_handles[req.rid]
         slot = self._free_slots.pop()
         try:
             restored = self.cache.swap_in_row(slot, handle)
         except RuntimeError:
+            del self._swap_handles[req.rid]
             self.cache.discard_swap(handle)
             self._free_slots.append(slot)
             return False
+        except BaseException:
+            # unexpected failure: return the slot and leave the
+            # handle mapped — the quarantine/restart paths discard
+            # parked records through _finish_queued_abnormal, so the
+            # host pages cannot leak
+            self._free_slots.append(slot)
+            raise
+        del self._swap_handles[req.rid]
         self.prefill_tokens_avoided += restored
         self.resumes_swapped += 1
         dt = time.perf_counter() - t0
@@ -733,11 +882,21 @@ class ContinuousBatchingEngine:
         self.preemptions += 1
         if mode == "swap":
             t0 = time.perf_counter()
-            self._swap_handles[req.rid] = self.cache.swap_out_row(slot)
-            self._release_aux(slot)
-            if self.metrics is not None:
-                self.metrics.swap_seconds.observe(
-                    time.perf_counter() - t0)
+            try:
+                self._swap_handles[req.rid] = \
+                    self.cache.swap_out_row(slot)
+            except RuntimeError:
+                # swap-out refused (host tier raced full, or an
+                # injected fault) — swap_out_row raises BEFORE
+                # mutating, so degrade to recompute-style preemption
+                # rather than poisoning the whole wave
+                mode = "recompute"
+                self._release_slot(slot)
+            else:
+                self._release_aux(slot)
+                if self.metrics is not None:
+                    self.metrics.swap_seconds.observe(
+                        time.perf_counter() - t0)
         else:
             self._release_slot(slot)
         if self.metrics is not None:
@@ -781,6 +940,113 @@ class ContinuousBatchingEngine:
                         preempted=req.preempted)
         self._finished.append(req)
 
+    # -- fault tolerance: abnormal retirement -----------------------------
+    def _count_abnormal(self, req: Request, status: str) -> None:
+        """Single bookkeeping site for every non-"ok" ending (plain
+        counters + registry instruments stay in lockstep)."""
+        if status == "cancelled":
+            self.requests_cancelled += 1
+        elif status == "expired":
+            self.requests_expired += 1
+        else:
+            self.requests_faulted += 1
+        if self.metrics is not None:
+            m = self.metrics
+            c = {"cancelled": m.requests_cancelled,
+                 "expired": m.requests_expired}.get(
+                     status, m.requests_faulted)
+            c.inc()
+            m.ring.emit("request_aborted", rid=req.rid, status=status,
+                        generated=len(req.generated))
+
+    def _retire_abnormal(self, slot: int, status: str,
+                         error: Optional[str] = None) -> None:
+        """Retire an ACTIVE request outside the normal eos/budget path
+        (cancelled / expired / wave fault): its pages free through the
+        same ``release_row`` seam, and it surfaces in ``finished()``
+        carrying ``status`` (+ ``error``) so serving fronts answer the
+        client honestly.  No TPOT sample — the generation did not run
+        to completion.  The request is failed + finished even when the
+        release itself raises (poisoned allocator): a client must
+        ALWAYS get a terminal message, whatever the cache's state."""
+        req = self._active.pop(slot)
+        req.done = True
+        req.status = status
+        req.error = error
+        req.t_finish = time.monotonic()
+        try:
+            self._release_slot(slot)
+        finally:
+            self._free_slots.append(slot)
+            self._remaining[slot] = 0
+            self._active_mask[slot] = 0
+            self._count_abnormal(req, status)
+            self._finished.append(req)
+
+    def _finish_queued_abnormal(self, req: Request, status: str,
+                                error: Optional[str] = None) -> None:
+        """Retire a QUEUED request (cancelled / expired before
+        admission): its host-tier swap record — the only resource a
+        queued request can hold — discards, releasing held device refs
+        and host pages."""
+        handle = self._swap_handles.pop(req.rid, None)
+        if handle is not None:
+            self.cache.discard_swap(handle)
+        req.done = True
+        req.status = status
+        req.error = error
+        req.t_finish = time.monotonic()
+        self._count_abnormal(req, status)
+        self._finished.append(req)
+
+    def _sweep_cancelled_expired(self) -> None:
+        """Retire cancelled/deadline-expired requests at this flush
+        point.  Queued ones leave the queue (swap records discard);
+        active ones release their slot only AFTER the lookahead
+        pipeline drains — an in-flight dispatch still writes their
+        pages, and freeing them under it would hand the pages to the
+        victim's successor while stale writes are queued (the same
+        flush discipline preemption follows)."""
+        if not self._cancelled and not self._has_deadlines:
+            return
+        now = self._now()
+
+        def _hit(req: Request) -> Optional[str]:
+            if req.rid in self._cancelled:
+                return "cancelled"
+            if req.deadline and now >= req.deadline:
+                return "expired"
+            return None
+
+        if self._queue:
+            keep: deque = deque()
+            for req in self._queue:
+                status = _hit(req)
+                if status is None:
+                    keep.append(req)
+                else:
+                    self._finish_queued_abnormal(req, status)
+            self._queue = keep
+        victims = []
+        for slot, req in list(self._active.items()):
+            status = _hit(req)
+            if status is not None:
+                victims.append((slot, req, status))
+        if victims:
+            if self.overlap:
+                self._pipeline_flush()
+            for slot, req, status in victims:
+                # the flush may have retired the victim normally
+                # (eos/budget landed on-device first) — honour that
+                if self._active.get(slot) is req:
+                    self._retire_abnormal(slot, status)
+        if self._cancelled:
+            # purge consumed marks (and marks whose request finished
+            # normally before the sweep saw them)
+            live = {r.rid for r in self._queue}
+            live.update(r.rid for r in self._active.values())
+            self._cancelled &= live
+
     def _collect_admissions(self):
         """Pop every queued request that fits (slots + pool pages).
         Head-of-line FIFO: stop at the first that doesn't fit — a
@@ -819,7 +1085,96 @@ class ContinuousBatchingEngine:
 
     def step(self) -> int:
         """Admit + one decode token for every active slot.  Returns the
-        number of active requests after the step."""
+        number of active requests after the step.
+
+        With ``quarantine_faults`` (default) a per-step exception does
+        NOT kill the engine: the poisoned wave quarantines — every
+        slot it carried retires with an error done-message
+        (``status == "error"``), the lookahead pipeline's un-drained
+        dispatches drop, and the next ``step()`` admits from the queue
+        as if nothing happened.  ``max_consecutive_faults`` faults in
+        a row escalate (re-raise): a fault on EVERY step means the
+        engine itself is broken, and only a supervisor rebuild
+        (:class:`EngineSupervisor`) can help."""
+        try:
+            n = self._step_inner()
+        except Exception as exc:
+            if not self.quarantine_faults:
+                raise
+            self._consecutive_faults += 1
+            if self._consecutive_faults > self.max_consecutive_faults:
+                raise
+            self._quarantine(exc)
+            return len(self._active)
+        self._consecutive_faults = 0
+        return n
+
+    def _quarantine(self, exc: BaseException) -> None:
+        """Contain a step fault: drop the poisoned in-flight
+        dispatches un-drained (their tokens die with the wave), retire
+        every slot the wave carried with an error done-message, and
+        leave the queue + allocator ready for the next step."""
+        text = f"{type(exc).__name__}: {exc}"
+        self.last_fault = text
+        self.step_faults += 1
+        self._inflight.clear()
+        self._dev = None
+        self._needs_flush = False
+        self._drain_active = np.zeros((self.B,), bool)
+        if self.cache.host is not None:
+            try:
+                # commit staged swap-out copies: their device gathers
+                # predate the fault, and dropping them would corrupt
+                # parked rows
+                self.cache.host.flush()
+            except Exception:
+                pass
+        for slot in list(self._active):
+            try:
+                self._retire_abnormal(slot, "error", text)
+            except Exception:
+                # the allocator itself refused the release (poisoned
+                # cache): the request is already failed + finished
+                # (_retire_abnormal's finally) — if this recurs,
+                # consecutive-fault escalation hands the engine to
+                # the supervisor for a full rebuild
+                pass
+        # requests the faulted step had already popped off the queue
+        # but not yet committed to _active (admission-phase fault, e.g.
+        # a prefill dispatch OOM) must not vanish: fail them with an
+        # error done-message so their waiters unblock (this also
+        # discards a swap record a faulted swap-in resume left parked)
+        for req in self._admitting:
+            if req.done or (req.slot is not None
+                            and self._active.get(req.slot) is req):
+                continue
+            try:
+                self._finish_queued_abnormal(req, "error", text)
+            except Exception:
+                req.done, req.status, req.error = True, "error", text
+                req.t_finish = time.monotonic()
+                self._finished.append(req)
+        self._admitting = []
+        # reclaim slots stranded mid-admission: popped from the free
+        # list (rows possibly holding freshly-claimed pages) but never
+        # committed to _active
+        for slot in range(self.B):
+            if slot in self._active or slot in self._free_slots:
+                continue
+            try:
+                self.cache.release_row(slot)
+            except Exception:
+                pass
+            self._free_slots.append(slot)
+            self._remaining[slot] = 0
+            self._active_mask[slot] = 0
+        if self.metrics is not None:
+            self.metrics.ring.emit(
+                "engine_quarantine", error=text,
+                consecutive=self._consecutive_faults)
+
+    def _step_inner(self) -> int:
+        self._sweep_cancelled_expired()
         admits, swap_ins = self._collect_admissions()
         while not admits and not swap_ins and not self._active \
                 and self._queue and self._degrade_one_swap():
@@ -831,6 +1186,10 @@ class ContinuousBatchingEngine:
             # admission is a scheduler mutation: drain the lookahead
             # pipeline before slots/pages move under it
             self._pipeline_flush()
+        # track requests popped off the queue but not yet committed to
+        # _active: an admission-phase fault must fail them loudly (see
+        # _quarantine), never drop them with the stack
+        self._admitting = [req for req, _ in admits] + list(swap_ins)
         failed_swap_ins = [req for req in swap_ins
                            if not self._admit_swapped(req)]
         for req in reversed(failed_swap_ins):
@@ -838,6 +1197,7 @@ class ContinuousBatchingEngine:
             # failures back-to-front): the oldest failed resume must
             # stay at the head for its recompute admission
             self._queue.appendleft(req)
+        self._admitting = [req for req, _ in admits]
         all_resumes = bool(admits) and all(r.generated
                                            for r, _ in admits)
         t_adm = time.perf_counter() if admits else 0.0
@@ -861,6 +1221,7 @@ class ContinuousBatchingEngine:
                 buckets.setdefault(Lp, []).append((req, ctx))
             for group in buckets.values():
                 self._admit_batch(group)
+        self._admitting = []          # every admit committed to _active
         if all_resumes:
             # an all-resume recompute wave: its admission wall IS the
             # resume latency, attributed PER REQUEST so the sample
@@ -875,13 +1236,12 @@ class ContinuousBatchingEngine:
                     dt / len(admits))
         if not self._active:
             return 0
-        if self.metrics is None:
-            self._decode_once()
-        else:
-            t0 = time.perf_counter()
-            self._decode_once()
-            self.metrics.decode_seconds.observe(
-                time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        self._decode_once()
+        dt = time.perf_counter() - t0
+        self.decode_wall_s += dt
+        if self.metrics is not None:
+            self.metrics.decode_seconds.observe(dt)
         return len(self._active)
 
     def _ensure_or_preempt(self, new_tokens: int = 1,
@@ -950,6 +1310,7 @@ class ContinuousBatchingEngine:
         lens = jnp.asarray(cache.lens.copy())
         tok = jnp.asarray(self._next_tok.copy())
         self._key, sub = jax.random.split(self._key)
+        faults.fire("step_dispatch")
         if cache.kv_quant == "int8":
             (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
              nxt) = self._step(self.params, cache.kpool, cache.vpool,
@@ -1036,6 +1397,7 @@ class ContinuousBatchingEngine:
             self._dev_tables_version = cache.tables_version
         d = self._dev
         self._key, sub = jax.random.split(self._key)
+        faults.fire("step_dispatch")
         if cache.kv_quant == "int8":
             (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
              nxt, lens2, rem2, act2, done) = self._step_async(
@@ -1130,12 +1492,143 @@ class ContinuousBatchingEngine:
     def run_to_completion(self, max_steps: int = 10_000):
         """Drive until the queue drains; returns all finished requests
         in completion order."""
-        out = []
-        steps = 0
-        while self.has_work():
-            self.step()
-            out.extend(self.finished())
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError("serving loop exceeded max_steps")
-        return out
+        return _drive_to_completion(self, max_steps)
+
+
+class EngineSupervisor:
+    """Crash-recovery wrapper over :class:`ContinuousBatchingEngine`:
+    drive it through :meth:`step` and, when a step exception ESCAPES
+    the engine's own wave quarantine (consecutive-fault escalation, a
+    poisoned allocator, device OOM), the supervisor rebuilds the
+    engine from ``factory`` and carries the still-live work over —
+    queued requests transplant with their rids/deadlines/timestamps
+    intact (swapped-out ones degrade to recompute resumes: their
+    host-tier records died with the old cache), active requests retire
+    with an error done-message (their device pages are gone), and
+    un-drained ``finished()`` results survive the swap.
+
+    Restart budget: ``max_restarts`` within a sliding ``window_s``,
+    each preceded by an exponential ``backoff_s * 2**k`` sleep
+    (``backoff_s=0`` disables sleeping — tests observe restarts
+    through the counters, never through time).  Past the budget
+    :class:`EngineDeadError` raises and the serving front fails
+    pending requests loudly.
+
+    ``factory()`` must return a fresh engine; if it reuses a cache
+    object, the supervisor best-effort releases the dead engine's rows
+    and swap records first so page accounting starts clean (verified
+    by ``PagedKVCache.audit()`` in tests)."""
+
+    def __init__(self, factory, max_restarts: int = 3,
+                 window_s: float = 60.0, backoff_s: float = 0.05):
+        self._factory = factory
+        self.engine: ContinuousBatchingEngine = factory()
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.backoff_s = float(backoff_s)
+        self.restarts = 0
+        self._restart_times: deque = deque()
+
+    # -- engine API passthrough (the serving front drives these) ----------
+    def submit(self, *a, **kw) -> int:
+        return self.engine.submit(*a, **kw)
+
+    def cancel(self, rid: int) -> bool:
+        return self.engine.cancel(rid)
+
+    def finished(self) -> List[Request]:
+        return self.engine.finished()
+
+    def drain_stream(self) -> List:
+        return self.engine.drain_stream()
+
+    def has_work(self) -> bool:
+        return self.engine.has_work()
+
+    def step(self) -> int:
+        try:
+            return self.engine.step()
+        except Exception as exc:
+            self._restart(exc)
+            return len(self.engine._active)
+
+    def run_to_completion(self, max_steps: int = 10_000):
+        return _drive_to_completion(self, max_steps)
+
+    def _restart(self, exc: BaseException) -> None:
+        now = time.monotonic()
+        while self._restart_times and \
+                now - self._restart_times[0] > self.window_s:
+            self._restart_times.popleft()
+        if len(self._restart_times) >= self.max_restarts:
+            raise EngineDeadError(
+                f"engine unrecoverable after {self.restarts} "
+                f"restart(s) ({len(self._restart_times)} in the last "
+                f"{self.window_s:.0f}s): {type(exc).__name__}: {exc}"
+            ) from exc
+        if self.backoff_s > 0:
+            time.sleep(self.backoff_s
+                       * (2 ** len(self._restart_times)))
+        old = self.engine
+        text = f"{type(exc).__name__}: {exc}"
+        # best-effort cleanup of the dead engine's claims — EVERY slot
+        # off the free list (active rows AND rows stranded
+        # mid-admission by the fatal step), so a factory that reuses
+        # the cache starts from clean page accounting
+        for slot in range(old.B):
+            if slot in old._free_slots:
+                continue
+            try:
+                old.cache.release_row(slot)
+            except Exception:
+                pass
+        for handle in list(old._swap_handles.values()):
+            try:
+                old.cache.discard_swap(handle)
+            except Exception:
+                pass
+        old._swap_handles.clear()
+        new = self._factory()
+        # results the serving front has not drained yet survive
+        new._finished.extend(old._finished)
+        old._finished = []
+        # active requests died with their pages: error done-message
+        for slot, req in list(old._active.items()):
+            req.done, req.status, req.error = True, "error", text
+            req.t_finish = time.monotonic()
+            new._count_abnormal(req, "error")
+            new._finished.append(req)
+        old._active.clear()
+        # requests the fatal step had popped off the queue but not yet
+        # committed to _active (admission-phase death) fail loudly too
+        # — never dropped with the dead engine
+        for req in old._admitting:
+            if req.done or any(q is req for q in old._queue):
+                continue
+            req.done, req.status, req.error = True, "error", text
+            req.t_finish = time.monotonic()
+            new._count_abnormal(req, "error")
+            new._finished.append(req)
+        old._admitting = []
+        # still-live queued requests transplant (rids preserved);
+        # cancelled/expired ones retire on the way over
+        for req in old._queue:
+            req.slot = None
+            if req.rid in old._cancelled:
+                new._finish_queued_abnormal(req, "cancelled")
+            elif req.deadline and new._now() >= req.deadline:
+                new._finish_queued_abnormal(req, "expired")
+            else:
+                new._queue.append(req)
+                if req.deadline:
+                    new._has_deadlines = True
+        old._queue.clear()
+        new._next_rid = max(new._next_rid, old._next_rid)
+        new.last_fault = text
+        self.engine = new
+        self._restart_times.append(now)
+        self.restarts += 1
+        if new.metrics is not None:
+            new.metrics.engine_restarts.inc()
+            new.metrics.ring.emit("engine_restart", error=text,
+                                  restarts=self.restarts)

@@ -74,6 +74,32 @@ class EngineMetrics:
         self.preemptions = r.counter(
             "paddle_tpu_engine_preemptions_total",
             "Active requests evicted + requeued on pool exhaustion")
+        # -- fault tolerance (docs/FAULT_TOLERANCE.md) ------------------
+        self.requests_cancelled = r.counter(
+            "paddle_tpu_engine_requests_cancelled_total",
+            "Requests retired by cancel() — client cancellation or a "
+            "mid-stream HTTP disconnect")
+        self.requests_expired = r.counter(
+            "paddle_tpu_engine_requests_expired_total",
+            "Requests retired at their deadline_s before completing")
+        self.requests_rejected = r.counter(
+            "paddle_tpu_engine_requests_rejected_total",
+            "submit() calls refused by the bounded admission queue "
+            "(max_queue_len / max_queued_tokens backpressure; HTTP "
+            "maps these to 429)")
+        self.requests_faulted = r.counter(
+            "paddle_tpu_engine_requests_faulted_total",
+            "Requests retired with an error done-message because the "
+            "decode wave they rode faulted (step-exception "
+            "quarantine or an engine restart)")
+        self.engine_restarts = r.counter(
+            "paddle_tpu_engine_restarts_total",
+            "Dead-engine rebuilds by EngineSupervisor (queued "
+            "requests re-queued, active ones faulted)")
+        self.queued_tokens = r.gauge(
+            "paddle_tpu_engine_queued_tokens_count",
+            "Context tokens waiting in the admission queue (the "
+            "max_queued_tokens backpressure bound reads this)")
         self.queue_wait = r.histogram(
             "paddle_tpu_request_queue_wait_seconds",
             "submit() -> first admission")
@@ -231,6 +257,8 @@ def bind_engine_gauges(m: EngineMetrics, engine) -> None:
         _weak_fn(engine, lambda e: float(len(e._active))))
     m.queued_requests.set_function(
         _weak_fn(engine, lambda e: float(len(e._queue))))
+    m.queued_tokens.set_function(
+        _weak_fn(engine, lambda e: float(e.queued_tokens())))
     m.batch_occupancy.set_function(
         _weak_fn(engine, lambda e: len(e._active) / e.B))
     m.inflight_dispatches.set_function(

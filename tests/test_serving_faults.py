@@ -1,0 +1,676 @@
+"""Fault-tolerant serving: deadlines + cancellation, bounded-queue
+backpressure, wave quarantine + engine crash recovery, and the
+deterministic fault-injection plane (paddle_tpu/testing/faults.py).
+
+Contract under test:
+* CANCEL and DEADLINE EXPIRY retire requests at flush points across
+  the packed/batched/chunked admission lanes and ``overlap=True/False``
+  with the KV host tier attached — survivors stay token-exact, pages /
+  swap records / prefix refs all release (``PagedKVCache.audit()``
+  clean, pool fully free afterwards);
+* an injected step exception QUARANTINES the poisoned wave: its slots
+  retire with an error done-message, queued requests then run
+  token-exact vs an unfaulted engine; consecutive faults escalate;
+* injected swap faults (swap_in / swap_out / host_pool_full) degrade
+  to recompute preemption, token-exact, audit clean;
+* ``EngineSupervisor`` rebuilds a genuinely dead engine, transplants
+  the still-live queue (rids intact) and enforces its restart budget;
+* the bounded admission queue rejects with a finite ``retry_after``
+  (HTTP: 429 + ``Retry-After``); ``/health`` splits live vs ready;
+* an HTTP mid-stream disconnect (injected ``stream_write`` fault)
+  cancels the request instead of decoding to budget; ``POST /cancel``
+  delivers a terminal 499 to the waiter; engine death surfaces its
+  stored exception text to pending (500) and new (503) requests.
+
+No test observes recovery through sleeps — assertions are driven by
+engine counters/metrics (bounded polls where another thread runs the
+engine).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import (ContinuousBatchingEngine,
+                                              EngineDeadError,
+                                              EngineSupervisor,
+                                              QueueFullError)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+_PROMPTS = np.random.RandomState(77).randint(1, 128, (4, 10))
+
+_LANE_KW = {"packed": {},
+            "batched": {"packed": False},
+            "chunked": {"packed": False, "prefill_chunk": 32}}
+
+
+def _cache(cfg, host_pages=8, **kw):
+    base = dict(num_pages=64, pages_max=8, batch=2, page=16)
+    base.update(kw)
+    return PagedKVCache(cfg, host_pages=host_pages, **base)
+
+
+def _assert_drained(cache):
+    """Every fault path must leave the allocator spotless."""
+    cache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+    assert not cache._swapped, "leaked swap records"
+    if cache.host is not None:
+        assert cache.host.used_pages() == 0, "leaked host pages"
+
+
+_REF = {}
+
+
+def _ref_outputs(cfg, params, new=8):
+    """Unfaulted greedy outputs per request index (batched through a
+    clean engine once per module — greedy decode is batch-composition
+    independent, so survivors of any faulted run must match these)."""
+    key = new
+    if key not in _REF:
+        eng = ContinuousBatchingEngine(cfg, params, _cache(cfg))
+        rids = [eng.submit(p, max_new_tokens=new) for p in _PROMPTS]
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        _REF[key] = [done[rid] for rid in rids]
+    return _REF[key]
+
+
+# ---------------------------------------------------------------------------
+# the fault plane itself
+# ---------------------------------------------------------------------------
+def test_fault_plane_determinism():
+    fp = faults.FaultPlane()
+    fp.inject("a", RuntimeError("x"), every=3)
+    hits = []
+    for i in range(1, 10):
+        try:
+            fp.fire("a")
+            hits.append(False)
+        except RuntimeError:
+            hits.append(True)
+    assert hits == [False, False, True] * 3
+    assert fp.counts["a"] == 9 and fp.fired["a"] == 3
+
+    # nth + times: one shot, exactly at the nth consult
+    fp.inject("b", ValueError("y"), nth=2)
+    fp.fire("b")
+    with pytest.raises(ValueError):
+        fp.fire("b")
+    fp.fire("b")
+
+    # seeded probabilistic rules replay exactly
+    def draw(seed):
+        p = faults.FaultPlane()
+        p.inject("c", p=0.5, seed=seed)
+        return [p.active("c") for _ in range(20)]
+
+    assert draw(7) == draw(7)
+    assert draw(7) != draw(8)
+
+    # uninstalled plane: the production seams are no-ops
+    assert faults.get() is None
+    faults.fire("anything")
+    assert faults.active("anything") is False
+
+
+# ---------------------------------------------------------------------------
+# deadlines + cancellation across lanes / overlap, offload attached
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("overlap", [False, True])
+@pytest.mark.parametrize("lane", ["packed", "batched", "chunked"])
+def test_cancel_and_deadline_token_exact(cfg, params, lane, overlap):
+    """Cancel one request mid-decode and expire another via a pinned
+    clock: both retire with the right status, the survivor's output is
+    token-exact vs the clean run, and page accounting is spotless."""
+    ref = _ref_outputs(cfg, params)
+    cache = _cache(cfg)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   overlap=overlap, **_LANE_KW[lane])
+    r0 = eng.submit(_PROMPTS[0], max_new_tokens=8)
+    r1 = eng.submit(_PROMPTS[1], max_new_tokens=8)
+    r2 = eng.submit(_PROMPTS[2], max_new_tokens=8, deadline_s=1e6)
+    for _ in range(3):
+        eng.step()
+    eng.cancel(r0)
+    eng._now = lambda: time.monotonic() + 2e6    # r2's deadline passes
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert done[r0].status == "cancelled"
+    assert done[r2].status == "expired"
+    assert done[r1].status == "ok"
+    assert list(done[r1].generated) == ref[1]
+    assert eng.requests_cancelled == 1 and eng.requests_expired == 1
+    if eng.metrics is not None:
+        assert eng.metrics.requests_cancelled.value == 1
+        assert eng.metrics.requests_expired.value == 1
+    _assert_drained(cache)
+
+
+def test_cancel_queued_swapped_request_discards_record(cfg, params):
+    """Cancelling a PREEMPTED request whose pages are parked in the
+    host tier discards the swap record — host pages free, held device
+    refs release, audit clean (the resource a queued request can
+    hold)."""
+    cache = _cache(cfg, host_pages=16, num_pages=5, pages_max=4)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    prompts = [np.random.RandomState(6).randint(1, 128, (16,))
+               for _ in range(2)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=20)
+    # drive until a preemption parks a swap record
+    steps = 0
+    while not eng._swap_handles:
+        eng.step()
+        steps += 1
+        assert steps < 200, "no swap-preemption happened"
+    victim_rid = next(iter(eng._swap_handles))
+    assert eng.cancel(victim_rid)
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert done[victim_rid].status == "cancelled"
+    survivor = [r for r in done.values() if r.status == "ok"]
+    assert len(survivor) == 1
+    _assert_drained(cache)
+
+
+# ---------------------------------------------------------------------------
+# step-fault quarantine
+# ---------------------------------------------------------------------------
+def test_step_fault_quarantine_remaining_token_exact(cfg, params):
+    """An injected step-dispatch exception retires exactly the wave it
+    poisoned (error done-messages) and the engine keeps serving: the
+    queued requests complete token-exact vs the unfaulted run."""
+    ref = _ref_outputs(cfg, params)
+    cache = _cache(cfg)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("injected step fault"),
+                  nth=4)
+        rids = [eng.submit(p, max_new_tokens=8) for p in _PROMPTS]
+        done = {r.rid: r for r in eng.run_to_completion()}
+    # the first admitted wave (2 slots) rode the poisoned dispatch
+    assert eng.step_faults == 1
+    errs = [rid for rid in rids if done[rid].status == "error"]
+    oks = [rid for rid in rids if done[rid].status == "ok"]
+    assert errs == rids[:2] and oks == rids[2:]
+    for rid in errs:
+        assert "injected step fault" in done[rid].error
+    for i, rid in enumerate(rids):
+        if done[rid].status == "ok":
+            assert list(done[rid].generated) == ref[i]
+    assert eng.requests_faulted == 2
+    _assert_drained(cache)
+
+
+@pytest.mark.parametrize("lane", ["packed", "batched", "chunked"])
+def test_admission_fault_fails_wave_loudly(cfg, params, lane):
+    """A prefill dispatch that raises MID-ADMISSION (slots and pages
+    already claimed, requests already popped off the queue) must fail
+    that wave with error done-messages — never drop the requests with
+    the stack — reclaim the stranded slots/pages, and keep serving
+    the rest of the queue token-exact."""
+    ref = _ref_outputs(cfg, params)
+    cache = _cache(cfg)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   **_LANE_KW[lane])
+    with faults.plane() as fp:
+        fp.inject("prefill_dispatch",
+                  RuntimeError("injected admission fault"), nth=1)
+        rids = [eng.submit(p, max_new_tokens=8) for p in _PROMPTS]
+        done = {r.rid: r for r in eng.run_to_completion()}
+    # EVERY submitted request has a finished() record — none vanished
+    assert sorted(done) == sorted(rids)
+    errs = [rid for rid in rids if done[rid].status == "error"]
+    assert errs, "the faulted admission wave must fail loudly"
+    for rid in errs:
+        assert "injected admission fault" in done[rid].error
+    for i, rid in enumerate(rids):
+        if done[rid].status == "ok":
+            assert list(done[rid].generated) == ref[i]
+    assert len(eng._free_slots) == eng.B
+    _assert_drained(cache)
+
+
+def test_step_fault_quarantine_overlap_offload(cfg, params):
+    """Quarantine under the dispatch-ahead pipeline with the host tier
+    attached: in-flight dispatches drop un-drained, pages and host
+    pool come back clean, and the engine accepts new work after."""
+    cache = _cache(cfg, host_pages=16, num_pages=5, pages_max=4)
+    eng = ContinuousBatchingEngine(cfg, params, cache, overlap=True)
+    prompts = [np.random.RandomState(6).randint(1, 128, (16,))
+               for _ in range(2)]
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("overlap fault"),
+                  nth=6)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=20)
+        done = eng.run_to_completion()
+    assert eng.step_faults >= 1
+    assert not eng._inflight and eng._dev is None
+    _assert_drained(cache)
+    # still serving: a fresh request completes normally
+    eng.submit(_PROMPTS[0], max_new_tokens=8)
+    done = eng.run_to_completion()
+    assert done[-1].status == "ok"
+    assert list(done[-1].generated) == _ref_outputs(cfg, params)[0]
+    _assert_drained(cache)
+
+
+def test_consecutive_faults_escalate(cfg, params):
+    """A fault on EVERY step means the engine itself is broken:
+    after max_consecutive_faults quarantines the exception escapes
+    (the supervisor's cue to rebuild)."""
+    eng = ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                   max_consecutive_faults=2)
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("persistent"))
+        # keep the queue stocked so every step has a wave to fault on
+        # (each quarantine consumes the admitted wave of 2)
+        for p in list(_PROMPTS) * 2:
+            eng.submit(p, max_new_tokens=8)
+        eng.step()                 # quarantine 1
+        eng.step()                 # quarantine 2
+        with pytest.raises(RuntimeError, match="persistent"):
+            eng.step()             # escalation
+    assert eng.step_faults == 2
+    assert eng._consecutive_faults == 3
+
+
+# ---------------------------------------------------------------------------
+# swap-path faults degrade to recompute, token-exact
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("site", ["swap_in", "swap_out",
+                                  "host_pool_full"])
+def test_swap_faults_degrade_to_recompute(cfg, params, site):
+    """Each swap-path fault leaves preemption working in
+    recompute-style and outputs identical to the no-offload engine."""
+    prompts = [np.random.RandomState(6).randint(1, 128, (16,))
+               for _ in range(2)]
+
+    def run(host_pages, arm):
+        cache = _cache(cfg, host_pages=host_pages, num_pages=5,
+                       pages_max=4)
+        eng = ContinuousBatchingEngine(cfg, params, cache)
+        with faults.plane() as fp:
+            if arm:
+                fp.inject(site, None if site == "host_pool_full"
+                          else RuntimeError(f"injected {site}"))
+            for p in prompts:
+                eng.submit(p, max_new_tokens=20)
+            done = {r.rid: list(r.generated)
+                    for r in eng.run_to_completion()}
+        _assert_drained(cache)
+        return done, eng
+
+    ref, e0 = run(0, arm=False)
+    assert e0.preemptions > 0
+    got, e1 = run(16, arm=True)
+    assert got == ref
+    assert e1.preemptions > 0
+    assert e1.resumes_swapped == 0          # the degraded path ran
+    assert e1.resumes_recompute > 0
+
+
+def test_swap_in_unexpected_exception_no_leak(cfg, params):
+    """A NON-RuntimeError from the swap-in path is a wave fault (no
+    recompute fallback contract), but it must not strand the parked
+    swap record: the quarantine discards it, host pages free, every
+    submitted request still gets a finished() record."""
+    cache = _cache(cfg, host_pages=16, num_pages=5, pages_max=4)
+    eng = ContinuousBatchingEngine(cfg, params, cache)
+    prompts = [np.random.RandomState(6).randint(1, 128, (16,))
+               for _ in range(2)]
+    with faults.plane() as fp:
+        fp.inject("swap_in", ValueError("unexpected swap error"),
+                  times=1)
+        rids = [eng.submit(p, max_new_tokens=20) for p in prompts]
+        done = {r.rid: r for r in eng.run_to_completion()}
+    assert sorted(done) == sorted(rids)     # nothing vanished
+    assert any(r.status == "error" and
+               "unexpected swap error" in r.error
+               for r in done.values())
+    _assert_drained(cache)
+
+
+# ---------------------------------------------------------------------------
+# supervisor restart
+# ---------------------------------------------------------------------------
+def test_supervisor_restart_transplants_queue(cfg, params):
+    """A dead engine (quarantine disabled, injected fault) rebuilds
+    through the factory: active requests fault with the exception
+    text, QUEUED requests transplant with their rids and complete
+    token-exact, restart counters move."""
+    ref = _ref_outputs(cfg, params)
+    from paddle_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+    caches = []
+
+    def factory():
+        cache = _cache(cfg)
+        caches.append(cache)
+        return ContinuousBatchingEngine(cfg, params, cache,
+                                        quarantine_faults=False,
+                                        metrics_registry=reg)
+
+    sup = EngineSupervisor(factory, max_restarts=3, backoff_s=0.0)
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("engine death"), nth=4)
+        rids = [sup.submit(p, max_new_tokens=8) for p in _PROMPTS]
+        done = {r.rid: r for r in sup.run_to_completion()}
+    assert sup.restarts == 1
+    assert [done[rid].status for rid in rids] == \
+        ["error", "error", "ok", "ok"]
+    for rid in rids[:2]:
+        assert "engine death" in done[rid].error
+    for i, rid in enumerate(rids[2:], start=2):
+        assert list(done[rid].generated) == ref[i]
+    assert sup.engine.metrics.engine_restarts.value == 1
+    assert sup.engine.requests_faulted == 2
+    for cache in caches:
+        cache.audit()
+
+
+def test_supervisor_admission_death_fails_wave_loudly(cfg, params):
+    """An ADMISSION-phase exception that escapes straight to the
+    supervisor (quarantine disabled) must still fail the popped
+    requests with error done-messages — the restart cannot drop them
+    with the dead engine."""
+    def factory():
+        return ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                        quarantine_faults=False)
+
+    sup = EngineSupervisor(factory, max_restarts=3, backoff_s=0.0)
+    with faults.plane() as fp:
+        fp.inject("prefill_dispatch",
+                  RuntimeError("admission death"), nth=1)
+        rids = [sup.submit(p, max_new_tokens=8) for p in _PROMPTS]
+        done = {r.rid: r for r in sup.run_to_completion()}
+    assert sup.restarts == 1
+    assert sorted(done) == sorted(rids)     # nothing vanished
+    errs = [rid for rid in rids if done[rid].status == "error"]
+    assert errs and all("admission death" in done[rid].error
+                        for rid in errs)
+    ref = _ref_outputs(cfg, params)
+    for i, rid in enumerate(rids):
+        if done[rid].status == "ok":
+            assert list(done[rid].generated) == ref[i]
+
+
+def test_supervisor_budget_exhausted(cfg, params):
+    """Past max_restarts within the window the supervisor gives up
+    LOUDLY with the root-cause text."""
+    def factory():
+        return ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                        quarantine_faults=False)
+
+    sup = EngineSupervisor(factory, max_restarts=2, backoff_s=0.0)
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("hard fault"))
+        # each fault consumes the active wave — resubmit so every
+        # step has work whose decode dispatch can fault
+        sup.submit(_PROMPTS[0], max_new_tokens=8)
+        sup.step()                 # restart 1
+        sup.submit(_PROMPTS[1], max_new_tokens=8)
+        sup.step()                 # restart 2
+        sup.submit(_PROMPTS[2], max_new_tokens=8)
+        with pytest.raises(EngineDeadError, match="hard fault"):
+            sup.step()
+    assert sup.restarts == 2
+
+
+# ---------------------------------------------------------------------------
+# bounded admission queue (engine level)
+# ---------------------------------------------------------------------------
+def test_bounded_queue_rejects_with_finite_retry_after(cfg, params):
+    eng = ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                   max_queue_len=2)
+    eng.submit(_PROMPTS[0], max_new_tokens=4)
+    eng.submit(_PROMPTS[1], max_new_tokens=4)
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(_PROMPTS[2], max_new_tokens=4)
+    assert 0.1 <= ei.value.retry_after <= 60.0
+    assert eng.requests_rejected == 1
+    assert eng.metrics.requests_rejected.value == 1
+
+    eng2 = ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                    max_queued_tokens=25)
+    eng2.submit(_PROMPTS[0], max_new_tokens=4)       # 10 tokens
+    eng2.submit(_PROMPTS[1], max_new_tokens=4)       # 20 tokens
+    with pytest.raises(QueueFullError):
+        eng2.submit(_PROMPTS[2], max_new_tokens=4)   # would be 30
+    assert eng2.queued_tokens() == 20
+    # the queue drains normally after rejections
+    done = eng2.run_to_completion()
+    assert [r.status for r in done] == ["ok", "ok"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+def _server(cfg, params, **kw):
+    from paddle_tpu.inference.serving import GenerationServer
+    cache = kw.pop("cache", None) or _cache(cfg)
+    return GenerationServer(cfg, params, cache, **kw)
+
+
+def _http_err(url, data=None, timeout=10):
+    try:
+        req = urllib.request.Request(url, data=data)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _poll(predicate, timeout_s=30.0):
+    """Bounded metric-driven wait on a condition another thread
+    advances (never a fixed sleep)."""
+    t0 = time.monotonic()
+    while not predicate():
+        assert time.monotonic() - t0 < timeout_s, "condition timeout"
+        time.sleep(0.01)
+
+
+def test_http_backpressure_429_and_health_split(cfg, params):
+    """A saturated queue answers 429 with a finite Retry-After;
+    /health/live stays 200 (the loop runs) while /health/ready flips
+    503 (no new work accepted)."""
+    srv = _server(cfg, params, max_queue_len=1)
+    # hold the engine: the drive loop parks on the stop event so the
+    # queue can only grow (deterministic saturation, no timing races)
+    srv._drive = srv._stop.wait
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        srv.submit([int(t) for t in _PROMPTS[0]], 4)
+        body = json.dumps({"prompt": [int(t) for t in _PROMPTS[1]],
+                           "max_new_tokens": 4}).encode()
+        code, text, headers = _http_err(url + "/generate", body)
+        assert code == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert b"queue full" in text
+        assert _http_err(url + "/health/live")[0] == 200
+        assert _http_err(url + "/health/ready")[0] == 503
+        h = json.loads(_http_err(url + "/health")[1])
+        assert h["live"] is True and h["ready"] is False
+        assert h["requests_rejected"] == 1
+    finally:
+        srv.stop()
+
+
+def test_http_deadline_maps_to_504(cfg, params):
+    from paddle_tpu.inference.serving import generate_http
+    srv = _server(cfg, params)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            generate_http(url, _PROMPTS[0], max_new_tokens=4,
+                          deadline_s=-1.0)
+        assert ei.value.code == 504
+        # the engine keeps serving afterwards
+        got = generate_http(url, _PROMPTS[0], max_new_tokens=4)
+        assert got == _ref_outputs(cfg, params)[0][:4]
+        h = json.loads(_http_err(url + "/health")[1])
+        assert h["requests_expired"] == 1
+    finally:
+        srv.stop()
+
+
+def test_http_stream_disconnect_cancels_request(cfg, params):
+    """An injected mid-stream BrokenPipeError (the deterministic stand
+    -in for a vanished client) must CANCEL the generation — observed
+    through the cancelled counter, pool drained after."""
+    from paddle_tpu.inference.serving import generate_http_stream
+    srv = _server(cfg, params)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with faults.plane() as fp:
+            fp.inject("stream_write",
+                      BrokenPipeError("injected disconnect"), nth=3)
+            try:
+                got = list(generate_http_stream(
+                    url, _PROMPTS[0], max_new_tokens=100, timeout=30))
+                assert len(got) < 100       # truncated, never full
+            except Exception:
+                pass                        # client-side cutoff is fine
+            _poll(lambda: srv.engine.requests_cancelled == 1)
+        with srv._lock:
+            assert not srv.engine.has_work()
+            _assert_drained(srv.engine.cache)
+    finally:
+        srv.stop()
+
+
+def test_http_cancel_endpoint_delivers_499(cfg, params):
+    srv = _server(cfg, params)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        rid, q = srv.submit([int(t) for t in _PROMPTS[0]], 100)
+        body = json.dumps({"rid": rid}).encode()
+        code, text, _ = _http_err(url + "/cancel", body)
+        assert code == 200 and json.loads(text)["cancelled"] is True
+        while True:
+            kind, payload = q.get(timeout=30)
+            if kind != "tok":
+                break
+        assert kind == "err" and payload[0] == 499
+        _poll(lambda: not srv.engine.has_work())
+        with srv._lock:
+            _assert_drained(srv.engine.cache)
+        # unknown rid: harmless no-op
+        code, text, _ = _http_err(url + "/cancel",
+                                  json.dumps({"rid": 999}).encode())
+        assert json.loads(text)["cancelled"] is False
+    finally:
+        srv.stop()
+
+
+def test_http_engine_death_surfaces_exception_text(cfg, params):
+    """Satellite fix: the engine's stored exception text reaches the
+    operator — pending requests 500 with it, new submits 503 with it
+    (was: generic "engine unavailable"/"generation failed")."""
+    from paddle_tpu.inference.serving import generate_http
+    srv = _server(cfg, params)
+
+    def boom():
+        raise RuntimeError("induced engine failure xyz")
+
+    srv.engine.step = boom
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            generate_http(url, _PROMPTS[0], max_new_tokens=4,
+                          timeout=30)
+        assert ei.value.code == 500
+        assert "induced engine failure xyz" in ei.value.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            generate_http(url, _PROMPTS[0], max_new_tokens=4,
+                          timeout=30)
+        assert ei2.value.code == 503
+        assert "induced engine failure xyz" in \
+            ei2.value.read().decode()
+        # liveness reflects the dead loop
+        assert _http_err(url + "/health/live")[0] == 503
+    finally:
+        srv.stop()
+
+
+def test_http_supervised_server_recovers(cfg, params):
+    """GenerationServer(engine_factory=...) survives engine death: the
+    faulted wave answers 500 with the root cause, queued requests
+    transplant and complete, new HTTP traffic serves, /health counts
+    the restart."""
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+    from paddle_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+
+    def factory():
+        return ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                        quarantine_faults=False,
+                                        metrics_registry=reg)
+
+    srv = GenerationServer(engine_factory=factory,
+                           restart_backoff_s=0.0)
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("death mid-wave"),
+                  nth=4)
+        queues = [srv.submit([int(t) for t in p], 8)[1]
+                  for p in _PROMPTS]
+        port = srv.start()
+        url = f"http://127.0.0.1:{port}"
+        try:
+            results = []
+            for q in queues:
+                while True:
+                    kind, payload = q.get(timeout=60)
+                    if kind != "tok":
+                        results.append((kind, payload))
+                        break
+            # first admitted wave died with the engine; the queued two
+            # transplanted and finished
+            assert [k for k, _ in results] == \
+                ["err", "err", "done", "done"]
+            assert "death mid-wave" in results[0][1][1]
+            assert results[2][1] == _ref_outputs(cfg, params)[2]
+            assert srv.restarts == 1
+            # the front keeps serving new traffic after the restart
+            got = generate_http(url, _PROMPTS[0], max_new_tokens=4)
+            assert got == _ref_outputs(cfg, params)[0][:4]
+            h = json.loads(_http_err(url + "/health")[1])
+            assert h["restarts"] == 1 and h["status"] == "ok"
+            assert h["requests_faulted"] == 2
+        finally:
+            srv.stop()

@@ -114,7 +114,9 @@ def cmd_snapshot(args) -> int:
     # padding-waste fraction, and the two-tier KV cache swap traffic
     _DERIVED = ("host_overhead_frac", "prefill_padded_token_frac",
                 "swap_out_pages_total", "swap_in_pages_total",
-                "swap_bytes_total", "prefill_tokens_avoided_total")
+                "swap_bytes_total", "prefill_tokens_avoided_total",
+                "requests_faulted_total", "engine_restarts_total",
+                "requests_rejected_total")
     derived = {}
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
