@@ -1,0 +1,66 @@
+"""paddle_tpu.analysis — hot-path invariant checker (static analysis).
+
+An AST-based rule engine (stdlib-only: ``ast`` + ``tokenize``; it
+never imports the code it inspects) that machine-checks the serving
+stack's load-bearing invariants on every test run:
+
+* ``sync-in-hot-path`` — no unjustified blocking host syncs reachable
+  from the overlap decode / packed-admission hot loops;
+* ``trace-impure`` — no side effects inside jit/shard_map/pallas-
+  traced functions;
+* ``lock-discipline`` / ``lock-order`` — shared cross-thread state
+  only under its declared lock, locks in one global order;
+* ``flush-point`` — scheduler mutations only behind a drained
+  dispatch-ahead pipeline.
+
+Entry points::
+
+    from paddle_tpu.analysis import analyze_paths, analyze_sources
+    report = analyze_paths(["paddle_tpu/models"])    # all rules
+    assert not report.unsuppressed()
+
+CLI: ``python tools/check.py`` (or the ``paddle-tpu-check`` console
+script); tier-1 wiring: ``pytest -m analysis``.  Rule catalogue and
+suppression policy: docs/STATIC_ANALYSIS.md.  Invariant declarations
+(hot roots, shared-state registry, flush exemptions):
+:mod:`paddle_tpu.analysis.annotations`.
+"""
+
+# NOTE: no `from __future__ import annotations` here — it would bind
+# the package attribute `annotations` to the compiler _Feature and
+# shadow the paddle_tpu.analysis.annotations submodule.
+from typing import Dict, List, Optional
+
+from . import annotations
+from .core import (BAD_SUPPRESSION, PARSE_ERROR, UNUSED_SUPPRESSION,
+                   Analyzer, Finding, Report, Rule, SourceModule)
+from .rules import (ALL_RULE_IDS, FlushPointRule, LockDisciplineRule,
+                    SyncLintRule, TracePurityRule, default_rules)
+
+__all__ = ["Analyzer", "Finding", "Report", "Rule", "SourceModule",
+           "analyze_paths", "analyze_sources", "default_rules",
+           "ALL_RULE_IDS", "BAD_SUPPRESSION", "PARSE_ERROR",
+           "UNUSED_SUPPRESSION",
+           "annotations", "SyncLintRule", "TracePurityRule",
+           "LockDisciplineRule", "FlushPointRule", "DEFAULT_TARGETS"]
+
+# the production modules tier-1 holds at zero unsuppressed findings
+DEFAULT_TARGETS = ("paddle_tpu/models", "paddle_tpu/inference",
+                   "paddle_tpu/observability")
+
+
+def analyze_paths(paths: List[str],
+                  rules: Optional[List[Rule]] = None) -> Report:
+    """Run ``rules`` (default: the full production set) over files /
+    directory trees."""
+    return Analyzer(rules if rules is not None
+                    else default_rules()).run_paths(paths)
+
+
+def analyze_sources(sources: Dict[str, str],
+                    rules: Optional[List[Rule]] = None) -> Report:
+    """Run over in-memory ``{modname: source}`` — the fixture seam the
+    tests and the mutation fuzzer (paddle_tpu/testing/mutants.py)
+    drive."""
+    return Analyzer(rules if rules is not None
+                    else default_rules()).run_sources(sources)

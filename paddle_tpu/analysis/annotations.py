@@ -1,0 +1,255 @@
+"""Invariant annotations: the ground truth the rules are seeded with.
+
+The analyzer cannot infer *intent* — which methods form the overlap
+hot loop, which attribute is the designated blocking seam, which lock
+guards which attributes across the engine/HTTP/supervisor threads.
+This module records those facts ONCE, next to the analysis code, and
+everything consumes it:
+
+* the rules (``paddle_tpu/analysis/rules/``) read their roots, seam
+  names and shared-state specs from here;
+* ``tests/test_analysis.py`` consistency-checks the thread-safety
+  documentation (docs/FAULT_TOLERANCE.md and the ``submit``/``cancel``
+  docstrings) against :data:`THREAD_SAFETY` — the docs cannot drift
+  from the registry without a test failure;
+* humans read it as the canonical statement of the concurrency and
+  sync contracts.
+
+When the serving stack grows a new thread, a new lock, or a new hot
+path, THIS file is where the invariant is declared.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Tuple
+
+__all__ = ["SharedStateSpec", "SHARED_STATE", "SYNC_HOT_ROOTS",
+           "DEVICE_PRODUCER_NAMES", "DEVICE_PRODUCER_ATTRS",
+           "BLOCKING_SEAMS", "EXTRA_TRACED", "FLUSH_MUTATORS",
+           "FLUSH_SAFE", "ENGINE_CLASSES", "THREAD_SAFETY",
+           "thread_safety_doc_lines"]
+
+
+# ---------------------------------------------------------------------------
+# sync-lint: the overlap decode / packed-admission hot paths
+# ---------------------------------------------------------------------------
+# Call-graph roots of the "zero blocking host syncs in steady state"
+# contract (PERF.md round 6): the dispatch-ahead decode loop, every
+# admission lane (admission runs between flushed pipelines, but its
+# syncs must still route through the audited seam), and the
+# speculative round.  Patterns are segment-aligned suffixes resolved
+# by Project.match_qualnames; make_paged_decode_step_async matches its
+# jitted closures too.
+SYNC_HOT_ROOTS: List[str] = [
+    "ContinuousBatchingEngine._decode_overlap",
+    "ContinuousBatchingEngine._dispatch_async",
+    "ContinuousBatchingEngine._drain_one",
+    "ContinuousBatchingEngine._pipeline_flush",
+    "ContinuousBatchingEngine._ensure_or_preempt",
+    "ContinuousBatchingEngine._admit_packed",
+    "ContinuousBatchingEngine._admit_batch",
+    "ContinuousBatchingEngine._admit_chunked",
+    "ContinuousBatchingEngine._admit_swapped",
+    "SpeculativeEngine._decode_once",
+    "SpeculativeEngine._finish_admit",
+    "make_paged_decode_step_async",
+]
+
+# Calls whose RESULT lives on the device: the taint seeds for the
+# "int()/float()/np.asarray() on a device value" checks.  Bare names
+# (module functions) and `self.<attr>` callables (the engine's jitted
+# step handles).  `jnp.*` / `jax.*` calls are device producers by
+# construction and are recognized structurally, not listed here.
+DEVICE_PRODUCER_NAMES: FrozenSet[str] = frozenset({
+    "_prefill", "_prefill_chunk", "_prefill_packed",
+    "_prefill_chunk_batched", "_pick_token", "_mm", "_rms_norm",
+    "_last_logits",
+})
+DEVICE_PRODUCER_ATTRS: FrozenSet[str] = frozenset({
+    "_step", "_step_async", "_dstep", "_verify",
+})
+
+# The engine's DESIGNATED blocking drain: every hot-path call to it is
+# a deliberate sync and must carry a suppression documenting why that
+# sync is sound (steady-state drain one step behind; admission
+# first-token fetch behind a flushed pipeline; speculative round
+# boundary).  This is how "reviewer vigilance" became "machine
+# checked": an unjustified drain cannot land.
+BLOCKING_SEAMS: FrozenSet[str] = frozenset({"_fetch"})
+
+
+# ---------------------------------------------------------------------------
+# trace-purity: functions staged by jit/shard_map/pallas
+# ---------------------------------------------------------------------------
+# Traced functions the structural detector cannot see (the def is
+# returned by a factory and jitted at a distance, e.g.
+# `step, step_q8 = _build_step_fns(...); jax.jit(step_q8)`).
+# Patterns match qualnames, including nested defs.
+EXTRA_TRACED: List[str] = [
+    "paged_decode._build_step_fns",
+    "paged_decode._build_tp_inner",
+]
+
+
+# ---------------------------------------------------------------------------
+# flush-point discipline (overlap=True scheduler mutations)
+# ---------------------------------------------------------------------------
+ENGINE_CLASSES: FrozenSet[str] = frozenset({
+    "ContinuousBatchingEngine", "SpeculativeEngine",
+})
+
+# Scheduler-mutation methods: calling one moves slots/pages under the
+# decode pipeline, so the CALL SITE must be dominated by a pipeline
+# flush (or schedule one) whenever overlap=True can reach it.
+FLUSH_MUTATORS: FrozenSet[str] = frozenset({
+    "_retire", "_retire_abnormal", "_preempt",
+    "_admit_packed", "_admit_batch", "_admit_chunked",
+    "_admit_swapped",
+})
+
+# Contexts exempt from the dominance check, WITH the reason the
+# exemption is sound (rendered in the finding hint when a mutant
+# removes the justification):
+FLUSH_SAFE: Dict[str, str] = {
+    "ContinuousBatchingEngine._drain_one":
+        "the drain IS the pipeline: tokens are attributed against the "
+        "dispatch-time active mask, and host-only retirements schedule "
+        "_needs_flush",
+    "ContinuousBatchingEngine._pipeline_flush":
+        "the flush itself",
+    "ContinuousBatchingEngine._quarantine":
+        "quarantine clears _inflight first — no dispatch is in flight "
+        "when the wave's slots retire",
+    "ContinuousBatchingEngine._finish_admit":
+        "admission tail: every admission lane runs behind the "
+        "_step_inner flush",
+    "ContinuousBatchingEngine._decode_sync":
+        "synchronous lane: overlap=False, there is no pipeline",
+    "SpeculativeEngine._decode_once":
+        "speculative rounds never populate _inflight — each round "
+        "fetches its own outputs before bookkeeping",
+}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline: shared state across engine / HTTP / supervisor threads
+# ---------------------------------------------------------------------------
+@dataclass
+class SharedStateSpec:
+    """Which attributes of a class are shared across threads and which
+    lock guards them.
+
+    ``attrs``: attribute names that MUST be accessed under ``lock``.
+    ``proxies``: attributes whose referent's whole state is owned by
+    the engine thread — any chained access (``self.engine.X``,
+    ``srv._driver.m()``) must hold the lock; reading the bare
+    reference is allowed (atomic ref read).
+    ``locked_methods``: methods whose body is only ever entered with
+    the lock already held (documented contract) — treated as
+    lock-held.
+    ``exempt_methods``: methods outside the discipline (single-
+    threaded construction, pure ref-read properties).  ``__init__`` /
+    ``__del__`` are always exempt.
+    """
+
+    lock: str
+    attrs: FrozenSet[str] = frozenset()
+    proxies: FrozenSet[str] = frozenset()
+    locked_methods: FrozenSet[str] = frozenset()
+    exempt_methods: FrozenSet[str] = frozenset()
+    note: str = ""
+
+
+SHARED_STATE: Dict[str, SharedStateSpec] = {
+    # HTTP front: handler threads (submit/cancel/health) race the
+    # engine drive thread; _lock serializes every engine touch.
+    "inference.serving.GenerationServer": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_queues", "_fatal"}),
+        proxies=frozenset({"engine", "_engine", "_driver",
+                           "_supervisor"}),
+        locked_methods=frozenset({"_rebind_observability",
+                                  "_is_ready_locked",
+                                  "_health_locked"}),
+        exempt_methods=frozenset({"engine", "_driver", "restarts",
+                                  "start", "stop"}),
+        note="engine state is owned by the drive thread; HTTP "
+             "handlers reach it only through submit()/cancel()/"
+             "health_snapshot(), all of which take _lock"),
+    "inference.serving.InferenceServer": SharedStateSpec(
+        lock="_count_lock",
+        attrs=frozenset({"request_count"}),
+        exempt_methods=frozenset({"start", "stop"})),
+    "inference.serving.DevicePool": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_rr"})),
+    # observability primitives: scraped from HTTP threads while the
+    # engine thread records
+    "observability.metrics.Counter": SharedStateSpec(
+        lock="_lock", attrs=frozenset({"_value"})),
+    "observability.metrics.Gauge": SharedStateSpec(
+        lock="_lock", attrs=frozenset({"_value", "_fn"})),
+    "observability.metrics.Histogram": SharedStateSpec(
+        lock="_lock", attrs=frozenset({"_counts", "_sum", "_count"})),
+    "observability.metrics.MetricsRegistry": SharedStateSpec(
+        lock="_lock", attrs=frozenset({"_metrics"})),
+    "observability.events.EventRing": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_events", "_seq", "_dropped"})),
+    # fault plane: consulted from the engine thread and HTTP handler
+    # threads concurrently
+    "testing.faults.FaultPlane": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_rules", "counts", "fired"})),
+}
+
+
+# ---------------------------------------------------------------------------
+# thread-safety contract (consistency-checked against the docs)
+# ---------------------------------------------------------------------------
+# designation -> meaning:
+#   "any-thread"          safe to call from any thread as-is
+#   "external-lock"       safe from any thread ONLY behind one shared
+#                         lock (GenerationServer serializes on _lock)
+#   "engine-thread-only"  must run on the thread driving step()
+THREAD_SAFETY: Dict[str, Tuple[str, str]] = {
+    "submit": ("external-lock",
+               "validates + enqueues; races cancel()/step() on _queue "
+               "and the rid counter"),
+    "cancel": ("external-lock",
+               "marks the rid; the engine retires it at the next "
+               "flush point"),
+    "step": ("engine-thread-only",
+             "drives admission + decode; owns every scheduler "
+             "structure"),
+    "finished": ("engine-thread-only",
+                 "drains the finished list the step loop appends to"),
+    "drain_stream": ("engine-thread-only",
+                     "drains the token stream the step loop appends "
+                     "to"),
+    "has_work": ("engine-thread-only",
+                 "reads _queue/_active without synchronization"),
+    "queued_tokens": ("any-thread",
+                      "sums an atomic tuple() snapshot of _queue, so "
+                      "scrape-thread gauges read it lock-free (at "
+                      "most one admission stale); exact behind the "
+                      "serving front's _lock"),
+    "retry_after_s": ("external-lock",
+                      "reads throughput counters the step loop "
+                      "writes; submit() consults it under the same "
+                      "serialization"),
+    "run_to_completion": ("engine-thread-only",
+                          "wraps step()/finished()"),
+}
+
+
+def thread_safety_doc_lines() -> List[str]:
+    """The markdown table rows docs/FAULT_TOLERANCE.md must carry,
+    generated from :data:`THREAD_SAFETY` so prose and registry cannot
+    diverge (asserted by tests/test_analysis.py)."""
+    rows = []
+    for api in sorted(THREAD_SAFETY):
+        designation, why = THREAD_SAFETY[api]
+        rows.append(f"| `{api}()` | `{designation}` | {why} |")
+    return rows

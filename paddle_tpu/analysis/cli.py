@@ -1,0 +1,110 @@
+"""CLI for the hot-path invariant checker.
+
+Invocations (equivalent)::
+
+    python tools/check.py [paths...] [options]
+    paddle-tpu-check [paths...] [options]        # console script
+    python -m paddle_tpu.analysis.cli [...]
+
+Default paths are the tier-1-pinned production modules
+(``paddle_tpu/models inference/ observability/``).  Exit status: 0
+clean, 1 unsuppressed findings, 2 usage errors — suitable as a
+pre-commit hook (see README).
+
+``--baseline findings.json`` grandfathers previously recorded
+findings (matched on rule + file + message, so line drift does not
+resurrect them); ``--write-baseline findings.json`` records the
+current unsuppressed set.  New code must stay clean: baselines are
+for adopting a rule over legacy findings, not for muting new ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import DEFAULT_TARGETS, analyze_paths
+from .rules import ALL_RULE_IDS, default_rules, expand_rule_ids
+
+__all__ = ["main"]
+
+
+def _repo_root() -> str:
+    """The checkout root (parent of the paddle_tpu package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle-tpu-check",
+        description="hot-path invariant checker (sync-lint, "
+                    "trace-purity, lock-discipline, flush-point)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "tier-1 production modules)")
+    p.add_argument("--rule", action="append", dest="rules",
+                   metavar="RULE_ID", choices=list(ALL_RULE_IDS),
+                   help="run only this rule (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings report on stdout")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="JSON baseline of grandfathered findings")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="record current unsuppressed findings and "
+                        "exit 0")
+    p.add_argument("--include-suppressed", action="store_true",
+                   help="show suppressed findings in text output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.rule_id:20s} {rule.description}")
+        print(f"{'lock-order':20s} inconsistent lock-acquisition "
+              f"orders (emitted by lock-discipline)")
+        return 0
+    paths = args.paths or [os.path.join(_repo_root(), t)
+                           for t in DEFAULT_TARGETS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"error: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    report = analyze_paths(paths, rules=default_rules(args.rules))
+    if args.rules:
+        # the lock rules share one implementation: scope the REPORT to
+        # the requested ids too, or `--rule lock-order` would exit 1
+        # on lock-discipline findings the user explicitly excluded
+        report.filter_rules(expand_rule_ids(args.rules))
+    if args.baseline:
+        try:
+            with open(args.baseline) as f:
+                report.apply_baseline(json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as f:
+            json.dump(report.baseline_entries(), f, indent=2)
+        print(f"wrote {len(report.baseline_entries())} baseline "
+              f"entr(ies) to {args.write_baseline}")
+        return 0
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_text(
+            include_suppressed=args.include_suppressed))
+    return 1 if report.unsuppressed() else 0
+
+
+if __name__ == "__main__":                      # pragma: no cover
+    sys.exit(main())

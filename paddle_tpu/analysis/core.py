@@ -1,0 +1,458 @@
+"""Hot-path invariant checker: the rule engine.
+
+The serving stack's speed and correctness rest on invariants that no
+runtime test can pin exhaustively — "zero blocking host syncs in
+overlap steady state", "every scheduler mutation happens behind a
+pipeline flush", "jitted step functions are pure", "shared state is
+touched only under its lock".  One stray ``.item()`` or an unlocked
+dict read silently reintroduces exactly the regressions the overlap /
+packed-admission / fault-tolerance PRs engineered away.  This package
+makes those invariants MACHINE-CHECKED on every test run: an AST walk
+over the production modules, four production rules
+(``paddle_tpu/analysis/rules/``), and a findings report wired into
+tier-1 (``tests/test_analysis.py``) and a CLI (``tools/check.py``).
+
+Everything here is stdlib-only (``ast`` + ``tokenize``): the analyzer
+must run in any environment the tests run in, and must never import
+the modules it inspects (importing would execute device code).
+
+Suppressions
+------------
+A finding is silenced IN SOURCE, next to the code it concerns::
+
+    x = np.asarray(nxt)  # analysis: ignore[sync-in-hot-path] reason=drain seam, one step behind
+
+The ``reason=`` clause is MANDATORY — a suppression without a reason
+does not suppress and instead raises a ``bad-suppression`` finding.
+A suppression comment standing alone on its own line applies to the
+next statement (for statements too long to share a line with the
+comment); both forms cover every line of a wrapped simple statement.
+See docs/STATIC_ANALYSIS.md for the policy.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Finding", "Rule", "Suppression", "SourceModule", "Report",
+           "Analyzer", "load_module", "BAD_SUPPRESSION", "PARSE_ERROR",
+           "UNUSED_SUPPRESSION"]
+
+# engine-level pseudo rule ids (reported like rule findings but emitted
+# by the analyzer itself)
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+UNUSED_SUPPRESSION = "unused-suppression"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*ignore\[([^\]]*)\]\s*(?:reason=\s*(.*\S))?\s*$")
+
+
+@dataclass
+class Finding:
+    """One rule violation, anchored to ``path:line``."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+    reason: Optional[str] = None
+    baselined: bool = False
+
+    def render(self) -> str:
+        tag = ""
+        if self.suppressed:
+            tag = f"  [suppressed: {self.reason}]"
+        elif self.baselined:
+            tag = "  [baselined]"
+        out = (f"{self.path}:{self.line}:{self.col}: "
+               f"[{self.rule}] {self.message}{tag}")
+        if self.hint and not (self.suppressed or self.baselined):
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "hint": self.hint, "suppressed": self.suppressed,
+                "reason": self.reason, "baselined": self.baselined}
+
+
+@dataclass
+class Suppression:
+    """A parsed ``# analysis: ignore[rule, ...] reason=...`` comment."""
+
+    line: int                 # line the comment sits on
+    rules: List[str]
+    reason: Optional[str]
+    standalone: bool          # comment is alone on its line
+    applies_to: set = field(default_factory=set)   # line numbers
+    used: bool = False
+
+    @property
+    def valid(self) -> bool:
+        return bool(self.reason) and bool(self.rules)
+
+    def matches(self, finding: Finding) -> bool:
+        return (finding.line in self.applies_to
+                and finding.rule in self.rules)
+
+
+def _parse_suppressions(source: str) -> List[Suppression]:
+    """Extract suppression comments via tokenize (comments are not in
+    the AST).  A standalone comment applies to itself and the next
+    code-bearing line; an inline comment applies to its own line."""
+    out: List[Suppression] = []
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m is None:
+            continue
+        rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+        reason = m.group(2)
+        lineno = tok.start[0]
+        before = lines[lineno - 1][: tok.start[1]]
+        standalone = not before.strip()
+        sup = Suppression(lineno, rules, reason, standalone)
+        sup.applies_to.add(lineno)
+        if standalone:
+            for nxt in range(lineno + 1, len(lines) + 1):
+                raw = lines[nxt - 1]
+                text = raw.strip()
+                if not text or text.startswith("#"):
+                    continue
+                # a dedent below the comment's column leaves the
+                # comment's block: a suppression sitting at the end
+                # of a compound body must not reach forward and
+                # silence the next statement of the ENCLOSING scope
+                # (round 3 cut the backward reach onto a compound
+                # head; this cuts the forward reach across a dedent)
+                if len(raw) - len(raw.lstrip()) >= tok.start[1]:
+                    sup.applies_to.add(nxt)
+                break
+        out.append(sup)
+    return out
+
+
+class SourceModule:
+    """One parsed source file: AST + suppression map + import aliases.
+
+    ``modname`` is the dotted module name derived from the path (the
+    part starting at ``paddle_tpu``), used to build qualified names
+    like ``paddle_tpu.models.serving_engine.ContinuousBatchingEngine.
+    _drain_one``.
+    """
+
+    def __init__(self, path: str, source: str, modname: str):
+        self.path = path
+        self.source = source
+        self.modname = modname
+        self.tree = ast.parse(source)
+        self.suppressions = _parse_suppressions(source)
+        self._anchor_suppressions()
+        # alias -> dotted target, e.g. {"np": "numpy",
+        #   "jnp": "jax.numpy", "_prefill":
+        #   "paddle_tpu.models.paged_decode._prefill"}
+        self.imports: Dict[str, str] = {}
+        self._collect_imports()
+
+    # statements whose whole source span a suppression may cover —
+    # for wrapped simple statements the finding can anchor to any of
+    # their lines (a call on a continuation line carries the call's
+    # own lineno).  Compound statements (defs, if/for/with/try) are
+    # excluded: covering their span would suppress an entire body.
+    _SIMPLE_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                     ast.Expr, ast.Return, ast.Assert, ast.Raise,
+                     ast.Delete)
+
+    def _anchor_suppressions(self) -> None:
+        """A suppression attached to any line of a wrapped SIMPLE
+        statement (inline on a continuation line, or standalone above
+        the statement head) must match findings anchored to any other
+        of its lines — widen ``applies_to`` to the innermost simple
+        statement's full span.  Compound statements get NO widening:
+        a comment sitting somewhere inside an ``if`` body must never
+        reach back and silence a finding on the ``if`` line (the
+        standalone form already covers a compound's head via the
+        next-code-line anchor from parsing)."""
+        stmts = [n for n in ast.walk(self.tree)
+                 if isinstance(n, ast.stmt)]
+        for sup in self.suppressions:
+            for ln in sorted(sup.applies_to):
+                spanning = [s for s in stmts
+                            if s.lineno <= ln
+                            <= (s.end_lineno or s.lineno)]
+                if not spanning:
+                    continue
+                inner = max(spanning, key=lambda s: s.lineno)
+                if isinstance(inner, self._SIMPLE_STMTS):
+                    sup.applies_to.update(
+                        range(inner.lineno,
+                              (inner.end_lineno or inner.lineno) + 1))
+
+    # -- imports ----------------------------------------------------------
+    def _package(self, level: int) -> str:
+        """The package ``level`` dots refer to (``from .. import x``)."""
+        parts = self.modname.split(".")
+        return ".".join(parts[:-level]) if level < len(parts) else ""
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = self._package(node.level)
+                    mod = (base + "." + node.module if node.module
+                           else base)
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = \
+                        f"{mod}.{a.name}" if mod else a.name
+
+    def resolve_alias(self, name: str) -> Optional[str]:
+        """Dotted target a top-level name refers to, if imported."""
+        return self.imports.get(name)
+
+
+def module_name_for(path: str) -> str:
+    """Derive a dotted module name from a file path: everything from
+    the ``paddle_tpu`` component on; bare stem otherwise."""
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if "paddle_tpu" in parts:
+        parts = parts[parts.index("paddle_tpu"):]
+    else:
+        parts = parts[-1:]
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _baseline_path_key(path: str) -> str:
+    """Stable baseline-matching key: the path suffix from the
+    ``paddle_tpu`` component on — tolerant of repo relocation, not of
+    same-named files in different packages.  Out-of-package files keep
+    their full path (no tolerance to trade for)."""
+    parts = os.path.normpath(path).split(os.sep)
+    if "paddle_tpu" in parts:
+        parts = parts[parts.index("paddle_tpu"):]
+    return "/".join(parts)
+
+
+def load_module(path: str) -> SourceModule:
+    with open(path, "r") as f:
+        source = f.read()
+    return SourceModule(path, source, module_name_for(path))
+
+
+class Rule:
+    """Base class: one invariant checked over a whole
+    :class:`~paddle_tpu.analysis.project.Project`."""
+
+    rule_id: str = "abstract"
+    description: str = ""
+
+    @property
+    def emits(self) -> List[str]:
+        """Every rule id this rule can emit findings under (the
+        lock-discipline rule also emits ``lock-order``) — consulted
+        when deciding whether an unmatched suppression is stale."""
+        return [self.rule_id]
+
+    def run(self, project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Report:
+    """All findings of one analyzer run + the suppression accounting."""
+
+    def __init__(self, findings: List[Finding],
+                 modules: Sequence[SourceModule]):
+        self.findings = findings
+        self.modules = list(modules)
+
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    # engine pseudo findings are never grandfathered: a baseline
+    # exists to adopt a RULE over legacy code, not to wave through a
+    # reasonless suppression or an unparseable file — analyzer health
+    # must fail every run until actually fixed
+    _NEVER_BASELINED = frozenset({BAD_SUPPRESSION, PARSE_ERROR,
+                                  UNUSED_SUPPRESSION})
+
+    def apply_baseline(self, entries: List[dict]) -> None:
+        """Grandfather known findings: an entry matches on
+        ``(rule, path-suffix, message)`` so baselines survive line
+        drift and repo relocation.  The path key is the in-package
+        suffix (``paddle_tpu/...``), not the basename — two modules
+        named ``serving.py`` in different packages must not silence
+        each other's findings.  The line-drift tolerance is a
+        documented trade: a NEW finding with an identical message in
+        the same file rides an existing entry (tier-1 pins the
+        production modules at zero baselined, so nothing hides behind
+        this there).  Engine pseudo findings never baseline
+        (``_NEVER_BASELINED``)."""
+        keys = {(e["rule"], _baseline_path_key(e["path"]),
+                 e["message"]) for e in entries}
+        for f in self.findings:
+            if f.rule in self._NEVER_BASELINED:
+                continue
+            if (f.rule, _baseline_path_key(f.path),
+                    f.message) in keys:
+                f.baselined = True
+
+    def filter_rules(self, keep) -> None:
+        """Drop findings whose rule id is not in ``keep``.  Engine
+        pseudo-ids (bad-suppression / parse-error /
+        unused-suppression) always pass: they report analyzer health,
+        not rule verdicts, and a ``--rule``-scoped run must still
+        refuse to bless an unparseable file or a reasonless
+        suppression.  Runs AFTER suppression accounting, so a
+        suppression matched by a filtered-out finding stays `used`
+        and never misreports as stale."""
+        ids = set(keep) | {BAD_SUPPRESSION, PARSE_ERROR,
+                           UNUSED_SUPPRESSION}
+        self.findings = [f for f in self.findings if f.rule in ids]
+
+    def baseline_entries(self) -> List[dict]:
+        return [{"rule": f.rule, "path": f.path, "message": f.message}
+                for f in self.findings
+                if not f.suppressed
+                and f.rule not in self._NEVER_BASELINED]
+
+    def render_text(self, include_suppressed: bool = False) -> str:
+        shown = [f for f in self.findings
+                 if include_suppressed
+                 or (not f.suppressed and not f.baselined)]
+        lines = [f.render() for f in shown]
+        n_bad = len(self.unsuppressed())
+        lines.append(
+            f"{len(self.findings)} finding(s): {n_bad} unsuppressed, "
+            f"{len(self.suppressed())} suppressed, "
+            f"{sum(1 for f in self.findings if f.baselined)} baselined "
+            f"across {len(self.modules)} module(s)")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"findings": [f.to_dict() for f in self.findings],
+             "modules": [m.path for m in self.modules],
+             "unsuppressed": len(self.unsuppressed())},
+            indent=2)
+
+
+class Analyzer:
+    """Load modules, run every rule, apply suppressions."""
+
+    def __init__(self, rules: Sequence[Rule]):
+        self.rules = list(rules)
+
+    def run_paths(self, paths: Sequence[str]) -> Report:
+        files: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for root, _dirs, names in os.walk(p):
+                    if "__pycache__" in root:
+                        continue
+                    files.extend(os.path.join(root, n)
+                                 for n in sorted(names)
+                                 if n.endswith(".py"))
+            else:
+                files.append(p)
+        modules, findings = [], []
+        for path in sorted(set(files)):
+            try:
+                modules.append(load_module(path))
+            except SyntaxError as e:
+                findings.append(Finding(
+                    PARSE_ERROR, path, e.lineno or 0, 0,
+                    f"cannot parse: {e.msg}"))
+        return self._run(modules, findings)
+
+    def run_sources(self, sources: Dict[str, str]) -> Report:
+        """Analyze in-memory sources: {modname: source} — the fixture
+        seam tests/test_analysis.py and the mutation fuzzer use."""
+        modules = [SourceModule(f"<{name}>", src, name)
+                   for name, src in sources.items()]
+        return self._run(modules, [])
+
+    def _run(self, modules: List[SourceModule],
+             findings: List[Finding]) -> Report:
+        from .project import Project
+        project = Project(modules)
+        for rule in self.rules:
+            findings.extend(rule.run(project))
+        active = {rid for rule in self.rules for rid in rule.emits}
+        self._apply_suppressions(modules, findings, active)
+        return Report(findings, modules)
+
+    @staticmethod
+    def _apply_suppressions(modules: List[SourceModule],
+                            findings: List[Finding],
+                            active_rules: set) -> None:
+        by_path = {m.path: m for m in modules}
+        for f in findings:
+            mod = by_path.get(f.path)
+            if mod is None:
+                continue
+            for sup in mod.suppressions:
+                if sup.matches(f):
+                    if sup.valid:
+                        f.suppressed = True
+                        f.reason = sup.reason
+                        sup.used = True
+                    # an invalid suppression never silences — the
+                    # bad-suppression finding below explains why
+        for mod in modules:
+            for sup in mod.suppressions:
+                if not sup.valid:
+                    what = ("missing mandatory reason= clause"
+                            if sup.rules else "no rule id given")
+                    findings.append(Finding(
+                        BAD_SUPPRESSION, mod.path, sup.line, 0,
+                        f"invalid suppression ({what})",
+                        hint="write `# analysis: ignore[rule-id] "
+                             "reason=<why this is sound>`"))
+                elif not sup.used \
+                        and set(sup.rules) & active_rules:
+                    # the named rule ran and flagged nothing here —
+                    # the code it justified is gone; stale comments
+                    # must not linger as phantom blind spots.  Only
+                    # judged when the named rule actually ran, so
+                    # `--rule` filtering never misfires this.
+                    findings.append(Finding(
+                        UNUSED_SUPPRESSION, mod.path, sup.line, 0,
+                        f"suppression for "
+                        f"[{', '.join(sup.rules)}] matches no "
+                        f"finding",
+                        hint="the flagged code was fixed or moved — "
+                             "delete the stale comment"))

@@ -1,0 +1,298 @@
+"""Cross-module symbol table + call graph for the rule engine.
+
+Static resolution is deliberately BEST-EFFORT: the rules need "which
+function does ``self._drain_one(...)`` name" and "what is reachable
+from the decode hot loop", not a full type system.  The resolution
+strategy (documented so rule authors know the limits):
+
+* ``name(...)`` — innermost enclosing local ``def``, then module-level
+  ``def``, then an imported alias that names a function in an analyzed
+  module;
+* ``self.m(...)`` — method ``m`` anywhere in the enclosing class's MRO
+  *plus* every override in analyzed subclasses (a base-class hot loop
+  reaches subclass hooks at runtime, so reachability must include
+  them);
+* ``mod.f(...)`` — resolved when ``mod`` is an imported alias of an
+  analyzed module;
+* ``factory(...)(args)`` — the inner call resolves (an edge to the
+  factory); the returned callable is opaque.
+
+Unresolvable calls produce no edge — rules that need stronger
+guarantees about opaque attributes take explicit name lists from
+:mod:`paddle_tpu.analysis.annotations`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import SourceModule
+
+__all__ = ["FunctionInfo", "ClassInfo", "Project"]
+
+
+class FunctionInfo:
+    def __init__(self, qualname: str, name: str, node,
+                 module: SourceModule, cls: Optional["ClassInfo"],
+                 parent: Optional["FunctionInfo"]):
+        self.qualname = qualname
+        self.name = name
+        self.node = node
+        self.module = module
+        self.cls = cls                    # class the def sits in (method)
+        self.parent = parent              # enclosing def (nested)
+        self.children: List["FunctionInfo"] = []
+
+
+class ClassInfo:
+    def __init__(self, qualname: str, name: str, node,
+                 module: SourceModule):
+        self.qualname = qualname
+        self.name = name
+        self.node = node
+        self.module = module
+        self.base_names: List[str] = []   # raw base identifiers
+        self.methods: Dict[str, FunctionInfo] = {}
+
+
+def _attr_chain(node) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for non-name-rooted chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class Project:
+    """All analyzed modules + derived indexes."""
+
+    def __init__(self, modules: List[SourceModule]):
+        self.modules = {m.modname: m for m in modules}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        for m in modules:
+            self._index_module(m)
+        self._link_hierarchy()
+        self.call_graph: Dict[str, Set[str]] = {}
+        for fn in list(self.functions.values()):
+            self.call_graph[fn.qualname] = self._call_edges(fn)
+        # by-name method index: the fallback resolution for opaque
+        # attribute calls (`self.cache.ensure_capacity(...)` — the
+        # receiver's type is unknown statically, the method name is
+        # not).  Over-approximates; used only for reachability.
+        self.methods_named: Dict[str, List[str]] = {}
+        for ci in self.classes.values():
+            for name, fi in ci.methods.items():
+                self.methods_named.setdefault(name, []).append(
+                    fi.qualname)
+
+    # -- indexing ---------------------------------------------------------
+    def _index_module(self, m: SourceModule) -> None:
+        def visit(node, prefix, cls, parent):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{prefix}.{child.name}"
+                    fi = FunctionInfo(q, child.name, child, m, cls,
+                                      parent)
+                    self.functions[q] = fi
+                    if cls is not None and parent is None:
+                        cls.methods[child.name] = fi
+                    if parent is not None:
+                        parent.children.append(fi)
+                    visit(child, q, None, fi)
+                elif isinstance(child, ast.ClassDef):
+                    q = f"{prefix}.{child.name}"
+                    ci = ClassInfo(q, child.name, child, m)
+                    for b in child.bases:
+                        chain = _attr_chain(b)
+                        if chain:
+                            ci.base_names.append(chain[-1])
+                    self.classes[q] = ci
+                    self.classes_by_name.setdefault(
+                        child.name, []).append(ci)
+                    visit(child, q, ci, None)
+                else:
+                    # descend through control flow (If/Try/With/...):
+                    # defs conditionally bound there are still defs
+                    # (e.g. the q8/non-q8 jitted step variants)
+                    visit(child, prefix, cls, parent)
+
+        visit(m.tree, m.modname, None, None)
+
+    def _link_hierarchy(self) -> None:
+        self.bases: Dict[str, List[ClassInfo]] = {}
+        self.subclasses: Dict[str, List[ClassInfo]] = {}
+        for ci in self.classes.values():
+            resolved = []
+            for bname in ci.base_names:
+                for cand in self.classes_by_name.get(bname, ()):
+                    resolved.append(cand)
+                    self.subclasses.setdefault(
+                        cand.qualname, []).append(ci)
+            self.bases[ci.qualname] = resolved
+
+    def mro(self, ci: ClassInfo) -> List[ClassInfo]:
+        out, seen = [], set()
+        stack = [ci]
+        while stack:
+            c = stack.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            out.append(c)
+            stack.extend(self.bases.get(c.qualname, ()))
+        return out
+
+    def all_subclasses(self, ci: ClassInfo) -> List[ClassInfo]:
+        out, seen = [], {ci.qualname}
+        stack = list(self.subclasses.get(ci.qualname, ()))
+        while stack:
+            c = stack.pop()
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            out.append(c)
+            stack.extend(self.subclasses.get(c.qualname, ()))
+        return out
+
+    # -- resolution -------------------------------------------------------
+    def method_defs(self, ci: ClassInfo, name: str,
+                    include_overrides: bool = True
+                    ) -> List[FunctionInfo]:
+        """Defs ``self.<name>`` may dispatch to: MRO definitions plus
+        (for reachability soundness) subclass overrides."""
+        out = []
+        for c in self.mro(ci):
+            if name in c.methods:
+                out.append(c.methods[name])
+                break
+        if include_overrides:
+            for c in self.all_subclasses(ci):
+                if name in c.methods:
+                    out.append(c.methods[name])
+        return out
+
+    def resolve_name(self, name: str,
+                     scope: FunctionInfo) -> List[FunctionInfo]:
+        """A bare ``name`` in ``scope``: nested defs of enclosing
+        functions, module-level defs, then import aliases."""
+        fn = scope
+        while fn is not None:
+            for child in fn.children:
+                if child.name == name:
+                    return [child]
+            fn = fn.parent
+        mod_q = f"{scope.module.modname}.{name}"
+        if mod_q in self.functions:
+            return [self.functions[mod_q]]
+        target = scope.module.resolve_alias(name)
+        if target and target in self.functions:
+            return [self.functions[target]]
+        return []
+
+    def resolve_call(self, call: ast.Call,
+                     scope: FunctionInfo) -> List[FunctionInfo]:
+        func = call.func
+        if isinstance(func, ast.Call):           # factory(...)(args)
+            return self.resolve_call(func, scope)
+        if isinstance(func, ast.Name):
+            return self.resolve_name(func.id, scope)
+        if isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            if chain is None:
+                return []
+            if chain[0] == "self" and len(chain) == 2 \
+                    and scope.cls is not None:
+                return self.method_defs(scope.cls, chain[1])
+            if len(chain) == 2:
+                target = scope.module.resolve_alias(chain[0])
+                if target and target in self.modules:
+                    q = f"{target}.{chain[1]}"
+                    if q in self.functions:
+                        return [self.functions[q]]
+        return []
+
+    def _call_edges(self, fn: FunctionInfo) -> Set[str]:
+        edges: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                for callee in self.resolve_call(node, fn):
+                    edges.add(callee.qualname)
+        # calls inside nested defs belong to the nested def's edges,
+        # but ast.walk(fn.node) sees them too — prune by re-attributing:
+        # simplest correct form: subtract nothing (over-approximation
+        # is sound for reachability; rules that need exact bodies walk
+        # the node themselves with nested defs skipped)
+        return edges
+
+    # -- reachability -----------------------------------------------------
+    def match_qualnames(self, pattern: str) -> List[str]:
+        """Qualnames matching ``pattern``: exact, segment-aligned
+        suffix (``Engine._drain_one``), or prefix (a function name
+        matches its nested defs too)."""
+        out = []
+        for q in self.functions:
+            if q == pattern or q.endswith("." + pattern) \
+                    or q.startswith(pattern + "."):
+                out.append(q)
+                continue
+            if ("." + pattern + ".") in q:
+                out.append(q)
+        return out
+
+    def reachable(self, roots: List[str],
+                  attr_methods: bool = False) -> Set[str]:
+        """Functions reachable from root patterns through resolved
+        call edges; a reached function also pulls in its nested defs
+        (closures run inside the caller's dynamic extent).  With
+        ``attr_methods=True``, unresolvable attribute calls also
+        reach same-named methods of analyzed classes (see
+        :meth:`reachable_with_attr_methods`)."""
+        seeds: Set[str] = set()
+        for pat in roots:
+            seeds.update(self.match_qualnames(pat))
+        seen: Set[str] = set()
+        stack = list(seeds)
+        while stack:
+            q = stack.pop()
+            if q in seen or q not in self.functions:
+                continue
+            seen.add(q)
+            fi = self.functions[q]
+            stack.extend(c.qualname for c in fi.children)
+            stack.extend(self.call_graph.get(q, ()))
+            if attr_methods:
+                stack.extend(self._attr_method_edges(fi))
+        return seen
+
+    def _attr_method_edges(self, fn: FunctionInfo) -> Set[str]:
+        """Fallback edges for calls :meth:`resolve_call` cannot place:
+        an attribute call resolves BY METHOD NAME to every analyzed
+        class method with that name (`self.cache.release_row(...)` ->
+        PagedKVCache.release_row).  Sound over-approximation for
+        reachability walks; never used for precise resolution."""
+        edges: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.resolve_call(node, fn):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                edges.update(self.methods_named.get(func.attr, ()))
+        return edges
+
+    def reachable_with_attr_methods(self,
+                                    roots: List[str]) -> Set[str]:
+        """Like :meth:`reachable` but unresolvable attribute calls
+        also reach same-named methods of analyzed classes — the hot
+        loop's `self.cache.*` / `self.host.*` helpers stay inside the
+        checked perimeter."""
+        return self.reachable(roots, attr_methods=True)

@@ -1,0 +1,64 @@
+"""Production rule set for the hot-path invariant checker.
+
+Four rules, each guarding an invariant a previous PR engineered into
+the serving stack (docs/STATIC_ANALYSIS.md is the catalogue):
+
+========================  =================================================
+rule id                   invariant
+========================  =================================================
+``sync-in-hot-path``      zero unjustified blocking host syncs reachable
+                          from the overlap decode / packed-admission paths
+``trace-impure``          jit/shard_map/pallas-traced functions are pure
+``lock-discipline``       shared cross-thread state only under its lock
+``lock-order``            one global lock-acquisition order
+``flush-point``           scheduler mutations behind a drained pipeline
+========================  =================================================
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .flush_lint import FlushPointRule
+from .lock_discipline import LOCK_ORDER_RULE_ID, LockDisciplineRule
+from .sync_lint import SyncLintRule
+from .trace_purity import TracePurityRule
+
+__all__ = ["SyncLintRule", "TracePurityRule", "LockDisciplineRule",
+           "FlushPointRule", "LOCK_ORDER_RULE_ID", "default_rules",
+           "expand_rule_ids", "ALL_RULE_IDS"]
+
+# every id a finding can carry (lock-order is emitted by
+# LockDisciplineRule; bad-suppression/parse-error by the engine)
+ALL_RULE_IDS = ("sync-in-hot-path", "trace-impure", "lock-discipline",
+                "lock-order", "flush-point")
+
+
+def expand_rule_ids(only: List[str]) -> set:
+    """The finding ids a ``--rule`` selection is entitled to see:
+    ``lock-discipline`` keeps its documented ``lock-order`` ride-along
+    (one rule emits both); the reverse does NOT hold — a run scoped to
+    ``lock-order`` must not fail on lock-discipline findings the
+    implementing rule also produced."""
+    keep = set(only)
+    if "lock-discipline" in keep:
+        keep.add(LOCK_ORDER_RULE_ID)
+    return keep
+
+
+def default_rules(only: List[str] = None) -> List[Rule]:
+    """The production rule set, configured from
+    :mod:`paddle_tpu.analysis.annotations`.  ``only`` filters by rule
+    id; selecting ``lock-order`` runs its implementing rule
+    (LockDisciplineRule) — pair with
+    :meth:`~paddle_tpu.analysis.core.Report.filter_rules` over
+    :func:`expand_rule_ids` so only the requested findings surface."""
+    rules: List[Rule] = [SyncLintRule(), TracePurityRule(),
+                         LockDisciplineRule(), FlushPointRule()]
+    if only:
+        keep = set(only)
+        if LOCK_ORDER_RULE_ID in keep:
+            keep.add("lock-discipline")
+        rules = [r for r in rules if r.rule_id in keep]
+    return rules

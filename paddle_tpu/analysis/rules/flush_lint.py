@@ -1,0 +1,144 @@
+"""flush-point: scheduler mutations happen only behind a drained
+pipeline when ``overlap=True`` paths can reach them.
+
+The dispatch-ahead pipeline (PR 2, PERF.md round 6) keeps up to
+``lookahead`` decode dispatches in flight.  Admission, preemption,
+cancellation sweeps and retirement all MOVE slots and pages; doing so
+under an in-flight dispatch hands a victim's pages to its successor
+while stale writes are still queued — the classic corruption the
+flush discipline exists to prevent.  The invariant: every call site
+of a scheduler-mutation method (:data:`~paddle_tpu.analysis.
+annotations.FLUSH_MUTATORS`) inside an engine class must be
+
+* DOMINATED by flush handling in the same function — a
+  ``self._pipeline_flush()`` call or a ``self._needs_flush = True``
+  schedule appearing earlier in the function body, or
+* inside a context :data:`~paddle_tpu.analysis.annotations.
+  FLUSH_SAFE` declares exempt, with the recorded justification (the
+  sync lane has no pipeline; the drain IS the pipeline; quarantine
+  clears the in-flight list first).
+
+"Earlier in the function" is a deliberate, reviewable approximation
+of dominance: the engine's flush points all sit at the top of their
+functions, and a mutant that deletes the flush (the fuzz seam in
+paddle_tpu/testing/mutants.py exercises exactly this) leaves no
+earlier mention and trips the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .. import annotations as A
+from ..core import Finding, Rule
+from ..project import FunctionInfo, Project
+from .sync_lint import _iter_own_nodes
+
+__all__ = ["FlushPointRule"]
+
+
+class FlushPointRule(Rule):
+    rule_id = "flush-point"
+    description = ("scheduler-mutation call sites not dominated by a "
+                   "pipeline flush on overlap-reachable paths")
+
+    def __init__(self, mutators: Optional[Set[str]] = None,
+                 flush_safe: Optional[Dict[str, str]] = None,
+                 engine_classes: Optional[Set[str]] = None,
+                 flush_markers: Optional[Set[str]] = None):
+        self.mutators = set(mutators) if mutators is not None \
+            else set(A.FLUSH_MUTATORS)
+        self.flush_safe = dict(flush_safe) if flush_safe is not None \
+            else dict(A.FLUSH_SAFE)
+        self.engine_classes = set(engine_classes) \
+            if engine_classes is not None else set(A.ENGINE_CLASSES)
+        self.flush_markers = set(flush_markers) \
+            if flush_markers is not None \
+            else {"_pipeline_flush", "_needs_flush"}
+
+    def _is_engine_fn(self, fn: FunctionInfo) -> bool:
+        cls, anc = fn.cls, fn
+        while cls is None and anc.parent is not None:
+            anc = anc.parent
+            cls = anc.cls
+        return cls is not None and cls.name in self.engine_classes
+
+    def _safe_reason(self, fn: FunctionInfo) -> Optional[str]:
+        for pat, why in self.flush_safe.items():
+            q = fn.qualname
+            if q == pat or q.endswith("." + pat) \
+                    or ("." + pat + ".") in q:
+                return why
+        return None
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in project.functions.values():
+            if not self._is_engine_fn(fn):
+                continue
+            if fn.name in self.mutators:
+                continue             # the mutator body, not a call site
+            if self._safe_reason(fn) is not None:
+                continue
+            findings.extend(self._check_function(fn))
+        return findings
+
+    @staticmethod
+    def _is_self_attr(node, names: Set[str]) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in names)
+
+    def _check_function(self, fn: FunctionInfo) -> List[Finding]:
+        out: List[Finding] = []
+        # lines where flush HANDLING is visible in THIS function: a
+        # `self._pipeline_flush()` call or a `self._needs_flush = True`
+        # schedule.  A bare READ of a marker (`if self._needs_flush:
+        # return`) is not handling, and neither is CLEARING the flag
+        # (`self._needs_flush = False`) — counting either would let an
+        # unflushed mutation hide behind the code that skipped or
+        # cancelled the flush.  Nested defs are excluded on both
+        # sides: a flush inside a closure never dominates the
+        # enclosing body (the closure may run later or not at all),
+        # and a closure's own mutations are checked when the closure
+        # is analyzed as its own function.  Lambdas are asymmetric:
+        # they are never indexed as functions, so their mutation
+        # calls are checked HERE (lambdas=True below) — but a flush
+        # deferred into a lambda has not happened and never counts
+        # as a marker (lambdas=False).
+        marker_lines: List[int] = []
+        for node in _iter_own_nodes(fn.node, lambdas=False):
+            if isinstance(node, ast.Call) \
+                    and self._is_self_attr(node.func,
+                                           self.flush_markers):
+                marker_lines.append(node.lineno)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is True \
+                    and any(self._is_self_attr(t, self.flush_markers)
+                            for t in node.targets):
+                marker_lines.append(node.lineno)
+        for node in _iter_own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "self"
+                    and func.attr in self.mutators):
+                continue
+            if any(ml <= node.lineno for ml in marker_lines):
+                continue
+            out.append(Finding(
+                self.rule_id, fn.module.path, node.lineno,
+                node.col_offset,
+                f"scheduler mutation `self.{func.attr}()` in "
+                f"{fn.qualname} is not dominated by a pipeline flush",
+                "drain the lookahead pipeline first "
+                "(`self._pipeline_flush()` when overlap is on, or "
+                "schedule `self._needs_flush = True`), or register "
+                "the context in analysis/annotations.py FLUSH_SAFE "
+                "with its justification"))
+        return out

@@ -1,0 +1,319 @@
+"""lock-discipline / lock-order: shared state is touched only under
+its guarding lock, and locks nest in one global order.
+
+The serving stack runs three kinds of threads concurrently: the
+engine drive thread, HTTP handler threads (submit / cancel / health /
+metrics scrapes), and the supervisor's restart path.  Which attributes
+they share, and which lock guards each, is declared in
+:data:`paddle_tpu.analysis.annotations.SHARED_STATE` — this rule
+enforces the declaration:
+
+* inside a registered class's methods, reading or writing a shared
+  attribute (``self._queues``, ``self._fatal``) outside ``with
+  self.<lock>:`` is a finding;
+* PROXY attributes (``GenerationServer.engine`` / ``_driver``) name
+  objects whose whole state belongs to the engine thread: any chained
+  access (``self.engine.step_faults``, ``srv._driver.submit(...)``)
+  must hold the lock — reading the bare reference is allowed (atomic
+  ref read), and aliases (``eng = self.engine``) are tracked;
+* OTHER functions join the discipline by ANNOTATING the instance:
+  ``srv: "GenerationServer" = self.server.owner`` (the HTTP handlers'
+  existing idiom) or an annotated parameter — the rule then audits
+  the variable exactly like ``self``;
+* methods listed ``locked_methods`` are contract-documented as
+  called-with-lock-held and check as such; ``exempt_methods`` (and
+  always ``__init__``/``__del__``) are outside the discipline;
+* every textually nested lock acquisition contributes an ordering
+  edge; a pair of acquisitions observed in BOTH orders anywhere in
+  the analyzed set is a ``lock-order`` finding (the classic ABBA
+  deadlock shape).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import annotations as A
+from ..core import Finding, Rule
+from ..project import FunctionInfo, Project, _attr_chain
+
+__all__ = ["LockDisciplineRule", "LOCK_ORDER_RULE_ID"]
+
+LOCK_ORDER_RULE_ID = "lock-order"
+
+
+class LockDisciplineRule(Rule):
+    rule_id = "lock-discipline"
+    description = ("shared-state access outside the guarding lock, "
+                   "and inconsistent lock-acquisition orders")
+
+    @property
+    def emits(self) -> List[str]:
+        return [self.rule_id, LOCK_ORDER_RULE_ID]
+
+    def __init__(self, shared_state: Optional[dict] = None):
+        self.shared_state = dict(shared_state) \
+            if shared_state is not None else dict(A.SHARED_STATE)
+        # simple class name -> (key, spec), for annotation matching
+        self.by_simple_name = {key.rsplit(".", 1)[-1]: (key, spec)
+                               for key, spec in self.shared_state.items()}
+
+    def _spec_for_class(self, qualname: str):
+        for key, spec in self.shared_state.items():
+            if qualname == key or qualname.endswith("." + key):
+                return spec
+        return None
+
+    # ------------------------------------------------------------------
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        self._order_edges: Dict[Tuple[str, str],
+                                Tuple[str, int]] = {}
+        for fn in project.functions.values():
+            findings.extend(self._check_function(fn))
+        findings.extend(self._order_findings())
+        return findings
+
+    def _order_findings(self) -> List[Finding]:
+        out = []
+        reported = set()
+        for (a, b), (path, line) in sorted(self._order_edges.items()):
+            if (b, a) in self._order_edges and a < b \
+                    and (a, b) not in reported:
+                reported.add((a, b))
+                other_path, other_line = self._order_edges[(b, a)]
+                out.append(Finding(
+                    LOCK_ORDER_RULE_ID, path, line, 0,
+                    f"lock order inversion: `{a}` -> `{b}` here but "
+                    f"`{b}` -> `{a}` at {other_path}:{other_line}",
+                    "pick one global acquisition order and refactor "
+                    "the minority site (ABBA nesting deadlocks under "
+                    "contention)"))
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_function(self, fn: FunctionInfo) -> List[Finding]:
+        out: List[Finding] = []
+        # tracked instance vars: var name -> (spec, owner-kind)
+        tracked: Dict[str, object] = {}
+        aliases: Dict[str, str] = {}          # proxy alias -> owner var
+        # a closure inherits the enclosing method's discipline —
+        # shared state is no less shared one `def` deeper, and a
+        # closure typically runs on whatever thread calls it later
+        spec = None
+        outermost = fn
+        while outermost.parent is not None:
+            outermost = outermost.parent
+        if outermost.cls is not None:
+            spec = self._spec_for_class(outermost.cls.qualname)
+        exempt = {"__init__", "__del__"}
+        if spec is not None:
+            if outermost.name in exempt | set(spec.exempt_methods):
+                spec = None
+            else:
+                tracked["self"] = spec
+        # annotated parameters + annotated assignments, the enclosing
+        # defs' included (a closure sees the parent's `srv` binding)
+        anc = fn
+        while anc is not None:
+            argspec = anc.node.args
+            for a in (argspec.args + argspec.kwonlyargs
+                      + argspec.posonlyargs):
+                s = self._annotation_spec(a.annotation)
+                if s is not None:
+                    tracked.setdefault(a.arg, s)
+            for node in ast.walk(anc.node):
+                if isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    s = self._annotation_spec(node.annotation)
+                    if s is not None:
+                        tracked.setdefault(node.target.id, s)
+            anc = anc.parent
+        if not tracked:
+            # still contribute lock-order edges from textual nesting
+            self._collect_order(fn, tracked)
+            return out
+        held0: Set[str] = set()
+        if spec is not None and fn.name in spec.locked_methods:
+            held0.add("self")
+
+        def lock_var(expr) -> Optional[str]:
+            """var whose registered lock this with-item acquires."""
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name):
+                v = expr.value.id
+                s = tracked.get(aliases.get(v, v))
+                if s is not None and expr.attr == s.lock:
+                    return aliases.get(v, v)
+            return None
+
+        def flag(node, message, hint=""):
+            out.append(Finding(self.rule_id, fn.module.path,
+                               node.lineno, node.col_offset, message,
+                               hint))
+
+        def check_expr(e, held: Set[str]) -> None:
+            for node in ast.walk(e):
+                if isinstance(node, ast.Attribute):
+                    self._check_attr(node, fn, tracked, aliases, held,
+                                     flag)
+
+        def track_alias(stmt) -> None:
+            if not isinstance(stmt, ast.Assign) \
+                    or len(stmt.targets) != 1 \
+                    or not isinstance(stmt.targets[0], ast.Name):
+                return
+            tgt = stmt.targets[0].id
+            v = stmt.value
+            if isinstance(v, ast.Name) and v.id in aliases:
+                aliases[tgt] = aliases[v.id]
+            elif isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name):
+                owner = v.value.id
+                owner = aliases.get(owner, owner)
+                s = tracked.get(owner)
+                if s is not None and v.attr in s.proxies:
+                    aliases[tgt] = owner
+
+        def walk(stmts, held: Set[str]) -> None:
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(s, ast.With):
+                    newly = set()
+                    for item in s.items:
+                        check_expr(item.context_expr, held)
+                        v = lock_var(item.context_expr)
+                        if v is not None:
+                            newly.add(v)
+                    walk(s.body, held | newly)
+                    continue
+                track_alias(s)
+                if isinstance(s, (ast.If, ast.While)):
+                    check_expr(s.test, held)
+                    walk(s.body, held)
+                    walk(s.orelse, held)
+                elif isinstance(s, ast.For):
+                    check_expr(s.iter, held)
+                    check_expr(s.target, held)
+                    walk(s.body, held)
+                    walk(s.orelse, held)
+                elif isinstance(s, ast.Try):
+                    walk(s.body, held)
+                    for h in s.handlers:
+                        walk(h.body, held)
+                    walk(s.orelse, held)
+                    walk(s.finalbody, held)
+                else:
+                    check_expr(s, held)
+
+        walk(fn.node.body, held0)
+        self._collect_order(fn, tracked)
+        return out
+
+    # ------------------------------------------------------------------
+    def _annotation_spec(self, ann):
+        if ann is None:
+            return None
+        name = None
+        if isinstance(ann, ast.Name):
+            name = ann.id
+        elif isinstance(ann, ast.Constant) \
+                and isinstance(ann.value, str):
+            name = ann.value.rsplit(".", 1)[-1]
+        elif isinstance(ann, ast.Attribute):
+            name = ann.attr
+        if name is None:
+            return None
+        hit = self.by_simple_name.get(name)
+        return hit[1] if hit else None
+
+    def _check_attr(self, node: ast.Attribute, fn: FunctionInfo,
+                    tracked, aliases, held: Set[str], flag) -> None:
+        v = node.value
+        # direct shared-attr access: var.<attr in spec.attrs>
+        if isinstance(v, ast.Name):
+            owner = aliases.get(v.id, v.id)
+            s = tracked.get(owner)
+            if s is not None and v.id not in aliases:
+                if node.attr in s.attrs and owner not in held:
+                    kind = "write to" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "read of"
+                    flag(node,
+                         f"unlocked {kind} shared attribute "
+                         f"`{v.id}.{node.attr}` in {fn.qualname}",
+                         f"guard with `with {v.id}.{s.lock}:` or use "
+                         f"a locked accessor (see analysis/"
+                         f"annotations.py SHARED_STATE)")
+                    return
+            if v.id in aliases and owner not in held:
+                # any dereference of a proxy alias needs the lock
+                flag(node,
+                     f"engine-state access `{v.id}.{node.attr}` "
+                     f"outside the owner lock in {fn.qualname}",
+                     "the referent is owned by the engine thread; "
+                     "hold the server lock or use a locked accessor")
+                return
+        # chained proxy access: var.<proxy>.<anything>
+        if isinstance(v, ast.Attribute) \
+                and isinstance(v.value, ast.Name):
+            owner = aliases.get(v.value.id, v.value.id)
+            s = tracked.get(owner)
+            if s is not None and v.attr in s.proxies \
+                    and owner not in held:
+                flag(node,
+                     f"unlocked engine-state access "
+                     f"`{v.value.id}.{v.attr}.{node.attr}` in "
+                     f"{fn.qualname}",
+                     f"chained access through a proxy attribute "
+                     f"must hold `{s.lock}`")
+
+    # -- lock-order edges --------------------------------------------------
+    def _lock_key(self, expr, fn: FunctionInfo,
+                  tracked) -> Optional[str]:
+        if not (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            return None
+        attr = expr.attr
+        known_locks = {s.lock for s in self.shared_state.values()}
+        if attr not in known_locks and "lock" not in attr:
+            return None
+        v = expr.value.id
+        if v == "self" and fn.cls is not None:
+            return f"{fn.cls.name}.{attr}"
+        s = tracked.get(v)
+        if s is not None:
+            for key, sp in self.shared_state.items():
+                if sp is s:
+                    return f"{key.rsplit('.', 1)[-1]}.{attr}"
+        return f"{v}.{attr}"
+
+    def _collect_order(self, fn: FunctionInfo, tracked) -> None:
+        edges = self._order_edges
+
+        def walk(stmts, stack: List[str]) -> None:
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(s, ast.With):
+                    keys = [k for k in
+                            (self._lock_key(i.context_expr, fn,
+                                            tracked)
+                             for i in s.items) if k]
+                    for k in keys:
+                        for outer in stack:
+                            if outer != k:
+                                edges.setdefault(
+                                    (outer, k),
+                                    (fn.module.path, s.lineno))
+                    walk(s.body, stack + keys)
+                    continue
+                for attr in ("body", "orelse", "finalbody"):
+                    walk(getattr(s, attr, []), stack)
+                for h in getattr(s, "handlers", []):
+                    walk(h.body, stack)
+
+        walk(fn.node.body, [])

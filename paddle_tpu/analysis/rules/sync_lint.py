@@ -1,0 +1,251 @@
+"""sync-in-hot-path: no unjustified blocking host<->device sync in
+the overlap decode / packed-admission hot paths.
+
+The dispatch-ahead pipeline's whole value proposition (PERF.md round
+6) is that the host never blocks on the step it just dispatched.  One
+stray ``.item()`` / ``np.asarray`` / ``int()`` on a device value
+re-serializes host and device and silently gives the win back.  This
+rule walks the call graph from the hot roots
+(:data:`~paddle_tpu.analysis.annotations.SYNC_HOT_ROOTS`) and flags:
+
+* ``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` —
+  always (there is no innocent use of these in the hot path);
+* ``np.asarray`` / ``np.array`` / ``int()`` / ``float()`` applied to
+  a DEVICE-TAINTED value — taint seeds from calls to ``jnp.*`` /
+  ``jax.*`` and the known device producers (the jitted step handles,
+  the prefill factories) and propagates through assignments,
+  unpacking, subscripts and arithmetic;
+* calls to the designated blocking seam (``engine._fetch``) — every
+  deliberate drain must carry a suppression documenting why that sync
+  is sound, so the set of sanctioned syncs is enumerable by grep.
+
+Host-numpy arithmetic (``int(self.lens[slot])`` on the host mirror)
+is NOT flagged: taint starts only at device-producing calls.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .. import annotations as A
+from ..core import Finding, Rule
+from ..project import FunctionInfo, Project, _attr_chain
+
+__all__ = ["SyncLintRule"]
+
+_ALWAYS_BLOCKING_ATTRS = {"item", "block_until_ready"}
+_NP_SINKS = {"asarray", "array"}
+
+
+def _iter_own_nodes(fn_node, lambdas: bool = True):
+    """Walk a function body EXCLUDING nested function/class defs (they
+    are separate FunctionInfos and analyzed on their own).  Lambda
+    bodies ARE included by default: lambdas are never indexed as
+    functions, so the enclosing function's walk is the only look any
+    rule gets at them — skipping them would make ``key=lambda s:
+    int(nxt_dev[s])`` a blind spot.  Pass ``lambdas=False`` where
+    crediting a lambda's body would be unsound (flush-marker
+    detection: a flush deferred into a callback has not happened)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Lambda) and not lambdas:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own_statements(fn_node):
+    """Statements of the body in source order, recursing into control
+    flow but not nested defs."""
+    out = []
+
+    def walk(stmts):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            out.append(s)
+            for attr in ("body", "orelse", "finalbody"):
+                walk(getattr(s, attr, []))
+            for h in getattr(s, "handlers", []):
+                walk(h.body)
+
+    walk(fn_node.body)
+    return out
+
+
+class SyncLintRule(Rule):
+    rule_id = "sync-in-hot-path"
+    description = ("blocking host sync APIs reachable from the overlap "
+                   "decode / packed-admission hot loops")
+
+    def __init__(self, roots: Optional[List[str]] = None,
+                 device_names: Optional[Set[str]] = None,
+                 device_attrs: Optional[Set[str]] = None,
+                 seams: Optional[Set[str]] = None):
+        self.roots = list(roots) if roots is not None \
+            else list(A.SYNC_HOT_ROOTS)
+        self.device_names = set(device_names) if device_names \
+            is not None else set(A.DEVICE_PRODUCER_NAMES)
+        self.device_attrs = set(device_attrs) if device_attrs \
+            is not None else set(A.DEVICE_PRODUCER_ATTRS)
+        self.seams = set(seams) if seams is not None \
+            else set(A.BLOCKING_SEAMS)
+
+    # -- device taint -----------------------------------------------------
+    def _is_device_call(self, call: ast.Call, fn: FunctionInfo) -> bool:
+        func = call.func
+        if isinstance(func, ast.Call):          # _prefill(cfg)(...)
+            return self._is_device_call(func, fn)
+        if isinstance(func, ast.Name):
+            if func.id in self.device_names:
+                return True
+            target = fn.module.resolve_alias(func.id)
+            return bool(target) and (target == "jax"
+                                     or target.startswith("jax."))
+        if isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            if chain is None:
+                return False
+            if chain[0] == "self" and len(chain) >= 2 \
+                    and chain[1] in self.device_attrs:
+                return True
+            target = fn.module.resolve_alias(chain[0])
+            return bool(target) and (target == "jax"
+                                     or target.startswith("jax."))
+        return False
+
+    def _expr_tainted(self, e, taint: Set[str],
+                      fn: FunctionInfo) -> bool:
+        """Does expression ``e`` carry device taint?  The ONE walker
+        used both to grow the taint set and to test sink arguments —
+        a shared implementation so the two sides cannot drift."""
+        if isinstance(e, ast.Name):
+            return e.id in taint
+        if isinstance(e, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self._expr_tainted(e.value, taint, fn)
+        if isinstance(e, ast.BinOp):
+            return (self._expr_tainted(e.left, taint, fn)
+                    or self._expr_tainted(e.right, taint, fn))
+        if isinstance(e, ast.UnaryOp):
+            return self._expr_tainted(e.operand, taint, fn)
+        if isinstance(e, ast.IfExp):
+            return (self._expr_tainted(e.body, taint, fn)
+                    or self._expr_tainted(e.orelse, taint, fn))
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(x, taint, fn)
+                       for x in e.elts)
+        if isinstance(e, ast.Call):
+            return self._is_device_call(e, fn)
+        return False
+
+    def _taint_set(self, fn: FunctionInfo) -> Set[str]:
+        taint: Set[str] = set()
+
+        def expr_tainted(e) -> bool:
+            return self._expr_tainted(e, taint, fn)
+
+        def mark(target) -> None:
+            if isinstance(target, ast.Name):
+                taint.add(target.id)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for t in target.elts:
+                    mark(t)
+            elif isinstance(target, ast.Starred):
+                mark(target.value)
+
+        stmts = _own_statements(fn.node)
+        for _ in range(2):                      # loop-carried taint
+            for s in stmts:
+                if isinstance(s, ast.Assign) and expr_tainted(s.value):
+                    for t in s.targets:
+                        mark(t)
+                elif isinstance(s, ast.AugAssign) \
+                        and expr_tainted(s.value):
+                    mark(s.target)
+                elif isinstance(s, ast.AnnAssign) and s.value is not None \
+                        and expr_tainted(s.value):
+                    mark(s.target)
+                elif isinstance(s, ast.For) and expr_tainted(s.iter):
+                    mark(s.target)
+        return taint
+
+    # -- rule body --------------------------------------------------------
+    def run(self, project: Project) -> List[Finding]:
+        hot = project.reachable_with_attr_methods(self.roots)
+        findings: List[Finding] = []
+        for q in sorted(hot):
+            fn = project.functions.get(q)
+            if fn is None:
+                continue
+            findings.extend(self._check_function(fn))
+        return findings
+
+    def _check_function(self, fn: FunctionInfo) -> List[Finding]:
+        out: List[Finding] = []
+        taint = self._taint_set(fn)
+        mod = fn.module
+
+        def flag(node, message, hint):
+            out.append(Finding(self.rule_id, mod.path, node.lineno,
+                               node.col_offset, message, hint))
+
+        for node in _iter_own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                chain = _attr_chain(func)
+                if func.attr in _ALWAYS_BLOCKING_ATTRS:
+                    flag(node,
+                         f"blocking `.{func.attr}()` in hot-path "
+                         f"function {fn.qualname}",
+                         "keep the value on device, or drain it "
+                         "through the engine's _fetch seam at a "
+                         "sanctioned point")
+                    continue
+                if chain and chain[0] == "self" and len(chain) == 2 \
+                        and chain[1] in self.seams:
+                    flag(node,
+                         f"call to blocking drain seam "
+                         f"`{chain[1]}` in {fn.qualname}",
+                         "every deliberate drain needs `# analysis: "
+                         "ignore[sync-in-hot-path] reason=...` naming "
+                         "why this sync is sound here")
+                    continue
+                if chain:
+                    target = mod.resolve_alias(chain[0])
+                    if target == "jax" and chain[-1] == "device_get":
+                        flag(node,
+                             f"`jax.device_get` in hot-path function "
+                             f"{fn.qualname}",
+                             "device_get blocks until the value "
+                             "materializes on host")
+                        continue
+                    if target == "numpy" and len(chain) == 2 \
+                            and chain[1] in _NP_SINKS \
+                            and any(self._expr_tainted(a, taint, fn)
+                                    for a in node.args):
+                        flag(node,
+                             f"`np.{chain[1]}` on a device value in "
+                             f"hot-path function {fn.qualname}",
+                             "this is a blocking transfer; chain the "
+                             "value on device or route through the "
+                             "_fetch seam")
+                        continue
+            elif isinstance(func, ast.Name):
+                if func.id in ("int", "float") and node.args \
+                        and self._expr_tainted(node.args[0], taint,
+                                               fn):
+                    flag(node,
+                         f"`{func.id}()` on a device value in "
+                         f"hot-path function {fn.qualname}",
+                         "scalar coercion of a traced/device value "
+                         "blocks the pipeline; fetch a batch at the "
+                         "drain point instead")
+        return out

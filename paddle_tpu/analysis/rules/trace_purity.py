@@ -1,0 +1,272 @@
+"""trace-impure: no side effects inside jit/shard_map/pallas-traced
+functions.
+
+A traced function's Python body runs ONCE, at trace time.  Reading the
+clock, drawing from a global RNG, appending to a captured list, or
+bumping a metrics counter inside one does not error — it silently
+bakes the trace-time value into the compiled program forever (the
+counter increments once per COMPILE, the timestamp is frozen, the
+list grows per retrace).  TensorFlow ships autograph diagnostics for
+exactly this class of bug; this rule is ours.
+
+Traced functions are found structurally:
+
+* ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` decorators;
+* a local/module def later passed to ``jax.jit(f)``, ``shard_map(f,
+  ...)`` or ``pallas_call(f, ...)`` (by name, including the repo's
+  version-compat shard_map shims);
+* qualname patterns from
+  :data:`~paddle_tpu.analysis.annotations.EXTRA_TRACED` — factories
+  whose returned defs are jitted at a distance;
+* every def NESTED in a traced def.
+
+Flagged inside a traced body: calls into host-clock/RNG/I-O modules
+(``time``, ``random``, ``datetime``, ``np.random``), ``print`` /
+``open``, fault-plane consults, mutating method calls on CAPTURED
+objects (``.append`` / ``.inc`` / ``.observe`` / ``.emit`` ...), any
+assignment to a captured object's attributes/elements, and
+``global`` / ``nonlocal`` declarations.  Mutating a LOCAL (trace-time
+scratch like a list of layer outputs) is pure and allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .. import annotations as A
+from ..core import Finding, Rule
+from ..project import FunctionInfo, Project, _attr_chain
+
+__all__ = ["TracePurityRule"]
+
+_IMPURE_MODULE_ROOTS = {"time", "random", "datetime", "os", "sys",
+                        "io", "timeit"}
+_IMPURE_BUILTINS = {"print", "open", "input"}
+_MUTATOR_METHODS = {"append", "extend", "add", "update", "discard",
+                    "remove", "clear", "pop", "popleft", "appendleft",
+                    "inc", "dec", "observe", "emit", "record", "put",
+                    "write", "setdefault"}
+
+
+class TracePurityRule(Rule):
+    rule_id = "trace-impure"
+    description = ("side effects inside jit/shard_map/pallas-traced "
+                   "functions (baked into the compiled program)")
+
+    def __init__(self, extra_traced: Optional[List[str]] = None):
+        self.extra_traced = list(extra_traced) \
+            if extra_traced is not None else list(A.EXTRA_TRACED)
+
+    # -- traced-function discovery ----------------------------------------
+    def _traced_roots(self, project: Project) -> Set[str]:
+        traced: Set[str] = set()
+        for fn in project.functions.values():
+            if self._has_trace_decorator(fn):
+                traced.add(fn.qualname)
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not self._is_tracer_call(node, mod):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = node.args[0].id
+                    scope = self._enclosing_function(project, mod,
+                                                     node)
+                    if scope is not None:
+                        for fi in project.resolve_name(target, scope):
+                            traced.add(fi.qualname)
+                    else:
+                        q = f"{mod.modname}.{target}"
+                        if q in project.functions:
+                            traced.add(q)
+        for pat in self.extra_traced:
+            # patterns name FACTORIES: only their nested defs are
+            # traced (the factory body itself runs at build time and
+            # may legitimately write memo caches)
+            for q in project.functions:
+                if ("." + pat + ".") in q or q.startswith(pat + "."):
+                    traced.add(q)
+        return traced
+
+    @staticmethod
+    def _enclosing_function(project: Project, mod,
+                            node) -> Optional[FunctionInfo]:
+        """The innermost analyzed function containing ``node`` (by
+        line span)."""
+        best, best_span = None, None
+        for fn in project.functions.values():
+            if fn.module is not mod:
+                continue
+            end = getattr(fn.node, "end_lineno", fn.node.lineno)
+            if fn.node.lineno <= node.lineno <= end:
+                span = end - fn.node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = fn, span
+        return best
+
+    @staticmethod
+    def _is_tracer_name(chain: List[str], mod) -> bool:
+        if chain[-1] in ("shard_map", "pallas_call"):
+            return True
+        if chain[-1] == "jit":
+            if len(chain) == 1:
+                target = mod.resolve_alias("jit")
+                return target in ("jax.jit", None, "jit")
+            target = mod.resolve_alias(chain[0])
+            return target == "jax" or (target or "").startswith("jax")
+        return False
+
+    def _is_tracer_call(self, call: ast.Call, mod) -> bool:
+        func = call.func
+        chain = _attr_chain(func)
+        if chain is not None and self._is_tracer_name(chain, mod):
+            return True
+        # partial(jax.jit, ...)(f) — rare; handled via decorator path
+        return False
+
+    def _has_trace_decorator(self, fn: FunctionInfo) -> bool:
+        for dec in fn.node.decorator_list:
+            node = dec.func if isinstance(dec, ast.Call) else dec
+            chain = _attr_chain(node)
+            if chain is None:
+                continue
+            if self._is_tracer_name(chain, fn.module):
+                return True
+            if chain[-1] == "partial" and isinstance(dec, ast.Call) \
+                    and dec.args:
+                inner = _attr_chain(dec.args[0])
+                if inner and self._is_tracer_name(inner, fn.module):
+                    return True
+        return False
+
+    # -- purity check -----------------------------------------------------
+    def run(self, project: Project) -> List[Finding]:
+        traced = set()
+        stack = list(self._traced_roots(project))
+        while stack:                     # nested defs of traced defs
+            q = stack.pop()
+            if q in traced or q not in project.functions:
+                continue
+            traced.add(q)
+            stack.extend(c.qualname
+                         for c in project.functions[q].children)
+        findings: List[Finding] = []
+        for q in sorted(traced):
+            findings.extend(self._check_traced(project.functions[q]))
+        return findings
+
+    def _check_traced(self, fn: FunctionInfo) -> List[Finding]:
+        out: List[Finding] = []
+        mod = fn.module
+        local: Set[str] = {a.arg for a in fn.node.args.args}
+        local.update(a.arg for a in fn.node.args.kwonlyargs)
+        local.update(a.arg for a in fn.node.args.posonlyargs)
+        if fn.node.args.vararg:
+            local.add(fn.node.args.vararg.arg)
+        if fn.node.args.kwarg:
+            local.add(fn.node.args.kwarg.arg)
+
+        def own_nodes():
+            stack = list(ast.iter_child_nodes(fn.node))
+            while stack:
+                node = stack.pop(0)
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    local.add(node.name)
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+        # pass 1: local names (any Store binds locally in Python)
+        nodes = list(own_nodes())
+        for node in nodes:
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+
+        def root_name(e):
+            while isinstance(e, (ast.Attribute, ast.Subscript,
+                                 ast.Starred)):
+                e = e.value
+            return e.id if isinstance(e, ast.Name) else None
+
+        def flag(node, message, hint=""):
+            out.append(Finding(self.rule_id, mod.path, node.lineno,
+                               node.col_offset, message, hint))
+
+        for node in nodes:
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                flag(node,
+                     f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}` "
+                     f"rebinding inside traced function {fn.qualname}",
+                     "traced bodies run once at trace time; the "
+                     "rebound value is frozen into the program")
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        r = root_name(t)
+                        if r is not None and r not in local:
+                            flag(t,
+                                 f"write to captured state `{r}` "
+                                 f"inside traced function "
+                                 f"{fn.qualname}",
+                                 "the mutation happens once per "
+                                 "trace, not per execution")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                if func.id in _IMPURE_BUILTINS:
+                    flag(node,
+                         f"`{func.id}()` inside traced function "
+                         f"{fn.qualname}",
+                         "I/O inside a traced body runs at trace "
+                         "time only (use jax.debug.print for runtime "
+                         "prints)")
+                continue
+            chain = _attr_chain(func)
+            if chain is None:
+                continue
+            root = chain[0]
+            target = mod.resolve_alias(root) or root
+            top = target.split(".")[0]
+            if top in _IMPURE_MODULE_ROOTS and root not in local:
+                flag(node,
+                     f"host `{'.'.join(chain)}` call inside traced "
+                     f"function {fn.qualname}",
+                     "clock/RNG/I-O reads freeze their trace-time "
+                     "value into the compiled program")
+                continue
+            if target == "numpy" and len(chain) >= 2 \
+                    and chain[1] == "random":
+                flag(node,
+                     f"`np.random` draw inside traced function "
+                     f"{fn.qualname}",
+                     "use jax.random with an explicit key argument")
+                continue
+            if target.endswith("testing.faults") or \
+                    (root == "faults" and root not in local):
+                flag(node,
+                     f"fault-plane consult inside traced function "
+                     f"{fn.qualname}",
+                     "the consult fires at trace time, not per step "
+                     "— hoist it to the dispatch site")
+                continue
+            if func.attr in _MUTATOR_METHODS:
+                r = root_name(func.value)
+                if r is not None and r not in local:
+                    flag(node,
+                         f"mutating `.{func.attr}()` on captured "
+                         f"`{r}` inside traced function "
+                         f"{fn.qualname}",
+                         "captured-state mutation (metrics, lists, "
+                         "registries) executes once per trace; "
+                         "record at the dispatch site instead")
+        return out

@@ -38,6 +38,7 @@ from __future__ import annotations
 import io
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -76,7 +77,9 @@ def _snap_val(snap: dict, name: str, default=0):
     return default if v is None else v
 
 
-def _serve_observability(handler, path: str, registry, ring) -> bool:
+def _serve_observability(handler, path: str,
+                         registry: "MetricsRegistry",
+                         ring: "EventRing") -> bool:
     """Shared GET endpoints for both servers: ``/metrics`` (Prometheus
     text exposition), ``/stats`` (JSON registry snapshot), ``/events``
     (ring tail; ``?n=`` limit, ``?since=<seq>`` for followers).
@@ -186,8 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
         srv: "InferenceServer" = self.server.owner
         path = urllib.parse.urlsplit(self.path).path.rstrip("/")
         if path in ("", "/health"):
+            # handler threads race do_POST's counter bump — read
+            # under the same lock (analysis rule: lock-discipline)
+            with srv._count_lock:
+                count = srv.request_count
             meta = {"status": "ok", "devices": srv.pool.device_names,
-                    "requests": srv.request_count}
+                    "requests": count}
             self._reply(200, json.dumps(meta).encode(),
                         "application/json")
         elif _serve_observability(self, path, srv.registry, srv.ring):
@@ -322,104 +329,12 @@ class _GenHandler(BaseHTTPRequestHandler):
                         else b'{"ready": false}')
             return
         if path in ("", "/health"):
-            # /health is a VIEW over the metrics registry (same keys
-            # as ever; single source of truth is the instrumentation,
-            # not ad-hoc reads of engine attributes).  An engine built
-            # with metrics_registry=False has no instrumentation to
-            # view — fall back to live attribute reads rather than
-            # reporting a healthy server as drained/exhausted.
-            if getattr(srv.engine, "metrics", None) is None:
-                eng = srv.engine
-                h = {"status": "ok" if srv._fatal is None
-                     else "failed",
-                     "error": srv._fatal,
-                     "live": srv.is_live(),
-                     "ready": srv.is_ready(),
-                     "restarts": srv.restarts,
-                     "requests_cancelled": eng.requests_cancelled,
-                     "requests_expired": eng.requests_expired,
-                     "requests_rejected": eng.requests_rejected,
-                     "requests_faulted": eng.requests_faulted,
-                     "step_faults": eng.step_faults,
-                     "queued_tokens": eng.queued_tokens(),
-                     "active": len(eng._active),
-                     "queued": len(eng._queue),
-                     "free_pages": eng.cache.free_pages(),
-                     "decode_steps": eng.decode_steps,
-                     "tokens_generated": eng.tokens_generated,
-                     "prefill_calls": eng.prefill_calls,
-                     "preemptions": eng.preemptions,
-                     "prefix_hits": eng.cache.prefix_hits,
-                     "swap_out_pages": eng.cache.swap_out_pages,
-                     "swap_in_pages": eng.cache.swap_in_pages,
-                     "prefill_tokens_avoided":
-                         getattr(eng, "prefill_tokens_avoided", 0),
-                     "requests_finished": eng.requests_finished}
-                if hasattr(eng, "spec_rounds"):
-                    h["spec_rounds"] = eng.spec_rounds
-                    h["spec_accepted"] = eng.spec_accepted
-                    h["gamma"] = eng.gamma
-                self._reply(200, json.dumps(h).encode())
-                return
-            snap = srv.registry.snapshot()
-            v = _snap_val
-            h = {"status": "ok" if srv._fatal is None else "failed",
-                 "error": srv._fatal,
-                 "live": srv.is_live(),
-                 "ready": srv.is_ready(),
-                 "restarts": srv.restarts,
-                 "requests_cancelled": int(v(
-                     snap,
-                     "paddle_tpu_engine_requests_cancelled_total")),
-                 "requests_expired": int(v(
-                     snap,
-                     "paddle_tpu_engine_requests_expired_total")),
-                 "requests_rejected": int(v(
-                     snap,
-                     "paddle_tpu_engine_requests_rejected_total")),
-                 "requests_faulted": int(v(
-                     snap,
-                     "paddle_tpu_engine_requests_faulted_total")),
-                 "step_faults": srv.engine.step_faults,
-                 "queued_tokens": int(v(
-                     snap, "paddle_tpu_engine_queued_tokens_count")),
-                 "active": int(v(
-                     snap, "paddle_tpu_engine_active_requests_count")),
-                 "queued": int(v(
-                     snap, "paddle_tpu_engine_queued_requests_count")),
-                 "free_pages": int(v(
-                     snap, "paddle_tpu_kvcache_free_pages_count")),
-                 "occupancy": v(
-                     snap, "paddle_tpu_engine_batch_occupancy_ratio"),
-                 "decode_steps": int(v(
-                     snap, "paddle_tpu_engine_decode_steps_total")),
-                 "tokens_generated": int(v(
-                     snap, "paddle_tpu_engine_tokens_generated_total")),
-                 "prefill_calls": int(v(
-                     snap,
-                     "paddle_tpu_engine_prefill_dispatches_total")),
-                 "preemptions": int(v(
-                     snap, "paddle_tpu_engine_preemptions_total")),
-                 "prefix_hits": int(v(
-                     snap,
-                     "paddle_tpu_kvcache_prefix_hit_pages_total")),
-                 "swap_out_pages": int(v(
-                     snap, "paddle_tpu_kvcache_swap_out_pages_total")),
-                 "swap_in_pages": int(v(
-                     snap, "paddle_tpu_kvcache_swap_in_pages_total")),
-                 "prefill_tokens_avoided": int(v(
-                     snap,
-                     "paddle_tpu_engine_prefill_tokens_avoided_total")),
-                 "requests_finished": int(v(
-                     snap,
-                     "paddle_tpu_engine_requests_finished_total"))}
-            if hasattr(srv.engine, "spec_rounds"):  # speculative
-                h["spec_rounds"] = int(v(
-                    snap, "paddle_tpu_spec_rounds_total"))
-                h["spec_accepted"] = int(v(
-                    snap, "paddle_tpu_spec_accepted_tokens_total"))
-                h["gamma"] = srv.engine.gamma
-            self._reply(200, json.dumps(h).encode())
+            # ONE locked accessor instead of handler-side reads of
+            # engine state racing the drive thread (analysis rule:
+            # lock-discipline — the /health dict is built by the
+            # server under its own lock)
+            self._reply(200,
+                        json.dumps(srv.health_snapshot()).encode())
         elif _serve_observability(self, path, srv.registry, srv.ring):
             pass
         else:
@@ -598,6 +513,14 @@ class GenerationServer:
         self._drive_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._fatal: Optional[str] = None
+        # last readiness verdict computed under _lock; served lock-
+        # free when a probe cannot get the lock promptly (see
+        # is_ready)
+        self._ready_last = False
+        # last /health document + the monotonic instant it was built
+        # (same bounded-wait contract; see health_snapshot) — an
+        # atomic ref publish of one tuple, read lock-free
+        self._health_last: Optional[tuple] = None
         # observability surface: /metrics, /stats, /events, and
         # /health all read the ENGINE's registry (an engine built with
         # metrics_registry=False serves an empty one)
@@ -645,9 +568,38 @@ class GenerationServer:
         t = self._drive_thread
         return t is not None and t.is_alive()
 
+    # how long a readiness probe waits for the server lock before
+    # serving the last computed verdict instead (a first-wave JIT
+    # compile can hold the drive loop's step for seconds — a k8s
+    # probe with a 1s timeout must not blackout during it)
+    _READY_PROBE_WAIT_S = 0.05
+
     def is_ready(self) -> bool:
         """READINESS: live, engine healthy, and the admission queue
-        below its bound — new work will be accepted right now."""
+        below its bound — new work will be accepted right now.  Takes
+        the server lock (the queue-depth reads race the drive thread
+        otherwise: iterating ``_queue`` while the engine mutates it
+        can raise, not just misread) but only waits
+        ``_READY_PROBE_WAIT_S`` for it — if the drive thread is deep
+        in a step (e.g. compiling a new batch shape), the probe gets
+        the last verdict computed under the lock rather than
+        stalling."""
+        if not self._lock.acquire(timeout=self._READY_PROBE_WAIT_S):
+            # bounded-wait fallback: an immutable bool published under
+            # the lock, read atomically — one step stale in the
+            # normal case; a WEDGED step serves it indefinitely
+            # (/health's stale_s field is the wedge detector)
+            return self._ready_last
+        try:
+            r = self._is_ready_locked()
+            self._ready_last = r
+            return r
+        finally:
+            self._lock.release()
+
+    def _is_ready_locked(self) -> bool:
+        """Readiness check body; CONTRACT: caller holds ``_lock``
+        (registered in analysis/annotations.py ``locked_methods``)."""
         if not self.is_live() or self._fatal is not None:
             return False
         eng = self.engine
@@ -658,6 +610,172 @@ class GenerationServer:
                 eng.queued_tokens() >= eng.max_queued_tokens:
             return False
         return True
+
+    def health_snapshot(self) -> dict:
+        """The ``/health`` document — the one accessor HTTP handler
+        threads use instead of reaching into engine state while the
+        drive thread mutates it (machine-checked by the
+        ``lock-discipline`` analysis rule).  Engine-attribute reads
+        happen under the server lock, but a scrape only waits
+        ``_READY_PROBE_WAIT_S`` for it — when the drive thread is
+        deep in a step (a first-wave JIT compile can hold the lock
+        for seconds) the scrape serves the last document built under
+        the lock instead of blacking out the monitoring plane, the
+        same bounded-wait contract as :meth:`is_ready` (the very
+        first scrape has no prior document and does wait).  A served
+        fallback carries ``stale_s`` — seconds since the document
+        was built — so a WEDGED step (hung device call holding the
+        lock forever) is observable as monotonically growing
+        ``stale_s`` under frozen counters, not mistakable for a
+        healthy node.
+        ``registry.snapshot()`` runs OUTSIDE the lock, keeping the
+        full-snapshot cost out of the critical section the drive
+        loop contends on.  That is sound because set-value metrics
+        carry their own locks and every callback gauge reads engine
+        state through atomic operations only (``len()`` of a live
+        container, ``queued_tokens()``'s tuple snapshot) — an
+        unlocked scrape can be a step stale, never torn or
+        raising."""
+        if not self._lock.acquire(timeout=self._READY_PROBE_WAIT_S):
+            last = self._health_last
+            if last is not None:
+                doc, built_t = last
+                stale = dict(doc)
+                stale["stale_s"] = round(
+                    time.monotonic() - built_t, 3)
+                return stale
+            self._lock.acquire()   # first scrape: wait for a real one
+        try:
+            h, registry_args = self._health_locked()
+        finally:
+            self._lock.release()
+        if h is None:
+            h = self._health_from_registry(*registry_args)
+        # atomic ref publish (the _ready_last idiom): bounded-wait
+        # scrapes serve this document while the drive thread holds
+        # the lock
+        self._health_last = (h, time.monotonic())
+        return h
+
+    def _health_locked(self):
+        """Locked half of :meth:`health_snapshot`; CONTRACT: caller
+        holds ``_lock`` (registered in analysis/annotations.py
+        ``locked_methods``).  Returns ``(doc, None)`` when there is
+        no metrics registry to view, else ``(None, args)`` for the
+        registry-backed build that runs after the caller releases
+        the lock."""
+        eng = self.engine
+        live = self.is_live()
+        ready = self._is_ready_locked()
+        if getattr(eng, "metrics", None) is None:
+            # no instrumentation to view (metrics_registry=False):
+            # fall back to live attribute reads — consistent here,
+            # the lock is held
+            h = {"status": "ok" if self._fatal is None
+                 else "failed",
+                 "error": self._fatal,
+                 "live": live,
+                 "ready": ready,
+                 "restarts": self.restarts,
+                 "requests_cancelled": eng.requests_cancelled,
+                 "requests_expired": eng.requests_expired,
+                 "requests_rejected": eng.requests_rejected,
+                 "requests_faulted": eng.requests_faulted,
+                 "step_faults": eng.step_faults,
+                 "queued_tokens": eng.queued_tokens(),
+                 "active": len(eng._active),
+                 "queued": len(eng._queue),
+                 "free_pages": eng.cache.free_pages(),
+                 "decode_steps": eng.decode_steps,
+                 "tokens_generated": eng.tokens_generated,
+                 "prefill_calls": eng.prefill_calls,
+                 "preemptions": eng.preemptions,
+                 "prefix_hits": eng.cache.prefix_hits,
+                 "swap_out_pages": eng.cache.swap_out_pages,
+                 "swap_in_pages": eng.cache.swap_in_pages,
+                 "prefill_tokens_avoided":
+                     getattr(eng, "prefill_tokens_avoided", 0),
+                 "requests_finished": eng.requests_finished}
+            if hasattr(eng, "spec_rounds"):
+                h["spec_rounds"] = eng.spec_rounds
+                h["spec_accepted"] = eng.spec_accepted
+                h["gamma"] = eng.gamma
+            return h, None
+        # metrics path: copy the handful of attrs the registry
+        # does not carry while the lock is still held; the full
+        # snapshot runs after the caller releases the lock
+        return None, (
+            live, ready, self._fatal, self.restarts,
+            self.registry, eng.step_faults,
+            eng.gamma if hasattr(eng, "spec_rounds") else None)
+
+    @staticmethod
+    def _health_from_registry(live, ready, fatal, restarts, registry,
+                              step_faults, gamma) -> dict:
+        # /health is a VIEW over the metrics registry (single source
+        # of truth is the instrumentation, not ad-hoc attribute
+        # reads); snapshot() outside the lock — set-value metrics are
+        # internally locked and callback gauges read only atomic
+        # engine snapshots (see the health_snapshot docstring)
+        snap = registry.snapshot()
+        v = _snap_val
+        h = {"status": "ok" if fatal is None else "failed",
+             "error": fatal,
+             "live": live,
+             "ready": ready,
+             "restarts": restarts,
+             "requests_cancelled": int(v(
+                 snap,
+                 "paddle_tpu_engine_requests_cancelled_total")),
+             "requests_expired": int(v(
+                 snap,
+                 "paddle_tpu_engine_requests_expired_total")),
+             "requests_rejected": int(v(
+                 snap,
+                 "paddle_tpu_engine_requests_rejected_total")),
+             "requests_faulted": int(v(
+                 snap,
+                 "paddle_tpu_engine_requests_faulted_total")),
+             "step_faults": step_faults,
+             "queued_tokens": int(v(
+                 snap, "paddle_tpu_engine_queued_tokens_count")),
+             "active": int(v(
+                 snap, "paddle_tpu_engine_active_requests_count")),
+             "queued": int(v(
+                 snap, "paddle_tpu_engine_queued_requests_count")),
+             "free_pages": int(v(
+                 snap, "paddle_tpu_kvcache_free_pages_count")),
+             "occupancy": v(
+                 snap, "paddle_tpu_engine_batch_occupancy_ratio"),
+             "decode_steps": int(v(
+                 snap, "paddle_tpu_engine_decode_steps_total")),
+             "tokens_generated": int(v(
+                 snap, "paddle_tpu_engine_tokens_generated_total")),
+             "prefill_calls": int(v(
+                 snap,
+                 "paddle_tpu_engine_prefill_dispatches_total")),
+             "preemptions": int(v(
+                 snap, "paddle_tpu_engine_preemptions_total")),
+             "prefix_hits": int(v(
+                 snap,
+                 "paddle_tpu_kvcache_prefix_hit_pages_total")),
+             "swap_out_pages": int(v(
+                 snap, "paddle_tpu_kvcache_swap_out_pages_total")),
+             "swap_in_pages": int(v(
+                 snap, "paddle_tpu_kvcache_swap_in_pages_total")),
+             "prefill_tokens_avoided": int(v(
+                 snap,
+                 "paddle_tpu_engine_prefill_tokens_avoided_total")),
+             "requests_finished": int(v(
+                 snap,
+                 "paddle_tpu_engine_requests_finished_total"))}
+        if gamma is not None:                       # speculative
+            h["spec_rounds"] = int(v(
+                snap, "paddle_tpu_spec_rounds_total"))
+            h["spec_accepted"] = int(v(
+                snap, "paddle_tpu_spec_accepted_tokens_total"))
+            h["gamma"] = gamma
+        return h
 
     def submit(self, prompt, max_new_tokens, deadline_s=None):
         import queue as _queue
@@ -723,12 +841,13 @@ class GenerationServer:
                                        f"{req.error or 'engine fault'}"
                                        )))
             except Exception as e:                # engine wedged
+                text = f"{type(e).__name__}: {e}"
                 with self._lock:
                     dead, self._queues = self._queues, {}
-                    self._fatal = f"{type(e).__name__}: {e}"
+                    self._fatal = text
                 for q in dead.values():
-                    q.put(("err", (500, "generation failed: "
-                                   f"{self._fatal}")))
+                    q.put(("err", (500,
+                                   f"generation failed: {text}")))
                 return
             if not worked:
                 _time.sleep(self._poll_s)

@@ -333,7 +333,17 @@ class ContinuousBatchingEngine:
         EXPIRED — retired at the next flush point whether queued or
         mid-decode, resources freed, surfaced in ``finished()`` with
         ``status == "expired"`` (a request whose client stopped
-        waiting must stop burning decode slots)."""
+        waiting must stop burning decode slots).
+
+        Thread safety: ``external-lock`` — NOT internally
+        synchronized; safe from non-engine threads only when every
+        engine touch serializes behind one shared lock
+        (``GenerationServer`` does this with ``_lock``).  The full
+        per-API contract lives in ``paddle_tpu/analysis/
+        annotations.py`` ``THREAD_SAFETY`` and docs/FAULT_TOLERANCE.md
+        (consistency-checked by tests/test_analysis.py); the
+        ``lock-discipline`` analysis rule enforces it at the serving
+        front."""
         prompt = np.asarray(prompt, np.int64)
         if prompt.size == 0:
             # an empty prompt has no last-position logits to sample a
@@ -407,7 +417,12 @@ class ContinuousBatchingEngine:
         (``PagedKVCache.audit()`` stays clean).  The request surfaces
         in ``finished()`` with ``status == "cancelled"``.  Returns
         False when the rid is unknown or already finished — cancelling
-        a completed request is a harmless no-op."""
+        a completed request is a harmless no-op.
+
+        Thread safety: ``external-lock`` — like :meth:`submit`, safe
+        from HTTP handler threads only behind the serving front's
+        shared lock (see ``analysis/annotations.py THREAD_SAFETY``
+        and docs/FAULT_TOLERANCE.md)."""
         if any(r.rid == rid for r in self._queue) or \
                 any(r.rid == rid for r in self._active.values()):
             self._cancelled.add(rid)
@@ -417,9 +432,18 @@ class ContinuousBatchingEngine:
     def queued_tokens(self) -> int:
         """Context tokens waiting for (re-)admission — the prefill
         work the queue represents (preempted requests count their
-        regenerated context too)."""
+        regenerated context too).
+
+        Thread safety: ``any-thread`` — sums over an atomic
+        ``tuple()`` snapshot of the queue (one C-level copy under the
+        GIL), so metrics scrape threads read it lock-free; a racing
+        submit/step makes the answer at most one admission stale,
+        never a ``deque mutated during iteration`` error.  Exact when
+        serialized behind the serving front's ``_lock``, which is how
+        the backpressure path consults it (see
+        ``analysis/annotations.py THREAD_SAFETY``)."""
         return sum(len(r.prompt) + len(r.generated)
-                   for r in self._queue)
+                   for r in tuple(self._queue))
 
     def retry_after_s(self) -> float:
         """Finite back-off hint for a rejected client: the queue's
@@ -567,6 +591,10 @@ class ContinuousBatchingEngine:
             logits = _mm(h, self.params["lm_head"],
                          self.cfg.dtype).astype(jnp.float32)
             self._key, sub = jax.random.split(self._key)
+            # sanctioned drain, kept OFF the _fetch seam: pipeline-
+            # depth accounting (one _fetch per drained decode step) is
+            # pinned by the overlap tests
+            # analysis: ignore[sync-in-hot-path] reason=admission first-token fetch; the pipeline is flushed before any _admit_* runs
             toks = np.asarray(_pick_token(logits, self.temperature,
                                           sub, self.top_k,
                                           self.top_p))
@@ -636,6 +664,7 @@ class ContinuousBatchingEngine:
             logits = _mm(h, self.params["lm_head"],
                          self.cfg.dtype).astype(jnp.float32)
             self._key, sub = jax.random.split(self._key)
+            # analysis: ignore[sync-in-hot-path] reason=admission first-token fetch; the pipeline is flushed before any _admit_* runs
             tok = int(_pick_token(logits[None], self.temperature,
                                   sub, self.top_k, self.top_p)[0])
             req.generated.append(tok)
@@ -768,6 +797,10 @@ class ContinuousBatchingEngine:
             logits = _mm(h, self.params["lm_head"],
                          self.cfg.dtype).astype(jnp.float32)
             self._key, sub = jax.random.split(self._key)
+            # sanctioned drain, kept OFF the _fetch seam: pipeline-
+            # depth accounting (one _fetch per drained decode step) is
+            # pinned by the overlap tests
+            # analysis: ignore[sync-in-hot-path] reason=admission first-token fetch; the pipeline is flushed before any _admit_* runs
             toks_out = np.asarray(_pick_token(
                 logits, self.temperature, sub, self.top_k, self.top_p))
         for i, (req, ctx, slot, start, s_real, Wp, off) in \
@@ -1436,6 +1469,7 @@ class ContinuousBatchingEngine:
         retires the request and schedules a pipeline flush, since the
         device-side active chain cannot know about it."""
         e = self._inflight.pop(0)
+        # analysis: ignore[sync-in-hot-path] reason=the pipeline's one sanctioned sync point: drains the OLDEST step while a newer dispatch is already in flight
         nxt, done = self._fetch(e["nxt"], e["done"])
         t0 = time.perf_counter() if self.metrics is not None else 0.0
         mask = self._drain_active

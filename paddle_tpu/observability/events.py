@@ -68,7 +68,7 @@ class EventRing:
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=capacity)
         self._seq = 0
-        self.dropped = 0          # events pushed out of the ring
+        self._dropped = 0         # events pushed out of the ring
 
     def emit(self, name: str, **fields) -> dict:
         ev = {"name": name,
@@ -80,9 +80,17 @@ class EventRing:
             self._seq += 1
             ev["seq"] = self._seq
             if len(self._events) == self.capacity:
-                self.dropped += 1
+                self._dropped += 1
             self._events.append(ev)
         return ev
+
+    @property
+    def dropped(self) -> int:
+        """Events pushed out of the ring — read from scrape threads
+        (``/stats``) while the engine thread emits, so the counter
+        lives behind the lock like the ring itself."""
+        with self._lock:
+            return self._dropped
 
     def span(self, name: str, **fields) -> _RingSpan:
         return _RingSpan(self, name, fields)

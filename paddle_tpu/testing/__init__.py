@@ -6,5 +6,6 @@ stack's degraded paths so every failure mode is exercisable on demand
 """
 
 from . import faults  # noqa: F401
+from . import mutants  # noqa: F401
 
-__all__ = ["faults"]
+__all__ = ["faults", "mutants"]

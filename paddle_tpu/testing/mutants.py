@@ -1,0 +1,282 @@
+"""Mutation fuzz seam for the hot-path invariant checker.
+
+The analyzer (:mod:`paddle_tpu.analysis`) guards the serving stack;
+THIS module guards the analyzer: known-good hot-loop snippets are
+mutated one invariant violation at a time (insert a blocking sync,
+drop a lock, delete a flush, put a clock read inside a jitted body),
+and ``tests/test_analysis.py`` asserts
+
+* every BASE snippet analyzes clean (no false positives), and
+* every MUTANT trips exactly the rule its mutation violates (no
+  silent rot: a refactor that blinds a rule fails tier-1 the moment
+  it lands).
+
+Mutations are marker-driven: templates carry ``# MUTATE: <site>``
+lines, and each :class:`Mutant` replaces one marker with its payload
+at the marker's indentation, keeping the snippet syntactically valid
+by construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List
+
+__all__ = ["Mutant", "BaseCase", "base_cases", "iter_mutants"]
+
+
+@dataclass
+class BaseCase:
+    name: str
+    sources: Dict[str, str]           # modname -> source
+    rules: Callable[[], list]         # fresh configured rule instances
+
+
+@dataclass
+class Mutant:
+    name: str
+    sources: Dict[str, str]
+    rules: Callable[[], list]
+    expect_rule: str                  # rule id that must fire
+
+
+def _replace_marker(src: str, marker: str, payload: List[str]) -> str:
+    """Replace the line containing ``marker`` with ``payload`` lines
+    at the marker's indentation.  Raises if the marker is absent (a
+    template edit must not silently disable a mutant)."""
+    out, hit = [], False
+    for line in src.splitlines():
+        if marker in line:
+            hit = True
+            indent = line[: len(line) - len(line.lstrip())]
+            out.extend(indent + p if p else p for p in payload)
+        else:
+            out.append(line)
+    if not hit:
+        raise ValueError(f"marker {marker!r} not found")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# T1: dispatch-ahead hot loop (sync-lint + flush-point)
+# ---------------------------------------------------------------------------
+_HOT = '''\
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def _fetch(self, *arrs):
+        return [np.asarray(a) for a in arrs]
+
+    def _pipeline_flush(self):
+        while self._inflight:
+            self._drain_one()
+        self._dev = None
+
+    def _drain_one(self):
+        e = self._inflight.pop(0)
+        # analysis: ignore[sync-in-hot-path] reason=the pipeline's one sync point, one step behind
+        nxt = self._fetch(e)[0]
+        for slot in np.nonzero(self._mask)[0]:
+            self._retire(int(slot))
+
+    def _retire(self, slot):
+        self._active.pop(slot)
+
+    def _step_inner(self):
+        self._pipeline_flush()  # MUTATE: flush
+        self._admit_batch(self._queue)
+
+    def _admit_batch(self, group):
+        logits = self._step(group)
+        # analysis: ignore[sync-in-hot-path] reason=admission fetch behind a flushed pipeline  # MUTATE: justify
+        toks = self._fetch(logits)[0]
+        return toks
+
+    def _decode_overlap(self):
+        out = self._step(self._tok)
+        # MUTATE: decode
+        self._inflight.append(out)
+'''
+
+
+def _hot_rules() -> list:
+    from paddle_tpu.analysis.rules import FlushPointRule, SyncLintRule
+    return [
+        SyncLintRule(roots=["Engine._decode_overlap",
+                            "Engine._drain_one", "Engine._step_inner",
+                            "Engine._admit_batch"]),
+        FlushPointRule(
+            engine_classes={"Engine"},
+            mutators={"_retire", "_admit_batch"},
+            flush_safe={"Engine._drain_one":
+                        "the drain is the pipeline"}),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# T2: jitted step function (trace-purity)
+# ---------------------------------------------------------------------------
+_TRACED = '''\
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+METRICS = []
+
+
+def make_step(cfg):
+    @jax.jit
+    def step(x, y):
+        h = jnp.dot(x, y)
+        # MUTATE: purity
+        return jnp.tanh(h)
+    return step
+'''
+
+
+def _traced_rules() -> list:
+    from paddle_tpu.analysis.rules import TracePurityRule
+    return [TracePurityRule(extra_traced=[])]
+
+
+# ---------------------------------------------------------------------------
+# T3: shared state behind a lock (lock-discipline)
+# ---------------------------------------------------------------------------
+_LOCKED = '''\
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues = {}
+        self._fatal = None
+
+    def submit(self, rid, q):
+        with self._lock:  # MUTATE: lock
+            self._queues[rid] = q
+
+    def fatal(self):
+        with self._lock:
+            return self._fatal
+'''
+
+
+def _locked_rules() -> list:
+    from paddle_tpu.analysis.annotations import SharedStateSpec
+    from paddle_tpu.analysis.rules import LockDisciplineRule
+    return [LockDisciplineRule(shared_state={
+        "fixture_lock.Server": SharedStateSpec(
+            lock="_lock", attrs=frozenset({"_queues", "_fatal"}))})]
+
+
+# ---------------------------------------------------------------------------
+# T4: nested lock pair (lock-order)
+# ---------------------------------------------------------------------------
+_ORDERED = '''\
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                self.x = 1
+
+    def backward(self):
+        with self._a_lock:  # MUTATE: outer
+            with self._b_lock:  # MUTATE: inner
+                self.x = 2
+'''
+
+
+def _ordered_rules() -> list:
+    from paddle_tpu.analysis.rules import LockDisciplineRule
+    return [LockDisciplineRule(shared_state={})]
+
+
+# ---------------------------------------------------------------------------
+# the catalogue
+# ---------------------------------------------------------------------------
+def base_cases() -> List[BaseCase]:
+    return [
+        BaseCase("hot-loop", {"fixture_hot": _HOT}, _hot_rules),
+        BaseCase("traced-step", {"fixture_trace": _TRACED},
+                 _traced_rules),
+        BaseCase("locked-server", {"fixture_lock": _LOCKED},
+                 _locked_rules),
+        BaseCase("lock-pair", {"fixture_order": _ORDERED},
+                 _ordered_rules),
+    ]
+
+
+def iter_mutants() -> List[Mutant]:
+    out: List[Mutant] = []
+
+    def hot(name, marker, payload, expect):
+        out.append(Mutant(
+            name, {"fixture_hot":
+                   _replace_marker(_HOT, marker, payload)},
+            _hot_rules, expect))
+
+    # 1. stray .item() drain in the overlap decode loop
+    hot("insert-item-drain", "# MUTATE: decode",
+        ["lat = out[0].item()"], "sync-in-hot-path")
+    # 2. scalar int() coercion of an on-device token
+    hot("insert-int-coercion", "# MUTATE: decode",
+        ["tok0 = int(out[0])"], "sync-in-hot-path")
+    # 3. np.asarray drain of the chained device state
+    hot("insert-asarray-drain", "# MUTATE: decode",
+        ["host = np.asarray(out)"], "sync-in-hot-path")
+    # 4. scalar coercion of a device value hidden inside a lambda —
+    #    lambdas are not indexed as functions, so the enclosing
+    #    function's walk is the only chance to see the sync
+    hot("insert-int-coercion-in-lambda", "# MUTATE: decode",
+        ["order = sorted(range(4), key=lambda s: int(out[s]))"],
+        "sync-in-hot-path")
+    # 5. blocking seam call without a justifying suppression
+    hot("drop-drain-justification", "# MUTATE: justify",
+        [], "sync-in-hot-path")
+    # 6. admission no longer behind a pipeline flush
+    hot("drop-admission-flush", "# MUTATE: flush",
+        ["pass"], "flush-point")
+
+    def trace(name, payload, expect="trace-impure"):
+        out.append(Mutant(
+            name, {"fixture_trace":
+                   _replace_marker(_TRACED, "# MUTATE: purity",
+                                   payload)},
+            _traced_rules, expect))
+
+    # 7. host clock read baked into the compiled program
+    trace("clock-in-trace", ["t0 = time.time()"])
+    # 8. captured-list mutation (metrics-style side effect)
+    trace("captured-append-in-trace", ["METRICS.append(1)"])
+    # 9. global-RNG draw at trace time
+    trace("global-rng-in-trace", ["r = random.random()"])
+
+    # 10. shared dict written with the lock dropped
+    out.append(Mutant(
+        "drop-lock",
+        {"fixture_lock": _replace_marker(_LOCKED, "# MUTATE: lock",
+                                         ["if True:"])},
+        _locked_rules, "lock-discipline"))
+
+    # 11. ABBA lock-order inversion
+    inverted = _replace_marker(
+        _replace_marker(_ORDERED, "# MUTATE: outer",
+                        ["with self._b_lock:"]),
+        "# MUTATE: inner", ["with self._a_lock:"])
+    out.append(Mutant("invert-lock-order",
+                      {"fixture_order": inverted},
+                      _ordered_rules, "lock-order"))
+    return out

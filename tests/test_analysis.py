@@ -1,0 +1,832 @@
+"""Tier-1 wiring for the hot-path invariant checker
+(paddle_tpu/analysis): per-rule positive/negative fixtures, the
+zero-unsuppressed-findings pin over the production modules, the
+mutation fuzz seam guarding the analyzer itself, CLI behavior, and
+the docs/annotations consistency checks.
+
+Everything here runs on the plain CPU test environment — the analyzer
+is stdlib-only and never imports the code it inspects.
+"""
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.analysis import (ALL_RULE_IDS, BAD_SUPPRESSION,
+                                 DEFAULT_TARGETS, FlushPointRule,
+                                 LockDisciplineRule, SyncLintRule,
+                                 TracePurityRule, analyze_paths,
+                                 analyze_sources)
+from paddle_tpu.analysis.annotations import SharedStateSpec
+
+pytestmark = pytest.mark.analysis
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sync_rules():
+    return [SyncLintRule(roots=["Eng._hot"])]
+
+
+def _trace_rules():
+    return [TracePurityRule(extra_traced=[])]
+
+
+def _lock_rules():
+    return [LockDisciplineRule(shared_state={
+        "fix.Srv": SharedStateSpec(
+            lock="_lock", attrs=frozenset({"_state"}),
+            proxies=frozenset({"engine"}),
+            locked_methods=frozenset({"locked_helper"}))})]
+
+
+def _order_rules():
+    return [LockDisciplineRule(shared_state={})]
+
+
+def _flush_rules():
+    return [FlushPointRule(engine_classes={"Engine"},
+                           mutators={"_retire"},
+                           flush_safe={"Engine.safe_ctx": "fixture"})]
+
+
+def _sync_src(body: str) -> str:
+    return f'''
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Eng:
+    def _hot(self):
+        out = self._step(self.tok)
+{body}
+'''
+
+
+# ---------------------------------------------------------------------------
+# positive fixtures: each MUST fire its rule
+# ---------------------------------------------------------------------------
+POSITIVE_FIXTURES = [
+    ("sync-item-drain", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        v = out.item()\n        return v")}),
+    ("sync-int-coercion", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        t = int(out[0])\n        return t")}),
+    ("sync-asarray-on-device", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        h = np.asarray(out)\n"
+                       "        return h")}),
+    ("sync-device-get", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        g = jax.device_get(out)\n"
+                       "        return g")}),
+    ("sync-block-until-ready", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        out.block_until_ready()")}),
+    ("sync-unjustified-seam", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        toks = self._fetch(out)\n"
+                       "        return toks")}),
+    ("sync-taint-through-alias", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        y = out + 1\n"
+                       "        z = y[0]\n"
+                       "        return float(z)")}),
+    ("trace-clock-read", _trace_rules, "trace-impure",
+     {"fix": '''
+import time
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    t0 = time.time()
+    return jnp.sin(x) + t0
+'''}),
+    ("trace-captured-append", _trace_rules, "trace-impure",
+     {"fix": '''
+import jax
+import jax.numpy as jnp
+
+EVENTS = []
+
+
+def make(cfg):
+    def step(x):
+        EVENTS.append(1)
+        return jnp.sin(x)
+    return jax.jit(step)
+'''}),
+    ("trace-shardmap-captured-write", _trace_rules, "trace-impure",
+     {"fix": '''
+from jax.experimental.shard_map import shard_map
+
+STATE = {}
+
+
+def make(mesh):
+    def inner(x):
+        STATE["hits"] = 1
+        return x
+    return shard_map(inner, mesh=mesh, in_specs=None, out_specs=None)
+'''}),
+    ("trace-np-random", _trace_rules, "trace-impure",
+     {"fix": '''
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    noise = np.random.rand(4)
+    return x + noise
+'''}),
+    ("lock-unguarded-write", _lock_rules, "lock-discipline",
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def bad_write(self):
+        self._state["b"] = 2
+'''}),
+    ("lock-unguarded-read", _lock_rules, "lock-discipline",
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def bad_read(self):
+        return self._state
+'''}),
+    ("lock-unguarded-proxy-chain", _lock_rules, "lock-discipline",
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def bad_proxy(self):
+        return self.engine.step_count
+'''}),
+    ("lock-order-inversion", _order_rules, "lock-order",
+     {"fix": '''
+import threading
+
+
+class Pair:
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:
+                return 2
+'''}),
+    ("flush-undominated-mutation", _flush_rules, "flush-point",
+     {"fix": '''
+class Engine:
+    def bad(self):
+        self._retire(1)
+'''}),
+    ("suppression-without-reason", _sync_rules, BAD_SUPPRESSION,
+     {"fix": _sync_src(
+         "        # analysis: ignore[sync-in-hot-path]\n"
+         "        v = out.item()\n        return v")}),
+    ("flush-read-is-not-dominance", _flush_rules, "flush-point",
+     {"fix": '''
+class Engine:
+    def bad(self):
+        if self._needs_flush:
+            return
+        self._retire(1)
+'''}),
+    ("flush-clear-store-is-not-dominance", _flush_rules, "flush-point",
+     {"fix": '''
+class Engine:
+    def bad(self):
+        self._needs_flush = False
+        self._retire(1)
+'''}),
+    ("flush-in-closure-is-not-dominance", _flush_rules, "flush-point",
+     {"fix": '''
+class Engine:
+    def bad(self):
+        def cb():
+            self._pipeline_flush()
+        self._retire(1)
+'''}),
+    ("lock-unlocked-access-in-closure", _lock_rules,
+     "lock-discipline",
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def drive(self):
+        def fan():
+            return self._state.pop(1)
+        return fan()
+'''}),
+    ("sync-int-on-ternary-device-value", _sync_rules,
+     "sync-in-hot-path",
+     {"fix": _sync_src(
+         "        a = int(out[0] if self.flag else out[1])\n"
+         "        return a")}),
+    ("sync-item-inside-lambda", _sync_rules, "sync-in-hot-path",
+     {"fix": _sync_src("        cb = lambda: out.item()\n"
+                       "        return cb")}),
+    ("sync-tainted-int-inside-lambda", _sync_rules,
+     "sync-in-hot-path",
+     {"fix": _sync_src(
+         "        ks = sorted(range(4), key=lambda s: int(out[s]))\n"
+         "        return ks")}),
+    ("flush-mutation-inside-lambda", _flush_rules, "flush-point",
+     {"fix": '''
+class Engine:
+    def bad(self):
+        return lambda s: self._retire(s)
+'''}),
+    ("flush-lambda-flush-is-not-dominance", _flush_rules,
+     "flush-point",
+     {"fix": '''
+class Engine:
+    def bad(self):
+        cb = lambda: self._pipeline_flush()
+        self._retire(1)
+'''}),
+]
+
+# ---------------------------------------------------------------------------
+# negative fixtures: each MUST analyze clean
+# ---------------------------------------------------------------------------
+NEGATIVE_FIXTURES = [
+    ("sync-int-on-host", _sync_rules,
+     {"fix": _sync_src("        n = int(len(self.queue))\n"
+                       "        return n")}),
+    ("sync-asarray-on-host-list", _sync_rules,
+     {"fix": _sync_src("        a = np.asarray([1, 2])\n"
+                       "        return a")}),
+    ("sync-jnp-upload-ok", _sync_rules,
+     {"fix": _sync_src("        d = jnp.asarray(out)\n"
+                       "        return d")}),
+    ("sync-unreachable-function", _sync_rules,
+     {"fix": _sync_src("        return out") + '''
+
+    def _cold(self):
+        out = self._step(self.tok)
+        return np.asarray(out)
+'''}),
+    ("sync-justified-seam", _sync_rules,
+     {"fix": _sync_src(
+         "        # analysis: ignore[sync-in-hot-path] "
+         "reason=fixture drain point\n"
+         "        toks = self._fetch(out)\n        return toks")}),
+    ("sync-item-suppressed-inline", _sync_rules,
+     {"fix": _sync_src(
+         "        v = out.item()  # analysis: "
+         "ignore[sync-in-hot-path] reason=fixture scalar readback\n"
+         "        return v")}),
+    ("trace-pure-step", _trace_rules,
+     {"fix": '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    h = jnp.dot(x, x)
+    return jnp.tanh(h)
+'''}),
+    ("trace-clock-outside-trace", _trace_rules,
+     {"fix": '''
+import time
+import jax.numpy as jnp
+
+
+def host_loop(x):
+    t0 = time.time()
+    return jnp.sin(x), t0
+'''}),
+    ("trace-local-scratch-ok", _trace_rules,
+     {"fix": '''
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(xs):
+    acc = []
+    for i in range(3):
+        acc.append(xs * i)
+    return sum(acc)
+'''}),
+    ("lock-guarded-accesses", _lock_rules,
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def good(self):
+        with self._lock:
+            self._state["a"] = 1
+            return self.engine.step()
+'''}),
+    ("lock-locked-method-contract", _lock_rules,
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def locked_helper(self):
+        return self._state
+'''}),
+    ("lock-init-exempt", _lock_rules,
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self.engine = None
+'''}),
+    ("lock-annotated-param-guarded", _lock_rules,
+     {"fix": '''
+import threading
+
+
+def handler(srv: "Srv"):
+    with srv._lock:
+        return srv._state
+'''}),
+    ("lock-order-consistent", _order_rules,
+     {"fix": '''
+import threading
+
+
+class Pair:
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+
+    def backward(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 2
+'''}),
+    ("flush-dominated-mutation", _flush_rules,
+     {"fix": '''
+class Engine:
+    def good(self):
+        self._pipeline_flush()
+        self._retire(0)
+'''}),
+    ("flush-safe-context", _flush_rules,
+     {"fix": '''
+class Engine:
+    def safe_ctx(self):
+        self._retire(2)
+'''}),
+    ("flush-non-engine-class", _flush_rules,
+     {"fix": '''
+class Other:
+    def meh(self):
+        self._retire(3)
+'''}),
+    ("flush-schedule-store-dominates", _flush_rules,
+     {"fix": '''
+class Engine:
+    def good(self):
+        self._needs_flush = True
+        self._retire(0)
+'''}),
+    ("sync-inline-suppressed-multiline", _sync_rules,
+     {"fix": _sync_src(
+         "        v = np.asarray(\n"
+         "            out)  # analysis: ignore[sync-in-hot-path] "
+         "reason=fixture wrapped drain\n"
+         "        return v")}),
+    ("sync-standalone-suppressed-multiline", _sync_rules,
+     {"fix": _sync_src(
+         "        # analysis: ignore[sync-in-hot-path] "
+         "reason=fixture wrapped drain\n"
+         "        toks = (\n"
+         "            self._fetch(out))\n"
+         "        return toks")}),
+    ("lock-closure-locked-access", _lock_rules,
+     {"fix": '''
+import threading
+
+
+class Srv:
+    def drive(self):
+        def fan():
+            with self._lock:
+                return self._state.pop(1)
+        return fan()
+'''}),
+    ("sync-lambda-on-host-values", _sync_rules,
+     {"fix": _sync_src(
+         "        ks = sorted([1, 2], key=lambda s: int(s))\n"
+         "        return ks")}),
+    ("flush-lambda-mutation-after-flush", _flush_rules,
+     {"fix": '''
+class Engine:
+    def good(self):
+        self._pipeline_flush()
+        return lambda s: self._retire(s)
+'''}),
+]
+
+
+def test_fixture_counts():
+    """The acceptance floor: >= 12 positive and >= 12 negative
+    fixtures pin the rules."""
+    assert len(POSITIVE_FIXTURES) >= 12
+    assert len(NEGATIVE_FIXTURES) >= 12
+
+
+@pytest.mark.parametrize(
+    "name,rules,expect,sources",
+    POSITIVE_FIXTURES, ids=[f[0] for f in POSITIVE_FIXTURES])
+def test_positive_fixture(name, rules, expect, sources):
+    report = analyze_sources(sources, rules=rules())
+    fired = {f.rule for f in report.unsuppressed()}
+    assert expect in fired, (
+        f"{name}: expected {expect}, got {fired or 'nothing'}:\n"
+        + report.render_text(include_suppressed=True))
+
+
+@pytest.mark.parametrize(
+    "name,rules,sources",
+    NEGATIVE_FIXTURES, ids=[f[0] for f in NEGATIVE_FIXTURES])
+def test_negative_fixture(name, rules, sources):
+    report = analyze_sources(sources, rules=rules())
+    bad = report.unsuppressed()
+    assert not bad, (
+        f"{name}: expected clean, got:\n"
+        + "\n".join(f.render() for f in bad))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 pin: production modules analyze clean
+# ---------------------------------------------------------------------------
+def test_production_modules_zero_unsuppressed_findings():
+    """The invariants are REGRESSION-TESTED: the full rule set over
+    paddle_tpu/models + inference + observability reports zero
+    unsuppressed findings, every suppression carries a reason, and the
+    rules demonstrably fire on real code (the sanctioned drains are
+    suppressed findings, not blind spots)."""
+    paths = [os.path.join(_REPO, t) for t in DEFAULT_TARGETS]
+    report = analyze_paths(paths)
+    bad = report.unsuppressed()
+    assert not bad, "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in bad)
+    sup = report.suppressed()
+    assert len(sup) >= 5, "expected the sanctioned hot-path drains " \
+        "to surface as suppressed findings"
+    assert all(f.reason for f in sup)
+    for m in report.modules:
+        for s in m.suppressions:
+            assert s.valid, (f"{m.path}:{s.line} suppression without "
+                             f"a reason")
+    assert len(report.modules) >= 15
+
+
+def test_production_run_covers_all_rules():
+    """Every production rule actually examined code (non-vacuous run):
+    sync-lint found the suppressed drains; trace-purity saw traced
+    functions; lock-discipline saw registered classes."""
+    from paddle_tpu.analysis.core import Analyzer
+    from paddle_tpu.analysis.project import Project
+    from paddle_tpu.analysis.rules.trace_purity import TracePurityRule
+
+    paths = [os.path.join(_REPO, t) for t in DEFAULT_TARGETS]
+    analyzer = Analyzer([])
+    report = analyzer.run_paths(paths)
+    project = Project(report.modules)
+    # the overlap hot loop resolves and is non-trivial
+    hot = project.reachable_with_attr_methods(
+        ["ContinuousBatchingEngine._decode_overlap"])
+    assert any(q.endswith("._drain_one") for q in hot)
+    assert any(q.endswith("._fetch") for q in hot)
+    assert any(q.endswith(".release_row") for q in hot)
+    # traced-function discovery sees the jitted step bodies
+    tp = TracePurityRule()
+    traced = tp._traced_roots(project)
+    assert any("_build_step_fns" in q for q in traced)
+    assert any("make_paged_decode_step_async" in q for q in traced), \
+        traced
+    # lock rule matches the registered classes
+    rule = LockDisciplineRule()
+    assert rule._spec_for_class(
+        "paddle_tpu.inference.serving.GenerationServer") is not None
+    assert rule._spec_for_class(
+        "paddle_tpu.observability.events.EventRing") is not None
+
+
+# ---------------------------------------------------------------------------
+# mutation fuzz seam: the analyzer itself is guarded against rot
+# ---------------------------------------------------------------------------
+def test_mutant_base_cases_are_clean():
+    from paddle_tpu.testing import mutants
+    for case in mutants.base_cases():
+        report = analyze_sources(case.sources, rules=case.rules())
+        bad = report.unsuppressed()
+        assert not bad, (f"base case {case.name} not clean:\n"
+                         + "\n".join(f.render() for f in bad))
+
+
+def test_mutants_are_caught():
+    """Each known-good snippet, mutated one violation at a time
+    (insert a sync, drop a lock, delete a flush, impurity in a jitted
+    body), trips exactly the rule the mutation violates."""
+    from paddle_tpu.testing import mutants
+    muts = mutants.iter_mutants()
+    assert len(muts) >= 8
+    for m in muts:
+        report = analyze_sources(m.sources, rules=m.rules())
+        fired = {f.rule for f in report.unsuppressed()}
+        assert m.expect_rule in fired, (
+            f"mutant {m.name}: expected {m.expect_rule}, got "
+            f"{fired or 'nothing'}")
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+def test_suppression_requires_reason_and_reports_bad_suppression():
+    src = _sync_src(
+        "        # analysis: ignore[sync-in-hot-path]\n"
+        "        v = out.item()\n        return v")
+    report = analyze_sources({"fix": src}, rules=_sync_rules())
+    rules_fired = [f.rule for f in report.unsuppressed()]
+    assert "sync-in-hot-path" in rules_fired      # NOT silenced
+    assert BAD_SUPPRESSION in rules_fired
+
+
+def test_suppression_standalone_applies_to_next_line():
+    src = _sync_src(
+        "        # analysis: ignore[sync-in-hot-path] reason=fixture\n"
+        "        v = out.item()\n        return v")
+    report = analyze_sources({"fix": src}, rules=_sync_rules())
+    assert not report.unsuppressed()
+    assert len(report.suppressed()) == 1
+    assert report.suppressed()[0].reason == "fixture"
+
+
+def test_unused_suppression_is_flagged():
+    """A suppression whose named rule ran and flagged nothing is
+    stale — it must surface, not linger as a phantom blind spot.
+    (Rule-scoping guard: test_suppression_is_rule_scoped pins that a
+    suppression naming an INACTIVE rule is never called unused.)"""
+    src = _sync_src(
+        "        # analysis: ignore[sync-in-hot-path] reason=stale\n"
+        "        n = len(self.queue)\n        return n")
+    report = analyze_sources({"fix": src}, rules=_sync_rules())
+    assert [f.rule for f in report.unsuppressed()] \
+        == ["unused-suppression"]
+
+
+def test_suppression_in_body_does_not_reach_compound_head():
+    """A suppression sitting inside an `if` body must not silence a
+    finding anchored to the `if` line itself — and since it then
+    matches nothing, it is additionally surfaced as stale."""
+    src = _sync_src(
+        "        if int(jnp.sum(out)):\n"
+        "            # analysis: ignore[sync-in-hot-path] "
+        "reason=misplaced\n"
+        "            self.log()\n"
+        "        return out")
+    report = analyze_sources({"fix": src}, rules=_sync_rules())
+    assert sorted(f.rule for f in report.unsuppressed()) \
+        == ["sync-in-hot-path", "unused-suppression"]
+
+
+def test_standalone_suppression_does_not_cross_dedent():
+    """A standalone suppression that is the LAST line of a compound
+    body must not reach forward across the dedent and silence a
+    finding on the next statement of the enclosing scope — and since
+    it then matches nothing, it is additionally surfaced as stale."""
+    src = _sync_src(
+        "        if self.flag:\n"
+        "            self.log()\n"
+        "            # analysis: ignore[sync-in-hot-path] "
+        "reason=misplaced\n"
+        "        v = out.item()\n"
+        "        return v")
+    report = analyze_sources({"fix": src}, rules=_sync_rules())
+    assert sorted(f.rule for f in report.unsuppressed()) \
+        == ["sync-in-hot-path", "unused-suppression"]
+
+
+def test_baseline_never_blesses_engine_findings(tmp_path, capsys):
+    """--write-baseline must not record — and --baseline must not
+    grandfather — engine pseudo findings: a reasonless suppression
+    (and the real finding it fails to silence) keeps failing every
+    run until actually fixed."""
+    from paddle_tpu.analysis.cli import main
+    bad = tmp_path / "srv.py"
+    bad.write_text('''
+class ContinuousBatchingEngine:
+    def helper(self):
+        # analysis: ignore[flush-point]
+        self._retire(1)
+''')
+    base = tmp_path / "baseline.json"
+    assert main([str(bad), "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+    entries = json.loads(base.read_text())
+    assert all(e["rule"] != BAD_SUPPRESSION for e in entries)
+    # the flush-point finding is grandfathered, the bad suppression
+    # is not — the run still fails
+    assert main([str(bad), "--baseline", str(base)]) == 1
+    assert BAD_SUPPRESSION in capsys.readouterr().out
+
+
+def test_suppression_is_rule_scoped():
+    """A suppression for one rule id does not silence another."""
+    src = _sync_src(
+        "        # analysis: ignore[trace-impure] reason=wrong rule\n"
+        "        v = out.item()\n        return v")
+    report = analyze_sources({"fix": src}, rules=_sync_rules())
+    assert [f.rule for f in report.unsuppressed()] \
+        == ["sync-in-hot-path"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_clean_run_and_json(tmp_path, capsys):
+    from paddle_tpu.analysis.cli import main
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(x):\n    return x + 1\n")
+    assert main([str(clean)]) == 0
+    capsys.readouterr()
+    assert main([str(clean), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["unsuppressed"] == 0
+
+
+def test_cli_finding_exit_code_rule_filter_and_baseline(tmp_path,
+                                                        capsys):
+    from paddle_tpu.analysis.cli import main
+    bad = tmp_path / "srv.py"
+    bad.write_text('''
+class ContinuousBatchingEngine:
+    def helper(self):
+        self._retire(1)
+''')
+    # flush-point fires (engine class matched by name, mutation not
+    # dominated by a flush)
+    assert main([str(bad)]) == 1
+    capsys.readouterr()
+    # filtered to an unrelated rule: clean
+    assert main([str(bad), "--rule", "sync-in-hot-path"]) == 0
+    capsys.readouterr()
+    # baseline round-trip grandfathers the finding
+    base = tmp_path / "baseline.json"
+    assert main([str(bad), "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+    assert json.loads(base.read_text())
+    assert main([str(bad), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_rule_filter_scopes_lock_findings(tmp_path, capsys):
+    """`--rule lock-order` runs its implementing rule
+    (LockDisciplineRule) but must not print — or exit nonzero on —
+    lock-discipline findings the user excluded; the reverse
+    direction keeps the documented ride-along: a lock-discipline
+    run still surfaces ABBA inversions."""
+    from paddle_tpu.analysis.cli import main
+    disc = tmp_path / "handler.py"
+    disc.write_text('''
+def peek(srv: "GenerationServer"):
+    return srv._fatal
+''')
+    assert main([str(disc)]) == 1
+    assert "lock-discipline" in capsys.readouterr().out
+    assert main([str(disc), "--rule", "lock-order"]) == 0
+    assert "lock-discipline" not in capsys.readouterr().out
+    abba = tmp_path / "pair.py"
+    abba.write_text('''
+class Pair:
+    def fwd(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+
+    def rev(self):
+        with self._b_lock:
+            with self._a_lock:
+                return 2
+''')
+    assert main([str(abba), "--rule", "lock-discipline"]) == 1
+    assert "lock-order" in capsys.readouterr().out
+
+
+def test_baseline_does_not_collide_across_same_named_files(tmp_path,
+                                                           capsys):
+    """A grandfathered finding in one file must not silence an
+    identical-message finding in a same-named file elsewhere."""
+    from paddle_tpu.analysis.cli import main
+    src = '''
+class ContinuousBatchingEngine:
+    def helper(self):
+        self._retire(1)
+'''
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    (d1 / "srv.py").write_text(src)
+    (d2 / "srv.py").write_text(src)
+    base = tmp_path / "baseline.json"
+    assert main([str(d1 / "srv.py"),
+                 "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+    assert main([str(d1 / "srv.py"), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    assert main([str(d2 / "srv.py"), "--baseline", str(base)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_default_targets_are_clean(capsys):
+    """`python tools/check.py` with no args = the tier-1 contract."""
+    from paddle_tpu.analysis.cli import main
+    assert main([]) == 0
+    assert "0 unsuppressed" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# docs + annotation-registry consistency
+# ---------------------------------------------------------------------------
+def test_static_analysis_doc_catalogues_every_rule():
+    """docs/STATIC_ANALYSIS.md names every rule id, the suppression
+    syntax, and the reason policy (linted the same way
+    docs/OBSERVABILITY.md is)."""
+    with open(os.path.join(_REPO, "docs", "STATIC_ANALYSIS.md")) as f:
+        doc = f.read()
+    for rid in ALL_RULE_IDS:
+        assert f"`{rid}`" in doc, f"rule {rid} missing from catalogue"
+    assert f"`{BAD_SUPPRESSION}`" in doc
+    assert "analysis: ignore[" in doc
+    assert "reason=" in doc
+    for tool in ("tools/check.py", "--baseline", "--rule",
+                 "-m analysis"):
+        assert tool in doc
+
+
+def test_thread_safety_docs_match_annotation_registry():
+    """The thread-safety table in docs/FAULT_TOLERANCE.md is generated
+    from analysis/annotations.py THREAD_SAFETY — rows must match the
+    registry verbatim, the registry must cover the engine's driving
+    surface, and submit()/cancel() docstrings must carry their
+    designation."""
+    from paddle_tpu.analysis.annotations import (THREAD_SAFETY,
+                                                 thread_safety_doc_lines)
+    with open(os.path.join(_REPO, "docs", "FAULT_TOLERANCE.md")) as f:
+        doc = f.read()
+    for line in thread_safety_doc_lines():
+        assert line in doc, f"doc row drifted from registry: {line}"
+    from paddle_tpu.models.serving_engine import \
+        ContinuousBatchingEngine as E
+    for api in ("submit", "cancel", "step", "finished",
+                "drain_stream", "has_work", "queued_tokens",
+                "retry_after_s", "run_to_completion"):
+        assert api in THREAD_SAFETY, f"{api} missing from registry"
+        assert callable(getattr(E, api))
+    for api in ("submit", "cancel"):
+        designation = THREAD_SAFETY[api][0]
+        doc_str = getattr(E, api).__doc__ or ""
+        assert designation in doc_str, (
+            f"{api}() docstring must state its `{designation}` "
+            f"thread-safety designation")
+
+
+def test_shared_state_registry_names_real_attributes():
+    """Every attribute the SHARED_STATE registry declares actually
+    exists in the class it names — a rename cannot silently blind the
+    lock rule."""
+    from paddle_tpu.analysis.annotations import SHARED_STATE
+    from paddle_tpu.analysis.core import Analyzer
+    paths = [os.path.join(_REPO, t) for t in DEFAULT_TARGETS]
+    paths.append(os.path.join(_REPO, "paddle_tpu", "testing"))
+    report = Analyzer([]).run_paths(paths)
+    import ast as _ast
+    from paddle_tpu.analysis.project import Project
+    project = Project(report.modules)
+    for key, spec in SHARED_STATE.items():
+        matches = [ci for q, ci in project.classes.items()
+                   if q == key or q.endswith("." + key)]
+        assert matches, f"registered class {key} not found"
+        ci = matches[0]
+        seen = set()
+        for node in _ast.walk(ci.node):
+            if isinstance(node, _ast.Attribute):
+                seen.add(node.attr)
+        for attr in set(spec.attrs) | {spec.lock}:
+            assert attr in seen, (
+                f"{key}: registered attribute {attr!r} never appears "
+                f"in the class body (stale registry entry?)")

@@ -526,6 +526,47 @@ def test_http_backpressure_429_and_health_split(cfg, params):
         srv.stop()
 
 
+def test_readiness_probe_does_not_stall_behind_held_lock(cfg, params):
+    """A readiness probe must answer promptly even while the drive
+    thread holds the server lock across a long step (e.g. a JIT
+    compile): is_ready() bounded-waits and serves the last verdict
+    computed under the lock."""
+    srv = _server(cfg, params)
+    port = srv.start()
+    try:
+        _poll(srv.is_ready)                 # publish a True verdict
+        with srv._lock:                     # simulate a long step
+            t0 = time.monotonic()
+            assert srv.is_ready() is True   # cached, not blocked
+            assert time.monotonic() - t0 < 1.0
+    finally:
+        srv.stop()
+
+
+def test_health_scrape_does_not_stall_behind_held_lock(cfg, params):
+    """A /health scrape must answer promptly even while the drive
+    thread holds the server lock across a long step:
+    health_snapshot() bounded-waits and serves the last document
+    built under the lock (the first scrape, with nothing to serve,
+    waits it out)."""
+    srv = _server(cfg, params)
+    srv.start()
+    try:
+        first = srv.health_snapshot()       # publish a real document
+        assert first["status"] == "ok"
+        assert "stale_s" not in first       # fresh build, no marker
+        with srv._lock:                     # simulate a long step
+            t0 = time.monotonic()
+            h = srv.health_snapshot()       # cached, not blocked
+            assert time.monotonic() - t0 < 1.0
+            # the fallback is the last document plus a staleness
+            # marker — a wedged step shows as growing stale_s
+            assert h.pop("stale_s") >= 0.0
+            assert h == first
+    finally:
+        srv.stop()
+
+
 def test_http_deadline_maps_to_504(cfg, params):
     from paddle_tpu.inference.serving import generate_http
     srv = _server(cfg, params)
@@ -674,3 +715,44 @@ def test_http_supervised_server_recovers(cfg, params):
             assert h["requests_faulted"] == 2
         finally:
             srv.stop()
+
+
+def test_queued_tokens_scrape_is_lock_free_safe():
+    """Observability gauge callbacks read ``queued_tokens()`` from
+    scrape threads with NO lock (engine_metrics binds it as a
+    callback gauge): a racing submit/step may shift the answer by
+    one admission, but must never raise ``deque mutated during
+    iteration`` — the ``any-thread`` contract the THREAD_SAFETY
+    registry declares."""
+    import threading
+    from collections import deque
+    from types import SimpleNamespace
+
+    from paddle_tpu.models.serving_engine import Request
+
+    def req(rid):
+        return Request(rid=rid, prompt=np.zeros(32, dtype=np.int64),
+                       max_new_tokens=4)
+
+    q = deque(req(i) for i in range(64))
+    eng = SimpleNamespace(_queue=q)
+    stop = threading.Event()
+
+    def churn():
+        rid = 64
+        while not stop.is_set():
+            q.append(req(rid))
+            q.popleft()
+            rid += 1
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            n = ContinuousBatchingEngine.queued_tokens(eng)
+            # steady size 64 (or 65 mid-churn): 2048 or 2080 tokens
+            assert n in (2048, 2080)
+    finally:
+        stop.set()
+        t.join(timeout=5)

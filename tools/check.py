@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Hot-path invariant checker CLI — thin wrapper over
+paddle_tpu.analysis.cli (kept in tools/ so `python tools/check.py`
+works from a bare checkout; the installed console script
+`paddle-tpu-check` points at the same entry).
+
+    python tools/check.py                      # tier-1 modules, all rules
+    python tools/check.py --rule sync-in-hot-path paddle_tpu/models
+    python tools/check.py --json               # machine-readable
+    python tools/check.py --write-baseline baseline.json
+
+Exit status: 0 clean, 1 unsuppressed findings, 2 usage error.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
