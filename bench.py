@@ -879,6 +879,127 @@ def _fault_recovery_line() -> dict:
     }
 
 
+def _serving_tp_line() -> dict:
+    """TENSOR-PARALLEL serving A/B on an mp mesh (PR-7 tentpole): the
+    same mixed-length trace admits through the batched-under-TP and
+    packed-under-TP lanes (dispatch counts pin the ONE-dispatch-per-
+    wave contract on a mesh), then decodes with ``tp_allreduce`` fp32
+    vs int8 (+ overlap) — reporting admission dispatches, decode
+    tok/s, and analytic collective bytes-moved per decode step per
+    lane.  ``value`` is the int8 bytes per step over a 4-BYTE fp32
+    wire (the EQuARX win and the acceptance pin; <= ~0.31 at smoke
+    scale, ~0.27 at bench hidden sizes); ``extra`` also carries the
+    ratio against the default lane's ACTUAL wire dtype, which on a
+    bf16 TPU config is 2 bytes (ratio ~0.56).
+
+    Needs >= 2 devices: on CPU run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=4``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  build_mesh,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import (
+        PagedKVCache, tp_collective_bytes_per_step)
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+
+    platform = jax.devices()[0].platform
+    ndev = len(jax.devices())
+    mp = 4 if ndev >= 4 else (2 if ndev >= 2 else 0)
+    if not mp:
+        return _error_line(
+            "serving_tp_ab", "ratio",
+            f"needs >= 2 devices for a TP mesh, have {ndev}; on CPU "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=4")
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, new, page = 8, 32, 64
+        num_pages, pages_max = 96, 16
+        trace = [640, 64, 96, 500, 128, 72, 320, 200]
+        metric = "serving_tp_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, new, page = 4, 8, 16
+        num_pages, pages_max = 64, 8
+        trace = [100, 5, 9, 12]
+        metric = "serving_tp_tiny_cpu_smoke_ab"
+
+    mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=mp,
+                      devices=jax.devices()[:mp])
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (L,)) for L in trace]
+
+    def run(packed, mode, overlap):
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page, mesh=mesh)
+        eng = ContinuousBatchingEngine(
+            cfg, params, cache, mesh=mesh, metrics_registry=False,
+            packed=packed, tp_allreduce=mode, overlap=overlap)
+        # warm every compile the timed wave will hit
+        for p in prompts:
+            eng.submit(p, max_new_tokens=2)
+        eng.run_to_completion()
+        calls0 = eng.prefill_calls
+        for p in prompts:
+            eng.submit(p, max_new_tokens=new)
+        t0 = time.perf_counter()
+        eng.step()                    # the admission wave (+1 decode)
+        admission_ms = (time.perf_counter() - t0) * 1000
+        while eng._queue:
+            eng.step()
+        t1 = time.perf_counter()
+        done = eng.run_to_completion()
+        decode_s = time.perf_counter() - t1
+        return {
+            "prefill_calls": eng.prefill_calls - calls0,
+            "admission_ms": round(admission_ms, 2),
+            "decode_tok_per_s": round(
+                sum(len(r.generated) for r in done)
+                / max(decode_s + admission_ms / 1000, 1e-9), 1),
+            "bytes_per_step": eng._tp_bytes_step,
+            "allreduce_mbytes_total": round(
+                eng.tp_allreduce_bytes / 1e6, 4),
+        }
+
+    batched = run(False, "fp32", False)
+    packed = run(True, "fp32", False)
+    q8_overlap = run(True, "int8", True)
+    fp_bytes = tp_collective_bytes_per_step(cfg, mp, "fp32", batch)
+    q8_bytes = tp_collective_bytes_per_step(cfg, mp, "int8", batch)
+    # the acceptance pin is against a 4-byte fp32 wire; the default
+    # lane's actual wire is the compute dtype (2 bytes under bf16)
+    fp32_4byte = fp_bytes * 4 // np.dtype(cfg.dtype).itemsize
+    return {
+        "metric": metric,
+        "value": round(q8_bytes / max(fp32_4byte, 1), 4),
+        "unit": "ratio",
+        "vs_baseline": 0,
+        "extra": {"platform": platform, "mp": mp,
+                  "trace_lens": trace, "batch_slots": batch,
+                  "batched_fp32": batched, "packed_fp32": packed,
+                  "packed_int8_overlap": q8_overlap,
+                  "bytes_per_step_default_lane": fp_bytes,
+                  "bytes_per_step_int8": q8_bytes,
+                  "ratio_vs_default_lane": round(
+                      q8_bytes / max(fp_bytes, 1), 4)},
+    }
+
+
 def _serving_line() -> dict:
     return _serving_run(overlap=False)
 
@@ -951,6 +1072,7 @@ def main() -> None:
         ("serving_engine_overlap_decode_tokens_per_sec", "tokens/s",
          _serving_overlap_line),
         ("serving_admission_packed_vs_batched", "x", _admission_line),
+        ("serving_tp_ab", "ratio", _serving_tp_line),
         ("serving_preemption_offload_resume_ab", "x",
          _preemption_line),
         ("serving_fault_recovery", "ratio", _fault_recovery_line),

@@ -54,6 +54,13 @@ SYNC_HOT_ROOTS: List[str] = [
     "SpeculativeEngine._decode_once",
     "SpeculativeEngine._finish_admit",
     "make_paged_decode_step_async",
+    # the TP shard_map lanes (PR 7): the sharded step/prefill inner
+    # fns and the quantized-collective builder must stay lint-clean
+    # themselves, not merely be reachable from the engine roots
+    "paged_decode._build_tp_inner",
+    "paged_decode._prefill_packed_tp",
+    "paged_decode._prefill_chunk_batched_tp",
+    "paged_decode._make_q8_allreduce",
 ]
 
 # Calls whose RESULT lives on the device: the taint seeds for the
@@ -89,6 +96,12 @@ BLOCKING_SEAMS: FrozenSet[str] = frozenset({"_fetch"})
 EXTRA_TRACED: List[str] = [
     "paged_decode._build_step_fns",
     "paged_decode._build_tp_inner",
+    # PR 7 TP shard_map seams: packed prefill + batched verify are
+    # jitted shard_map programs built by factories, and the quantized
+    # ring collective is a closure staged inside the TP step
+    "paged_decode._prefill_packed_tp",
+    "paged_decode._prefill_chunk_batched_tp",
+    "paged_decode._make_q8_allreduce",
 ]
 
 

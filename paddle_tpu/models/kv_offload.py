@@ -28,6 +28,16 @@ per swap-in.
 Buffers are ``[L, host_pages, nkv, page, d]`` matching the device
 pool layout exactly (int8 pools carry their per-(head, slot) scale
 buffers too), so swap round-trips are bitwise.
+
+TENSOR-PARALLEL pools (kv-head-sharded over the ``mp`` axis) stage
+PER SHARD: a gathered page block arrives as one jax array sharded on
+the head axis, and :meth:`stage` splits it into its addressable
+shards — each rank's local-heads slice rides its own async D2H copy
+straight into that slice of the host buffer, so no device-side
+reassembly (cross-chip collective) ever happens on the swap path.
+The host buffer keeps the full logical ``nkv`` layout; restores hand
+the assembled block to one batched scatter whose GSPMD partitioning
+takes each rank's head slice back.
 """
 
 from __future__ import annotations
@@ -105,20 +115,51 @@ class HostPagePool:
         self._free.append(hid)
 
     # -- device -> host ---------------------------------------------------
+    @staticmethod
+    def _split_shards(k, v, ks, vs):
+        """Split a (possibly kv-head-sharded) gathered page block into
+        per-shard pieces ``[(head_slice, k_i, v_i, ks_i, vs_i)]``.  A
+        single-device array yields one full-slice entry; a TP-sharded
+        array yields one entry per distinct head slice, each piece a
+        single-device array whose D2H copy needs no reassembly.
+        Replicated copies (mesh axes of size > 1 besides ``mp``)
+        dedupe on the slice."""
+        k_shards = getattr(k, "addressable_shards", None)
+        if not k_shards or len(k_shards) == 1:
+            return [(slice(None), k, v, ks, vs)]
+        v_shards = v.addressable_shards
+        ks_shards = None if ks is None else ks.addressable_shards
+        vs_shards = None if vs is None else vs.addressable_shards
+        out, seen = [], set()
+        for i, sh in enumerate(k_shards):
+            sl = sh.index[2]              # the kv-head axis of
+            #                               [L, n, nkv, page, d]
+            key = (sl.start, sl.stop)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append((sl, sh.data, v_shards[i].data,
+                        None if ks_shards is None else ks_shards[i].data,
+                        None if vs_shards is None else vs_shards[i].data))
+        return out
+
     def stage(self, hids: List[int], k, v, ks=None, vs=None) -> None:
         """Stage a batched device→host copy of gathered pages
-        (``k``/``v``: ``[L, len(hids), nkv, page, d]`` device arrays).
-        The fetch starts asynchronously where the backend supports it
-        and overlaps whatever the device runs next; the numpy write
-        happens at :meth:`flush`."""
-        for a in (k, v, ks, vs):
-            if a is None:
-                continue
-            try:
-                a.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass                      # backend without async D2H
-        self._pending.append((list(hids), k, v, ks, vs))
+        (``k``/``v``: ``[L, len(hids), nkv, page, d]`` device arrays,
+        kv-head-sharded under TP).  Each shard's fetch starts
+        asynchronously where the backend supports it and overlaps
+        whatever the device runs next; the numpy write happens at
+        :meth:`flush`."""
+        pieces = self._split_shards(k, v, ks, vs)
+        for _, *arrs in pieces:
+            for a in arrs:
+                if a is None:
+                    continue
+                try:
+                    a.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass                  # backend without async D2H
+        self._pending.append((list(hids), pieces))
         if len(self._pending) >= _MAX_PENDING:
             self.flush()
 
@@ -129,12 +170,13 @@ class HostPagePool:
         self._flush_entries(pending)
 
     def _flush_entries(self, entries) -> None:
-        for hids, k, v, ks, vs in entries:
-            self.kbuf[:, hids] = np.asarray(k)
-            self.vbuf[:, hids] = np.asarray(v)
-            if self.kscale is not None:
-                self.kscale[:, hids] = np.asarray(ks)
-                self.vscale[:, hids] = np.asarray(vs)
+        for hids, pieces in entries:
+            for sl, k, v, ks, vs in pieces:
+                self.kbuf[:, hids, sl] = np.asarray(k)
+                self.vbuf[:, hids, sl] = np.asarray(v)
+                if self.kscale is not None:
+                    self.kscale[:, hids, sl] = np.asarray(ks)
+                    self.vscale[:, hids, sl] = np.asarray(vs)
 
     # -- host -> device (caller scatters) ---------------------------------
     def gather(self, hids: List[int]):

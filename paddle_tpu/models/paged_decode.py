@@ -61,7 +61,11 @@ class PagedKVCache:
     (``swap_out_row`` / ``swap_in_row`` — resume restores pages with
     zero prefill tokens) and evicted cached-prefix pages demote to
     host and promote back on lookup, so prefix-cache depth scales
-    with host RAM rather than the decode pool.
+    with host RAM rather than the decode pool.  The two compose: on a
+    TP mesh the host tier stages PER SHARD (each rank's local-heads
+    slice rides its own async D2H copy — see kv_offload.py), and the
+    int8 scale planes shard with the heads, so offload / promote /
+    demote and :meth:`audit` all work against the sharded pool.
     """
 
     def __init__(self, cfg: LlamaPretrainConfig, num_pages: int,
@@ -70,12 +74,6 @@ class PagedKVCache:
                  mesh=None, host_pages: int = 0):
         if kv_quant not in (None, "int8"):
             raise ValueError("kv_quant must be None or 'int8'")
-        if host_pages and mesh is not None \
-                and mesh.shape.get("mp", 1) > 1:
-            raise ValueError(
-                "host_pages (the host-RAM page tier) is single-device "
-                "only for now — a kv-head-sharded pool would need "
-                "per-shard host buffers")
         self.cfg = cfg
         self.page = page
         self.pages_max = pages_max
@@ -1039,7 +1037,8 @@ def make_paged_decode_step_async(cfg: LlamaPretrainConfig,
                                  temperature: float = 0.0,
                                  kv_quant: Optional[str] = None,
                                  top_k: int = 0, top_p: float = 1.0,
-                                 mesh=None):
+                                 mesh=None,
+                                 tp_allreduce: str = "fp32"):
     """Jitted DISPATCH-AHEAD decode step: the per-token program plus a
     functional advance of the whole serving-loop state, so the engine
     can chain step k's on-device outputs straight into step k+1's
@@ -1070,14 +1069,15 @@ def make_paged_decode_step_async(cfg: LlamaPretrainConfig,
     mesh_key = mesh if (mesh is not None
                         and mesh.shape.get("mp", 1) > 1) else None
     ckey = (_cfg_key(cfg), temperature, kv_quant, top_k, top_p,
-            mesh_key)
+            mesh_key, tp_allreduce if mesh_key is not None else "fp32")
     hit = _step_async_cache.get(ckey)
     if hit is not None:
         return hit
 
     if mesh_key is not None:
         base = _build_tp_inner(cfg, mesh, temperature, kv_quant,
-                               top_k, top_p)
+                               top_k, top_p,
+                               tp_allreduce=tp_allreduce)
     else:
         step, step_q8 = _build_step_fns(cfg, temperature, False,
                                         top_k, top_p)
@@ -1120,24 +1120,11 @@ _step_tp_cache: dict = {}
 _tp_inner_cache: dict = {}
 
 
-def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
-                    temperature: float, kv_quant: Optional[str],
-                    top_k: int, top_p: float):
-    """Memoised UNJITTED shard_map per-token TP step — the sync
-    factory jits it directly; :func:`make_paged_decode_step_async`
-    composes the loop-state advance around it inside one outer jit.
-    Signature matches the single-device raw step (q8 variant inserts
-    the scale pools after ``vpool``)."""
-    mp = mesh.shape["mp"]
-    ckey = (_cfg_key(cfg), temperature, kv_quant, mesh, top_k, top_p)
-    hit = _tp_inner_cache.get(ckey)
-    if hit is not None:
-        return hit
-
-    from jax.sharding import PartitionSpec as P
-    from .llama_pretrain import param_specs
+def _shard_map_fn():
+    """jax.shard_map with the 0.4.x compat shim (experimental
+    namespace, check_vma→check_rep) — shared by every TP builder."""
     try:                               # jax >= 0.5 top-level export
-        shard_map = jax.shard_map
+        return jax.shard_map
     except AttributeError:             # 0.4.x: experimental namespace,
         from jax.experimental.shard_map import shard_map as _sm
 
@@ -1145,10 +1132,170 @@ def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
             if "check_vma" in kw:
                 kw["check_rep"] = kw.pop("check_vma")
             return _sm(*a, **kw)
+        return shard_map
+
+
+# -- quantized + overlapped TP collectives (EQuARX / T3) ------------------
+_Q8_SCALE_BYTES = 4                    # f32 per-block scales on the wire
+
+
+def _q8_ring_plan(H: int, mp: int):
+    """How ``tp_allreduce="int8"`` splits one ``[B, H]`` output
+    reduction: ``nchunks`` column chunks of the producing matmul (each
+    chunk runs its own ring, so chunk c's ppermute hops carry no data
+    dependency on chunk c+1's matmul — the T3/FLUX latency-hiding
+    arrangement) and the per-block scale granularity of the int8
+    wire.  Wire bytes per fp32 byte = (1 + 4/block) / 4."""
+    if H % mp:
+        raise ValueError(f"hidden {H} must divide over mp={mp} for "
+                         "tp_allreduce='int8'")
+    C = H // mp
+    # chunking needs the per-rank width to split evenly too (an odd C
+    # would otherwise fail only at trace time, inside a reshape)
+    nchunks = 2 if (C >= 64 and C % 2 == 0) else 1
+    Cc = C // nchunks
+    block = 32
+    while block > 1 and Cc % block:
+        block //= 2
+    return nchunks, block
+
+
+def tp_collective_bytes_per_step(cfg, mp: int, mode: str = "fp32",
+                                 batch: int = 1) -> int:
+    """Analytic bytes ONE device sends per decode step in the
+    per-layer OUTPUT reductions (attention ``wo`` + FFN ``w_down`` —
+    the collectives ``tp_allreduce`` controls; the vocab-parallel
+    embed psum and the final logits all-gather are mode-independent
+    and excluded).  fp32 lane: ring all-reduce of ``[B, H]`` in the
+    compute dtype, ``2*(mp-1)/mp*B*H*itemsize`` per reduction.  int8
+    lane: ring reduce-scatter + all-gather whose hops carry int8
+    payloads + f32 per-block scales.  Feeds the
+    ``paddle_tpu_engine_tp_allreduce_bytes_total`` counter and the
+    bench A/B — and the ≤~30%-of-fp32 acceptance pin.  NOTE the
+    baseline dtype: the pin is against a 4-BYTE fp32 wire; a bf16
+    compute dtype halves the default lane's bytes, so the same int8
+    lane reads ~0.53-0.56 of a bf16 baseline (bench reports both
+    ratios)."""
+    if mp <= 1:
+        return 0
+    H, L = cfg.hidden_size, cfg.num_hidden_layers
+    if mode == "fp32":
+        per = (2.0 * (mp - 1) / mp * batch * H
+               * np.dtype(cfg.dtype).itemsize)
+    else:
+        nch, block = _q8_ring_plan(H, mp)
+        C = H // (mp * nch)
+        per = (nch * 2.0 * (mp - 1) * batch
+               * (C + (C // block) * _Q8_SCALE_BYTES))
+    return int(round(2 * L * per))
+
+
+def _embed_vocab_parallel(embed_l, tok, ax: str, dt):
+    """Vocab-parallel embedding lookup inside shard_map (Megatron
+    VocabParallelEmbedding): mask the out-of-shard ids, take locally,
+    psum across the mp axis.  ``tok`` may be any shape; shared by the
+    TP decode step and both TP prefill programs so their embedding
+    numerics can never fork."""
+    V_l = embed_l.shape[0]
+    start = jax.lax.axis_index(ax) * V_l
+    local = tok - start
+    ok = (local >= 0) & (local < V_l)
+    x = jnp.take(embed_l, jnp.clip(local, 0, V_l - 1), axis=0)
+    return jax.lax.psum(jnp.where(ok[..., None], x, 0).astype(dt), ax)
+
+
+def _make_q8_allreduce(ax: str, mp: int, Hc: int, block: int):
+    """Quantized ring all-reduce closure for one ``[B, Hc]`` chunk
+    inside shard_map (EQuARX, arxiv 2506.17615): a ring
+    reduce-scatter followed by a ring all-gather via ``lax.ppermute``,
+    every wire hop carrying int8 payloads + f32 per-block scales
+    (~(1+4/block)/4 of the fp32 bytes).  Hops are Python-unrolled so
+    each ppermute is an independent graph node XLA's latency-hiding
+    scheduler can run under the neighbouring matmuls."""
+    C = Hc // mp
+    perm = [(d, (d + 1) % mp) for d in range(mp)]
+
+    def wire(x):                      # [B, C] f32 -> int8 + scales
+        xb = x.reshape(x.shape[0], C // block, block)
+        s = jnp.max(jnp.abs(xb), -1, keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-30)
+        q = jnp.clip(jnp.round(xb / s), -127, 127).astype(jnp.int8)
+        return q, s
+
+    def unwire(q, s):
+        return (q.astype(jnp.float32) * s).reshape(q.shape[0], C)
+
+    def allreduce(x):                 # [B, Hc] partial sums -> reduced
+        B = x.shape[0]
+        i = jax.lax.axis_index(ax)
+        xc = x.astype(jnp.float32).reshape(B, mp, C)
+        # ring REDUCE-SCATTER: after mp-1 hops rank i holds the full
+        # cross-rank sum of chunk i
+        acc = jnp.take(xc, (i - 1) % mp, axis=1)
+        for s in range(mp - 1):
+            q, sc = wire(acc)
+            q = jax.lax.ppermute(q, ax, perm)
+            sc = jax.lax.ppermute(sc, ax, perm)
+            acc = unwire(q, sc) + jnp.take(xc, (i - s - 2) % mp,
+                                           axis=1)
+        # ring ALL-GATHER of the reduced shards: each chunk is wired
+        # ONCE and the (q, scale) payload forwards UNCHANGED hop to
+        # hop — every rank dequantizes the SAME payload, so the
+        # "replicated" output is bit-identical across ranks (a rank
+        # keeping its own exact acc, or re-quantizing per hop, would
+        # leave the mp copies divergent and the chained decode loop
+        # would fork per-shard token histories).  Arrival r holds
+        # chunk (i - r) mod mp, so the reversed stack rolled by i+1
+        # reads in chunk order 0..mp-1.
+        q, sc = wire(acc)
+        rows = [unwire(q, sc)]
+        for _ in range(mp - 1):
+            q = jax.lax.ppermute(q, ax, perm)
+            sc = jax.lax.ppermute(sc, ax, perm)
+            rows.append(unwire(q, sc))
+        stacked = jnp.stack(rows[::-1], axis=0)        # [mp, B, C]
+        full = jnp.roll(stacked, i + 1, axis=0)
+        return full.transpose(1, 0, 2).reshape(B, Hc)
+
+    return allreduce
+
+
+def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
+                    temperature: float, kv_quant: Optional[str],
+                    top_k: int, top_p: float,
+                    tp_allreduce: str = "fp32"):
+    """Memoised UNJITTED shard_map per-token TP step — the sync
+    factory jits it directly; :func:`make_paged_decode_step_async`
+    composes the loop-state advance around it inside one outer jit.
+    Signature matches the single-device raw step (q8 variant inserts
+    the scale pools after ``vpool``).
+
+    ``tp_allreduce="int8"`` swaps each layer's two output all-reduces
+    (attention ``wo``, FFN ``w_down``) for the quantized ring
+    reduce-scatter/all-gather pair (:func:`_make_q8_allreduce`), with
+    the producing matmul column-chunked so chunk c's collective hops
+    overlap chunk c+1's matmul in the schedule.  Opt-in: greedy
+    outputs then carry quantization noise and are held to a
+    statistical bar, not token-exactness (tests/test_serving_tp.py).
+    """
+    if tp_allreduce not in ("fp32", "int8"):
+        raise ValueError("tp_allreduce must be 'fp32' or 'int8', got "
+                         f"{tp_allreduce!r}")
+    mp = mesh.shape["mp"]
+    ckey = (_cfg_key(cfg), temperature, kv_quant, mesh, top_k, top_p,
+            tp_allreduce)
+    hit = _tp_inner_cache.get(ckey)
+    if hit is not None:
+        return hit
+
+    from jax.sharding import PartitionSpec as P
+    from .llama_pretrain import param_specs
+    shard_map = _shard_map_fn()
     from ..ops.pallas.paged_attention import (
         paged_decode_attention, paged_decode_attention_q8,
         quantize_kv_token)
     q8 = kv_quant == "int8"
+    q8_ar = tp_allreduce == "int8"
 
     n, d = cfg.num_attention_heads, cfg.head_dim
     nkv = cfg.num_key_value_heads
@@ -1158,22 +1305,32 @@ def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
     dt = cfg.dtype
     ax = "mp"
 
-    def embed_vp(embed_l, tok):
-        """Vocab-parallel embedding lookup: mask + psum (Megatron
-        VocabParallelEmbedding)."""
-        V_l = embed_l.shape[0]
-        start = jax.lax.axis_index(ax) * V_l
-        local = tok - start
-        ok = (local >= 0) & (local < V_l)
-        x = jnp.take(embed_l, jnp.clip(local, 0, V_l - 1), axis=0)
-        x = jnp.where(ok[..., None], x, 0).astype(dt)
-        return jax.lax.psum(x, ax)
+    if q8_ar:
+        ar_nchunks, ar_block = _q8_ring_plan(cfg.hidden_size, mp)
+        ar_fn = _make_q8_allreduce(
+            ax, mp, cfg.hidden_size // ar_nchunks, ar_block)
+
+        def reduce_out(y, w):
+            # T3/FLUX arrangement: column-chunk the row-parallel
+            # matmul; chunk c's ring hops are graph-independent of
+            # chunk c+1's matmul, so the collective hides under the
+            # neighbouring compute instead of serialising after it
+            Hc = w.shape[1] // ar_nchunks
+            outs = [ar_fn(_mm(y, w[:, c * Hc:(c + 1) * Hc], dt))
+                    for c in range(ar_nchunks)]
+            out = outs[0] if len(outs) == 1 \
+                else jnp.concatenate(outs, -1)
+            return out.astype(dt)
+    else:
+        def reduce_out(y, w):
+            return jax.lax.psum(_mm(y, w, dt), ax)
 
     def step_local(params, kpool, vpool, kscale, vscale, tables, lens,
                    tok, key):
         B = tok.shape[0]
         page = kpool.shape[3]
-        x = embed_vp(params["embed"], tok)            # [B, H] replicated
+        x = _embed_vocab_parallel(params["embed"], tok, ax,
+                                  dt)                 # [B, H] replicated
         page_ids = tables[jnp.arange(B), lens // page]
         slots = lens % page
 
@@ -1206,14 +1363,13 @@ def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
                 vp = vp.at[page_ids, :, slots, :].set(v.astype(vp.dtype))
                 attn = paged_decode_attention(q, kp, vp, tables,
                                               lens + 1)
-            o = _mm(attn.reshape(B, n_l * d), bp["wo"], dt)
-            xc = xc + jax.lax.psum(o, ax)             # row-parallel
+            xc = xc + reduce_out(attn.reshape(B, n_l * d),
+                                 bp["wo"])            # row-parallel
             res = xc
             y2 = _rms_norm(xc, bp["ln2"], cfg.rms_norm_eps)
             act = (jax.nn.silu(_mm(y2, bp["w_gate"], dt))
                    * _mm(y2, bp["w_up"], dt))
-            ffn = _mm(act, bp["w_down"], dt)
-            return res + jax.lax.psum(ffn, ax), \
+            return res + reduce_out(act, bp["w_down"]), \
                 ((kp, vp, ks, vs) if q8 else (kp, vp))
 
         xs = (params["blocks"], kpool, vpool)
@@ -1259,7 +1415,8 @@ def _build_tp_inner(cfg: LlamaPretrainConfig, mesh,
 def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
                               temperature: float = 0.0,
                               kv_quant: Optional[str] = None,
-                              top_k: int = 0, top_p: float = 1.0):
+                              top_k: int = 0, top_p: float = 1.0,
+                              tp_allreduce: str = "fp32"):
     """TENSOR-PARALLEL paged decode step: the whole per-token program is
     ONE jitted shard_map over the mesh's ``mp`` axis — Megatron-sharded
     weights (column q/k/v + gate/up, row wo/w_down with psum),
@@ -1274,20 +1431,25 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
     is shard_map and not GSPMD auto-partitioning — XLA cannot split a
     pallas_call.  Same signature/caller contract as
     :func:`make_paged_decode_step`.
+
+    ``tp_allreduce="int8"`` (opt-in) quantizes the per-layer output
+    all-reduces into ring reduce-scatter/all-gather pairs whose hops
+    carry int8 + per-block scales, chunk-interleaved with the
+    producing matmuls — see :func:`_build_tp_inner`.
     """
     hit = _step_tp_cache.get((_cfg_key(cfg), temperature, kv_quant,
-                              mesh, top_k, top_p))
+                              mesh, top_k, top_p, tp_allreduce))
     if hit is not None:
         return hit
 
     inner = _build_tp_inner(cfg, mesh, temperature, kv_quant, top_k,
-                            top_p)
+                            top_p, tp_allreduce=tp_allreduce)
     if kv_quant == "int8":
         fn = jax.jit(inner, donate_argnums=(1, 2, 3, 4))
     else:
         fn = jax.jit(inner, donate_argnums=(1, 2))
     _step_tp_cache[(_cfg_key(cfg), temperature, kv_quant, mesh,
-                    top_k, top_p)] = fn
+                    top_k, top_p, tp_allreduce)] = fn
     return fn
 
 
@@ -1610,6 +1772,117 @@ def _prefill_packed(cfg: LlamaPretrainConfig, q8: bool,
     return run
 
 
+_packed_tp_cache: dict = {}
+
+
+def _prefill_packed_tp(cfg: LlamaPretrainConfig, mesh, q8: bool,
+                       with_hist: bool):
+    """PACKED VARLEN prefill composed through the TP shard_map seam —
+    same signature and stream layout as :func:`_prefill_packed`, so
+    the engine's packed admission lane stays ONE dispatch per wave on
+    a mesh.  Per shard: local-head q/k/v (Megatron column split),
+    segment-masked attention over the LOCAL heads (the segmented
+    Pallas kernel per shard on TPU — heads are embarrassingly
+    parallel — XLA mask on CPU), history K/V gathered from the local
+    pool shard (int8 dequant via the local scale planes: page ids are
+    replicated, heads are sharded, so nothing crosses the mp axis),
+    and row-parallel psums for wo / w_down (exact fp reductions —
+    prefill keeps the token-exactness bar; ``tp_allreduce`` is a
+    decode-lane knob).  Returns replicated ``x [1, T, H]`` and
+    head-SHARDED ``ks``/``vs [Lyr, T, nkv, d]`` — per-segment page
+    scatters then stay local to each shard."""
+    ckey = (_cfg_key(cfg), mesh, q8, with_hist)
+    hit = _packed_tp_cache.get(ckey)
+    if hit is not None:
+        return hit
+    from jax.sharding import PartitionSpec as P
+    from .llama_pretrain import param_specs
+    from .decode import _grouped_attn
+    from ..ops.pallas.flash_attention import _interpret, _pick_blocks
+    from ..ops.pallas.flash_varlen import flash_attention_segmented
+
+    shard_map = _shard_map_fn()
+    mp = mesh.shape["mp"]
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    if n % mp or nkv % mp:
+        raise ValueError(f"heads {n}/{nkv} must divide over mp={mp}")
+    n_l, nkv_l = n // mp, nkv // mp
+    dt = cfg.dtype
+    ax = "mp"
+
+    def run_local(params, toks, seg, pos, kpool, vpool, kscale,
+                  vscale, hist_page, hist_slot, pool_hist, stream_src,
+                  stream_hist):
+        B, T = toks.shape                  # B == 1
+        x = _embed_vocab_parallel(params["embed"], toks, ax, dt)
+        use_kernel = (not _interpret()) and _pick_blocks(T) is not None
+        if not use_kernel:
+            idx = jnp.arange(T, dtype=jnp.int32)
+            mask = ((seg[0][:, None] == seg[0][None, :])
+                    & (idx[:, None] >= idx[None, :]))[None, None, None]
+
+        def layer(carry, inp):
+            if q8:
+                bp, kp_l, vp_l, ks_l, vs_l = inp
+            else:
+                bp, kp_l, vp_l = inp
+                ks_l = vs_l = None
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, T, n_l, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, T, nkv_l, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, T, nkv_l, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            if with_hist:
+                kh = kp_l[hist_page, :, hist_slot]   # [T, nkv_l, d]
+                vh = vp_l[hist_page, :, hist_slot]
+                if q8:
+                    kh = (kh.astype(jnp.float32)
+                          * ks_l[hist_page, :, hist_slot][..., None])
+                    vh = (vh.astype(jnp.float32)
+                          * vs_l[hist_page, :, hist_slot][..., None])
+                sel = pool_hist[None, :, None, None]
+                k = jnp.where(sel, kh.astype(dt)[None], k)
+                v = jnp.where(sel, vh.astype(dt)[None], v)
+                sel2 = stream_hist[None, :, None, None]
+                k = jnp.where(sel2, k[:, stream_src], k)
+                v = jnp.where(sel2, v[:, stream_src], v)
+            if use_kernel:
+                attn = flash_attention_segmented(q, k, v, seg,
+                                                 causal=True)
+            else:
+                attn = _grouped_attn(q, k, v, mask)
+            o = _mm(attn.reshape(B, T, n_l * d), bp["wo"], dt)
+            xc = xc + jax.lax.psum(o, ax)             # row-parallel
+            res = xc
+            y2 = _rms_norm(xc, bp["ln2"], cfg.rms_norm_eps)
+            act = (jax.nn.silu(_mm(y2, bp["w_gate"], dt))
+                   * _mm(y2, bp["w_up"], dt))
+            ffn = _mm(act, bp["w_down"], dt)
+            return res + jax.lax.psum(ffn, ax), (k[0], v[0])
+
+        xs = (params["blocks"], kpool, vpool)
+        if q8:
+            xs = xs + (kscale, vscale)
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
+        return x, ks, vs
+
+    pool_spec = P(None, None, "mp", None, None)
+    scale_spec = P(None, None, "mp", None) if q8 else P()
+    run = jax.jit(shard_map(
+        run_local, mesh=mesh,
+        in_specs=(param_specs(cfg, pp=1), P(), P(), P(), pool_spec,
+                  pool_spec, scale_spec, scale_spec, P(), P(), P(),
+                  P(), P()),
+        out_specs=(P(), P(None, None, "mp", None),
+                   P(None, None, "mp", None)),
+        check_vma=False))
+    _packed_tp_cache[ckey] = run
+    return run
+
+
 _chunk_b_cache: dict = {}
 
 
@@ -1673,6 +1946,95 @@ def _prefill_chunk_batched(cfg: LlamaPretrainConfig):
         return x, ks, vs
 
     _chunk_b_cache[_cfg_key(cfg)] = run
+    return run
+
+
+_chunk_b_tp_cache: dict = {}
+
+
+def _prefill_chunk_batched_tp(cfg: LlamaPretrainConfig, mesh):
+    """TENSOR-PARALLEL batched prefill-with-history — the speculative
+    VERIFY program on a mesh, same signature as
+    :func:`_prefill_chunk_batched`.  One shard_map forward scores
+    every row's candidate block over the kv-head-SHARDED page pools:
+    per-row tables/positions/visibility are replicated host state,
+    the context gather and attention run on LOCAL heads, and wo /
+    w_down reduce with exact fp psums (verification must stay exact —
+    it is what makes speculative output provably the target model's
+    greedy sequence).  Returns replicated ``x [B, C, H]`` and
+    head-sharded ``ks``/``vs [Lyr, B, C, nkv, d]``."""
+    ckey = (_cfg_key(cfg), mesh)
+    hit = _chunk_b_tp_cache.get(ckey)
+    if hit is not None:
+        return hit
+    from jax.sharding import PartitionSpec as P
+    from .llama_pretrain import param_specs
+    from .decode import _grouped_attn
+
+    shard_map = _shard_map_fn()
+    mp = mesh.shape["mp"]
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    if n % mp or nkv % mp:
+        raise ValueError(f"heads {n}/{nkv} must divide over mp={mp}")
+    n_l, nkv_l = n // mp, nkv // mp
+    dt = cfg.dtype
+    ax = "mp"
+
+    def run_local(params, toks, kpool, vpool, tables, ctx_len):
+        B, C = toks.shape
+        Pg = tables.shape[1]
+        page = kpool.shape[3]
+        S_ctx = Pg * page
+        x = _embed_vocab_parallel(params["embed"], toks, ax, dt)
+        pos = ctx_len[:, None] + jnp.arange(C, dtype=jnp.int32)
+        ctx_vis = (jnp.arange(S_ctx, dtype=jnp.int32)[None]
+                   < ctx_len[:, None])
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(ctx_vis[:, None], (B, C, S_ctx)),
+             jnp.broadcast_to(jnp.tril(jnp.ones((C, C), bool))[None],
+                              (B, C, C))], axis=2)
+        mask = mask[:, None, None]
+
+        def gather_ctx(pool):
+            pages = pool[tables]      # [B, P, nkv_l, page, d]
+            return pages.transpose(0, 1, 3, 2, 4).reshape(
+                B, S_ctx, nkv_l, d).astype(dt)
+
+        def layer(carry, inp):
+            bp, kp_l, vp_l = inp
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, C, n_l, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, C, nkv_l, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, C, nkv_l, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            ck = jnp.concatenate([gather_ctx(kp_l), k], axis=1)
+            cv = jnp.concatenate([gather_ctx(vp_l), v], axis=1)
+            attn = _grouped_attn(q, ck, cv, mask)
+            o = _mm(attn.reshape(B, C, n_l * d), bp["wo"], dt)
+            xc = xc + jax.lax.psum(o, ax)
+            res = xc
+            y2 = _rms_norm(xc, bp["ln2"], cfg.rms_norm_eps)
+            act = (jax.nn.silu(_mm(y2, bp["w_gate"], dt))
+                   * _mm(y2, bp["w_up"], dt))
+            ffn = _mm(act, bp["w_down"], dt)
+            return res + jax.lax.psum(ffn, ax), (k, v)
+
+        x, (ks, vs) = jax.lax.scan(
+            layer, x, (params["blocks"], kpool, vpool))
+        return x, ks, vs
+
+    pool_spec = P(None, None, "mp", None, None)
+    run = jax.jit(shard_map(
+        run_local, mesh=mesh,
+        in_specs=(param_specs(cfg, pp=1), P(), pool_spec, pool_spec,
+                  P(), P()),
+        out_specs=(P(), P(None, None, None, "mp", None),
+                   P(None, None, None, "mp", None)),
+        check_vma=False))
+    _chunk_b_tp_cache[ckey] = run
     return run
 
 

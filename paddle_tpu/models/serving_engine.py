@@ -12,10 +12,12 @@ tables + lengths make rows independent, so a slot is just (table row,
 lens entry).  Admission packs every waiting prompt — mixed lengths,
 prefix-cache suffixes — into ONE token stream with segment ids and
 prefills it as a single segmented-flash program (the packed varlen
-lane; the per-bucket batched and per-chunk lanes remain for TP and as
-explicit fallbacks); the shared per-token step then advances every
-active slot.  Inactive slots carry ``lens = 0`` and attend nothing
-(the kernel visits zero pages).
+lane, single-device and TP alike — the sharded form composes through
+the same shard_map seam as the decode step; the per-bucket batched
+and per-chunk lanes remain as explicit fallbacks); the shared
+per-token step then advances every active slot.  Inactive slots
+carry ``lens = 0`` and attend nothing (the kernel visits zero
+pages).
 
 With a HOST PAGE TIER on the cache (``PagedKVCache(host_pages=N)``,
 models/kv_offload.py) preemption swaps the victim's pages to host RAM
@@ -43,10 +45,11 @@ from ..observability import (EngineMetrics, MetricsRegistry,
 from ..testing import faults
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
-                           _prefill_packed, _pick_token,
-                           make_paged_decode_step,
+                           _prefill_packed, _prefill_packed_tp,
+                           _pick_token, make_paged_decode_step,
                            make_paged_decode_step_async,
-                           make_paged_decode_step_tp)
+                           make_paged_decode_step_tp,
+                           tp_collective_bytes_per_step)
 
 __all__ = ["ContinuousBatchingEngine", "EngineDeadError",
            "EngineSupervisor", "QueueFullError", "Request"]
@@ -134,13 +137,29 @@ class ContinuousBatchingEngine:
                  max_queue_len: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
                  quarantine_faults: bool = True,
-                 max_consecutive_faults: int = 3):
+                 max_consecutive_faults: int = 3,
+                 tp_allreduce: str = "fp32"):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
         shard_map program (make_paged_decode_step_tp); prefill rides
         GSPMD over the same sharded params.  A model wider than one
-        chip serves through the identical engine API.
+        chip serves through the identical engine API — every lane:
+        packed admission stays one dispatch per wave (the packed
+        program composes through the same shard_map seam), the
+        dispatch-ahead overlap pipeline wraps the sharded step, and a
+        host page tier offloads the sharded pool per shard.
+
+        ``tp_allreduce="int8"`` (TP engines only, opt-in) swaps each
+        decode layer's two output all-reduces for a quantized ring
+        reduce-scatter/all-gather (int8 wire + per-block f32 scales,
+        EQuARX-style — ~25-31% of a 4-byte fp32 wire's bytes; vs a
+        bf16 compute dtype's 2-byte wire the saving halves) whose
+        ppermute hops are chunk-interleaved with the producing
+        matmuls (T3/FLUX latency hiding).  Greedy outputs then carry
+        quantization noise: held to a pinned statistical bar, not
+        token-exactness.  Prefill and the speculative verify always
+        reduce exact.
 
         ``overlap=True`` switches the decode hot loop to the
         DISPATCH-AHEAD pipeline: loop state (next token, lens, active
@@ -162,9 +181,9 @@ class ContinuousBatchingEngine:
         token stream with segment ids and prefills as exactly ONE
         jitted segmented-flash program per admission wave (compile
         count O(log total-token-buckets), padded-token waste only the
-        sub-bucket remainder).  TP engines (mp>1) fall back to the
-        batched per-bucket path for now; ``packed=False`` forces the
-        batched/chunked lanes everywhere."""
+        sub-bucket remainder), single-device and TP alike;
+        ``packed=False`` forces the batched/chunked lanes
+        everywhere."""
         self.cfg = cfg
         self.params = params
         self.cache = cache
@@ -192,10 +211,30 @@ class ContinuousBatchingEngine:
         # sublinearity contract (K same-bucket admits = ONE dispatch;
         # packed lane: ANY-mix wave = ONE dispatch)
         self.prefill_calls = 0
-        # PACKED VARLEN admission (single-device only: the packed
-        # program is not shard_mapped yet — TP rides the batched path)
-        self._packed = bool(packed) and (
-            mesh is None or mesh.shape.get("mp", 1) == 1)
+        # PACKED VARLEN admission — every mesh: the TP lane composes
+        # the packed program through the _build_tp_inner shard_map
+        # seam (_prefill_packed_tp), so an admission wave is ONE
+        # dispatch single-device and sharded alike
+        self._packed = bool(packed)
+        self._tp = mesh is not None and mesh.shape.get("mp", 1) > 1
+        # -- TP collectives (tp_allreduce="int8": quantized ring
+        # RS/AG on the decode layers' output reductions) -------------
+        if tp_allreduce not in ("fp32", "int8"):
+            raise ValueError("tp_allreduce must be 'fp32' or 'int8', "
+                             f"got {tp_allreduce!r}")
+        if tp_allreduce == "int8" and not self._tp:
+            raise ValueError(
+                "tp_allreduce='int8' quantizes the TP decode "
+                "collectives — it needs an mp>1 mesh (single-device "
+                "engines have no collectives to quantize)")
+        self.tp_allreduce = tp_allreduce
+        # analytic bytes one device sends in the per-layer output
+        # collectives of ONE decode dispatch (the
+        # tp_allreduce_bytes_total counter's increment; 0 off-mesh)
+        self._tp_bytes_step = tp_collective_bytes_per_step(
+            cfg, mesh.shape["mp"], tp_allreduce,
+            cache.tables.shape[0]) if self._tp else 0
+        self.tp_allreduce_bytes = 0
         # padding-waste accounting across ALL prefill lanes: dispatched
         # token slots vs slots that carried no real context token
         # (bucket/page padding) — bench.py's admission A/B reads these
@@ -237,9 +276,10 @@ class ContinuousBatchingEngine:
         # re-admission is a page restore + table rebuild with ZERO
         # prefill tokens — guarded by the bytes-vs-FLOPs cost model
         # below (recompute remains the fallback: host tier full, or a
-        # context cheap enough that re-prefilling beats the DMA)
-        self._offload = cache.host is not None and (
-            mesh is None or mesh.shape.get("mp", 1) == 1)
+        # context cheap enough that re-prefilling beats the DMA).
+        # TP meshes included: the host tier stages per shard
+        # (kv_offload.py) and restores through the sharded scatter.
+        self._offload = cache.host is not None
         self._swap_handles: Dict[int, int] = {}   # rid -> swap handle
         self.prefill_tokens_avoided = 0
         self.resumes_swapped = 0
@@ -282,7 +322,7 @@ class ContinuousBatchingEngine:
         if mesh is not None and mesh.shape.get("mp", 1) > 1:
             self._step = make_paged_decode_step_tp(
                 cfg, mesh, temperature, kv_quant=cache.kv_quant,
-                top_k=top_k, top_p=top_p)
+                top_k=top_k, top_p=top_p, tp_allreduce=tp_allreduce)
         else:
             self._step = make_paged_decode_step(
                 cfg, temperature, kv_quant=cache.kv_quant,
@@ -299,7 +339,8 @@ class ContinuousBatchingEngine:
         if self.overlap:
             self._step_async = make_paged_decode_step_async(
                 cfg, temperature, kv_quant=cache.kv_quant,
-                top_k=top_k, top_p=top_p, mesh=mesh)
+                top_k=top_k, top_p=top_p, mesh=mesh,
+                tp_allreduce=tp_allreduce)
         self._inflight: List[Dict] = []   # oldest-first undrained steps
         # active mask AT DISPATCH of the oldest undrained step (host
         # attributes drained tokens against it, then chains done masks)
@@ -755,7 +796,14 @@ class ContinuousBatchingEngine:
                     hist_slot[a:a + page] = np.arange(page)
                     pool_hist[a:a + page] = True
         q8 = self.cache.kv_quant == "int8"
-        run = _prefill_packed(self.cfg, q8, self.enable_prefix_caching)
+        if self._tp:
+            # same stream layout, composed through the shard_map
+            # seam: the wave stays ONE dispatch on the mesh
+            run = _prefill_packed_tp(self.cfg, self.mesh, q8,
+                                     self.enable_prefix_caching)
+        else:
+            run = _prefill_packed(self.cfg, q8,
+                                  self.enable_prefix_caching)
         dummy = jnp.zeros((1,), jnp.float32)
         faults.fire("prefill_dispatch")
         x, ks, vs = run(
@@ -1275,7 +1323,25 @@ class ContinuousBatchingEngine:
         self.decode_wall_s += dt
         if self.metrics is not None:
             self.metrics.decode_seconds.observe(dt)
+            if self._tp:
+                # host-observed wall of the collective-bearing TP
+                # decode round (single-device engines never record it)
+                self.metrics.tp_collective_seconds.observe(dt)
         return len(self._active)
+
+    def _count_tp_dispatch(self, n: int = 1,
+                           bytes_per: Optional[int] = None) -> None:
+        """Account one (or ``n``) TP decode dispatches' collective
+        traffic: the analytic per-dispatch bytes of the per-layer
+        output reductions (attention wo + FFN w_down) in the engine's
+        ``tp_allreduce`` mode.  No-op off-mesh."""
+        if not self._tp:
+            return
+        b = (self._tp_bytes_step if bytes_per is None else bytes_per) \
+            * n
+        self.tp_allreduce_bytes += b
+        if self.metrics is not None:
+            self.metrics.tp_allreduce_bytes.inc(b)
 
     def _ensure_or_preempt(self, new_tokens: int = 1,
                            aux_cache=None, aux_new: int = 0) -> None:
@@ -1355,6 +1421,7 @@ class ContinuousBatchingEngine:
                 tok, sub)
         cache.lens = cache.lens + self._active_mask
         self.decode_steps += 1
+        self._count_tp_dispatch()
         nxt = np.asarray(nxt)
         self.host_syncs += 1
         t0 = time.perf_counter() if self.metrics is not None else 0.0
@@ -1447,6 +1514,7 @@ class ContinuousBatchingEngine:
         d["active"], d["remaining"] = act2, rem2
         self._inflight.append({"nxt": nxt, "done": done})
         self.decode_steps += 1
+        self._count_tp_dispatch()
         if self.metrics is not None:
             self.metrics.decode_steps.inc()
         # advance the host lens mirror for the NEXT dispatch's

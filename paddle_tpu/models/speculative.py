@@ -37,7 +37,10 @@ import numpy as np
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            _prefill_chunk_batched,
-                           make_paged_decode_step)
+                           _prefill_chunk_batched_tp,
+                           make_paged_decode_step,
+                           make_paged_decode_step_tp,
+                           tp_collective_bytes_per_step)
 
 __all__ = ["generate_speculative", "SpeculativeEngine"]
 
@@ -196,6 +199,14 @@ class SpeculativeEngine(ContinuousBatchingEngine):
     the draft matrix is fetched once — 2 blocking host syncs per
     round (drafts, verify logits) instead of gamma+2.  Token-exact
     either way.
+
+    ``mesh`` (mp>1, inherited) runs draft AND verify on the same
+    sharded mesh: the draft cache must be built with the same
+    ``mesh`` (kv-head-sharded draft pool), drafting rides the TP
+    shard_map step, and verification rides the shard_map batched
+    prefill-with-history with exact fp reductions — so the committed
+    output remains provably the target model's greedy sequence even
+    when ``tp_allreduce="int8"`` quantizes the draft collectives.
     """
 
     def __init__(self, cfg, params, cache, draft_cfg, draft_params,
@@ -214,12 +225,21 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             raise ValueError(
                 f"gamma must be in [1, page-1], got {gamma}")
         mesh = kw.get("mesh")
-        if mesh is not None and mesh.shape.get("mp", 1) > 1:
-            raise NotImplementedError(
-                "speculative serving over a TP mesh: the draft step "
-                "and batched verify are single-device programs — "
-                "shard-map them before composing (serve TP models "
-                "through the plain ContinuousBatchingEngine)")
+        tp = mesh is not None and mesh.shape.get("mp", 1) > 1
+        if tp and draft_cache.mesh != mesh:
+            # the one REAL constraint of TP speculative serving:
+            # draft and target run the same mesh, so the draft pool
+            # must be kv-head-sharded over it exactly like the target
+            # pool (a single-device draft pool would make every draft
+            # dispatch reshard the pools across chips)
+            raise ValueError(
+                "TP speculative serving runs draft and verify on the "
+                "SAME mesh: build the draft PagedKVCache with "
+                "mesh=<the engine's mesh> (and init draft_params on "
+                "it).  Workaround if the draft model cannot shard "
+                "(e.g. indivisible heads): serve the target through "
+                "the plain ContinuousBatchingEngine(mesh=...) "
+                "without a draft.")
         super().__init__(cfg, params, cache, **kw)
         self.dcfg, self.dparams = draft_cfg, draft_params
         self.dcache = draft_cache
@@ -232,9 +252,28 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         self.adaptive_gamma = adaptive_gamma
         self.max_gamma = min(max_gamma, cache.page - 1)
         self._accept_ema = float(gamma)
-        self._dstep = make_paged_decode_step(draft_cfg,
-                                             temperature=0.0)
-        self._verify = _prefill_chunk_batched(cfg)
+        if tp:
+            # draft and verify on the SAME mesh: drafting rides the
+            # sharded per-token step (the draft inherits the engine's
+            # tp_allreduce — quantized draft collectives change only
+            # which tokens get PROPOSED; exact verification keeps the
+            # committed output the target's greedy sequence), and
+            # verify is the shard_map batched prefill-with-history
+            # (exact fp reductions — the acceptance rule must score
+            # with the target's true logits)
+            self._dstep = make_paged_decode_step_tp(
+                draft_cfg, mesh, temperature=0.0,
+                tp_allreduce=self.tp_allreduce)
+            self._verify = _prefill_chunk_batched_tp(cfg, mesh)
+            mp = mesh.shape["mp"]
+            self._tp_bytes_draft = tp_collective_bytes_per_step(
+                draft_cfg, mp, self.tp_allreduce, self.B)
+            self._tp_bytes_verify = tp_collective_bytes_per_step(
+                cfg, mp, "fp32", self.B * 2 * cache.page)
+        else:
+            self._dstep = make_paged_decode_step(draft_cfg,
+                                                 temperature=0.0)
+            self._verify = _prefill_chunk_batched(cfg)
         self._seq: Dict[int, list] = {}     # slot -> committed toks
         self._d_len = np.zeros(self.B, np.int64)
         self.spec_rounds = 0
@@ -383,6 +422,12 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         # ---- per-row acceptance + commit (host bookkeeping)
         self.decode_steps += 1
         self.spec_rounds += 1
+        if self._tp:
+            # collective-traffic accounting: gamma+1 draft dispatches
+            # (2 sync feeds + gamma-1 chained) in the engine's
+            # tp_allreduce mode, one exact-fp verify forward
+            self._count_tp_dispatch(gamma + 1, self._tp_bytes_draft)
+            self._count_tp_dispatch(1, self._tp_bytes_verify)
         self.spec_drafted += gamma * len(active)
         round_accepted = 0
         round_tokens = 0

@@ -146,6 +146,22 @@ class EngineMetrics:
             "dispatch; sum/decode_step_seconds.sum is the host "
             "overhead fraction)",
             buckets=_HOST_BUCKETS)
+        self.tp_allreduce_bytes = r.counter(
+            "paddle_tpu_engine_tp_allreduce_bytes_total",
+            "Analytic bytes one device sends in the per-layer output "
+            "collectives (attention wo + FFN w_down) of TP decode "
+            "dispatches — tp_allreduce='int8' moves ~25-31% of a "
+            "4-byte fp32 wire (~53-56% of a bf16 wire); embed psum "
+            "and the logits all-gather are mode-independent and "
+            "excluded")
+        self.tp_collective_seconds = r.histogram(
+            "paddle_tpu_engine_tp_collective_seconds",
+            "Host-observed wall time of one collective-bearing TP "
+            "decode round (recorded only by mp>1 engines; the "
+            "collectives themselves are fused into the dispatch, so "
+            "this is the round wall, comparable across "
+            "tp_allreduce modes)",
+            buckets=_STEP_BUCKETS)
         self.inflight_dispatches = r.gauge(
             "paddle_tpu_engine_inflight_dispatches_count",
             "Decode dispatches issued but not yet drained by the "

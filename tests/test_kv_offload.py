@@ -466,9 +466,11 @@ def test_ensure_capacity_bumps_tables_version_once():
     assert cache.tables_version == v0 + 1
 
 
-def test_host_tier_rejects_tensor_parallel_mesh():
-    """The host tier is single-device for now: a kv-head-sharded pool
-    must refuse it loudly at construction."""
+def test_host_tier_accepts_tensor_parallel_mesh():
+    """The host tier composes with a kv-head-sharded pool: staging is
+    per shard (kv_offload._split_shards) and a sharded swap
+    round-trips — construction must succeed and audit clean (the
+    deep sharded-offload coverage lives in tests/test_serving_tp.py)."""
     from paddle_tpu.models.llama_pretrain import build_mesh
 
     cfg = _cfg()
@@ -476,6 +478,9 @@ def test_host_tier_rejects_tensor_parallel_mesh():
         pytest.skip("needs 2 devices")
     mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=2,
                       devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="single-device"):
-        PagedKVCache(cfg, num_pages=8, pages_max=4, batch=2, page=16,
-                     mesh=mesh, host_pages=8)
+    cache = PagedKVCache(cfg, num_pages=8, pages_max=4, batch=2,
+                         page=16, mesh=mesh, host_pages=8)
+    cache.alloc_row(0, 20)
+    handle = cache.swap_out_row(0)
+    assert cache.swap_in_row(0, handle) == 20
+    cache.audit()

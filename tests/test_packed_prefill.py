@@ -310,10 +310,11 @@ def test_packed_wave_page_writes_are_one_scatter_dispatch(kv_quant):
             assert list(req.generated) == _solo_ref(cfg, params, p, 3)
 
 
-def test_packed_disabled_for_tp_mesh():
-    """TP engines (mp>1) fall back to the batched lane for now — the
-    packed program is not shard_mapped; the flag must switch off
-    silently rather than dispatch an unsharded program."""
+def test_packed_enabled_for_tp_mesh():
+    """TP engines (mp>1) now run the packed lane too — composed
+    through the shard_map seam (_prefill_packed_tp), so the flag must
+    stay ON for a mesh engine (deep TP-lane coverage lives in
+    tests/test_serving_tp.py)."""
     from paddle_tpu.models.llama_pretrain import build_mesh
 
     cfg = _cfg()
@@ -323,7 +324,7 @@ def test_packed_disabled_for_tp_mesh():
     cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
                          page=16, mesh=mesh)
     eng = ContinuousBatchingEngine(cfg, params, cache, mesh=mesh)
-    assert eng._packed is False
+    assert eng._packed is True
     eng1 = ContinuousBatchingEngine(
         cfg, _params(cfg),
         PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2, page=16))
