@@ -879,6 +879,244 @@ def _fault_recovery_line() -> dict:
     }
 
 
+def _fleet_line() -> dict:
+    """FLEET serving A/B (PR-8 tentpole): the same offered load runs
+    through 1 engine replica and an N-replica ``FleetRouter`` —
+    aggregate decode tok/s, p50/p99 TTFT, and the prefix-hit pages
+    with vs without prefix-aware routing (the affinity stage is what
+    keeps a fleet's two-tier caches warm); plus the same load with
+    ``replica_death`` injected every K replica-steps, reporting
+    recovered/total (failover + auto-replace keep accepted requests
+    alive).  ``value`` is the N-replica/1-replica aggregate
+    throughput ratio.  ``extra.soak`` is a short LOAD-SOAK window
+    (mixed lengths + cancels + deadlines + step faults + a replica
+    death + slow stalls): bounded RSS growth, first-half vs
+    second-half p99, zero silent drops, every replica's
+    ``PagedKVCache.audit()`` clean — the seed of the sustained-soak
+    bench ROADMAP item 5 calls for."""
+    import resource
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.fleet import FleetRouter
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_ring
+    from paddle_tpu.testing import faults
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, page, new = 8, 64, 48
+        num_pages, pages_max = 96, 8
+        n_replicas, n_groups, per_group = 3, 4, 6
+        prefix_len, tail_lens = 128, (16, 48, 96, 200)
+        death_every = 60
+        soak_waves, soak_per_wave, soak_new = 8, 6, 32
+        metric = "serving_fleet_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, page, new = 2, 16, 8
+        num_pages, pages_max = 64, 8
+        n_replicas, n_groups, per_group = 3, 3, 4
+        prefix_len, tail_lens = 16, (2, 6, 11, 18)
+        death_every = 10
+        soak_waves, soak_per_wave, soak_new = 6, 4, 10
+        metric = "serving_fleet_tiny_cpu_smoke_ab"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    # G prefix groups: shared page-aligned prefix + per-request tail —
+    # the workload prefix-affinity routing exists for
+    def make_prompts(r):
+        gs = [r.randint(1, cfg.vocab_size, (prefix_len,))
+              for _ in range(n_groups)]
+        out = []
+        for i in range(n_groups * per_group):
+            tail = r.randint(1, cfg.vocab_size,
+                             (tail_lens[i % len(tail_lens)],))
+            out.append(np.concatenate([gs[i % n_groups], tail]))
+        return out
+
+    prompts = make_prompts(rng)
+    # warmup twin: the SAME length mix (same compiles) but different
+    # tokens, so warming never pre-seeds the timed window's prefixes
+    warm_prompts = make_prompts(np.random.RandomState(1))
+
+    def factory():
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page)
+        return ContinuousBatchingEngine(
+            cfg, params, cache, metrics_registry=default_registry(),
+            metrics_ring=default_ring(), enable_prefix_caching=True)
+
+    def run(n, prefix_routing=True, death_k=None):
+        router = FleetRouter([factory] * n,
+                             prefix_routing=prefix_routing)
+        # warm every compile the timed window hits (the FULL length
+        # mix — per-arm queue depth changes which packed-bucket
+        # shapes admission waves take) without seeding its prefixes
+        for p in warm_prompts:
+            router.submit(p, max_new_tokens=2)
+        router.run_to_completion()
+        # per-replica baseline keyed on replace count: a replica
+        # rebuilt after a death starts a FRESH cache (prefix_hits=0),
+        # so its warmup baseline must not be subtracted
+        hits0 = {h.idx: (h.replaces, h.engine.cache.prefix_hits)
+                 for h in router._replicas}
+        fp = faults.install() if death_k else None
+        try:
+            if death_k:
+                fp.inject("replica_death",
+                          RuntimeError("bench replica death"),
+                          every=death_k)
+            t0 = time.perf_counter()
+            for p in prompts:
+                router.submit(p, max_new_tokens=new)
+            done = router.run_to_completion()
+            dt = time.perf_counter() - t0
+        finally:
+            if death_k:
+                faults.uninstall()
+        for h in router._replicas:
+            h.engine.cache.audit()
+        ok = [r for r in done if r.status == "ok"]
+        ttfts = sorted((r.t_first_token - r.t_submit) * 1000
+                       for r in ok if r.t_first_token)
+        pct = lambda q: round(  # noqa: E731
+            ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))], 2) \
+            if ttfts else 0.0
+        hits = sum(
+            h.engine.cache.prefix_hits
+            - (hits0[h.idx][1]
+               if h.replaces == hits0[h.idx][0] else 0)
+            for h in router._replicas)
+        offered = sum(len(p) // page for p in prompts)
+        return {
+            "replicas": n, "requests": len(done),
+            "recovered": len(ok),
+            "tok_per_s": round(
+                sum(len(r.generated) for r in ok) / dt, 1),
+            "ttft_p50_ms": pct(0.50), "ttft_p99_ms": pct(0.99),
+            "prefix_hit_pages": hits,
+            "prefix_hit_rate": round(hits / max(offered, 1), 4),
+            "routed": dict(router.routed),
+            "failovers": router.failovers,
+            "deaths": router.deaths, "replaces": router.replaces,
+        }
+
+    def soak():
+        """Short mixed soak: cancels + deadlines + step faults + one
+        replica death + slow stalls under continuous offered load."""
+        router = FleetRouter([factory] * n_replicas)
+        for p in warm_prompts:                      # warm compiles
+            router.submit(p, max_new_tokens=2)
+        router.run_to_completion()
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        submitted, cancelled = 0, 0
+        done = []
+        t0 = time.perf_counter()
+        fp = faults.install()
+        try:
+            fp.inject("step_dispatch",
+                      RuntimeError("soak step fault"), every=37)
+            fp.inject("replica_death",
+                      RuntimeError("soak replica death"), nth=29)
+            fp.inject("replica_slow", p=0.05, seed=11)
+            for w in range(soak_waves):
+                rids = []
+                for j in range(soak_per_wave):
+                    p = prompts[(w * soak_per_wave + j)
+                                % len(prompts)]
+                    kw = {}
+                    if j % 4 == 3:
+                        kw["deadline_s"] = 30.0
+                    rids.append(router.submit(
+                        p, max_new_tokens=soak_new, **kw))
+                    submitted += 1
+                if w % 2 == 1:
+                    router.cancel(rids[0])
+                    cancelled += 1
+                for _ in range(4):
+                    router.step()
+                done.extend(router.finished())
+            done.extend(router.run_to_completion())
+        finally:
+            faults.uninstall()
+        wall = time.perf_counter() - t0
+        for h in router._replicas:
+            h.engine.cache.audit()
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        ok = [r for r in done if r.status == "ok"]
+        lats = [(r.t_finish - r.t_submit) * 1000 for r in ok]
+        half = len(lats) // 2
+
+        def p99(xs):
+            xs = sorted(xs)
+            return round(xs[min(len(xs) - 1, int(0.99 * len(xs)))],
+                         2) if xs else 0.0
+
+        return {
+            "submitted": submitted, "finished": len(done),
+            "silent_drops": submitted - len(done),
+            "ok": len(ok), "cancelled_req": cancelled,
+            "statuses": {s: sum(1 for r in done if r.status == s)
+                         for s in {r.status for r in done}},
+            "wall_s": round(wall, 2),
+            "tok_per_s": round(
+                sum(len(r.generated) for r in ok) / wall, 1),
+            "p99_first_half_ms": p99(lats[:half]),
+            "p99_second_half_ms": p99(lats[half:]),
+            "rss_growth_mb": round((rss1 - rss0) / 1024.0, 1),
+            "deaths": router.deaths, "replaces": router.replaces,
+            "audit_ok": True,
+        }
+
+    single = run(1)
+    fleet = run(n_replicas, prefix_routing=True)
+    no_affinity = run(n_replicas, prefix_routing=False)
+    deaths = run(n_replicas, prefix_routing=True,
+                 death_k=death_every)
+    soaked = soak()
+    return {
+        "metric": metric,
+        "value": round(fleet["tok_per_s"]
+                       / max(single["tok_per_s"], 1e-9), 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {"platform": platform, "replicas": n_replicas,
+                  "batch_slots": batch,
+                  "requests": len(prompts),
+                  "prefix_groups": n_groups,
+                  "death_every_k_replica_steps": death_every,
+                  "single": single, "fleet": fleet,
+                  "fleet_no_prefix_routing": no_affinity,
+                  "fleet_replica_deaths": deaths,
+                  "recovered_under_deaths":
+                      f"{deaths['recovered']}/{deaths['requests']}",
+                  "soak": soaked},
+    }
+
+
 def _serving_tp_line() -> dict:
     """TENSOR-PARALLEL serving A/B on an mp mesh (PR-7 tentpole): the
     same mixed-length trace admits through the batched-under-TP and
@@ -1057,6 +1295,16 @@ def _snapshot_line() -> dict:
                           "paddle_tpu_engine_restarts_total"),
                       "requests_rejected_total": _cval(
                           "paddle_tpu_engine_requests_rejected_total"),
+                      # fleet tier (the serving_fleet_ab line's
+                      # routers publish process-wide)
+                      "fleet_failovers_total": _cval(
+                          "paddle_tpu_fleet_failovers_total"),
+                      "fleet_rejected_total": _cval(
+                          "paddle_tpu_fleet_rejected_total"),
+                      "fleet_replica_deaths_total": _cval(
+                          "paddle_tpu_fleet_replica_deaths_total"),
+                      "fleet_replica_replaces_total": _cval(
+                          "paddle_tpu_fleet_replica_replaces_total"),
                       "events": default_ring().recent(50)}}
 
 
@@ -1076,6 +1324,7 @@ def main() -> None:
         ("serving_preemption_offload_resume_ab", "x",
          _preemption_line),
         ("serving_fault_recovery", "ratio", _fault_recovery_line),
+        ("serving_fleet_ab", "x", _fleet_line),
     ]
 
     devs, err = _init_devices()

@@ -46,7 +46,7 @@ __all__ = ["Analyzer", "Finding", "Report", "Rule", "SourceModule",
 
 # the production modules tier-1 holds at zero unsuppressed findings
 DEFAULT_TARGETS = ("paddle_tpu/models", "paddle_tpu/inference",
-                   "paddle_tpu/observability")
+                   "paddle_tpu/observability", "paddle_tpu/fleet")
 
 
 def analyze_paths(paths: List[str],

@@ -53,6 +53,13 @@ SYNC_HOT_ROOTS: List[str] = [
     "ContinuousBatchingEngine._admit_swapped",
     "SpeculativeEngine._decode_once",
     "SpeculativeEngine._finish_admit",
+    # the fleet routing decision path (PR 8): a routing choice runs on
+    # the submit path under the router lock while replicas decode —
+    # a blocking host sync here would stall every handler thread, so
+    # the placement walk must stay pure host bookkeeping
+    "FleetRouter._submit_locked",
+    "FleetRouter._candidates_locked",
+    "FleetRouter._place_locked",
     "make_paged_decode_step_async",
     # the TP shard_map lanes (PR 7): the sharded step/prefill inner
     # fns and the quantized-collective builder must stay lint-clean
@@ -215,6 +222,44 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
     "testing.faults.FaultPlane": SharedStateSpec(
         lock="_lock",
         attrs=frozenset({"_rules", "counts", "fired"})),
+    # fleet router (PR 8): HTTP handler threads submit/cancel while
+    # the serving front's drive thread steps; the replica table,
+    # request table and routing stats all serialize on the router
+    # lock (the replica ENGINES inherit engine-thread-only semantics
+    # — they are only ever touched under this lock)
+    "fleet.router.FleetRouter": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_replicas", "_requests", "_pending",
+                         "_stream", "_finished", "_prefix_owner",
+                         "_next_rid", "routed", "failovers",
+                         "rejected", "deaths", "replaces",
+                         "route_errors"}),
+        locked_methods=frozenset({
+            "_submit_locked", "_candidates_locked", "_place_locked",
+            "_step_locked", "_on_death_locked", "_replace_locked",
+            "_flush_pending_locked", "_finish_synth_locked",
+            "_has_work_locked", "_accepting_locked",
+            "_states_locked", "_snapshot_locked",
+            "_update_gauges_locked"}),
+        note="public API takes _lock; every *_locked helper is a "
+             "documented called-with-lock-held contract"),
+    # fleet HTTP front: same discipline as GenerationServer (it IS
+    # GenerationServer's plumbing over the router)
+    "fleet.server.FleetServer": SharedStateSpec(
+        lock="_lock",
+        # _queues is inherited and only touched by GenerationServer's
+        # own methods (checked under ITS spec); the subclass body
+        # reaches _fatal and the proxies only
+        attrs=frozenset({"_fatal"}),
+        proxies=frozenset({"engine", "_engine", "_driver",
+                           "_supervisor"}),
+        locked_methods=frozenset({"_is_ready_locked",
+                                  "_health_locked", "_fleet_locked"}),
+        exempt_methods=frozenset({"engine", "_driver", "restarts",
+                                  "router", "start", "stop"}),
+        note="inherits GenerationServer's contract; fleet_state() "
+             "bounded-waits on _lock (the health_snapshot idiom) "
+             "before reaching the router through _fleet_locked"),
 }
 
 

@@ -7,7 +7,8 @@ Invocations (equivalent)::
     python -m paddle_tpu.analysis.cli [...]
 
 Default paths are the tier-1-pinned production modules
-(``paddle_tpu/models inference/ observability/``).  Exit status: 0
+(``paddle_tpu/models inference/ observability/ fleet/``).  Exit
+status: 0
 clean, 1 unsuppressed findings, 2 usage errors — suitable as a
 pre-commit hook (see README).
 

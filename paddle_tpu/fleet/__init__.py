@@ -1,0 +1,35 @@
+"""paddle_tpu.fleet — the replica router tier over N serving engines.
+
+Reference role: the Fleet distributed-serving surface PaddlePaddle
+ships over its single-device predictors (fleet_executor DistModel +
+the PaddleNLP multi-replica serving deployments) — rebuilt over the
+continuous-batching engine stack of PRs 1-7.  One engine is one
+chip's worth of traffic and a single point of failure; this package
+turns it into a servable SYSTEM:
+
+* :class:`FleetRouter` — owns N :class:`ReplicaHandle`\\ s (each an
+  engine behind a generalized ``EngineSupervisor`` lifecycle:
+  ``STARTING/READY/DEGRADED/DRAINING/DEAD``) and routes every request
+  with prefix-cache affinity first, least-loaded placement second.
+  Fleet-wide admission sheds at the router (one saturated replica
+  never 429s traffic another could take), and a request orphaned by a
+  replica death before its first streamed token transparently fails
+  over to a healthy replica with its rid/deadline intact.
+* :class:`FleetServer` — the HTTP front over the router: the existing
+  ``/generate[_stream]`` protocol plus aggregated ``/metrics`` /
+  ``/stats`` and a per-replica ``/fleet`` state endpoint, reusing
+  ``GenerationServer``'s handler plumbing.
+
+Every degradation path is driven by the deterministic fault plane
+(``paddle_tpu/testing/faults.py`` sites ``route_dispatch`` /
+``replica_death`` / ``replica_slow``) — chaos runs are reproducible
+tests, not hopes.  Failure semantics: docs/FAULT_TOLERANCE.md "Fleet
+failure-mode matrix"; metric catalogue: docs/OBSERVABILITY.md.
+"""
+
+from .router import (FleetRouter, ReplicaHandle,       # noqa: F401
+                     REPLICA_STATES)
+from .server import FleetServer                        # noqa: F401
+
+__all__ = ["FleetRouter", "ReplicaHandle", "FleetServer",
+           "REPLICA_STATES"]

@@ -1,0 +1,702 @@
+"""Replica router: lifecycle-managed fleet serving over N engines.
+
+The router is the fleet's control plane.  Each replica is one
+:class:`~paddle_tpu.models.serving_engine.ContinuousBatchingEngine`
+behind an :class:`~paddle_tpu.models.serving_engine.EngineSupervisor`
+(PR 5's crash recovery generalized to replica lifecycle), wrapped in a
+:class:`ReplicaHandle` carrying the fleet-level state machine::
+
+    STARTING -> READY <-> DEGRADED        (replica_slow stalls)
+                  |  \\-> DRAINING -> (drained) -> replace -> READY
+                  \\--> DEAD -> (auto_replace) -> READY
+
+Routing (``submit``):
+
+1. **prefix affinity** — the prompt's full pages hash to a key; the
+   replica that last served that key holds its KV pages in the
+   two-tier cache (PR 4), so routing there turns a re-prefill into a
+   prefix hit.  Tried first when the owner is READY.
+2. **least loaded** — otherwise the READY replica with the fewest
+   (active + queued) requests, ties broken by queued tokens, fed by
+   the same host-side counters the observability snapshots read.
+3. **fleet-wide admission** — a replica whose bounded queue refuses is
+   skipped, not surfaced: the router only raises ``QueueFullError``
+   when EVERY admitting replica refused, and the ``retry_after`` it
+   carries is the MIN over READY replicas' hints (the soonest any
+   capacity frees), so one saturated replica never 429s traffic
+   another could take.
+
+Failover (``step``): a replica death (escaped step exception,
+exhausted supervisor budget, injected ``replica_death`` fault) orphans
+the requests routed to it.  Those that have not streamed a token yet
+resubmit transparently to a healthy replica — same fleet rid, same
+deadline — and complete token-exact (greedy decode is placement
+independent); those mid-stream finish with ``status="error"`` so the
+client sees an honest 500, never a silent truncation.  Dead replicas
+rebuild from their factory (``auto_replace``), and ``drain()`` takes a
+replica out of rotation gracefully: admission stops, in-flight work
+finishes, then the replica restarts fresh.
+
+Thread safety: every public method serializes on ``_lock`` (the
+``lock-discipline`` analysis rule enforces it via the SHARED_STATE
+registry) — HTTP handler threads submit/cancel while the serving
+front's drive thread steps.  The replica engines themselves are only
+ever touched under that lock, preserving their engine-thread-only
+contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models.serving_engine import (EngineDeadError, EngineSupervisor,
+                                     QueueFullError, Request,
+                                     _drive_to_completion,
+                                     _release_engine_claims)
+from ..observability import FleetMetrics
+from ..testing import faults
+
+__all__ = ["FleetRouter", "ReplicaHandle", "REPLICA_STATES"]
+
+REPLICA_STATES = ("STARTING", "READY", "DEGRADED", "DRAINING", "DEAD")
+
+
+class ReplicaHandle:
+    """One engine replica owned by the router: a supervisor-wrapped
+    engine plus the fleet-level lifecycle state and the local→fleet
+    rid map.  All access runs under the router's lock — the handle
+    itself carries no synchronization."""
+
+    def __init__(self, idx: int, factory: Callable, *,
+                 max_restarts: int = 3, window_s: float = 60.0,
+                 backoff_s: float = 0.0):
+        self.idx = idx
+        self._factory = factory
+        self._sup_kw = dict(max_restarts=max_restarts,
+                            window_s=window_s, backoff_s=backoff_s)
+        self.state = "STARTING"
+        self.error: Optional[str] = None
+        self.deaths = 0
+        self.replaces = 0
+        self.drains = 0
+        self.slow_ticks = 0
+        # local engine rid -> fleet rid, for stream/finished remap;
+        # cleared on replace (a fresh engine starts a fresh rid space)
+        self.local_rids: Dict[int, int] = {}
+        self.supervisor = EngineSupervisor(factory, **self._sup_kw)
+        self.state = "READY"
+
+    @property
+    def engine(self):
+        return self.supervisor.engine
+
+    def load(self):
+        """Placement key: (requests on the replica, queued tokens) —
+        both host counters the engine already maintains."""
+        eng = self.supervisor.engine
+        return (len(eng._active) + len(eng._queue),
+                eng.queued_tokens())
+
+    @property
+    def admitting(self) -> bool:
+        """Routing eligibility: READY admits; DEGRADED only as a last
+        resort (handled by the router's candidate ordering);
+        DRAINING/DEAD never."""
+        return self.state in ("READY", "DEGRADED")
+
+    def kill(self, error: str) -> None:
+        """Mark the replica DEAD after an escaped failure, releasing
+        the engine's page/swap claims through the same seam
+        ``EngineSupervisor._restart`` uses so a shared cache audits
+        clean (the replica's requests are triaged by the router)."""
+        self.state = "DEAD"
+        self.error = error
+        self.deaths += 1
+        _release_engine_claims(self.supervisor.engine)
+        self.local_rids.clear()
+
+    def replace(self) -> None:
+        """Rebuild the replica from its factory (after a death, or at
+        the end of a drain): fresh supervisor, fresh engine, fresh
+        local rid space."""
+        self.state = "STARTING"
+        self.local_rids.clear()
+        self.supervisor = EngineSupervisor(self._factory,
+                                           **self._sup_kw)
+        self.replaces += 1
+        self.error = None
+        self.state = "READY"
+
+    def drain(self) -> None:
+        """Take the replica out of rotation: the supervisor refuses
+        new submissions while ``step()`` finishes in-flight work; the
+        router replaces it once ``drained``."""
+        self.supervisor.drain()
+        self.state = "DRAINING"
+        self.drains += 1
+
+    @property
+    def drained(self) -> bool:
+        return self.state == "DRAINING" and self.supervisor.drained
+
+
+@dataclass
+class _FleetRequest:
+    """Router-side bookkeeping for one accepted request: where it
+    lives now, how much the client has seen (the failover
+    eligibility test), and the fleet-level deadline."""
+    rid: int                          # fleet-wide rid (client-visible)
+    prompt: np.ndarray
+    max_new_tokens: int
+    stop_sequences: Optional[list]
+    deadline: float                   # absolute monotonic; 0.0 = none
+    t_submit: float
+    replica: int = -1                 # current replica idx (-1 pending)
+    local_rid: int = -1
+    streamed: int = 0                 # tokens drained to the fleet stream
+    failovers: int = 0
+    # router-level cancel mark: the engine-side mark dies with a dead
+    # replica, and a cancelled request must NEVER be revived by
+    # failover (the waiter expects its 499, and a disconnect-triggered
+    # cancel has no client left to generate for)
+    cancelled: bool = False
+
+
+class FleetRouter:
+    """In-process router over N engine replicas — drive it exactly
+    like an engine (``submit`` / ``step`` / ``finished`` /
+    ``drain_stream`` / ``cancel``), and it speaks the same ``Request``
+    results, so ``GenerationServer``'s drive loop (and
+    :class:`~paddle_tpu.fleet.FleetServer`) works unchanged.
+
+    ``factories``: one zero-arg engine factory per replica.  For an
+    aggregated ``/metrics``, build every engine against ONE shared
+    ``MetricsRegistry`` — the router then publishes its fleet
+    instruments to the same registry automatically.
+
+    ``prefix_routing=False`` disables the affinity stage (placement
+    becomes pure least-loaded — the bench A/B's control arm).
+    ``auto_replace=False`` leaves dead replicas down until
+    :meth:`replace` is called explicitly."""
+
+    def __init__(self, factories: Sequence[Callable], *,
+                 prefix_routing: bool = True,
+                 auto_replace: bool = True,
+                 max_restarts: int = 3,
+                 restart_window_s: float = 60.0,
+                 restart_backoff_s: float = 0.0,
+                 metrics_registry=None, metrics_ring=None):
+        if not factories:
+            raise ValueError("FleetRouter needs >= 1 replica factory")
+        self._lock = threading.Lock()
+        self.prefix_routing = bool(prefix_routing)
+        self.auto_replace = bool(auto_replace)
+        self._replicas: List[ReplicaHandle] = [
+            ReplicaHandle(i, f, max_restarts=max_restarts,
+                          window_s=restart_window_s,
+                          backoff_s=restart_backoff_s)
+            for i, f in enumerate(factories)]
+        self._page = int(self._replicas[0].engine.cache.page)
+        self._requests: Dict[int, _FleetRequest] = {}
+        self._pending: deque = deque()    # orphans awaiting re-placement
+        self._stream: List = []           # (fleet rid, token)
+        self._finished: List[Request] = []
+        self._prefix_owner: Dict[int, int] = {}   # prefix hash -> idx
+        self._prefix_cap = 4096
+        self._next_rid = 0
+        self._now = time.monotonic        # seam: tests pin the clock
+        # routing stats (plain counters — exact even with metrics off)
+        self.routed = {"prefix": 0, "least_loaded": 0, "failover": 0}
+        self.failovers = 0
+        self.rejected = 0
+        self.deaths = 0
+        self.replaces = 0
+        self.route_errors = 0             # route_dispatch candidate fails
+        if metrics_registry is False:
+            self.metrics = None
+        else:
+            if metrics_registry is None:
+                # share the replicas' registry when they have one, so
+                # /metrics on the fleet front is the aggregate view
+                for h in self._replicas:
+                    m = getattr(h.engine, "metrics", None)
+                    if m is not None:
+                        metrics_registry = m.registry
+                        if metrics_ring is None:
+                            metrics_ring = m.ring
+                        break
+            from ..observability import MetricsRegistry
+            self.metrics = FleetMetrics(
+                metrics_registry if metrics_registry is not None
+                else MetricsRegistry(), ring=metrics_ring)
+        self._update_gauges_locked()
+
+    # -- client side ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 64,
+               stop_sequences=None,
+               deadline_s: Optional[float] = None) -> int:
+        """Route + queue a request; returns the FLEET rid (stable
+        across failovers).  Raises ``ValueError`` for a request no
+        replica could ever hold (same validation as the engine) and
+        ``QueueFullError`` only when EVERY admitting replica refused —
+        carrying the aggregate ``retry_after`` (min over READY
+        replicas).  Thread safety: ``any-thread`` (serializes on the
+        router lock)."""
+        with self._lock:
+            return self._submit_locked(prompt, max_new_tokens,
+                                       stop_sequences, deadline_s)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a fleet request wherever it lives — on a replica
+        (retired at that engine's next flush point) or in the
+        failover pending queue (retired immediately).  False for
+        unknown/finished rids."""
+        with self._lock:
+            freq = self._requests.get(rid)
+            if freq is None:
+                return False
+            # mark at the ROUTER too: the engine-side mark lives in
+            # the replica and dies with it — a death between this
+            # cancel and its flush point must not fail the request
+            # over as if it were still wanted
+            freq.cancelled = True
+            if freq.replica >= 0:
+                return self._replicas[freq.replica].supervisor.cancel(
+                    freq.local_rid)
+            self._pending = deque(q for q in self._pending
+                                  if q is not freq)
+            self._finish_synth_locked(freq, "cancelled", None)
+            return True
+
+    def finished(self) -> List[Request]:
+        with self._lock:
+            out, self._finished = self._finished, []
+            return out
+
+    def drain_stream(self) -> List:
+        with self._lock:
+            out, self._stream = self._stream, []
+            return out
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return self._has_work_locked()
+
+    def accepting(self) -> bool:
+        """Readiness: at least one replica is admitting with queue
+        capacity (the serving front's ``/health/ready`` reads this)."""
+        with self._lock:
+            return self._accepting_locked()
+
+    def fleet_snapshot(self) -> dict:
+        """The ``/fleet`` document: per-replica lifecycle + load, and
+        the router's routing/degradation counters."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    # -- lifecycle verbs --------------------------------------------------
+    def drain(self, idx: int) -> None:
+        """Drain replica ``idx``: admission stops (routing steers
+        around it), in-flight work finishes, then the replica rebuilds
+        fresh and returns to READY — the zero-downtime restart verb."""
+        with self._lock:
+            h = self._replicas[idx]
+            h.drain()
+            if self.metrics is not None:
+                self.metrics.replica_drains.inc()
+                self.metrics.ring.emit("replica_drain", replica=idx)
+            self._update_gauges_locked()
+
+    def replace(self, idx: int) -> None:
+        """Rebuild replica ``idx`` from its factory immediately (the
+        manual form of ``auto_replace``)."""
+        with self._lock:
+            self._replace_locked(self._replicas[idx])
+
+    # -- engine-compatible drive loop -------------------------------------
+    def step(self) -> int:
+        """One fleet tick: replace dead/drained replicas, re-place
+        orphaned requests, step every serving replica (consulting the
+        ``replica_death`` / ``replica_slow`` fault sites), and merge
+        each replica's stream/finished into the fleet-level ones.
+        Returns the number of active requests fleet-wide."""
+        with self._lock:
+            return self._step_locked()
+
+    def run_to_completion(self, max_steps: int = 10_000):
+        return _drive_to_completion(self, max_steps)
+
+    # -- locked internals (CONTRACT: caller holds _lock; registered in
+    #    analysis/annotations.py locked_methods) --------------------------
+    def _submit_locked(self, prompt, max_new_tokens, stop_sequences,
+                       deadline_s) -> int:
+        prompt = np.asarray(prompt, np.int64)
+        now = self._now()
+        deadline = 0.0 if deadline_s is None \
+            else now + float(deadline_s)
+        freq = _FleetRequest(self._next_rid, prompt,
+                             int(max_new_tokens), stop_sequences,
+                             deadline, now)
+        # place BEFORE committing the rid: a rejected submit must not
+        # burn a fleet rid or leave a phantom request entry
+        self._place_locked(freq, failover=False)
+        self._next_rid += 1
+        self._requests[freq.rid] = freq
+        return freq.rid
+
+    def _candidates_locked(self, freq: _FleetRequest):
+        """Routing order: prefix owner first (READY only), then READY
+        by ascending load, then DEGRADED by load as a last resort.
+        Returns ``(candidates, prefix_hit_idx, prefix_key)`` — the
+        key is computed once here and reused by the placement (the
+        hash runs under the contended router lock)."""
+        ready = sorted((h for h in self._replicas
+                        if h.state == "READY"),
+                       key=lambda h: h.load())
+        degraded = sorted((h for h in self._replicas
+                           if h.state == "DEGRADED"),
+                          key=lambda h: h.load())
+        cands = ready + degraded
+        prefix_hit = None
+        key = self._prefix_key(freq.prompt) if self.prefix_routing \
+            else None
+        if key is not None:
+            owner = self._prefix_owner.get(key)
+            for h in cands:
+                if h.idx == owner and h.state == "READY":
+                    cands.remove(h)
+                    cands.insert(0, h)
+                    prefix_hit = h.idx
+                    break
+        return cands, prefix_hit, key
+
+    def _place_locked(self, freq: _FleetRequest,
+                      failover: bool) -> None:
+        """Hand ``freq`` to the best available replica; raises when no
+        replica took it (``QueueFullError`` with the aggregate
+        ``retry_after`` when every refusal was backpressure)."""
+        cands, prefix_hit, key = self._candidates_locked(freq)
+        if not cands:
+            raise EngineDeadError(
+                f"no replica available: {self._states_locked()}")
+        now = self._now()
+        deadline_s = None if freq.deadline == 0.0 \
+            else max(freq.deadline - now, 1e-6)
+        queue_full = False
+        last_exc: Optional[BaseException] = None
+        for h in cands:
+            if h.engine.queue_capacity_reason(
+                    len(freq.prompt)) is not None:
+                # side-effect-free capacity probe: a full replica is
+                # a ROUTING event, and charging its engine's
+                # requests_rejected counter (what submit()'s reject
+                # path does) would pollute the aggregated /metrics
+                # with rejections no client ever saw
+                queue_full = True
+                continue
+            try:
+                faults.fire("route_dispatch")
+                local = h.supervisor.submit(
+                    freq.prompt, max_new_tokens=freq.max_new_tokens,
+                    stop_sequences=freq.stop_sequences,
+                    deadline_s=deadline_s)
+            except ValueError:
+                # the request itself is malformed/oversized — every
+                # replica would refuse identically; the client's fault
+                raise
+            except QueueFullError as e:
+                queue_full = True
+                last_exc = e
+                continue
+            except Exception as e:
+                # route_dispatch fault / replica refused the handoff:
+                # steer to the next candidate
+                self.route_errors += 1
+                last_exc = e
+                continue
+            h.local_rids[local] = freq.rid
+            freq.replica, freq.local_rid = h.idx, local
+            reason = ("failover" if failover
+                      else "prefix" if prefix_hit == h.idx
+                      else "least_loaded")
+            self.routed[reason] += 1
+            if key is not None:
+                # this replica now holds the prefix's pages
+                self._prefix_owner[key] = h.idx
+                while len(self._prefix_owner) > self._prefix_cap:
+                    self._prefix_owner.pop(
+                        next(iter(self._prefix_owner)))
+            if self.metrics is not None:
+                m = self.metrics
+                {"prefix": m.routed_prefix,
+                 "least_loaded": m.routed_least_loaded,
+                 "failover": m.routed_failover}[reason].inc()
+            return
+        if queue_full:
+            # FLEET-WIDE admission verdict: every admitting replica's
+            # bounded queue refused.  Retry-After is the MIN over
+            # READY replicas — the soonest ANY capacity frees — so the
+            # client backs off no longer than the healthiest replica
+            # needs (a single saturated replica never dictates it).
+            ready = [h for h in self._replicas if h.state == "READY"]
+            agg = min((h.engine.retry_after_s()
+                       for h in (ready or cands)), default=1.0)
+            if not failover:
+                # rejection accounting counts CLIENT-visible 429s
+                # only — a failover re-placement retry swallows this
+                # exception and keeps the orphan pending, so counting
+                # it would inflate the counter once per idle tick
+                self.rejected += 1
+                if self.metrics is not None:
+                    self.metrics.rejected.inc()
+                    self.metrics.ring.emit(
+                        "fleet_rejected", replicas=len(cands),
+                        retry_after=agg)
+            raise QueueFullError(
+                f"fleet saturated: all {len(cands)} admitting "
+                f"replicas rejected", retry_after=agg)
+        raise last_exc if last_exc is not None else EngineDeadError(
+            f"no replica accepted: {self._states_locked()}")
+
+    def _step_locked(self) -> int:
+        now = self._now()
+        # 1. lifecycle: revive the dead, finish completed drains
+        for h in self._replicas:
+            if h.state == "DEAD" and self.auto_replace:
+                self._replace_locked(h)
+            elif h.drained:
+                self._replace_locked(h)
+        # 2. re-place orphans (failover) before stepping: they re-enter
+        # FIFO so a crash costs one tick of queue position, not more
+        self._flush_pending_locked(now)
+        # 3. step every serving replica, then merge its outputs
+        active = 0
+        for h in self._replicas:
+            if h.state == "DEAD":
+                continue
+            if faults.active("replica_slow"):
+                # the replica stalls this tick (no step) and routing
+                # deprioritizes it until the stall clears
+                if h.state == "READY":
+                    h.state = "DEGRADED"
+                h.slow_ticks += 1
+                continue
+            if h.state == "DEGRADED":
+                h.state = "READY"
+            if not h.supervisor.has_work():
+                continue
+            try:
+                faults.fire("replica_death")
+                h.supervisor.step()
+            except Exception as exc:
+                self._on_death_locked(h, exc)
+                continue
+            for local, tok in h.supervisor.drain_stream():
+                rid = h.local_rids.get(local)
+                if rid is None:
+                    continue          # request already triaged away
+                freq = self._requests.get(rid)
+                if freq is not None:
+                    freq.streamed += 1
+                self._stream.append((rid, tok))
+            for req in h.supervisor.finished():
+                rid = h.local_rids.pop(req.rid, None)
+                if rid is None:
+                    continue
+                freq = self._requests.pop(rid, None)
+                req.rid = rid         # surface the FLEET rid
+                if freq is not None:
+                    # a failed-over request was re-submitted later:
+                    # latency fields must measure from the CLIENT's
+                    # submission, not the re-placement
+                    req.t_submit = freq.t_submit
+                self._finished.append(req)
+            active += len(h.engine._active)
+        # a drain that completed THIS tick replaces immediately — the
+        # fleet may go idle right here, and an idle fleet is never
+        # stepped again until new work arrives
+        for h in self._replicas:
+            if h.drained:
+                self._replace_locked(h)
+        self._update_gauges_locked()
+        return active
+
+    def _on_death_locked(self, h: ReplicaHandle,
+                         exc: BaseException) -> None:
+        """Triage a replica death: orphans that streamed nothing
+        fail over (transparent resubmission, same rid/deadline);
+        mid-stream ones finish with an explicit error status.  The
+        replica goes DEAD and — with ``auto_replace`` — rebuilds on
+        the next step."""
+        text = (f"replica {h.idx} died: "
+                f"{type(exc).__name__}: {exc}")
+        self.deaths += 1
+        orphans = list(h.local_rids.values())
+        h.kill(text)
+        n_failover = 0
+        for rid in orphans:
+            freq = self._requests.get(rid)
+            if freq is None:
+                continue
+            freq.replica, freq.local_rid = -1, -1
+            if freq.cancelled:
+                # the client already let go — honour the cancel the
+                # dead engine never got to flush, don't regenerate
+                self._finish_synth_locked(freq, "cancelled", None)
+            elif freq.streamed == 0:
+                freq.failovers += 1
+                self.failovers += 1
+                n_failover += 1
+                self._pending.append(freq)
+            else:
+                self._finish_synth_locked(freq, "error", text)
+        if self.metrics is not None:
+            m = self.metrics
+            m.replica_deaths.inc()
+            m.failovers.inc(n_failover)
+            m.ring.emit("replica_death", replica=h.idx, error=text,
+                        failovers=n_failover,
+                        errored=len(orphans) - n_failover)
+
+    def _replace_locked(self, h: ReplicaHandle) -> None:
+        h.replace()
+        # the rebuilt replica's cache is COLD: prefix keys it owned
+        # must not keep steering traffic to it (and counting those
+        # placements as prefix hits) over less-loaded siblings
+        self._prefix_owner = {k: v for k, v
+                              in self._prefix_owner.items()
+                              if v != h.idx}
+        self.replaces += 1
+        if self.metrics is not None:
+            self.metrics.replica_replaces.inc()
+            self.metrics.ring.emit("replica_replace", replica=h.idx)
+
+    def _flush_pending_locked(self, now: float) -> None:
+        """Try to re-place every orphaned request.  Backpressure keeps
+        it pending (an ACCEPTED request is never 429'd); a dead fleet
+        with auto-replace waits for the revival; anything else fails
+        loudly with an error status — never a silent drop."""
+        keep: deque = deque()
+        while self._pending:
+            freq = self._pending.popleft()
+            if freq.cancelled:
+                self._finish_synth_locked(freq, "cancelled", None)
+                continue
+            if freq.deadline and now >= freq.deadline:
+                self._finish_synth_locked(freq, "expired", None)
+                continue
+            try:
+                self._place_locked(freq, failover=True)
+            except QueueFullError:
+                keep.append(freq)
+            except EngineDeadError as e:
+                if self.auto_replace:
+                    keep.append(freq)
+                else:
+                    self._finish_synth_locked(freq, "error", str(e))
+            except Exception as e:
+                self._finish_synth_locked(
+                    freq, "error",
+                    f"failover placement failed: "
+                    f"{type(e).__name__}: {e}")
+        self._pending = keep
+
+    def _finish_synth_locked(self, freq: _FleetRequest, status: str,
+                             error: Optional[str]) -> None:
+        """Terminal message for a request no engine owns anymore
+        (orphan expired/cancelled while pending, replica death
+        mid-stream): the client ALWAYS gets a status."""
+        self._requests.pop(freq.rid, None)
+        req = Request(freq.rid, freq.prompt, freq.max_new_tokens,
+                      stop_sequences=freq.stop_sequences,
+                      t_submit=freq.t_submit)
+        req.done = True
+        req.status = status
+        req.error = error
+        req.t_finish = self._now()
+        self._finished.append(req)
+
+    def _has_work_locked(self) -> bool:
+        # undelivered TERMINAL messages count as work: a cancel() can
+        # synthesize a finished result OUTSIDE step() while the fleet
+        # is otherwise idle, and drive loops only drain finished()
+        # when has_work() says so — reporting False would strand the
+        # waiter's 499 forever.  (_stream deliberately does NOT
+        # count: drivers that never drain it — run_to_completion —
+        # must still terminate, and a stream tail without its
+        # terminal message has no blocked waiter to unblock.)
+        if self._pending or self._finished:
+            return True
+        return any(h.state != "DEAD" and h.supervisor.has_work()
+                   for h in self._replicas)
+
+    def _accepting_locked(self) -> bool:
+        return any(h.admitting and
+                   h.engine.queue_capacity_reason() is None
+                   for h in self._replicas)
+
+    def _states_locked(self) -> dict:
+        out = {s: 0 for s in REPLICA_STATES}
+        for h in self._replicas:
+            out[h.state] += 1
+        return out
+
+    def _snapshot_locked(self) -> dict:
+        reps = []
+        for h in self._replicas:
+            eng = h.engine
+            reps.append({
+                "idx": h.idx, "state": h.state,
+                "active": len(eng._active),
+                "queued": len(eng._queue),
+                "queued_tokens": eng.queued_tokens(),
+                "occupancy": round(len(eng._active) / eng.B, 4),
+                "decode_steps": eng.decode_steps,
+                "tokens_generated": eng.tokens_generated,
+                "requests_finished": eng.requests_finished,
+                "prefix_hit_pages": eng.cache.prefix_hits,
+                "retry_after_s": round(eng.retry_after_s(), 3),
+                "restarts": h.supervisor.restarts,
+                "deaths": h.deaths, "replaces": h.replaces,
+                "drains": h.drains, "slow_ticks": h.slow_ticks,
+                "error": h.error,
+            })
+        return {"replicas": reps,
+                "states": self._states_locked(),
+                "routed": dict(self.routed),
+                "failovers": self.failovers,
+                "rejected": self.rejected,
+                "deaths": self.deaths,
+                "replaces": self.replaces,
+                "route_errors": self.route_errors,
+                "pending_failovers": len(self._pending),
+                "requests_live": len(self._requests)}
+
+    def _update_gauges_locked(self) -> None:
+        if self.metrics is None:
+            return
+        states = self._states_locked()
+        m = self.metrics
+        m.replicas.set(len(self._replicas))
+        m.replicas_ready.set(states["READY"])
+        m.replicas_degraded.set(states["DEGRADED"])
+        m.replicas_draining.set(states["DRAINING"])
+        m.replicas_dead.set(states["DEAD"])
+        m.pending_failovers.set(len(self._pending))
+
+    def _prefix_key(self, prompt: np.ndarray) -> Optional[int]:
+        """Affinity key: the prompt's FULL pages (what the prefix
+        cache can actually reuse).  Shorter-than-a-page prompts have
+        no reusable prefix and route by load."""
+        full = (len(prompt) // self._page) * self._page
+        if full == 0:
+            return None
+        return zlib.crc32(np.ascontiguousarray(
+            prompt[:full]).tobytes())

@@ -1,0 +1,127 @@
+"""HTTP front for the fleet: ``GenerationServer`` plumbing over a
+:class:`~paddle_tpu.fleet.router.FleetRouter`.
+
+The router speaks the engine's drive surface (``submit`` / ``step`` /
+``finished`` / ``drain_stream`` / ``cancel``), so the whole HTTP
+stack — ``/generate``, ``/generate_stream``, ``/cancel``, the token
+fan-out drive loop, backpressure's 429 + ``Retry-After`` (now the
+FLEET-AGGREGATE hint the router computes), deadline 504s, disconnect
+cancellation — is inherited unchanged from
+:class:`~paddle_tpu.inference.serving.GenerationServer`.  This module
+only overrides what is fleet-shaped:
+
+* ``/fleet`` — per-replica lifecycle + load + the routing counters
+  (the document :meth:`FleetRouter.fleet_snapshot` builds);
+* ``/health`` — fleet health: live/ready plus the same snapshot;
+* ``/health/ready`` — true while ANY replica is admitting with queue
+  capacity (a draining or dead replica pulls only itself out of
+  rotation, never the fleet);
+* ``/metrics`` / ``/stats`` — the shared registry the replicas and
+  the router publish to, i.e. the AGGREGATED fleet exposition.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+
+from ..inference.serving import GenerationServer, _GenHandler
+from .router import FleetRouter
+
+__all__ = ["FleetServer"]
+
+
+class _FleetHandler(_GenHandler):
+    server_version = "paddle_tpu-fleetserving/0.1"
+
+    def do_GET(self):
+        srv: "FleetServer" = self.server.owner
+        path = urllib.parse.urlsplit(self.path).path.rstrip("/")
+        if path == "/fleet":
+            self._reply(200, json.dumps(srv.fleet_state()).encode())
+            return
+        _GenHandler.do_GET(self)
+
+
+class FleetServer(GenerationServer):
+    """Continuous-batching LLM serving over HTTP across N engine
+    replicas: the :class:`~paddle_tpu.fleet.router.FleetRouter` is the
+    drive target, so requests arriving concurrently route with
+    prefix-cache affinity, shed only when the whole fleet is
+    saturated, and survive replica deaths via transparent failover
+    (docs/FAULT_TOLERANCE.md, "Fleet failure-mode matrix").
+
+    >>> router = FleetRouter([factory] * 3)
+    >>> srv = FleetServer(router)
+    >>> port = srv.start()
+    >>> # ... generate_http / generate_http_stream as usual ...
+    >>> srv.stop()
+    """
+
+    handler_class = _FleetHandler
+
+    def __init__(self, router: FleetRouter,
+                 host: str = "127.0.0.1", port: int = 0,
+                 poll_s: float = 0.002):
+        # the router rides the caller-built-engine seam: every piece
+        # of GenerationServer's plumbing (lock, per-rid queues, drive
+        # loop, observability wiring off router.metrics) applies as-is
+        super().__init__(engine=router, host=host, port=port,
+                         poll_s=poll_s)
+        # last /fleet document + build instant (atomic ref publish,
+        # the _health_last idiom): bounded-wait scrapes serve it
+        # while the drive thread holds the lock
+        self._fleet_last = None
+
+    @property
+    def router(self) -> FleetRouter:
+        return self._engine
+
+    def fleet_state(self) -> dict:
+        """The ``/fleet`` document.  Same bounded-wait contract as
+        ``/health``: a scrape waits at most ``_READY_PROBE_WAIT_S``
+        for the server lock and then serves the last document built
+        under it, tagged with ``stale_s`` — the monitoring plane must
+        not black out behind a JIT-compiling step (the very first
+        scrape has no prior document and does wait)."""
+        if not self._lock.acquire(timeout=self._READY_PROBE_WAIT_S):
+            last = self._fleet_last
+            if last is not None:
+                doc, built_t = last
+                stale = dict(doc)
+                stale["stale_s"] = round(time.monotonic() - built_t,
+                                         3)
+                return stale
+            self._lock.acquire()  # first scrape: wait for a real one
+        try:
+            doc = self._fleet_locked()
+        finally:
+            self._lock.release()
+        self._fleet_last = (doc, time.monotonic())
+        return doc
+
+    def _fleet_locked(self) -> dict:
+        """Router-snapshot body; CONTRACT: caller holds ``_lock``
+        (registered in analysis/annotations.py ``locked_methods``)."""
+        return self._engine.fleet_snapshot()
+
+    def _is_ready_locked(self) -> bool:
+        """Fleet readiness; CONTRACT: caller holds ``_lock``
+        (registered in analysis/annotations.py ``locked_methods``).
+        Ready while any replica admits with capacity — a single
+        draining/dead/saturated replica is the router's problem, not
+        the client's."""
+        if not self.is_live() or self._fatal is not None:
+            return False
+        return self._engine.accepting()
+
+    def _health_locked(self):
+        """Fleet ``/health`` document; CONTRACT: caller holds
+        ``_lock``.  Returns ``(doc, None)`` — the fleet snapshot IS
+        the document, no separate registry-backed build."""
+        return ({"status": "ok" if self._fatal is None else "failed",
+                 "error": self._fatal,
+                 "live": self.is_live(),
+                 "ready": self._is_ready_locked(),
+                 "fleet": self._engine.fleet_snapshot()}, None)

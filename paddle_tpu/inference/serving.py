@@ -467,8 +467,15 @@ class GenerationServer:
     Reference analog: the dynamic-batching inference servers the
     reference's block_multihead_attention op exists for, and — with
     ``mesh`` — fleet_executor DistModel multi-device serving
-    (fluid/distributed/fleet_executor/dist_model.h:57).
+    (fluid/distributed/fleet_executor/dist_model.h:57).  The
+    multi-replica form is :class:`paddle_tpu.fleet.FleetServer`,
+    which reuses this class's handler plumbing over a
+    :class:`~paddle_tpu.fleet.FleetRouter`.
     """
+
+    # the request handler the HTTP listener serves; subclasses
+    # (FleetServer) extend it with extra endpoints
+    handler_class = _GenHandler
 
     def __init__(self, cfg=None, params=None, cache=None, mesh=None,
                  host: str = "127.0.0.1", port: int = 0,
@@ -602,14 +609,15 @@ class GenerationServer:
         (registered in analysis/annotations.py ``locked_methods``)."""
         if not self.is_live() or self._fatal is not None:
             return False
-        eng = self.engine
-        if eng.max_queue_len is not None and \
-                len(eng._queue) >= eng.max_queue_len:
+        if self._supervisor is not None and \
+                self._supervisor.state != "READY":
+            # DRAINING: deliberately refusing new work while in-flight
+            # requests finish — probes must pull the node out of
+            # rotation (route elsewhere), not restart it
             return False
-        if eng.max_queued_tokens is not None and \
-                eng.queued_tokens() >= eng.max_queued_tokens:
-            return False
-        return True
+        # the ONE admission-capacity predicate submit() also uses —
+        # readiness can never disagree with what submit() accepts
+        return self.engine.queue_capacity_reason() is None
 
     def health_snapshot(self) -> dict:
         """The ``/health`` document — the one accessor HTTP handler
@@ -854,7 +862,7 @@ class GenerationServer:
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer((self._host, self._port),
-                                          _GenHandler)
+                                          self.handler_class)
         self._httpd.owner = self
         for target in (self._httpd.serve_forever, self._drive):
             t = threading.Thread(target=target, daemon=True)

@@ -73,6 +73,30 @@ class EngineDeadError(RuntimeError):
     fail pending requests loudly instead of retrying forever."""
 
 
+def _release_engine_claims(engine) -> None:
+    """Best-effort release of EVERY page/swap claim a dead engine
+    holds — each slot off the free list (active rows AND rows
+    stranded mid-admission by a fatal step) and every parked swap
+    record — so a cache that outlives the engine starts from clean
+    page accounting (verified by ``PagedKVCache.audit()`` in tests).
+    Shared by :class:`EngineSupervisor`'s restart and the fleet
+    router's replica-death path: the claim-release rules must never
+    diverge between them."""
+    for slot in range(engine.B):
+        if slot in engine._free_slots:
+            continue
+        try:
+            engine.cache.release_row(slot)
+        except Exception:
+            pass
+    for handle in list(engine._swap_handles.values()):
+        try:
+            engine.cache.discard_swap(handle)
+        except Exception:
+            pass
+    engine._swap_handles.clear()
+
+
 def _drive_to_completion(driver, max_steps: int):
     """Step ``driver`` (an engine or a supervisor) until its queue
     drains; returns all finished requests in completion order."""
@@ -422,17 +446,9 @@ class ContinuousBatchingEngine:
                         "each stop sequence must be a NON-EMPTY list "
                         f"of token ids, got {q!r}")
                 stops.append([int(t) for t in q])
-        if self.max_queue_len is not None and \
-                len(self._queue) >= self.max_queue_len:
-            self._reject(f"admission queue full: {len(self._queue)} "
-                         f"waiting >= max_queue_len "
-                         f"{self.max_queue_len}")
-        if self.max_queued_tokens is not None:
-            waiting = self.queued_tokens()
-            if waiting + len(prompt) > self.max_queued_tokens:
-                self._reject(
-                    f"queued tokens {waiting} + prompt {len(prompt)} "
-                    f"> max_queued_tokens {self.max_queued_tokens}")
+        why = self.queue_capacity_reason(len(prompt))
+        if why is not None:
+            self._reject(why)
         deadline = 0.0
         if deadline_s is not None:
             deadline = self._now() + float(deadline_s)
@@ -485,6 +501,31 @@ class ContinuousBatchingEngine:
         ``analysis/annotations.py THREAD_SAFETY``)."""
         return sum(len(r.prompt) + len(r.generated)
                    for r in tuple(self._queue))
+
+    def queue_capacity_reason(
+            self, prompt_len: int = 0) -> Optional[str]:
+        """Why the bounded admission queue would refuse a submission
+        right now, or ``None`` while capacity remains — the ONE
+        predicate behind ``submit()``'s backpressure, the serving
+        front's ``/health/ready``, and the fleet router's
+        ``accepting()``, so readiness can never disagree with what
+        ``submit()`` actually accepts.  ``prompt_len=0`` asks the
+        readiness form: would a minimal (1-token) prompt risk
+        refusal.  Thread safety: ``external-lock``, like
+        :meth:`submit` (see ``analysis/annotations.py
+        THREAD_SAFETY``)."""
+        if self.max_queue_len is not None and \
+                len(self._queue) >= self.max_queue_len:
+            return (f"admission queue full: {len(self._queue)} "
+                    f"waiting >= max_queue_len {self.max_queue_len}")
+        if self.max_queued_tokens is not None:
+            waiting = self.queued_tokens()
+            need = max(int(prompt_len), 1)
+            if waiting + need > self.max_queued_tokens:
+                return (f"queued tokens {waiting} + prompt {need} "
+                        f"> max_queued_tokens "
+                        f"{self.max_queued_tokens}")
+        return None
 
     def retry_after_s(self) -> float:
         """Finite back-off hint for a rejected client: the queue's
@@ -1616,6 +1657,13 @@ class EngineSupervisor:
     :class:`EngineDeadError` raises and the serving front fails
     pending requests loudly.
 
+    Lifecycle: ``state`` reports ``READY`` / ``DRAINING`` / ``DEAD``;
+    :meth:`drain` stops admission while in-flight work finishes
+    (``drained`` flips True, readiness probes report false so traffic
+    routes elsewhere) and :meth:`resume` re-opens it.  The fleet
+    router (``paddle_tpu/fleet``) drives these verbs per replica and
+    steers around every non-READY state.
+
     ``factory()`` must return a fresh engine; if it reuses a cache
     object, the supervisor best-effort releases the dead engine's rows
     and swap records first so page accounting starts clean (verified
@@ -1630,9 +1678,51 @@ class EngineSupervisor:
         self.backoff_s = float(backoff_s)
         self.restarts = 0
         self._restart_times: deque = deque()
+        self._draining = False
+        self._dead = False
+
+    # -- lifecycle (the fleet router's replica verbs; serving fronts
+    #    read `state` for readiness) --------------------------------------
+    @property
+    def state(self) -> str:
+        """``READY`` (serving), ``DRAINING`` (finishing in-flight
+        work, refusing new submissions — readiness probes report
+        false so load balancers pull the node out of rotation), or
+        ``DEAD`` (restart budget exhausted; only a rebuild/replace
+        helps)."""
+        if self._dead:
+            return "DEAD"
+        if self._draining:
+            return "DRAINING"
+        return "READY"
+
+    def drain(self) -> None:
+        """Stop admitting: ``submit()`` raises while ``step()`` keeps
+        finishing queued + active work.  ``drained`` turns True once
+        nothing is left — the caller then restarts/replaces the engine
+        (a fleet router does) or :meth:`resume`\\ s admission."""
+        self._draining = True
+
+    def resume(self) -> None:
+        """Re-open admission after a :meth:`drain` (maintenance done
+        without a rebuild)."""
+        self._draining = False
+
+    @property
+    def drained(self) -> bool:
+        """True once a drain has finished its in-flight work."""
+        return self._draining and not self.engine.has_work()
 
     # -- engine API passthrough (the serving front drives these) ----------
     def submit(self, *a, **kw) -> int:
+        if self._dead:
+            raise EngineDeadError(
+                "engine dead: restart budget exhausted")
+        if self._draining:
+            raise RuntimeError(
+                "engine draining: not admitting new requests (the "
+                "in-flight work is finishing; restart/replace or "
+                "resume() follows)")
         return self.engine.submit(*a, **kw)
 
     def cancel(self, rid: int) -> bool:
@@ -1663,6 +1753,7 @@ class EngineSupervisor:
                 now - self._restart_times[0] > self.window_s:
             self._restart_times.popleft()
         if len(self._restart_times) >= self.max_restarts:
+            self._dead = True
             raise EngineDeadError(
                 f"engine unrecoverable after {self.restarts} "
                 f"restart(s) ({len(self._restart_times)} in the last "
@@ -1673,23 +1764,7 @@ class EngineSupervisor:
                        * (2 ** len(self._restart_times)))
         old = self.engine
         text = f"{type(exc).__name__}: {exc}"
-        # best-effort cleanup of the dead engine's claims — EVERY slot
-        # off the free list (active rows AND rows stranded
-        # mid-admission by the fatal step), so a factory that reuses
-        # the cache starts from clean page accounting
-        for slot in range(old.B):
-            if slot in old._free_slots:
-                continue
-            try:
-                old.cache.release_row(slot)
-            except Exception:
-                pass
-        for handle in list(old._swap_handles.values()):
-            try:
-                old.cache.discard_swap(handle)
-            except Exception:
-                pass
-        old._swap_handles.clear()
+        _release_engine_claims(old)
         new = self._factory()
         # results the serving front has not drained yet survive
         new._finished.extend(old._finished)

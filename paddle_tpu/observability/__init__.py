@@ -25,7 +25,8 @@ from .metrics import (Counter, Gauge, Histogram,       # noqa: F401
                       MetricsRegistry, default_registry)
 from .engine_metrics import (EngineMetrics,            # noqa: F401
                              bind_engine_gauges)
+from .fleet_metrics import FleetMetrics                # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "EventRing", "default_ring",
-           "EngineMetrics", "bind_engine_gauges"]
+           "EngineMetrics", "bind_engine_gauges", "FleetMetrics"]

@@ -1,0 +1,108 @@
+"""Instrument bundle for the fleet router tier.
+
+One :class:`FleetMetrics` per :class:`~paddle_tpu.fleet.FleetRouter`:
+every Counter/Gauge the replica-routing layer publishes, created
+against one registry — normally the SAME registry the replica engines
+share, so ``GET /metrics`` on a :class:`~paddle_tpu.fleet.FleetServer`
+is the aggregated fleet exposition (engine counters sum across
+replicas because the instruments are shared; see docs/OBSERVABILITY.md
+"Fleet router" for the aggregation semantics).
+
+The registry is label-free by design (PR 1), so the labelled series a
+Prometheus deployment would write as ``paddle_tpu_fleet_replicas{state
+="ready"}`` / ``fleet_routed_total{reason="prefix"}`` flatten into one
+instrument per state / reason — the catalogue in docs/OBSERVABILITY.md
+documents the mapping.
+
+Unlike :class:`EngineMetrics`, the per-state gauges here are SET from
+inside the router's step (under the router lock) instead of scrape-
+time callbacks: a callback closure would read the replica table from
+the scrape thread outside the lock, which the ``lock-discipline``
+analysis rule forbids — and the router step already holds everything
+it needs, so the update is a handful of float stores.
+"""
+
+from __future__ import annotations
+
+from .events import EventRing
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["FleetMetrics"]
+
+
+class FleetMetrics:
+    """All instruments the fleet router records into.
+
+    ``registry=None`` uses the process-wide default registry; pass the
+    registry the replica engines share for one aggregated ``/metrics``
+    (the recommended wiring — :class:`~paddle_tpu.fleet.FleetRouter`
+    does this automatically when its replicas carry metrics).
+    """
+
+    def __init__(self, registry: MetricsRegistry = None, ring=None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        self.ring = ring if ring is not None else EventRing()
+
+        # -- replica lifecycle (per-state flattening of
+        #    fleet_replicas{state=...}) ---------------------------------
+        self.replicas = r.gauge(
+            "paddle_tpu_fleet_replicas_count",
+            "Engine replicas the router owns (all states)")
+        self.replicas_ready = r.gauge(
+            "paddle_tpu_fleet_replicas_ready_count",
+            "Replicas in state READY (admitting + decoding)")
+        self.replicas_degraded = r.gauge(
+            "paddle_tpu_fleet_replicas_degraded_count",
+            "Replicas in state DEGRADED (serving but deprioritized "
+            "by routing — e.g. stalled by a replica_slow fault)")
+        self.replicas_draining = r.gauge(
+            "paddle_tpu_fleet_replicas_draining_count",
+            "Replicas in state DRAINING (finishing in-flight work, "
+            "refusing new admissions; restart/replace follows)")
+        self.replicas_dead = r.gauge(
+            "paddle_tpu_fleet_replicas_dead_count",
+            "Replicas in state DEAD (died and not yet replaced)")
+        self.pending_failovers = r.gauge(
+            "paddle_tpu_fleet_pending_failovers_count",
+            "Accepted requests orphaned by a replica death, waiting "
+            "for re-placement on a healthy replica")
+
+        # -- routing decisions (per-reason flattening of
+        #    fleet_routed_total{reason=...}) ----------------------------
+        self.routed_prefix = r.counter(
+            "paddle_tpu_fleet_routed_prefix_total",
+            "Requests routed to the replica whose two-tier cache "
+            "already holds their prompt prefix (prefix-affinity hit)")
+        self.routed_least_loaded = r.counter(
+            "paddle_tpu_fleet_routed_least_loaded_total",
+            "Requests placed on the least-loaded READY replica (no "
+            "prefix owner, or the owner was unavailable/full)")
+        self.routed_failover = r.counter(
+            "paddle_tpu_fleet_routed_failover_total",
+            "Re-placements of requests orphaned by a replica death "
+            "(the transparent resubmission path)")
+
+        # -- degradation ------------------------------------------------
+        self.failovers = r.counter(
+            "paddle_tpu_fleet_failovers_total",
+            "Requests orphaned by a replica death before their first "
+            "streamed token and queued for transparent resubmission")
+        self.rejected = r.counter(
+            "paddle_tpu_fleet_rejected_total",
+            "Submissions rejected at the ROUTER because every "
+            "admitting replica's bounded queue refused (HTTP 429 "
+            "with the aggregate Retry-After: min over READY replicas)")
+        self.replica_deaths = r.counter(
+            "paddle_tpu_fleet_replica_deaths_total",
+            "Replica deaths observed by the router (escaped step "
+            "exceptions, exhausted supervisor budgets, injected "
+            "replica_death faults)")
+        self.replica_replaces = r.counter(
+            "paddle_tpu_fleet_replica_replaces_total",
+            "Replicas rebuilt from their factory (auto-replace after "
+            "death, or restart at the end of a drain)")
+        self.replica_drains = r.counter(
+            "paddle_tpu_fleet_replica_drains_total",
+            "drain() calls: replicas taken out of rotation to finish "
+            "in-flight work before a restart/replace")

@@ -32,6 +32,20 @@ site                   consulted by
 ``stream_write``       the ``/generate_stream`` chunk writer — simulates
                        a client disconnect (``BrokenPipeError``) without
                        a real socket close
+``route_dispatch``     ``FleetRouter`` immediately before handing an
+                       accepted request to the chosen replica — the
+                       router steers to the next candidate; with no
+                       candidate left the submit fails loudly
+``replica_death``      the router's per-replica step seam (consulted
+                       once per stepped replica) — an exception rule
+                       simulates a replica process death: state DEAD,
+                       un-streamed requests fail over, mid-stream ones
+                       error, ``auto_replace`` rebuilds
+``replica_slow``       condition rule at the same per-replica step
+                       seam — while active the replica STALLS (no step
+                       this tick) and is marked DEGRADED so routing
+                       steers around it; it recovers to READY when the
+                       rule stops matching
 =====================  ==================================================
 
 Faults are DETERMINISTIC: rules match by call index (``nth`` = exactly

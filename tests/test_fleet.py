@@ -1,0 +1,745 @@
+"""Fleet serving: the replica router (paddle_tpu/fleet) — lifecycle
+management, prefix-aware + least-loaded routing, fleet-wide admission,
+request failover, and chaos-tested degradation.
+
+Contract under test:
+* N-replica fleets produce TOKEN-EXACT outputs vs a single engine
+  (greedy decode is placement independent), through the router API and
+  the FleetServer HTTP front alike;
+* routing prefers the replica whose two-tier cache holds the prompt's
+  prefix, falls back to least-loaded, and steers around every
+  non-READY state (DEGRADED / DRAINING / DEAD);
+* admission sheds at the ROUTER: a single saturated replica never
+  429s traffic another replica could take, and a fleet-wide rejection
+  carries the AGGREGATE Retry-After (min over READY replicas);
+* a replica death orphans its requests — those with no streamed token
+  fail over transparently (same fleet rid + deadline, token-exact);
+  mid-stream ones finish with an explicit error status; dead replicas
+  auto-replace; `PagedKVCache.audit()` is clean on every replica
+  after every fault path;
+* `EngineSupervisor` gained drain()/state: a draining engine refuses
+  submissions, finishes in-flight work, and reports not-ready to
+  probes (`GET /health/ready` → 503);
+* seeded chaos (random replica deaths + stalls under load) never
+  silently drops an accepted request and never wedges.
+
+No test observes recovery through sleeps — assertions are driven by
+router/engine counters (bounded polls where another thread runs the
+drive loop).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fleet import FleetRouter, FleetServer
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import (ContinuousBatchingEngine,
+                                              EngineSupervisor,
+                                              QueueFullError)
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+_RNG = np.random.RandomState(77)
+_PROMPTS = [_RNG.randint(1, 128, (L,))
+            for L in (10, 21, 33, 8, 17, 26, 12, 19)]
+
+
+def _factory(cfg, params, **kw):
+    """One replica factory; identical replicas unless kw overrides."""
+    def mk():
+        cache_kw = dict(num_pages=64, pages_max=8, batch=2, page=16)
+        for k in ("num_pages", "pages_max", "batch", "page",
+                  "host_pages"):
+            if k in kw:
+                cache_kw[k] = kw[k]
+        eng_kw = {k: v for k, v in kw.items() if k not in cache_kw}
+        cache = PagedKVCache(cfg, **cache_kw)
+        return ContinuousBatchingEngine(cfg, params, cache,
+                                        metrics_registry=False,
+                                        **eng_kw)
+    return mk
+
+
+def _audit_all(router):
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+_REF = {}
+
+
+def _ref_outputs(cfg, params, prompts, new=8):
+    """Unfaulted greedy outputs per prompt index through one clean
+    engine (greedy decode is batch/placement independent, so any
+    fleet run's ok-requests must match token-exactly)."""
+    key = (new, len(prompts))
+    if key not in _REF:
+        eng = _factory(cfg, params)()
+        rids = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        _REF[key] = [done[rid] for rid in rids]
+    return _REF[key]
+
+
+def _poll(predicate, timeout_s=30.0):
+    t0 = time.monotonic()
+    while not predicate():
+        assert time.monotonic() - t0 < timeout_s, "condition timeout"
+        time.sleep(0.01)
+
+
+def _http_err(url, data=None, timeout=10):
+    try:
+        req = urllib.request.Request(url, data=data)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# routing: token-exactness, prefix affinity, least-loaded, steering
+# ---------------------------------------------------------------------------
+def test_fleet_token_exact_vs_single_engine(cfg, params):
+    ref = _ref_outputs(cfg, params, _PROMPTS)
+    router = FleetRouter([_factory(cfg, params)] * 3,
+                         metrics_registry=False)
+    rids = [router.submit(p, max_new_tokens=8) for p in _PROMPTS]
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(rids), "request lost or invented"
+    for i, rid in enumerate(rids):
+        assert done[rid].status == "ok"
+        assert list(done[rid].generated) == ref[i]
+    # work actually spread across replicas
+    assert sum(1 for h in router._replicas
+               if h.engine.requests_finished) >= 2
+    _audit_all(router)
+
+
+def test_prefix_affinity_routes_to_owner(cfg, params):
+    """Requests sharing a full-page prompt prefix land on the replica
+    that served the prefix first — its cache turns the prefill into a
+    prefix hit — while distinct prompts spread by load."""
+    reg = MetricsRegistry()
+    mk = _factory(cfg, params, enable_prefix_caching=True)
+    router = FleetRouter([mk] * 3, metrics_registry=reg)
+    prefix = _RNG.randint(1, 128, (32,))          # two full pages
+    group = [np.concatenate([prefix,
+                             _RNG.randint(1, 128, (3 + i,))])
+             for i in range(4)]
+    rids = [router.submit(p, max_new_tokens=4) for p in group]
+    done = router.run_to_completion()
+    assert all(r.status == "ok" for r in done)
+    assert len(done) == len(rids)
+    # first placement is least_loaded (no owner yet), the rest affine
+    assert router.routed["prefix"] == 3
+    assert router.routed["least_loaded"] == 1
+    owner = [h for h in router._replicas
+             if h.engine.requests_finished]
+    assert len(owner) == 1, "prefix group split across replicas"
+    assert owner[0].engine.cache.prefix_hits > 0
+    assert reg.get(
+        "paddle_tpu_fleet_routed_prefix_total").value == 3
+    _audit_all(router)
+
+
+def test_prefix_routing_off_is_pure_least_loaded(cfg, params):
+    mk = _factory(cfg, params, enable_prefix_caching=True)
+    router = FleetRouter([mk] * 2, prefix_routing=False,
+                         metrics_registry=False)
+    prefix = _RNG.randint(1, 128, (32,))
+    group = [np.concatenate([prefix,
+                             _RNG.randint(1, 128, (3 + i,))])
+             for i in range(4)]
+    for p in group:
+        router.submit(p, max_new_tokens=4)
+    router.run_to_completion()
+    assert router.routed["prefix"] == 0
+    assert router.routed["least_loaded"] == 4
+    # least-loaded spreads the group over both replicas
+    assert all(h.engine.requests_finished for h in router._replicas)
+
+
+def test_routing_steers_around_draining_replica(cfg, params):
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    router.drain(0)
+    assert router._replicas[0].state == "DRAINING"
+    rids = [router.submit(p, max_new_tokens=4)
+            for p in _PROMPTS[:3]]
+    done = router.run_to_completion()
+    assert {r.rid for r in done} == set(rids)
+    # nothing landed on the draining replica; it rebuilt and rejoined
+    assert router._replicas[0].engine.requests_finished == 0
+    assert router._replicas[0].state == "READY"
+    assert router._replicas[0].replaces == 1
+    assert router._replicas[1].engine.requests_finished == 3
+
+
+def test_drain_finishes_inflight_then_replaces(cfg, params):
+    """drain() keeps in-flight work running to completion (no drop,
+    no cancel), refuses new admissions, then the router rebuilds the
+    replica fresh."""
+    router = FleetRouter([_factory(cfg, params)],
+                         metrics_registry=False)
+    ref = _ref_outputs(cfg, params, _PROMPTS)
+    rid = router.submit(_PROMPTS[0], max_new_tokens=8)
+    router.step()                         # admitted + streaming
+    router.drain(0)
+    sup = router._replicas[0].supervisor
+    assert sup.state == "DRAINING"
+    with pytest.raises((RuntimeError, QueueFullError)):
+        router.submit(_PROMPTS[1], max_new_tokens=4)
+    done = router.run_to_completion()
+    assert [r.rid for r in done] == [rid]
+    assert done[0].status == "ok"
+    assert list(done[0].generated) == ref[0]
+    assert router._replicas[0].state == "READY"
+    assert router._replicas[0].replaces == 1
+    _audit_all(router)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide admission / backpressure
+# ---------------------------------------------------------------------------
+def test_single_saturated_replica_does_not_reject_fleet(cfg, params):
+    """REGRESSION (the PR's admission fix): one full replica's
+    QueueFullError is a routing event, not a client-visible 429 —
+    traffic spills to the replica with capacity; only a fleet-wide
+    saturation rejects, and then with the MIN retry_after over READY
+    replicas."""
+    reg = MetricsRegistry()
+    mk = _factory(cfg, params, max_queue_len=2)
+    router = FleetRouter([mk] * 2, metrics_registry=reg)
+    prefix = _RNG.randint(1, 128, (32,))
+    same = [np.concatenate([prefix, _RNG.randint(1, 128, (4,))])
+            for _ in range(8)]
+    # no stepping: queues only grow.  The prefix owner (replica 0)
+    # absorbs its bound, then the router SPILLS instead of rejecting.
+    for p in same[:4]:
+        router.submit(p, max_new_tokens=4)
+    q0 = len(router._replicas[0].engine._queue)
+    q1 = len(router._replicas[1].engine._queue)
+    assert q0 == 2 and q1 == 2, "spill did not balance"
+    assert router.rejected == 0
+    # routing probes never charge the ENGINES' reject counters — the
+    # aggregated /metrics must only count client-visible rejections
+    assert all(h.engine.requests_rejected == 0
+               for h in router._replicas)
+    # fleet-wide saturation: now it IS a 429, with the aggregate hint
+    with pytest.raises(QueueFullError) as ei:
+        router.submit(same[4], max_new_tokens=4)
+    agg = min(h.engine.retry_after_s() for h in router._replicas)
+    assert ei.value.retry_after == pytest.approx(agg)
+    assert router.rejected == 1
+    assert reg.get("paddle_tpu_fleet_rejected_total").value == 1
+    assert all(h.engine.requests_rejected == 0
+               for h in router._replicas)
+    router.run_to_completion()
+
+
+def test_fleet_http_429_carries_aggregate_retry_after(cfg, params):
+    """The HTTP layer surfaces the router's fleet-wide rejection as
+    429 + the aggregate Retry-After; a half-saturated fleet keeps
+    accepting (no 429) and stays ready."""
+    mk = _factory(cfg, params, max_queue_len=1)
+    router = FleetRouter([mk] * 2, metrics_registry=False)
+    srv = FleetServer(router)
+    srv._drive = srv._stop.wait      # park the loop: queues only grow
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        body = lambda i: json.dumps(  # noqa: E731
+            {"prompt": [int(t) for t in _PROMPTS[i]],
+             "max_new_tokens": 4}).encode()
+        # one replica saturated -> the other absorbs: 200-path accept
+        srv.submit([int(t) for t in _PROMPTS[0]], 4)
+        assert _http_err(url + "/health/ready")[0] == 200
+        srv.submit([int(t) for t in _PROMPTS[1]], 4)
+        assert _http_err(url + "/health/ready")[0] == 503
+        code, text, headers = _http_err(url + "/generate", body(2))
+        assert code == 429
+        assert b"fleet saturated" in text
+        agg = min(h.engine.retry_after_s()
+                  for h in router._replicas)
+        assert int(headers["Retry-After"]) >= 1
+        assert int(headers["Retry-After"]) <= int(-(-agg // 1)) + 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica death: failover, explicit errors, auto-replace
+# ---------------------------------------------------------------------------
+def test_replica_death_fails_over_queued_requests(cfg, params):
+    """A replica death before a request's first streamed token is
+    INVISIBLE: the request resubmits to a healthy replica with its
+    fleet rid intact and completes token-exact."""
+    ref = _ref_outputs(cfg, params, _PROMPTS)
+    reg = MetricsRegistry()
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=reg)
+    # load replica queues beyond slot capacity so deaths find queued
+    # (never-streamed) requests to fail over
+    rids = [router.submit(p, max_new_tokens=8) for p in _PROMPTS]
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("killed"), nth=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(rids)
+    assert router.deaths == 1
+    assert router.failovers > 0
+    assert router.routed["failover"] == router.failovers
+    assert reg.get("paddle_tpu_fleet_failovers_total").value \
+        == router.failovers
+    # every failed-over request completed token-exact; every
+    # mid-stream casualty carries an explicit error
+    for i, rid in enumerate(rids):
+        r = done[rid]
+        if r.status == "ok":
+            assert list(r.generated) == ref[i]
+        else:
+            assert r.status == "error"
+            assert "died" in r.error
+    assert any(done[rid].status == "ok" for rid in rids)
+    # the dead replica auto-replaced and is serving again
+    assert all(h.state == "READY" for h in router._replicas)
+    assert router.replaces == 1
+    _audit_all(router)
+
+
+def test_replica_death_without_auto_replace_keeps_fleet_serving(
+        cfg, params):
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         auto_replace=False, metrics_registry=False)
+    rids = [router.submit(p, max_new_tokens=6) for p in _PROMPTS[:6]]
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("killed"), nth=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(rids)
+    states = {h.state for h in router._replicas}
+    assert "DEAD" in states and "READY" in states
+    # the dead replica stays down; the survivor carried the work
+    assert router.replaces == 0
+    snap = router.fleet_snapshot()
+    assert snap["states"]["DEAD"] == 1
+    # manual replace restores capacity
+    dead_idx = next(h.idx for h in router._replicas
+                    if h.state == "DEAD")
+    router.replace(dead_idx)
+    assert router._replicas[dead_idx].state == "READY"
+    r2 = router.submit(_PROMPTS[0], max_new_tokens=4)
+    assert any(r.rid == r2 and r.status == "ok"
+               for r in router.run_to_completion())
+
+
+def test_failover_preserves_deadline(cfg, params):
+    """A failed-over request keeps its ABSOLUTE deadline: if the
+    deadline passes while it waits for re-placement, it expires —
+    never a silent requeue-forever."""
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         auto_replace=False, metrics_registry=False)
+    t = [1000.0]
+    router._now = lambda: t[0]
+    rids = [router.submit(p, max_new_tokens=8, deadline_s=5.0)
+            for p in _PROMPTS[:6]]
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("killed"), nth=1)
+        router.step()                 # death -> orphans go pending
+    assert router.deaths == 1
+    t[0] += 10.0                      # deadline passes while pending
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(rids), "orphan silently dropped"
+    statuses = {done[rid].status for rid in rids}
+    assert statuses <= {"ok", "error", "expired"}
+    assert any(done[rid].status == "expired" for rid in rids), \
+        "pending orphans should expire at their deadline"
+    _audit_all(router)
+
+
+def test_cancel_of_pending_orphan_delivers_terminal_message(
+        cfg, params):
+    """REGRESSION: cancelling an orphan that sits in the failover
+    pending queue while the fleet is otherwise IDLE must still
+    surface the terminal 'cancelled' result — has_work() reports the
+    undelivered message so drive loops drain it (a False here
+    stranded the waiter's 499 forever)."""
+    router = FleetRouter([_factory(cfg, params, max_queue_len=2)],
+                         auto_replace=False, metrics_registry=False)
+    rids = [router.submit(p, max_new_tokens=4) for p in _PROMPTS[:2]]
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("killed"), nth=1)
+        router.step()                 # death -> queued orphan pends
+    assert len(router._pending) >= 1
+    pending_rid = router._pending[0].rid
+    assert router.cancel(pending_rid) is True
+    # the fleet has no replica work left (sole replica DEAD, no
+    # auto-replace) — but the synthesized result must still drain
+    assert router.has_work()
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert done[pending_rid].status == "cancelled"
+    assert set(done) <= set(rids)
+    assert not router.has_work()
+    # failover retries against a saturated fleet never count as
+    # client rejections (the 429 counter stays client-truthful)
+    assert router.rejected == 0
+
+
+def test_replaced_replica_loses_prefix_ownership(cfg, params):
+    """A rebuilt replica's cache is cold: the prefix keys it owned
+    must stop steering traffic to it (and stop counting as prefix
+    hits) until it earns them back."""
+    mk = _factory(cfg, params, enable_prefix_caching=True)
+    router = FleetRouter([mk] * 2, metrics_registry=False)
+    prefix = _RNG.randint(1, 128, (32,))
+    p = np.concatenate([prefix, _RNG.randint(1, 128, (4,))])
+    router.submit(p, max_new_tokens=4)
+    router.run_to_completion()
+    owner = next(h for h in router._replicas
+                 if h.engine.requests_finished)
+    assert set(router._prefix_owner.values()) == {owner.idx}
+    router.replace(owner.idx)
+    assert owner.idx not in set(router._prefix_owner.values())
+    # the next same-prefix request routes by load, not cold affinity
+    router.submit(np.concatenate(
+        [prefix, _RNG.randint(1, 128, (5,))]), max_new_tokens=4)
+    router.run_to_completion()
+    assert router.routed["prefix"] == 0
+    assert router.routed["least_loaded"] == 2
+
+
+def test_cancelled_request_is_not_revived_by_failover(cfg, params):
+    """REGRESSION: a cancel acknowledged by the router, followed by
+    the replica dying BEFORE its flush point, must surface
+    'cancelled' — the engine-side cancel mark died with the replica,
+    and failing the request over would regenerate work for a waiter
+    expecting its 499."""
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    rids = [router.submit(p, max_new_tokens=8) for p in _PROMPTS[:6]]
+    victim = rids[-1]                     # queued: streams nothing
+    assert router.cancel(victim) is True
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("killed"), nth=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(rids)
+    assert done[victim].status == "cancelled", done[victim].status
+    assert done[victim].generated == [], \
+        "cancelled request regenerated after the death"
+    _audit_all(router)
+
+
+def test_fleet_state_does_not_stall_behind_held_lock(cfg, params):
+    """/fleet keeps the monitoring plane's bounded-wait contract: a
+    scrape while the drive thread holds the server lock serves the
+    last document (tagged stale_s) instead of blocking."""
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    srv = FleetServer(router)
+    port = srv.start()
+    try:
+        doc = srv.fleet_state()           # prime the last document
+        assert doc["states"]["READY"] == 2
+        with srv._lock:                   # simulate a long step
+            t0 = time.monotonic()
+            stale = srv.fleet_state()
+            assert time.monotonic() - t0 < 1.0
+            assert stale["states"]["READY"] == 2
+            assert "stale_s" in stale
+    finally:
+        srv.stop()
+
+
+def test_route_dispatch_fault_steers_to_next_candidate(cfg, params):
+    """A failed handoff to the chosen replica (route_dispatch fault)
+    retries the next candidate transparently; only a fleet-wide
+    refusal surfaces to the client."""
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    with faults.plane() as fp:
+        fp.inject("route_dispatch", RuntimeError("route boom"),
+                  nth=1)
+        rid = router.submit(_PROMPTS[0], max_new_tokens=4)
+    assert router.route_errors == 1
+    done = router.run_to_completion()
+    assert done[0].rid == rid and done[0].status == "ok"
+    # every candidate refusing fails LOUDLY (no silent drop)
+    with faults.plane() as fp:
+        fp.inject("route_dispatch", RuntimeError("route boom"),
+                  times=2)
+        with pytest.raises(RuntimeError, match="route boom"):
+            router.submit(_PROMPTS[1], max_new_tokens=4)
+
+
+def test_replica_slow_degrades_and_recovers(cfg, params):
+    """An armed replica_slow stall flips the replica to DEGRADED
+    (routing deprioritizes it) without losing its work; when the
+    stall clears it returns to READY and finishes token-exact."""
+    ref = _ref_outputs(cfg, params, _PROMPTS)
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    rids = [router.submit(p, max_new_tokens=8) for p in _PROMPTS[:2]]
+    with faults.plane() as fp:
+        # nth=1: exactly the first replica's next consult stalls
+        fp.inject("replica_slow", nth=1)
+        router.step()
+        assert router._replicas[0].state == "DEGRADED"
+        assert router._replicas[0].slow_ticks == 1
+        # new work steers to the healthy replica while degraded
+        snap = router.fleet_snapshot()
+        assert snap["states"]["DEGRADED"] == 1
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert router._replicas[0].state == "READY"
+    for i, rid in enumerate(rids):
+        assert done[rid].status == "ok"
+        assert list(done[rid].generated) == ref[i]
+
+
+# ---------------------------------------------------------------------------
+# chaos: seeded random deaths + stalls under load (the acceptance pin)
+# ---------------------------------------------------------------------------
+def test_chaos_seeded_deaths_and_stalls_no_silent_drops(cfg, params):
+    """The chaos pin: replica_death every K replica-steps plus seeded
+    random stalls on an N=3 fleet under queued load — every accepted
+    request either completes TOKEN-EXACT (failover: identical to the
+    no-fault run) or finishes with an explicit error status; zero
+    silent drops, zero wedges, every replica's audit() clean."""
+    new = 8
+    ref = _ref_outputs(cfg, params, _PROMPTS, new=new)
+    router = FleetRouter([_factory(cfg, params)] * 3,
+                         metrics_registry=False)
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("chaos kill"),
+                  every=9)
+        fp.inject("replica_slow", p=0.15, seed=3)
+        rids = [router.submit(p, max_new_tokens=new)
+                for p in _PROMPTS]
+        done = {r.rid: r for r in
+                router.run_to_completion(max_steps=5000)}
+    assert set(done) == set(rids), "accepted request silently dropped"
+    ok = err = 0
+    for i, rid in enumerate(rids):
+        r = done[rid]
+        assert r.done
+        if r.status == "ok":
+            ok += 1
+            assert list(r.generated) == ref[i], \
+                f"request {i} recovered but not token-exact"
+        else:
+            err += 1
+            assert r.status == "error" and r.error
+    assert router.deaths >= 1
+    assert ok >= 1, "chaos killed everything — no recovery happened"
+    _audit_all(router)
+    # the fleet is still servable after the chaos window
+    rid = router.submit(_PROMPTS[0], max_new_tokens=new)
+    final = router.run_to_completion()
+    assert any(r.rid == rid and r.status == "ok" and
+               list(r.generated) == ref[0] for r in final)
+
+
+def test_chaos_cancel_and_deadline_under_deaths(cfg, params):
+    """Cancellation and deadlines keep their contracts while replicas
+    die: terminal statuses only, allocator spotless."""
+    router = FleetRouter([_factory(cfg, params)] * 3,
+                         metrics_registry=False)
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("chaos kill"),
+                  every=6)
+        rids = [router.submit(p, max_new_tokens=16)
+                for p in _PROMPTS]
+        router.cancel(rids[0])
+        router.cancel(rids[5])
+        expired = router.submit(_PROMPTS[1], max_new_tokens=16,
+                                deadline_s=0.0)
+        done = {r.rid: r for r in
+                router.run_to_completion(max_steps=5000)}
+    assert set(done) == set(rids) | {expired}
+    assert all(r.done for r in done.values())
+    assert done[expired].status in ("expired", "error")
+    for rid in (rids[0], rids[5]):
+        assert done[rid].status in ("cancelled", "error")
+    _audit_all(router)
+
+
+# ---------------------------------------------------------------------------
+# supervisor lifecycle verbs (drain / state / resume)
+# ---------------------------------------------------------------------------
+def test_supervisor_drain_state_resume(cfg, params):
+    sup = EngineSupervisor(_factory(cfg, params), backoff_s=0.0)
+    assert sup.state == "READY"
+    rid = sup.submit(_PROMPTS[0], max_new_tokens=6)
+    sup.drain()
+    assert sup.state == "DRAINING"
+    assert not sup.drained                # in-flight work remains
+    with pytest.raises(RuntimeError, match="draining"):
+        sup.submit(_PROMPTS[1], max_new_tokens=4)
+    done = sup.run_to_completion()
+    assert [r.rid for r in done] == [rid]
+    assert sup.drained
+    sup.resume()
+    assert sup.state == "READY"
+    assert sup.submit(_PROMPTS[1], max_new_tokens=4) == rid + 1
+
+
+def test_supervisor_dead_state_after_budget(cfg, params):
+    from paddle_tpu.models.serving_engine import EngineDeadError
+    sup = EngineSupervisor(
+        _factory(cfg, params, quarantine_faults=False),
+        max_restarts=0, backoff_s=0.0)
+    sup.submit(_PROMPTS[0], max_new_tokens=4)
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("boom"), nth=1)
+        with pytest.raises(EngineDeadError):
+            sup.step()
+    assert sup.state == "DEAD"
+    with pytest.raises(EngineDeadError):
+        sup.submit(_PROMPTS[1], max_new_tokens=4)
+
+
+def test_http_ready_false_while_draining(cfg, params):
+    """GET /health/ready flips 503 while the supervisor drains, so
+    probes pull the node out of rotation; it recovers on resume()."""
+    from paddle_tpu.inference.serving import GenerationServer
+    srv = GenerationServer(
+        engine_factory=_factory(cfg, params), restart_backoff_s=0.0)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        _poll(srv.is_ready)
+        assert _http_err(url + "/health/ready")[0] == 200
+        srv._supervisor.drain()
+        _poll(lambda: not srv.is_ready())
+        assert _http_err(url + "/health/ready")[0] == 503
+        assert _http_err(url + "/health/live")[0] == 200
+        srv._supervisor.resume()
+        _poll(srv.is_ready)
+        assert _http_err(url + "/health/ready")[0] == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetServer: HTTP front, /fleet endpoint, aggregated metrics
+# ---------------------------------------------------------------------------
+def test_fleet_server_end_to_end_token_exact(cfg, params):
+    from paddle_tpu.inference.serving import (generate_http,
+                                              generate_http_stream)
+    ref = _ref_outputs(cfg, params, _PROMPTS)
+    reg = MetricsRegistry()
+    mk = _factory(cfg, params)
+
+    def mk_metrics():
+        eng = mk()
+        from paddle_tpu.observability import EngineMetrics
+        eng.metrics = EngineMetrics(reg)
+        return eng
+
+    router = FleetRouter([mk_metrics] * 2, metrics_registry=reg)
+    srv = FleetServer(router)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        toks = generate_http(url, [int(t) for t in _PROMPTS[0]],
+                             max_new_tokens=8)
+        assert toks == ref[0]
+        streamed = list(generate_http_stream(
+            url, [int(t) for t in _PROMPTS[1]], max_new_tokens=8))
+        assert streamed == ref[1]
+        # /fleet: per-replica lifecycle + the routing counters
+        doc = json.loads(_http_err(url + "/fleet")[1])
+        assert len(doc["replicas"]) == 2
+        assert doc["states"]["READY"] == 2
+        assert sum(doc["routed"].values()) == 2
+        assert {r["state"] for r in doc["replicas"]} == {"READY"}
+        # /health carries the fleet document
+        h = json.loads(_http_err(url + "/health")[1])
+        assert h["ready"] is True and "fleet" in h
+        # /metrics is the AGGREGATED exposition: fleet instruments +
+        # engine counters summed across replicas on the shared registry
+        text = _http_err(url + "/metrics")[1].decode()
+        assert "paddle_tpu_fleet_replicas_ready_count 2" in text
+        assert "paddle_tpu_fleet_routed_least_loaded_total" in text
+        total = reg.get(
+            "paddle_tpu_engine_requests_finished_total").value
+        assert total == 2
+    finally:
+        srv.stop()
+
+
+def test_fleet_server_replica_death_mid_stream_surfaces_500(
+        cfg, params):
+    """A replica death after a request streamed tokens surfaces the
+    explicit error to the HTTP waiter (500-family terminal message),
+    never a silent truncation; failover keeps untouched requests
+    alive."""
+    from paddle_tpu.inference.serving import generate_http_stream
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    srv = FleetServer(router)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        fp = faults.install()
+        fp.inject("replica_death", RuntimeError("killed"), nth=2)
+        with pytest.raises((RuntimeError,
+                            urllib.error.HTTPError)):
+            # stream dies mid-request -> terminal error line raises
+            list(generate_http_stream(
+                url, [int(t) for t in _PROMPTS[0]],
+                max_new_tokens=64, timeout=30))
+        _poll(lambda: router.deaths >= 1)
+    finally:
+        faults.uninstall()
+        srv.stop()
+    assert router.deaths >= 1
+
+
+def test_metrics_dump_renders_fleet_snapshot(cfg, params):
+    """tools/metrics_dump.py fleet <url> pretty-prints the aggregated
+    /fleet document."""
+    import importlib
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        md = importlib.import_module("metrics_dump")
+    finally:
+        sys.path.pop(0)
+    router = FleetRouter([_factory(cfg, params)] * 2,
+                         metrics_registry=False)
+    router.submit(_PROMPTS[0], max_new_tokens=4)
+    router.run_to_completion()
+    text = md._render_fleet(router.fleet_snapshot())
+    assert "ready=2" in text
+    assert "least_loaded=1" in text
+    assert "idx" in text and "state" in text
+    assert text.count("READY") == 2

@@ -472,6 +472,8 @@ def test_metric_names_lint():
 
     reg = MetricsRegistry()
     EngineMetrics(reg)                        # engine + cache + spec
+    from paddle_tpu.observability import FleetMetrics
+    FleetMetrics(reg)                         # fleet router tier
     mgr = W.CommTaskManager(scan_interval=60)
     mgr.bind_metrics(reg, EventRing())
     mgr.shutdown()

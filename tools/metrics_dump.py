@@ -6,14 +6,18 @@ Usage:
   python tools/metrics_dump.py stats   http://127.0.0.1:8000
   python tools/metrics_dump.py metrics http://127.0.0.1:8000
   python tools/metrics_dump.py events  http://127.0.0.1:8000 [-n 50] [--follow]
+  python tools/metrics_dump.py fleet   http://127.0.0.1:8000
   python tools/metrics_dump.py snapshot BENCH_r05.json
 
 ``stats`` renders ``GET /stats`` (the JSON snapshot) as an aligned
 table; ``metrics`` dumps the raw Prometheus text from ``GET /metrics``;
 ``events`` prints the last N ring events as JSON lines and with
-``--follow`` polls ``/events?since=<seq>`` for new ones; ``snapshot``
-pretty-prints a snapshot previously written to a file (e.g. the
-``metrics_snapshot`` line bench.py appends to BENCH_r*.json output).
+``--follow`` polls ``/events?since=<seq>`` for new ones; ``fleet``
+renders a FleetServer's aggregated ``GET /fleet`` snapshot (replica
+lifecycle states, per-replica load, routing/failover counters);
+``snapshot`` pretty-prints a snapshot previously written to a file
+(e.g. the ``metrics_snapshot`` line bench.py appends to BENCH_r*.json
+output).
 
 Stdlib only — usable on any host that can reach the server.
 """
@@ -85,6 +89,48 @@ def cmd_events(args) -> int:
         time.sleep(args.interval)
 
 
+def _render_fleet(doc: dict) -> str:
+    """The aggregated fleet snapshot: one header line (states +
+    routing/degradation counters), then a per-replica table."""
+    states = doc.get("states", {})
+    lines = ["fleet: " + "  ".join(
+        f"{s.lower()}={states.get(s, 0)}" for s in
+        ("READY", "DEGRADED", "DRAINING", "DEAD", "STARTING"))]
+    routed = doc.get("routed", {})
+    lines.append("routed: " + "  ".join(
+        f"{k}={routed.get(k, 0)}"
+        for k in ("prefix", "least_loaded", "failover")))
+    lines.append(
+        f"failovers={doc.get('failovers', 0)}  "
+        f"rejected={doc.get('rejected', 0)}  "
+        f"deaths={doc.get('deaths', 0)}  "
+        f"replaces={doc.get('replaces', 0)}  "
+        f"pending_failovers={doc.get('pending_failovers', 0)}  "
+        f"requests_live={doc.get('requests_live', 0)}")
+    cols = ("idx", "state", "active", "queued", "queued_tokens",
+            "occupancy", "decode_steps", "tokens_generated",
+            "prefix_hit_pages", "restarts", "deaths", "replaces",
+            "drains", "retry_after_s")
+    rows = [[str(r.get(c, "")) for c in cols]
+            for r in doc.get("replicas", [])]
+    widths = [max(len(c), *(len(row[i]) for row in rows))
+              if rows else len(c) for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for row in rows:
+        lines.append("  ".join(v.ljust(w)
+                               for v, w in zip(row, widths)))
+    for r in doc.get("replicas", []):
+        if r.get("error"):
+            lines.append(f"replica {r['idx']} error: {r['error']}")
+    return "\n".join(lines)
+
+
+def cmd_fleet(args) -> int:
+    doc = json.loads(_get(args.url.rstrip("/") + "/fleet"))
+    print(_render_fleet(doc))
+    return 0
+
+
 def cmd_snapshot(args) -> int:
     with open(args.path) as f:
         text = f.read()
@@ -116,7 +162,12 @@ def cmd_snapshot(args) -> int:
                 "swap_out_pages_total", "swap_in_pages_total",
                 "swap_bytes_total", "prefill_tokens_avoided_total",
                 "requests_faulted_total", "engine_restarts_total",
-                "requests_rejected_total")
+                "requests_rejected_total",
+                # fleet tier (the serving_fleet_ab bench line's
+                # routers publish process-wide)
+                "fleet_failovers_total", "fleet_rejected_total",
+                "fleet_replica_deaths_total",
+                "fleet_replica_replaces_total")
     derived = {}
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
@@ -163,6 +214,10 @@ def main(argv=None) -> int:
                    help="poll for new events")
     s.add_argument("--interval", type=float, default=1.0)
     s.set_defaults(fn=cmd_events)
+    s = sub.add_parser("fleet",
+                       help="pretty-print GET /fleet (FleetServer)")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_fleet)
     s = sub.add_parser("snapshot",
                        help="pretty-print a snapshot file")
     s.add_argument("path")
