@@ -1117,6 +1117,240 @@ def _fleet_line() -> dict:
     }
 
 
+def _disagg_line() -> dict:
+    """DISAGGREGATED prefill/decode A/B (PR-9 tentpole): the same
+    offered load — waves of long prompts (the stall-inducing
+    workload) plus short ones (the cost model keeps them colocated) —
+    runs through one UNIFIED engine and a 1P+1D
+    ``DisaggCoordinator`` at the same submission schedule.  Reports
+    TTFT/TPOT p50/p99, the decode-step p99 DURING ADMISSION WAVES
+    (the stall this architecture deletes: on the unified engine an
+    admission tick's step includes the packed prefill; on the disagg
+    pair the decode engine's step never does), handoff ms/request,
+    and the per-request cost-model routing counts.  ``value`` is the
+    unified/disagg ratio of admission-tick decode-step p99 (>1 =
+    disagg deleted stall)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.disagg import (DecodeEngine,
+                                          DisaggCoordinator,
+                                          PrefillEngine,
+                                          handoff_flip_gbps)
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_ring
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, page, new = 8, 64, 48
+        num_pages, pages_max, host_pages = 128, 8, 96
+        long_lens, short_lens = (192, 256, 320, 448), (16, 32)
+        waves, per_wave, wave_gap = 4, 6, 6
+        metric = "serving_disagg_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, page, new = 4, 16, 12
+        num_pages, pages_max, host_pages = 96, 8, 64
+        long_lens, short_lens = (48, 64, 80, 100), (3, 6)
+        waves, per_wave, wave_gap = 4, 4, 4
+        metric = "serving_disagg_tiny_cpu_smoke_ab"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    # submission schedule: one wave every wave_gap ticks, mostly long
+    # prompts + a short tail rider per wave
+    def make_sched(r):
+        out = []
+        for w in range(waves):
+            ps = [r.randint(1, cfg.vocab_size,
+                            (long_lens[(w * per_wave + j)
+                                       % len(long_lens)],))
+                  for j in range(per_wave - 1)]
+            ps.append(r.randint(1, cfg.vocab_size,
+                               (short_lens[w % len(short_lens)],)))
+            out.append(ps)
+        return out
+
+    sched = make_sched(rng)
+    # warmup twin: the SAME length mix and wave structure (same
+    # packed-bucket / restore-scatter compile shapes) with different
+    # tokens, driven through the same schedule so the timed window
+    # never pays a first-shape compile
+    warm_sched = make_sched(np.random.RandomState(1))
+
+    def mk_cache(hp=0):
+        return PagedKVCache(cfg, num_pages=num_pages,
+                            pages_max=pages_max, batch=batch,
+                            page=page, host_pages=hp)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3) \
+            if xs else 0.0
+
+    def lat_stats(done):
+        ok = [r for r in done if r.status == "ok"]
+        ttft = [(r.t_first_token - r.t_submit) * 1000
+                for r in ok if r.t_first_token]
+        tpot = [(r.t_finish - r.t_first_token) * 1000
+                / (len(r.generated) - 1)
+                for r in ok if r.t_first_token
+                and len(r.generated) > 1]
+        return {"requests_ok": len(ok),
+                "ttft_p50_ms": pct(ttft, 0.5),
+                "ttft_p99_ms": pct(ttft, 0.99),
+                "tpot_p50_ms": pct(tpot, 0.5),
+                "tpot_p99_ms": pct(tpot, 0.99)}
+
+    def drive(submit, step, admitted_this_tick, schedule):
+        """Shared offered-load loop: submit waves on schedule, step
+        once per tick, sample the decode-step wall split by whether
+        an admission wave ran this tick."""
+        adm, quiet = [], []
+        pend = list(enumerate(schedule))
+        tick = 0
+        done = []
+        while pend or step.__self__.has_work():
+            if pend and tick >= pend[0][0] * wave_gap:
+                for p in pend.pop(0)[1]:
+                    submit(p, new)
+            t0 = time.perf_counter()
+            step()
+            wall = (time.perf_counter() - t0) * 1000
+            drv = step.__self__
+            dec_ms = wall if not hasattr(drv, "last_decode_step_s") \
+                else drv.last_decode_step_s * 1000
+            hit = admitted_this_tick()    # advances its counters —
+            #                               consult EVERY tick
+            if dec_ms > 0:        # disagg ticks with no decode work
+                #                   carry no decode-step sample
+                (adm if hit else quiet).append(dec_ms)
+            done.extend(drv.finished())
+            tick += 1
+            if tick > 5000:
+                raise RuntimeError("disagg bench did not drain")
+        return adm, quiet, done
+
+    def run_unified():
+        eng = ContinuousBatchingEngine(
+            cfg, params, mk_cache(), metrics_registry=False)
+        last = {"pf": eng.prefill_calls}
+
+        def admitted():
+            hit = eng.prefill_calls > last["pf"]
+            last["pf"] = eng.prefill_calls
+            return hit
+
+        submit = lambda p, n: eng.submit(p, max_new_tokens=n)  # noqa: E731
+        drive(submit, eng.step, admitted, warm_sched)   # compiles
+        adm, quiet, done = drive(submit, eng.step, admitted, sched)
+        out = lat_stats(done)
+        out.update({"decode_step_p99_during_admission_ms":
+                    pct(adm, 0.99),
+                    "decode_step_p50_during_admission_ms":
+                    pct(adm, 0.5),
+                    "decode_step_p99_quiet_ms": pct(quiet, 0.99),
+                    "admission_ticks": len(adm)})
+        eng.cache.audit()
+        return out
+
+    def run_disagg():
+        pe = PrefillEngine(cfg, params, mk_cache(host_pages),
+                           metrics_registry=default_registry(),
+                           metrics_ring=default_ring(),
+                           max_inflight_handoffs=2 * batch)
+        de = DecodeEngine(cfg, params, mk_cache(host_pages),
+                          metrics_registry=default_registry(),
+                          metrics_ring=default_ring())
+        # calibrate the cost-model link speed so the decision SPLITS
+        # this workload: geometric mean of the gbps thresholds at
+        # which the shortest long prompt and the longest short prompt
+        # flip (the decision stays a counter, reported below)
+        gbps = float(np.sqrt(
+            handoff_flip_gbps(min(long_lens), de)
+            * handoff_flip_gbps(max(short_lens), de)))
+        co = DisaggCoordinator(pe, de, handoff_gbps=gbps)
+        last = {"pf": pe.prefill_calls, "sw": de.resumes_swapped}
+
+        def admitted():
+            # an admission-adjacent tick: the prefill engine ran a
+            # wave OR the decode engine restored shipped pages (the
+            # disagg arm's admission cost lives in the restores)
+            hit = (pe.prefill_calls > last["pf"]
+                   or de.resumes_swapped > last["sw"])
+            last["pf"] = pe.prefill_calls
+            last["sw"] = de.resumes_swapped
+            return hit
+
+        submit = lambda p, n: co.submit(p, max_new_tokens=n)  # noqa: E731
+        drive(submit, co.step, admitted, warm_sched)    # compiles
+        warm_routed = dict(co.routed)
+        adm, quiet, done = drive(submit, co.step, admitted, sched)
+        out = lat_stats(done)
+        out.update({
+            "decode_step_p99_during_admission_ms": pct(adm, 0.99),
+            "decode_step_p50_during_admission_ms": pct(adm, 0.5),
+            "decode_step_p99_quiet_ms": pct(quiet, 0.99),
+            "admission_ticks": len(adm),
+            "handoff_gbps_knob": round(gbps, 3),
+            "routed": {k: co.routed[k] - warm_routed[k]
+                       for k in co.routed},
+            "handoffs_shipped": co.handoffs_shipped,
+            "handoff_pages": co.handoff_pages,
+            "handoff_ms_per_request": round(
+                1000.0 * co.handoff_wall_s
+                / max(co.handoffs_shipped, 1), 4),
+            "colocated_fallbacks": co.colocated_fallbacks,
+            "decode_prefill_calls": de.prefill_calls,
+            "prefill_tokens_avoided": de.prefill_tokens_avoided})
+        pe.cache.audit()
+        de.cache.audit()
+        return out
+
+    unified = run_unified()
+    disagg = run_disagg()
+    u99 = unified["decode_step_p99_during_admission_ms"]
+    d99 = disagg["decode_step_p99_during_admission_ms"]
+    return {
+        "metric": metric,
+        "value": round(u99 / max(d99, 1e-9), 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {
+            "platform": platform, "batch_slots": batch,
+            "requests": sum(len(w) for w in sched),
+            "waves": waves, "wave_gap_ticks": wave_gap,
+            "unified": unified, "disagg_1p1d": disagg,
+            "disagg_deletes_admission_stall": bool(u99 > d99),
+            "note": "CPU smoke time-slices both engines on one host: "
+                    "TTFT/TPOT wall numbers interleave the two "
+                    "devices' work and cannot show the concurrency "
+                    "win — the decode-step latency during admission "
+                    "waves is the honest per-device measurable "
+                    "(on-chip capture: ROADMAP item 5)",
+        },
+    }
+
+
 def _serving_tp_line() -> dict:
     """TENSOR-PARALLEL serving A/B on an mp mesh (PR-7 tentpole): the
     same mixed-length trace admits through the batched-under-TP and
@@ -1305,6 +1539,16 @@ def _snapshot_line() -> dict:
                           "paddle_tpu_fleet_replica_deaths_total"),
                       "fleet_replica_replaces_total": _cval(
                           "paddle_tpu_fleet_replica_replaces_total"),
+                      # disaggregated prefill/decode (the
+                      # serving_disagg_ab line's coordinator
+                      # publishes process-wide)
+                      "disagg_handoff_pages_total": _cval(
+                          "paddle_tpu_disagg_handoff_pages_total"),
+                      "disagg_handoff_bytes_total": _cval(
+                          "paddle_tpu_disagg_handoff_bytes_total"),
+                      "disagg_colocated_fallback_total": _cval(
+                          "paddle_tpu_disagg_colocated_fallback"
+                          "_total"),
                       "events": default_ring().recent(50)}}
 
 
@@ -1325,6 +1569,7 @@ def main() -> None:
          _preemption_line),
         ("serving_fault_recovery", "ratio", _fault_recovery_line),
         ("serving_fleet_ab", "x", _fleet_line),
+        ("serving_disagg_ab", "x", _disagg_line),
     ]
 
     devs, err = _init_devices()

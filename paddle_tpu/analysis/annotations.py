@@ -60,6 +60,21 @@ SYNC_HOT_ROOTS: List[str] = [
     "FleetRouter._submit_locked",
     "FleetRouter._candidates_locked",
     "FleetRouter._place_locked",
+    # disaggregated prefill/decode (PR 9): the restore-side admission
+    # path (adopt + zero-prefill re-admission) and the coordinator/
+    # router handoff-ship paths run under the pipeline lock while
+    # replicas decode — they must stay pure host bookkeeping except
+    # for the audited staging flush inside materialize()
+    "DecodeEngine.admit_handoff",
+    "DecodeEngine.admit_degraded",
+    "DecodeEngine._admit_swapped",
+    "DecodeEngine._finish_admit",
+    "PrefillEngine._decode_once",
+    "PrefillEngine._collect_admissions",
+    "DisaggCoordinator._ship_locked",
+    "DisaggCoordinator._submit_locked",
+    "FleetRouter._ship_handoffs_locked",
+    "FleetRouter._disagg_wins_locked",
     "make_paged_decode_step_async",
     # the TP shard_map lanes (PR 7): the sharded step/prefill inner
     # fns and the quantized-collective builder must stay lint-clean
@@ -117,6 +132,7 @@ EXTRA_TRACED: List[str] = [
 # ---------------------------------------------------------------------------
 ENGINE_CLASSES: FrozenSet[str] = frozenset({
     "ContinuousBatchingEngine", "SpeculativeEngine",
+    "PrefillEngine", "DecodeEngine",
 })
 
 # Scheduler-mutation methods: calling one moves slots/pages under the
@@ -149,6 +165,14 @@ FLUSH_SAFE: Dict[str, str] = {
     "SpeculativeEngine._decode_once":
         "speculative rounds never populate _inflight — each round "
         "fetches its own outputs before bookkeeping",
+    "PrefillEngine._decode_once":
+        "prefill engines have no decode pipeline: overlap=True is "
+        "rejected at construction, so no dispatch is ever in flight "
+        "when a wave's slots export",
+    "DecodeEngine._admit_swapped":
+        "delegates to the base admission path, which runs behind "
+        "_step_inner's flush (the override only reclaims dead "
+        "handoff blobs on failure)",
 }
 
 
@@ -233,16 +257,52 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
                          "_stream", "_finished", "_prefix_owner",
                          "_next_rid", "routed", "failovers",
                          "rejected", "deaths", "replaces",
-                         "route_errors"}),
+                         "route_errors", "_handoffs",
+                         "disagg_decisions", "handoffs_shipped",
+                         "handoff_pages", "handoff_bytes",
+                         "colocated_fallbacks"}),
         locked_methods=frozenset({
             "_submit_locked", "_candidates_locked", "_place_locked",
             "_step_locked", "_on_death_locked", "_replace_locked",
             "_flush_pending_locked", "_finish_synth_locked",
             "_has_work_locked", "_accepting_locked",
             "_states_locked", "_snapshot_locked",
-            "_update_gauges_locked"}),
+            "_update_gauges_locked", "_ship_handoffs_locked",
+            "_transport_default", "_disagg_wins_locked",
+            "_count_disagg_placement_locked",
+            "_inflight_handoffs_locked", "_roles_locked"}),
         note="public API takes _lock; every *_locked helper is a "
-             "documented called-with-lock-held contract"),
+             "documented called-with-lock-held contract "
+             "(handoff_transport, _transport_default included: ship "
+             "runs inside the router step)"),
+    # disaggregation coordinator (PR 9): HTTP handler threads
+    # submit/cancel while the serving front's drive thread ticks the
+    # pipeline; the request table, handoff queues and pipeline
+    # counters all serialize on the coordinator lock (the two engines
+    # inherit engine-thread-only semantics — only ever touched under
+    # it).  Lock order: a server lock may wrap the coordinator lock
+    # (GenerationServer -> coordinator); the coordinator never takes
+    # the router/server lock, so no ABBA pairing exists.
+    "models.disagg.DisaggCoordinator": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_requests", "_prefill_rids", "_decode_rids",
+                         "_handoffs", "_degraded", "_stream",
+                         "_finished", "_next_rid", "routed",
+                         "handoffs_shipped", "handoff_pages",
+                         "handoff_bytes", "handoff_wall_s",
+                         "colocated_fallbacks", "last_decode_step_s",
+                         "last_tick_admissions"}),
+        locked_methods=frozenset({
+            "_submit_locked", "_step_locked", "_ship_locked",
+            "_commit_decode_locked", "_degrade_locked",
+            "_finish_synth_locked", "_update_gauges_locked",
+            "_inflight_locked", "_route_prefill_locked",
+            "_count_placement_locked"}),
+        exempt_methods=frozenset({"cache", "queued_tokens",
+                                  "retry_after_s"}),
+        note="public API takes _lock; engine-summing compatibility "
+             "properties read only host ints the serving front "
+             "already serializes behind its own lock"),
     # fleet HTTP front: same discipline as GenerationServer (it IS
     # GenerationServer's plumbing over the router)
     "fleet.server.FleetServer": SharedStateSpec(

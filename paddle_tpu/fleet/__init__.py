@@ -20,11 +20,22 @@ turns it into a servable SYSTEM:
   ``/stats`` and a per-replica ``/fleet`` state endpoint, reusing
   ``GenerationServer``'s handler plumbing.
 
+With ``roles=`` the router grows DISAGGREGATED serving lanes
+(docs/DISAGGREGATION.md): ``"prefill"`` replicas run admission waves
+and export KV handoff records, ``"decode"`` replicas adopt them
+through the zero-prefill restore path, and the PR-4 bytes-vs-FLOPs
+cost model routes per request (short prompts stay colocated).  The
+ship runs through a swappable ``handoff_transport`` seam — the
+in-process default pins the semantics; a sockets transport drops in
+for multi-host fleets.
+
 Every degradation path is driven by the deterministic fault plane
 (``paddle_tpu/testing/faults.py`` sites ``route_dispatch`` /
-``replica_death`` / ``replica_slow``) — chaos runs are reproducible
-tests, not hopes.  Failure semantics: docs/FAULT_TOLERANCE.md "Fleet
-failure-mode matrix"; metric catalogue: docs/OBSERVABILITY.md.
+``replica_death`` / ``replica_slow`` / ``kv_handoff``) — chaos runs
+are reproducible tests, not hopes.  Failure semantics:
+docs/FAULT_TOLERANCE.md "Fleet failure-mode matrix" + "Disaggregated
+prefill/decode failure-mode matrix"; metric catalogue:
+docs/OBSERVABILITY.md.
 """
 
 from .router import (FleetRouter, ReplicaHandle,       # noqa: F401

@@ -76,11 +76,16 @@ class ReplicaHandle:
 
     def __init__(self, idx: int, factory: Callable, *,
                  max_restarts: int = 3, window_s: float = 60.0,
-                 backoff_s: float = 0.0):
+                 backoff_s: float = 0.0, role: str = "unified"):
         self.idx = idx
         self._factory = factory
         self._sup_kw = dict(max_restarts=max_restarts,
                             window_s=window_s, backoff_s=backoff_s)
+        # serving lane (disaggregated prefill/decode, ROADMAP item 3):
+        # "prefill" replicas run admission waves and export KV handoff
+        # records; "decode" replicas adopt them through the
+        # zero-prefill restore path; "unified" serves both colocated
+        self.role = role
         self.state = "STARTING"
         self.error: Optional[str] = None
         self.deaths = 0
@@ -192,17 +197,73 @@ class FleetRouter:
                  max_restarts: int = 3,
                  restart_window_s: float = 60.0,
                  restart_backoff_s: float = 0.0,
+                 roles: Optional[Sequence[str]] = None,
+                 handoff_transport: Optional[Callable] = None,
+                 handoff_gbps: float = 10.0,
+                 handoff_chip_flops: Optional[float] = None,
+                 max_inflight_handoffs: int = 8,
                  metrics_registry=None, metrics_ring=None):
+        """``roles`` (one per factory, default all ``"unified"``)
+        grows DISAGGREGATED serving lanes: requests the PR-4
+        bytes-vs-FLOPs cost model prices above the handoff DMA route
+        to a ``"prefill"`` replica (its factory must build a
+        :class:`~paddle_tpu.models.disagg.PrefillEngine`), whose KV
+        handoff records the router ships — through
+        ``handoff_transport`` (default: in-process
+        ``DecodeEngine.admit_handoff``; a sockets transport replaces
+        this seam) — to the least-loaded ``"decode"`` replica.  Short
+        prompts stay colocated on decode/unified lanes; N prefill : M
+        decode replicas scale TTFT and TPOT independently.  A failed
+        ship/restore (``kv_handoff`` fault, full host tier, death
+        mid-handoff) degrades the request to a colocated re-prefill —
+        token-exact, counted in ``colocated_fallbacks``."""
         if not factories:
             raise ValueError("FleetRouter needs >= 1 replica factory")
+        if roles is None:
+            roles = ["unified"] * len(factories)
+        roles = list(roles)
+        if len(roles) != len(factories):
+            raise ValueError(
+                f"roles ({len(roles)}) must match factories "
+                f"({len(factories)})")
+        bad = [r for r in roles
+               if r not in ("unified", "prefill", "decode")]
+        if bad:
+            raise ValueError(
+                f"unknown replica role(s) {bad}: expected 'unified', "
+                f"'prefill' or 'decode'")
         self._lock = threading.Lock()
         self.prefix_routing = bool(prefix_routing)
         self.auto_replace = bool(auto_replace)
         self._replicas: List[ReplicaHandle] = [
             ReplicaHandle(i, f, max_restarts=max_restarts,
                           window_s=restart_window_s,
-                          backoff_s=restart_backoff_s)
-            for i, f in enumerate(factories)]
+                          backoff_s=restart_backoff_s, role=role)
+            for i, (f, role) in enumerate(zip(factories, roles))]
+        self._has_prefill_lane = "prefill" in roles
+        for h in self._replicas:
+            eng = h.engine
+            if h.role == "prefill" and \
+                    not hasattr(eng, "take_handoffs"):
+                raise ValueError(
+                    f"replica {h.idx} has role='prefill' but its "
+                    f"factory built {type(eng).__name__} — a prefill "
+                    f"lane needs a models.disagg.PrefillEngine (it "
+                    f"exports KV handoff records instead of decoding)")
+            if h.role == "decode" and \
+                    not hasattr(eng, "admit_handoff"):
+                raise ValueError(
+                    f"replica {h.idx} has role='decode' but its "
+                    f"factory built {type(eng).__name__} — a decode "
+                    f"lane needs a models.disagg.DecodeEngine (it "
+                    f"adopts KV handoffs through the zero-prefill "
+                    f"restore path)")
+        self.handoff_transport = handoff_transport \
+            if handoff_transport is not None else self._transport_default
+        self.handoff_gbps = float(handoff_gbps)
+        self.handoff_chip_flops = handoff_chip_flops
+        self.max_inflight_handoffs = int(max_inflight_handoffs)
+        self._handoffs: deque = deque()   # (record, freq) awaiting ship
         self._page = int(self._replicas[0].engine.cache.page)
         self._requests: Dict[int, _FleetRequest] = {}
         self._pending: deque = deque()    # orphans awaiting re-placement
@@ -213,12 +274,20 @@ class FleetRouter:
         self._next_rid = 0
         self._now = time.monotonic        # seam: tests pin the clock
         # routing stats (plain counters — exact even with metrics off)
-        self.routed = {"prefix": 0, "least_loaded": 0, "failover": 0}
+        self.routed = {"prefix": 0, "least_loaded": 0, "failover": 0,
+                       "disagg": 0}
+        # per-request cost-model verdicts on disagg fleets ("the
+        # decision is a counter, not a guess")
+        self.disagg_decisions = {"disagg": 0, "colocated": 0}
         self.failovers = 0
         self.rejected = 0
         self.deaths = 0
         self.replaces = 0
         self.route_errors = 0             # route_dispatch candidate fails
+        self.handoffs_shipped = 0
+        self.handoff_pages = 0
+        self.handoff_bytes = 0
+        self.colocated_fallbacks = 0      # degraded handoffs
         if metrics_registry is False:
             self.metrics = None
         else:
@@ -236,6 +305,15 @@ class FleetRouter:
             self.metrics = FleetMetrics(
                 metrics_registry if metrics_registry is not None
                 else MetricsRegistry(), ring=metrics_ring)
+        # disaggregation instruments (handoff traffic + fallbacks)
+        # share the fleet registry; only built when a prefill lane
+        # exists so unified fleets keep their exposition unchanged
+        if self._has_prefill_lane and self.metrics is not None:
+            from ..observability import DisaggMetrics
+            self.disagg_metrics = DisaggMetrics(
+                self.metrics.registry, ring=self.metrics.ring)
+        else:
+            self.disagg_metrics = None
         self._update_gauges_locked()
 
     # -- client side ------------------------------------------------------
@@ -268,8 +346,21 @@ class FleetRouter:
             # over as if it were still wanted
             freq.cancelled = True
             if freq.replica >= 0:
-                return self._replicas[freq.replica].supervisor.cancel(
+                ok = self._replicas[freq.replica].supervisor.cancel(
                     freq.local_rid)
+                # a prefill-lane request may have been exported this
+                # very tick (record not yet taken): the engine no
+                # longer knows the rid, but the cancelled mark above
+                # reclaims it at take/ship time — still a successful
+                # cancel from the client's side
+                return ok or \
+                    self._replicas[freq.replica].role == "prefill"
+            for i, (rec, f) in enumerate(self._handoffs):
+                if f is freq:
+                    # mid-handoff: reclaim the record inline
+                    del self._handoffs[i]
+                    rec.discard()
+                    break
             self._pending = deque(q for q in self._pending
                                   if q is not freq)
             self._finish_synth_locked(freq, "cancelled", None)
@@ -346,22 +437,106 @@ class FleetRouter:
                              deadline, now)
         # place BEFORE committing the rid: a rejected submit must not
         # burn a fleet rid or leave a phantom request entry
-        self._place_locked(freq, failover=False)
+        if self._disagg_wins_locked(len(prompt),
+                                    int(max_new_tokens)):
+            try:
+                self._place_locked(freq, failover=False,
+                                   lane="prefill")
+                self._count_disagg_placement_locked(True)
+            except ValueError:
+                # malformed/oversized request: every lane would
+                # refuse identically — the client's fault, no fallback
+                raise
+            except Exception:
+                # the prefill lane is saturated/down/faulting
+                # (QueueFullError, EngineDeadError, a route_dispatch
+                # fault surfacing as last_exc): colocation is strictly
+                # better than shedding — fall through to the serve
+                # lane (the 429 verdict belongs to it alone)
+                self._place_locked(freq, failover=False)
+                self._count_disagg_placement_locked(False)
+        else:
+            self._place_locked(freq, failover=False)
+            if self._has_prefill_lane:
+                self._count_disagg_placement_locked(False)
         self._next_rid += 1
         self._requests[freq.rid] = freq
         return freq.rid
 
-    def _candidates_locked(self, freq: _FleetRequest):
+    def _disagg_wins_locked(self, prompt_len: int,
+                            max_new_tokens: int = 0) -> bool:
+        """Per-request disaggregation verdict (pure): the PR-4
+        bytes-vs-FLOPs model prices the prefill stall a decode device
+        would pay against the handoff DMA; short prompts stay
+        colocated, a full in-flight handoff queue forces colocation
+        (bounded pipeline — backpressure, not growth), and a request
+        the decode lane's pool could never hold routes colocated so
+        the canonical submit() ValueError rejects it upfront.
+        Counting happens only once a placement LANDS
+        (:meth:`_count_disagg_placement_locked`), so a rejected
+        submit or a saturation fallback can never make the decision
+        counters disagree with where requests actually went."""
+        if not self._has_prefill_lane:
+            return False
+        ref = next((h for h in self._replicas
+                    if h.role != "prefill"), None)
+        if ref is None:
+            return False              # nowhere to decode: misconfig,
+            #                           placement will fail loudly
+        cache = ref.engine.cache
+        row_cap = min(cache.pages_max,
+                      cache.num_pages - 1) * cache.page
+        if prompt_len + max_new_tokens > row_cap:
+            return False
+        from ..models.disagg import handoff_wins
+        return self._inflight_handoffs_locked() \
+            < self.max_inflight_handoffs and \
+            handoff_wins(prompt_len, ref.engine, self.handoff_gbps,
+                         self.handoff_chip_flops)
+
+    def _count_disagg_placement_locked(self, disagg: bool) -> None:
+        self.disagg_decisions["disagg" if disagg
+                              else "colocated"] += 1
+        if self.disagg_metrics is not None:
+            (self.disagg_metrics.routed_prefill if disagg
+             else self.disagg_metrics.routed_colocated).inc()
+
+    def _inflight_handoffs_locked(self) -> int:
+        """Handoffs anywhere in the fleet pipeline: exported-untaken
+        on prefill replicas + router-pending + adopted-unadmitted on
+        decode replicas."""
+        n = len(self._handoffs)
+        for h in self._replicas:
+            if h.state == "DEAD":
+                continue
+            eng = h.engine
+            if h.role == "prefill":
+                n += len(getattr(eng, "_handoff_ready", ()))
+            elif h.role == "decode":
+                n += eng.pending_handoffs()
+        return n
+
+    def _candidates_locked(self, freq: _FleetRequest,
+                           lane: str = "serve"):
         """Routing order: prefix owner first (READY only), then READY
-        by ascending load, then DEGRADED by load as a last resort.
-        Returns ``(candidates, prefix_hit_idx, prefix_key)`` — the
-        key is computed once here and reused by the placement (the
-        hash runs under the contended router lock)."""
+        by ascending load, then DEGRADED by load as a last resort —
+        within the requested LANE (``"serve"`` = decode + unified
+        replicas, the client-facing default; ``"prefill"`` = the
+        disaggregated admission lane).  Returns ``(candidates,
+        prefix_hit_idx, prefix_key)`` — the key is computed once here
+        and reused by the placement (the hash runs under the
+        contended router lock)."""
+        if lane == "prefill":
+            def _in_lane(h):
+                return h.role == "prefill"
+        else:
+            def _in_lane(h):
+                return h.role != "prefill"
         ready = sorted((h for h in self._replicas
-                        if h.state == "READY"),
+                        if h.state == "READY" and _in_lane(h)),
                        key=lambda h: h.load())
         degraded = sorted((h for h in self._replicas
-                           if h.state == "DEGRADED"),
+                           if h.state == "DEGRADED" and _in_lane(h)),
                           key=lambda h: h.load())
         cands = ready + degraded
         prefix_hit = None
@@ -378,11 +553,15 @@ class FleetRouter:
         return cands, prefix_hit, key
 
     def _place_locked(self, freq: _FleetRequest,
-                      failover: bool) -> None:
-        """Hand ``freq`` to the best available replica; raises when no
-        replica took it (``QueueFullError`` with the aggregate
-        ``retry_after`` when every refusal was backpressure)."""
-        cands, prefix_hit, key = self._candidates_locked(freq)
+                      failover: bool, lane: str = "serve") -> None:
+        """Hand ``freq`` to the best available replica in ``lane``;
+        raises when no replica took it (``QueueFullError`` with the
+        aggregate ``retry_after`` when every refusal was
+        backpressure).  Failover re-placements always run on the
+        serve lane: a re-prefill on a decode/unified replica is
+        token-exact, while a re-disaggregation would re-pay the
+        handoff for a request that already lost one."""
+        cands, prefix_hit, key = self._candidates_locked(freq, lane)
         if not cands:
             raise EngineDeadError(
                 f"no replica available: {self._states_locked()}")
@@ -423,7 +602,8 @@ class FleetRouter:
                 continue
             h.local_rids[local] = freq.rid
             freq.replica, freq.local_rid = h.idx, local
-            reason = ("failover" if failover
+            reason = ("disagg" if lane == "prefill"
+                      else "failover" if failover
                       else "prefix" if prefix_hit == h.idx
                       else "least_loaded")
             self.routed[reason] += 1
@@ -437,7 +617,8 @@ class FleetRouter:
                 m = self.metrics
                 {"prefix": m.routed_prefix,
                  "least_loaded": m.routed_least_loaded,
-                 "failover": m.routed_failover}[reason].inc()
+                 "failover": m.routed_failover,
+                 "disagg": m.routed_disagg}[reason].inc()
             return
         if queue_full:
             # FLEET-WIDE admission verdict: every admitting replica's
@@ -476,6 +657,11 @@ class FleetRouter:
         # 2. re-place orphans (failover) before stepping: they re-enter
         # FIFO so a crash costs one tick of queue position, not more
         self._flush_pending_locked(now)
+        # 2b. ship handoffs taken LAST tick (their staged D2H copies
+        # have ridden under the intervening dispatches — the T3
+        # pipelining discipline; see models/disagg.py)
+        if self._handoffs:
+            self._ship_handoffs_locked(now)
         # 3. step every serving replica, then merge its outputs
         active = 0
         for h in self._replicas:
@@ -498,6 +684,25 @@ class FleetRouter:
             except Exception as exc:
                 self._on_death_locked(h, exc)
                 continue
+            if h.role == "prefill":
+                # take the wave's exported records: popping the local
+                # rid here (a) hands ownership to the router pipeline
+                # and (b) makes the stream/finished merges below skip
+                # these requests (their first token streams at the
+                # DECODE side's admission — the failover-eligibility
+                # window stays open until then)
+                for rec in h.engine.take_handoffs():
+                    rid = h.local_rids.pop(rec.request.rid, None)
+                    freq = None if rid is None \
+                        else self._requests.get(rid)
+                    if freq is None or freq.cancelled:
+                        rec.discard()
+                        if freq is not None:
+                            self._finish_synth_locked(
+                                freq, "cancelled", None)
+                        continue
+                    freq.replica, freq.local_rid = -1, -1
+                    self._handoffs.append((rec, freq))
             for local, tok in h.supervisor.drain_stream():
                 rid = h.local_rids.get(local)
                 if rid is None:
@@ -608,6 +813,111 @@ class FleetRouter:
                     f"{type(e).__name__}: {e}")
         self._pending = keep
 
+    # -- KV handoff shipping (disaggregated lanes) ------------------------
+    def _transport_default(self, rec, h: ReplicaHandle) -> int:
+        """In-process handoff transport: materialise on the source
+        side, adopt on the destination's host tier (the
+        ``kv_handoff`` fault site's two halves fire inside).  Returns
+        the decode-side local rid.  A multi-host deployment replaces
+        THIS seam with a sockets transport — the record's
+        ``materialize()`` blobs are plain numpy, wire-format ready —
+        while every routing/failover/backpressure decision above it
+        stays unchanged."""
+        eng = h.engine
+        if not hasattr(eng, "admit_handoff"):
+            raise RuntimeError(
+                f"replica {h.idx} (role {h.role!r}) cannot adopt a "
+                f"KV handoff — ship targets need a DecodeEngine")
+        rec.materialize()
+        return eng.admit_handoff(rec)
+
+    def _ship_handoffs_locked(self, now: float) -> None:
+        """Ship every pending handoff to the least-loaded decode-lane
+        replica.  Backpressure (every target's queue full) keeps the
+        record pending — an accepted request is never 429'd; any
+        other failure (``kv_handoff`` fault, full host tier, no
+        decode lane up) DEGRADES the request to a colocated
+        re-prefill through the ordinary failover placement —
+        token-exact, counted, never dropped."""
+        keep: deque = deque()
+        while self._handoffs:
+            rec, freq = self._handoffs.popleft()
+            if freq.cancelled:
+                rec.discard()
+                self._finish_synth_locked(freq, "cancelled", None)
+                continue
+            if freq.deadline and now >= freq.deadline:
+                rec.discard()
+                self._finish_synth_locked(freq, "expired", None)
+                continue
+            targets = [h for h in self._replicas
+                       if h.role == "decode" and h.state == "READY"]
+            targets.sort(key=lambda h: h.load())
+            t0 = time.perf_counter()
+            shipped = False
+            queue_full = False
+            for h in targets:
+                try:
+                    local = self.handoff_transport(rec, h)
+                except QueueFullError:
+                    queue_full = True
+                    continue
+                except Exception:
+                    # ship/restore fault or a full host tier: one
+                    # failed target does not fail the handoff — but a
+                    # consumed fault rule means THIS record's ship is
+                    # poisoned, so degrade rather than hammer the
+                    # next target with a half-materialised record
+                    shipped = False
+                    queue_full = False
+                    break
+                h.local_rids[local] = freq.rid
+                freq.replica, freq.local_rid = h.idx, local
+                shipped = True
+                dt = time.perf_counter() - t0
+                self.handoffs_shipped += 1
+                self.handoff_pages += rec.pages
+                self.handoff_bytes += rec.nbytes
+                if self.disagg_metrics is not None:
+                    m = self.disagg_metrics
+                    m.handoff_pages.inc(rec.pages)
+                    m.handoff_bytes.inc(rec.nbytes)
+                    m.handoff_seconds.observe(dt)
+                break
+            if shipped:
+                continue
+            if queue_full:
+                keep.append((rec, freq))       # retry next tick
+                continue
+            # no decode target took it: degrade to a colocated
+            # re-prefill.  Prefer admit_degraded on a decode-lane
+            # replica — it PRESERVES the already-sampled first token
+            # (token-exact at any temperature, single emission);
+            # otherwise fall back to the standard failover placement
+            # (fresh prefill — identical under greedy decode; the
+            # pending queue absorbs a saturated fleet)
+            rec.discard()
+            self.colocated_fallbacks += 1
+            if self.disagg_metrics is not None:
+                self.disagg_metrics.colocated_fallback.inc()
+                self.disagg_metrics.ring.emit(
+                    "kv_handoff_fallback", rid=freq.rid)
+            placed = False
+            for h in targets:
+                if not hasattr(h.engine, "admit_degraded"):
+                    continue
+                try:
+                    local = h.engine.admit_degraded(rec.request)
+                except Exception:
+                    continue
+                h.local_rids[local] = freq.rid
+                freq.replica, freq.local_rid = h.idx, local
+                placed = True
+                break
+            if not placed:
+                self._pending.append(freq)
+        self._handoffs = keep
+
     def _finish_synth_locked(self, freq: _FleetRequest, status: str,
                              error: Optional[str]) -> None:
         """Terminal message for a request no engine owns anymore
@@ -632,13 +942,15 @@ class FleetRouter:
         # count: drivers that never drain it — run_to_completion —
         # must still terminate, and a stream tail without its
         # terminal message has no blocked waiter to unblock.)
-        if self._pending or self._finished:
+        if self._pending or self._finished or self._handoffs:
             return True
         return any(h.state != "DEAD" and h.supervisor.has_work()
                    for h in self._replicas)
 
     def _accepting_locked(self) -> bool:
-        return any(h.admitting and
+        # prefill-lane replicas never serve a request END TO END —
+        # readiness needs a decode/unified lane with capacity
+        return any(h.admitting and h.role != "prefill" and
                    h.engine.queue_capacity_reason() is None
                    for h in self._replicas)
 
@@ -653,7 +965,7 @@ class FleetRouter:
         for h in self._replicas:
             eng = h.engine
             reps.append({
-                "idx": h.idx, "state": h.state,
+                "idx": h.idx, "state": h.state, "role": h.role,
                 "active": len(eng._active),
                 "queued": len(eng._queue),
                 "queued_tokens": eng.queued_tokens(),
@@ -668,16 +980,33 @@ class FleetRouter:
                 "drains": h.drains, "slow_ticks": h.slow_ticks,
                 "error": h.error,
             })
-        return {"replicas": reps,
-                "states": self._states_locked(),
-                "routed": dict(self.routed),
-                "failovers": self.failovers,
-                "rejected": self.rejected,
-                "deaths": self.deaths,
-                "replaces": self.replaces,
-                "route_errors": self.route_errors,
-                "pending_failovers": len(self._pending),
-                "requests_live": len(self._requests)}
+        doc = {"replicas": reps,
+               "states": self._states_locked(),
+               "roles": self._roles_locked(),
+               "routed": dict(self.routed),
+               "failovers": self.failovers,
+               "rejected": self.rejected,
+               "deaths": self.deaths,
+               "replaces": self.replaces,
+               "route_errors": self.route_errors,
+               "pending_failovers": len(self._pending),
+               "requests_live": len(self._requests)}
+        if self._has_prefill_lane:
+            doc["disagg"] = {
+                "decisions": dict(self.disagg_decisions),
+                "handoffs_shipped": self.handoffs_shipped,
+                "handoff_pages": self.handoff_pages,
+                "handoff_bytes": self.handoff_bytes,
+                "handoffs_inflight":
+                    self._inflight_handoffs_locked(),
+                "colocated_fallbacks": self.colocated_fallbacks}
+        return doc
+
+    def _roles_locked(self) -> dict:
+        out = {"unified": 0, "prefill": 0, "decode": 0}
+        for h in self._replicas:
+            out[h.role] += 1
+        return out
 
     def _update_gauges_locked(self) -> None:
         if self.metrics is None:
@@ -690,6 +1019,13 @@ class FleetRouter:
         m.replicas_draining.set(states["DRAINING"])
         m.replicas_dead.set(states["DEAD"])
         m.pending_failovers.set(len(self._pending))
+        roles = self._roles_locked()
+        m.role_prefill.set(roles["prefill"])
+        m.role_decode.set(roles["decode"])
+        m.role_unified.set(roles["unified"])
+        if self.disagg_metrics is not None:
+            self.disagg_metrics.handoff_inflight.set(
+                self._inflight_handoffs_locked())
 
     def _prefix_key(self, prompt: np.ndarray) -> Optional[int]:
         """Affinity key: the prompt's FULL pages (what the prefix

@@ -783,6 +783,15 @@ class GenerationServer:
             h["spec_accepted"] = int(v(
                 snap, "paddle_tpu_spec_accepted_tokens_total"))
             h["gamma"] = gamma
+        if "paddle_tpu_disagg_handoff_pages_total" in snap:
+            # disaggregated prefill/decode front (DisaggCoordinator /
+            # role-aware fleet): surface the handoff pipeline
+            h["handoff_pages"] = int(v(
+                snap, "paddle_tpu_disagg_handoff_pages_total"))
+            h["handoff_inflight"] = int(v(
+                snap, "paddle_tpu_disagg_handoff_inflight_count"))
+            h["disagg_colocated_fallbacks"] = int(v(
+                snap, "paddle_tpu_disagg_colocated_fallback_total"))
         return h
 
     def submit(self, prompt, max_new_tokens, deadline_s=None):

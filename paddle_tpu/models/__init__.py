@@ -21,3 +21,5 @@ from .serving_engine import (  # noqa: F401
     ContinuousBatchingEngine, Request)
 from .speculative import (  # noqa: F401
     generate_speculative, SpeculativeEngine)
+from .disagg import (  # noqa: F401
+    DisaggCoordinator, DecodeEngine, HandoffRecord, PrefillEngine)

@@ -156,6 +156,11 @@ class PagedKVCache:
         self._demote_pending: list = []   # (pid, hid) gathers to stage
         self._swapped: dict = {}          # handle -> swapped-row record
         self._next_swap = 0
+        # live cross-cache exports (disaggregated prefill/decode KV
+        # handoff): export id -> staging state; audit() accounts their
+        # host pages until export_fetch/export_discard resolves them
+        self._exports: dict = {}
+        self._next_export = 0
         self.prefix_promotions = 0        # host->HBM page promotions
         self.swap_out_pages = 0
         self.swap_in_pages = 0
@@ -762,6 +767,113 @@ class PagedKVCache:
             else:
                 self._host_free(val)
 
+    # -- cross-cache KV handoff (disaggregated prefill/decode) ------------
+    def export_row(self, b: int) -> dict:
+        """Stage row ``b``'s WHOLE written context (shared prefix pages
+        included — a foreign cache holds none of our pages) for a
+        CROSS-CACHE handoff and release the row.  Unlike
+        :meth:`swap_out_row`, the result is portable: pages destined
+        for another engine's pool, not a parked record in this one.
+
+        The gather stages through the host tier's async D2H path when
+        capacity allows (the copy then rides under neighbouring
+        dispatches — the same T3 discipline swap-out uses; the
+        disaggregation coordinator materialises one tick later,
+        after the next prefill wave has been dispatched over it) and
+        falls back to a synchronous fetch otherwise.  Returns an
+        opaque export state for :meth:`export_fetch` /
+        :meth:`export_discard`; live exports are tracked so
+        :meth:`audit` accounts their host pages."""
+        page = self.page
+        L = int(self.lens[b])
+        npg = (L + page - 1) // page
+        pids = self._owned[b][:npg]
+        state = {"id": self._next_export, "lens": L, "pages": npg}
+        self._next_export += 1
+        if npg and self.host is not None \
+                and self.host_available() >= npg:
+            hids = [self._host_alloc() for _ in range(npg)]
+            self._stage_swap_out(pids, hids)
+            state["hids"] = hids
+        elif npg:
+            ids = jnp.asarray(np.asarray(pids, np.int32))
+            state["k"] = np.asarray(self.kpool[:, ids])
+            state["v"] = np.asarray(self.vpool[:, ids])
+            if self.kv_quant == "int8":
+                state["ks"] = np.asarray(self.kscale[:, ids])
+                state["vs"] = np.asarray(self.vscale[:, ids])
+        self.release_row(b)
+        self._exports[state["id"]] = state
+        return state
+
+    def export_fetch(self, state: dict):
+        """Materialise an export into portable numpy blocks
+        ``(k, v, kscale, vscale, ctx_len)`` (scales ``None`` for
+        non-int8 pools) and free the staging host pages.  This is the
+        handoff's one blocking point — the host-pool flush commits
+        copies that have been riding under dispatches since
+        :meth:`export_row`."""
+        self._exports.pop(state["id"], None)
+        if "hids" in state:
+            k, v, ks, vs = self.host.gather(state["hids"])
+            for hid in state["hids"]:
+                self._host_free(hid)
+            return k, v, ks, vs, state["lens"]
+        return (state.get("k"), state.get("v"), state.get("ks"),
+                state.get("vs"), state["lens"])
+
+    def export_discard(self, state: dict) -> None:
+        """Drop an un-shipped export (its request degraded to a
+        colocated re-prefill, or its prefill engine died): staging
+        host pages free, nothing leaks (audit-verified)."""
+        if self._exports.pop(state["id"], None) is None:
+            return                     # already fetched or discarded
+        for hid in state.get("hids", ()):
+            self._host_free(hid)
+
+    def adopt_swap(self, k, v, kscale, vscale, length: int) -> int:
+        """Import a shipped context into THIS cache's host tier as a
+        swap record (all-``host`` entries) — the receiving half of a
+        KV handoff.  The owning engine maps the returned handle to its
+        request and re-admits through the ordinary ``_admit_swapped``
+        path: ONE batched restore scatter, zero prefill tokens, the
+        exact machinery preemption resume already trusts.  Raises
+        ``RuntimeError`` (before mutating) when there is no host tier
+        or it cannot hold the pages — the caller degrades the request
+        to a colocated re-prefill."""
+        if self.host is None:
+            raise RuntimeError(
+                "adopt_swap needs a host page tier on the receiving "
+                "cache (PagedKVCache(host_pages=N)) — handoff records "
+                "park there until their batched restore")
+        npg = (int(length) + self.page - 1) // self.page
+        if self.host_available() < npg:
+            raise RuntimeError(
+                f"host tier full: {npg} pages to adopt, "
+                f"{self.host_available()} available")
+        if k.dtype != self.host.kbuf.dtype:
+            raise ValueError(
+                f"handoff dtype {k.dtype} != pool dtype "
+                f"{self.host.kbuf.dtype} (source and destination "
+                f"caches must share dtype/kv_quant for a bitwise "
+                f"restore)")
+        if (kscale is None) == (self.kv_quant == "int8"):
+            raise ValueError(
+                "handoff kv_quant mismatch: int8 records need their "
+                "scale planes and fp records must not carry them")
+        hids = [self._host_alloc() for _ in range(npg)]
+        self.host.kbuf[:, hids] = k
+        self.host.vbuf[:, hids] = v
+        if self.kv_quant == "int8":
+            self.host.kscale[:, hids] = kscale
+            self.host.vscale[:, hids] = vscale
+        handle = self._next_swap
+        self._next_swap += 1
+        self._swapped[handle] = {
+            "entries": [("host", h) for h in hids],
+            "lens": int(length)}
+        return handle
+
     # -- page-accounting audit --------------------------------------------
     def audit(self) -> dict:
         """Check every page-accounting invariant and return pool
@@ -827,7 +939,9 @@ class PagedKVCache:
                 "host free list has duplicates"
             used = list(self._host_prefix_index.values()) + [
                 hid for rec in self._swapped.values()
-                for kind, hid in rec["entries"] if kind == "host"]
+                for kind, hid in rec["entries"] if kind == "host"] + [
+                hid for st in self._exports.values()
+                for hid in st.get("hids", ())]
             assert len(set(used)) == len(used), \
                 "host page held twice"
             assert not (set(hfree) & set(used)), \

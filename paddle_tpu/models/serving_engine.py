@@ -95,6 +95,31 @@ def _release_engine_claims(engine) -> None:
         except Exception:
             pass
     engine._swap_handles.clear()
+    # engines with claims beyond rows + swap records (the disagg
+    # PrefillEngine's staged handoff exports) release them through
+    # this seam so orphaned handoff records are reclaimed, not leaked
+    extra = getattr(engine, "release_extra_claims", None)
+    if extra is not None:
+        try:
+            extra()
+        except Exception:
+            pass
+
+
+def _chip_flops_default() -> float:
+    """Assumed chip compute rate for the bytes-vs-FLOPs cost models
+    (preemption swap-vs-recompute, disagg handoff-vs-stall): v5e bf16
+    peak on TPU, a conservative figure otherwise.  ONE definition —
+    the models must never disagree about the chip."""
+    return (197e12 if jax.devices()[0].platform in ("tpu", "axon")
+            else 5e10)
+
+
+def _count_params(params) -> int:
+    """Total parameter count (the 2*N*L FLOPs-per-token estimate's
+    N); engines cache it in ``_n_params``."""
+    return sum(int(np.prod(x.shape))
+               for x in jax.tree_util.tree_leaves(params))
 
 
 def _drive_to_completion(driver, max_steps: int):
@@ -962,13 +987,10 @@ class ContinuousBatchingEngine:
         if cache.host_available() < private:
             return "recompute"    # host tier full
         if self._n_params is None:
-            self._n_params = sum(
-                int(np.prod(x.shape))
-                for x in jax.tree_util.tree_leaves(self.params))
+            self._n_params = _count_params(self.params)
         chip = self.offload_chip_flops
         if chip is None:
-            chip = (197e12 if jax.devices()[0].platform
-                    in ("tpu", "axon") else 5e10)
+            chip = _chip_flops_default()
         swap_s = (2.0 * private * cache.page_bytes
                   / (self.offload_swap_gbps * 1e9))
         recompute_s = 2.0 * self._n_params * L / chip
@@ -1801,6 +1823,14 @@ class EngineSupervisor:
                     new._has_deadlines = True
         old._queue.clear()
         new._next_rid = max(new._next_rid, old._next_rid)
+        # engines carrying cross-engine state (the disagg DecodeEngine's
+        # adopted-but-unadmitted KV handoffs, the PrefillEngine's
+        # exported-but-untaken records) re-register / fail it here — a
+        # rebuilt decode engine must not strand the prefill side's
+        # half of an in-flight handoff until its deadline
+        hook = getattr(new, "transplant_extra", None)
+        if hook is not None:
+            hook(old)
         new.last_fault = text
         self.engine = new
         self._restart_times.append(now)

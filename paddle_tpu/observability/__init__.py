@@ -26,7 +26,9 @@ from .metrics import (Counter, Gauge, Histogram,       # noqa: F401
 from .engine_metrics import (EngineMetrics,            # noqa: F401
                              bind_engine_gauges)
 from .fleet_metrics import FleetMetrics                # noqa: F401
+from .disagg_metrics import DisaggMetrics              # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "EventRing", "default_ring",
-           "EngineMetrics", "bind_engine_gauges", "FleetMetrics"]
+           "EngineMetrics", "bind_engine_gauges", "FleetMetrics",
+           "DisaggMetrics"]

@@ -68,6 +68,22 @@ class FleetMetrics:
             "Accepted requests orphaned by a replica death, waiting "
             "for re-placement on a healthy replica")
 
+        # -- engine roles (per-role flattening of
+        #    fleet_replicas{role=...} — disaggregated serving lanes) ----
+        self.role_prefill = r.gauge(
+            "paddle_tpu_fleet_role_prefill_count",
+            "Replicas serving the PREFILL lane of a disaggregated "
+            "fleet (admission waves + KV handoff export, no decode)")
+        self.role_decode = r.gauge(
+            "paddle_tpu_fleet_role_decode_count",
+            "Replicas serving the DECODE lane (adopt KV handoffs "
+            "through the zero-prefill restore path + colocated "
+            "short-prompt traffic)")
+        self.role_unified = r.gauge(
+            "paddle_tpu_fleet_role_unified_count",
+            "Replicas serving both phases colocated (the pre-disagg "
+            "default)")
+
         # -- routing decisions (per-reason flattening of
         #    fleet_routed_total{reason=...}) ----------------------------
         self.routed_prefix = r.counter(
@@ -82,6 +98,11 @@ class FleetMetrics:
             "paddle_tpu_fleet_routed_failover_total",
             "Re-placements of requests orphaned by a replica death "
             "(the transparent resubmission path)")
+        self.routed_disagg = r.counter(
+            "paddle_tpu_fleet_routed_disagg_total",
+            "Requests the bytes-vs-FLOPs cost model placed on a "
+            "prefill-role replica (disaggregated admission; the KV "
+            "handoff to a decode lane follows)")
 
         # -- degradation ------------------------------------------------
         self.failovers = r.counter(

@@ -46,6 +46,17 @@ site                   consulted by
                        this tick) and is marked DEGRADED so routing
                        steers around it; it recovers to READY when the
                        rule stops matching
+``kv_handoff``         the disaggregated prefill/decode handoff, TWO
+                       halves per handoff: the SHIP half fires in
+                       ``HandoffRecord.materialize`` (the staging
+                       flush committing the async D2H copies) and the
+                       RESTORE half in ``DecodeEngine.admit_handoff``
+                       (before the record adopts into the receiving
+                       host tier).  Either failure degrades the
+                       request to a colocated re-prefill on the
+                       decode side — token-exact, counted in
+                       ``disagg_colocated_fallback_total``, never a
+                       dropped request
 =====================  ==================================================
 
 Faults are DETERMINISTIC: rules match by call index (``nth`` = exactly

@@ -1,0 +1,719 @@
+"""Disaggregated prefill/decode serving (paddle_tpu/models/disagg.py
++ role-aware fleet routing) — the PR-9 tentpole.
+
+Contract under test:
+* a 1P+1D `DisaggCoordinator` produces TOKEN-EXACT outputs vs a
+  unified engine across the packed and chunked admission lanes, int8
+  KV caches, an overlap=True decode engine, and an mp=4 mesh — the
+  handoff is the bitwise swap-record machinery, so greedy decode
+  cannot tell the difference;
+* the decode engine admits disagg traffic EXCLUSIVELY through the
+  `_admit_swapped` restore path: ZERO prefill dispatches, pinned by
+  counting `prefill_calls`, with `prefill_tokens_avoided` counting
+  every handed-off context token;
+* the bytes-vs-FLOPs cost model keeps short prompts colocated and
+  sends long ones through the handoff — the decision is a counter;
+* every failure of the handoff (`kv_handoff` ship/restore faults, a
+  full receiving host tier, replica death mid-handoff, a supervisor
+  restart of the decode engine) degrades to a colocated re-prefill
+  or a re-registered restore — token-exact, never a dropped request,
+  `PagedKVCache.audit()` clean on every path, orphaned records
+  reclaimed rather than leaked;
+* the bounded in-flight handoff queue backpressures prefill
+  admission instead of growing host memory.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fleet import FleetRouter
+from paddle_tpu.models.disagg import (DecodeEngine, DisaggCoordinator,
+                                      PrefillEngine,
+                                      handoff_flip_gbps, handoff_wins)
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              build_mesh, init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import (ContinuousBatchingEngine,
+                                              EngineSupervisor,
+                                              Request)
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # identical to tests/test_fleet.py's config so the jitted-program
+    # caches (keyed on cfg) are shared across the suite
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+_RNG = np.random.RandomState(55)
+_PROMPTS = [_RNG.randint(1, 128, (L,)) for L in (10, 33, 21, 40)]
+_SHORT = [_RNG.randint(1, 128, (L,)) for L in (3, 5)]
+
+_CACHE_KW = dict(num_pages=64, pages_max=8, batch=2, page=16)
+
+
+def _cache(cfg, host_pages=32, **kw):
+    ck = dict(_CACHE_KW)
+    ck.update(kw)
+    return PagedKVCache(cfg, host_pages=host_pages, **ck)
+
+
+def _pair(cfg, params, pe_kw=None, de_kw=None, co_kw=None,
+          pe_cache=None, de_cache=None):
+    pe = PrefillEngine(cfg, params,
+                       pe_cache if pe_cache is not None
+                       else _cache(cfg),
+                       metrics_registry=False, **(pe_kw or {}))
+    de = DecodeEngine(cfg, params,
+                      de_cache if de_cache is not None
+                      else _cache(cfg),
+                      metrics_registry=False, **(de_kw or {}))
+    co = DisaggCoordinator(pe, de, metrics_registry=False,
+                           **dict({"force_route": "prefill"},
+                                  **(co_kw or {})))
+    return pe, de, co
+
+
+_REF = {}
+
+
+def _ref(cfg, params, prompts, new=8, kv_quant=None):
+    """Unified-engine greedy outputs per prompt index (any disagg
+    arrangement must match token-exactly)."""
+    key = (tuple(tuple(p) for p in prompts), new, kv_quant)
+    if key not in _REF:
+        eng = ContinuousBatchingEngine(
+            cfg, params, _cache(cfg, host_pages=0, kv_quant=kv_quant),
+            metrics_registry=False)
+        rids = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        _REF[key] = [done[r] for r in rids]
+    return _REF[key]
+
+
+def _drive(co, prompts, new=8, **submit_kw):
+    """Submit + drive a coordinator, collecting stream and finished."""
+    rids = [co.submit(p, max_new_tokens=new, **submit_kw)
+            for p in prompts]
+    stream = {r: [] for r in rids}
+    done = {}
+    steps = 0
+    while co.has_work():
+        co.step()
+        for rid, t in co.drain_stream():
+            stream[rid].append(t)
+        for r in co.finished():
+            done[r.rid] = r
+        steps += 1
+        assert steps < 2000, "coordinator did not drain"
+    return rids, done, stream
+
+
+# ---------------------------------------------------------------------------
+# token-exactness matrix + the zero-prefill-dispatch pin
+# ---------------------------------------------------------------------------
+def test_disagg_token_exact_packed_zero_prefill(cfg, params):
+    """The tentpole pin: 1P+1D output is token-exact vs unified, the
+    decode engine runs ZERO prefill dispatches (counted, not vibes),
+    every context token rides the handoff, and the stream carries
+    exactly the generated tokens (first token included, once)."""
+    ref = _ref(cfg, params, _PROMPTS)
+    pe, de, co = _pair(cfg, params)
+    rids, done, stream = _drive(co, _PROMPTS)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert all(done[r].status == "ok" for r in rids)
+    assert de.prefill_calls == 0, \
+        "disagg traffic must NEVER prefill on the decode engine"
+    assert de.decode_steps > 0 and pe.decode_steps == 0
+    assert pe.prefill_calls >= 1       # the packed lane ran the waves
+    assert co.handoffs_shipped == len(_PROMPTS)
+    assert co.routed == {"prefill": len(_PROMPTS), "colocated": 0}
+    assert de.handoff_admits == len(_PROMPTS)
+    assert de.prefill_tokens_avoided == sum(len(p) for p in _PROMPTS)
+    for r in rids:
+        assert stream[r] == list(done[r].generated)
+    pe.cache.audit()
+    de.cache.audit()
+    assert co._inflight_locked() == 0
+
+
+def test_disagg_token_exact_chunked_lane(cfg, params):
+    """The chunked admission lane (packed=False + prefill_chunk) on
+    the prefill engine hands off token-exact too."""
+    ref = _ref(cfg, params, _PROMPTS)
+    pe, de, co = _pair(cfg, params,
+                       pe_kw=dict(packed=False, prefill_chunk=32))
+    rids, done, _ = _drive(co, _PROMPTS)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert de.prefill_calls == 0
+    pe.cache.audit()
+    de.cache.audit()
+
+
+def test_disagg_token_exact_int8_kv(cfg, params):
+    """int8 KV caches on both sides: the handoff ships the int8 pages
+    + scale planes bitwise, so output matches the unified int8 engine
+    exactly."""
+    ref = _ref(cfg, params, _PROMPTS, kv_quant="int8")
+    pe, de, co = _pair(cfg, params,
+                       pe_cache=_cache(cfg, kv_quant="int8"),
+                       de_cache=_cache(cfg, kv_quant="int8"))
+    rids, done, _ = _drive(co, _PROMPTS)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert de.prefill_calls == 0
+    pe.cache.audit()
+    de.cache.audit()
+
+
+def test_disagg_token_exact_decode_overlap(cfg, params):
+    """The decode engine runs the dispatch-ahead pipeline
+    (overlap=True): handoff admissions are scheduler mutations behind
+    its flush discipline, and output stays token-exact."""
+    ref = _ref(cfg, params, _PROMPTS)
+    pe, de, co = _pair(cfg, params, de_kw=dict(overlap=True))
+    rids, done, stream = _drive(co, _PROMPTS)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert de.prefill_calls == 0
+    for r in rids:
+        assert stream[r] == list(done[r].generated)
+    pe.cache.audit()
+    de.cache.audit()
+
+
+@pytest.mark.tp
+def test_disagg_token_exact_tp_mesh(params):
+    """Both engines on a 4-way mesh: packed TP admission on the
+    prefill side (one dispatch per wave), per-shard staged handoff,
+    GSPMD-resharded restore on the decode side — token-exact vs the
+    unified TP engine."""
+    cfg4 = LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+    mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=4,
+                      devices=jax.devices()[:4])
+    params4 = init_params(cfg4, jax.random.PRNGKey(0), mesh)
+    prompts = [_RNG.randint(1, 128, (L,)) for L in (10, 26)]
+
+    def mk(host):
+        return PagedKVCache(cfg4, mesh=mesh, host_pages=host,
+                            **_CACHE_KW)
+
+    ref_eng = ContinuousBatchingEngine(cfg4, params4, mk(0),
+                                       mesh=mesh,
+                                       metrics_registry=False)
+    rids = [ref_eng.submit(p, max_new_tokens=6) for p in prompts]
+    ref = {r.rid: list(r.generated)
+           for r in ref_eng.run_to_completion()}
+    ref = [ref[r] for r in rids]
+
+    pe = PrefillEngine(cfg4, params4, mk(32), mesh=mesh,
+                       metrics_registry=False)
+    de = DecodeEngine(cfg4, params4, mk(32), mesh=mesh,
+                      metrics_registry=False)
+    co = DisaggCoordinator(pe, de, force_route="prefill",
+                           metrics_registry=False)
+    rids, done, _ = _drive(co, prompts, new=6)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert de.prefill_calls == 0
+    assert pe.prefill_calls == 1, \
+        "a mixed wave on the mesh must stay ONE packed dispatch"
+    pe.cache.audit()
+    de.cache.audit()
+
+
+def test_first_token_at_max_new_tokens_1_finishes_on_prefill(
+        cfg, params):
+    """A request whose budget is exhausted by the sampled first token
+    finishes ON the prefill engine — no handoff ships, the stream
+    still carries its one token."""
+    ref = _ref(cfg, params, _PROMPTS[:2], new=1)
+    pe, de, co = _pair(cfg, params)
+    rids, done, stream = _drive(co, _PROMPTS[:2], new=1)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert co.handoffs_shipped == 0
+    assert de.handoff_admits == 0
+    for r in rids:
+        assert stream[r] == list(done[r].generated)
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# the cost model: a counter, not a guess
+# ---------------------------------------------------------------------------
+def test_cost_model_keeps_short_prompts_colocated(cfg, params):
+    """With a link speed between the two flip thresholds, long
+    prompts route to the prefill engine and short ones stay
+    colocated — both decisions counted, outputs token-exact either
+    way."""
+    prompts = _PROMPTS + _SHORT
+    ref = _ref(cfg, params, prompts)
+    pe, de, co = _pair(cfg, params, co_kw=dict(force_route=None))
+    lo = min(handoff_flip_gbps(len(p), de) for p in _PROMPTS)
+    hi = min(handoff_flip_gbps(len(p), de) for p in _SHORT)
+    assert lo < hi, "page rounding must separate the thresholds"
+    co.handoff_gbps = float(np.sqrt(lo * hi))
+    assert handoff_wins(max(len(p) for p in _PROMPTS), de,
+                        co.handoff_gbps)
+    assert not handoff_wins(min(len(p) for p in _SHORT), de,
+                            co.handoff_gbps)
+    rids, done, _ = _drive(co, prompts)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert co.routed["prefill"] == len(_PROMPTS)
+    assert co.routed["colocated"] == len(_SHORT)
+    # the colocated shorts prefill ON the decode engine, by design
+    assert de.prefill_calls > 0
+    assert de.handoff_admits == len(_PROMPTS)
+    pe.cache.audit()
+    de.cache.audit()
+
+
+def test_prefill_queue_full_falls_back_colocated(cfg, params):
+    """A saturated prefill lane must NOT 429 while the decode engine
+    has room: the coordinator falls back to colocated admission (the
+    fleet router's rule), the placement counters reflect where the
+    request actually went, and readiness agrees."""
+    ref = _ref(cfg, params, _PROMPTS[:3])
+    pe, de, co = _pair(
+        cfg, params,
+        pe_kw=dict(max_queue_len=2))   # 3rd disagg submit overflows
+    rids = [co.submit(p, max_new_tokens=8) for p in _PROMPTS[:3]]
+    assert co.routed == {"prefill": 2, "colocated": 1}
+    assert co.queue_capacity_reason(len(_PROMPTS[0])) is None, \
+        "readiness must reflect the colocated fallback"
+    done = {}
+    steps = 0
+    while co.has_work():
+        co.step()
+        for r in co.finished():
+            done[r.rid] = r
+        steps += 1
+        assert steps < 2000
+    assert [list(done[r].generated) for r in rids] == ref
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# fault plane: kv_handoff ship/restore halves + receiving-tier limits
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("half,nth", [("ship", 1), ("restore", 2)])
+def test_handoff_fault_degrades_to_colocated(cfg, params, half, nth):
+    """An injected kv_handoff failure (either half) degrades the
+    request to a colocated re-prefill on the decode side: token-exact
+    (the sampled first token is preserved and streams exactly once),
+    fallbacks counted, audits clean."""
+    ref = _ref(cfg, params, _PROMPTS)
+    pe, de, co = _pair(cfg, params)
+    with faults.plane() as fp:
+        fp.inject("kv_handoff", RuntimeError(f"{half} fault"),
+                  nth=nth, times=1)
+        rids, done, stream = _drive(co, _PROMPTS)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert co.colocated_fallbacks == 1
+    assert de.colocated_fallbacks == 1
+    assert de.prefill_calls >= 1, \
+        "the degraded request must re-prefill on the decode side"
+    for r in rids:
+        assert stream[r] == list(done[r].generated)
+    pe.cache.audit()
+    de.cache.audit()
+    assert co._inflight_locked() == 0
+
+
+def test_receiving_host_tier_full_falls_back(cfg, params):
+    """adopt_swap refusing (decode host tier too small for the
+    context) is a degradation, not an error: colocated re-prefill,
+    token-exact."""
+    ref = _ref(cfg, params, _PROMPTS)
+    # 1 host page cannot hold any multi-page context
+    pe, de, co = _pair(cfg, params,
+                       de_cache=_cache(cfg, host_pages=1))
+    rids, done, _ = _drive(co, _PROMPTS)
+    assert [list(done[r].generated) for r in rids] == ref
+    assert co.colocated_fallbacks >= len(
+        [p for p in _PROMPTS if len(p) > 16])
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# bounded in-flight queue: backpressure, not growth
+# ---------------------------------------------------------------------------
+def test_bounded_inflight_backpressures_admission(cfg, params):
+    """max_inflight_handoffs=1: the pipeline never holds more than
+    one handoff anywhere (exported / pending / adopted-unadmitted),
+    admission waves stall while it drains, and everything still
+    completes token-exact."""
+    ref = _ref(cfg, params, _PROMPTS)
+    pe, de, co = _pair(cfg, params,
+                       pe_kw=dict(max_inflight_handoffs=1))
+    rids = [co.submit(p, max_new_tokens=8) for p in _PROMPTS]
+    done = {}
+    peak = 0
+    steps = 0
+    while co.has_work():
+        co.step()
+        peak = max(peak, co._inflight_locked())
+        for r in co.finished():
+            done[r.rid] = r
+        steps += 1
+        assert steps < 2000
+    assert [list(done[r].generated) for r in rids] == ref
+    assert peak <= 1, f"in-flight handoffs peaked at {peak} > bound 1"
+    assert pe.admission_stalls > 0, \
+        "the full queue must stall admission (backpressure observable)"
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# cancel / deadline while the record is in flight
+# ---------------------------------------------------------------------------
+def test_cancel_and_deadline_mid_handoff_reclaim_records(cfg, params):
+    """A cancel or deadline expiry while the record sits in the
+    handoff queue reclaims the staging pages immediately and
+    synthesizes the terminal status — nothing leaks, nothing decodes."""
+    pe, de, co = _pair(cfg, params)
+    t0 = 1000.0
+    co._now = lambda: t0
+    r_cancel = co.submit(_PROMPTS[0], max_new_tokens=8)
+    r_expire = co.submit(_PROMPTS[1], max_new_tokens=8,
+                         deadline_s=50.0)
+    co.step()                  # wave admits + exports + takes
+    assert {co._requests[r_cancel].where,
+            co._requests[r_expire].where} == {"handoff"}
+    host_used = pe.cache.host.used_pages()
+    assert host_used > 0       # staging pages live in the export
+    assert co.cancel(r_cancel) is True
+    co._now = lambda: t0 + 100.0     # past the deadline
+    co.step()                  # ship tick: expiry reclaims
+    done = {r.rid: r for r in co.finished()}
+    assert done[r_cancel].status == "cancelled"
+    assert done[r_expire].status == "expired"
+    assert pe.cache.host.used_pages() == 0, "staging pages leaked"
+    assert de.handoff_admits == 0 and de.prefill_calls == 0
+    assert co._inflight_locked() == 0
+    pe.cache.audit()
+    de.cache.audit()
+    assert not co.has_work()
+
+
+# ---------------------------------------------------------------------------
+# supervisor restart of a decode engine re-registers its handoffs
+# ---------------------------------------------------------------------------
+def test_supervisor_restart_reregisters_inflight_handoff(cfg, params):
+    """The restart-mid-handoff bugfix: a DecodeEngine rebuilt by its
+    EngineSupervisor re-adopts every in-flight handoff for the
+    transplanted queue — the request completes through the
+    zero-prefill restore (prefill_calls stays 0 on the rebuilt
+    engine), token-exact, instead of stranding the prefill side's
+    record."""
+    busy_p, long_p = _SHORT[0], _PROMPTS[1]
+    ref = _ref(cfg, params, [long_p])
+
+    def de_factory():
+        # batch=1: one busy slot blocks the handoff's admission, so
+        # the restart hits the adopted-but-unadmitted window
+        return DecodeEngine(
+            cfg, params, _cache(cfg, batch=1),
+            metrics_registry=False, quarantine_faults=False)
+
+    pe = PrefillEngine(cfg, params, _cache(cfg),
+                       metrics_registry=False)
+    sup = EngineSupervisor(de_factory, max_restarts=3, backoff_s=0.0)
+    de = sup.engine
+    busy = de.submit(busy_p, max_new_tokens=20)
+    sup.step()                         # busy owns the only slot
+    pe.submit(long_p, max_new_tokens=8)
+    pe.step()
+    rec = pe.take_handoffs()[0]
+    local = de.admit_handoff(rec)      # queued, cannot admit yet
+    assert de.pending_handoffs() == 1
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("boom"), every=1,
+                  times=1)
+        sup.step()                     # dies + supervisor rebuilds
+    new = sup.engine
+    assert sup.restarts == 1 and new is not de
+    assert len(new._queue) == 1, "queued handoff must transplant"
+    assert local in new._swap_handles, \
+        "the rebuilt engine must re-adopt the in-flight handoff"
+    assert new.pending_handoffs() == 1
+    done = {r.rid: r for r in sup.finished()}
+    done.update({r.rid: r for r in sup.run_to_completion()})
+    assert done[busy].status == "error"        # pages died with engine
+    assert done[local].status == "ok"
+    assert list(done[local].generated) == ref[0]
+    assert new.prefill_calls == 0, \
+        "re-registration must restore, not silently re-prefill"
+    new.cache.audit()
+    pe.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# construction contracts
+# ---------------------------------------------------------------------------
+def test_validation_errors(cfg, params):
+    with pytest.raises(ValueError, match="no decode loop"):
+        PrefillEngine(cfg, params, _cache(cfg),
+                      metrics_registry=False, overlap=True)
+    with pytest.raises(ValueError, match="host page tier"):
+        DecodeEngine(cfg, params, _cache(cfg, host_pages=0),
+                     metrics_registry=False)
+    plain = ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                     metrics_registry=False)
+    de = DecodeEngine(cfg, params, _cache(cfg),
+                      metrics_registry=False)
+    with pytest.raises(ValueError, match="PrefillEngine"):
+        DisaggCoordinator(plain, de, metrics_registry=False)
+    pe = PrefillEngine(cfg, params, _cache(cfg),
+                       metrics_registry=False)
+    with pytest.raises(ValueError, match="DecodeEngine"):
+        DisaggCoordinator(pe, plain, metrics_registry=False)
+    with pytest.raises(ValueError, match="roles"):
+        FleetRouter([lambda: plain], roles=["prefill", "decode"],
+                    metrics_registry=False)
+    with pytest.raises(ValueError, match="role='prefill'"):
+        FleetRouter([lambda: ContinuousBatchingEngine(
+            cfg, params, _cache(cfg), metrics_registry=False)],
+            roles=["prefill"], metrics_registry=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet tier: role lanes, failover, reclamation
+# ---------------------------------------------------------------------------
+def _role_factories(cfg, params):
+    def pf():
+        return PrefillEngine(cfg, params, _cache(cfg),
+                             metrics_registry=False)
+
+    def df():
+        return DecodeEngine(cfg, params, _cache(cfg),
+                            metrics_registry=False)
+    return pf, df
+
+
+def test_fleet_roles_token_exact_zero_prefill_on_decode(cfg, params):
+    """1 prefill + 2 decode lanes, every request forced through the
+    handoff (huge link speed): token-exact vs unified, decode
+    replicas never prefill, roles and handoff counters surfaced in
+    /fleet."""
+    ref = _ref(cfg, params, _PROMPTS)
+    pf, df = _role_factories(cfg, params)
+    router = FleetRouter([pf, df, df],
+                         roles=["prefill", "decode", "decode"],
+                         metrics_registry=False, handoff_gbps=1e9)
+    rids = [router.submit(p, max_new_tokens=8) for p in _PROMPTS]
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert [list(done[r].generated) for r in rids] == ref
+    assert router.routed["disagg"] == len(_PROMPTS)
+    assert router.handoffs_shipped == len(_PROMPTS)
+    for h in router._replicas:
+        h.engine.cache.audit()
+        if h.role == "decode":
+            assert h.engine.prefill_calls == 0
+    snap = router.fleet_snapshot()
+    assert snap["roles"] == {"unified": 0, "prefill": 1, "decode": 2}
+    assert snap["disagg"]["handoffs_shipped"] == len(_PROMPTS)
+    assert snap["disagg"]["handoffs_inflight"] == 0
+    assert [r["role"] for r in snap["replicas"]] == \
+        ["prefill", "decode", "decode"]
+
+
+def test_fleet_cost_model_splits_lanes(cfg, params):
+    """On a role fleet with a calibrated link speed, long prompts
+    ride the prefill lane and short prompts place directly on decode
+    replicas (colocated) — decisions counted, outputs token-exact."""
+    prompts = _PROMPTS + _SHORT
+    ref = _ref(cfg, params, prompts)
+    pf, df = _role_factories(cfg, params)
+    router = FleetRouter([pf, df], roles=["prefill", "decode"],
+                         metrics_registry=False)
+    de = router._replicas[1].engine
+    router.handoff_gbps = float(np.sqrt(
+        min(handoff_flip_gbps(len(p), de) for p in _PROMPTS)
+        * min(handoff_flip_gbps(len(p), de) for p in _SHORT)))
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert [list(done[r].generated) for r in rids] == ref
+    assert router.disagg_decisions == {
+        "disagg": len(_PROMPTS), "colocated": len(_SHORT)}
+    assert router.routed["disagg"] == len(_PROMPTS)
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_fleet_prefill_death_reclaims_records_and_fails_over(
+        cfg, params):
+    """A prefill replica dying with exported-but-untaken records:
+    the records' staging pages reclaim (never leak), and the
+    requests — zero-streamed by construction — fail over to the
+    serve lane as colocated re-prefills, token-exact."""
+    ref = _ref(cfg, params, _PROMPTS[:2])
+    pf, df = _role_factories(cfg, params)
+    router = FleetRouter([pf, df], roles=["prefill", "decode"],
+                         metrics_registry=False, handoff_gbps=1e9)
+    rids = [router.submit(p, max_new_tokens=8)
+            for p in _PROMPTS[:2]]
+    pe = router._replicas[0].engine
+    # run the prefill wave OUTSIDE the router tick so the records sit
+    # exported-but-untaken when the death fires
+    pe.step()
+    assert len(pe._handoff_ready) == len(rids)
+    host_used = pe.cache.host.used_pages()
+    assert host_used > 0
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("prefill died"),
+                  nth=1, times=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert [list(done[r].generated) for r in rids] == ref
+    assert all(done[r].status == "ok" for r in rids)
+    assert router.failovers == len(rids)
+    assert pe.cache.host.used_pages() == 0, \
+        "orphaned handoff records must reclaim their staging pages"
+    pe.cache.audit()
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_fleet_decode_death_mid_handoff_fails_over(cfg, params):
+    """A decode replica dying with an adopted-but-unadmitted handoff
+    (the exact mid-handoff window: shipped, zero tokens streamed):
+    the request transparently re-places on the serve lane and
+    completes token-exact."""
+    ref = _ref(cfg, params, _PROMPTS[:1])
+    pf, df = _role_factories(cfg, params)
+    router = FleetRouter([pf, df, df],
+                         roles=["prefill", "decode", "decode"],
+                         metrics_registry=False, handoff_gbps=1e9)
+    rid = router.submit(_PROMPTS[0], max_new_tokens=8)
+    router.step()              # tick 1: prefill wave exports + takes
+    assert len(router._handoffs) == 1
+    with faults.plane() as fp:
+        # next tick: ship adopts into the target decode replica; its
+        # step-seam consult — the FIRST since the plane armed (idle
+        # replicas are never consulted) — then fires: death lands in
+        # the exact adopted-but-unadmitted window
+        fp.inject("replica_death", RuntimeError("decode died"),
+                  nth=1, times=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert done[rid].status == "ok"
+    assert list(done[rid].generated) == ref[0]
+    assert router.deaths == 1 and router.failovers == 1
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_fleet_handoff_fault_degrades_colocated(cfg, params):
+    """kv_handoff faults at the fleet tier: the record degrades to a
+    serve-lane re-prefill through the pending-failover queue —
+    token-exact, counted."""
+    ref = _ref(cfg, params, _PROMPTS[:2])
+    pf, df = _role_factories(cfg, params)
+    router = FleetRouter([pf, df], roles=["prefill", "decode"],
+                         metrics_registry=False, handoff_gbps=1e9)
+    with faults.plane() as fp:
+        fp.inject("kv_handoff", RuntimeError("wire down"), nth=1,
+                  times=1)
+        rids = [router.submit(p, max_new_tokens=8)
+                for p in _PROMPTS[:2]]
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert [list(done[r].generated) for r in rids] == ref
+    assert router.colocated_fallbacks == 1
+    # the degrade must ride admit_degraded on the decode lane —
+    # preserving the sampled first token (token-exact at ANY
+    # temperature) — not a failover re-prefill that re-samples it
+    assert router._replicas[1].engine.colocated_fallbacks == 1
+    assert router.routed["failover"] == 0
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_fleet_route_fault_on_prefill_lane_falls_back(cfg, params):
+    """A non-backpressure failure handing a request to the prefill
+    lane (route_dispatch fault) falls back to colocated placement —
+    the client never sees an error the decode lane could absorb."""
+    ref = _ref(cfg, params, _PROMPTS[:1])
+    pf, df = _role_factories(cfg, params)
+    router = FleetRouter([pf, df], roles=["prefill", "decode"],
+                         metrics_registry=False, handoff_gbps=1e9)
+    with faults.plane() as fp:
+        fp.inject("route_dispatch", RuntimeError("handoff refused"),
+                  nth=1, times=1)
+        rid = router.submit(_PROMPTS[0], max_new_tokens=8)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert list(done[rid].generated) == ref[0]
+    assert router.route_errors == 1
+    assert router.routed["disagg"] == 0
+    assert router.disagg_decisions["colocated"] == 1
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_mismatched_decode_geometry_rejected_upfront(cfg, params):
+    """A request the decode pool could never hold must fail with the
+    canonical submit() ValueError at submission — not wedge the
+    decode FIFO after a wasted prefill + handoff."""
+    pe, de, co = _pair(
+        cfg, params,
+        de_cache=_cache(cfg, pages_max=2))   # row cap = 32 slots
+    with pytest.raises(ValueError, match="row capacity"):
+        co.submit(_PROMPTS[3], max_new_tokens=30)   # 40 + 30 > 32
+    # the capacity guard also covers the handoff import path directly
+    with pytest.raises(ValueError, match="geometries disagree"):
+        de._import_request(Request(0, _PROMPTS[3], 30, generated=[1]))
+    assert not co.has_work()
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+def test_disagg_metrics_registered_and_settle(cfg, params):
+    """The DisaggMetrics instruments register against the engines'
+    shared registry, count the pipeline, and the in-flight gauge
+    settles back to zero."""
+    reg = MetricsRegistry()
+    pe = PrefillEngine(cfg, params, _cache(cfg),
+                       metrics_registry=reg)
+    de = DecodeEngine(cfg, params, _cache(cfg), metrics_registry=reg)
+    co = DisaggCoordinator(pe, de, force_route="prefill")
+    assert co.metrics is not None and co.metrics.registry is reg
+    rids, done, _ = _drive(co, _PROMPTS[:2])
+    snap = reg.snapshot()
+    pages = sum(-(-len(p) // 16) for p in _PROMPTS[:2])
+    assert snap["paddle_tpu_disagg_handoff_pages_total"]["value"] \
+        == pages
+    assert snap["paddle_tpu_disagg_handoff_bytes_total"]["value"] \
+        == pages * de.cache.page_bytes
+    assert snap["paddle_tpu_disagg_handoff_seconds"]["count"] == 2
+    assert snap["paddle_tpu_disagg_handoff_inflight_count"]["value"] \
+        == 0
+    assert snap["paddle_tpu_disagg_routed_prefill_total"]["value"] \
+        == 2
+    assert snap[
+        "paddle_tpu_disagg_colocated_fallback_total"]["value"] == 0

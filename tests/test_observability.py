@@ -472,8 +472,9 @@ def test_metric_names_lint():
 
     reg = MetricsRegistry()
     EngineMetrics(reg)                        # engine + cache + spec
-    from paddle_tpu.observability import FleetMetrics
+    from paddle_tpu.observability import DisaggMetrics, FleetMetrics
     FleetMetrics(reg)                         # fleet router tier
+    DisaggMetrics(reg)                        # disagg handoff tier
     mgr = W.CommTaskManager(scan_interval=60)
     mgr.bind_metrics(reg, EventRing())
     mgr.shutdown()

@@ -7,6 +7,7 @@ Usage:
   python tools/metrics_dump.py metrics http://127.0.0.1:8000
   python tools/metrics_dump.py events  http://127.0.0.1:8000 [-n 50] [--follow]
   python tools/metrics_dump.py fleet   http://127.0.0.1:8000
+  python tools/metrics_dump.py disagg  http://127.0.0.1:8000
   python tools/metrics_dump.py snapshot BENCH_r05.json
 
 ``stats`` renders ``GET /stats`` (the JSON snapshot) as an aligned
@@ -15,7 +16,10 @@ table; ``metrics`` dumps the raw Prometheus text from ``GET /metrics``;
 ``--follow`` polls ``/events?since=<seq>`` for new ones; ``fleet``
 renders a FleetServer's aggregated ``GET /fleet`` snapshot (replica
 lifecycle states, per-replica load, routing/failover counters);
-``snapshot`` pretty-prints a snapshot previously written to a file
+``disagg`` renders the disaggregated prefill/decode slice of
+``GET /stats`` (handoff traffic, in-flight depth, routing decisions,
+fallbacks, handoff ms/request); ``snapshot`` pretty-prints a snapshot
+previously written to a file
 (e.g. the ``metrics_snapshot`` line bench.py appends to BENCH_r*.json
 output).
 
@@ -99,7 +103,23 @@ def _render_fleet(doc: dict) -> str:
     routed = doc.get("routed", {})
     lines.append("routed: " + "  ".join(
         f"{k}={routed.get(k, 0)}"
-        for k in ("prefix", "least_loaded", "failover")))
+        for k in ("prefix", "least_loaded", "failover", "disagg")))
+    roles = doc.get("roles")
+    if roles and (roles.get("prefill") or roles.get("decode")):
+        lines.append("roles: " + "  ".join(
+            f"{k}={roles.get(k, 0)}"
+            for k in ("prefill", "decode", "unified")))
+    dis = doc.get("disagg")
+    if dis:
+        lines.append(
+            "disagg: " + "  ".join(
+                f"{k}={dis.get(k, 0)}"
+                for k in ("handoffs_shipped", "handoff_pages",
+                          "handoffs_inflight",
+                          "colocated_fallbacks"))
+            + "  decisions=" + "/".join(
+                str(dis.get("decisions", {}).get(k, 0))
+                for k in ("disagg", "colocated")))
     lines.append(
         f"failovers={doc.get('failovers', 0)}  "
         f"rejected={doc.get('rejected', 0)}  "
@@ -128,6 +148,33 @@ def _render_fleet(doc: dict) -> str:
 def cmd_fleet(args) -> int:
     doc = json.loads(_get(args.url.rstrip("/") + "/fleet"))
     print(_render_fleet(doc))
+    return 0
+
+
+def _render_disagg(snap: dict) -> str:
+    """The disaggregated prefill/decode slice of a registry snapshot:
+    handoff traffic, in-flight depth, routing decisions, fallbacks,
+    and the handoff-latency histogram."""
+    dis = {n: m for n, m in snap.items()
+           if n.startswith("paddle_tpu_disagg_")}
+    if not dis:
+        return ("no paddle_tpu_disagg_* metrics in this snapshot "
+                "(not a disaggregated serving front?)")
+    lines = [_render_snapshot(dis)]
+    ship = dis.get("paddle_tpu_disagg_handoff_seconds") or {}
+    pages = (dis.get("paddle_tpu_disagg_handoff_pages_total")
+             or {}).get("value") or 0
+    if ship.get("count"):
+        lines.append(
+            f"handoff ms/request = "
+            f"{1000.0 * ship['sum'] / ship['count']:.3f}  "
+            f"pages/handoff = {pages / ship['count']:.1f}")
+    return "\n".join(lines)
+
+
+def cmd_disagg(args) -> int:
+    body = json.loads(_get(args.url.rstrip("/") + "/stats"))
+    print(_render_disagg(body.get("metrics", body)))
     return 0
 
 
@@ -167,7 +214,12 @@ def cmd_snapshot(args) -> int:
                 # routers publish process-wide)
                 "fleet_failovers_total", "fleet_rejected_total",
                 "fleet_replica_deaths_total",
-                "fleet_replica_replaces_total")
+                "fleet_replica_replaces_total",
+                # disaggregated prefill/decode (the serving_disagg_ab
+                # bench line's coordinator publishes process-wide)
+                "disagg_handoff_pages_total",
+                "disagg_handoff_bytes_total",
+                "disagg_colocated_fallback_total")
     derived = {}
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
@@ -218,6 +270,11 @@ def main(argv=None) -> int:
                        help="pretty-print GET /fleet (FleetServer)")
     s.add_argument("url")
     s.set_defaults(fn=cmd_fleet)
+    s = sub.add_parser("disagg",
+                       help="pretty-print the disaggregated "
+                            "prefill/decode slice of GET /stats")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_disagg)
     s = sub.add_parser("snapshot",
                        help="pretty-print a snapshot file")
     s.add_argument("path")
