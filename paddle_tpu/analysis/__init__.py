@@ -32,17 +32,21 @@ suppression policy: docs/STATIC_ANALYSIS.md.  Invariant declarations
 from typing import Dict, List, Optional
 
 from . import annotations
+from .cfg import CFG, CFGNode, build_cfg
 from .core import (BAD_SUPPRESSION, PARSE_ERROR, UNUSED_SUPPRESSION,
                    Analyzer, Finding, Report, Rule, SourceModule)
-from .rules import (ALL_RULE_IDS, FlushPointRule, LockDisciplineRule,
-                    SyncLintRule, TracePurityRule, default_rules)
+from .rules import (ALL_RULE_IDS, ClaimLifecycleRule, FlushPointRule,
+                    LockDisciplineRule, SyncLintRule, TracePurityRule,
+                    default_rules)
 
 __all__ = ["Analyzer", "Finding", "Report", "Rule", "SourceModule",
            "analyze_paths", "analyze_sources", "default_rules",
            "ALL_RULE_IDS", "BAD_SUPPRESSION", "PARSE_ERROR",
            "UNUSED_SUPPRESSION",
            "annotations", "SyncLintRule", "TracePurityRule",
-           "LockDisciplineRule", "FlushPointRule", "DEFAULT_TARGETS"]
+           "LockDisciplineRule", "FlushPointRule",
+           "ClaimLifecycleRule", "CFG", "CFGNode", "build_cfg",
+           "DEFAULT_TARGETS"]
 
 # the production modules tier-1 holds at zero unsuppressed findings
 DEFAULT_TARGETS = ("paddle_tpu/models", "paddle_tpu/inference",

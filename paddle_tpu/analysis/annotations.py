@@ -28,7 +28,8 @@ __all__ = ["SharedStateSpec", "SHARED_STATE", "SYNC_HOT_ROOTS",
            "DEVICE_PRODUCER_NAMES", "DEVICE_PRODUCER_ATTRS",
            "BLOCKING_SEAMS", "EXTRA_TRACED", "FLUSH_MUTATORS",
            "FLUSH_SAFE", "ENGINE_CLASSES", "THREAD_SAFETY",
-           "thread_safety_doc_lines"]
+           "thread_safety_doc_lines", "ClaimSpec", "CLAIMS",
+           "checked_claims", "claims_doc_lines"]
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +322,149 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
              "bounded-waits on _lock (the health_snapshot idiom) "
              "before reaching the router through _fleet_locked"),
 }
+
+
+# ---------------------------------------------------------------------------
+# claim lifecycle: refcounted resources the CFG rules audit
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class ClaimSpec:
+    """One refcounted claim kind the allocator facade hands out.
+
+    ``acquires``/``releases`` are CALL NAMES (bare function or
+    attribute method names): a call to an acquire name creates a live
+    claim at that CFG node; a call to a release name — or to any
+    function whose interprocedural summary transitively reaches one
+    (``_release_engine_claims`` → ``release_row``/``discard_swap``/
+    ``release_extra_claims``) — retires it.
+
+    ``value_bearing`` claims return a token (swap handle, export
+    state, engine-local rid) the caller must route somewhere: the
+    claim also retires when the token ESCAPES — returned, stored into
+    an attribute/subscript (the audited registries: ``_swap_handles``,
+    ``_handoff_ready``, ``local_rids``...), or passed onward.  A
+    value-bearing claim leaks when ANY path reaches a function exit
+    with the token neither released nor escaped.  Value-less claims
+    (``alloc_row`` binds pages to a row the scheduler already owns)
+    leak only on EXCEPTIONAL paths — the unwind that strands the row.
+
+    ``scope``: ``"cfg"`` kinds are checked by the claim-lifecycle /
+    except-swallow rules; ``"registry"`` kinds live across ticks
+    inside audited containers, where an intraprocedural CFG proof is
+    the wrong tool — their accounting is pinned at runtime by
+    ``PagedKVCache.audit()`` and the fleet/disagg reclamation tests
+    (the taxonomy table in docs/STATIC_ANALYSIS.md documents both).
+    """
+
+    kind: str
+    acquires: FrozenSet[str]
+    releases: FrozenSet[str]
+    value_bearing: bool = True
+    scope: str = "cfg"                 # "cfg" | "registry"
+    leak: str = ""                     # what a leak strands
+    note: str = ""
+
+
+CLAIMS: Dict[str, ClaimSpec] = {
+    # device KV pages claimed for a row: alloc_row/alloc_row_prefix
+    # bind pages to a slot the scheduler owns from that moment, and
+    # swap_in_row converts a parked record back into row pages.  The
+    # steady-state release is retirement/preemption (release_row via
+    # _release_slot); the CFG-checked hazard is the UNWIND — a
+    # prefill fault after the alloc strands the slot off the free
+    # list unless the quarantine/rollback path releases it.
+    "device-pages": ClaimSpec(
+        kind="device-pages",
+        acquires=frozenset({"alloc_row", "alloc_row_prefix",
+                            "swap_in_row"}),
+        releases=frozenset({"release_row"}),
+        value_bearing=False,
+        leak="slot pages off the free list forever (admission "
+             "faults, PR 5's stranded-slot class)",
+        note="swap_in_row acquires row pages AND releases the swap "
+             "record it consumes"),
+    # host-tier swap record: parked preempted rows + adopted handoff
+    # blobs.  The handle MUST land in an audited registry
+    # (_swap_handles) or be discarded — a dropped handle pins host
+    # pages and held device refs until engine death.
+    "swap-record": ClaimSpec(
+        kind="swap-record",
+        acquires=frozenset({"swap_out_row", "adopt_swap"}),
+        releases=frozenset({"swap_in_row", "discard_swap"}),
+        value_bearing=True,
+        leak="host pages + held device refs pinned by an orphaned "
+             "record (audit() fails)"),
+    # cross-cache KV export (disaggregated handoff ship half): the
+    # opaque state must reach a HandoffRecord (or be fetched /
+    # discarded) on every path, including the degrade branches.
+    "export-record": ClaimSpec(
+        kind="export-record",
+        acquires=frozenset({"export_row"}),
+        releases=frozenset({"export_fetch", "export_discard",
+                            "materialize"}),
+        value_bearing=True,
+        leak="staging host pages of an un-shipped export (orphaned "
+             "export records on prefill death, PR 9's class)",
+        note="HandoffRecord.discard is credited through its summary "
+             "(it calls export_discard), NOT by the bare name "
+             "`discard` — that would collide with set.discard "
+             "bookkeeping on the very triage paths under check"),
+    # an engine-local placement: submit()/admit_* return a local rid
+    # whose engine-side state only the caller can still reach — it
+    # must commit to a routing table (local_rids, _decode_rids,
+    # _queues) before anything on the path can raise, or the replica
+    # generates for a client nobody can deliver to.
+    "placed-request": ClaimSpec(
+        kind="placed-request",
+        acquires=frozenset({"submit", "admit_handoff",
+                            "admit_degraded"}),
+        releases=frozenset({"cancel"}),
+        value_bearing=True,
+        leak="an accepted request no routing table maps: tokens "
+             "generated for nobody, failover/cancel blind to it"),
+    # -- registry-scope kinds (runtime-audited, documented here) ------
+    "prefix-ref": ClaimSpec(
+        kind="prefix-ref",
+        acquires=frozenset({"register_prefix", "alloc_row_prefix"}),
+        releases=frozenset({"release_row"}),
+        value_bearing=False,
+        scope="registry",
+        leak="un-evictable index pages / un-purged fleet "
+             "prefix-owner entries steering traffic to cold replicas",
+        note="refcount identities pinned by PagedKVCache.audit(); "
+             "fleet _prefix_owner purge pinned by the replace tests"),
+    "handoff-record": ClaimSpec(
+        kind="handoff-record",
+        acquires=frozenset({"take_handoffs"}),
+        releases=frozenset({"discard", "admit_handoff",
+                            "release_extra_claims"}),
+        value_bearing=True,
+        scope="registry",
+        leak="records stranded between engines on cancel/expiry/"
+             "death (reclaimed through _release_engine_claims)",
+        note="owned by coordinator/router deques across ticks; "
+             "every triage branch discards or ships — chaos-tested"),
+}
+
+
+def checked_claims() -> Dict[str, ClaimSpec]:
+    """The kinds the CFG rules enforce (``scope == "cfg"``)."""
+    return {k: s for k, s in CLAIMS.items() if s.scope == "cfg"}
+
+
+def claims_doc_lines() -> List[str]:
+    """The markdown taxonomy rows docs/STATIC_ANALYSIS.md must carry,
+    generated from :data:`CLAIMS` so the doc cannot drift from the
+    registry (asserted by tests/test_analysis.py, the same discipline
+    as the THREAD_SAFETY table)."""
+    rows = []
+    for kind in sorted(CLAIMS):
+        s = CLAIMS[kind]
+        acq = ", ".join(f"`{a}`" for a in sorted(s.acquires))
+        rel = ", ".join(f"`{r}`" for r in sorted(s.releases))
+        rows.append(f"| `{kind}` | {acq} | {rel} | {s.scope} | "
+                    f"{s.leak} |")
+    return rows
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,407 @@
+"""Intraprocedural control-flow graphs for the rule engine.
+
+The statement-level rules (sync-lint, flush-point) get away with
+"textual precedence inside one function" because the invariants they
+check are anchored to single call sites.  The claim-lifecycle family
+cannot: "every acquired claim is released on EVERY path" is a
+property of paths — the early ``return`` that skips the
+``discard_swap``, the ``except`` branch that swallows the error the
+release lived under, the loop back-edge that re-acquires into the
+same variable.  This module builds a real CFG per function:
+
+* one node per simple statement and per compound-statement HEAD (the
+  ``if``/``while`` test, the ``for`` iterable, the ``with`` context
+  expression) — bodies become their own node chains;
+* normal edges (``"n"``), loop BACK edges (``"b"``, so non-vacuity
+  tests can assert loops are actually modeled), and EXCEPTION edges
+  (``"e"``) from every statement that can realistically raise to the
+  innermost enclosing handlers — and past them to the next level when
+  no handler is a catch-all;
+* ``try``/``finally`` routed properly: normal completion, handler
+  completion, and every jump out of the protected region (``return``
+  / ``raise`` / ``break`` / ``continue`` / uncaught exception) all
+  pass through the ``finally`` subgraph before continuing to their
+  real target (one shared ``finally`` instance with fan-out
+  continuations — a documented over-approximation that only ADDS
+  paths, which is sound for a may-leak analysis);
+* two distinct exits: ``exit_normal`` (returns + falling off the
+  end) and ``exit_raise`` (uncaught exceptions) — the claim rules
+  treat them differently.
+
+"Can realistically raise" is deliberate engineering, not soundness
+theater: modeling every attribute access as a potential ``raise``
+would drown the claim rules in paths no reviewer believes in.  A
+statement raises when it contains a call that is not on the
+:data:`NONRAISING_CALLS` allowlist (container appends, metric
+bumps, clock reads...), or is a ``raise``/``assert``.  Calls inside
+``lambda``/nested ``def`` bodies do not raise at the statement that
+merely builds the closure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+__all__ = ["CFG", "CFGNode", "build_cfg", "stmt_can_raise",
+           "node_exprs", "NONRAISING_CALLS"]
+
+# attribute/function names whose calls the CFG treats as non-raising
+# (the claim rules inherit this): container/bookkeeping mutations,
+# metric instruments, clock seams, pure constructors of builtin
+# containers.  `.pop()` / `.popleft()` / `faults.fire()` are absent
+# ON PURPOSE — they raise by contract.
+NONRAISING_CALLS = frozenset({
+    "append", "appendleft", "extend", "add", "discard", "clear",
+    "update", "setdefault", "get", "keys", "values", "items", "copy",
+    "count", "index_of",
+    "len", "range", "enumerate", "zip", "sorted", "reversed", "iter",
+    "min", "max", "sum", "abs", "round", "id", "repr", "str", "bool",
+    "int", "float", "isinstance", "issubclass", "hasattr", "getattr",
+    "callable", "list", "dict", "set", "tuple", "frozenset", "deque",
+    "monotonic", "perf_counter", "time",
+    "inc", "dec", "observe",
+    "emit",
+    "join", "split", "strip", "startswith", "endswith", "format",
+})
+
+# edge types
+_N, _E, _B = "n", "e", "b"
+
+
+class CFGNode:
+    """One CFG vertex.  ``stmt`` is the anchoring AST node (a
+    statement, an ``ast.ExceptHandler`` for handler entries, or None
+    for the synthetic entry/exit vertices); ``kind`` distinguishes the
+    synthetic and structural roles the non-vacuity tests assert on."""
+
+    __slots__ = ("idx", "stmt", "kind", "succ")
+
+    def __init__(self, idx: int, stmt, kind: str):
+        self.idx = idx
+        self.stmt = stmt
+        self.kind = kind          # "entry" | "exit" | "raise-exit" |
+        #                           "stmt" | "loop-head" | "loop-exit" |
+        #                           "with" | "except" | "finally" |
+        #                           "match-head"
+        self.succ: List[Tuple[int, str]] = []   # (target idx, "n|e|b")
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self):                         # pragma: no cover
+        return f"<CFGNode {self.idx} {self.kind} L{self.line}>"
+
+
+class CFG:
+    def __init__(self):
+        self.nodes: List[CFGNode] = []
+        self.entry = self._new(None, "entry")
+        self.exit_normal = self._new(None, "exit")
+        self.exit_raise = self._new(None, "raise-exit")
+
+    def _new(self, stmt, kind: str) -> CFGNode:
+        n = CFGNode(len(self.nodes), stmt, kind)
+        self.nodes.append(n)
+        return n
+
+    def edge(self, a: CFGNode, b: CFGNode, et: str = _N) -> None:
+        if (b.idx, et) not in a.succ:
+            a.succ.append((b.idx, et))
+
+    # -- queries the rules/tests use --------------------------------------
+    def successors(self, n: CFGNode,
+                   etypes: Iterable[str] = (_N, _E, _B)
+                   ) -> List[Tuple[CFGNode, str]]:
+        return [(self.nodes[i], et) for i, et in n.succ
+                if et in etypes]
+
+    def kinds(self) -> Set[str]:
+        return {n.kind for n in self.nodes}
+
+    def has_back_edge(self) -> bool:
+        return any(et == _B for n in self.nodes for _, et in n.succ)
+
+    def has_exception_edge(self) -> bool:
+        return any(et == _E for n in self.nodes for _, et in n.succ)
+
+    def nodes_of_kind(self, kind: str) -> List[CFGNode]:
+        return [n for n in self.nodes if n.kind == kind]
+
+    def stmt_nodes(self) -> List[CFGNode]:
+        return [n for n in self.nodes if n.stmt is not None]
+
+
+def _calls_in(tree) -> List[ast.Call]:
+    """Calls in ``tree`` excluding nested def/class/lambda bodies
+    (building a closure executes nothing inside it).  A ROOT that is
+    itself a def/lambda is walked (the function under analysis); only
+    nested closures are pruned."""
+    out: List[ast.Call] = []
+    stack = list(ast.iter_child_nodes(tree)) \
+        if isinstance(tree, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) else [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def node_exprs(node: CFGNode) -> List[ast.AST]:
+    """The AST actually EVALUATED at this CFG node: the whole
+    statement for simple statements, only the head expression for
+    compound ones (their bodies are separate nodes)."""
+    s = node.stmt
+    if s is None:
+        return []
+    if node.kind == "except":                   # ast.ExceptHandler
+        return [s.type] if s.type is not None else []
+    if node.kind == "finally":
+        return []
+    if isinstance(s, (ast.If, ast.While)):
+        return [s.test]
+    if isinstance(s, ast.For):
+        return [s.iter, s.target]
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in s.items]
+    if isinstance(s, ast.Try):                  # finally-entry reuse
+        return []
+    if isinstance(s, ast.Match):
+        return [s.subject]
+    return [s]
+
+
+def stmt_can_raise(node: CFGNode) -> bool:
+    """Whether this node gets exception out-edges (see the module
+    docstring for the allowlist rationale)."""
+    s = node.stmt
+    if s is None or node.kind == "finally":
+        return False
+    if isinstance(s, (ast.Raise, ast.Assert)):
+        return True
+    for tree in node_exprs(node):
+        if tree is None:
+            continue
+        for call in _calls_in(tree):
+            name = _call_name(call)
+            if name is None or name not in NONRAISING_CALLS:
+                return True
+    return False
+
+
+def _is_catch_all(h: ast.ExceptHandler) -> bool:
+    """``except:`` / ``except BaseException`` / ``except Exception``
+    (the quarantine idiom) stop outward exception propagation."""
+    if h.type is None:
+        return True
+    t = h.type
+    if isinstance(t, ast.Attribute):
+        t_name = t.attr
+    elif isinstance(t, ast.Name):
+        t_name = t.id
+    else:
+        return False
+    return t_name in ("BaseException", "Exception")
+
+
+class _Builder:
+    """Recursive-descent CFG construction.  ``frames`` is the active
+    enclosing-context stack (innermost last), each entry one of::
+
+        ["loop", head_node, exit_node]
+        ["except", [handler_entry_nodes], catch_all]
+        ["finally", entry_node, {jump kinds routed through}]
+    """
+
+    def __init__(self):
+        self.cfg = CFG()
+
+    def build(self, fn_node) -> CFG:
+        outs = self._block(fn_node.body, [self.cfg.entry], [])
+        for o in outs:
+            self.cfg.edge(o, self.cfg.exit_normal)
+        return self.cfg
+
+    # -- jump routing ------------------------------------------------------
+    def _route(self, src: CFGNode, kind: str, frames: list,
+               et: str = _N) -> None:
+        """Connect a jump (``return``/``raise``/``break``/
+        ``continue``) from ``src`` to its destination, detouring
+        through every intervening ``finally`` (the finally subgraph
+        re-dispatches recorded jump kinds when it completes)."""
+        cfg = self.cfg
+        for i in range(len(frames) - 1, -1, -1):
+            f = frames[i]
+            if f[0] == "finally":
+                cfg.edge(src, f[1], et)
+                f[2].add(kind)
+                return
+            if kind == "raise" and f[0] == "except":
+                for h in f[1]:
+                    cfg.edge(src, h, _E)
+                if f[2]:                        # catch-all: contained
+                    return
+                continue                        # may not match: onward
+            if kind == "break" and f[0] == "loop":
+                cfg.edge(src, f[2], et)
+                return
+            if kind == "continue" and f[0] == "loop":
+                cfg.edge(src, f[1], et if et == _E else _B)
+                return
+        if kind == "raise":
+            cfg.edge(src, cfg.exit_raise, _E)
+        else:                                   # return (or stray jump)
+            cfg.edge(src, cfg.exit_normal, et)
+
+    def _maybe_raise(self, node: CFGNode, frames: list) -> None:
+        if stmt_can_raise(node):
+            self._route(node, "raise", frames, et=_E)
+
+    # -- structure ---------------------------------------------------------
+    def _block(self, stmts, preds: List[CFGNode],
+               frames: list) -> List[CFGNode]:
+        cur = preds
+        for s in stmts:
+            cur = self._stmt(s, cur, frames)
+        return cur
+
+    def _link(self, preds: List[CFGNode], node: CFGNode) -> None:
+        for p in preds:
+            self.cfg.edge(p, node)
+
+    def _stmt(self, s, preds: List[CFGNode],
+              frames: list) -> List[CFGNode]:
+        cfg = self.cfg
+        if isinstance(s, ast.If):
+            head = cfg._new(s, "stmt")
+            self._link(preds, head)
+            self._maybe_raise(head, frames)
+            outs = self._block(s.body, [head], frames)
+            if s.orelse:
+                outs += self._block(s.orelse, [head], frames)
+            else:
+                outs = outs + [head]
+            return outs
+        if isinstance(s, (ast.While, ast.For)):
+            return self._loop(s, preds, frames)
+        if isinstance(s, ast.Try):
+            return self._try(s, preds, frames)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            head = cfg._new(s, "with")
+            self._link(preds, head)
+            self._maybe_raise(head, frames)
+            return self._block(s.body, [head], frames)
+        if isinstance(s, ast.Match):
+            head = cfg._new(s, "match-head")
+            self._link(preds, head)
+            self._maybe_raise(head, frames)
+            outs: List[CFGNode] = [head]
+            for case in s.cases:
+                outs += self._block(case.body, [head], frames)
+            return outs
+        if isinstance(s, ast.Return):
+            node = cfg._new(s, "stmt")
+            self._link(preds, node)
+            self._maybe_raise(node, frames)
+            self._route(node, "return", frames)
+            return []
+        if isinstance(s, ast.Raise):
+            node = cfg._new(s, "stmt")
+            self._link(preds, node)
+            self._route(node, "raise", frames, et=_E)
+            return []
+        if isinstance(s, (ast.Break, ast.Continue)):
+            node = cfg._new(s, "stmt")
+            self._link(preds, node)
+            self._route(node,
+                        "break" if isinstance(s, ast.Break)
+                        else "continue", frames)
+            return []
+        # simple statement (incl. nested def/class bindings)
+        node = cfg._new(s, "stmt")
+        self._link(preds, node)
+        self._maybe_raise(node, frames)
+        return [node]
+
+    def _loop(self, s, preds: List[CFGNode],
+              frames: list) -> List[CFGNode]:
+        cfg = self.cfg
+        head = cfg._new(s, "loop-head")
+        after = cfg._new(s, "loop-exit")
+        self._link(preds, head)
+        self._maybe_raise(head, frames)
+        body_frames = frames + [["loop", head, after]]
+        body_outs = self._block(s.body, [head], body_frames)
+        for o in body_outs:
+            cfg.edge(o, head, _B)
+        infinite = (isinstance(s, ast.While)
+                    and isinstance(s.test, ast.Constant)
+                    and s.test.value is True)
+        if not infinite:
+            if s.orelse:
+                for o in self._block(s.orelse, [head], frames):
+                    cfg.edge(o, after)
+            else:
+                cfg.edge(head, after)
+        return [after]
+
+    def _try(self, s: ast.Try, preds: List[CFGNode],
+             frames: list) -> List[CFGNode]:
+        cfg = self.cfg
+        fin_frame = None
+        inner = list(frames)
+        if s.finalbody:
+            fe = cfg._new(s, "finally")
+            fin_frame = ["finally", fe, set()]
+            inner = inner + [fin_frame]
+        handler_entries: List[CFGNode] = []
+        if s.handlers:
+            catch_all = any(_is_catch_all(h) for h in s.handlers)
+            for h in s.handlers:
+                handler_entries.append(cfg._new(h, "except"))
+            body_frames = inner + [["except", handler_entries,
+                                    catch_all]]
+        else:
+            body_frames = inner
+        outs = self._block(s.body, preds, body_frames)
+        if s.orelse:        # runs on normal body completion, NOT
+            #                 protected by this try's handlers
+            outs = self._block(s.orelse, outs, inner)
+        for he, h in zip(handler_entries, s.handlers):
+            outs += self._block(h.body, [he], inner)
+        if fin_frame is not None:
+            fe = fin_frame[1]
+            for o in outs:
+                cfg.edge(o, fe)
+            fin_outs = self._block(s.finalbody, [fe], frames)
+            # re-dispatch every jump kind that detoured through the
+            # finally to its REAL destination, resolved against the
+            # frames OUTSIDE this try
+            for kind in sorted(fin_frame[2]):
+                for o in fin_outs:
+                    self._route(o, kind, frames,
+                                et=_E if kind == "raise" else _N)
+            return fin_outs
+        return outs
+
+
+def build_cfg(fn_node) -> CFG:
+    """CFG of one ``ast.FunctionDef`` / ``ast.AsyncFunctionDef`` body
+    (nested defs appear as single binding statements — they have their
+    own CFGs when analyzed as their own functions)."""
+    return _Builder().build(fn_node)

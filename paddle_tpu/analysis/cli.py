@@ -12,11 +12,27 @@ status: 0
 clean, 1 unsuppressed findings, 2 usage errors — suitable as a
 pre-commit hook (see README).
 
+``--changed`` scopes the REPORT to files the git working tree
+touched (staged, unstaged, and untracked ``.py`` files): the whole
+analyzed path set (default: the tier-1 targets) is still parsed —
+cross-module resolution and the interprocedural release summaries
+span it — but only findings in changed files surface, which is what
+a pre-commit hook wants.  A changed file OUTSIDE the analyzed paths
+is not checked; pass paths explicitly to widen the set.  ``--format
+sarif`` emits SARIF 2.1.0 (repo-relative uris) so CI annotates
+findings inline on the diff.
+
 ``--baseline findings.json`` grandfathers previously recorded
 findings (matched on rule + file + message, so line drift does not
 resurrect them); ``--write-baseline findings.json`` records the
-current unsuppressed set.  New code must stay clean: baselines are
-for adopting a rule over legacy findings, not for muting new ones.
+current unsuppressed set.  Loading a baseline WARNS (stderr, exit
+status unchanged) about entries whose file no longer exists — they
+can never match again and would otherwise be carried forever;
+``--write-baseline`` prunes them: entries for deleted files drop,
+and entries for files outside the analyzed path set are preserved
+as-is (a scoped re-record must not silently discard the rest of the
+baseline).  New code must stay clean: baselines are for adopting a
+rule over legacy findings, not for muting new ones.
 """
 
 from __future__ import annotations
@@ -24,10 +40,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
 from . import DEFAULT_TARGETS, analyze_paths
+from .core import Report
 from .rules import ALL_RULE_IDS, default_rules, expand_rule_ids
 
 __all__ = ["main"]
@@ -43,25 +61,240 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="paddle-tpu-check",
         description="hot-path invariant checker (sync-lint, "
-                    "trace-purity, lock-discipline, flush-point)")
+                    "trace-purity, lock-discipline, flush-point, "
+                    "claim-lifecycle)")
     p.add_argument("paths", nargs="*",
                    help="files/directories to analyze (default: the "
                         "tier-1 production modules)")
     p.add_argument("--rule", action="append", dest="rules",
                    metavar="RULE_ID", choices=list(ALL_RULE_IDS),
                    help="run only this rule (repeatable)")
+    p.add_argument("--changed", action="store_true",
+                   help="report only findings in files the git "
+                        "working tree touched (the analyzed path "
+                        "set — default: the tier-1 targets — is "
+                        "still parsed in full for resolution)")
+    p.add_argument("--format", dest="fmt",
+                   choices=("text", "json", "sarif"), default=None,
+                   help="output format (default: text)")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable findings report on stdout")
+                   help="alias for --format json")
     p.add_argument("--baseline", metavar="FILE",
                    help="JSON baseline of grandfathered findings")
     p.add_argument("--write-baseline", metavar="FILE",
                    help="record current unsuppressed findings and "
-                        "exit 0")
+                        "exit 0 (prunes entries for deleted files; "
+                        "preserves out-of-scope entries)")
     p.add_argument("--include-suppressed", action="store_true",
                    help="show suppressed findings in text output")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
     return p
+
+
+def _git_toplevel(root: str) -> Optional[str]:
+    """The git checkout toplevel containing ``root`` (which may sit
+    ABOVE it when this package is vendored inside a larger repo);
+    None when git is unavailable or ``root`` is not a checkout."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=root, capture_output=True, text=True, check=True
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return top or root
+
+
+def _git_changed_files(root: str) -> Optional[List[str]]:
+    """Absolute paths of ``.py`` files the working tree touched
+    relative to HEAD (staged + unstaged) plus untracked ones; None
+    when git is unavailable (the caller reports a usage error
+    instead of silently checking nothing).  ``git diff`` prints
+    paths relative to the repository TOPLEVEL; ``ls-files`` prints
+    them relative to its cwd — each joins onto its own base.  With
+    an UNBORN HEAD (pre-commit hook on the repo's very first commit)
+    there is nothing to diff against: everything in the index plus
+    the untracked files IS the change set."""
+    top = _git_toplevel(root)
+    if top is None:
+        return None
+    try:
+        diff = subprocess.run(
+            ["git", "-c", "core.quotePath=false", "diff",
+             "--name-only", "HEAD", "--"],
+            cwd=root, capture_output=True, text=True, check=True)
+        pairs = [(top, diff.stdout)]
+    except (OSError, subprocess.CalledProcessError):
+        try:
+            staged = subprocess.run(     # unborn HEAD: whole index
+                ["git", "-c", "core.quotePath=false",
+                 "ls-files", "--cached"],
+                cwd=root, capture_output=True, text=True, check=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        pairs = [(root, staged.stdout)]
+    try:
+        untracked = subprocess.run(
+            ["git", "-c", "core.quotePath=false", "ls-files",
+             "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    pairs.append((root, untracked.stdout))
+    out = []
+    for base, text in pairs:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.append(os.path.abspath(os.path.join(base, line)))
+    return sorted(set(out))
+
+
+def _filter_report_to(report: Report, keep_paths: List[str]) -> None:
+    keep = {os.path.abspath(p) for p in keep_paths}
+    report.findings = [f for f in report.findings
+                       if os.path.abspath(f.path) in keep]
+
+
+def _sarif(report: Report) -> str:
+    """SARIF 2.1.0: one run, one result per finding.  Suppressed /
+    baselined findings ride along with a ``suppressions`` entry so
+    the audit trail survives into CI, at level ``note``."""
+    rules_seen = sorted({f.rule for f in report.findings})
+    # CI consumers resolve uris against the GIT toplevel (which sits
+    # above _repo_root when this checkout is vendored inside a larger
+    # repo — the same case _git_changed_files handles)
+    top = _git_toplevel(_repo_root()) or _repo_root()
+
+    def _uri(path: str) -> str:
+        # CI inline annotation needs CHECKOUT-RELATIVE uris: an
+        # absolute path never matches the repository's files
+        ap = os.path.abspath(path)
+        if ap == top or ap.startswith(top + os.sep):
+            ap = os.path.relpath(ap, top)
+        return ap.replace(os.sep, "/")
+
+    results = []
+    for f in report.findings:
+        silenced = f.suppressed or f.baselined
+        res = {
+            "ruleId": f.rule,
+            "level": "note" if silenced else "error",
+            "message": {"text": f.message
+                        + (f"\nhint: {f.hint}" if f.hint else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(f.path)},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                }}],
+        }
+        if silenced:
+            res["suppressions"] = [{
+                "kind": "inSource" if f.suppressed else "external",
+                "justification": f.reason or "baselined"}]
+        results.append(res)
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paddle-tpu-check",
+                "rules": [{"id": rid} for rid in rules_seen],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
+def _load_baseline(path: str):
+    with open(path) as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise ValueError("baseline must be a JSON list")
+    for e in entries:
+        if not isinstance(e, dict) \
+                or not {"rule", "path", "message"} <= set(e):
+            raise ValueError(
+                "each baseline entry needs rule/path/message keys")
+    return entries
+
+
+def _baseline_file_exists(path: str) -> bool:
+    """Whether a baseline entry's file still exists.  Matching is
+    path-SUFFIX based (baselines survive repo relocation — see
+    Report.apply_baseline), so staleness must be too: a recorded
+    absolute path from another checkout still 'exists' when its
+    in-package suffix resolves under THIS repo root."""
+    if os.path.exists(path):
+        return True
+    from .core import _baseline_path_key
+    return os.path.exists(os.path.join(_repo_root(),
+                                       _baseline_path_key(path)))
+
+
+def _warn_stale(entries, label: str) -> List[dict]:
+    """Entries whose file is gone, reported to stderr (exit status
+    unchanged — stale baseline lines are lint about the baseline,
+    not about the code under analysis)."""
+    stale = [e for e in entries
+             if not _baseline_file_exists(e["path"])]
+    if stale:
+        gone = sorted({e["path"] for e in stale})
+        print(f"warning: {len(stale)} baseline entr(ies) in {label} "
+              f"reference files that no longer exist "
+              f"({', '.join(gone[:5])}"
+              f"{', ...' if len(gone) > 5 else ''}) — "
+              f"prune with --write-baseline", file=sys.stderr)
+    return stale
+
+
+def _write_baseline(report: Report, path: str,
+                    analyzed_paths: List[str]) -> Optional[int]:
+    """Current unsuppressed findings + preserved out-of-scope
+    entries from an existing baseline at ``path``; entries for
+    deleted files are PRUNED.  Returns the pruned count, or None
+    when an EXISTING baseline is unreadable — overwriting a corrupt
+    file would silently discard every out-of-scope entry it held,
+    exactly what the preservation contract forbids."""
+    entries = report.baseline_entries()
+    pruned = 0
+    if os.path.exists(path):
+        try:
+            old = _load_baseline(path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: existing baseline {path} is unreadable "
+                  f"({e}) — fix or delete it before re-recording",
+                  file=sys.stderr)
+            return None
+        from .core import _baseline_path_key
+        roots = [os.path.abspath(p) for p in analyzed_paths]
+
+        def in_scope(e) -> bool:
+            # judged on BOTH the recorded absolute path and its
+            # suffix resolved under this root — scoping must agree
+            # with the suffix-based matching/staleness, or a
+            # relocated-checkout entry for an in-scope file would be
+            # preserved forever next to its fresh duplicate
+            cands = {os.path.abspath(e["path"]),
+                     os.path.abspath(os.path.join(
+                         _repo_root(), _baseline_path_key(e["path"])))}
+            return any(ap == r or ap.startswith(r + os.sep)
+                       for ap in cands for r in roots)
+
+        for e in old:
+            if not _baseline_file_exists(e["path"]):
+                pruned += 1          # stale: carried forever before
+                continue
+            if not in_scope(e):
+                entries.append(e)    # outside this run: preserve
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=2)
+    print(f"wrote {len(entries)} baseline entr(ies) to {path}"
+          + (f" ({pruned} stale pruned)" if pruned else ""))
+    return pruned
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -71,7 +304,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule.rule_id:20s} {rule.description}")
         print(f"{'lock-order':20s} inconsistent lock-acquisition "
               f"orders (emitted by lock-discipline)")
+        print(f"{'except-swallow':20s} handler swallows a failure on "
+              f"a claim-holding path (emitted by claim-lifecycle)")
         return 0
+    fmt = args.fmt or ("json" if args.json else "text")
     paths = args.paths or [os.path.join(_repo_root(), t)
                            for t in DEFAULT_TARGETS]
     missing = [p for p in paths if not os.path.exists(p)]
@@ -79,28 +315,51 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
+    changed: Optional[List[str]] = None
+    if args.changed:
+        if args.write_baseline:
+            # a diff-scoped report would re-record only the changed
+            # files' findings, silently discarding every in-scope
+            # entry whose file did not change this time — refuse
+            print("error: --changed cannot be combined with "
+                  "--write-baseline (re-record from a full run)",
+                  file=sys.stderr)
+            return 2
+        changed = _git_changed_files(_repo_root())
+        if changed is None:
+            print("error: --changed needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print("no changed python files — nothing to report")
+            return 0
     report = analyze_paths(paths, rules=default_rules(args.rules))
     if args.rules:
-        # the lock rules share one implementation: scope the REPORT to
-        # the requested ids too, or `--rule lock-order` would exit 1
-        # on lock-discipline findings the user explicitly excluded
+        # the lock/claim families share one implementation each:
+        # scope the REPORT to the requested ids too, or `--rule
+        # lock-order` would exit 1 on lock-discipline findings the
+        # user explicitly excluded
         report.filter_rules(expand_rule_ids(args.rules))
+    if changed is not None:
+        _filter_report_to(report, changed)
     if args.baseline:
         try:
-            with open(args.baseline) as f:
-                report.apply_baseline(json.load(f))
+            entries = _load_baseline(args.baseline)
         except (OSError, ValueError, KeyError) as e:
             print(f"error: cannot read baseline {args.baseline}: {e}",
                   file=sys.stderr)
             return 2
+        _warn_stale(entries, args.baseline)
+        report.apply_baseline(entries)
     if args.write_baseline:
-        with open(args.write_baseline, "w") as f:
-            json.dump(report.baseline_entries(), f, indent=2)
-        print(f"wrote {len(report.baseline_entries())} baseline "
-              f"entr(ies) to {args.write_baseline}")
+        if _write_baseline(report, args.write_baseline,
+                           paths) is None:
+            return 2
         return 0
-    if args.json:
+    if fmt == "json":
         print(report.to_json())
+    elif fmt == "sarif":
+        print(_sarif(report))
     else:
         print(report.render_text(
             include_suppressed=args.include_suppressed))

@@ -1,6 +1,6 @@
 """Production rule set for the hot-path invariant checker.
 
-Four rules, each guarding an invariant a previous PR engineered into
+Six rules, each guarding an invariant a previous PR engineered into
 the serving stack (docs/STATIC_ANALYSIS.md is the catalogue):
 
 ========================  =================================================
@@ -12,6 +12,10 @@ rule id                   invariant
 ``lock-discipline``       shared cross-thread state only under its lock
 ``lock-order``            one global lock-acquisition order
 ``flush-point``           scheduler mutations behind a drained pipeline
+``claim-lifecycle``       every page/swap/export/placement claim released
+                          or transferred on every CFG path
+``except-swallow``        no handler swallows a failure on a claim-holding
+                          path (emitted by claim-lifecycle)
 ========================  =================================================
 """
 
@@ -20,45 +24,58 @@ from __future__ import annotations
 from typing import List
 
 from ..core import Rule
+from .claim_lifecycle import EXCEPT_SWALLOW_RULE_ID, ClaimLifecycleRule
 from .flush_lint import FlushPointRule
 from .lock_discipline import LOCK_ORDER_RULE_ID, LockDisciplineRule
 from .sync_lint import SyncLintRule
 from .trace_purity import TracePurityRule
 
 __all__ = ["SyncLintRule", "TracePurityRule", "LockDisciplineRule",
-           "FlushPointRule", "LOCK_ORDER_RULE_ID", "default_rules",
-           "expand_rule_ids", "ALL_RULE_IDS"]
+           "FlushPointRule", "ClaimLifecycleRule",
+           "LOCK_ORDER_RULE_ID", "EXCEPT_SWALLOW_RULE_ID",
+           "default_rules", "expand_rule_ids", "ALL_RULE_IDS"]
 
 # every id a finding can carry (lock-order is emitted by
-# LockDisciplineRule; bad-suppression/parse-error by the engine)
+# LockDisciplineRule, except-swallow by ClaimLifecycleRule;
+# bad-suppression/parse-error by the engine)
 ALL_RULE_IDS = ("sync-in-hot-path", "trace-impure", "lock-discipline",
-                "lock-order", "flush-point")
+                "lock-order", "flush-point", "claim-lifecycle",
+                "except-swallow")
+
+# rule id -> (implementing rule id, rides_along): the two families
+# where one Rule instance emits a second id
+_SECONDARY = {LOCK_ORDER_RULE_ID: "lock-discipline",
+              EXCEPT_SWALLOW_RULE_ID: "claim-lifecycle"}
 
 
 def expand_rule_ids(only: List[str]) -> set:
     """The finding ids a ``--rule`` selection is entitled to see:
     ``lock-discipline`` keeps its documented ``lock-order`` ride-along
-    (one rule emits both); the reverse does NOT hold — a run scoped to
-    ``lock-order`` must not fail on lock-discipline findings the
-    implementing rule also produced."""
+    and ``claim-lifecycle`` its ``except-swallow`` one (one rule emits
+    both); the reverse does NOT hold — a run scoped to the secondary
+    id must not fail on primary findings the implementing rule also
+    produced."""
     keep = set(only)
-    if "lock-discipline" in keep:
-        keep.add(LOCK_ORDER_RULE_ID)
+    for secondary, primary in _SECONDARY.items():
+        if primary in keep:
+            keep.add(secondary)
     return keep
 
 
 def default_rules(only: List[str] = None) -> List[Rule]:
     """The production rule set, configured from
     :mod:`paddle_tpu.analysis.annotations`.  ``only`` filters by rule
-    id; selecting ``lock-order`` runs its implementing rule
-    (LockDisciplineRule) — pair with
+    id; selecting a secondary id (``lock-order``, ``except-swallow``)
+    runs its implementing rule — pair with
     :meth:`~paddle_tpu.analysis.core.Report.filter_rules` over
     :func:`expand_rule_ids` so only the requested findings surface."""
     rules: List[Rule] = [SyncLintRule(), TracePurityRule(),
-                         LockDisciplineRule(), FlushPointRule()]
+                         LockDisciplineRule(), FlushPointRule(),
+                         ClaimLifecycleRule()]
     if only:
         keep = set(only)
-        if LOCK_ORDER_RULE_ID in keep:
-            keep.add("lock-discipline")
+        for secondary, primary in _SECONDARY.items():
+            if secondary in keep:
+                keep.add(primary)
         rules = [r for r in rules if r.rule_id in keep]
     return rules

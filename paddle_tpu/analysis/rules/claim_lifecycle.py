@@ -1,0 +1,406 @@
+"""claim-lifecycle / except-swallow: every acquired claim is released
+(or transferred) on every CFG path.
+
+The review-hardening log of PRs 5, 8 and 9 kept re-finding one bug
+class: a refcounted claim — device pages, a host-tier swap record, a
+staged KV export, an engine-local placement — acquired on one path
+and never released on an early return, an exception edge, or a
+degrade branch.  The statement-level rules cannot see it (nothing is
+wrong with any single statement); this rule walks the
+:mod:`~paddle_tpu.analysis.cfg` graph instead, the same shape as
+Clang Static Analyzer's malloc checker and Infer's bi-abduction
+resource leaks:
+
+* an ACQUIRE site (a call named in a
+  :class:`~paddle_tpu.analysis.annotations.ClaimSpec`'s ``acquires``)
+  creates a live claim;
+* the claim dies at a RELEASE (a call named in ``releases``, or any
+  call whose interprocedural summary transitively reaches one — the
+  ``_release_engine_claims`` / ``_quarantine`` helpers are credited
+  at their call sites), or — for value-bearing claims — when the
+  token ESCAPES: returned/yielded, stored into an attribute or
+  subscript (the audited registries), or passed onward as a call
+  argument;
+* the rule reports any path from the acquire to a function exit on
+  which the claim is still live.  Exits classify the finding:
+
+  - ``exit_normal`` reached with a live token → the early-return /
+    fall-through leak (value-bearing kinds only — a value-less
+    ``alloc_row`` claim is owned by the scheduler on normal paths);
+  - ``exit_raise`` reached → the exception-path leak (the unwind
+    strands the claim in a caller that never learns it exists);
+  - ``exit_normal`` reached AFTER traversing an ``except`` handler
+    entered with the claim live → the handler SWALLOWED the failure
+    without releasing: reported as **except-swallow**, anchored at
+    the handler (the claim-lifecycle finding is subsumed — one
+    defect, one finding);
+  - the acquire's own variable re-bound by a second acquire (a loop
+    back-edge re-entering the site, or a second site writing the
+    same name) with the first claim live → the re-acquire leak.
+
+Exception edges out of the acquire statement ITSELF carry no claim:
+every registered acquire rolls back before raising (``alloc_row``'s
+documented failure contract, ``swap_out_row``/``adopt_swap`` raising
+before mutation).
+
+Anchoring: claim-lifecycle findings anchor at the ACQUIRE line (one
+finding per leak class, so a deliberate transfer is justified by one
+suppression at the acquisition it covers); except-swallow findings
+anchor at the handler line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import annotations as A
+from ..cfg import (CFG, CFGNode, _call_name, _calls_in, build_cfg,
+                   node_exprs)
+from ..core import Finding, Rule
+from ..project import FunctionInfo, Project
+
+__all__ = ["ClaimLifecycleRule", "EXCEPT_SWALLOW_RULE_ID"]
+
+EXCEPT_SWALLOW_RULE_ID = "except-swallow"
+
+
+def _names_loaded(tree) -> Set[str]:
+    return {n.id for n in ast.walk(tree)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)}
+
+
+class _Acquire:
+    """One acquire site inside one function."""
+
+    __slots__ = ("node", "call", "kind", "spec", "var", "born_moved",
+                 "dropped")
+
+    def __init__(self, node: CFGNode, call: ast.Call, kind: str,
+                 spec, var: Optional[str], born_moved: bool,
+                 dropped: bool = False):
+        self.node = node
+        self.call = call
+        self.kind = kind
+        self.spec = spec
+        self.var = var              # token variable (value-bearing)
+        self.born_moved = born_moved  # transferred in the same stmt
+        self.dropped = dropped      # bare-Expr: token never bound
+
+
+class ClaimLifecycleRule(Rule):
+    rule_id = "claim-lifecycle"
+    description = ("a page/swap/export/placement claim leaks on some "
+                   "CFG path (early return, exception edge, degrade "
+                   "branch, loop re-acquire)")
+
+    def __init__(self, claims: Optional[Dict[str, object]] = None):
+        self.claims = dict(claims) if claims is not None \
+            else A.checked_claims()
+        self._acquire_names: Dict[str, List[str]] = {}
+        for kind, spec in self.claims.items():
+            for name in spec.acquires:
+                self._acquire_names.setdefault(name, []).append(kind)
+        # non-vacuity stats, read by tests/test_analysis.py
+        self.stats = {"functions_with_acquires": 0,
+                      "acquire_sites": 0, "paths_walked": 0}
+
+    @property
+    def emits(self) -> List[str]:
+        return [self.rule_id, EXCEPT_SWALLOW_RULE_ID]
+
+    # -- interprocedural release summaries --------------------------------
+    def _release_summaries(self, project: Project
+                           ) -> Dict[str, Set[str]]:
+        """kinds each analyzed function may (transitively) release.
+        Direct facts AND call edges come from the closure-pruned
+        walker (building a closure releases nothing and credits no
+        edge — a nested def has its own summary, reached only through
+        an actual call to it); edges resolve precisely where
+        possible and by method name otherwise (over-crediting a
+        release can only MISS a leak, never invent one)."""
+        release_names: Dict[str, Set[str]] = {}
+        for kind, spec in self.claims.items():
+            for name in spec.releases:
+                release_names.setdefault(name, set()).add(kind)
+        summary: Dict[str, Set[str]] = {}
+        callees: Dict[str, Set[str]] = {}
+        for q, fn in project.functions.items():
+            kinds: Set[str] = set()
+            # _calls_in prunes nested closures: a release inside a
+            # never-invoked callback must NOT credit the enclosing
+            # function (the closure has its own summary, reached via
+            # the call-graph edge only when it is actually called)
+            edges: Set[str] = set()
+            for call in _calls_in(fn.node):
+                name = _call_name(call)
+                if name in release_names:
+                    kinds |= release_names[name]
+                targets = project.resolve_call(call, fn)
+                if targets:
+                    edges.update(t.qualname for t in targets)
+                elif isinstance(call.func, ast.Attribute):
+                    edges.update(project.methods_named.get(
+                        call.func.attr, ()))
+            summary[q] = kinds
+            callees[q] = edges
+        changed = True
+        while changed:
+            changed = False
+            for q in summary:
+                add: Set[str] = set()
+                for c in callees[q]:
+                    add |= summary.get(c, set())
+                if not add <= summary[q]:
+                    summary[q] |= add
+                    changed = True
+        return summary
+
+    # -- per-node facts ----------------------------------------------------
+    def _released_kinds(self, node: CFGNode, fn: FunctionInfo,
+                        project: Project,
+                        summaries: Dict[str, Set[str]]) -> Set[str]:
+        kinds: Set[str] = set()
+        for tree in node_exprs(node):
+            if tree is None:
+                continue
+            for call in _calls_in(tree):
+                name = _call_name(call)
+                if name is None:
+                    continue
+                for kind, spec in self.claims.items():
+                    if name in spec.releases:
+                        kinds.add(kind)
+                # summary credit through resolved callees; fall back
+                # to same-named analyzed methods for opaque receivers
+                targets = [c.qualname
+                           for c in project.resolve_call(call, fn)]
+                if not targets and isinstance(call.func,
+                                              ast.Attribute):
+                    targets = project.methods_named.get(
+                        call.func.attr, [])
+                for t in targets:
+                    kinds |= summaries.get(t, set())
+        return kinds
+
+    def _acquires_at(self, node: CFGNode) -> List[Tuple[ast.Call,
+                                                        str]]:
+        out = []
+        for tree in node_exprs(node):
+            if tree is None:
+                continue
+            for call in _calls_in(tree):
+                name = _call_name(call)
+                for kind in self._acquire_names.get(name, ()):
+                    out.append((call, kind))
+        return out
+
+    def _token_of(self, node: CFGNode, call: ast.Call,
+                  value_bearing: bool
+                  ) -> Tuple[Optional[str], bool, bool]:
+        """(token variable, born_moved, dropped).  A value-bearing
+        acquire whose result goes straight into a return / attribute
+        / subscript / enclosing call is transferred in the same
+        statement; one bound to a simple name is tracked by that
+        name; a BARE expression statement drops the token on the
+        floor (``dropped`` — reported immediately, the most blatant
+        leak shape); anything else (tuple unpacking, embedded
+        expressions) is treated as moved."""
+        s = node.stmt
+        if not value_bearing:
+            return None, False, False
+        if isinstance(s, ast.Assign) and s.value is call \
+                and len(s.targets) == 1:
+            t = s.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id, False, False
+            return None, True, False    # registry store / unpacking
+        if isinstance(s, ast.AnnAssign) and s.value is call \
+                and isinstance(s.target, ast.Name):
+            return s.target.id, False, False
+        if isinstance(s, ast.Expr) and s.value is call:
+            return None, True, True     # result discarded outright
+        return None, True, False
+
+    def _escapes(self, node: CFGNode, var: str) -> bool:
+        """Does ``var`` escape at this node: returned/yielded, stored
+        into an attribute/subscript, or passed as an argument?"""
+        for tree in node_exprs(node):
+            if tree is None:
+                continue
+            for n in ast.walk(tree):
+                if isinstance(n, (ast.Return, ast.Yield,
+                                  ast.YieldFrom)):
+                    if n.value is not None \
+                            and var in _names_loaded(n.value):
+                        return True
+                elif isinstance(n, ast.Call):
+                    args = list(n.args) + [k.value
+                                           for k in n.keywords]
+                    if any(var in _names_loaded(a) for a in args):
+                        return True
+                elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    reg = [t for t in targets
+                           if isinstance(t, (ast.Attribute,
+                                             ast.Subscript))]
+                    if reg and var in _names_loaded(n.value):
+                        return True
+                    # the token as the KEY of a registry store
+                    # (`local_rids[local] = rid`) is the transfer too
+                    if any(var in _names_loaded(t) for t in reg):
+                        return True
+        return False
+
+    def _rebinds(self, node: CFGNode, var: str) -> bool:
+        for tree in node_exprs(node):
+            if tree is None:
+                continue
+            for n in ast.walk(tree):
+                if isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == var
+                        for t in n.targets):
+                    return True
+                if isinstance(n, (ast.AnnAssign, ast.AugAssign)) \
+                        and isinstance(n.target, ast.Name) \
+                        and n.target.id == var:
+                    return True
+        return False
+
+    # -- the walk ----------------------------------------------------------
+    def run(self, project: Project) -> List[Finding]:
+        summaries = self._release_summaries(project)
+        findings: List[Finding] = []
+        for q in sorted(project.functions):
+            fn = project.functions[q]
+            findings.extend(
+                self._check_function(fn, project, summaries))
+        return findings
+
+    def _check_function(self, fn: FunctionInfo, project: Project,
+                        summaries: Dict[str, Set[str]]
+                        ) -> List[Finding]:
+        # cheap pre-scan before paying for a CFG
+        names = {_call_name(c) for c in _calls_in(fn.node)}
+        if not names & set(self._acquire_names):
+            return []
+        cfg = build_cfg(fn.node)
+        acquires: List[_Acquire] = []
+        for node in cfg.stmt_nodes():
+            for call, kind in self._acquires_at(node):
+                spec = self.claims[kind]
+                var, born_moved, dropped = self._token_of(
+                    node, call, spec.value_bearing)
+                acquires.append(_Acquire(node, call, kind, spec,
+                                         var, born_moved, dropped))
+        if not acquires:
+            return []
+        self.stats["functions_with_acquires"] += 1
+        self.stats["acquire_sites"] += len(acquires)
+        released: Dict[int, Set[str]] = {
+            n.idx: self._released_kinds(n, fn, project, summaries)
+            for n in cfg.nodes if n.stmt is not None}
+        out: List[Finding] = []
+        for acq in acquires:
+            if acq.dropped:
+                name = _call_name(acq.call)
+                out.append(Finding(
+                    self.rule_id, fn.module.path, acq.call.lineno,
+                    acq.call.col_offset,
+                    f"claim `{acq.kind}` acquired by `{name}()` in "
+                    f"{fn.qualname} has its token DISCARDED (bare "
+                    f"statement) — nothing can ever release it",
+                    "bind the result and release it or store it "
+                    "into an audited registry"))
+                continue
+            if acq.born_moved:
+                continue
+            out.extend(self._walk_claim(cfg, fn, acq, acquires,
+                                        released))
+        return out
+
+    def _walk_claim(self, cfg: CFG, fn: FunctionInfo, acq: _Acquire,
+                    acquires: List[_Acquire],
+                    released: Dict[int, Set[str]]) -> List[Finding]:
+        self.stats["paths_walked"] += 1
+        # nodes where a second acquire would re-bind THIS claim's
+        # token before it is released (loop back-edge shapes)
+        rebind_sites = {a.node.idx for a in acquires
+                        if acq.var is not None and a.var == acq.var} \
+            | ({acq.node.idx} if acq.var is not None else set())
+        leaks: Dict[str, CFGNode] = {}      # class -> anchor node
+        start = [(i, None) for i, et in acq.node.succ if et != "e"]
+        seen: Set[Tuple[int, Optional[int]]] = set()
+        stack = list(start)
+        while stack:
+            nid, handler = stack.pop()
+            if (nid, handler) in seen:
+                continue
+            seen.add((nid, handler))
+            node = cfg.nodes[nid]
+            if node is cfg.exit_normal:
+                if handler is not None:
+                    leaks.setdefault("swallow", cfg.nodes[handler])
+                elif acq.spec.value_bearing:
+                    leaks.setdefault("return", node)
+                continue
+            if node is cfg.exit_raise:
+                leaks.setdefault("raise", node)
+                continue
+            if acq.kind in released.get(nid, ()):
+                continue                      # claim retired
+            if acq.var is not None and self._escapes(node, acq.var):
+                continue                      # token transferred
+            if nid in rebind_sites:
+                leaks.setdefault("reacquire", node)
+                continue
+            if acq.var is not None and node.stmt is not None \
+                    and self._rebinds(node, acq.var):
+                continue                      # token rebound: opaque
+            if node.kind == "except":
+                handler = nid
+            stack.extend((i, handler) for i, _et in node.succ)
+        return self._render(fn, acq, leaks)
+
+    def _render(self, fn: FunctionInfo, acq: _Acquire,
+                leaks: Dict[str, CFGNode]) -> List[Finding]:
+        out: List[Finding] = []
+        mod = fn.module
+        call, kind = acq.call, acq.kind
+        name = _call_name(call)
+        what = (f"claim `{kind}` acquired by `{name}()` in "
+                f"{fn.qualname}")
+        hint = (f"release it ({', '.join(sorted(acq.spec.releases))})"
+                f" or transfer it into an audited registry on that "
+                f"path; a deliberate transfer is justified with "
+                f"`# analysis: ignore[claim-lifecycle] reason=...`")
+        if "return" in leaks:
+            out.append(Finding(
+                self.rule_id, mod.path, call.lineno, call.col_offset,
+                f"{what} can reach a return with the token neither "
+                f"released nor stored", hint))
+        if "raise" in leaks:
+            out.append(Finding(
+                self.rule_id, mod.path, call.lineno, call.col_offset,
+                f"{what} escapes on an exception path without a "
+                f"release", hint))
+        if "reacquire" in leaks:
+            out.append(Finding(
+                self.rule_id, mod.path, call.lineno, call.col_offset,
+                f"{what} is re-acquired (loop back-edge or second "
+                f"site rebinding `{acq.var}`) before the live claim "
+                f"is released", hint))
+        if "swallow" in leaks:
+            h = leaks["swallow"]
+            out.append(Finding(
+                EXCEPT_SWALLOW_RULE_ID, mod.path, h.line,
+                h.stmt.col_offset if h.stmt is not None else 0,
+                f"`except` handler swallows a failure while "
+                f"{what.split(' in ')[0]} (line {call.lineno}) is "
+                f"live — the handler neither releases it nor "
+                f"re-raises",
+                f"release the claim in the handler, re-raise, or "
+                f"route the token out before the fallthrough"))
+        return out

@@ -799,10 +799,14 @@ class GenerationServer:
         with self._lock:
             if self._fatal is not None:
                 raise RuntimeError(f"engine died: {self._fatal}")
+            # build the waiter queue BEFORE the engine accepts: the
+            # placement must commit to _queues with nothing fallible
+            # in between, or the engine generates for a client no
+            # fan-out can reach (claim-lifecycle: placed-request)
+            q = _queue.Queue()
             rid = self._driver.submit(prompt,
                                       max_new_tokens=max_new_tokens,
                                       deadline_s=deadline_s)
-            q = _queue.Queue()
             self._queues[rid] = q
         self._http_counters["generate"].inc()
         return rid, q

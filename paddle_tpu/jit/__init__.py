@@ -260,6 +260,7 @@ class StaticFunction:
                     if conv is not None:
                         import types
                         orig_fwd = layer.__dict__.get("forward")
+                        # analysis: ignore[trace-impure] reason=deliberate once-per-trace monkeypatch routing the dy2static-converted forward; restored in the finally below before tracing returns
                         layer.forward = types.MethodType(conv, layer)
                         try:
                             with rng_guard:
@@ -270,6 +271,7 @@ class StaticFunction:
                             if orig_fwd is None:
                                 del layer.forward
                             else:
+                                # analysis: ignore[trace-impure] reason=restores the pre-trace forward the monkeypatch above replaced; both writes happen once per trace by design
                                 layer.forward = orig_fwd
                     else:
                         with rng_guard:
@@ -282,6 +284,7 @@ class StaticFunction:
                         out = fn(*call_args, **call_kwargs)
                 flat, treedef = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))
+                # analysis: ignore[trace-impure] reason=the canonical smuggle-the-treedef-out-of-trace idiom: the structure is a trace-time constant recorded exactly once per compile, which is the point
                 out_tree[0] = treedef
                 return tuple(t._data if isinstance(t, Tensor)
                              else jnp.asarray(t) for t in flat)

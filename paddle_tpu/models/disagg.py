@@ -340,10 +340,14 @@ class DecodeEngine(ContinuousBatchingEngine):
             # count 429s no client ever saw (the fleet router learned
             # this the same way)
             raise QueueFullError(why, retry_after=self.retry_after_s())
+        # validate + import BEFORE claiming the host tier: a geometry
+        # mismatch used to raise AFTER adopt_swap, orphaning the
+        # adopted record (host pages pinned forever — caught by the
+        # claim-lifecycle rule, pinned by test_claim_regressions)
+        req = self._import_request(src)
         blobs = rec.materialize()
         faults.fire("kv_handoff")              # RESTORE half
         handle = self.cache.adopt_swap(*blobs)
-        req = self._import_request(src)
         self._swap_handles[req.rid] = handle
         self._handoff_blobs[req.rid] = blobs
         self._handoff_first.add(req.rid)
@@ -747,7 +751,13 @@ class DisaggCoordinator:
                 disagg = False
         target = self.prefill if disagg else self.decode
         # place BEFORE committing the rid: a rejected submit must not
-        # burn a coordinator rid or count a routing decision
+        # burn a coordinator rid or count a routing decision.  The
+        # clock read and the decision counter both moved OUT of the
+        # placement→commit window: nothing fallible may run between
+        # the engine accepting the request and the rid tables mapping
+        # it, or the engine generates for a request the coordinator
+        # cannot cancel/triage (claim-lifecycle: placed-request)
+        now = self._now()
         try:
             local = target.submit(prompt,
                                   max_new_tokens=max_new_tokens,
@@ -766,8 +776,6 @@ class DisaggCoordinator:
                                   max_new_tokens=max_new_tokens,
                                   stop_sequences=stop_sequences,
                                   deadline_s=deadline_s)
-        self._count_placement_locked(disagg)
-        now = self._now()
         freq = _DisaggRequest(
             self._next_rid, prompt, int(max_new_tokens),
             stop_sequences,
@@ -779,6 +787,7 @@ class DisaggCoordinator:
             self._prefill_rids[local] = freq.rid
         else:
             self._decode_rids[local] = freq.rid
+        self._count_placement_locked(disagg)
         return freq.rid
 
     def _step_locked(self) -> int:

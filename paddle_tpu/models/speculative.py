@@ -54,6 +54,7 @@ def _prefill_into(cfg, params, cache: PagedKVCache, prompt: np.ndarray):
     """Dense prefill of ``prompt`` into row 0; returns the greedy next
     token.  Sets lens = len(prompt)."""
     L = len(prompt)
+    # analysis: ignore[claim-lifecycle] reason=one-shot generate: both caches are local to generate_speculative and die with any exception — no pool outlives the call to audit
     cache.alloc_row(0, L)
     page = cache.page
     Lp = ((L + page - 1) // page) * page
@@ -296,6 +297,7 @@ class SpeculativeEngine(ContinuousBatchingEngine):
         # committed sequence for this slot
         ctx = self._ctx_of(req)
         L = len(ctx)
+        # analysis: ignore[claim-lifecycle] reason=draft-row transfer: a draft prefill fault quarantines, and _retire_abnormal releases the slot through _release_slot -> _release_aux -> dcache.release_row (audit-clean)
         self.dcache.alloc_row(slot, L)
         page = self.dcache.page
         Lp = ((L + page - 1) // page) * page

@@ -192,5 +192,6 @@ def apply(name: str, jfn: Callable, *inputs: Tensor,
     if flags.FLAGS_benchmark and not tape.in_functional_trace():
         for o in outs_t:
             if hasattr(o, "block_until_ready"):
+                # analysis: ignore[sync-in-hot-path] reason=FLAGS_benchmark opt-in: per-op timing is a sync by definition; the flag is never set in serving
                 o.block_until_ready()
     return out_tensors[0] if single else out_tensors

@@ -46,10 +46,12 @@ def _bwd_kernel(x_ref, w_ref, rstd_ref, do_ref, dx_ref, dwp_ref):
 
     @pl.when(pl.program_id(0) == 0)
     def _init():
+        # analysis: ignore[trace-impure] reason=Pallas Ref store IS the kernel's output path (pl.when branches write the grid-resident accumulator), not trace-time state capture
         dwp_ref[:] = slab
 
     @pl.when(pl.program_id(0) != 0)
     def _accum():
+        # analysis: ignore[trace-impure] reason=Pallas Ref store IS the kernel's output path (pl.when branches write the grid-resident accumulator), not trace-time state capture
         dwp_ref[:] = dwp_ref[:] + slab
 
 

@@ -205,6 +205,65 @@ def _ordered_rules() -> list:
 
 
 # ---------------------------------------------------------------------------
+# T5: claim lifecycle (claim-lifecycle + except-swallow)
+# ---------------------------------------------------------------------------
+# Known-good acquire/release shapes over a swap-record-style claim:
+# the early-return branch discards, the degrade handler discards
+# before falling back, the loop stores each handle before the next
+# acquire.  Each leak-class mutant removes exactly one of those.
+_CLAIMS = '''\
+class Engine:
+    def preempt(self, slot):
+        handle = self.cache.swap_out_row(slot)
+        if self._full:
+            self.cache.discard_swap(handle)  # MUTATE: early-release
+            return None
+        self._swap_handles[slot] = handle
+        return handle
+
+    def resume(self, slot):
+        handle = self.cache.swap_out_row(slot)
+        try:
+            self.dispatch(slot)
+        except Exception:
+            self.cache.discard_swap(handle)  # MUTATE: swallow-release
+            return None
+        self._swap_handles[slot] = handle
+        return handle
+
+    def ship(self, slot):
+        state = self.cache.export_row(slot)
+        try:
+            self.transport_send(slot)
+        except Exception:
+            # degrade: colocated fallback
+            self.cache.export_discard(state)  # MUTATE: degrade-discard
+            return False
+        self._records[slot] = state
+        return True
+
+    def park_all(self, slots):
+        for s in slots:
+            h = self.cache.swap_out_row(s)
+            self._swap_handles[s] = h  # MUTATE: loop-store
+'''
+
+
+def _claim_rules() -> list:
+    from paddle_tpu.analysis.annotations import ClaimSpec
+    from paddle_tpu.analysis.rules import ClaimLifecycleRule
+    return [ClaimLifecycleRule(claims={
+        "swap-record": ClaimSpec(
+            kind="swap-record",
+            acquires=frozenset({"swap_out_row"}),
+            releases=frozenset({"discard_swap"})),
+        "export-record": ClaimSpec(
+            kind="export-record",
+            acquires=frozenset({"export_row"}),
+            releases=frozenset({"export_discard"}))})]
+
+
+# ---------------------------------------------------------------------------
 # the catalogue
 # ---------------------------------------------------------------------------
 def base_cases() -> List[BaseCase]:
@@ -216,6 +275,8 @@ def base_cases() -> List[BaseCase]:
                  _locked_rules),
         BaseCase("lock-pair", {"fixture_order": _ORDERED},
                  _ordered_rules),
+        BaseCase("claim-shapes", {"fixture_claim": _CLAIMS},
+                 _claim_rules),
     ]
 
 
@@ -279,4 +340,28 @@ def iter_mutants() -> List[Mutant]:
     out.append(Mutant("invert-lock-order",
                       {"fixture_order": inverted},
                       _ordered_rules, "lock-order"))
+
+    def claim(name, marker, payload, expect):
+        out.append(Mutant(
+            name, {"fixture_claim":
+                   _replace_marker(_CLAIMS, marker, payload)},
+            _claim_rules, expect))
+
+    # 12. drop the release before an early return: the refused-claim
+    #     branch leaks the handle on a NORMAL exit
+    claim("drop-release-before-early-return",
+          "# MUTATE: early-release", ["pass"], "claim-lifecycle")
+    # 13. swallow the exception around a release: the handler neither
+    #     discards nor re-raises, then returns — the failure path
+    #     leaks THROUGH the handler
+    claim("swallow-exception-around-release",
+          "# MUTATE: swallow-release", ["pass"], "except-swallow")
+    # 14. delete the degrade-path discard: the colocated-fallback
+    #     branch strands the staged export
+    claim("delete-degrade-path-discard",
+          "# MUTATE: degrade-discard", ["pass"], "except-swallow")
+    # 15. re-acquire without releasing in a loop: the back edge
+    #     re-binds the handle while the previous claim is live
+    claim("reacquire-in-loop-without-release",
+          "# MUTATE: loop-store", ["pass"], "claim-lifecycle")
     return out

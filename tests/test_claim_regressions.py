@@ -1,0 +1,184 @@
+"""Regression pins for the real claim-leak findings the
+claim-lifecycle CFG rule surfaced (ISSUE 11, satellite 1).  Each test
+drives the ORIGINAL leak path and asserts the allocator stays
+audit-clean — i.e. the reordered code releases/commits the claim the
+old code stranded:
+
+* ``DecodeEngine.admit_handoff`` used to ``adopt_swap`` BEFORE
+  validating the request against the decode cache's geometry
+  (``_import_request``): a geometry mismatch raised ``ValueError``
+  after the adopt, orphaning the adopted swap record — host pages
+  pinned forever, ``audit()`` failing one subsystem away.  Fixed by
+  importing first; pinned here with a deliberately roomier prefill
+  cache.
+* ``DisaggCoordinator._submit_locked`` ran the clock seam and the
+  placement counter between the engine accepting the request and the
+  rid tables mapping it: a failure there stranded an accepted
+  request no table could cancel or triage.  Fixed by moving both out
+  of the placement→commit window.
+* ``GenerationServer.submit`` built the waiter queue AFTER the
+  engine accepted: a ``Queue()`` failure left the engine generating
+  for a client no fan-out could reach.  Fixed by building the queue
+  first.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.disagg import (DecodeEngine, DisaggCoordinator,
+                                      PrefillEngine)
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # identical to tests/test_disagg.py's config so jitted-program
+    # caches (keyed on cfg) are shared across the suite
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+def _prefill_cache(cfg):
+    # tests/test_disagg.py's geometry (row capacity 8*16 = 128), so
+    # the jitted programs are shared across the suite
+    return PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                        page=16, host_pages=32)
+
+
+def _decode_cache(cfg):
+    # tight geometry: row capacity = pages_max*page = 4*16 = 64 —
+    # rows the prefill side above holds fine but this pool can NEVER
+    return PagedKVCache(cfg, num_pages=32, pages_max=4, batch=2,
+                        page=16, host_pages=32)
+
+
+def test_admit_handoff_geometry_mismatch_leaves_audit_clean(cfg,
+                                                            params):
+    """The RESTORE half refusing a record (ValueError: prompt +
+    max_new_tokens exceed the decode row capacity) must not orphan an
+    adopted swap record in the decode cache's host tier."""
+    rng = np.random.RandomState(7)
+    pe = PrefillEngine(cfg, params, _prefill_cache(cfg),
+                       metrics_registry=False)
+    de = DecodeEngine(cfg, params, _decode_cache(cfg),
+                      metrics_registry=False)
+    # a prompt the PREFILL cache holds fine but whose worst case
+    # (prompt + max_new) the decode row capacity cannot
+    pe.submit(rng.randint(1, 128, (40,)), max_new_tokens=60)
+    for _ in range(50):
+        if pe._handoff_ready:
+            break
+        pe.step()
+    recs = pe.take_handoffs()
+    assert len(recs) == 1
+    before = len(de.cache._swapped)
+    with pytest.raises(ValueError):
+        de.admit_handoff(recs[0])
+    # the old ordering left an orphaned record here: adopt_swap ran
+    # before _import_request's validation raised
+    assert len(de.cache._swapped) == before == 0
+    de.cache.audit()
+    # the record is still whole — the caller's degrade path owns it
+    recs[0].discard()
+    pe.cache.audit()
+
+
+def test_coordinator_commit_window_has_nothing_fallible(cfg, params):
+    """A failure in the placement counter (the last fallible thing
+    that used to sit between placement and commit) must not strand an
+    accepted request outside the rid tables; after the reorder the
+    request is tracked — cancellable, triagable — even if the counter
+    blows up."""
+    rng = np.random.RandomState(8)
+    pe = PrefillEngine(cfg, params, _prefill_cache(cfg),
+                       metrics_registry=False)
+    de = DecodeEngine(cfg, params, _decode_cache(cfg),
+                      metrics_registry=False)
+    co = DisaggCoordinator(pe, de, metrics_registry=False,
+                           force_route="colocated")
+
+    def boom(disagg):
+        raise RuntimeError("counter backend down")
+
+    co._count_placement_locked = boom
+    with pytest.raises(RuntimeError):
+        co.submit(rng.randint(1, 128, (6,)), max_new_tokens=4)
+    # the engine-side placement IS mapped: the coordinator can still
+    # cancel it (the old order left the engine generating for a
+    # request no table knew)
+    assert len(co._requests) == 1
+    rid = next(iter(co._requests))
+    assert co.cancel(rid)
+    for _ in range(50):
+        if not co.has_work():
+            break
+        co.step()
+        co.finished()
+        co.drain_stream()
+    de.cache.audit()
+    pe.cache.audit()
+
+
+def test_generation_server_queue_exists_before_placement(cfg, params,
+                                                         monkeypatch):
+    """GenerationServer.submit builds the waiter queue BEFORE the
+    engine accepts: when Queue() construction fails, the engine must
+    not have accepted anything (no tokens generated for a client no
+    fan-out can reach)."""
+    import queue as _qmod
+
+    from paddle_tpu.models.serving_engine import \
+        ContinuousBatchingEngine
+    from paddle_tpu.inference.serving import GenerationServer
+
+    eng = ContinuousBatchingEngine(
+        cfg, params, _decode_cache(cfg), metrics_registry=False)
+    srv = GenerationServer.__new__(GenerationServer)
+    # minimal wiring: submit() only touches _lock/_fatal/_driver/
+    # _queues/_http_counters (_driver is a property over the
+    # supervisor-or-engine seam)
+    import threading
+    srv._lock = threading.Lock()
+    srv._fatal = None
+    srv._supervisor = None
+    srv._engine = eng
+    srv.engine_factory = None
+    srv._queues = {}
+
+    class _Cnt:
+        def inc(self, *a):
+            pass
+
+    srv._http_counters = {"generate": _Cnt()}
+    rid, q = srv.submit([1, 2, 3], 4)
+    assert rid in srv._queues and srv._queues[rid] is q
+
+    def boom():
+        raise MemoryError("no queue")
+
+    monkeypatch.setattr(_qmod, "Queue", boom)
+    n_before = len(eng._queue)
+    with pytest.raises(MemoryError):
+        srv.submit([4, 5, 6], 4)
+    # the engine accepted NOTHING for the failed submit
+    assert len(eng._queue) == n_before
+    eng.cache.audit()

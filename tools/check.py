@@ -6,7 +6,9 @@ works from a bare checkout; the installed console script
 
     python tools/check.py                      # tier-1 modules, all rules
     python tools/check.py --rule sync-in-hot-path paddle_tpu/models
+    python tools/check.py --changed            # pre-commit: changed files
     python tools/check.py --json               # machine-readable
+    python tools/check.py --format sarif       # CI inline annotations
     python tools/check.py --write-baseline baseline.json
 
 Exit status: 0 clean, 1 unsuppressed findings, 2 usage error.
