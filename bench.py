@@ -1117,6 +1117,139 @@ def _fleet_line() -> dict:
     }
 
 
+def _ab_pct(xs, q):
+    """Percentile over a small sample (shared by the serving A/B
+    lines so their reported quantiles are computed identically)."""
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3) \
+        if xs else 0.0
+
+
+def _ab_lat_stats(done) -> dict:
+    """TTFT/TPOT p50/p99 over the ok-finished requests — the shared
+    latency block of the serving A/B lines."""
+    ok = [r for r in done if r.status == "ok"]
+    ttft = [(r.t_first_token - r.t_submit) * 1000
+            for r in ok if r.t_first_token]
+    tpot = [(r.t_finish - r.t_first_token) * 1000
+            / (len(r.generated) - 1)
+            for r in ok if r.t_first_token and len(r.generated) > 1]
+    return {"requests_ok": len(ok),
+            "ttft_p50_ms": _ab_pct(ttft, 0.5),
+            "ttft_p99_ms": _ab_pct(ttft, 0.99),
+            "tpot_p50_ms": _ab_pct(tpot, 0.5),
+            "tpot_p99_ms": _ab_pct(tpot, 0.99)}
+
+
+def _ab_drive(submit, step, admitted_this_tick, schedule, wave_gap,
+              new, stagger=0):
+    """Shared offered-load loop of the serving A/B lines
+    (serving_disagg_ab, serving_mixed_ab — SAME harness, so their
+    ratios stay comparable at the same offered load): submit waves on
+    schedule, step once per tick, sample the decode-step wall split
+    by whether this tick was admission-adjacent.  ``stagger`` adds
+    ``stagger * j`` generated tokens to the j-th request of each wave
+    so the resident batch drains gradually (slots free while
+    neighbours still decode — the arrival pattern the mixed lane
+    exists for; 0 keeps the lockstep schedule)."""
+    adm, quiet = [], []
+    pend = list(enumerate(schedule))
+    tick = 0
+    done = []
+    while pend or step.__self__.has_work():
+        if pend and tick >= pend[0][0] * wave_gap:
+            for j, p in enumerate(pend.pop(0)[1]):
+                submit(p, new + stagger * j)
+        t0 = time.perf_counter()
+        step()
+        wall = (time.perf_counter() - t0) * 1000
+        drv = step.__self__
+        dec_ms = wall if not hasattr(drv, "last_decode_step_s") \
+            else drv.last_decode_step_s * 1000
+        hit = admitted_this_tick()    # advances its counters —
+        #                               consult EVERY tick
+        if dec_ms > 0:        # ticks with no decode work carry no
+            #                   decode-step sample
+            (adm if hit else quiet).append(dec_ms)
+        done.extend(drv.finished())
+        tick += 1
+        if tick > 5000:
+            raise RuntimeError("serving A/B bench did not drain")
+    return adm, quiet, done
+
+
+def _ab_run_disagg(cfg, params, mk_cache, host_pages, batch,
+                   long_lens, short_lens, drive, warm_sched, sched,
+                   detail=False, registry=None, ring=None):
+    """The 1P+1D arm shared by serving_disagg_ab and
+    serving_mixed_ab (ONE implementation, so the two lines' disagg
+    numbers stay comparable as the harness evolves): build the pair,
+    calibrate the cost-model link speed so the decision SPLITS this
+    workload (geometric mean of the gbps thresholds at which the
+    shortest long prompt and the longest short prompt flip — the
+    decision stays a counter), warm, drive, report.  ``detail`` adds
+    the routing/handoff counters serving_disagg_ab reports."""
+    import numpy as np
+
+    from paddle_tpu.models.disagg import (DecodeEngine,
+                                          DisaggCoordinator,
+                                          PrefillEngine,
+                                          handoff_flip_gbps)
+
+    pe = PrefillEngine(cfg, params, mk_cache(host_pages),
+                       metrics_registry=registry
+                       if registry is not None else False,
+                       metrics_ring=ring,
+                       max_inflight_handoffs=2 * batch)
+    de = DecodeEngine(cfg, params, mk_cache(host_pages),
+                      metrics_registry=registry
+                      if registry is not None else False,
+                      metrics_ring=ring)
+    gbps = float(np.sqrt(
+        handoff_flip_gbps(min(long_lens), de)
+        * handoff_flip_gbps(max(short_lens), de)))
+    co = DisaggCoordinator(pe, de, handoff_gbps=gbps)
+    last = {"pf": pe.prefill_calls, "sw": de.resumes_swapped}
+
+    def admitted():
+        # an admission-adjacent tick: the prefill engine ran a wave
+        # OR the decode engine restored shipped pages (the disagg
+        # arm's admission cost lives in the restores)
+        hit = (pe.prefill_calls > last["pf"]
+               or de.resumes_swapped > last["sw"])
+        last["pf"] = pe.prefill_calls
+        last["sw"] = de.resumes_swapped
+        return hit
+
+    submit = lambda p, n: co.submit(p, max_new_tokens=n)  # noqa: E731
+    drive(submit, co.step, admitted, warm_sched)    # compiles
+    warm_routed = dict(co.routed)
+    adm, quiet, done = drive(submit, co.step, admitted, sched)
+    out = _ab_lat_stats(done)
+    out.update({"decode_step_p99_during_admission_ms":
+                _ab_pct(adm, 0.99),
+                "decode_step_p50_during_admission_ms":
+                _ab_pct(adm, 0.5),
+                "decode_step_p99_quiet_ms": _ab_pct(quiet, 0.99),
+                "admission_ticks": len(adm),
+                "handoff_gbps_knob": round(gbps, 3)})
+    if detail:
+        out.update({
+            "routed": {k: co.routed[k] - warm_routed[k]
+                       for k in co.routed},
+            "handoffs_shipped": co.handoffs_shipped,
+            "handoff_pages": co.handoff_pages,
+            "handoff_ms_per_request": round(
+                1000.0 * co.handoff_wall_s
+                / max(co.handoffs_shipped, 1), 4),
+            "colocated_fallbacks": co.colocated_fallbacks,
+            "decode_prefill_calls": de.prefill_calls,
+            "prefill_tokens_avoided": de.prefill_tokens_avoided})
+    pe.cache.audit()
+    de.cache.audit()
+    return out
+
+
 def _disagg_line() -> dict:
     """DISAGGREGATED prefill/decode A/B (PR-9 tentpole): the same
     offered load — waves of long prompts (the stall-inducing
@@ -1135,10 +1268,6 @@ def _disagg_line() -> dict:
     import numpy as np
     from jax.sharding import Mesh
 
-    from paddle_tpu.models.disagg import (DecodeEngine,
-                                          DisaggCoordinator,
-                                          PrefillEngine,
-                                          handoff_flip_gbps)
     from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
                                                   init_params)
     from paddle_tpu.models.paged_decode import PagedKVCache
@@ -1202,53 +1331,11 @@ def _disagg_line() -> dict:
                             pages_max=pages_max, batch=batch,
                             page=page, host_pages=hp)
 
-    def pct(xs, q):
-        xs = sorted(xs)
-        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3) \
-            if xs else 0.0
-
-    def lat_stats(done):
-        ok = [r for r in done if r.status == "ok"]
-        ttft = [(r.t_first_token - r.t_submit) * 1000
-                for r in ok if r.t_first_token]
-        tpot = [(r.t_finish - r.t_first_token) * 1000
-                / (len(r.generated) - 1)
-                for r in ok if r.t_first_token
-                and len(r.generated) > 1]
-        return {"requests_ok": len(ok),
-                "ttft_p50_ms": pct(ttft, 0.5),
-                "ttft_p99_ms": pct(ttft, 0.99),
-                "tpot_p50_ms": pct(tpot, 0.5),
-                "tpot_p99_ms": pct(tpot, 0.99)}
+    pct, lat_stats = _ab_pct, _ab_lat_stats
 
     def drive(submit, step, admitted_this_tick, schedule):
-        """Shared offered-load loop: submit waves on schedule, step
-        once per tick, sample the decode-step wall split by whether
-        an admission wave ran this tick."""
-        adm, quiet = [], []
-        pend = list(enumerate(schedule))
-        tick = 0
-        done = []
-        while pend or step.__self__.has_work():
-            if pend and tick >= pend[0][0] * wave_gap:
-                for p in pend.pop(0)[1]:
-                    submit(p, new)
-            t0 = time.perf_counter()
-            step()
-            wall = (time.perf_counter() - t0) * 1000
-            drv = step.__self__
-            dec_ms = wall if not hasattr(drv, "last_decode_step_s") \
-                else drv.last_decode_step_s * 1000
-            hit = admitted_this_tick()    # advances its counters —
-            #                               consult EVERY tick
-            if dec_ms > 0:        # disagg ticks with no decode work
-                #                   carry no decode-step sample
-                (adm if hit else quiet).append(dec_ms)
-            done.extend(drv.finished())
-            tick += 1
-            if tick > 5000:
-                raise RuntimeError("disagg bench did not drain")
-        return adm, quiet, done
+        return _ab_drive(submit, step, admitted_this_tick, schedule,
+                         wave_gap, new)
 
     def run_unified():
         eng = ContinuousBatchingEngine(
@@ -1273,61 +1360,12 @@ def _disagg_line() -> dict:
         eng.cache.audit()
         return out
 
-    def run_disagg():
-        pe = PrefillEngine(cfg, params, mk_cache(host_pages),
-                           metrics_registry=default_registry(),
-                           metrics_ring=default_ring(),
-                           max_inflight_handoffs=2 * batch)
-        de = DecodeEngine(cfg, params, mk_cache(host_pages),
-                          metrics_registry=default_registry(),
-                          metrics_ring=default_ring())
-        # calibrate the cost-model link speed so the decision SPLITS
-        # this workload: geometric mean of the gbps thresholds at
-        # which the shortest long prompt and the longest short prompt
-        # flip (the decision stays a counter, reported below)
-        gbps = float(np.sqrt(
-            handoff_flip_gbps(min(long_lens), de)
-            * handoff_flip_gbps(max(short_lens), de)))
-        co = DisaggCoordinator(pe, de, handoff_gbps=gbps)
-        last = {"pf": pe.prefill_calls, "sw": de.resumes_swapped}
-
-        def admitted():
-            # an admission-adjacent tick: the prefill engine ran a
-            # wave OR the decode engine restored shipped pages (the
-            # disagg arm's admission cost lives in the restores)
-            hit = (pe.prefill_calls > last["pf"]
-                   or de.resumes_swapped > last["sw"])
-            last["pf"] = pe.prefill_calls
-            last["sw"] = de.resumes_swapped
-            return hit
-
-        submit = lambda p, n: co.submit(p, max_new_tokens=n)  # noqa: E731
-        drive(submit, co.step, admitted, warm_sched)    # compiles
-        warm_routed = dict(co.routed)
-        adm, quiet, done = drive(submit, co.step, admitted, sched)
-        out = lat_stats(done)
-        out.update({
-            "decode_step_p99_during_admission_ms": pct(adm, 0.99),
-            "decode_step_p50_during_admission_ms": pct(adm, 0.5),
-            "decode_step_p99_quiet_ms": pct(quiet, 0.99),
-            "admission_ticks": len(adm),
-            "handoff_gbps_knob": round(gbps, 3),
-            "routed": {k: co.routed[k] - warm_routed[k]
-                       for k in co.routed},
-            "handoffs_shipped": co.handoffs_shipped,
-            "handoff_pages": co.handoff_pages,
-            "handoff_ms_per_request": round(
-                1000.0 * co.handoff_wall_s
-                / max(co.handoffs_shipped, 1), 4),
-            "colocated_fallbacks": co.colocated_fallbacks,
-            "decode_prefill_calls": de.prefill_calls,
-            "prefill_tokens_avoided": de.prefill_tokens_avoided})
-        pe.cache.audit()
-        de.cache.audit()
-        return out
-
     unified = run_unified()
-    disagg = run_disagg()
+    disagg = _ab_run_disagg(cfg, params, mk_cache, host_pages, batch,
+                            long_lens, short_lens, drive, warm_sched,
+                            sched, detail=True,
+                            registry=default_registry(),
+                            ring=default_ring())
     u99 = unified["decode_step_p99_during_admission_ms"]
     d99 = disagg["decode_step_p99_during_admission_ms"]
     return {
@@ -1347,6 +1385,178 @@ def _disagg_line() -> dict:
                     "win — the decode-step latency during admission "
                     "waves is the honest per-device measurable "
                     "(on-chip capture: ROADMAP item 5)",
+        },
+    }
+
+
+def _serving_mixed_line() -> dict:
+    """MIXED prefill+decode A/B (PR-11 tentpole, Sarathi-style
+    token-budget piggybacking): the same offered load — waves of long
+    prompts arriving while a resident batch decodes — runs through
+    (a) a UNIFIED engine with sequential packed admission (every wave
+    is a stall: the admission tick's step carries the whole packed
+    prefill), (b) the same engine with ``mixed=True`` (prefill tokens
+    ride inside the decode dispatches, ``mixed_token_budget`` per
+    tick — no second engine, no stall), and (c) the 1P+1D
+    ``DisaggCoordinator`` (the architecture that deletes the stall by
+    paying for a second engine).  Reports decode-step p99 DURING the
+    admission phase (the stall this lane deletes), TTFT/TPOT p50/p99
+    and the mixed lane's budget utilization.  ``value`` is the
+    unified/mixed ratio of admission-phase decode-step p99 (>1 =
+    mixed deleted stall without a second engine)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_ring
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, page, new = 8, 64, 48
+        num_pages, pages_max, host_pages = 160, 8, 96
+        long_lens, short_lens = (192, 256, 320, 448), (16, 32)
+        waves, per_wave, wave_gap = 4, 6, 6
+        budget = 2 * page
+        metric = "serving_mixed_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, page, new = 4, 16, 12
+        num_pages, pages_max, host_pages = 96, 8, 64
+        long_lens, short_lens = (48, 64, 80, 100), (3, 6)
+        waves, per_wave, wave_gap = 4, 4, 4
+        budget = page
+        metric = "serving_mixed_tiny_cpu_smoke_ab"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+    def make_sched(r):
+        out = []
+        for w in range(waves):
+            ps = [r.randint(1, cfg.vocab_size,
+                            (long_lens[(w * per_wave + j)
+                                       % len(long_lens)],))
+                  for j in range(per_wave - 1)]
+            ps.append(r.randint(1, cfg.vocab_size,
+                                (short_lens[w % len(short_lens)],)))
+            out.append(ps)
+        return out
+
+    sched = make_sched(np.random.RandomState(0))
+    # warmup twin: same length mix / wave structure, different tokens
+    # — the timed window never pays a first-shape compile
+    warm_sched = make_sched(np.random.RandomState(1))
+
+    def mk_cache(hp=0):
+        return PagedKVCache(cfg, num_pages=num_pages,
+                            pages_max=pages_max, batch=batch,
+                            page=page, host_pages=hp)
+
+    pct, lat_stats = _ab_pct, _ab_lat_stats
+
+    def drive(submit, step, admitted_this_tick, schedule):
+        # stagger=3: generation lengths vary per request so the
+        # resident batch drains gradually (the arrival-into-a-busy-
+        # batch pattern the mixed lane exists for)
+        return _ab_drive(submit, step, admitted_this_tick, schedule,
+                         wave_gap, new, stagger=3)
+
+    def run_engine(mixed):
+        # BOTH arms carry identical instrumentation (the shared
+        # default registry), so the u99/m99 headline compares equal
+        # per-tick observation cost
+        eng = ContinuousBatchingEngine(
+            cfg, params, mk_cache(),
+            metrics_registry=default_registry(),
+            metrics_ring=default_ring(),
+            mixed=mixed, mixed_token_budget=budget if mixed else 0)
+        last = {"pf": eng.prefill_calls, "mx": eng.mixed_prefill_tokens}
+
+        def admitted():
+            # admission-phase tick: a sequential wave ran, or the
+            # mixed dispatch piggybacked fresh prefill tokens
+            hit = (eng.prefill_calls > last["pf"]
+                   or eng.mixed_prefill_tokens > last["mx"])
+            last["pf"] = eng.prefill_calls
+            last["mx"] = eng.mixed_prefill_tokens
+            return hit
+
+        submit = lambda p, n: eng.submit(p, max_new_tokens=n)  # noqa: E731
+        drive(submit, eng.step, admitted, warm_sched)   # compiles
+        t_mark = (eng.mixed_ticks, eng.mixed_prefill_tokens,
+                  eng.mixed_degraded)
+        adm, quiet, done = drive(submit, eng.step, admitted, sched)
+        out = lat_stats(done)
+        out.update({"decode_step_p99_during_admission_ms":
+                    pct(adm, 0.99),
+                    "decode_step_p50_during_admission_ms":
+                    pct(adm, 0.5),
+                    "decode_step_p99_quiet_ms": pct(quiet, 0.99),
+                    "admission_ticks": len(adm)})
+        if mixed:
+            ticks = eng.mixed_ticks - t_mark[0]
+            piggy = eng.mixed_prefill_tokens - t_mark[1]
+            out.update({
+                "mixed_ticks": ticks,
+                "piggybacked_prefill_tokens": piggy,
+                "mixed_token_budget": eng.mixed_token_budget,
+                "budget_utilization": round(
+                    piggy / max(ticks * eng.mixed_token_budget, 1),
+                    4),
+                "mixed_degraded_waves":
+                    eng.mixed_degraded - t_mark[2],
+                "prefill_calls": eng.prefill_calls})
+        eng.cache.audit()
+        return out
+
+    unified = run_engine(mixed=False)
+    mixed = run_engine(mixed=True)
+    disagg = _ab_run_disagg(cfg, params, mk_cache, host_pages, batch,
+                            long_lens, short_lens, drive, warm_sched,
+                            sched)
+    u99 = unified["decode_step_p99_during_admission_ms"]
+    m99 = mixed["decode_step_p99_during_admission_ms"]
+    d99 = disagg["decode_step_p99_during_admission_ms"]
+    return {
+        "metric": metric,
+        "value": round(u99 / max(m99, 1e-9), 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {
+            "platform": platform, "batch_slots": batch,
+            "requests": sum(len(w) for w in sched),
+            "waves": waves, "wave_gap_ticks": wave_gap,
+            "unified_sequential": unified,
+            "mixed": mixed,
+            "disagg_1p1d": disagg,
+            "mixed_deletes_admission_stall": bool(u99 > m99),
+            "mixed_vs_disagg_stall_ratio": round(
+                d99 / max(m99, 1e-9), 4),
+            "note": "mixed deletes the colocated admission stall "
+                    "WITHOUT a second engine: compare value (>1) "
+                    "against serving_disagg_ab's unified/disagg "
+                    "ratio at the same offered load.  CPU smoke "
+                    "walls include queued host work; the admission-"
+                    "phase decode-step p99 is the honest per-device "
+                    "measurable (on-chip capture: ROADMAP item 5)",
         },
     }
 
@@ -1539,6 +1749,14 @@ def _snapshot_line() -> dict:
                           "paddle_tpu_fleet_replica_deaths_total"),
                       "fleet_replica_replaces_total": _cval(
                           "paddle_tpu_fleet_replica_replaces_total"),
+                      # mixed prefill+decode lane (the
+                      # serving_mixed_ab line's engine publishes
+                      # process-wide)
+                      "mixed_ticks_total": _cval(
+                          "paddle_tpu_engine_mixed_ticks_total"),
+                      "mixed_piggybacked_prefill_tokens_total": _cval(
+                          "paddle_tpu_engine_mixed_piggybacked_"
+                          "prefill_tokens_total"),
                       # disaggregated prefill/decode (the
                       # serving_disagg_ab line's coordinator
                       # publishes process-wide)
@@ -1570,6 +1788,7 @@ def main() -> None:
         ("serving_fault_recovery", "ratio", _fault_recovery_line),
         ("serving_fleet_ab", "x", _fleet_line),
         ("serving_disagg_ab", "x", _disagg_line),
+        ("serving_mixed_ab", "x", _serving_mixed_line),
     ]
 
     devs, err = _init_devices()

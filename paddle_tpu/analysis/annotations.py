@@ -84,6 +84,17 @@ SYNC_HOT_ROOTS: List[str] = [
     "paged_decode._prefill_packed_tp",
     "paged_decode._prefill_chunk_batched_tp",
     "paged_decode._make_q8_allreduce",
+    # the mixed prefill+decode lane (PR 11, ISSUE 12): carving parks chunk state
+    # with ZERO dispatches, and the mixed tick is one fused program —
+    # a blocking sync in either would stall the decode cadence the
+    # lane exists to protect (the sync lane's one fetch per tick and
+    # the drain seam carry the only sanctioned drains)
+    "ContinuousBatchingEngine._mixed_carve",
+    "ContinuousBatchingEngine._mixed_plan",
+    "ContinuousBatchingEngine._decode_mixed",
+    "paged_decode.make_mixed_step",
+    "paged_decode._packed_prefill_body",
+    "paged_decode._packed_prefill_body_tp",
 ]
 
 # Calls whose RESULT lives on the device: the taint seeds for the
@@ -97,7 +108,7 @@ DEVICE_PRODUCER_NAMES: FrozenSet[str] = frozenset({
     "_last_logits",
 })
 DEVICE_PRODUCER_ATTRS: FrozenSet[str] = frozenset({
-    "_step", "_step_async", "_dstep", "_verify",
+    "_step", "_step_async", "_step_mixed", "_dstep", "_verify",
 })
 
 # The engine's DESIGNATED blocking drain: every hot-path call to it is
@@ -125,6 +136,13 @@ EXTRA_TRACED: List[str] = [
     "paged_decode._prefill_packed_tp",
     "paged_decode._prefill_chunk_batched_tp",
     "paged_decode._make_q8_allreduce",
+    # PR-11 mixed lane: the packed-prefill bodies are unjitted
+    # factories (jitted at a distance by _prefill_packed[_tp] and
+    # composed into make_mixed_step's outer jit), and the mixed step
+    # itself stages its fn/fn_fp closures
+    "paged_decode._packed_prefill_body",
+    "paged_decode._packed_prefill_body_tp",
+    "paged_decode.make_mixed_step",
 ]
 
 
@@ -174,6 +192,10 @@ FLUSH_SAFE: Dict[str, str] = {
         "delegates to the base admission path, which runs behind "
         "_step_inner's flush (the override only reclaims dead "
         "handoff blobs on failure)",
+    "ContinuousBatchingEngine._admit_sequential":
+        "lane choice only: both call sites (_admit_wave's sequential "
+        "path and _mixed_carve's shape-forced degrades) flush the "
+        "pipeline before handing it the popped wave",
 }
 
 
@@ -380,9 +402,13 @@ CLAIMS: Dict[str, ClaimSpec] = {
         releases=frozenset({"release_row"}),
         value_bearing=False,
         leak="slot pages off the free list forever (admission "
-             "faults, PR 5's stranded-slot class)",
+             "faults, PR 5's stranded-slot class; partially-prefilled "
+             "mixed rows parked in _mixed_pref)",
         note="swap_in_row acquires row pages AND releases the swap "
-             "record it consumes"),
+             "record it consumes; the mixed lane's carve transfers "
+             "its claim into _mixed_pref, whose rows the sweep/"
+             "quarantine/restart paths release (audit-pinned by "
+             "test_serving_mixed)"),
     # host-tier swap record: parked preempted rows + adopted handoff
     # blobs.  The handle MUST land in an audited registry
     # (_swap_handles) or be discarded — a dropped handle pins host
@@ -493,7 +519,8 @@ THREAD_SAFETY: Dict[str, Tuple[str, str]] = {
     "has_work": ("engine-thread-only",
                  "reads _queue/_active without synchronization"),
     "queued_tokens": ("any-thread",
-                      "sums an atomic tuple() snapshot of _queue, so "
+                      "sums atomic tuple() snapshots of _queue and "
+                      "the mixed lane's parked-row map, so "
                       "scrape-thread gauges read it lock-free (at "
                       "most one admission stale); exact behind the "
                       "serving front's _lock"),

@@ -691,7 +691,8 @@ class GenerationServer:
                  "requests_faulted": eng.requests_faulted,
                  "step_faults": eng.step_faults,
                  "queued_tokens": eng.queued_tokens(),
-                 "active": len(eng._active),
+                 "active": len(eng._active)
+                 + len(getattr(eng, "_mixed_pref", ())),
                  "queued": len(eng._queue),
                  "free_pages": eng.cache.free_pages(),
                  "decode_steps": eng.decode_steps,
@@ -703,6 +704,14 @@ class GenerationServer:
                  "swap_in_pages": eng.cache.swap_in_pages,
                  "prefill_tokens_avoided":
                      getattr(eng, "prefill_tokens_avoided", 0),
+                 "mixed_ticks": getattr(eng, "mixed_ticks", 0),
+                 "mixed_prefill_tokens":
+                     getattr(eng, "mixed_prefill_tokens", 0),
+                 "mixed_budget_utilization": round(
+                     getattr(eng, "mixed_prefill_tokens", 0)
+                     / max(getattr(eng, "mixed_ticks", 0)
+                           * getattr(eng, "mixed_token_budget", 0),
+                           1), 4),
                  "requests_finished": eng.requests_finished}
             if hasattr(eng, "spec_rounds"):
                 h["spec_rounds"] = eng.spec_rounds
@@ -715,11 +724,13 @@ class GenerationServer:
         return None, (
             live, ready, self._fatal, self.restarts,
             self.registry, eng.step_faults,
-            eng.gamma if hasattr(eng, "spec_rounds") else None)
+            eng.gamma if hasattr(eng, "spec_rounds") else None,
+            getattr(eng, "mixed_token_budget", 0))
 
     @staticmethod
     def _health_from_registry(live, ready, fatal, restarts, registry,
-                              step_faults, gamma) -> dict:
+                              step_faults, gamma,
+                              mixed_budget=0) -> dict:
         # /health is a VIEW over the metrics registry (single source
         # of truth is the instrumentation, not ad-hoc attribute
         # reads); snapshot() outside the lock — set-value metrics are
@@ -774,6 +785,18 @@ class GenerationServer:
              "prefill_tokens_avoided": int(v(
                  snap,
                  "paddle_tpu_engine_prefill_tokens_avoided_total")),
+             "mixed_ticks": int(v(
+                 snap, "paddle_tpu_engine_mixed_ticks_total")),
+             "mixed_prefill_tokens": int(v(
+                 snap,
+                 "paddle_tpu_engine_mixed_piggybacked_prefill_"
+                 "tokens_total")),
+             "mixed_budget_utilization": round(
+                 v(snap,
+                   "paddle_tpu_engine_mixed_piggybacked_prefill_"
+                   "tokens_total")
+                 / max(v(snap, "paddle_tpu_engine_mixed_ticks_total")
+                       * mixed_budget, 1), 4),
              "requests_finished": int(v(
                  snap,
                  "paddle_tpu_engine_requests_finished_total"))}

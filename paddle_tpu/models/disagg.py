@@ -112,7 +112,14 @@ def handoff_wins(prompt_len: int, decode_engine, gbps: float,
     stall is cheaper than moving their pages, so they stay colocated.
     Chip-rate and parameter-count defaults are the SAME helpers the
     preemption cost model uses (serving_engine) — the two models can
-    never disagree about the hardware."""
+    never disagree about the hardware.
+
+    A MIXED-CAPABLE colocated lane (``decode_engine`` built with
+    ``mixed=True``, serving_engine's token-budget piggybacking) pays
+    NO admission stall — its prefill tokens ride inside the decode
+    dispatches — so there is no stall for disaggregation to delete
+    and the handoff DMA is pure cost: every request colocates
+    (``handoff_flip_gbps`` reads ``inf``)."""
     return gbps > handoff_flip_gbps(prompt_len, decode_engine,
                                     chip_flops)
 
@@ -130,6 +137,12 @@ def handoff_flip_gbps(prompt_len: int, decode_engine,
         # a zero-length context has no prefill stall to avoid: no
         # finite link speed makes disaggregation win (readiness
         # probes ask with prompt_len=0)
+        return float("inf")
+    if getattr(decode_engine, "_mixed", False):
+        # a mixed-capable lane admits WITHOUT stalling decode
+        # (token-budget piggybacking): the stall term of the
+        # inequality is zero, so no finite link speed makes the
+        # handoff DMA worth paying
         return float("inf")
     cache = decode_engine.cache
     npg = (int(prompt_len) + cache.page - 1) // cache.page
@@ -169,6 +182,12 @@ class PrefillEngine(ContinuousBatchingEngine):
                 "PrefillEngine has no decode loop to overlap "
                 "(overlap=True applies to the DecodeEngine of a "
                 "disaggregated pair)")
+        if kw.get("mixed"):
+            raise ValueError(
+                "PrefillEngine has no decode rows to piggyback on "
+                "(mixed=True deletes the stall a COLOCATED engine "
+                "pays; a disaggregated prefill engine has no stall "
+                "to delete — see handoff_wins)")
         super().__init__(*args, **kw)
         self.max_inflight_handoffs = int(max_inflight_handoffs)
         self._handoff_ready: List[HandoffRecord] = []
@@ -275,6 +294,15 @@ class DecodeEngine(ContinuousBatchingEngine):
     records park there until their restore."""
 
     def __init__(self, *args, **kw):
+        if kw.get("mixed"):
+            raise ValueError(
+                "mixed=True on a DecodeEngine is unsupported: its "
+                "admission overrides (_handoff_first single-emission, "
+                "adopted-blob bookkeeping) do not compose with the "
+                "mixed lane's in-program first-token sampling.  Run "
+                "the UNIFIED engine with mixed=True instead — the "
+                "cost model (handoff_wins) then keeps traffic "
+                "colocated, which is the point")
         super().__init__(*args, **kw)
         if self.cache.host is None:
             raise ValueError(

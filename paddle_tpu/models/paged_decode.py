@@ -38,8 +38,8 @@ from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn, _mm,
                              _rms_norm)
 
 __all__ = ["PagedKVCache", "make_paged_decode_step",
-           "make_paged_decode_step_async", "generate_paged",
-           "generate_auto"]
+           "make_paged_decode_step_async", "make_mixed_step",
+           "generate_paged", "generate_auto"]
 
 
 class PagedKVCache:
@@ -1147,6 +1147,20 @@ def make_paged_decode_step(cfg: LlamaPretrainConfig,
 _step_async_cache: dict = {}
 
 
+def _advance_loop_state(nxt, tok, lens, active, remaining, eos):
+    """The ON-DEVICE serving-loop state advance (traced into the
+    async and mixed step programs — ONE definition, or the two
+    lanes' done/eos semantics could silently fork): inactive rows
+    keep their token, lens/remaining move only under ``active``, and
+    ``done`` marks rows that just hit eos or exhausted their
+    budget."""
+    nxt = jnp.where(active, nxt, tok)
+    lens2 = lens + active.astype(lens.dtype)
+    rem2 = remaining - active.astype(remaining.dtype)
+    done = active & ((nxt == eos) | (rem2 <= 0))
+    return nxt, lens2, rem2, active & ~done, done
+
+
 def make_paged_decode_step_async(cfg: LlamaPretrainConfig,
                                  temperature: float = 0.0,
                                  kv_quant: Optional[str] = None,
@@ -1197,12 +1211,7 @@ def make_paged_decode_step_async(cfg: LlamaPretrainConfig,
                                         top_k, top_p)
         base = step_q8 if q8 else step
 
-    def advance(nxt, tok, lens, active, remaining, eos):
-        nxt = jnp.where(active, nxt, tok)
-        lens2 = lens + active.astype(lens.dtype)
-        rem2 = remaining - active.astype(remaining.dtype)
-        done = active & ((nxt == eos) | (rem2 <= 0))
-        return nxt, lens2, rem2, active & ~done, done
+    advance = _advance_loop_state
 
     if q8:
         def fn(params, kpool, vpool, kscale, vscale, tables, lens,
@@ -1817,6 +1826,24 @@ def _prefill_packed(cfg: LlamaPretrainConfig, q8: bool,
     hit = _packed_prefill_cache.get((_cfg_key(cfg), q8, with_hist))
     if hit is not None:
         return hit
+    run = jax.jit(_packed_prefill_body(cfg, q8, with_hist))
+    _packed_prefill_cache[(_cfg_key(cfg), q8, with_hist)] = run
+    return run
+
+
+_packed_body_cache: dict = {}
+
+
+def _packed_prefill_body(cfg: LlamaPretrainConfig, q8: bool,
+                         with_hist: bool):
+    """Memoised UNJITTED packed-varlen prefill body — the stream math
+    of :func:`_prefill_packed` (which jits it directly) factored out
+    so :func:`make_mixed_step` can compose it with the decode-step
+    body, the page scatter and the first-token tail inside ONE outer
+    jit: a mixed prefill+decode tick stays a single dispatch."""
+    hit = _packed_body_cache.get((_cfg_key(cfg), q8, with_hist))
+    if hit is not None:
+        return hit
     from .decode import _grouped_attn
     from ..ops.pallas.flash_attention import _interpret, _pick_blocks
     from ..ops.pallas.flash_varlen import flash_attention_segmented
@@ -1825,7 +1852,6 @@ def _prefill_packed(cfg: LlamaPretrainConfig, q8: bool,
     nkv = cfg.num_key_value_heads
     dt = cfg.dtype
 
-    @jax.jit
     def run(params, toks, seg, pos, kpool, vpool, kscale, vscale,
             hist_page, hist_slot, pool_hist, stream_src, stream_hist):
         B, T = toks.shape                      # B == 1
@@ -1882,7 +1908,7 @@ def _prefill_packed(cfg: LlamaPretrainConfig, q8: bool,
         x, (ks, vs) = jax.lax.scan(layer, x, xs)
         return x, ks, vs
 
-    _packed_prefill_cache[(_cfg_key(cfg), q8, with_hist)] = run
+    _packed_body_cache[(_cfg_key(cfg), q8, with_hist)] = run
     return run
 
 
@@ -1907,6 +1933,25 @@ def _prefill_packed_tp(cfg: LlamaPretrainConfig, mesh, q8: bool,
     scatters then stay local to each shard."""
     ckey = (_cfg_key(cfg), mesh, q8, with_hist)
     hit = _packed_tp_cache.get(ckey)
+    if hit is not None:
+        return hit
+    run = jax.jit(_packed_prefill_body_tp(cfg, mesh, q8, with_hist))
+    _packed_tp_cache[ckey] = run
+    return run
+
+
+_packed_body_tp_cache: dict = {}
+
+
+def _packed_prefill_body_tp(cfg: LlamaPretrainConfig, mesh, q8: bool,
+                            with_hist: bool):
+    """Memoised UNJITTED (but shard_map'd) TP packed-prefill body —
+    :func:`_prefill_packed_tp` jits it directly; the TP form of
+    :func:`make_mixed_step` composes it with the sharded decode step
+    inside one outer jit so a mixed tick stays one dispatch on the
+    mesh."""
+    ckey = (_cfg_key(cfg), mesh, q8, with_hist)
+    hit = _packed_body_tp_cache.get(ckey)
     if hit is not None:
         return hit
     from jax.sharding import PartitionSpec as P
@@ -1985,15 +2030,15 @@ def _prefill_packed_tp(cfg: LlamaPretrainConfig, mesh, q8: bool,
 
     pool_spec = P(None, None, "mp", None, None)
     scale_spec = P(None, None, "mp", None) if q8 else P()
-    run = jax.jit(shard_map(
+    run = shard_map(
         run_local, mesh=mesh,
         in_specs=(param_specs(cfg, pp=1), P(), P(), P(), pool_spec,
                   pool_spec, scale_spec, scale_spec, P(), P(), P(),
                   P(), P()),
         out_specs=(P(), P(None, None, "mp", None),
                    P(None, None, "mp", None)),
-        check_vma=False))
-    _packed_tp_cache[ckey] = run
+        check_vma=False)
+    _packed_body_tp_cache[ckey] = run
     return run
 
 
@@ -2150,6 +2195,171 @@ def _prefill_chunk_batched_tp(cfg: LlamaPretrainConfig, mesh):
         check_vma=False))
     _chunk_b_tp_cache[ckey] = run
     return run
+
+
+_mixed_step_cache: dict = {}
+
+
+def make_mixed_step(cfg: LlamaPretrainConfig,
+                    temperature: float = 0.0,
+                    kv_quant: Optional[str] = None,
+                    top_k: int = 0, top_p: float = 1.0,
+                    mesh=None, tp_allreduce: str = "fp32",
+                    with_hist: bool = True):
+    """ONE jitted program per MIXED serving tick (Sarathi-style
+    chunked-prefill piggybacking, the scheduler-level form of the
+    T3/FLUX fuse-the-phases idea): advance every active decode row
+    exactly like :func:`make_paged_decode_step_async` AND consume a
+    budget of packed varlen prefill-stream tokens in the SAME
+    dispatch — a colocated engine never stops decoding to admit.
+
+    The dispatch packs decode rows as length-1 paged-attention
+    segments alongside the prefill stream: the prefill half is the
+    packed-varlen body (:func:`_packed_prefill_body` — segmented
+    flash kernel on TPU, XLA segment mask on CPU, bitwise parity with
+    the sequential packed lane) with prefix-history gathers for
+    resumed chunks; its per-segment page scatters (int8
+    quantize-on-write included) and the first-token sampling tail run
+    INSIDE the program, so the host never syncs for admission.
+    Completing segments ACTIVATE on-device: the returned loop state
+    carries them into the next chained dispatch with no pipeline
+    flush, and the host learns their sampled first token at the
+    ordinary one-step-behind drain (``ftok``).
+
+    ``fn(params, kpool, vpool, [kscale, vscale,] tables, lens, tok,
+    active, remaining, eos, key,
+    p_toks [1,T], p_seg [1,T], p_pos [1,T],
+    hist_page [T], hist_slot [T], pool_hist [T],
+    dest_page [T], dest_slot [T],
+    sample_idx [B], activate [B], p_first [B], p_sample [B],
+    p_len [B], p_rem [B])
+    -> (kpool, vpool, [kscale, vscale,] nxt, lens', remaining',
+    active', done, ftok)``
+
+    * decode half: identical math/advance to the async step; inactive
+      rows' junk writes are steered to reserved page 0 via a masked
+      tables view, so mid-prefill rows' freshly-written pages can
+      never be clobbered by an idle decode lane;
+    * prefill half: ``dest_page``/``dest_slot`` route each fresh
+      stream token's K/V into its row's pages (history + padding
+      slots scatter to page 0); same-wave stream sharing is never
+      needed — the scheduler registers prefix pages only after their
+      chunk's dispatch, so sharers always gather from the pool one
+      dispatch behind;
+    * first tokens: ``sample_idx`` gathers each completing segment's
+      last real hidden state through the shared logits tail;
+      ``p_sample`` rows take the sampled token, resume rows take
+      ``p_first`` (their saved next input).  ``activate`` rows enter
+      the chained state with ``lens = p_len``, ``remaining = p_rem``.
+
+    With ``mesh`` (mp>1) both halves compose through the existing
+    shard_map seams (:func:`_build_tp_inner`,
+    :func:`_packed_prefill_body_tp`) inside the same outer jit — one
+    dispatch per tick on the mesh, scatters and history gathers stay
+    shard-local on the kv-head axis.
+    """
+    q8 = kv_quant == "int8"
+    mesh_key = mesh if (mesh is not None
+                        and mesh.shape.get("mp", 1) > 1) else None
+    ckey = (_cfg_key(cfg), temperature, kv_quant, top_k, top_p,
+            mesh_key, tp_allreduce if mesh_key is not None else "fp32",
+            with_hist)
+    hit = _mixed_step_cache.get(ckey)
+    if hit is not None:
+        return hit
+
+    from ..ops.pallas.paged_attention import quantize_kv_token
+    dt = cfg.dtype
+    if mesh_key is not None:
+        dec_base = _build_tp_inner(cfg, mesh, temperature, kv_quant,
+                                   top_k, top_p,
+                                   tp_allreduce=tp_allreduce)
+        pre_body = _packed_prefill_body_tp(cfg, mesh, q8, with_hist)
+    else:
+        step, step_q8 = _build_step_fns(cfg, temperature, False,
+                                        top_k, top_p)
+        dec_base = step_q8 if q8 else step
+        pre_body = _packed_prefill_body(cfg, q8, with_hist)
+
+    advance = _advance_loop_state   # the async lane's exact advance
+
+    def scatter(kpool, vpool, kscale, vscale, ks, vs, dest_page,
+                dest_slot):
+        # per-token page scatter of the stream K/V (fresh chunk slots
+        # land in their row's pages; history/padding slots land on
+        # junk page 0 — DMA-valid, never read below lens)
+        if q8:
+            ks, ksc = quantize_kv_token(ks)
+            vs, vsc = quantize_kv_token(vs)
+        kpool = kpool.at[:, dest_page, :, dest_slot, :].set(
+            jnp.transpose(ks, (1, 0, 2, 3)).astype(kpool.dtype))
+        vpool = vpool.at[:, dest_page, :, dest_slot, :].set(
+            jnp.transpose(vs, (1, 0, 2, 3)).astype(vpool.dtype))
+        if q8:
+            kscale = kscale.at[:, dest_page, :, dest_slot].set(
+                jnp.transpose(ksc, (1, 0, 2)))
+            vscale = vscale.at[:, dest_page, :, dest_slot].set(
+                jnp.transpose(vsc, (1, 0, 2)))
+        return kpool, vpool, kscale, vscale
+
+    def fn(params, kpool, vpool, kscale, vscale, tables, lens, tok,
+           active, remaining, eos, key, p_toks, p_seg, p_pos,
+           hist_page, hist_slot, pool_hist, dest_page, dest_slot,
+           sample_idx, activate, p_first, p_sample, p_len, p_rem):
+        T = p_toks.shape[1]
+        k_dec, k_smp = jax.random.split(key)
+        if q8:
+            ks_in, vs_in = kscale, vscale
+        else:
+            ks_in = vs_in = jnp.zeros((1,), jnp.float32)
+        x, ks, vs = pre_body(
+            params, p_toks, p_seg, p_pos, kpool, vpool, ks_in, vs_in,
+            hist_page, hist_slot, pool_hist,
+            jnp.zeros((T,), jnp.int32), jnp.zeros((T,), bool))
+        # first-token sampling: each completing segment's LAST real
+        # position through the shared logits tail (the same eager
+        # tail the sequential lanes use, so greedy outputs match)
+        h = _rms_norm(x[0, sample_idx], params["final_norm"],
+                      cfg.rms_norm_eps)
+        logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
+        sampled = _pick_token(logits, temperature, k_smp, top_k,
+                              top_p)
+        kpool, vpool, kscale, vscale = scatter(
+            kpool, vpool, kscale, vscale, ks, vs, dest_page,
+            dest_slot)
+        # decode half: inactive rows (mid-prefill rows included) see a
+        # zeroed table row, so their dead writes land on page 0
+        tables_d = jnp.where(active[:, None], tables, 0)
+        if q8:
+            kpool, vpool, kscale, vscale, nxt = dec_base(
+                params, kpool, vpool, kscale, vscale, tables_d, lens,
+                tok, k_dec)
+        else:
+            kpool, vpool, nxt = dec_base(params, kpool, vpool,
+                                         tables_d, lens, tok, k_dec)
+        nxt, lens2, rem2, act2, done = advance(nxt, tok, lens, active,
+                                               remaining, eos)
+        ftok = jnp.where(p_sample, sampled.astype(p_first.dtype),
+                         p_first)
+        nxt = jnp.where(activate, ftok.astype(nxt.dtype), nxt)
+        lens2 = jnp.where(activate, p_len.astype(lens2.dtype), lens2)
+        rem2 = jnp.where(activate, p_rem.astype(rem2.dtype), rem2)
+        act2 = act2 | activate
+        if q8:
+            return (kpool, vpool, kscale, vscale, nxt, lens2, rem2,
+                    act2, done, ftok)
+        return kpool, vpool, nxt, lens2, rem2, act2, done, ftok
+
+    if q8:
+        jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+    else:
+        def fn_fp(params, kpool, vpool, tables, lens, tok, active,
+                  remaining, eos, key, *rest):
+            return fn(params, kpool, vpool, None, None, tables, lens,
+                      tok, active, remaining, eos, key, *rest)
+        jitted = jax.jit(fn_fp, donate_argnums=(1, 2))
+    _mixed_step_cache[ckey] = jitted
+    return jitted
 
 
 def generate_paged(cfg: LlamaPretrainConfig, params, prompt,

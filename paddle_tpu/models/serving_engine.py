@@ -46,7 +46,8 @@ from ..testing import faults
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            _prefill_packed, _prefill_packed_tp,
-                           _pick_token, make_paged_decode_step,
+                           _pick_token, make_mixed_step,
+                           make_paged_decode_step,
                            make_paged_decode_step_async,
                            make_paged_decode_step_tp,
                            tp_collective_bytes_per_step)
@@ -187,7 +188,10 @@ class ContinuousBatchingEngine:
                  max_queued_tokens: Optional[int] = None,
                  quarantine_faults: bool = True,
                  max_consecutive_faults: int = 3,
-                 tp_allreduce: str = "fp32"):
+                 tp_allreduce: str = "fp32",
+                 mixed: bool = False,
+                 mixed_token_budget: int = 256,
+                 mixed_ctx_cap: Optional[int] = None):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -284,6 +288,35 @@ class ContinuousBatchingEngine:
             cfg, mesh.shape["mp"], tp_allreduce,
             cache.tables.shape[0]) if self._tp else 0
         self.tp_allreduce_bytes = 0
+        # -- MIXED prefill+decode steps (Sarathi-style chunked-prefill
+        # piggybacking): mixed=True fuses up to mixed_token_budget
+        # prefill-stream tokens into every decode dispatch, so a
+        # colocated engine never stops decoding to admit (the
+        # admission stall serving_disagg_ab measures is deleted
+        # without a second engine).  The budget is page-aligned;
+        # budget 0 (or an idle batch) degrades to the sequential
+        # admission lanes, as does any context longer than
+        # mixed_ctx_cap (the wave shape no longer fits the mixed
+        # stream; counted in mixed_degraded).
+        budget_pages = 0
+        if mixed and int(mixed_token_budget) > 0:
+            budget_pages = -(-int(mixed_token_budget) // page)
+        self.mixed_token_budget = budget_pages * page
+        self._mixed = bool(mixed) and budget_pages > 0
+        cap = (mixed_ctx_cap if mixed_ctx_cap is not None
+               else 4 * max(self.mixed_token_budget,
+                            self.prefill_bucket))
+        self.mixed_ctx_cap = max(int(cap) // page, 1) * page
+        self._mixed_pref: Dict[int, dict] = {}    # slot -> chunk state
+        self.mixed_ticks = 0              # dispatches that piggybacked
+        self.mixed_prefill_tokens = 0     # fresh tokens piggybacked
+        self.mixed_degraded = 0           # shape-forced sequential waves
+        self._step_mixed = None
+        if self._mixed:
+            self._step_mixed = make_mixed_step(
+                cfg, temperature, kv_quant=cache.kv_quant,
+                top_k=top_k, top_p=top_p, mesh=mesh,
+                tp_allreduce=tp_allreduce)
         # padding-waste accounting across ALL prefill lanes: dispatched
         # token slots vs slots that carried no real context token
         # (bucket/page padding) — bench.py's admission A/B reads these
@@ -506,26 +539,34 @@ class ContinuousBatchingEngine:
         shared lock (see ``analysis/annotations.py THREAD_SAFETY``
         and docs/FAULT_TOLERANCE.md)."""
         if any(r.rid == rid for r in self._queue) or \
-                any(r.rid == rid for r in self._active.values()):
+                any(r.rid == rid for r in self._active.values()) or \
+                any(e["req"].rid == rid
+                    for e in self._mixed_pref.values()):
             self._cancelled.add(rid)
             return True
         return False
 
     def queued_tokens(self) -> int:
-        """Context tokens waiting for (re-)admission — the prefill
-        work the queue represents (preempted requests count their
-        regenerated context too).
+        """Context tokens of PENDING prefill work: the admission
+        queue (preempted requests count their regenerated context
+        too) PLUS the not-yet-prefilled remainder of rows parked
+        mid-prefill in the mixed lane — they left the queue but their
+        prefill is still owed, so the ``max_queued_tokens``
+        backpressure bound must keep counting them.
 
-        Thread safety: ``any-thread`` — sums over an atomic
-        ``tuple()`` snapshot of the queue (one C-level copy under the
-        GIL), so metrics scrape threads read it lock-free; a racing
-        submit/step makes the answer at most one admission stale,
-        never a ``deque mutated during iteration`` error.  Exact when
-        serialized behind the serving front's ``_lock``, which is how
-        the backpressure path consults it (see
+        Thread safety: ``any-thread`` — sums over atomic ``tuple()``
+        snapshots of the queue and the parked-row map (one C-level
+        copy each under the GIL), so metrics scrape threads read it
+        lock-free; a racing submit/step makes the answer at most one
+        admission stale, never a ``mutated during iteration`` error.
+        Exact when serialized behind the serving front's ``_lock``,
+        which is how the backpressure path consults it (see
         ``analysis/annotations.py THREAD_SAFETY``)."""
-        return sum(len(r.prompt) + len(r.generated)
-                   for r in tuple(self._queue))
+        parked = getattr(self, "_mixed_pref", None)
+        owed = sum(len(e["ctx"]) - e["pos"]
+                   for e in tuple(parked.values())) if parked else 0
+        return owed + sum(len(r.prompt) + len(r.generated)
+                          for r in tuple(self._queue))
 
     def queue_capacity_reason(
             self, prompt_len: int = 0) -> Optional[str]:
@@ -584,7 +625,7 @@ class ContinuousBatchingEngine:
         return out
 
     def has_work(self) -> bool:
-        return bool(self._queue or self._active)
+        return bool(self._queue or self._active or self._mixed_pref)
 
     # -- engine side ------------------------------------------------------
     @staticmethod
@@ -1019,7 +1060,37 @@ class ContinuousBatchingEngine:
         host tier and a favourable cost model the victim's pages SWAP
         OUT (resume = restore, zero prefill); otherwise they release
         (recompute-style resumption).  Returns False when there is no
-        eligible victim (pool genuinely too small)."""
+        eligible victim (pool genuinely too small).
+
+        Mixed-lane rows parked mid-prefill are evicted FIRST
+        (carve-order LIFO): they are the youngest page-holders and
+        have produced nothing, and without this an over-eager carve
+        could leave an active row's growth with NO victim — the
+        sequential engine's equivalent admissions all sit in
+        ``_active`` and are preemptible, so the mixed lane must not
+        be less live.  A parked victim releases outright and requeues
+        at the head (its partial prefill recomputes at the next
+        carve); the pipeline is already drained when ``_preempt``
+        runs, so its half-written pages are safe to free."""
+        if self._mixed_pref:
+            slot = next(reversed(self._mixed_pref))
+            ent = self._mixed_pref.pop(slot)
+            req = ent["req"]
+            req.slot = None
+            req.preempted += 1
+            self.preemptions += 1
+            self._release_slot(slot)
+            self._free_slots.append(slot)
+            self._remaining[slot] = 0
+            self._active_mask[slot] = 0
+            self._queue.appendleft(req)
+            if self.metrics is not None:
+                self.metrics.preemptions.inc()
+                self.metrics.ring.emit(
+                    "preemption", rid=req.rid, slot=slot,
+                    mode="mixed-parked",
+                    generated=len(req.generated))
+            return True
         victims = [s for s in self._active if s != keep]
         if not victims:
             return False
@@ -1181,7 +1252,16 @@ class ContinuousBatchingEngine:
             status = _hit(req)
             if status is not None:
                 victims.append((slot, req, status))
-        if victims:
+        # mixed-lane rows mid-prefill hold a slot + pages but stream
+        # nothing yet: release through the same flush-then-free
+        # discipline (in-flight mixed dispatches still scatter into
+        # their pages)
+        mixed_victims = []
+        for slot, ent in list(self._mixed_pref.items()):
+            status = _hit(ent["req"])
+            if status is not None:
+                mixed_victims.append((slot, ent, status))
+        if victims or mixed_victims:
             if self.overlap:
                 self._pipeline_flush()
             for slot, req, status in victims:
@@ -1189,6 +1269,20 @@ class ContinuousBatchingEngine:
                 # (eos/budget landed on-device first) — honour that
                 if self._active.get(slot) is req:
                     self._retire_abnormal(slot, status)
+            for slot, ent, status in mixed_victims:
+                if self._mixed_pref.get(slot) is not ent:
+                    continue
+                del self._mixed_pref[slot]
+                try:
+                    self.cache.release_row(slot)
+                finally:
+                    # terminal message INSIDE the finally: even a
+                    # poisoned allocator must not strand the waiter
+                    # (same contract as _retire_abnormal)
+                    self._free_slots.append(slot)
+                    self._remaining[slot] = 0
+                    self._active_mask[slot] = 0
+                    self._finish_queued_abnormal(ent["req"], status)
         if self._cancelled:
             # purge consumed marks (and marks whose request finished
             # normally before the sweep saw them)
@@ -1304,6 +1398,22 @@ class ContinuousBatchingEngine:
                 req.t_finish = time.monotonic()
                 self._finished.append(req)
         self._admitting = []
+        # mixed-lane rows mid-prefill die with the wave: their parked
+        # chunk state cannot outlive the poisoned pipeline (the
+        # in-flight dispatches carrying their context dropped), so
+        # they fail loudly like the _admitting requests above; the
+        # stranded-slot sweep below reclaims their pages
+        for ent in self._mixed_pref.values():
+            req = ent["req"]
+            if req.done:
+                continue
+            try:
+                self._finish_queued_abnormal(req, "error", text)
+            except Exception:
+                req.done, req.status, req.error = True, "error", text
+                req.t_finish = time.monotonic()
+                self._finished.append(req)
+        self._mixed_pref.clear()
         # reclaim slots stranded mid-admission: popped from the free
         # list (rows possibly holding freshly-claimed pages) but never
         # committed to _active
@@ -1324,6 +1434,39 @@ class ContinuousBatchingEngine:
 
     def _step_inner(self) -> int:
         self._sweep_cancelled_expired()
+        if self._mixed and (self._active or self._mixed_pref):
+            # MIXED lane: decode never pauses for admission — waiting
+            # prompts park as chunk state and their tokens ride inside
+            # the decode dispatches below.  An IDLE mixed engine
+            # (nothing decoding, nothing parked) degrades to the
+            # sequential wave on purpose: there is no decode latency
+            # to protect, and one packed wave admits a cold batch
+            # faster than budget-sized ticks would.
+            self._mixed_carve()
+        else:
+            self._admit_wave()
+        if not self._active and not self._mixed_pref:
+            return 0
+        t0 = time.perf_counter()
+        if self._mixed_pref:
+            self._decode_mixed()
+        else:
+            self._decode_once()
+        dt = time.perf_counter() - t0
+        self.decode_wall_s += dt
+        if self.metrics is not None:
+            self.metrics.decode_seconds.observe(dt)
+            if self._tp:
+                # host-observed wall of the collective-bearing TP
+                # decode round (single-device engines never record it)
+                self.metrics.tp_collective_seconds.observe(dt)
+        return len(self._active)
+
+    def _admit_wave(self) -> None:
+        """The SEQUENTIAL admission path: pop everything that fits,
+        flush the pipeline (admission is a scheduler mutation) and
+        prefill it as one wave through the packed/batched/chunked
+        lanes."""
         admits, swap_ins = self._collect_admissions()
         while not admits and not swap_ins and not self._active \
                 and self._queue and self._degrade_one_swap():
@@ -1350,26 +1493,8 @@ class ContinuousBatchingEngine:
         all_resumes = bool(admits) and all(r.generated
                                            for r, _ in admits)
         t_adm = time.perf_counter() if admits else 0.0
-        if admits and self._packed:
-            # PACKED VARLEN lane: any length mix (prefix-cache
-            # suffixes, long prompts, resumes) is ONE dispatch per
-            # wave — prefill_chunk is moot here, the per-wave cost is
-            # bounded by the total waiting tokens, not per prompt
-            self._admit_packed(admits)
-        elif admits:
-            buckets: Dict[int, List] = {}
-            for req, ctx in admits:
-                L = len(ctx)
-                if self.enable_prefix_caching or (
-                        self.prefill_chunk is not None
-                        and L > self.prefill_chunk):
-                    self._admit_chunked(req, ctx)
-                    continue
-                Lp = ((L + self.prefill_bucket - 1) //
-                      self.prefill_bucket) * self.prefill_bucket
-                buckets.setdefault(Lp, []).append((req, ctx))
-            for group in buckets.values():
-                self._admit_batch(group)
+        if admits:
+            self._admit_sequential(admits)
         self._admitting = []          # every admit committed to _active
         if all_resumes:
             # an all-resume recompute wave: its admission wall IS the
@@ -1383,19 +1508,374 @@ class ContinuousBatchingEngine:
             if self.metrics is not None:
                 self.metrics.preempt_resume_seconds.observe(
                     dt / len(admits))
-        if not self._active:
-            return 0
-        t0 = time.perf_counter()
-        self._decode_once()
-        dt = time.perf_counter() - t0
-        self.decode_wall_s += dt
+
+    def _admit_sequential(self, admits: List) -> None:
+        """Lane choice for one popped admission wave — shared by the
+        sequential path and the mixed lane's shape-forced degrades
+        (both call it behind a flushed pipeline)."""
+        if self._packed:
+            # PACKED VARLEN lane: any length mix (prefix-cache
+            # suffixes, long prompts, resumes) is ONE dispatch per
+            # wave — prefill_chunk is moot here, the per-wave cost is
+            # bounded by the total waiting tokens, not per prompt
+            self._admit_packed(admits)
+            return
+        buckets: Dict[int, List] = {}
+        for req, ctx in admits:
+            L = len(ctx)
+            if self.enable_prefix_caching or (
+                    self.prefill_chunk is not None
+                    and L > self.prefill_chunk):
+                self._admit_chunked(req, ctx)
+                continue
+            Lp = ((L + self.prefill_bucket - 1) //
+                  self.prefill_bucket) * self.prefill_bucket
+            buckets.setdefault(Lp, []).append((req, ctx))
+        for group in buckets.values():
+            self._admit_batch(group)
+
+    # -- mixed prefill+decode lane (Sarathi-style piggybacking) ----------
+    def _mixed_carve(self) -> None:
+        """Admission for the MIXED lane: claim a slot + the full row's
+        pages for each waiting request that fits and park it as chunk
+        state in ``_mixed_pref`` — ZERO prefill dispatches here; the
+        context tokens ride inside subsequent mixed decode dispatches
+        (:meth:`_decode_mixed`), ``mixed_token_budget`` per tick.
+        Swapped-out resumes restore through the ordinary (flushing)
+        zero-prefill path; a context longer than ``mixed_ctx_cap``
+        no longer fits the mixed stream shape and degrades to ONE
+        sequential packed wave (counted in ``mixed_degraded``)."""
+        cache = self.cache
+        degrades: List = []
+        res_pages = 0
+        while self._queue:
+            if len(self._free_slots) <= len(degrades):
+                break                 # keep a slot per pending degrade
+            head = self._queue[0]
+            handle = self._swap_handles.get(head.rid)
+            if handle is not None:
+                need = cache.swap_pages_needed(handle)
+                if need + res_pages > cache.available_pages():
+                    break
+                if self.overlap:
+                    self._pipeline_flush()
+                req = self._queue.popleft()
+                self._admitting.append(req)
+                if not self._admit_swapped(req):
+                    # record dropped: requeue at the head for an
+                    # ordinary (mixed-carve) recompute admission
+                    self._queue.appendleft(req)
+                self._admitting = []
+                continue
+            ctx = self._ctx_of(head)
+            need = -(-len(ctx) // cache.page)
+            if need + res_pages > cache.available_pages():
+                break
+            if len(ctx) > self.mixed_ctx_cap:
+                degrades.append((self._queue.popleft(), ctx))
+                res_pages += need
+                continue
+            slot = self._free_slots.pop()
+            try:
+                if self.enable_prefix_caching:
+                    # analysis: ignore[claim-lifecycle] reason=mixed-lane transfer: the slot left _free_slots and parks in _mixed_pref, whose rows _quarantine/_sweep/restart reclaim via release_row (audit-clean, pinned by test_serving_mixed)
+                    start = cache.alloc_row_prefix(slot, ctx)
+                else:
+                    # analysis: ignore[claim-lifecycle] reason=mixed-lane transfer: the slot left _free_slots and parks in _mixed_pref, whose rows _quarantine/_sweep/restart reclaim via release_row (audit-clean, pinned by test_serving_mixed)
+                    cache.alloc_row(slot, len(ctx))
+                    start = 0
+            except RuntimeError:
+                # raced out of pages (eviction couldn't cover): the
+                # request stays queued for a later tick
+                self._free_slots.append(slot)
+                break
+            req = self._queue.popleft()
+            if req.generated:             # recompute-style resume
+                self.resumes_recompute += 1
+                if self.metrics is not None:
+                    self.metrics.preempt_resume_recompute.inc()
+            self._mixed_pref[slot] = {"req": req, "ctx": ctx,
+                                      "pos": start, "start": start}
+        if degrades:
+            self.mixed_degraded += len(degrades)
+            if self.overlap:
+                self._pipeline_flush()
+            self._admitting = [r for r, _ in degrades]
+            self._admit_sequential(degrades)
+            self._admitting = []
+
+    def _mixed_plan(self) -> List:
+        """Carve this tick's prefill budget across the parked chunk
+        states (FIFO by carve order): each gets up to the remaining
+        budget pages, bounded by the stream room left after its
+        history slots (a resumed chunk re-gathers its written context
+        into the stream).  Returns ``(slot, pos, take, npg)`` tuples;
+        page-aligned by construction.  Decode rows are never throttled
+        — the budget only bounds the piggybacked prefill."""
+        page = self.cache.page
+        budget_pg = self.mixed_token_budget // page
+        stream_pg = self.mixed_ctx_cap // page
+        plan: List = []
+        for slot, ent in self._mixed_pref.items():
+            if budget_pg <= 0 or stream_pg <= 0:
+                break
+            pos = ent["pos"]
+            rem = len(ent["ctx"]) - pos
+            hist_pg = pos // page
+            fit = stream_pg - hist_pg
+            if fit <= 0:
+                continue          # waits for a roomier tick
+            npg = min(-(-rem // page), budget_pg, fit)
+            if npg <= 0:
+                continue
+            take = min(rem, npg * page)
+            plan.append((slot, pos, take, npg))
+            budget_pg -= npg
+            stream_pg -= hist_pg + npg
+        return plan
+
+    def _decode_mixed(self) -> None:
+        """One MIXED tick: a single jitted dispatch advances every
+        active decode row AND consumes up to ``mixed_token_budget``
+        prefill tokens from the parked chunk states — the engine
+        never stops decoding to admit.  Completing segments sample
+        their first token INSIDE the program and activate on-device
+        (the overlap chain carries them into the next dispatch with
+        no flush); the host learns the sampled token at the ordinary
+        one-step-behind drain.  Zero new host syncs: the overlap lane
+        adds the first-token array to the existing single ``_fetch``
+        per drained step, the sync lane keeps its one fetch per
+        tick."""
+        cache = self.cache
+        page = cache.page
+        B = self.B
+        if self.overlap and self._needs_flush:
+            self._pipeline_flush()
+        if self._active:
+            self._ensure_or_preempt()
+            if self.overlap and self._needs_flush:  # a preemption landed
+                self._pipeline_flush()
+        plan = self._mixed_plan()
+        if not plan:
+            # the growth pass above preempted EVERY parked row (pool
+            # pressure empties _mixed_pref — a non-empty parked set
+            # always plans its first entry): nothing to piggyback, so
+            # run the plain decode tick instead of a fused dispatch
+            # over an all-padding stream
+            self._decode_once()
+            return
+        # stream assembly (the packed lane's layout: contiguous
+        # segments = [history slots][fresh chunk, page-padded])
+        T = sum((pos // page + npg) * page for _, pos, _, npg in plan)
+        Tb = self._packed_bucket(max(T, page))
+        nseg = len(plan)
+        toks = np.zeros((1, Tb), np.int64)
+        seg = np.full((1, Tb), nseg, np.int32)       # sentinel tail
+        posa = np.zeros((1, Tb), np.int32)
+        hist_page = np.zeros((Tb,), np.int32)
+        hist_slot = np.zeros((Tb,), np.int32)
+        pool_hist = np.zeros((Tb,), bool)
+        dest_page = np.zeros((Tb,), np.int32)
+        dest_slot = np.zeros((Tb,), np.int32)
+        sample_idx = np.zeros((B,), np.int32)
+        activate = np.zeros((B,), bool)
+        p_first = np.zeros((B,), np.int64)
+        p_sample = np.zeros((B,), bool)
+        p_len = np.zeros((B,), np.int32)
+        p_rem = np.zeros((B,), np.int64)
+        off = 0
+        fresh = 0
+        hist_total = 0
+        completing: List = []
+        for i, (slot, pos, take, npg) in enumerate(plan):
+            ent = self._mixed_pref[slot]
+            hist = pos
+            W = hist + npg * page
+            seg[0, off:off + W] = i
+            posa[0, off:off + W] = np.arange(W, dtype=np.int32)
+            toks[0, off + hist:off + hist + take] = \
+                ent["ctx"][pos:pos + take]
+            for j in range(hist // page):
+                a = off + j * page
+                hist_page[a:a + page] = int(cache.tables[slot, j])
+                hist_slot[a:a + page] = np.arange(page)
+                pool_hist[a:a + page] = True
+            for j in range(npg):
+                a = off + hist + j * page
+                dest_page[a:a + page] = int(
+                    cache.tables[slot, pos // page + j])
+                dest_slot[a:a + page] = np.arange(page)
+            fresh += take
+            hist_total += hist
+            if pos + take == len(ent["ctx"]):
+                req = ent["req"]
+                activate[slot] = True
+                p_len[slot] = len(ent["ctx"])
+                if req.generated:        # resume: saved next input
+                    p_first[slot] = req.generated[-1]
+                    p_rem[slot] = req.max_new_tokens - \
+                        len(req.generated)
+                else:                    # fresh: sample in-program
+                    p_sample[slot] = True
+                    sample_idx[slot] = off + hist + take - 1
+                    p_rem[slot] = req.max_new_tokens - 1
+                completing.append((slot, req))
+            off += W
+        q8 = cache.kv_quant == "int8"
+        if self.overlap:
+            d = self._seed_or_refresh_dev()
+            tables_in, lens_in, tok_in = (d["tables"], d["lens"],
+                                          d["tok"])
+            act_in, rem_in = d["active"], d["remaining"]
+        else:
+            tables_in = jnp.asarray(cache.tables.copy())
+            lens_in = jnp.asarray(cache.lens.copy())
+            tok_in = jnp.asarray(self._next_tok.copy())
+            act_in = jnp.asarray(self._active_mask.astype(bool))
+            rem_in = jnp.asarray(self._remaining.copy())
+        self._key, sub = jax.random.split(self._key)
+        faults.fire("step_dispatch")
+        args = (self.params, cache.kpool, cache.vpool)
+        if q8:
+            args += (cache.kscale, cache.vscale)
+        args += (tables_in, lens_in, tok_in, act_in, rem_in,
+                 self._eos_dev, sub, jnp.asarray(toks),
+                 jnp.asarray(seg), jnp.asarray(posa),
+                 jnp.asarray(hist_page), jnp.asarray(hist_slot),
+                 jnp.asarray(pool_hist), jnp.asarray(dest_page),
+                 jnp.asarray(dest_slot), jnp.asarray(sample_idx),
+                 jnp.asarray(activate), jnp.asarray(p_first),
+                 jnp.asarray(p_sample), jnp.asarray(p_len),
+                 jnp.asarray(p_rem))
+        out = self._step_mixed(*args)
+        if q8:
+            (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
+             nxt, lens2, rem2, act2, done, ftok) = out
+        else:
+            (cache.kpool, cache.vpool, nxt, lens2, rem2, act2, done,
+             ftok) = out
+        self.decode_steps += 1
+        self.mixed_ticks += 1
+        self.mixed_prefill_tokens += fresh
+        self._count_tp_dispatch()
+        self.prefill_token_slots += Tb
+        padded = Tb - hist_total - fresh
+        self.prefill_padded_tokens += padded
         if self.metrics is not None:
-            self.metrics.decode_seconds.observe(dt)
-            if self._tp:
-                # host-observed wall of the collective-bearing TP
-                # decode round (single-device engines never record it)
-                self.metrics.tp_collective_seconds.observe(dt)
-        return len(self._active)
+            m = self.metrics
+            m.decode_steps.inc()
+            m.mixed_ticks.inc()
+            m.mixed_prefill_tokens.inc(fresh)
+            m.mixed_budget_tokens.observe(fresh)
+            m.prefill_padded_tokens.inc(padded)
+        # host lens mirror BEFORE activation: the newly-activated
+        # rows' first decode write lands NEXT dispatch at p_len
+        # (cache.lens already reads the full context length from the
+        # carve-time alloc)
+        cache.lens = cache.lens + self._active_mask
+        if self.overlap:
+            d["lens"], d["tok"] = lens2, nxt
+            d["active"], d["remaining"] = act2, rem2
+            entry: Dict = {"nxt": nxt, "done": done}
+            if completing:
+                entry["ftok"] = ftok
+                entry["activate"] = activate.copy()
+                entry["mixed_first"] = {
+                    slot: req for slot, req in completing
+                    if not req.generated}
+            self._inflight.append(entry)
+        # chunk-state advance + progressive prefix registration (a
+        # page registers only AFTER the dispatch carrying its content
+        # — later sharers gather from the pool one dispatch behind,
+        # ordered by the threaded pool arrays)
+        for slot, pos, take, npg in plan:
+            ent = self._mixed_pref.get(slot)
+            req = ent["req"] if ent is not None else None
+            if req is None:
+                continue
+            ent["pos"] = pos + take if pos + take == len(ent["ctx"]) \
+                else pos + npg * page
+            if self.enable_prefix_caching:
+                written_prompt = min(pos + take, len(req.prompt))
+                if written_prompt >= page:
+                    self.cache.register_prefix(
+                        slot, np.asarray(req.prompt[:written_prompt]))
+        # activation commit: completing rows join the decode batch
+        # for the NEXT dispatch (the device chain already carries
+        # them); fresh rows' first token surfaces at the drain
+        for slot, req in completing:
+            ent = self._mixed_pref.pop(slot, None)
+            if ent is None:
+                continue
+            if req.t_admit == 0.0:
+                req.t_admit = time.monotonic()
+                if self.metrics is not None:
+                    self.metrics.queue_wait.observe(
+                        req.t_admit - req.t_submit)
+            req.slot = slot
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self._active[slot] = req
+            self._active_mask[slot] = 1
+            self._remaining[slot] = int(p_rem[slot])
+            if req.generated:            # resume: token already known
+                self._next_tok[slot] = req.generated[-1]
+                if self._hit_stop(req, req.generated[-1]) or \
+                        self._remaining[slot] <= 0:
+                    # host-only retirement under an in-flight
+                    # dispatch: same discipline as stop sequences
+                    self._retire(slot)
+                    if self.overlap:
+                        self._needs_flush = True
+        if self.overlap:
+            if len(self._inflight) > self.lookahead:
+                self._drain_one()
+            return
+        # -- synchronous lane: one fetch per tick (mirrors
+        # _decode_sync's single blocking round-trip)
+        # analysis: ignore[sync-in-hot-path] reason=the synchronous (overlap=False) mixed lane's one fetch per tick — the exact counterpart of _decode_sync's blocking round-trip
+        nxt_h, ftok_h = np.asarray(nxt), np.asarray(ftok)
+        self.host_syncs += 1
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
+        advanced = 0
+        for slot, req in list(self._active.items()):
+            if activate[slot]:
+                continue       # activated this tick: first decode
+                #                token arrives next tick
+            t = int(nxt_h[slot])
+            self._deliver_token(slot, req, t)
+            advanced += 1
+            self._remaining[slot] -= 1
+            if self._hit_stop(req, t) or self._remaining[slot] <= 0:
+                self._retire(slot)
+        for slot, req in completing:
+            if req.generated or self._active.get(slot) is not req:
+                continue
+            t = int(ftok_h[slot])
+            self._deliver_token(slot, req, t, count=False)
+            if self._hit_stop(req, t) or self._remaining[slot] <= 0:
+                self._retire(slot)
+        if self.metrics is not None:
+            self.metrics.tokens_generated.inc(advanced)
+            self.metrics.host_bookkeeping.observe(
+                time.perf_counter() - t0)
+
+    def _deliver_token(self, slot: int, req: Request, t: int,
+                       count: bool = True) -> None:
+        """The shared per-token delivery core every lane uses —
+        append + lifecycle stamp + stream emission + next-input
+        bookkeeping.  ONE definition, so the sync / overlap-drain /
+        mixed lanes' emission behaviour can never fork.
+        ``count=False`` for admission first tokens (no lane counts
+        them in ``tokens_generated``).  Remaining-budget decrement
+        and retire decisions stay at the call sites — they are what
+        legitimately differs per lane."""
+        req.generated.append(t)
+        if count:
+            self.tokens_generated += 1
+        self._note_first_token(req)
+        self._stream.append((req.rid, t))
+        self._next_tok[slot] = t
 
     def _count_tp_dispatch(self, n: int = 1,
                            bytes_per: Optional[int] = None) -> None:
@@ -1490,18 +1970,16 @@ class ContinuousBatchingEngine:
         cache.lens = cache.lens + self._active_mask
         self.decode_steps += 1
         self._count_tp_dispatch()
+        # analysis: ignore[sync-in-hot-path] reason=the synchronous lane's one blocking fetch per tick IS its design (overlap=False); reachable from the mixed hot root only via the degenerate all-parked-rows-preempted fallback tick
         nxt = np.asarray(nxt)
         self.host_syncs += 1
         t0 = time.perf_counter() if self.metrics is not None else 0.0
         advanced = 0
         for slot, req in list(self._active.items()):
+            # analysis: ignore[sync-in-hot-path] reason=host-numpy read: nxt was fetched by the sanctioned sync above (the taint walker keeps the rebind tainted)
             t = int(nxt[slot])
-            req.generated.append(t)
-            self.tokens_generated += 1
+            self._deliver_token(slot, req, t)
             advanced += 1
-            self._note_first_token(req)
-            self._stream.append((req.rid, t))
-            self._next_tok[slot] = t
             self._remaining[slot] -= 1
             if self._hit_stop(req, t) or self._remaining[slot] <= 0:
                 self._retire(slot)
@@ -1542,13 +2020,15 @@ class ContinuousBatchingEngine:
                 self._drain_one()
             self._dev = None
 
-    def _dispatch_async(self) -> None:
-        """Issue one decode step chained off the device-resident loop
-        state.  Zero blocking host work: uploads happen only when the
-        state was invalidated by a flush (or the block tables grew)."""
+    def _seed_or_refresh_dev(self) -> Dict:
+        """(Re)seed the device-resident loop state from host truth
+        after a flush, or re-upload only the block tables when page
+        allocations bumped ``tables_version`` — the ONE owner of the
+        overlap chain's seeding invariant, shared by the plain
+        dispatch-ahead lane and the mixed lane (their chained state
+        must never diverge)."""
         cache = self.cache
         if self._dev is None:
-            # (re)seed device loop state from host truth
             self._dev = {
                 "tables": jnp.asarray(cache.tables.copy()),
                 "lens": jnp.asarray(cache.lens.copy()),
@@ -1559,11 +2039,19 @@ class ContinuousBatchingEngine:
             self._dev_tables_version = cache.tables_version
             self._drain_active = self._active_mask.astype(bool)
         elif self._dev_tables_version != cache.tables_version:
-            # page growth: only the tables re-upload — the chained
-            # lens/tok/active/remaining stay device-resident
+            # page growth / carve allocs: only the tables re-upload —
+            # the chained lens/tok/active/remaining stay
+            # device-resident
             self._dev["tables"] = jnp.asarray(cache.tables.copy())
             self._dev_tables_version = cache.tables_version
-        d = self._dev
+        return self._dev
+
+    def _dispatch_async(self) -> None:
+        """Issue one decode step chained off the device-resident loop
+        state.  Zero blocking host work: uploads happen only when the
+        state was invalidated by a flush (or the block tables grew)."""
+        cache = self.cache
+        d = self._seed_or_refresh_dev()
         self._key, sub = jax.random.split(self._key)
         faults.fire("step_dispatch")
         if cache.kv_quant == "int8":
@@ -1605,8 +2093,14 @@ class ContinuousBatchingEngine:
         retires the request and schedules a pipeline flush, since the
         device-side active chain cannot know about it."""
         e = self._inflight.pop(0)
+        has_first = "ftok" in e
+        arrs = ([e["nxt"], e["done"], e["ftok"]] if has_first
+                else [e["nxt"], e["done"]])
+        # a mixed tick's first-token array rides the SAME single fetch
+        # as the decode outputs — zero syncs added by the mixed lane
         # analysis: ignore[sync-in-hot-path] reason=the pipeline's one sanctioned sync point: drains the OLDEST step while a newer dispatch is already in flight
-        nxt, done = self._fetch(e["nxt"], e["done"])
+        fetched = self._fetch(*arrs)
+        nxt, done = fetched[0], fetched[1]
         t0 = time.perf_counter() if self.metrics is not None else 0.0
         mask = self._drain_active
         advanced = 0
@@ -1619,12 +2113,8 @@ class ContinuousBatchingEngine:
                 # flush keeps the slot from being reused under it
                 continue
             t = int(nxt[slot])
-            req.generated.append(t)
-            self.tokens_generated += 1
+            self._deliver_token(slot, req, t)
             advanced += 1
-            self._note_first_token(req)
-            self._stream.append((req.rid, t))
-            self._next_tok[slot] = t
             self._remaining[slot] -= 1
             if done[slot]:
                 self._retire(slot)          # eos / budget (on-device)
@@ -1635,6 +2125,29 @@ class ContinuousBatchingEngine:
         # with active & ~done (host-only retirements are excluded by
         # the _active lookup above until the flush lands)
         self._drain_active = mask & ~done.astype(bool)
+        if has_first:
+            # first tokens of segments the mixed dispatch completed:
+            # deliver to the rows it activated (skipped if a cancel/
+            # preemption took the row since dispatch — the re-prefill
+            # will re-sample the same greedy token)
+            ftok = fetched[2]
+            for slot, req in e.get("mixed_first", {}).items():
+                if self._active.get(slot) is not req or req.generated:
+                    continue
+                t = int(ftok[slot])
+                self._deliver_token(slot, req, t, count=False)
+                if self._hit_stop(req, t) or \
+                        self._remaining[slot] <= 0:
+                    # first token ended the request (eos / budget 1):
+                    # host-only retirement, same flush discipline as
+                    # stop sequences — the chained dispatch's extra
+                    # token dies undelivered
+                    self._retire(slot)
+                    self._needs_flush = True
+        if "activate" in e:
+            # rows the mixed dispatch activated are live in every
+            # LATER undrained step
+            self._drain_active = self._drain_active | e["activate"]
         if self.metrics is not None:
             self.metrics.tokens_generated.inc(advanced)
             self.metrics.host_bookkeeping.observe(
@@ -1814,6 +2327,18 @@ class EngineSupervisor:
             new._count_abnormal(req, "error")
             new._finished.append(req)
         old._admitting = []
+        # mixed-lane rows mid-prefill died with their pages (partial
+        # context K/V is gone): error done-message, never dropped
+        for ent in getattr(old, "_mixed_pref", {}).values():
+            req = ent["req"]
+            if req.done:
+                continue
+            req.done, req.status, req.error = True, "error", text
+            req.t_finish = time.monotonic()
+            new._count_abnormal(req, "error")
+            new._finished.append(req)
+        if hasattr(old, "_mixed_pref"):
+            old._mixed_pref.clear()
         # still-live queued requests transplant (rids preserved);
         # cancelled/expired ones retire on the way over
         for req in old._queue:

@@ -218,6 +218,11 @@ class SpeculativeEngine(ContinuousBatchingEngine):
             raise ValueError(
                 "speculative serving is greedy-only (exact "
                 "verification); temperature must be 0")
+        if kw.get("mixed"):
+            raise ValueError(
+                "mixed=True is a plain-decode-lane knob: the "
+                "speculative round has its own draft+verify dispatch "
+                "structure the mixed program does not reproduce")
         if cache.kv_quant or draft_cache.kv_quant:
             raise NotImplementedError(
                 "speculative serving over int8 pools: dequant in "

@@ -33,6 +33,10 @@ _TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 # admission waves (token counts, powers of two like the bucketing)
 _PACKED_BUCKETS = (64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
                    8192.0, 16384.0, 32768.0, 65536.0, 131072.0)
+# mixed-tick piggybacked prefill tokens: a page .. large budgets
+# (token counts; utilization = sum/count over the configured budget)
+_BUDGET_BUCKETS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+                   1024.0, 2048.0, 4096.0)
 # host bookkeeping per decode step: 10us .. 1s (pure Python work —
 # far below the dispatch buckets; the overlap ratio
 # host_bookkeeping.sum / decode_step.sum needs resolution down here)
@@ -139,6 +143,22 @@ class EngineMetrics:
             "Packed-stream token slots per packed admission wave "
             "(one sample per packed prefill dispatch)",
             buckets=_PACKED_BUCKETS)
+        # -- mixed prefill+decode lane (token-budget piggybacking) ------
+        self.mixed_ticks = r.counter(
+            "paddle_tpu_engine_mixed_ticks_total",
+            "Decode dispatches that piggybacked prefill-stream "
+            "tokens (mixed=True: the engine admits without stalling "
+            "decode)")
+        self.mixed_prefill_tokens = r.counter(
+            "paddle_tpu_engine_mixed_piggybacked_prefill_tokens_total",
+            "Fresh context tokens prefilled INSIDE mixed decode "
+            "dispatches instead of dedicated admission waves")
+        self.mixed_budget_tokens = r.histogram(
+            "paddle_tpu_engine_mixed_budget_tokens",
+            "Fresh prefill tokens one mixed tick consumed (bounded "
+            "by mixed_token_budget; sum/count against the configured "
+            "budget is the budget utilization)",
+            buckets=_BUDGET_BUCKETS)
         self.host_bookkeeping = r.histogram(
             "paddle_tpu_engine_host_bookkeeping_seconds",
             "Host-side scheduling/streaming bookkeeping per decode "
@@ -269,14 +289,23 @@ def bind_engine_gauges(m: EngineMetrics, engine) -> None:
     from the engine constructor; re-binding (a newer engine on the
     shared default registry) is last-writer-wins by design."""
     cache = engine.cache
+    # mixed-lane rows parked mid-prefill (_mixed_pref) HOLD a slot:
+    # they count as active/occupying, or an operator reads a node
+    # holding every slot + most of the pool as idle
     m.active_requests.set_function(
-        _weak_fn(engine, lambda e: float(len(e._active))))
+        _weak_fn(engine,
+                 lambda e: float(len(e._active)
+                                 + len(getattr(e, "_mixed_pref",
+                                               ())))))
     m.queued_requests.set_function(
         _weak_fn(engine, lambda e: float(len(e._queue))))
     m.queued_tokens.set_function(
         _weak_fn(engine, lambda e: float(e.queued_tokens())))
     m.batch_occupancy.set_function(
-        _weak_fn(engine, lambda e: len(e._active) / e.B))
+        _weak_fn(engine,
+                 lambda e: (len(e._active)
+                            + len(getattr(e, "_mixed_pref", ())))
+                 / e.B))
     m.inflight_dispatches.set_function(
         _weak_fn(engine,
                  lambda e: float(len(getattr(e, "_inflight", ())))))

@@ -1,0 +1,625 @@
+"""Token-budget MIXED prefill+decode serving (``mixed=True``):
+every decode dispatch may also consume up to ``mixed_token_budget``
+prefill-stream tokens from waiting contexts, so a colocated engine
+never stops decoding to admit (Sarathi-style chunked-prefill
+piggybacking; the stall serving_disagg_ab measures, deleted without
+a second engine).
+
+Contract under test:
+* GREEDY TOKEN-EXACTNESS rid-for-rid vs the sequential admission
+  lanes across packed/chunked/int8/overlap/TP-mesh/prefix-cache/
+  preemption — the mixed lane changes WHEN tokens are produced,
+  never WHICH;
+* ONE fused dispatch per mixed tick: zero prefill-program calls,
+  zero host-side page-scatter dispatches, zero ADDED host syncs
+  (counting wrappers on the engine's dispatch/fetch seams);
+* budget accounting: fresh tokens per tick <= budget, totals pinned;
+* degradation: budget 0 / idle engine / over-cap contexts fall back
+  to the sequential packed lane (counted);
+* fault paths: step fault mid-mixed-wave quarantines cleanly,
+  cancel/deadline mid-prefill release audit-clean, supervisor
+  restarts fail parked rows loudly — ``PagedKVCache.audit()`` clean
+  everywhere.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import (ContinuousBatchingEngine,
+                                              EngineSupervisor)
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.mixed
+
+
+def _cfg(nkv=2):
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=nkv, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+def _params(cfg, mesh=None):
+    from jax.sharding import Mesh
+    if mesh is None:
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                    ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+def _specs(seed=0, n=6, lo=3, hi=30, new_lo=2, new_hi=8):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(1, 128, (int(rng.randint(lo, hi)),)),
+             int(rng.randint(new_lo, new_hi)))
+            for _ in range(n)]
+
+
+def _run(cfg, params, specs, cache_kw=None, stagger=False, **kw):
+    ck = dict(num_pages=64, pages_max=8, batch=3, page=16)
+    ck.update(cache_kw or {})
+    cache = PagedKVCache(cfg, **ck)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=False, **kw)
+    if stagger:
+        # resident batch first, THEN the waiting tail: the tail's
+        # tokens must piggyback inside live decode dispatches
+        for p, n in specs[:2]:
+            eng.submit(p, max_new_tokens=n)
+        eng.step()
+        for p, n in specs[2:]:
+            eng.submit(p, max_new_tokens=n)
+    else:
+        for p, n in specs:
+            eng.submit(p, max_new_tokens=n)
+    done = eng.run_to_completion(max_steps=100_000)
+    cache.audit()
+    if not kw.get("enable_prefix_caching"):
+        # (cached prefix pages legitimately stay indexed)
+        assert cache.free_pages() == cache.num_pages - 1
+    return {r.rid: list(r.generated) for r in done}, eng
+
+
+# ---------------------------------------------------------------------------
+# token exactness across lanes
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+@pytest.mark.parametrize("overlap", [False, True])
+def test_mixed_token_exact_vs_sequential(kv_quant, overlap):
+    """Mixed-lane generations equal the sequential packed lane's
+    token-for-token, rid-for-rid — sync and overlap, fp and int8 —
+    and the mixed lane actually piggybacked (not vacuous)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(0)
+    ref, _ = _run(cfg, params, specs,
+                  cache_kw=dict(kv_quant=kv_quant), stagger=True)
+    got, eng = _run(cfg, params, specs,
+                    cache_kw=dict(kv_quant=kv_quant), stagger=True,
+                    mixed=True, mixed_token_budget=16,
+                    overlap=overlap)
+    assert got == ref
+    assert eng.mixed_ticks > 0 and eng.mixed_prefill_tokens > 0
+
+
+def test_mixed_chunked_long_prompts_exact():
+    """Prompts spanning several budget chunks (multiple ticks of
+    history-resumed prefill) stay exact vs both the packed and the
+    chunked sequential lanes."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(1, n=5, lo=30, hi=60)
+    ref_packed, _ = _run(cfg, params, specs, stagger=True)
+    ref_chunked, _ = _run(cfg, params, specs, stagger=True,
+                          packed=False, prefill_chunk=16)
+    got, eng = _run(cfg, params, specs, stagger=True, mixed=True,
+                    mixed_token_budget=16, overlap=True)
+    assert got == ref_packed == ref_chunked
+    # a 30..60-token context against a 16-token budget needs >1 tick
+    assert eng.mixed_ticks >= 3
+
+
+def test_mixed_prefix_cache_exact_and_hits():
+    """Prefix caching composes: equal page-aligned prefixes share
+    pages under the mixed lane (progressive registration), outputs
+    exact vs the sequential prefix lane."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(3)
+    pref = rng.randint(1, 128, (32,))
+    specs = [(rng.randint(1, 128, (10,)), 6),
+             (np.concatenate([pref, rng.randint(1, 128, (5,))]), 5),
+             (np.concatenate([pref, rng.randint(1, 128, (9,))]), 5),
+             (np.concatenate([pref, rng.randint(1, 128, (3,))]), 4)]
+    ref, er = _run(cfg, params, specs, stagger=True,
+                   enable_prefix_caching=True)
+    got, eng = _run(cfg, params, specs, stagger=True,
+                    enable_prefix_caching=True, mixed=True,
+                    mixed_token_budget=16, overlap=True)
+    assert got == ref
+    assert eng.cache.prefix_hits > 0
+
+
+@pytest.mark.parametrize("host_pages", [0, 48])
+def test_mixed_preemption_exact(host_pages):
+    """Pool pressure mid-mixed-service: preemption (recompute-style
+    and swap-resume with a host tier) stays token-exact, and resumes
+    re-admit through the mixed lane."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(5)
+    specs = [(rng.randint(1, 128, (20,)), 60) for _ in range(3)]
+    ck = dict(num_pages=11, host_pages=host_pages)
+    ref, er = _run(cfg, params, specs, cache_kw=ck)
+    assert er.preemptions > 0, "fixture must force preemption"
+    got, eng = _run(cfg, params, specs, cache_kw=ck, mixed=True,
+                    mixed_token_budget=16, overlap=True)
+    assert got == ref
+    assert eng.preemptions > 0
+    if host_pages:
+        assert eng.resumes_swapped > 0
+    else:
+        assert eng.resumes_recompute > 0
+
+
+@pytest.mark.tp
+def test_mixed_tp_mesh_exact():
+    """The mixed program composed through the shard_map seams: an
+    mp=4 mesh engine's mixed outputs equal the single-device
+    sequential lane's, one fused dispatch per tick on the mesh."""
+    from jax.sharding import Mesh
+    cfg = _cfg(nkv=4)
+    devs = np.array(jax.devices()[:4]).reshape(1, 1, 1, 1, 4)
+    mesh = Mesh(devs, ("dp", "pp", "sharding", "sep", "mp"))
+    params1 = _params(cfg)
+    params4 = _params(cfg, mesh)
+    specs = _specs(7, n=5)
+    ref, _ = _run(cfg, params1, specs, stagger=True)
+
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=3,
+                         page=16, mesh=mesh)
+    eng = ContinuousBatchingEngine(cfg, params4, cache, mesh=mesh,
+                                   metrics_registry=False, mixed=True,
+                                   mixed_token_budget=16, overlap=True)
+    calls = {"n": 0}
+    inner = eng._step_mixed
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return inner(*a, **kw)
+
+    eng._step_mixed = counting
+    for p, n in specs[:2]:
+        eng.submit(p, max_new_tokens=n)
+    eng.step()
+    for p, n in specs[2:]:
+        eng.submit(p, max_new_tokens=n)
+    done = eng.run_to_completion(max_steps=100_000)
+    cache.audit()
+    got = {r.rid: list(r.generated) for r in done}
+    assert got == ref
+    assert eng.mixed_ticks > 0
+    assert calls["n"] == eng.mixed_ticks
+
+
+# ---------------------------------------------------------------------------
+# dispatch / sync / budget accounting pins
+# ---------------------------------------------------------------------------
+def test_mixed_one_dispatch_per_tick_and_zero_scatters():
+    """A mixed tick is ONE fused device program: no prefill-program
+    calls, no host-side page-scatter dispatches (the scatter runs
+    inside the program), exactly one _step_mixed call per mixed
+    tick, and decode never pauses (every engine tick after warmup
+    runs a decode dispatch)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(9, n=6, lo=10, hi=40, new_lo=8, new_hi=20)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=3,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=False, mixed=True,
+                                   mixed_token_budget=16, overlap=True)
+    calls = {"n": 0}
+    inner = eng._step_mixed
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return inner(*a, **kw)
+
+    eng._step_mixed = counting
+    for p, n in specs[:2]:
+        eng.submit(p, max_new_tokens=n)
+    eng.step()                     # cold sequential wave (by design)
+    pf0 = eng.prefill_calls
+    sc0 = cache.scatter_dispatches
+    steps0 = eng.decode_steps
+    for p, n in specs[2:]:
+        eng.submit(p, max_new_tokens=n)
+    ticks = 0
+    while eng.has_work():
+        eng.step()
+        ticks += 1
+    assert eng.mixed_ticks > 0
+    assert calls["n"] == eng.mixed_ticks
+    # the tail admitted with ZERO prefill programs and ZERO
+    # host-side scatters — everything rode inside the fused step
+    assert eng.prefill_calls == pf0
+    assert cache.scatter_dispatches == sc0
+    # every post-warmup tick ran exactly one decode dispatch: the
+    # engine never stopped decoding to admit
+    assert eng.decode_steps - steps0 == ticks
+    cache.audit()
+
+
+def test_mixed_budget_accounting():
+    """Per-tick fresh prefill tokens never exceed the (page-aligned)
+    budget; their sum equals the parked contexts' prefilled tokens
+    and the mixed_prefill_tokens counter."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(11, n=6, lo=20, hi=60, new_lo=10, new_hi=25)
+    cache = PagedKVCache(cfg, num_pages=96, pages_max=8, batch=3,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=False, mixed=True,
+                                   mixed_token_budget=20, overlap=True)
+    # budget rounds UP to a page multiple
+    assert eng.mixed_token_budget == 32
+    per_tick = []
+    inner = eng._mixed_plan
+
+    def spy():
+        plan = inner()
+        per_tick.append(sum(take for _, _, take, _ in plan))
+        return plan
+
+    eng._mixed_plan = spy
+    for p, n in specs[:2]:
+        eng.submit(p, max_new_tokens=n)
+    eng.step()
+    carved = []
+    for p, n in specs[2:]:
+        eng.submit(p, max_new_tokens=n)
+        carved.append(len(p))
+    eng.run_to_completion(max_steps=100_000)
+    assert per_tick and max(per_tick) <= eng.mixed_token_budget
+    assert sum(per_tick) == eng.mixed_prefill_tokens
+    assert eng.mixed_prefill_tokens == sum(carved)
+    cache.audit()
+
+
+def test_mixed_zero_added_host_syncs():
+    """Every blocking sync routes through the audited seams: in
+    overlap mode host_syncs == _fetch calls (the mixed first-token
+    array rides the SAME single fetch as the decode outputs), plus
+    the sanctioned admission first-token fetch of the cold wave."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(13, n=6, lo=8, hi=30, new_lo=6, new_hi=14)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=3,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=False, mixed=True,
+                                   mixed_token_budget=16, overlap=True)
+    fetches = {"n": 0}
+    inner = eng._fetch
+
+    def counting(*arrs):
+        fetches["n"] += 1
+        return inner(*arrs)
+
+    eng._fetch = counting
+    for p, n in specs[:2]:
+        eng.submit(p, max_new_tokens=n)
+    eng.step()
+    base = eng.host_syncs - fetches["n"]   # cold-wave admission fetch
+    for p, n in specs[2:]:
+        eng.submit(p, max_new_tokens=n)
+    eng.run_to_completion(max_steps=100_000)
+    assert eng.mixed_ticks > 0
+    # steady state: no sync outside the one-per-drain _fetch seam
+    assert eng.host_syncs == fetches["n"] + base
+    cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# degradations
+# ---------------------------------------------------------------------------
+def test_mixed_budget_zero_degrades_to_sequential():
+    """mixed=True with budget 0 IS the sequential engine (the knob
+    documents the degradation; nothing piggybacks)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(15)
+    ref, er = _run(cfg, params, specs)
+    got, eng = _run(cfg, params, specs, mixed=True,
+                    mixed_token_budget=0)
+    assert got == ref
+    assert eng.mixed_ticks == 0
+    assert eng.prefill_calls == er.prefill_calls
+
+
+def test_mixed_ctx_cap_degrades_wave_shape():
+    """A context longer than mixed_ctx_cap cannot fit the mixed
+    stream: it admits through ONE sequential packed wave (counted in
+    mixed_degraded), token-exact; shorter neighbours keep riding the
+    mixed lane."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(17)
+    specs = [(rng.randint(1, 128, (10,)), 12),
+             (rng.randint(1, 128, (8,)), 12),
+             (rng.randint(1, 128, (60,)), 5),    # > cap
+             (rng.randint(1, 128, (12,)), 6)]
+    ref, _ = _run(cfg, params, specs, stagger=True)
+    got, eng = _run(cfg, params, specs, stagger=True, mixed=True,
+                    mixed_token_budget=16, mixed_ctx_cap=32,
+                    overlap=True)
+    assert got == ref
+    assert eng.mixed_degraded >= 1
+    assert eng.mixed_ticks > 0
+
+
+def test_mixed_idle_engine_admits_sequentially():
+    """An IDLE mixed engine (nothing decoding) admits the whole cold
+    wave through the packed lane — there is no decode latency to
+    protect, and one wave beats budget-sized ticks."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(19, n=3)
+    got, eng = _run(cfg, params, specs, mixed=True,
+                    mixed_token_budget=16)
+    assert eng.prefill_calls >= 1    # the cold wave went sequential
+
+
+def test_mixed_first_token_eos_finishes_with_one_token():
+    """A request whose sampled FIRST token is eos retires with that
+    single token under the mixed lane, exactly like the sequential
+    lanes (host-side retirement at the drain, flush discipline)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(21)
+    filler = rng.randint(1, 128, (6,))
+    probe = rng.randint(1, 128, (9,))
+    # discover the probe's greedy first token with a sequential run
+    ref, _ = _run(cfg, params, [(filler, 20), (probe, 8)],
+                  stagger=True)
+    eos = ref[1][0]
+
+    def run(**kw):
+        cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                             page=16)
+        eng = ContinuousBatchingEngine(cfg, params, cache,
+                                       eos_id=int(eos),
+                                       metrics_registry=False, **kw)
+        eng.submit(filler, max_new_tokens=20)
+        eng.step()
+        eng.submit(probe, max_new_tokens=8)
+        done = eng.run_to_completion(max_steps=100_000)
+        cache.audit()
+        return {r.rid: list(r.generated) for r in done}
+
+    seq = run()
+    mix = run(mixed=True, mixed_token_budget=16, overlap=True)
+    assert mix == seq
+    assert mix[1] == [eos]
+
+
+# ---------------------------------------------------------------------------
+# fault / cancel / deadline / restart paths
+# ---------------------------------------------------------------------------
+def test_mixed_step_fault_quarantines_cleanly():
+    """A step fault mid-mixed-wave quarantines: active rows AND
+    parked mixed rows fail with error done-messages, the allocator
+    audits clean, and the engine keeps serving the rest."""
+    cfg = _cfg()
+    params = _params(cfg)
+    specs = _specs(23, n=5, lo=12, hi=40, new_lo=15, new_hi=30)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=3,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=False, mixed=True,
+                                   mixed_token_budget=16, overlap=True)
+    rids = [eng.submit(p, max_new_tokens=n) for p, n in specs]
+    eng.step()                                  # cold wave
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("injected mixed fault"),
+                  nth=2)
+        done = {r.rid: r for r in
+                eng.run_to_completion(max_steps=100_000)}
+    assert eng.step_faults >= 1
+    assert sorted(done) == sorted(rids)     # nobody vanished
+    errs = [rid for rid in rids if done[rid].status == "error"]
+    assert errs
+    for rid in errs:
+        assert "injected mixed fault" in done[rid].error
+    cache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+    assert len(eng._free_slots) == eng.B
+
+
+def test_mixed_cancel_and_deadline_mid_prefill():
+    """cancel() / deadline expiry of a PARKED mixed row (chunks still
+    streaming) releases its slot + pages audit-clean and surfaces
+    the right status."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(25)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=3,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=False, mixed=True,
+                                   mixed_token_budget=16, overlap=True)
+    now = [100.0]
+    eng._now = lambda: now[0]
+    r0 = eng.submit(rng.randint(1, 128, (8,)), max_new_tokens=30)
+    eng.step()
+    r1 = eng.submit(rng.randint(1, 128, (60,)), max_new_tokens=5)
+    r2 = eng.submit(rng.randint(1, 128, (60,)), max_new_tokens=5,
+                    deadline_s=1.0)
+    eng.step()
+    eng.step()
+    assert eng._mixed_pref, "fixture must park mixed rows"
+    assert eng.cancel(r1) is True
+    now[0] += 5.0                               # r2's deadline passes
+    done = {r.rid: r for r in
+            eng.run_to_completion(max_steps=100_000)}
+    assert done[r1].status == "cancelled"
+    assert done[r2].status == "expired"
+    assert done[r0].status == "ok"
+    cache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+
+
+def test_mixed_supervisor_restart_fails_parked_rows_loudly():
+    """An engine death mid-mixed-service: the supervisor transplant
+    fails parked mixed rows with error done-messages (their partial
+    K/V died with the pool) — never dropped silently."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(27)
+    specs = [(rng.randint(1, 128, (10,)), 25),
+             (rng.randint(1, 128, (50,)), 5),
+             (rng.randint(1, 128, (50,)), 5)]
+
+    def factory():
+        c = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+        return ContinuousBatchingEngine(
+            cfg, params, c, metrics_registry=False, mixed=True,
+            mixed_token_budget=16, quarantine_faults=False)
+
+    sup = EngineSupervisor(factory, backoff_s=0)
+    rids = [sup.submit(specs[0][0], max_new_tokens=specs[0][1])]
+    sup.step()                  # cold wave admits the first request
+    rids += [sup.submit(p, max_new_tokens=n) for p, n in specs[1:]]
+    sup.step()                  # carve + first mixed tick
+    assert sup.engine._mixed_pref, "fixture must park a mixed row"
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("mixed death"), nth=1)
+        sup.step()              # dies -> restart
+    done = {r.rid: r for r in
+            sup.run_to_completion(max_steps=100_000)}
+    assert sup.restarts == 1
+    assert sorted(done) == sorted(rids)
+    assert any(done[rid].status == "error" for rid in rids)
+    sup.engine.cache.audit()
+
+
+def test_mixed_parked_row_is_preemptible():
+    """A carve that claims the pool's last pages must not strand an
+    active row's growth: the parked mixed row is the preemption
+    victim (released + requeued, partial prefill recomputed), the
+    engine stays live, and outputs remain exact vs sequential."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(31)
+    # A sits 2 tokens from a page boundary when B's carve claims the
+    # pool's remaining 3 pages — A's growth lands while B is still
+    # parked mid-prefill (45 tokens / 16-token budget = 3 ticks)
+    a = (rng.randint(1, 128, (30,)), 40)
+    b = (rng.randint(1, 128, (45,)), 4)
+
+    def run(**kw):
+        cache = PagedKVCache(cfg, num_pages=6, pages_max=8, batch=2,
+                             page=16)
+        eng = ContinuousBatchingEngine(cfg, params, cache,
+                                       metrics_registry=False, **kw)
+        eng.submit(a[0], max_new_tokens=a[1])
+        eng.step()
+        eng.submit(b[0], max_new_tokens=b[1])
+        done = eng.run_to_completion(max_steps=100_000)
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        return {r.rid: list(r.generated) for r in done}, eng
+
+    ref, _ = run()
+    got, eng = run(mixed=True, mixed_token_budget=16, overlap=True)
+    assert got == ref
+    assert eng.preemptions >= 1      # the parked row was the victim
+    assert all(len(got[rid]) for rid in got)
+
+
+# ---------------------------------------------------------------------------
+# cost-model interplay
+# ---------------------------------------------------------------------------
+def test_handoff_cost_model_learns_mixed_capable_lanes():
+    """handoff_wins: a mixed-capable colocated engine pays no
+    admission stall, so disaggregation can never win against it
+    (flip gbps = inf) while the same engine without mixed keeps its
+    finite threshold."""
+    from paddle_tpu.models.disagg import (handoff_flip_gbps,
+                                          handoff_wins)
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    plain = ContinuousBatchingEngine(cfg, params, cache,
+                                     metrics_registry=False)
+    flip = handoff_flip_gbps(64, plain)
+    assert np.isfinite(flip) and flip > 0
+    assert handoff_wins(64, plain, gbps=flip * 10)
+
+    cache2 = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    mixed = ContinuousBatchingEngine(cfg, params, cache2,
+                                     metrics_registry=False,
+                                     mixed=True,
+                                     mixed_token_budget=16)
+    assert handoff_flip_gbps(64, mixed) == float("inf")
+    assert not handoff_wins(64, mixed, gbps=1e9)
+
+
+def test_mixed_rejected_on_special_engines():
+    """PrefillEngine / DecodeEngine / SpeculativeEngine name the real
+    constraint instead of silently mis-serving."""
+    from paddle_tpu.models.disagg import DecodeEngine, PrefillEngine
+    cfg = _cfg()
+    params = _params(cfg)
+    with pytest.raises(ValueError, match="mixed"):
+        PrefillEngine(cfg, params,
+                      PagedKVCache(cfg, num_pages=32, pages_max=8,
+                                   batch=2, page=16, host_pages=16),
+                      mixed=True)
+    with pytest.raises(ValueError, match="mixed"):
+        DecodeEngine(cfg, params,
+                     PagedKVCache(cfg, num_pages=32, pages_max=8,
+                                  batch=2, page=16, host_pages=16),
+                     mixed=True)
+
+
+def test_mixed_health_and_metrics_surface():
+    """mixed_ticks / mixed_piggybacked_prefill_tokens land in the
+    registry and the engine counters the /health view reads."""
+    from paddle_tpu.observability import MetricsRegistry
+    cfg = _cfg()
+    params = _params(cfg)
+    reg = MetricsRegistry()
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=3,
+                         page=16)
+    eng = ContinuousBatchingEngine(cfg, params, cache,
+                                   metrics_registry=reg, mixed=True,
+                                   mixed_token_budget=16, overlap=True)
+    specs = _specs(29, n=5, lo=10, hi=40, new_lo=8, new_hi=16)
+    for p, n in specs[:2]:
+        eng.submit(p, max_new_tokens=n)
+    eng.step()
+    for p, n in specs[2:]:
+        eng.submit(p, max_new_tokens=n)
+    eng.run_to_completion(max_steps=100_000)
+    assert eng.mixed_ticks > 0
+    snap = reg.snapshot()
+    assert snap["paddle_tpu_engine_mixed_ticks_total"]["value"] == \
+        eng.mixed_ticks
+    assert snap["paddle_tpu_engine_mixed_piggybacked_prefill_tokens"
+                "_total"]["value"] == eng.mixed_prefill_tokens
+    hist = snap["paddle_tpu_engine_mixed_budget_tokens"]
+    assert hist["count"] == eng.mixed_ticks
+    assert hist["sum"] == eng.mixed_prefill_tokens

@@ -215,6 +215,10 @@ def cmd_snapshot(args) -> int:
                 "fleet_failovers_total", "fleet_rejected_total",
                 "fleet_replica_deaths_total",
                 "fleet_replica_replaces_total",
+                # mixed prefill+decode lane (the serving_mixed_ab
+                # bench line's engine publishes process-wide)
+                "mixed_ticks_total",
+                "mixed_piggybacked_prefill_tokens_total",
                 # disaggregated prefill/decode (the serving_disagg_ab
                 # bench line's coordinator publishes process-wide)
                 "disagg_handoff_pages_total",
