@@ -1682,6 +1682,119 @@ def _serving_tp_line() -> dict:
     }
 
 
+def _trace_overhead_line() -> dict:
+    """TRACING-COST A/B (ISSUE-13 tentpole acceptance): the same
+    offered load runs through two identical engines — tracing OFF vs
+    tracing ON (per-request TraceContexts, phase-clock accrual,
+    retirement-time span materialization, tail-sampled store) — and
+    reports the decode tok/s delta, the decode-step p99 delta, and
+    the store's retained-bytes footprint.  ``value`` is the on/off
+    decode-tok/s ratio (acceptance bar: >= 0.97, i.e. <= 3% cost;
+    min-of-3 interleaved repeats so CI timer noise hits both arms).
+    The ON arm publishes to the process-wide default tracer, so the
+    final ``metrics_snapshot`` line carries its retained trace
+    ids."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_tracer
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, n_req, prompt_len, new, page = 8, 16, 128, 48, 64
+        num_pages, pages_max = 64, 8
+        metric = "serving_trace_overhead"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, n_req, prompt_len, new, page = 4, 8, 12, 16, 16
+        num_pages, pages_max = 64, 8
+        metric = "serving_trace_tiny_cpu_smoke_overhead"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    tracer = default_tracer()
+    tracer.store.bind_metrics(default_registry())
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+
+    def build(traced):
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page)
+        return ContinuousBatchingEngine(
+            cfg, params, cache, metrics_registry=False,
+            tracer=tracer if traced else None)
+
+    def run(eng):
+        for p in prompts:
+            eng.submit(p, max_new_tokens=new)
+        t0 = time.perf_counter()
+        walls = []
+        tokens = 0
+        while eng.has_work():
+            s0 = time.perf_counter()
+            eng.step()
+            walls.append((time.perf_counter() - s0) * 1000)
+            tokens += sum(len(r.generated) for r in eng.finished())
+        return tokens / (time.perf_counter() - t0), walls
+
+    eng_off, eng_on = build(False), build(True)
+    run(eng_off), run(eng_on)                  # warm both compiles
+    offs, ons, p99o, p99n = [], [], [], []
+    for _ in range(3):
+        tps, walls = run(eng_off)
+        offs.append(tps)
+        p99o.append(_ab_pct(walls, 0.99))
+        tps, walls = run(eng_on)
+        ons.append(tps)
+        p99n.append(_ab_pct(walls, 0.99))
+    t_off, t_on = max(offs), max(ons)          # min-wall == max-tok/s
+    store = tracer.store.stats()
+    return {
+        "metric": metric,
+        "value": round(t_on / max(t_off, 1e-9), 4),
+        "unit": "ratio",
+        "vs_baseline": 0,
+        "extra": {
+            "platform": platform, "requests_per_round": n_req,
+            "rounds": 3, "batch_slots": batch,
+            "decode_tok_per_s_off": round(t_off, 1),
+            "decode_tok_per_s_on": round(t_on, 1),
+            "tok_per_s_cost_pct": round(
+                100.0 * (1.0 - t_on / max(t_off, 1e-9)), 2),
+            "decode_step_p99_off_ms": min(p99o),
+            "decode_step_p99_on_ms": min(p99n),
+            "trace_store": store,
+            "trace_ids_sample": [
+                t["trace_id"] for t in tracer.index(limit=5)],
+            "note": "phase clocks accrue only at scheduler mutation "
+                    "points; decode steps are never spans — the "
+                    "per-token hot path is untouched by design "
+                    "(docs/OBSERVABILITY.md, Tracing)",
+        },
+    }
+
+
 def _serving_line() -> dict:
     return _serving_run(overlap=False)
 
@@ -1697,7 +1810,8 @@ def _snapshot_line() -> dict:
     numbers.  ``host_overhead_frac`` = host bookkeeping seconds /
     decode-step seconds across all engines this process ran — the
     fraction of decode wall the dispatch-ahead pipeline can hide."""
-    from paddle_tpu.observability import default_registry, default_ring
+    from paddle_tpu.observability import (default_registry,
+                                          default_ring, default_tracer)
     snap = default_registry().snapshot()
     host = snap.get("paddle_tpu_engine_host_bookkeeping_seconds") or {}
     dec = snap.get("paddle_tpu_engine_decode_step_seconds") or {}
@@ -1767,6 +1881,16 @@ def _snapshot_line() -> dict:
                       "disagg_colocated_fallback_total": _cval(
                           "paddle_tpu_disagg_colocated_fallback"
                           "_total"),
+                      # tail-sampled trace store: retention counters
+                      # + the retained trace ids (drill into any of
+                      # them with tools/metrics_dump.py trace)
+                      "trace_retained_total": _cval(
+                          "paddle_tpu_trace_retained_total"),
+                      "trace_sampled_out_total": _cval(
+                          "paddle_tpu_trace_sampled_out_total"),
+                      "trace_ids": [
+                          t["trace_id"] for t in
+                          default_tracer().index(limit=20)],
                       "events": default_ring().recent(50)}}
 
 
@@ -1789,6 +1913,7 @@ def main() -> None:
         ("serving_fleet_ab", "x", _fleet_line),
         ("serving_disagg_ab", "x", _disagg_line),
         ("serving_mixed_ab", "x", _serving_mixed_line),
+        ("serving_trace_overhead", "ratio", _trace_overhead_line),
     ]
 
     devs, err = _init_devices()

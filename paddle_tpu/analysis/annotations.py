@@ -92,6 +92,15 @@ SYNC_HOT_ROOTS: List[str] = [
     "ContinuousBatchingEngine._mixed_carve",
     "ContinuousBatchingEngine._mixed_plan",
     "ContinuousBatchingEngine._decode_mixed",
+    # per-request tracing (ISSUE 13): phase clocks accrue and
+    # materialize as spans ONLY at scheduler mutation / retirement
+    # points — the decode hot loop never touches the tracer, and the
+    # materialization path itself must stay pure host bookkeeping
+    # (no device fetch may hide inside a span report)
+    "ContinuousBatchingEngine._retire",
+    "ContinuousBatchingEngine._retire_abnormal",
+    "serving_engine._finalize_trace",
+    "tracing.TraceContext.report_request",
     "paged_decode.make_mixed_step",
     "paged_decode._packed_prefill_body",
     "paged_decode._packed_prefill_body_tp",
@@ -238,7 +247,8 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
                            "_supervisor"}),
         locked_methods=frozenset({"_rebind_observability",
                                   "_is_ready_locked",
-                                  "_health_locked"}),
+                                  "_health_locked",
+                                  "_attach_tracer"}),
         exempt_methods=frozenset({"engine", "_driver", "restarts",
                                   "start", "stop"}),
         note="engine state is owned by the drive thread; HTTP "
@@ -258,12 +268,32 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
     "observability.metrics.Gauge": SharedStateSpec(
         lock="_lock", attrs=frozenset({"_value", "_fn"})),
     "observability.metrics.Histogram": SharedStateSpec(
-        lock="_lock", attrs=frozenset({"_counts", "_sum", "_count"})),
+        lock="_lock", attrs=frozenset({"_counts", "_sum", "_count",
+                                       "_exemplars"})),
     "observability.metrics.MetricsRegistry": SharedStateSpec(
         lock="_lock", attrs=frozenset({"_metrics"})),
     "observability.events.EventRing": SharedStateSpec(
         lock="_lock",
         attrs=frozenset({"_events", "_seq", "_dropped"})),
+    # per-request tracing: engines report spans at retirement while
+    # HTTP handler threads read /trace*, so both tables live behind
+    # their own locks.  Lock order: a server/router/coordinator lock
+    # may wrap the tracer lock, and the tracer's finish_trace calls
+    # the store OUTSIDE its own lock — neither ever takes a lock
+    # upward, so no ABBA pairing exists.
+    "observability.tracing.Tracer": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_live"}),
+        note="begin/add_span/finish/get/index all serialize on "
+             "_lock; sealed docs leave the table before the store "
+             "offer runs"),
+    "observability.tracing.TraceStore": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_traces", "_n_ok", "retained",
+                         "sampled_out", "evicted"}),
+        note="tail-retention decision + FIFO eviction under _lock; "
+             "metric instruments update after release (internally "
+             "locked leaves)"),
     # fault plane: consulted from the engine thread and HTTP handler
     # threads concurrently
     "testing.faults.FaultPlane": SharedStateSpec(
@@ -293,7 +323,8 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
             "_update_gauges_locked", "_ship_handoffs_locked",
             "_transport_default", "_disagg_wins_locked",
             "_count_disagg_placement_locked",
-            "_inflight_handoffs_locked", "_roles_locked"}),
+            "_inflight_handoffs_locked", "_roles_locked",
+            "_harvest_dead_traces_locked"}),
         note="public API takes _lock; every *_locked helper is a "
              "documented called-with-lock-held contract "
              "(handoff_transport, _transport_default included: ship "
@@ -470,6 +501,24 @@ CLAIMS: Dict[str, ClaimSpec] = {
              "death (reclaimed through _release_engine_claims)",
         note="owned by coordinator/router deques across ticks; "
              "every triage branch discards or ships — chaos-tested"),
+    # a live trace entry: begun at submit, it must reach
+    # finish_trace on EVERY request ending (retire / synth finish /
+    # rejected placement) or it squats in Tracer._live — bounded by
+    # max_live eviction to "abandoned", audited by the
+    # no-live-traces-after-drain pins in tests/test_tracing.py.
+    "trace-entry": ClaimSpec(
+        kind="trace-entry",
+        acquires=frozenset({"begin_trace"}),
+        releases=frozenset({"finish_trace", "close"}),
+        value_bearing=True,
+        scope="registry",
+        leak="live traces pinned in Tracer._live until the "
+             "max_live eviction brands them 'abandoned' (a request "
+             "that ended without closing its trace)",
+        note="owned by the Request/_FleetRequest/_DisaggRequest that "
+             "carries the context across engines; engine-minted "
+             "contexts close at retirement, managed ones at the "
+             "router/coordinator finished-merge"),
 }
 
 

@@ -60,7 +60,8 @@ from ..models.serving_engine import (EngineDeadError, EngineSupervisor,
                                      QueueFullError, Request,
                                      _drive_to_completion,
                                      _release_engine_claims)
-from ..observability import FleetMetrics
+from ..observability import (FleetMetrics, advance_phase,
+                             finalize_request_trace, phase_clocks)
 from ..testing import faults
 
 __all__ = ["FleetRouter", "ReplicaHandle", "REPLICA_STATES"]
@@ -172,6 +173,11 @@ class _FleetRequest:
     # failover (the waiter expects its 499, and a disconnect-triggered
     # cancel has no client left to generate for)
     cancelled: bool = False
+    # fleet-level TraceContext (trace id = fleet rid, managed by the
+    # router) and the monotonic instant a death orphaned the request
+    # (the failover_gap span's start; 0.0 = not orphaned)
+    trace: Optional[object] = None
+    t_orphan: float = 0.0
 
 
 class FleetRouter:
@@ -202,7 +208,8 @@ class FleetRouter:
                  handoff_gbps: float = 10.0,
                  handoff_chip_flops: Optional[float] = None,
                  max_inflight_handoffs: int = 8,
-                 metrics_registry=None, metrics_ring=None):
+                 metrics_registry=None, metrics_ring=None,
+                 tracer=None):
         """``roles`` (one per factory, default all ``"unified"``)
         grows DISAGGREGATED serving lanes: requests the PR-4
         bytes-vs-FLOPs cost model prices above the handoff DMA route
@@ -233,6 +240,12 @@ class FleetRouter:
                 f"unknown replica role(s) {bad}: expected 'unified', "
                 f"'prefill' or 'decode'")
         self._lock = threading.Lock()
+        # per-request tracing: the router mints one MANAGED
+        # TraceContext per accepted request (trace id = FLEET rid) and
+        # propagates it into every engine that ever owns the request —
+        # placements, handoff ships and failover re-placements all
+        # land in ONE trace.  FleetServer attaches its tracer here.
+        self.tracer = tracer
         self.prefix_routing = bool(prefix_routing)
         self.auto_replace = bool(auto_replace)
         self._replicas: List[ReplicaHandle] = [
@@ -355,15 +368,18 @@ class FleetRouter:
                 # cancel from the client's side
                 return ok or \
                     self._replicas[freq.replica].role == "prefill"
+            src = None
             for i, (rec, f) in enumerate(self._handoffs):
                 if f is freq:
                     # mid-handoff: reclaim the record inline
                     del self._handoffs[i]
                     rec.discard()
+                    src = rec.request
                     break
             self._pending = deque(q for q in self._pending
                                   if q is not freq)
-            self._finish_synth_locked(freq, "cancelled", None)
+            self._finish_synth_locked(freq, "cancelled", None,
+                                      src=src)
             return True
 
     def finished(self) -> List[Request]:
@@ -435,30 +451,47 @@ class FleetRouter:
         freq = _FleetRequest(self._next_rid, prompt,
                              int(max_new_tokens), stop_sequences,
                              deadline, now)
+        if self.tracer is not None:
+            # the router OWNS the trace (managed=True): replicas
+            # report phase spans into it, and the close lands at the
+            # finished-merge under the FLEET rid — failovers and
+            # handoffs continue the SAME trace
+            freq.trace = self.tracer.begin_trace(
+                str(freq.rid), managed=True, prompt_len=len(prompt),
+                max_new_tokens=int(max_new_tokens))
         # place BEFORE committing the rid: a rejected submit must not
         # burn a fleet rid or leave a phantom request entry
-        if self._disagg_wins_locked(len(prompt),
-                                    int(max_new_tokens)):
-            try:
-                self._place_locked(freq, failover=False,
-                                   lane="prefill")
-                self._count_disagg_placement_locked(True)
-            except ValueError:
-                # malformed/oversized request: every lane would
-                # refuse identically — the client's fault, no fallback
-                raise
-            except Exception:
-                # the prefill lane is saturated/down/faulting
-                # (QueueFullError, EngineDeadError, a route_dispatch
-                # fault surfacing as last_exc): colocation is strictly
-                # better than shedding — fall through to the serve
-                # lane (the 429 verdict belongs to it alone)
+        try:
+            if self._disagg_wins_locked(len(prompt),
+                                        int(max_new_tokens)):
+                try:
+                    self._place_locked(freq, failover=False,
+                                       lane="prefill")
+                    self._count_disagg_placement_locked(True)
+                except ValueError:
+                    # malformed/oversized request: every lane would
+                    # refuse identically — the client's fault, no
+                    # fallback
+                    raise
+                except Exception:
+                    # the prefill lane is saturated/down/faulting
+                    # (QueueFullError, EngineDeadError, a
+                    # route_dispatch fault surfacing as last_exc):
+                    # colocation is strictly better than shedding —
+                    # fall through to the serve lane (the 429 verdict
+                    # belongs to it alone)
+                    self._place_locked(freq, failover=False)
+                    self._count_disagg_placement_locked(False)
+            else:
                 self._place_locked(freq, failover=False)
-                self._count_disagg_placement_locked(False)
-        else:
-            self._place_locked(freq, failover=False)
-            if self._has_prefill_lane:
-                self._count_disagg_placement_locked(False)
+                if self._has_prefill_lane:
+                    self._count_disagg_placement_locked(False)
+        except BaseException:
+            if freq.trace is not None:
+                freq.trace.close(status="rejected",
+                                 error="no replica accepted "
+                                       "(validation or backpressure)")
+            raise
         self._next_rid += 1
         self._requests[freq.rid] = freq
         return freq.rid
@@ -585,7 +618,7 @@ class FleetRouter:
                 local = h.supervisor.submit(
                     freq.prompt, max_new_tokens=freq.max_new_tokens,
                     stop_sequences=freq.stop_sequences,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, trace=freq.trace)
             except ValueError:
                 # the request itself is malformed/oversized — every
                 # replica would refuse identically; the client's fault
@@ -606,6 +639,23 @@ class FleetRouter:
                       else "failover" if failover
                       else "prefix" if prefix_hit == h.idx
                       else "least_loaded")
+            if freq.trace is not None:
+                ctx = freq.trace
+                if failover and freq.t_orphan:
+                    # orphaned → re-placement window, under the SAME
+                    # trace as both replicas' span batches.  Only a
+                    # DEATH-orphaned request is a failover_gap; a
+                    # handoff that waited out decode-lane
+                    # backpressure must not read as a replica death
+                    gap = ("failover_gap" if freq.failovers
+                           else "pending_replacement")
+                    ctx.span(gap, freq.t_orphan, time.monotonic(),
+                             phase=gap, to_replica=h.idx)
+                    freq.t_orphan = 0.0
+                ctx.event("route", reason=reason, replica=h.idx)
+                # engine-side phase spans reported from here on carry
+                # this replica's track
+                ctx.default_attrs["replica"] = h.idx
             self.routed[reason] += 1
             if key is not None:
                 # this replica now holds the prefix's pages
@@ -699,7 +749,8 @@ class FleetRouter:
                         rec.discard()
                         if freq is not None:
                             self._finish_synth_locked(
-                                freq, "cancelled", None)
+                                freq, "cancelled", None,
+                                src=rec.request)
                         continue
                     freq.replica, freq.local_rid = -1, -1
                     self._handoffs.append((rec, freq))
@@ -722,6 +773,15 @@ class FleetRouter:
                     # latency fields must measure from the CLIENT's
                     # submission, not the re-placement
                     req.t_submit = freq.t_submit
+                    if freq.trace is not None:
+                        try:
+                            freq.trace.close(
+                                status=req.status, error=req.error,
+                                tokens=len(req.generated),
+                                failovers=freq.failovers,
+                                clocks=phase_clocks(req))
+                        except Exception:
+                            pass
                 self._finished.append(req)
             active += len(h.engine._active)
         # a drain that completed THIS tick replaces immediately — the
@@ -743,9 +803,17 @@ class FleetRouter:
         text = (f"replica {h.idx} died: "
                 f"{type(exc).__name__}: {exc}")
         self.deaths += 1
-        orphans = list(h.local_rids.values())
+        local_map = dict(h.local_rids)
+        orphans = list(local_map.values())
+        # HARVEST the dead replica's span batches BEFORE kill: the
+        # request objects still sit in the dead engine's structures,
+        # and their accrued phase clocks are the only record of where
+        # this replica spent the request's time — a failed-over
+        # request's trace must show BOTH replicas
+        self._harvest_dead_traces_locked(h, local_map)
         h.kill(text)
         n_failover = 0
+        now = time.monotonic()
         for rid in orphans:
             freq = self._requests.get(rid)
             if freq is None:
@@ -757,6 +825,7 @@ class FleetRouter:
                 self._finish_synth_locked(freq, "cancelled", None)
             elif freq.streamed == 0:
                 freq.failovers += 1
+                freq.t_orphan = now
                 self.failovers += 1
                 n_failover += 1
                 self._pending.append(freq)
@@ -769,6 +838,44 @@ class FleetRouter:
             m.ring.emit("replica_death", replica=h.idx, error=text,
                         failovers=n_failover,
                         errored=len(orphans) - n_failover)
+
+    def _harvest_dead_traces_locked(self, h: ReplicaHandle,
+                                    local_map: Dict[int, int]) -> None:
+        """Report the dead replica's accrued phase intervals into
+        each orphan's fleet trace (tagged with the replica idx and
+        ``died=True``); CONTRACT: caller holds ``_lock``.  Runs at
+        death triage only — never on any hot path — and is
+        best-effort: tracing must not be able to break failover."""
+        if self.tracer is None:
+            return
+        try:
+            eng = h.supervisor.engine
+            by_local = {}
+            for r in list(eng._queue):
+                by_local[r.rid] = r
+            for r in list(eng._active.values()):
+                by_local[r.rid] = r
+            # _admitting: popped for an in-flight admission wave —
+            # the most likely place a death lands, and these
+            # requests still map in local_rids
+            for r in list(getattr(eng, "_admitting", ())):
+                by_local[r.rid] = r
+            for ent in getattr(eng, "_mixed_pref", {}).values():
+                by_local[ent["req"].rid] = ent["req"]
+            for rec in getattr(eng, "_handoff_ready", ()):
+                by_local[rec.request.rid] = rec.request
+            now = time.monotonic()
+            for local, rid in local_map.items():
+                freq = self._requests.get(rid)
+                req = by_local.get(local)
+                if freq is None or freq.trace is None or req is None:
+                    continue
+                if req.t_phase and req.phase != "done":
+                    advance_phase(req, "done", now=now)
+                freq.trace.report_request(req, replica=h.idx,
+                                          died=True)
+        except Exception:
+            pass
 
     def _replace_locked(self, h: ReplicaHandle) -> None:
         h.replace()
@@ -844,11 +951,13 @@ class FleetRouter:
             rec, freq = self._handoffs.popleft()
             if freq.cancelled:
                 rec.discard()
-                self._finish_synth_locked(freq, "cancelled", None)
+                self._finish_synth_locked(freq, "cancelled", None,
+                                          src=rec.request)
                 continue
             if freq.deadline and now >= freq.deadline:
                 rec.discard()
-                self._finish_synth_locked(freq, "expired", None)
+                self._finish_synth_locked(freq, "expired", None,
+                                          src=rec.request)
                 continue
             targets = [h for h in self._replicas
                        if h.role == "decode" and h.state == "READY"]
@@ -875,6 +984,13 @@ class FleetRouter:
                 freq.replica, freq.local_rid = h.idx, local
                 shipped = True
                 dt = time.perf_counter() - t0
+                if freq.trace is not None:
+                    t1 = time.monotonic()
+                    freq.trace.span("handoff_ship", t1 - dt, t1,
+                                    pages=rec.pages,
+                                    bytes=rec.nbytes,
+                                    to_replica=h.idx)
+                    freq.trace.default_attrs["replica"] = h.idx
                 self.handoffs_shipped += 1
                 self.handoff_pages += rec.pages
                 self.handoff_bytes += rec.nbytes
@@ -898,6 +1014,8 @@ class FleetRouter:
             # pending queue absorbs a saturated fleet)
             rec.discard()
             self.colocated_fallbacks += 1
+            if freq.trace is not None:
+                freq.trace.event("handoff_degraded")
             if self.disagg_metrics is not None:
                 self.disagg_metrics.colocated_fallback.inc()
                 self.disagg_metrics.ring.emit(
@@ -912,17 +1030,25 @@ class FleetRouter:
                     continue
                 h.local_rids[local] = freq.rid
                 freq.replica, freq.local_rid = h.idx, local
+                if freq.trace is not None:
+                    freq.trace.default_attrs["replica"] = h.idx
                 placed = True
                 break
             if not placed:
+                freq.t_orphan = time.monotonic()
                 self._pending.append(freq)
         self._handoffs = keep
 
     def _finish_synth_locked(self, freq: _FleetRequest, status: str,
-                             error: Optional[str]) -> None:
+                             error: Optional[str],
+                             src: Optional[Request] = None) -> None:
         """Terminal message for a request no engine owns anymore
         (orphan expired/cancelled while pending, replica death
-        mid-stream): the client ALWAYS gets a status."""
+        mid-stream): the client ALWAYS gets a status.  ``src`` is the
+        engine-side Request a triaged handoff record was carrying —
+        its accrued phase intervals report into the trace before the
+        close (death-orphaned requests are covered separately by the
+        death-triage harvest)."""
         self._requests.pop(freq.rid, None)
         req = Request(freq.rid, freq.prompt, freq.max_new_tokens,
                       stop_sequences=freq.stop_sequences,
@@ -931,6 +1057,17 @@ class FleetRouter:
         req.status = status
         req.error = error
         req.t_finish = self._now()
+        if freq.trace is not None:
+            if src is not None:
+                finalize_request_trace(freq.trace, src, status=status,
+                                       error=error,
+                                       failovers=freq.failovers)
+            else:
+                try:
+                    freq.trace.close(status=status, error=error,
+                                     failovers=freq.failovers)
+                except Exception:
+                    pass
         self._finished.append(req)
 
     def _has_work_locked(self) -> bool:

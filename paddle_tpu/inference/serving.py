@@ -79,11 +79,16 @@ def _snap_val(snap: dict, name: str, default=0):
 
 def _serve_observability(handler, path: str,
                          registry: "MetricsRegistry",
-                         ring: "EventRing") -> bool:
+                         ring: "EventRing", tracer=None) -> bool:
     """Shared GET endpoints for both servers: ``/metrics`` (Prometheus
     text exposition), ``/stats`` (JSON registry snapshot), ``/events``
-    (ring tail; ``?n=`` limit, ``?since=<seq>`` for followers).
-    Returns True when the path was handled."""
+    (ring tail; ``?n=`` limit, ``?since=<seq>`` for followers — the
+    response carries the ``gap`` delta when the ring wrapped past the
+    cursor), and — with a tracer attached — ``/traces``
+    (``?min_ms=&status=&limit=`` index) and ``/trace/<rid>`` (full
+    span-tree JSON; ``?format=perfetto`` merges the trace onto the
+    ring/profiler chrome timeline).  Returns True when the path was
+    handled."""
     if path == "/metrics":
         handler._reply(200, registry.render_prometheus().encode(),
                        "text/plain; version=0.0.4")
@@ -108,9 +113,46 @@ def _serve_observability(handler, path: str,
         except ValueError:
             handler._reply(400, b"bad query", "text/plain")
             return True
-        body = {"events": ring.recent(n=n, since=since)}
+        evs, gap = ring.recent_with_gap(n=n, since=since)
+        # ``gap``: events the ring dropped between the follower's
+        # cursor and the oldest retained event (a wrap between polls
+        # used to skip them SILENTLY); ``dropped`` is the lifetime
+        # total for /stats parity
+        body = {"events": evs, "gap": gap, "dropped": ring.dropped}
         handler._reply(200, json.dumps(body).encode(),
                        "application/json")
+        return True
+    if tracer is not None and path == "/traces":
+        q = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(handler.path).query)
+        try:
+            min_ms = float(q["min_ms"][0]) if "min_ms" in q else 0.0
+            limit = int(q["limit"][0]) if "limit" in q else 50
+            status = q["status"][0] if "status" in q else None
+        except ValueError:
+            handler._reply(400, b"bad query", "text/plain")
+            return True
+        body = {"traces": tracer.index(min_ms=min_ms, status=status,
+                                       limit=limit)}
+        handler._reply(200, json.dumps(body).encode(),
+                       "application/json")
+        return True
+    if tracer is not None and path.startswith("/trace/"):
+        rid = path[len("/trace/"):]
+        q = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(handler.path).query)
+        fmt = q.get("format", ["json"])[0]
+        if fmt == "perfetto":
+            doc = tracer.export_chrome_trace(rid, ring=ring)
+        else:
+            doc = tracer.get(rid)
+        if doc is None:
+            handler._reply(404, b"no such trace (dropped by tail "
+                                b"sampling, or never begun)",
+                           "text/plain")
+        else:
+            handler._reply(200, json.dumps(doc).encode(),
+                           "application/json")
         return True
     return False
 
@@ -197,7 +239,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "requests": count}
             self._reply(200, json.dumps(meta).encode(),
                         "application/json")
-        elif _serve_observability(self, path, srv.registry, srv.ring):
+        elif _serve_observability(self, path, srv.registry, srv.ring,
+                                  getattr(srv, "tracer", None)):
             pass
         else:
             self._reply(404, b"not found", "text/plain")
@@ -335,7 +378,8 @@ class _GenHandler(BaseHTTPRequestHandler):
             # server under its own lock)
             self._reply(200,
                         json.dumps(srv.health_snapshot()).encode())
-        elif _serve_observability(self, path, srv.registry, srv.ring):
+        elif _serve_observability(self, path, srv.registry, srv.ring,
+                                  srv.tracer):
             pass
         else:
             self._reply(404, b"not found", "text/plain")
@@ -482,7 +526,8 @@ class GenerationServer:
                  poll_s: float = 0.002, engine=None,
                  engine_factory=None, max_restarts: int = 3,
                  restart_window_s: float = 60.0,
-                 restart_backoff_s: float = 0.05, **engine_kw):
+                 restart_backoff_s: float = 0.05, tracer=None,
+                 **engine_kw):
         """``engine_factory`` (a zero-arg callable returning a fresh
         engine) enables CRASH RECOVERY: the drive loop runs the engine
         under an :class:`~paddle_tpu.models.serving_engine.
@@ -538,6 +583,28 @@ class GenerationServer:
             from ..observability import MetricsRegistry
             self.registry, self.ring = MetricsRegistry(), default_ring()
         self._http_counters = _http_metrics(self.registry)
+        # per-request distributed tracing (docs/OBSERVABILITY.md,
+        # "Tracing"): ON by default at the serving-product tier —
+        # tail sampling bounds the store, and the hot-path cost is
+        # phase-clock floats at scheduler mutation points only
+        # (bench.py's serving_trace_overhead line measures it).
+        # ``tracer=False`` disables; to aggregate several fronts,
+        # share a TraceStore (one Tracer per front) — two plain
+        # engines sharing one TRACER mint colliding local rids, and
+        # the ingress/stream spans this server attaches by rid would
+        # land on the disambiguated wrong trace.  Engines/routers/
+        # coordinators built without
+        # their own tracer inherit this one (re-checked after every
+        # supervisor restart in _rebind_observability).
+        if tracer is False:
+            self.tracer = None
+        elif tracer is None:
+            from ..observability import TraceStore, Tracer
+            self.tracer = Tracer(
+                TraceStore(metrics_registry=self.registry))
+        else:
+            self.tracer = tracer
+        self._attach_tracer()
 
     @property
     def engine(self):
@@ -568,6 +635,27 @@ class GenerationServer:
         if m is not None and m.registry is not self.registry:
             self.registry, self.ring = m.registry, m.ring
             self._http_counters = _http_metrics(self.registry)
+        self._attach_tracer()
+
+    def _attach_tracer(self) -> None:
+        """Keep the server and its drive target (engine, fleet
+        router or disagg coordinator) on ONE tracer: hand the
+        server's down when the target has none, and ADOPT the
+        target's when it brought its own — otherwise every trace
+        would land in the target's tracer while ``/trace*``, the
+        ingress/stream spans and the store metrics read the server's
+        empty one.  CONTRACT: caller holds ``_lock`` (or is the
+        single-threaded constructor)."""
+        drv = self.engine
+        if self.tracer is None:
+            return                    # tracer=False: surface off
+        t = getattr(drv, "tracer", None)
+        if t is None:
+            drv.tracer = self.tracer
+        elif t is not self.tracer:
+            self.tracer = t
+            if t.store.m_retained is None:
+                t.store.bind_metrics(self.registry)
 
     def is_live(self) -> bool:
         """LIVENESS: the serving loop thread is running (a dead loop
@@ -819,6 +907,7 @@ class GenerationServer:
 
     def submit(self, prompt, max_new_tokens, deadline_s=None):
         import queue as _queue
+        t0 = time.monotonic()
         with self._lock:
             if self._fatal is not None:
                 raise RuntimeError(f"engine died: {self._fatal}")
@@ -832,6 +921,12 @@ class GenerationServer:
                                       deadline_s=deadline_s)
             self._queues[rid] = q
         self._http_counters["generate"].inc()
+        if self.tracer is not None:
+            # HTTP ingress span: handler-side wall of the accepted
+            # submission (the trace itself was minted by the drive
+            # target under the same rid)
+            self.tracer.add_span(str(rid), "http_ingress", t0,
+                                 time.monotonic())
         return rid, q
 
     def cancel(self, rid: int) -> bool:
@@ -870,6 +965,17 @@ class GenerationServer:
                                 q.put(("tok", tok))
                         for req in drv.finished():
                             q = self._queues.pop(req.rid, None)
+                            if self.tracer is not None and \
+                                    req.t_finish:
+                                # terminal-delivery span: retirement
+                                # → waiter fan-out (a late span — it
+                                # lands iff tail retention kept the
+                                # trace)
+                                self.tracer.add_span(
+                                    str(req.rid), "stream",
+                                    req.t_finish, _time.monotonic(),
+                                    attrs={"phase": "stream",
+                                           "status": req.status})
                             if q is None:
                                 continue
                             if req.status == "ok":

@@ -58,11 +58,13 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..observability import DisaggMetrics
+from ..observability import (DisaggMetrics, advance_phase,
+                             finalize_request_trace, phase_clocks)
 from ..testing import faults
 from .paged_decode import PagedKVCache
 from .serving_engine import (ContinuousBatchingEngine, QueueFullError,
-                             Request, _drive_to_completion)
+                             Request, _drive_to_completion,
+                             _finalize_trace)
 
 __all__ = ["DisaggCoordinator", "DecodeEngine", "HandoffRecord",
            "PrefillEngine", "handoff_flip_gbps", "handoff_wins"]
@@ -228,6 +230,13 @@ class PrefillEngine(ContinuousBatchingEngine):
                 request=req, cache=self.cache, export=state,
                 pages=state["pages"],
                 nbytes=state["pages"] * self.cache.page_bytes)
+            # the request leaves this engine: its clocks ride the
+            # record to the decode side (trace-context propagation
+            # across the handoff — ONE trace, stitched)
+            advance_phase(req, "handoff_inflight")
+            if req.trace is not None:
+                req.trace.event("handoff_export", rid=req.rid,
+                                pages=rec.pages)
             self._handoff_ready.append(rec)
             self.handoffs_exported += 1
             if self.metrics is not None:
@@ -277,6 +286,7 @@ class PrefillEngine(ContinuousBatchingEngine):
                 "prefill engine restarted mid-handoff"
             req.t_finish = time.monotonic()
             self._count_abnormal(req, "error")
+            _finalize_trace(req)
             self._finished.append(req)
         old._handoff_ready = []
 
@@ -346,6 +356,14 @@ class DecodeEngine(ContinuousBatchingEngine):
                       t_admit=src.t_admit,
                       t_first_token=src.t_first_token,
                       deadline=src.deadline)
+        # trace-context propagation: the decode-side request
+        # CONTINUES the trace and phase accounting the prefill side
+        # accrued — spans stitch across the two engines through the
+        # HandoffRecord, so /trace/<rid> shows one tree
+        req.trace = src.trace
+        req.phase = src.phase
+        req.t_phase = src.t_phase or req.t_submit
+        req.phase_log = list(src.phase_log)
         self._next_rid += 1
         if req.deadline:
             self._has_deadlines = True
@@ -479,6 +497,7 @@ class _DisaggRequest:
     local: int = -1                   # engine-local rid (when owned)
     rec: Optional[HandoffRecord] = None   # while where == "handoff"
     cancelled: bool = False
+    trace: Optional[object] = None    # coordinator-managed TraceContext
 
 
 class DisaggCoordinator:
@@ -521,7 +540,8 @@ class DisaggCoordinator:
                  handoff_gbps: float = 10.0,
                  handoff_chip_flops: Optional[float] = None,
                  force_route: Optional[str] = None,
-                 metrics_registry=None, metrics_ring=None):
+                 metrics_registry=None, metrics_ring=None,
+                 tracer=None):
         if not hasattr(prefill_engine, "take_handoffs"):
             raise ValueError(
                 "prefill_engine must be a PrefillEngine (it exports "
@@ -535,6 +555,12 @@ class DisaggCoordinator:
                 "force_route must be None, 'prefill' or 'colocated', "
                 f"got {force_route!r}")
         self._lock = threading.Lock()
+        # per-request tracing: the coordinator mints a MANAGED
+        # TraceContext per accepted request (trace id = coordinator
+        # rid) and propagates it into whichever engine owns the
+        # request — the handoff carries it across, so one trace spans
+        # both engines.  GenerationServer attaches its tracer here.
+        self.tracer = tracer
         self.prefill = prefill_engine
         self.decode = decode_engine
         # the bound must cover the WHOLE pipeline, not just the
@@ -616,14 +642,20 @@ class DisaggCoordinator:
             if freq.where == "decode":
                 return self.decode.cancel(freq.local) or True
             # in the handoff queue: reclaim inline
+            src = None
             for i, (rec, f) in enumerate(self._handoffs):
                 if f is freq:
                     del self._handoffs[i]
                     rec.discard()
+                    src = rec.request
                     break
+            for r, f in self._degraded:
+                if f is freq:
+                    src = r
             self._degraded = deque(
                 (r, f) for r, f in self._degraded if f is not freq)
-            self._finish_synth_locked(freq, "cancelled", None)
+            self._finish_synth_locked(freq, "cancelled", None,
+                                      src=src)
             return True
 
     def finished(self) -> List[Request]:
@@ -786,29 +818,58 @@ class DisaggCoordinator:
         # it, or the engine generates for a request the coordinator
         # cannot cancel/triage (claim-lifecycle: placed-request)
         now = self._now()
+        ctx = None
+        if self.tracer is not None:
+            # the coordinator OWNS the trace lifecycle (managed=True):
+            # the engines report phase spans into it, the close lands
+            # at the finished-merge under the coordinator rid
+            ctx = self.tracer.begin_trace(
+                str(self._next_rid), managed=True,
+                prompt_len=len(prompt),
+                lane="prefill" if disagg else "colocated")
+            ctx.default_attrs["engine"] = \
+                "prefill" if disagg else "decode"
         try:
-            local = target.submit(prompt,
-                                  max_new_tokens=max_new_tokens,
-                                  stop_sequences=stop_sequences,
-                                  deadline_s=deadline_s)
-        except QueueFullError:
-            if not disagg:
-                raise
-            # the prefill lane's bounded queue is full: colocation is
-            # strictly better than shedding while the decode engine
-            # has room (parity with the fleet router's fallback — the
-            # 429 verdict belongs to the decode lane alone)
-            disagg = False
-            target = self.decode
-            local = target.submit(prompt,
-                                  max_new_tokens=max_new_tokens,
-                                  stop_sequences=stop_sequences,
-                                  deadline_s=deadline_s)
+            try:
+                local = target.submit(prompt,
+                                      max_new_tokens=max_new_tokens,
+                                      stop_sequences=stop_sequences,
+                                      deadline_s=deadline_s,
+                                      trace=ctx)
+            except QueueFullError:
+                if not disagg:
+                    raise
+                # the prefill lane's bounded queue is full: colocation
+                # is strictly better than shedding while the decode
+                # engine has room (parity with the fleet router's
+                # fallback — the 429 verdict belongs to the decode
+                # lane alone)
+                disagg = False
+                target = self.decode
+                if ctx is not None:
+                    ctx.default_attrs["engine"] = "decode"
+                    ctx.event("prefill_lane_full_fallback")
+                    # the index must not keep claiming the prefill
+                    # lane for a request that never rode it
+                    ctx.tracer.annotate(ctx.trace_id,
+                                        lane="colocated")
+                local = target.submit(prompt,
+                                      max_new_tokens=max_new_tokens,
+                                      stop_sequences=stop_sequences,
+                                      deadline_s=deadline_s,
+                                      trace=ctx)
+        except BaseException:
+            if ctx is not None:
+                ctx.close(status="rejected",
+                          error="submit refused (validation or "
+                                "backpressure)")
+            raise
         freq = _DisaggRequest(
             self._next_rid, prompt, int(max_new_tokens),
             stop_sequences,
             0.0 if deadline_s is None else now + float(deadline_s),
-            now, where="prefill" if disagg else "decode", local=local)
+            now, where="prefill" if disagg else "decode", local=local,
+            trace=ctx)
         self._next_rid += 1
         self._requests[freq.rid] = freq
         if disagg:
@@ -852,8 +913,9 @@ class DisaggCoordinator:
             rid = self._prefill_rids.pop(req.rid, None)
             if rid is None:
                 continue
-            self._requests.pop(rid, None)
+            freq = self._requests.pop(rid, None)
             req.rid = rid
+            self._close_trace_locked(freq, req)
             self._finished.append(req)
         # 4. decode: restore wave k (batched scatters, zero prefill
         # tokens) + one decode round
@@ -871,11 +933,27 @@ class DisaggCoordinator:
             rid = self._decode_rids.pop(req.rid, None)
             if rid is None:
                 continue
-            self._requests.pop(rid, None)
+            freq = self._requests.pop(rid, None)
             req.rid = rid
+            self._close_trace_locked(freq, req)
             self._finished.append(req)
         self._update_gauges_locked()
         return active
+
+    def _close_trace_locked(self, freq: Optional[_DisaggRequest],
+                            req: Request) -> None:
+        """Seal the coordinator-managed trace once the request
+        surfaces with its final status (the engine already reported
+        its phase spans at retirement); CONTRACT: caller holds
+        ``_lock``."""
+        if freq is None or freq.trace is None:
+            return
+        try:
+            freq.trace.close(status=req.status, error=req.error,
+                             tokens=len(req.generated),
+                             clocks=phase_clocks(req))
+        except Exception:
+            pass
 
     def _ship_locked(self, now: float) -> None:
         # degraded fallbacks first: they are oldest and already lost
@@ -884,10 +962,12 @@ class DisaggCoordinator:
         while self._degraded:
             src, freq = self._degraded.popleft()
             if freq.cancelled:
-                self._finish_synth_locked(freq, "cancelled", None)
+                self._finish_synth_locked(freq, "cancelled", None,
+                                          src=src)
                 continue
             if freq.deadline and now >= freq.deadline:
-                self._finish_synth_locked(freq, "expired", None)
+                self._finish_synth_locked(freq, "expired", None,
+                                          src=src)
                 continue
             try:
                 local = self.decode.admit_degraded(src)
@@ -906,11 +986,13 @@ class DisaggCoordinator:
             rec, freq = self._handoffs.popleft()
             if freq.cancelled:
                 rec.discard()
-                self._finish_synth_locked(freq, "cancelled", None)
+                self._finish_synth_locked(freq, "cancelled", None,
+                                          src=rec.request)
                 continue
             if freq.deadline and now >= freq.deadline:
                 rec.discard()
-                self._finish_synth_locked(freq, "expired", None)
+                self._finish_synth_locked(freq, "expired", None,
+                                          src=rec.request)
                 continue
             t0 = time.perf_counter()
             try:
@@ -926,16 +1008,24 @@ class DisaggCoordinator:
                 self._degrade_locked(rec, freq)
                 continue
             dt = time.perf_counter() - t0
+            # commit FIRST: the placed-request claim must reach the
+            # rid table before anything fallible (span reporting
+            # included) can raise — claim-lifecycle discipline
+            self._commit_decode_locked(freq, local)
             self.handoffs_shipped += 1
             self.handoff_pages += rec.pages
             self.handoff_bytes += rec.nbytes
             self.handoff_wall_s += dt
+            if freq.trace is not None:
+                t1 = time.monotonic()
+                freq.trace.span("handoff_ship", t1 - dt, t1,
+                                pages=rec.pages, bytes=rec.nbytes)
+                freq.trace.default_attrs["engine"] = "decode"
             if self.metrics is not None:
                 m = self.metrics
                 m.handoff_pages.inc(rec.pages)
                 m.handoff_bytes.inc(rec.nbytes)
                 m.handoff_seconds.observe(dt)
-            self._commit_decode_locked(freq, local)
         self._handoffs = keep
 
     def _commit_decode_locked(self, freq: _DisaggRequest,
@@ -947,6 +1037,9 @@ class DisaggCoordinator:
                         freq: _DisaggRequest) -> None:
         rec.discard()
         self.colocated_fallbacks += 1
+        if freq.trace is not None:
+            freq.trace.event("handoff_degraded")
+            freq.trace.default_attrs["engine"] = "decode"
         if self.metrics is not None:
             self.metrics.colocated_fallback.inc()
             self.metrics.ring.emit("kv_handoff_fallback", rid=freq.rid)
@@ -962,10 +1055,15 @@ class DisaggCoordinator:
         self._commit_decode_locked(freq, local)
 
     def _finish_synth_locked(self, freq: _DisaggRequest, status: str,
-                             error: Optional[str]) -> None:
+                             error: Optional[str],
+                             src: Optional[Request] = None) -> None:
         """Terminal message for a request neither engine owns anymore
         (cancelled/expired while in the handoff queue): the client
-        ALWAYS gets a status."""
+        ALWAYS gets a status.  ``src`` is the engine-side Request the
+        handoff was carrying, when one is at hand — its accrued phase
+        intervals report into the trace before the close, so the
+        always-kept abnormal traces still answer "where did the time
+        go"."""
         self._requests.pop(freq.rid, None)
         req = Request(freq.rid, freq.prompt, freq.max_new_tokens,
                       stop_sequences=freq.stop_sequences,
@@ -974,6 +1072,15 @@ class DisaggCoordinator:
         req.status = status
         req.error = error
         req.t_finish = self._now()
+        if freq.trace is not None:
+            if src is not None:
+                finalize_request_trace(freq.trace, src, status=status,
+                                       error=error)
+            else:
+                try:
+                    freq.trace.close(status=status, error=error)
+                except Exception:
+                    pass
         self._finished.append(req)
 
     def _update_gauges_locked(self) -> None:

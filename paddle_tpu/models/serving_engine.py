@@ -41,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import (EngineMetrics, MetricsRegistry,
-                             bind_engine_gauges)
+                             advance_phase, bind_engine_gauges,
+                             finalize_request_trace)
 from ..testing import faults
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
@@ -107,6 +108,40 @@ def _release_engine_claims(engine) -> None:
             pass
 
 
+def _tid(req: "Request") -> Optional[str]:
+    """Exemplar handle: the trace id behind a histogram observation
+    (None with tracing off — the observe() call is unchanged)."""
+    return req.trace.trace_id if req.trace is not None else None
+
+
+def _finalize_trace(req: "Request") -> None:
+    """Retirement-time trace materialization: close the request's
+    open phase interval at ``t_finish`` and report the accrued
+    intervals as synthetic spans — the ONE place per-request phase
+    clocks become trace spans (never per decode step, so the overlap
+    pipeline's zero-added-host-syncs discipline holds).  Engine-owned
+    (unmanaged) contexts also CLOSE the trace here with the request's
+    final status; router/coordinator-managed ones close at their
+    finished-merge, after the fleet rid is restored.  Never raises:
+    tracing must not be able to kill retirement."""
+    try:
+        ctx = req.trace
+        if ctx is None:
+            # clocks close even with tracing off — the span-
+            # accounting consistency contract is on the Request
+            if req.t_phase and req.phase != "done":
+                advance_phase(req, "done",
+                              now=req.t_finish if req.t_finish
+                              else None)
+            return
+        req.trace = None              # report + close exactly once
+        finalize_request_trace(ctx, req, close=not ctx.managed,
+                               tokens=len(req.generated),
+                               preemptions=req.preempted)
+    except Exception:
+        pass
+
+
 def _chip_flops_default() -> float:
     """Assumed chip compute rate for the bytes-vs-FLOPs cost models
     (preemption swap-vs-recompute, disagg handoff-vs-stall): v5e bf16
@@ -162,6 +197,17 @@ class Request:
     deadline: float = 0.0
     status: str = "ok"
     error: Optional[str] = None
+    # -- distributed tracing (observability/tracing.py) -----------------
+    # current lifecycle phase + the monotonic instant it began; every
+    # transition appends one closed (phase, t0, t1) interval to
+    # phase_log — O(1) work at scheduler mutation points only, NEVER
+    # per decode token.  ``trace`` is the propagated TraceContext
+    # (None with tracing off); the intervals materialize as synthetic
+    # spans once, at retirement (_finalize_trace).
+    phase: str = "queued"
+    t_phase: float = 0.0
+    phase_log: List = field(default_factory=list)
+    trace: Optional[object] = None
 
 
 class ContinuousBatchingEngine:
@@ -191,7 +237,8 @@ class ContinuousBatchingEngine:
                  tp_allreduce: str = "fp32",
                  mixed: bool = False,
                  mixed_token_budget: int = 256,
-                 mixed_ctx_cap: Optional[int] = None):
+                 mixed_ctx_cap: Optional[int] = None,
+                 tracer=None):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
         TENSOR-PARALLEL model: the decode step is one sharded jitted
@@ -241,6 +288,13 @@ class ContinuousBatchingEngine:
         self.params = params
         self.cache = cache
         self.mesh = mesh
+        # per-request distributed tracing (observability/tracing.py):
+        # with a Tracer attached, submit() mints a TraceContext per
+        # request (trace id = rid); fleet routers / disagg
+        # coordinators pass their own fleet-level context instead and
+        # this attribute stays unused.  Phase clocks accrue either way
+        # — they are plain host floats on the Request.
+        self.tracer = tracer
         self.eos_id = eos_id
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
@@ -438,7 +492,8 @@ class ContinuousBatchingEngine:
     # -- client side ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               trace=None) -> int:
         """Queue a request.  Oversized requests fail HERE with
         ``ValueError`` — one bad request must never surface mid
         ``step()`` and kill every in-flight generation (a row's
@@ -457,6 +512,12 @@ class ContinuousBatchingEngine:
         mid-decode, resources freed, surfaced in ``finished()`` with
         ``status == "expired"`` (a request whose client stopped
         waiting must stop burning decode slots).
+
+        ``trace``: an externally-minted
+        :class:`~paddle_tpu.observability.TraceContext` (fleet
+        routers / disagg coordinators propagate their fleet-rid
+        trace this way); ``None`` mints one from the engine's own
+        ``tracer`` when attached.
 
         Thread safety: ``external-lock`` — NOT internally
         synchronized; safe from non-engine threads only when every
@@ -513,10 +574,20 @@ class ContinuousBatchingEngine:
             self._has_deadlines = True
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, prompt, max_new_tokens,
-                                   stop_sequences=stops,
-                                   t_submit=time.monotonic(),
-                                   deadline=deadline))
+        req = Request(rid, prompt, max_new_tokens,
+                      stop_sequences=stops,
+                      t_submit=time.monotonic(),
+                      deadline=deadline)
+        # phase accounting starts at the queue; ``trace`` (a
+        # TraceContext a fleet router / disagg coordinator minted
+        # under ITS rid space) wins over the engine's own tracer
+        req.t_phase = req.t_submit
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.begin_trace(
+                str(rid), prompt_len=len(prompt),
+                max_new_tokens=int(max_new_tokens))
+        req.trace = trace
+        self._queue.append(req)
         if self.metrics is not None:
             self.metrics.requests_submitted.inc()
             self.metrics.ring.emit("request_submitted", rid=rid,
@@ -667,7 +738,8 @@ class ContinuousBatchingEngine:
             req.t_first_token = time.monotonic()
             if self.metrics is not None:
                 self.metrics.ttft.observe(
-                    req.t_first_token - req.t_submit)
+                    req.t_first_token - req.t_submit,
+                    exemplar=_tid(req))
 
     def _finish_admit(self, req: Request, slot: int, tok: int) -> None:
         """Shared bookkeeping tail of every admission path."""
@@ -675,7 +747,11 @@ class ContinuousBatchingEngine:
             req.t_admit = time.monotonic()
             if self.metrics is not None:
                 self.metrics.queue_wait.observe(
-                    req.t_admit - req.t_submit)
+                    req.t_admit - req.t_submit, exemplar=_tid(req))
+        # phase-clock transition: whatever came before (queued /
+        # prefill wave / swapped restore / handoff restore) closes
+        # here and decoding begins
+        advance_phase(req, "decode_active")
         self._note_first_token(req)
         req.slot = slot
         req.admit_seq = self._admit_seq
@@ -1011,6 +1087,12 @@ class ContinuousBatchingEngine:
             m.ring.emit("swap_resume", rid=req.rid, slot=slot,
                         tokens=restored)
         self._finish_admit(req, slot, req.generated[-1])
+        if req.trace is not None:
+            # span AFTER the admission commit: the restore's row
+            # claim must be committed before anything fallible runs
+            t1 = time.monotonic()
+            req.trace.span("swap_in", t1 - dt, t1, slot=slot,
+                           tokens=restored)
         return True
 
     def _preempt_mode(self, slot: int) -> str:
@@ -1079,6 +1161,10 @@ class ContinuousBatchingEngine:
             req.slot = None
             req.preempted += 1
             self.preemptions += 1
+            advance_phase(req, "preempted")
+            if req.trace is not None:
+                req.trace.event("preempt", mode="mixed-parked",
+                                slot=slot)
             self._release_slot(slot)
             self._free_slots.append(slot)
             self._remaining[slot] = 0
@@ -1119,6 +1205,14 @@ class ContinuousBatchingEngine:
                         time.perf_counter() - t0)
         else:
             self._release_slot(slot)
+        # "swapped" = parked in the host tier (restore pending);
+        # "preempted" = recompute-style requeue.  This runs at a
+        # flush point — the decode loop never touches phase clocks.
+        advance_phase(req, "swapped" if mode == "swap"
+                      else "preempted")
+        if req.trace is not None:
+            req.trace.event("preempt", mode=mode, slot=slot,
+                            generated=len(req.generated))
         if self.metrics is not None:
             self.metrics.preemptions.inc()
             self.metrics.ring.emit("preemption", rid=req.rid,
@@ -1155,9 +1249,11 @@ class ContinuousBatchingEngine:
                 # when the pool is under the pressure the preemption
                 # counter already reports.
                 m.tpot.observe(
-                    (req.t_finish - req.t_first_token) / (n - 1))
+                    (req.t_finish - req.t_first_token) / (n - 1),
+                    exemplar=_tid(req))
             m.ring.emit("request_finished", rid=req.rid, tokens=n,
                         preempted=req.preempted)
+        _finalize_trace(req)
         self._finished.append(req)
 
     # -- fault tolerance: abnormal retirement -----------------------------
@@ -1201,6 +1297,7 @@ class ContinuousBatchingEngine:
             self._remaining[slot] = 0
             self._active_mask[slot] = 0
             self._count_abnormal(req, status)
+            _finalize_trace(req)
             self._finished.append(req)
 
     def _finish_queued_abnormal(self, req: Request, status: str,
@@ -1217,6 +1314,7 @@ class ContinuousBatchingEngine:
         req.error = error
         req.t_finish = time.monotonic()
         self._count_abnormal(req, status)
+        _finalize_trace(req)
         self._finished.append(req)
 
     def _sweep_cancelled_expired(self) -> None:
@@ -1396,6 +1494,7 @@ class ContinuousBatchingEngine:
             except Exception:
                 req.done, req.status, req.error = True, "error", text
                 req.t_finish = time.monotonic()
+                _finalize_trace(req)
                 self._finished.append(req)
         self._admitting = []
         # mixed-lane rows mid-prefill die with the wave: their parked
@@ -1412,6 +1511,7 @@ class ContinuousBatchingEngine:
             except Exception:
                 req.done, req.status, req.error = True, "error", text
                 req.t_finish = time.monotonic()
+                _finalize_trace(req)
                 self._finished.append(req)
         self._mixed_pref.clear()
         # reclaim slots stranded mid-admission: popped from the free
@@ -1513,6 +1613,9 @@ class ContinuousBatchingEngine:
         """Lane choice for one popped admission wave — shared by the
         sequential path and the mixed lane's shape-forced degrades
         (both call it behind a flushed pipeline)."""
+        for req, _ in admits:
+            # the wave's wall lands in each rider's "prefill" clock
+            advance_phase(req, "prefill")
         if self._packed:
             # PACKED VARLEN lane: any length mix (prefix-cache
             # suffixes, long prompts, resumes) is ONE dispatch per
@@ -1594,6 +1697,9 @@ class ContinuousBatchingEngine:
                 self.resumes_recompute += 1
                 if self.metrics is not None:
                     self.metrics.preempt_resume_recompute.inc()
+            # parked mid-prefill: its context rides inside the mixed
+            # dispatches from here — "prefill" until activation
+            advance_phase(req, "prefill")
             self._mixed_pref[slot] = {"req": req, "ctx": ctx,
                                       "pos": start, "start": start}
         if degrades:
@@ -1811,7 +1917,9 @@ class ContinuousBatchingEngine:
                 req.t_admit = time.monotonic()
                 if self.metrics is not None:
                     self.metrics.queue_wait.observe(
-                        req.t_admit - req.t_submit)
+                        req.t_admit - req.t_submit,
+                        exemplar=_tid(req))
+            advance_phase(req, "decode_active")
             req.slot = slot
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
@@ -2306,6 +2414,10 @@ class EngineSupervisor:
         text = f"{type(exc).__name__}: {exc}"
         _release_engine_claims(old)
         new = self._factory()
+        if getattr(new, "tracer", None) is None:
+            # factory-built engines rarely carry a tracer: keep the
+            # serving front's tracing alive across restarts
+            new.tracer = old.tracer
         # results the serving front has not drained yet survive
         new._finished.extend(old._finished)
         old._finished = []
@@ -2314,6 +2426,7 @@ class EngineSupervisor:
             req.done, req.status, req.error = True, "error", text
             req.t_finish = time.monotonic()
             new._count_abnormal(req, "error")
+            _finalize_trace(req)
             new._finished.append(req)
         old._active.clear()
         # requests the fatal step had popped off the queue but not yet
@@ -2325,6 +2438,7 @@ class EngineSupervisor:
             req.done, req.status, req.error = True, "error", text
             req.t_finish = time.monotonic()
             new._count_abnormal(req, "error")
+            _finalize_trace(req)
             new._finished.append(req)
         old._admitting = []
         # mixed-lane rows mid-prefill died with their pages (partial
@@ -2336,6 +2450,7 @@ class EngineSupervisor:
             req.done, req.status, req.error = True, "error", text
             req.t_finish = time.monotonic()
             new._count_abnormal(req, "error")
+            _finalize_trace(req)
             new._finished.append(req)
         if hasattr(old, "_mixed_pref"):
             old._mixed_pref.clear()

@@ -14,6 +14,11 @@ scrape/export recipes):
 * :mod:`.engine_metrics` — the instrument bundle the
   continuous-batching serving stack records into (single source of
   truth for the metric catalogue).
+* :mod:`.tracing` — end-to-end per-request distributed tracing:
+  trace-context propagation across router/engine/handoff/failover
+  boundaries, retirement-time span materialization from per-request
+  phase clocks, and a bounded tail-sampling :class:`TraceStore`
+  served at ``GET /trace/<rid>`` / ``GET /traces``.
 
 Everything is stdlib-only and host-side: instrumentation adds zero
 jitted programs and never forces a device sync — values are recorded
@@ -27,8 +32,13 @@ from .engine_metrics import (EngineMetrics,            # noqa: F401
                              bind_engine_gauges)
 from .fleet_metrics import FleetMetrics                # noqa: F401
 from .disagg_metrics import DisaggMetrics              # noqa: F401
+from .tracing import (PHASES, TraceContext, Tracer,    # noqa: F401
+                      TraceStore, advance_phase, default_tracer,
+                      finalize_request_trace, phase_clocks)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "EventRing", "default_ring",
            "EngineMetrics", "bind_engine_gauges", "FleetMetrics",
-           "DisaggMetrics"]
+           "DisaggMetrics", "PHASES", "TraceContext", "Tracer",
+           "TraceStore", "advance_phase", "default_tracer",
+           "finalize_request_trace", "phase_clocks"]

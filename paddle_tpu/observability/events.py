@@ -31,6 +31,20 @@ from typing import Dict, List, Optional
 
 __all__ = ["EventRing", "default_ring"]
 
+# RecordEvent/TracerEventType resolved ONCE at first use: re-running
+# the import statement inside every span __enter__ put an
+# import-machinery round-trip on the hot span path (pinned by
+# tests/test_observability.py::test_ring_span_no_import_in_hot_path)
+_PROFILER_SPAN_TYPES = None
+
+
+def _record_event_types():
+    global _PROFILER_SPAN_TYPES
+    if _PROFILER_SPAN_TYPES is None:
+        from ..profiler.utils import RecordEvent, TracerEventType
+        _PROFILER_SPAN_TYPES = (RecordEvent, TracerEventType)
+    return _PROFILER_SPAN_TYPES
+
 
 class _RingSpan:
     """Context manager: profiler RecordEvent + ring event on exit."""
@@ -43,7 +57,7 @@ class _RingSpan:
         self._t0 = 0.0
 
     def __enter__(self):
-        from ..profiler.utils import RecordEvent, TracerEventType
+        RecordEvent, TracerEventType = _record_event_types()
         self._rec = RecordEvent(self._name,
                                 TracerEventType.UserDefined)
         self._rec.begin()
@@ -99,13 +113,32 @@ class EventRing:
                since: int = 0) -> List[dict]:
         """Last ``n`` events (all by default), optionally only those
         with ``seq > since`` (the tail-follow protocol)."""
+        return self.recent_with_gap(n=n, since=since)[0]
+
+    def recent_with_gap(self, n: Optional[int] = None,
+                        since: int = 0):
+        """``(events, gap)``: the tail-follow batch plus the number
+        of events that fell off the ring BETWEEN ``since`` and the
+        oldest retained event.  Without the gap figure a follower
+        polling ``/events?since=`` across a ring wrap silently skips
+        the lost events and reads a burst as a quiet stream — the
+        ``dropped`` delta makes the loss visible
+        (tools/metrics_dump.py prints a ``[gap: N events lost]``
+        marker)."""
         with self._lock:
             evs = list(self._events)
+            seq = self._seq
+        gap = 0
         if since:
+            # seq of the oldest event still in the ring; an empty
+            # ring means everything up to seq is gone
+            oldest = evs[0]["seq"] if evs else seq + 1
+            if since + 1 < oldest:
+                gap = oldest - since - 1
             evs = [e for e in evs if e["seq"] > since]
         if n is not None:
             evs = evs[-n:] if n > 0 else []   # n=0 is "none", not all
-        return evs
+        return evs, gap
 
     def drain(self) -> List[dict]:
         with self._lock:
@@ -120,13 +153,12 @@ class EventRing:
     def to_jsonl(self, n: Optional[int] = None) -> str:
         return "\n".join(json.dumps(e) for e in self.recent(n))
 
-    def export_chrome_trace(self, path: str,
-                            include_profiler_spans: bool = True
-                            ) -> str:
-        """Write a chrome trace: ring events as instants (spans when
-        they carry ``dur_s``) merged with the profiler's currently
-        buffered host spans — engine events and ``RecordEvent`` spans
-        on one timeline (open in Perfetto / chrome://tracing)."""
+    def chrome_events(self,
+                      include_profiler_spans: bool = True) -> List[dict]:
+        """The ring (and optionally the profiler's buffered host
+        spans) as chrome trace-event dicts — the building block
+        :meth:`export_chrome_trace` writes out and the per-trace
+        Perfetto export (observability/tracing.py) merges onto."""
         import os
         pid = os.getpid()
         trace_events = []
@@ -157,7 +189,18 @@ class EventRing:
                         "pid": pid, "tid": tid})
             except Exception:
                 pass              # profiler unavailable: events only
-        trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        return trace_events
+
+    def export_chrome_trace(self, path: str,
+                            include_profiler_spans: bool = True
+                            ) -> str:
+        """Write a chrome trace: ring events as instants (spans when
+        they carry ``dur_s``) merged with the profiler's currently
+        buffered host spans — engine events and ``RecordEvent`` spans
+        on one timeline (open in Perfetto / chrome://tracing)."""
+        trace = {"traceEvents":
+                 self.chrome_events(include_profiler_spans),
+                 "displayTimeUnit": "ms"}
         import os.path
         d = os.path.dirname(path)
         if d:

@@ -165,8 +165,17 @@ class Histogram:
         self._counts = [0] * (len(bs) + 1)
         self._sum = 0.0
         self._count = 0
+        # EXEMPLARS: the trace ids behind observations ("last" seen
+        # and the lifetime "max" value), so a TTFT-p99 spike in the
+        # aggregate links straight to the per-request span tree at
+        # /trace/<id> (docs/OBSERVABILITY.md, "Tracing")
+        self._exemplars: Dict[str, dict] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar=None) -> None:
+        """Record one observation; ``exemplar`` (a trace id) tags it
+        so the JSON snapshot carries a drill-down handle next to the
+        aggregate (OpenMetrics-style; the 0.0.4 text exposition is
+        unchanged)."""
         v = float(value)
         # bisect by hand: bucket lists are short (<=20) and the call
         # sits on the request path — avoid allocation
@@ -178,6 +187,12 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                ex = {"value": v, "trace_id": str(exemplar)}
+                self._exemplars["last"] = ex
+                mx = self._exemplars.get("max")
+                if mx is None or v >= mx["value"]:
+                    self._exemplars["max"] = ex
 
     @property
     def count(self) -> int:
@@ -201,11 +216,16 @@ class Histogram:
 
     def snapshot(self) -> dict:
         cum = self.cumulative()
-        return {"type": self.kind, "count": cum[-1], "sum": self.sum,
-                "buckets": {(_fmt(b) if not math.isinf(b) else "+Inf"):
-                            c for b, c in
-                            zip(list(self.buckets) + [float("inf")],
-                                cum)}}
+        out = {"type": self.kind, "count": cum[-1], "sum": self.sum,
+               "buckets": {(_fmt(b) if not math.isinf(b) else "+Inf"):
+                           c for b, c in
+                           zip(list(self.buckets) + [float("inf")],
+                               cum)}}
+        with self._lock:
+            if self._exemplars:
+                out["exemplars"] = {k: dict(v) for k, v
+                                    in self._exemplars.items()}
+        return out
 
     def expose(self) -> List[str]:
         cum = self.cumulative()

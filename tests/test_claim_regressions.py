@@ -154,8 +154,8 @@ def test_generation_server_queue_exists_before_placement(cfg, params,
         cfg, params, _decode_cache(cfg), metrics_registry=False)
     srv = GenerationServer.__new__(GenerationServer)
     # minimal wiring: submit() only touches _lock/_fatal/_driver/
-    # _queues/_http_counters (_driver is a property over the
-    # supervisor-or-engine seam)
+    # _queues/_http_counters/tracer (_driver is a property over the
+    # supervisor-or-engine seam; tracer=None means tracing off)
     import threading
     srv._lock = threading.Lock()
     srv._fatal = None
@@ -163,6 +163,7 @@ def test_generation_server_queue_exists_before_placement(cfg, params,
     srv._engine = eng
     srv.engine_factory = None
     srv._queues = {}
+    srv.tracer = None
 
     class _Cnt:
         def inc(self, *a):

@@ -154,6 +154,90 @@ def test_event_ring_bounded_and_seq_tagged():
     assert len(lines) == 4 and json.loads(lines[0])["name"] == "tick"
 
 
+def test_event_ring_since_follower_sees_wrap_gap():
+    """Regression (ISSUE-13 satellite): when the ring wraps between
+    polls, the tail-follow protocol must REPORT the lost events —
+    ``recent_with_gap`` returns the dropped delta instead of
+    silently skipping them."""
+    ring = EventRing(capacity=4)
+    for i in range(3):
+        ring.emit("tick", i=i)
+    evs, gap = ring.recent_with_gap(since=1)
+    assert gap == 0 and [e["i"] for e in evs] == [1, 2]
+    cursor = 3
+    for i in range(3, 9):                     # wraps: seqs 1..4 gone
+        ring.emit("tick", i=i)
+    evs, gap = ring.recent_with_gap(since=cursor)
+    # ring holds seqs 6..9; cursor 3 → seqs 4 and 5 fell off unseen
+    assert gap == 2
+    assert [e["seq"] for e in evs] == [6, 7, 8, 9]
+    # a follower that kept up sees no gap
+    evs, gap = ring.recent_with_gap(since=6)
+    assert gap == 0 and [e["seq"] for e in evs] == [7, 8, 9]
+    # everything expired (cursor far behind an emptied window): the
+    # whole distance is the gap
+    ring2 = EventRing(capacity=2)
+    for i in range(10):
+        ring2.emit("t")
+    evs, gap = ring2.recent_with_gap(since=2)
+    assert gap == 6 and [e["seq"] for e in evs] == [9, 10]
+    # recent() still matches the gap-aware batch
+    assert ring2.recent(since=2) == evs
+
+
+def test_metrics_dump_events_prints_gap_marker(capsys, monkeypatch):
+    """tools/metrics_dump.py ``events`` prints a visible
+    ``[gap: N events lost]`` marker when the server reports a wrap."""
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        md = importlib.import_module("metrics_dump")
+    finally:
+        sys.path.pop(0)
+    bodies = [json.dumps({"events": [{"name": "t", "seq": 9}],
+                          "gap": 4, "dropped": 4}).encode()]
+    monkeypatch.setattr(md, "_get",
+                        lambda url, timeout=10.0: bodies.pop(0))
+
+    class A:
+        url = "http://x"
+        n = 50
+        follow = False
+        interval = 0.0
+
+    assert md.cmd_events(A()) == 0
+    out = capsys.readouterr().out
+    assert "[gap: 4 events lost]" in out
+    assert '"seq": 9' in out
+
+
+def test_ring_span_no_import_in_hot_path(monkeypatch):
+    """Regression (ISSUE-13 satellite): ``EventRing.span()`` used to
+    re-run ``from ..profiler.utils import ...`` inside every
+    ``__enter__`` — the types must resolve once and stay pinned."""
+    import builtins
+    ring = EventRing()
+    with ring.span("warm"):                   # resolves the types
+        pass
+    real_import = builtins.__import__
+    hits = []
+
+    def counting(name, *a, **kw):
+        if "profiler" in name:
+            hits.append(name)
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", counting)
+    for _ in range(3):
+        with ring.span("hot"):
+            pass
+    assert hits == [], ("span __enter__ re-imported profiler.utils "
+                        f"on the hot path: {hits}")
+
+
 def test_event_ring_chrome_export_merges_profiler_spans(tmp_path):
     from paddle_tpu.profiler.utils import (RecordEvent,
                                            _disable_collection,
@@ -472,9 +556,11 @@ def test_metric_names_lint():
 
     reg = MetricsRegistry()
     EngineMetrics(reg)                        # engine + cache + spec
-    from paddle_tpu.observability import DisaggMetrics, FleetMetrics
+    from paddle_tpu.observability import (DisaggMetrics, FleetMetrics,
+                                          TraceStore)
     FleetMetrics(reg)                         # fleet router tier
     DisaggMetrics(reg)                        # disagg handoff tier
+    TraceStore(metrics_registry=reg)          # tail-sampled traces
     mgr = W.CommTaskManager(scan_interval=60)
     mgr.bind_metrics(reg, EventRing())
     mgr.shutdown()

@@ -1,0 +1,650 @@
+"""End-to-end per-request distributed tracing (observability/tracing
++ the serving stack's trace-context propagation) — the ISSUE-13
+tentpole.
+
+Contract under test:
+* a served request's PHASE CLOCKS (queued/prefill/decode_active/
+  preempted/swapped/handoff_inflight/failover_gap) chain gaplessly
+  from submit to finish — their durations sum to the request's wall
+  time, and the trace-derived TTFT/queue-wait agree with what the
+  histograms observed (whose exemplars carry the trace id);
+* tracing changes NOTHING about generation: traced vs untraced
+  outputs are token-exact across the packed and mixed lanes;
+* trace-context propagation crosses every boundary: HTTP ingress →
+  router placement → replica engine → disagg KV handoff (stitched
+  through the HandoffRecord) → failover re-placement → stream
+  completion — a request driven through fleet failover AND a handoff
+  yields ONE trace showing both replicas;
+* tail-based retention keeps error/cancelled/expired/failed-over and
+  slow traces ALWAYS, samples the fast-ok majority deterministically,
+  and stays bounded;
+* `GET /trace/<rid>` / `GET /traces` serve the span trees over HTTP,
+  with `?format=perfetto` merging onto the ring timeline.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fleet import FleetRouter
+from paddle_tpu.models.disagg import (DecodeEngine, DisaggCoordinator,
+                                      PrefillEngine)
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+from paddle_tpu.observability import (PHASES, MetricsRegistry,
+                                      TraceStore, Tracer,
+                                      phase_clocks)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # identical to tests/test_fleet.py's config so the jitted-program
+    # caches (keyed on cfg) are shared across the suite
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+_RNG = np.random.RandomState(13)
+_PROMPTS = [_RNG.randint(1, 128, (L,)) for L in (10, 21, 33, 8)]
+
+_CACHE_KW = dict(num_pages=64, pages_max=8, batch=2, page=16)
+
+
+def _cache(cfg, **kw):
+    ck = dict(_CACHE_KW)
+    ck.update(kw)
+    return PagedKVCache(cfg, **ck)
+
+
+def _keep_all_tracer() -> Tracer:
+    return Tracer(TraceStore(keep_slower_than_ms=0.0))
+
+
+def _engine(cfg, params, tracer=None, registry=False, **kw):
+    ck = {k: kw.pop(k) for k in ("num_pages", "pages_max", "batch",
+                                 "page", "host_pages")
+          if k in kw}
+    return ContinuousBatchingEngine(
+        cfg, params, _cache(cfg, **ck), metrics_registry=registry,
+        tracer=tracer, **kw)
+
+
+def _phase_spans(doc):
+    return [s for s in doc["spans"] if s["name"] in PHASES]
+
+
+# ---------------------------------------------------------------------------
+# store semantics: tail-based retention
+# ---------------------------------------------------------------------------
+def test_tail_retention_keeps_abnormal_and_slow_drops_fast():
+    store = TraceStore(capacity=64, keep_slower_than_ms=100.0,
+                       sample_every=4)
+    tr = Tracer(store)
+
+    def finish(i, status="ok", slow=False, **attrs):
+        ctx = tr.begin_trace(f"t{i}", **attrs)
+        if slow:
+            # back-date the start so duration crosses the threshold
+            with tr._lock:
+                tr._live[ctx.trace_id]["t0"] -= 1.0
+        return ctx.close(status=status)
+
+    # fast-ok traces: exactly 1 in 4 retained, deterministically
+    kept = [finish(i) for i in range(8)]
+    assert kept == [True, False, False, False] * 2
+    # abnormal statuses always kept
+    for i, status in enumerate(("error", "cancelled", "expired"),
+                               start=100):
+        assert finish(i, status=status) is True
+    # slow always kept; failed-over always kept
+    assert finish(200, slow=True) is True
+    assert finish(201, failovers=1) is True
+    # backpressure rejections ride the SAMPLER, not the always-keep
+    # rule: a saturated fleet's span-less rejected traces must not
+    # flood the FIFO and evict the error/failover tail
+    rejected = [finish(i, status="rejected") for i in range(300, 304)]
+    assert rejected.count(True) == 1
+    st = store.stats()
+    assert st["retained"] == 2 + 3 + 2 + 1
+    assert st["sampled_out"] == 6 + 3
+    assert store.get("t100")["status"] == "error"
+    assert store.get("t1") is None            # sampled out
+    # index filters
+    errs = store.index(status="error")
+    assert [t["trace_id"] for t in errs] == ["t100"]
+    slow = store.index(min_ms=100.0)
+    assert "t200" in {t["trace_id"] for t in slow}
+
+
+def test_store_bounded_fifo_eviction_and_live_bound():
+    store = TraceStore(capacity=3, keep_slower_than_ms=0.0)
+    tr = Tracer(store, max_live=4)
+    for i in range(5):
+        tr.begin_trace(f"t{i}").close()
+    assert len(store) == 3
+    assert store.get("t0") is None and store.get("t4") is not None
+    assert store.stats()["evicted"] == 2
+    # live-table bound: the oldest unfinished trace is evicted as
+    # "abandoned" (always kept by retention) instead of leaking
+    ctxs = [tr.begin_trace(f"live{i}") for i in range(6)]
+    ab = [t for t in store.index(status="abandoned")]
+    assert len(ab) >= 1
+    assert tr.get(ctxs[-1].trace_id)["in_flight"] is True
+    # duplicate ids disambiguate instead of clobbering
+    a = tr.begin_trace("dup")
+    b = tr.begin_trace("dup")
+    assert a.trace_id == "dup" and b.trace_id == "dup#1"
+
+
+def test_store_rekeys_colliding_trace_ids():
+    """Two fronts sharing one STORE (or a rid re-minted after a
+    rejection) must not overwrite each other's retained traces: the
+    older doc re-keys to ``id#n``, ``get(id)`` serves the newest."""
+    store = TraceStore(keep_slower_than_ms=0.0)
+    tr_a, tr_b = Tracer(store), Tracer(store)
+    tr_a.begin_trace("1", front="a").close()
+    tr_b.begin_trace("1", front="b").close()
+    assert len(store) == 2
+    assert store.stats()["retained"] == 2
+    assert store.get("1")["attrs"]["front"] == "b"     # newest
+    assert store.get("1#1")["attrs"]["front"] == "a"   # preserved
+    # the rejected-then-reused-rid shape: the abnormal trace survives
+    tr = Tracer(store)
+    tr.begin_trace("7").close(status="rejected",
+                              error="x")  # sampled: first slot kept
+    tr.begin_trace("7").close()
+    assert store.get("7#1")["status"] == "rejected"
+
+
+def test_late_spans_land_only_on_retained_traces():
+    store = TraceStore(capacity=8, keep_slower_than_ms=0.0)
+    tr = Tracer(store)
+    tr.begin_trace("kept").close()
+    assert tr.add_span("kept", "stream", 0.0, 0.1) is not None
+    assert [s["name"] for s in store.get("kept")["spans"]] == \
+        ["request", "stream"]
+    assert tr.add_span("never-begun", "stream", 0.0, 0.1) is None
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end: span accounting + exemplars + exactness
+# ---------------------------------------------------------------------------
+def test_phase_clocks_sum_to_wall_and_match_histograms(cfg, params):
+    """ISSUE-13 satellite: for a served request the phase clocks sum
+    to the wall duration, and the trace-derived TTFT/queue-wait agree
+    with the histogram observations (whose exemplars name the
+    trace)."""
+    reg = MetricsRegistry()
+    tr = _keep_all_tracer()
+    eng = _engine(cfg, params, tracer=tr, registry=reg)
+    rid = eng.submit(_PROMPTS[0], max_new_tokens=6)
+    done = eng.run_to_completion()
+    req = next(r for r in done if r.rid == rid)
+    clocks = phase_clocks(req)
+    wall = req.t_finish - req.t_submit
+    assert abs(sum(clocks.values()) - wall) < 1e-6 * max(wall, 1.0)
+    assert set(clocks) <= set(PHASES) | {"done"}
+    assert clocks["decode_active"] > 0 and clocks["prefill"] > 0
+
+    # trace-derived TTFT/queue-wait: submit -> end of the admission
+    # wave (the first token samples inside it)
+    derived = clocks["queued"] + clocks["prefill"]
+    snap = reg.snapshot()
+    ttft = snap["paddle_tpu_request_ttft_seconds"]
+    qw = snap["paddle_tpu_request_queue_wait_seconds"]
+    assert abs(derived - ttft["sum"]) < 0.05
+    assert abs(derived - qw["sum"]) < 0.05
+    # exemplars carry the trace id of the request behind the sample
+    assert ttft["exemplars"]["max"]["trace_id"] == str(rid)
+    assert qw["exemplars"]["last"]["trace_id"] == str(rid)
+    tpot = snap["paddle_tpu_request_tpot_seconds"]
+    assert tpot["exemplars"]["max"]["trace_id"] == str(rid)
+
+    # the span tree mirrors the clocks and closed with the request
+    doc = tr.get(str(rid))
+    assert doc["status"] == "ok" and not doc.get("in_flight")
+    names = [s["name"] for s in doc["spans"]]
+    assert names[0] == "request"
+    assert {"queued", "prefill", "decode_active"} <= set(names)
+    assert doc["attrs"]["tokens"] == len(req.generated)
+    by_phase = {}
+    for s in _phase_spans(doc):
+        by_phase[s["name"]] = by_phase.get(s["name"], 0.0) \
+            + s["dur_s"]
+    for k, v in clocks.items():
+        if k in PHASES:
+            assert abs(by_phase[k] - v) < 1e-9
+    # no live traces leak once the engine drained
+    assert tr.index(status="live") == []
+
+
+@pytest.mark.parametrize("mode", ["packed", "mixed"])
+def test_tracing_is_token_exact(cfg, params, mode):
+    """Tracing must never perturb generation: same prompts, traced vs
+    untraced, token-exact across the packed and mixed lanes."""
+    kw = dict(mixed=True, mixed_token_budget=16) \
+        if mode == "mixed" else {}
+
+    def run(tracer):
+        eng = _engine(cfg, params, tracer=tracer, **kw)
+        rids = [eng.submit(p, max_new_tokens=8) for p in _PROMPTS]
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        eng.cache.audit()
+        return [done[r] for r in rids]
+
+    assert run(None) == run(_keep_all_tracer())
+
+
+def test_mixed_lane_phase_accounting(cfg, params):
+    """Mixed-lane admissions park mid-prefill: their phase clocks
+    still chain submit→finish and sum to wall."""
+    tr = _keep_all_tracer()
+    eng = _engine(cfg, params, tracer=tr, mixed=True,
+                  mixed_token_budget=16, batch=4, overlap=True)
+    rids = [eng.submit(p, max_new_tokens=6) for p in _PROMPTS]
+    done = {r.rid: r for r in eng.run_to_completion()}
+    for rid in rids:
+        req = done[rid]
+        clocks = phase_clocks(req)
+        wall = req.t_finish - req.t_submit
+        assert abs(sum(clocks.values()) - wall) < 1e-6
+        assert clocks.get("decode_active", 0) > 0
+    eng.cache.audit()
+
+
+def test_preemption_spans_and_clocks(cfg, params):
+    """Preempted requests carry preempted/swapped phases and preempt
+    marker spans; clocks still sum to wall."""
+    tr = _keep_all_tracer()
+    eng = _engine(cfg, params, tracer=tr, num_pages=5, pages_max=4,
+                  host_pages=0)
+    rng = np.random.RandomState(7)
+    rids = [eng.submit(rng.randint(1, 128, (16,)), max_new_tokens=20)
+            for _ in range(2)]
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert eng.preemptions >= 1
+    victim = next(r for r in done.values() if r.preempted)
+    clocks = phase_clocks(victim)
+    assert clocks.get("preempted", 0) > 0
+    assert abs(sum(clocks.values())
+               - (victim.t_finish - victim.t_submit)) < 1e-6
+    doc = tr.get(str(victim.rid))
+    names = [s["name"] for s in doc["spans"]]
+    assert "preempt" in names and "preempted" in names
+    assert doc["attrs"]["preemptions"] == victim.preempted
+    eng.cache.audit()
+
+
+def test_swap_preemption_swapped_phase(cfg, params):
+    """With a host tier the victim parks swapped: the trace shows the
+    swapped phase and the swap_in restore span."""
+    tr = _keep_all_tracer()
+    eng = _engine(cfg, params, tracer=tr, num_pages=6, pages_max=4,
+                  host_pages=32)
+    eng.offload_swap_gbps = 1e9          # swap always wins
+    rng = np.random.RandomState(9)
+    rids = [eng.submit(rng.randint(1, 128, (16,)), max_new_tokens=20)
+            for _ in range(2)]
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert eng.resumes_swapped >= 1
+    victim = next(r for r in done.values() if r.preempted)
+    doc = tr.get(str(victim.rid))
+    names = [s["name"] for s in doc["spans"]]
+    assert "swapped" in names and "swap_in" in names
+    clocks = phase_clocks(victim)
+    assert clocks.get("swapped", 0) > 0
+    assert abs(sum(clocks.values())
+               - (victim.t_finish - victim.t_submit)) < 1e-6
+    eng.cache.audit()
+
+
+def test_cancelled_and_expired_traces_always_kept(cfg, params):
+    """Tail retention: a cancelled/expired request's trace survives
+    even with aggressive sampling (the tail is the point)."""
+    tr = Tracer(TraceStore(keep_slower_than_ms=1e12,
+                           sample_every=10**6))   # drop all fast-ok
+    tr.store._n_ok = 1      # burn the sampler's keep-the-first slot
+    eng = _engine(cfg, params, tracer=tr, batch=4)
+    ok = eng.submit(_PROMPTS[0], max_new_tokens=4)
+    gone = eng.submit(_PROMPTS[1], max_new_tokens=50)
+    late = eng.submit(_PROMPTS[2], max_new_tokens=50, deadline_s=0.0)
+    eng.cancel(gone)
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert done[gone].status == "cancelled"
+    assert done[late].status == "expired"
+    assert tr.get(str(gone))["status"] == "cancelled"
+    assert tr.get(str(late))["status"] == "expired"
+    assert tr.get(str(ok)) is None            # sampled out, as asked
+    st = tr.store.stats()
+    assert st["retained"] == 2 and st["sampled_out"] >= 1
+    eng.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated handoff: one stitched trace across two engines
+# ---------------------------------------------------------------------------
+def test_disagg_handoff_one_stitched_trace(cfg, params):
+    """The decode-side retirement materializes the FULL phase log —
+    prefill-side queued/prefill + handoff_inflight + decode side —
+    as ONE trace under the coordinator rid, with the ship span."""
+    tr = _keep_all_tracer()
+    pe = PrefillEngine(cfg, params, _cache(cfg, host_pages=32),
+                       metrics_registry=False)
+    de = DecodeEngine(cfg, params, _cache(cfg, host_pages=32),
+                      metrics_registry=False)
+    co = DisaggCoordinator(pe, de, force_route="prefill",
+                           metrics_registry=False, tracer=tr)
+    rids = [co.submit(p, max_new_tokens=6) for p in _PROMPTS[:2]]
+    done = {}
+    steps = 0
+    while co.has_work():
+        co.step()
+        for r in co.finished():
+            done[r.rid] = r
+        steps += 1
+        assert steps < 500
+    for rid in rids:
+        req = done[rid]
+        assert req.status == "ok"
+        clocks = phase_clocks(req)
+        assert clocks.get("handoff_inflight", 0) > 0
+        assert abs(sum(clocks.values())
+                   - (req.t_finish - req.t_submit)) < 1e-6
+        doc = tr.get(str(rid))
+        assert doc is not None and doc["status"] == "ok"
+        names = [s["name"] for s in doc["spans"]]
+        for must in ("handoff_export", "handoff_ship", "queued",
+                     "prefill", "handoff_inflight", "decode_active"):
+            assert must in names, (must, names)
+        # engine-track attribution: prefill-lane spans vs decode side
+        engines = {s["attrs"].get("engine")
+                   for s in doc["spans"] if "engine" in s["attrs"]}
+        assert {"prefill", "decode"} <= engines
+        assert doc["attrs"]["clocks"]["handoff_inflight"] > 0
+    assert tr.index(status="live") == []
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: fleet failover AND a disagg handoff, ONE trace
+# ---------------------------------------------------------------------------
+def test_fleet_failover_plus_handoff_single_trace(cfg, params):
+    """ISSUE-13 acceptance: a request routed through the disagg lane
+    whose decode replica dies in the adopted-but-unadmitted window
+    yields ONE trace at the fleet rid whose span tree shows BOTH
+    replicas, the handoff ship, the failover gap, and phase spans
+    covering the request's wall time."""
+    tr = _keep_all_tracer()
+
+    def pf():
+        return PrefillEngine(cfg, params, _cache(cfg, host_pages=32),
+                             metrics_registry=False)
+
+    def df():
+        return DecodeEngine(cfg, params, _cache(cfg, host_pages=32),
+                            metrics_registry=False)
+
+    router = FleetRouter([pf, df, df],
+                         roles=["prefill", "decode", "decode"],
+                         metrics_registry=False, handoff_gbps=1e9,
+                         tracer=tr)
+    rid = router.submit(_PROMPTS[0], max_new_tokens=6)
+    router.step()              # tick 1: prefill wave exports + takes
+    assert len(router._handoffs) == 1
+    with faults.plane() as fp:
+        # the ship adopts into a decode replica; its step-seam
+        # consult then fires — death in the adopted-unadmitted
+        # window, zero tokens streamed → transparent failover
+        fp.inject("replica_death", RuntimeError("decode died"),
+                  nth=1, times=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    req = done[rid]
+    assert req.status == "ok"
+    assert router.deaths == 1 and router.failovers == 1
+
+    doc = tr.get(str(rid))
+    assert doc is not None and doc["status"] == "ok"
+    assert doc["attrs"]["failovers"] == 1
+    names = [s["name"] for s in doc["spans"]]
+    assert "handoff_ship" in names
+    assert "failover_gap" in names
+    assert names.count("route") >= 2          # disagg + failover
+    # BOTH replicas appear in the tree (the dead one via the death
+    # harvest, the survivor via the final report)
+    replicas = {s["attrs"].get("replica") for s in doc["spans"]
+                if "replica" in s["attrs"]}
+    assert len(replicas) >= 2, doc["spans"]
+    assert any(s["attrs"].get("died") for s in doc["spans"])
+    # phase spans + the failover gap cover the request's wall time:
+    # harvested segment [submit, death] + gap + re-placed segment
+    covered = sum(s["dur_s"] for s in doc["spans"]
+                  if s["name"] in PHASES)
+    root = doc["spans"][0]["dur_s"]
+    assert covered == pytest.approx(root, abs=0.05)
+    # a failed-over trace is ALWAYS retained, even with sampling that
+    # would drop every fast-ok trace
+    strict = TraceStore(keep_slower_than_ms=1e12, sample_every=10**6)
+    assert strict.offer(dict(doc, attrs=dict(doc["attrs"]))) is True
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_cancel_mid_handoff_trace_keeps_phase_spans(cfg, params):
+    """A request cancelled while its record sits in the handoff
+    queue: the always-kept cancelled trace still carries the phase
+    intervals the prefill side accrued (synth finishes report the
+    carried Request before closing)."""
+    tr = _keep_all_tracer()
+    pe = PrefillEngine(cfg, params, _cache(cfg, host_pages=32),
+                       metrics_registry=False)
+    de = DecodeEngine(cfg, params, _cache(cfg, host_pages=32),
+                      metrics_registry=False)
+    co = DisaggCoordinator(pe, de, force_route="prefill",
+                           metrics_registry=False, tracer=tr)
+    rid = co.submit(_PROMPTS[1], max_new_tokens=8)
+    co.step()                    # prefill wave exports + takes
+    assert len(co._handoffs) == 1
+    assert co.cancel(rid) is True
+    done = {r.rid: r for r in co.finished()}
+    assert done[rid].status == "cancelled"
+    doc = tr.get(str(rid))
+    assert doc["status"] == "cancelled"
+    names = [s["name"] for s in doc["spans"]]
+    assert "prefill" in names and "handoff_inflight" in names
+    assert doc["attrs"]["clocks"]["prefill"] > 0
+    pe.cache.audit()
+    de.cache.audit()
+
+
+def test_fleet_plain_failover_latency_breakdown(cfg, params):
+    """A non-disagg fleet death: failover_gap recorded, trace closed
+    with the final status under the fleet rid, token-exact."""
+    tr = _keep_all_tracer()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            cfg, params, _cache(cfg), metrics_registry=False)
+
+    ref_eng = factory()
+    ref_rids = [ref_eng.submit(p, max_new_tokens=8) for p in _PROMPTS]
+    ref_done = {r.rid: list(r.generated)
+                for r in ref_eng.run_to_completion()}
+    ref = [ref_done[r] for r in ref_rids]
+
+    router = FleetRouter([factory] * 2, metrics_registry=False,
+                         tracer=tr)
+    rids = [router.submit(p, max_new_tokens=8) for p in _PROMPTS]
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("killed"), nth=1)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert router.failovers > 0
+    saw_gap = 0
+    for i, rid in enumerate(rids):
+        r = done[rid]
+        doc = tr.get(str(rid))
+        assert doc is not None
+        assert doc["status"] == r.status
+        if r.status == "ok":
+            assert list(r.generated) == ref[i]
+        if doc["attrs"].get("failovers"):
+            assert "failover_gap" in [s["name"] for s in doc["spans"]]
+            saw_gap += 1
+    assert saw_gap >= 1
+    assert tr.index(status="live") == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /trace, /traces, exemplars, perfetto
+# ---------------------------------------------------------------------------
+def test_generation_server_trace_endpoints(cfg, params):
+    from paddle_tpu.inference.serving import (GenerationServer,
+                                              generate_http)
+    srv = GenerationServer(cfg, params, _cache(cfg, batch=2))
+    assert srv.tracer is not None             # on by default
+    srv.tracer.store.keep_slower_than_ms = 0.0
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        toks = generate_http(url, [5, 6, 7, 8], max_new_tokens=4)
+        assert len(toks) == 4
+        idx = json.loads(urllib.request.urlopen(
+            url + "/traces").read())["traces"]
+        assert idx and idx[0]["status"] == "ok"
+        rid = idx[0]["trace_id"]
+        doc = json.loads(urllib.request.urlopen(
+            url + f"/trace/{rid}").read())
+        names = [s["name"] for s in doc["spans"]]
+        # the full boundary chain: ingress → engine phases → stream
+        for must in ("request", "http_ingress", "queued", "prefill",
+                     "decode_active", "stream"):
+            assert must in names, (must, names)
+        # per-trace perfetto export merges the ring timeline
+        perf = json.loads(urllib.request.urlopen(
+            url + f"/trace/{rid}?format=perfetto").read())
+        evnames = {e["name"] for e in perf["traceEvents"]}
+        assert "decode_active" in evnames
+        assert "request_submitted" in evnames     # ring event
+        # exemplars surface in the /stats JSON
+        stats = json.loads(urllib.request.urlopen(
+            url + "/stats").read())["metrics"]
+        ex = stats["paddle_tpu_request_ttft_seconds"]["exemplars"]
+        assert ex["max"]["trace_id"] == rid
+        # trace-store metrics registered on the server's registry
+        assert "paddle_tpu_trace_retained_total" in stats
+        # unknown rid → 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/trace/424242")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_server_adopts_drive_targets_own_tracer(cfg, params):
+    """A router/engine constructed with ITS OWN tracer: the server
+    must follow it (serve ITS traces at /trace*) instead of minting
+    a private empty one."""
+    from paddle_tpu.inference.serving import GenerationServer
+    tr = _keep_all_tracer()
+    eng = _engine(cfg, params, tracer=tr)
+    srv = GenerationServer(engine=eng)
+    assert srv.tracer is tr
+    # store metrics got bound to the server registry
+    assert srv.registry.get("paddle_tpu_trace_retained_total") \
+        is not None
+    rid, q = srv.submit([1, 2, 3], 2)
+    eng.run_to_completion()
+    assert tr.get(str(rid)) is not None
+    names = [s["name"] for s in tr.get(str(rid))["spans"]]
+    assert "http_ingress" in names    # ingress landed on the REAL trace
+
+
+def test_metrics_dump_trace_renderers(cfg, params, capsys):
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        md = importlib.import_module("metrics_dump")
+    finally:
+        sys.path.pop(0)
+    tr = _keep_all_tracer()
+    eng = _engine(cfg, params, tracer=tr)
+    rid = eng.submit(_PROMPTS[0], max_new_tokens=4)
+    eng.run_to_completion()
+    doc = tr.get(str(rid))
+    text = md._render_trace(doc)
+    assert f"trace {rid}" in text and "status=ok" in text
+    assert "decode_active" in text and "phase clocks" in text
+    # the traces index renderer
+    bodies = {"/traces": json.dumps(
+        {"traces": tr.index(limit=10)}).encode()}
+
+    def fake_get(url, timeout=10.0):
+        for k, v in bodies.items():
+            if k in url:
+                return v
+        raise AssertionError(url)
+
+    md_get, md._get = md._get, fake_get
+    try:
+        class A:
+            url = "http://x"
+            min_ms = 0.0
+            status = None
+            limit = 10
+
+        assert md.cmd_traces(A()) == 0
+    finally:
+        md._get = md_get
+    out = capsys.readouterr().out
+    assert str(rid) in out and "duration_ms" in out
+
+
+def test_supervisor_restart_faults_close_traces(cfg, params):
+    """Requests killed by an engine rebuild still close their traces
+    (status=error) — retirement is not the only trace exit."""
+    from paddle_tpu.models.serving_engine import EngineSupervisor
+    tr = _keep_all_tracer()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            cfg, params, _cache(cfg), metrics_registry=False,
+            quarantine_faults=False, tracer=tr)
+
+    sup = EngineSupervisor(factory, backoff_s=0.0)
+    rid = sup.submit(_PROMPTS[0], max_new_tokens=30)
+    sup.step()                                # admit + decode once
+    with faults.plane() as fp:
+        fp.inject("step_dispatch", RuntimeError("boom"), nth=1,
+                  times=1)
+        sup.step()                            # escapes → restart
+    done = {r.rid: r for r in sup.finished()}
+    assert done[rid].status == "error"
+    doc = tr.get(str(rid))
+    assert doc is not None and doc["status"] == "error"
+    assert "decode_active" in [s["name"] for s in doc["spans"]]
+    assert tr.index(status="live") == []

@@ -8,6 +8,8 @@ Usage:
   python tools/metrics_dump.py events  http://127.0.0.1:8000 [-n 50] [--follow]
   python tools/metrics_dump.py fleet   http://127.0.0.1:8000
   python tools/metrics_dump.py disagg  http://127.0.0.1:8000
+  python tools/metrics_dump.py traces  http://127.0.0.1:8000 [--min-ms N] [--status S]
+  python tools/metrics_dump.py trace   http://127.0.0.1:8000 <rid>
   python tools/metrics_dump.py snapshot BENCH_r05.json
 
 ``stats`` renders ``GET /stats`` (the JSON snapshot) as an aligned
@@ -18,7 +20,11 @@ renders a FleetServer's aggregated ``GET /fleet`` snapshot (replica
 lifecycle states, per-replica load, routing/failover counters);
 ``disagg`` renders the disaggregated prefill/decode slice of
 ``GET /stats`` (handoff traffic, in-flight depth, routing decisions,
-fallbacks, handoff ms/request); ``snapshot`` pretty-prints a snapshot
+fallbacks, handoff ms/request); ``traces`` lists the serving front's
+retained trace index (``GET /traces`` — tail-sampled: slow/abnormal
+traces always kept) and ``trace`` renders one request's span tree
+(``GET /trace/<rid>``) with its phase-clock latency breakdown;
+``snapshot`` pretty-prints a snapshot
 previously written to a file
 (e.g. the ``metrics_snapshot`` line bench.py appends to BENCH_r*.json
 output).
@@ -32,6 +38,7 @@ import argparse
 import json
 import sys
 import time
+import urllib.error
 import urllib.request
 
 
@@ -58,6 +65,14 @@ def _render_snapshot(snap: dict) -> str:
                 if c != prev:
                     lines.append(f"{'':<{width}}    le={le}: {c}")
                 prev = c
+            for kind, ex in sorted((m.get("exemplars")
+                                    or {}).items()):
+                # the trace id behind the observation: drill into
+                # the span tree with `trace <url> <id>`
+                lines.append(
+                    f"{'':<{width}}    exemplar {kind}="
+                    f"{ex.get('value', 0):.6g} "
+                    f"trace={ex.get('trace_id')}")
         else:
             v = m.get("value")
             vs = "NaN" if v is None else f"{v:.6g}"
@@ -83,8 +98,13 @@ def cmd_events(args) -> int:
     since = 0
     while True:
         q = f"?since={since}" if since else f"?n={args.n}"
-        evs = json.loads(_get(base + q)).get("events", [])
-        for ev in evs:
+        body = json.loads(_get(base + q))
+        gap = body.get("gap", 0)
+        if gap:
+            # the ring wrapped between polls: these events are GONE
+            # — a silent skip used to read as a quiet stream
+            print(f"[gap: {gap} events lost]")
+        for ev in body.get("events", []):
             print(json.dumps(ev))
             since = max(since, ev.get("seq", since))
         sys.stdout.flush()
@@ -178,6 +198,80 @@ def cmd_disagg(args) -> int:
     return 0
 
 
+def _render_trace(doc: dict) -> str:
+    """One request's span tree, indented by parent, with the
+    phase-clock latency breakdown the trace's close recorded."""
+    lines = [f"trace {doc.get('trace_id')}  "
+             f"status={doc.get('status')}  "
+             f"duration_ms={doc.get('duration_ms')}"
+             + ("  [in flight]" if doc.get("in_flight") else "")]
+    if doc.get("error"):
+        lines.append(f"error: {doc['error']}")
+    clocks = (doc.get("attrs") or {}).get("clocks") or {}
+    if clocks:
+        lines.append("phase clocks (ms): " + "  ".join(
+            f"{k}={1000.0 * v:.2f}"
+            for k, v in sorted(clocks.items(),
+                               key=lambda kv: -kv[1])))
+    children = {}
+    for span in doc.get("spans", []):
+        children.setdefault(span.get("parent"), []).append(span)
+
+    def walk(parent, depth):
+        for span in children.get(parent, []):
+            attrs = {k: v for k, v in (span.get("attrs")
+                                       or {}).items()
+                     if k not in ("phase",)}
+            extra = ("  " + " ".join(f"{k}={v}" for k, v
+                                     in sorted(attrs.items()))
+                     if attrs else "")
+            lines.append(
+                f"{'  ' * depth}{span['name']:<18} "
+                f"{1000.0 * (span.get('dur_s') or 0.0):9.3f} ms"
+                + extra)
+            walk(span["id"], depth + 1)
+
+    walk(None, 0)
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    try:
+        doc = json.loads(_get(
+            args.url.rstrip("/") + f"/trace/{args.rid}"))
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print(f"no trace for rid {args.rid} (dropped by tail "
+                  f"sampling, or never begun)", file=sys.stderr)
+            return 1
+        raise
+    print(_render_trace(doc))
+    return 0
+
+
+def cmd_traces(args) -> int:
+    q = []
+    if args.min_ms:
+        q.append(f"min_ms={args.min_ms}")
+    if args.status:
+        q.append(f"status={args.status}")
+    q.append(f"limit={args.limit}")
+    body = json.loads(_get(args.url.rstrip("/") + "/traces?"
+                           + "&".join(q)))
+    rows = body.get("traces", [])
+    if not rows:
+        print("no traces retained")
+        return 0
+    cols = ("trace_id", "status", "duration_ms", "spans")
+    srows = [[str(t.get(c, "")) for c in cols] for t in rows]
+    widths = [max(len(c), *(len(r[i]) for r in srows))
+              for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in srows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return 0
+
+
 def cmd_snapshot(args) -> int:
     with open(args.path) as f:
         text = f.read()
@@ -223,13 +317,19 @@ def cmd_snapshot(args) -> int:
                 # bench line's coordinator publishes process-wide)
                 "disagg_handoff_pages_total",
                 "disagg_handoff_bytes_total",
-                "disagg_colocated_fallback_total")
+                "disagg_colocated_fallback_total",
+                # tail-sampled trace store (the serving_trace_overhead
+                # bench line's tracer publishes process-wide)
+                "trace_retained_total", "trace_sampled_out_total")
     derived = {}
+    trace_ids = None
     for key in ("extra", "snapshot", "metrics"):
         if isinstance(snap, dict) and key in snap:
             for name in _DERIVED:
                 if isinstance(snap.get(name), (int, float)):
                     derived[name] = snap[name]
+            if isinstance(snap.get("trace_ids"), list):
+                trace_ids = snap["trace_ids"]
             snap = snap[key]
     print(_render_snapshot(snap))
     if "prefill_padded_token_frac" not in derived \
@@ -250,6 +350,9 @@ def cmd_snapshot(args) -> int:
                 print(f"{name} = {v:.4g}")
             else:                       # exact page/byte/token counts
                 print(f"{name} = {int(v)}")
+    if trace_ids:
+        print("retained trace ids: " + " ".join(
+            str(t) for t in trace_ids))
     return 0
 
 
@@ -279,6 +382,21 @@ def main(argv=None) -> int:
                             "prefill/decode slice of GET /stats")
     s.add_argument("url")
     s.set_defaults(fn=cmd_disagg)
+    s = sub.add_parser("traces",
+                       help="list the retained trace index "
+                            "(GET /traces)")
+    s.add_argument("url")
+    s.add_argument("--min-ms", type=float, default=0.0,
+                   dest="min_ms")
+    s.add_argument("--status", default=None)
+    s.add_argument("--limit", type=int, default=50)
+    s.set_defaults(fn=cmd_traces)
+    s = sub.add_parser("trace",
+                       help="render one request's span tree "
+                            "(GET /trace/<rid>)")
+    s.add_argument("url")
+    s.add_argument("rid")
+    s.set_defaults(fn=cmd_trace)
     s = sub.add_parser("snapshot",
                        help="pretty-print a snapshot file")
     s.add_argument("path")
