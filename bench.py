@@ -1117,6 +1117,333 @@ def _fleet_line() -> dict:
     }
 
 
+def _remote_line() -> dict:
+    """SOCKETS-TRANSPORT serving A/B (ISSUE 14 tentpole): the same
+    offered load runs through an in-process ``FleetRouter`` and a
+    SOCKET fleet — every replica a ``ReplicaAgent`` behind a real TCP
+    connection (in-thread agents: genuine localhost wire, no process
+    spawn) — reporting aggregate decode tok/s, TTFT p50/p99, the wire
+    bill (frames / bytes / RTT), handoff ms/request for a
+    disaggregated prefill→decode pair whose KV blobs cross the wire,
+    and recovered/total for BOTH fleets under the same
+    death-every-K schedule (``replica_death`` in-process,
+    ``agent_kill`` on the socket arm).  ``value`` is the
+    socket/in-process aggregate throughput ratio — the localhost-CPU
+    price of the wire.  ``extra.soak`` is a short CONNECTION-CHAOS
+    window (drops + stalled links + one agent kill under load):
+    zero silent drops, transport retry/reconnect counters, audits
+    clean — seeding the ROADMAP item-5 network soak."""
+    import resource
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.fleet import FleetRouter, ReplicaAgent, RemoteSpec
+    from paddle_tpu.models.disagg import DecodeEngine, PrefillEngine
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_ring
+    from paddle_tpu.testing import faults
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, page, new = 8, 64, 48
+        num_pages, pages_max = 96, 8
+        n_replicas, n_requests = 2, 20
+        lens = (16, 48, 96, 200)
+        death_every = 60
+        remote_death_every = 240
+        soak_waves, soak_per_wave, soak_new = 6, 5, 24
+        metric = "serving_remote_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, page, new = 2, 16, 8
+        num_pages, pages_max = 64, 8
+        n_replicas, n_requests = 2, 12
+        lens = (5, 10, 17, 26)
+        death_every = 10
+        remote_death_every = 40
+        soak_waves, soak_per_wave, soak_new = 5, 4, 10
+        metric = "serving_remote_tiny_cpu_smoke_ab"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (lens[i % len(lens)],))
+               for i in range(n_requests)]
+    warm_prompts = [np.random.RandomState(1).randint(
+        1, cfg.vocab_size, (L,)) for L in lens]
+
+    def factory(engine_cls=ContinuousBatchingEngine, host_pages=None):
+        ck = dict(num_pages=num_pages, pages_max=pages_max,
+                  batch=batch, page=page)
+        if host_pages is not None:
+            ck["host_pages"] = host_pages
+        cache = PagedKVCache(cfg, **ck)
+        return engine_cls(cfg, params, cache,
+                          metrics_registry=False)
+
+    def spec(role="unified", engine_cls=None, host_pages=None,
+             lease=2.0, timeout=5.0, retries=3, seed=0):
+        mk = (lambda: factory(engine_cls or ContinuousBatchingEngine,
+                              host_pages))
+        return RemoteSpec(
+            agent=lambda: ReplicaAgent(mk, role=role, lease_s=lease),
+            role=role, lease_s=lease, rpc_timeout_s=timeout,
+            max_retries=retries, backoff_s=0.01, jitter_seed=seed)
+
+    def teardown(router):
+        for h in router._replicas:
+            if getattr(h, "_agent", None) is not None:
+                h._agent.die()
+
+    def run(remote, death_k=None, chaos=False):
+        if remote:
+            lease, timeout = (0.4, 0.3) if death_k else (2.0, 5.0)
+            reps = [spec(lease=lease, timeout=timeout, seed=i)
+                    for i in range(n_replicas)]
+        else:
+            reps = [factory] * n_replicas
+        # the default registry EXPLICITLY: an all-remote fleet has
+        # no in-process engine registry to inherit, and the
+        # transport/disagg instruments must land where the
+        # metrics_snapshot line reads
+        router = FleetRouter(reps,
+                             metrics_registry=default_registry(),
+                             metrics_ring=default_ring())
+        try:
+            for p in warm_prompts:               # warm the compiles
+                router.submit(p, max_new_tokens=2)
+            router.run_to_completion(max_steps=1_000_000)
+            fp = faults.install() if (death_k or chaos) else None
+            try:
+                if death_k and remote:
+                    # the remote seam is consulted per SYNC tick
+                    # (~2 ms poll) where the in-process one is
+                    # consulted per ENGINE step, so the socket
+                    # arm's schedule is two FIXED consult indices —
+                    # deterministic, and bounded so
+                    # kill-faster-than-replace churn can never
+                    # livelock the run (each kill costs a full
+                    # agent rebuild)
+                    fp.inject("agent_kill",
+                              RuntimeError("bench death"),
+                              nth=death_k // 2)
+                    fp.inject("agent_kill",
+                              RuntimeError("bench death"),
+                              nth=death_k * 3 // 2)
+                elif death_k:
+                    fp.inject("replica_death",
+                              RuntimeError("bench death"),
+                              every=death_k)
+                if chaos:
+                    fp.inject("conn_drop",
+                              ConnectionResetError("bench drop"),
+                              every=23)
+                    fp.inject("net_delay", p=0.02, seed=3)
+                t0 = time.perf_counter()
+                for p in prompts:
+                    router.submit(p, max_new_tokens=new)
+                done = router.run_to_completion(max_steps=1_000_000)
+                dt = time.perf_counter() - t0
+            finally:
+                if fp is not None:
+                    faults.uninstall()
+            for h in router._replicas:
+                if h.state in ("READY", "DEGRADED"):
+                    h.engine.cache.audit()
+            ok = [r for r in done if r.status == "ok"]
+            ttfts = sorted((r.t_first_token - r.t_submit) * 1000
+                           for r in ok if r.t_first_token)
+            out = {
+                "requests": len(done), "recovered": len(ok),
+                "silent_drops": len(prompts) - len(done),
+                "tok_per_s": round(
+                    sum(len(r.generated) for r in ok) / dt, 1),
+                "ttft_p50_ms": _ab_pct(ttfts, 0.50),
+                "ttft_p99_ms": _ab_pct(ttfts, 0.99),
+                "failovers": router.failovers,
+                "deaths": router.deaths,
+                "replaces": router.replaces,
+            }
+            if remote:
+                snap = router.fleet_snapshot()["transport"]
+                rtt_ms = None
+                if router.transport_metrics is not None:
+                    h = router.transport_metrics.rtt_seconds
+                    if h.count:
+                        rtt_ms = round(1000.0 * h.sum / h.count, 3)
+                out["transport"] = dict(snap, rtt_ms_mean=rtt_ms)
+            return out
+        finally:
+            teardown(router)
+
+    def wire_handoff():
+        """1 prefill + 1 decode agent over sockets: every request's
+        KV blobs cross the wire; handoff ms/request measured at the
+        ship stage (the disagg histogram on the shared registry)."""
+        router = FleetRouter(
+            [spec(role="prefill", engine_cls=PrefillEngine,
+                  host_pages=num_pages),
+             spec(role="decode", engine_cls=DecodeEngine,
+                  host_pages=num_pages, seed=1)],
+            handoff_gbps=1e9,
+            metrics_registry=default_registry(),
+            metrics_ring=default_ring())
+        try:
+            for p in warm_prompts:
+                router.submit(p, max_new_tokens=2)
+            router.run_to_completion(max_steps=1_000_000)
+            bytes0 = router.fleet_snapshot()["transport"]["bytes"]
+            hist0 = (default_registry().snapshot().get(
+                "paddle_tpu_disagg_handoff_seconds") or {})
+            t0 = time.perf_counter()
+            for p in prompts:
+                router.submit(p, max_new_tokens=new)
+            done = router.run_to_completion(max_steps=1_000_000)
+            dt = time.perf_counter() - t0
+            hist = (default_registry().snapshot().get(
+                "paddle_tpu_disagg_handoff_seconds") or {})
+            shipped = ((hist.get("count") or 0)
+                       - (hist0.get("count") or 0))
+            ship_s = ((hist.get("sum") or 0.0)
+                      - (hist0.get("sum") or 0.0))
+            ok = [r for r in done if r.status == "ok"]
+            snap = router.fleet_snapshot()
+            return {
+                "requests": len(done), "ok": len(ok),
+                "handoffs_shipped": router.handoffs_shipped,
+                "handoff_ms_per_request": round(
+                    1000.0 * ship_s / max(shipped, 1), 3),
+                "wire_bytes": snap["transport"]["bytes"] - bytes0,
+                "tok_per_s": round(
+                    sum(len(r.generated) for r in ok) / dt, 1),
+            }
+        finally:
+            teardown(router)
+
+    def soak():
+        """Connection chaos under continuous load: drops + stalled
+        links + one agent kill; nothing silently dropped."""
+        router = FleetRouter(
+            [spec(lease=0.4, timeout=0.3, retries=2, seed=i)
+             for i in range(n_replicas)],
+            metrics_registry=default_registry(),
+            metrics_ring=default_ring())
+        try:
+            for p in warm_prompts:
+                router.submit(p, max_new_tokens=2)
+            router.run_to_completion(max_steps=1_000_000)
+            rss0 = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+            submitted, cancelled = 0, 0
+            done = []
+            t0 = time.perf_counter()
+            fp = faults.install()
+            try:
+                fp.inject("conn_drop",
+                          ConnectionResetError("soak drop"),
+                          every=17)
+                fp.inject("net_delay", p=0.03, seed=7)
+                fp.inject("agent_kill", RuntimeError("soak kill"),
+                          nth=9, times=1)
+                for w in range(soak_waves):
+                    rids = []
+                    for j in range(soak_per_wave):
+                        p = prompts[(w * soak_per_wave + j)
+                                    % len(prompts)]
+                        kw = {}
+                        if j % 4 == 3:
+                            kw["deadline_s"] = 30.0
+                        rids.append(router.submit(
+                            p, max_new_tokens=soak_new, **kw))
+                        submitted += 1
+                    if w % 2 == 1:
+                        router.cancel(rids[0])
+                        cancelled += 1
+                    for _ in range(4):
+                        router.step()
+                    done.extend(router.finished())
+                done.extend(
+                    router.run_to_completion(max_steps=1_000_000))
+            finally:
+                faults.uninstall()
+            wall = time.perf_counter() - t0
+            for h in router._replicas:
+                if h.state in ("READY", "DEGRADED"):
+                    h.engine.cache.audit()
+            rss1 = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+            ok = [r for r in done if r.status == "ok"]
+            snap = router.fleet_snapshot()
+            return {
+                "submitted": submitted, "finished": len(done),
+                "silent_drops": submitted - len(done),
+                "ok": len(ok), "cancelled_req": cancelled,
+                "statuses": {s: sum(1 for r in done
+                                    if r.status == s)
+                             for s in {r.status for r in done}},
+                "wall_s": round(wall, 2),
+                "tok_per_s": round(
+                    sum(len(r.generated) for r in ok) / wall, 1),
+                "rss_growth_mb": round((rss1 - rss0) / 1024.0, 1),
+                "deaths": snap["deaths"],
+                "replaces": snap["replaces"],
+                "transport": snap["transport"],
+                "audit_ok": True,
+            }
+        finally:
+            teardown(router)
+
+    inproc = run(remote=False)
+    sockets = run(remote=True)
+    inproc_deaths = run(remote=False, death_k=death_every)
+    socket_deaths = run(remote=True, death_k=remote_death_every)
+    handoff = wire_handoff()
+    soaked = soak()
+    return {
+        "metric": metric,
+        "value": round(sockets["tok_per_s"]
+                       / max(inproc["tok_per_s"], 1e-9), 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {
+            "platform": platform, "replicas": n_replicas,
+            "requests": n_requests,
+            "death_every_k_replica_steps": death_every,
+            "agent_kill_every_k_sync_ticks": remote_death_every,
+            "in_process": inproc, "sockets": sockets,
+            "in_process_deaths": inproc_deaths,
+            "socket_deaths": socket_deaths,
+            "recovered_in_process":
+                f"{inproc_deaths['recovered']}"
+                f"/{inproc_deaths['requests']}",
+            "recovered_sockets":
+                f"{socket_deaths['recovered']}"
+                f"/{socket_deaths['requests']}",
+            "wire_handoff": handoff,
+            "soak": soaked},
+    }
+
+
 def _ab_pct(xs, q):
     """Percentile over a small sample (shared by the serving A/B
     lines so their reported quantiles are computed identically)."""
@@ -1881,6 +2208,20 @@ def _snapshot_line() -> dict:
                       "disagg_colocated_fallback_total": _cval(
                           "paddle_tpu_disagg_colocated_fallback"
                           "_total"),
+                      # sockets transport (the serving_remote_ab
+                      # line's socket-fleet arms publish
+                      # process-wide)
+                      "transport_reconnects_total": _cval(
+                          "paddle_tpu_transport_reconnects_total"),
+                      "transport_retries_total": _cval(
+                          "paddle_tpu_transport_retries_total"),
+                      "transport_heartbeat_misses_total": _cval(
+                          "paddle_tpu_transport_heartbeat_misses"
+                          "_total"),
+                      "transport_frames_total": _cval(
+                          "paddle_tpu_transport_frames_total"),
+                      "transport_bytes_total": _cval(
+                          "paddle_tpu_transport_bytes_total"),
                       # tail-sampled trace store: retention counters
                       # + the retained trace ids (drill into any of
                       # them with tools/metrics_dump.py trace)
@@ -1914,6 +2255,7 @@ def main() -> None:
         ("serving_disagg_ab", "x", _disagg_line),
         ("serving_mixed_ab", "x", _serving_mixed_line),
         ("serving_trace_overhead", "ratio", _trace_overhead_line),
+        ("serving_remote_ab", "x", _remote_line),
     ]
 
     devs, err = _init_devices()

@@ -357,6 +357,55 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
         note="public API takes _lock; engine-summing compatibility "
              "properties read only host ints the serving front "
              "already serializes behind its own lock"),
+    # sockets transport (ISSUE 14): the router thread drives RPCs
+    # while HTTP handler threads cancel through the same connection —
+    # the socket, seq counter and lease clock serialize on the
+    # connection lock.  Lock order: the router lock may wrap a
+    # connection lock (placement/sync under FleetRouter._lock); a
+    # connection never takes a router/server lock, so no ABBA
+    # pairing exists.
+    "fleet.transport.Connection": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_sock", "_seq", "_closed", "_dialed",
+                         "last_ok", "reconnects", "retries",
+                         "heartbeat_misses", "frames", "bytes_sent",
+                         "bytes_recv"}),
+        locked_methods=frozenset({"_call_once_locked",
+                                  "_ensure_locked", "_drop_locked",
+                                  "_send_truncated_locked"}),
+        exempt_methods=frozenset({"lease_age", "lease_expired"}),
+        note="call()/close()/lease_expire() take _lock; lease_age/"
+             "lease_expired read one monotonic float (atomic under "
+             "the GIL) so the router's death triage never blocks on "
+             "an RPC in flight"),
+    # replica agent (server side of the transport): RPC handler
+    # threads and the drive thread serialize every engine touch on
+    # the agent lock — the GenerationServer discipline, one process
+    # over
+    "fleet.remote.ReplicaAgent": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_by_key", "_key_order", "_trace_ids",
+                         "_mut", "_ho_seq", "_ho_last"}),
+        proxies=frozenset({"_sup"}),
+        locked_methods=frozenset({"_harvest_locked",
+                                  "_remember_key_locked",
+                                  "_snapshot_locked", "_rpc_hello",
+                                  "_rpc_ping", "_rpc_submit",
+                                  "_rpc_cancel",
+                                  "_rpc_audit", "_rpc_drain",
+                                  "_rpc_resume", "_rpc_shutdown",
+                                  "_rpc_take_handoffs",
+                                  "_rpc_admit_handoff",
+                                  "_rpc_admit_degraded"}),
+        exempt_methods=frozenset({"start", "stop", "die", "join"}),
+        note="_dispatch takes _lock around every engine-touching op; "
+             "the drive loop steps + harvests under the same lock, "
+             "then PUBLISHES events/snapshot under the subordinate "
+             "_buf_lock (strict order _lock > _buf_lock), which is "
+             "all the sync heartbeat ever takes — a first-compile "
+             "step can hold _lock for seconds and must not expire a "
+             "healthy lease; lifecycle flags (_stop/_closing/_fatal) "
+             "are single-writer booleans read monotonically"),
     # fleet HTTP front: same discipline as GenerationServer (it IS
     # GenerationServer's plumbing over the router)
     "fleet.server.FleetServer": SharedStateSpec(
@@ -479,6 +528,19 @@ CLAIMS: Dict[str, ClaimSpec] = {
         value_bearing=True,
         leak="an accepted request no routing table maps: tokens "
              "generated for nobody, failover/cancel blind to it"),
+    # a live client connection to a remote replica agent: opened at
+    # handle spawn/replace, it must reach close() (normal teardown)
+    # or lease_expire() (the death edge) on every path — including
+    # the hello-failed unwind, where an unreleased socket would pin
+    # an FD per failed replace retry forever.
+    "connection-lease": ClaimSpec(
+        kind="connection-lease",
+        acquires=frozenset({"open_connection"}),
+        releases=frozenset({"close", "lease_expire"}),
+        value_bearing=True,
+        leak="a leaked socket FD + a peer that still believes a "
+             "client holds its lease (handle replace-retry loops "
+             "would exhaust FDs)"),
     # -- registry-scope kinds (runtime-audited, documented here) ------
     "prefix-ref": ClaimSpec(
         kind="prefix-ref",

@@ -29,10 +29,24 @@ ship runs through a swappable ``handoff_transport`` seam — the
 in-process default pins the semantics; a sockets transport drops in
 for multi-host fleets.
 
+With a :class:`RemoteSpec` in place of an engine factory, a replica
+lives behind a REAL TCP socket (its own thread, OS process, or host):
+:class:`ReplicaAgent` hosts one supervisor-wrapped engine and speaks
+the length-prefixed frame protocol of :mod:`.transport` (JSON control
+headers, zero-copy numpy KV blobs), and :class:`RemoteReplicaHandle`
+drops into the router beside the in-process handles — same lifecycle
+states, same ``handoff_transport`` seam, same failover semantics.
+Liveness is heartbeat + lease based (a missed lease degrades, an
+expired lease is a death that rides the existing failover path),
+RPCs retry with exponential backoff + jitter, and submission is
+idempotent (keyed on the fleet rid) so an ambiguous timeout can
+never double-generate.  docs/TRANSPORT.md has the wire contract.
+
 Every degradation path is driven by the deterministic fault plane
 (``paddle_tpu/testing/faults.py`` sites ``route_dispatch`` /
-``replica_death`` / ``replica_slow`` / ``kv_handoff``) — chaos runs
-are reproducible tests, not hopes.  Failure semantics:
+``replica_death`` / ``replica_slow`` / ``kv_handoff``, plus the
+transport's ``conn_drop`` / ``frame_truncate`` / ``net_delay`` /
+``agent_kill``) — chaos runs are reproducible tests, not hopes.  Failure semantics:
 docs/FAULT_TOLERANCE.md "Fleet failure-mode matrix" + "Disaggregated
 prefill/decode failure-mode matrix"; metric catalogue:
 docs/OBSERVABILITY.md.
@@ -41,6 +55,14 @@ docs/OBSERVABILITY.md.
 from .router import (FleetRouter, ReplicaHandle,       # noqa: F401
                      REPLICA_STATES)
 from .server import FleetServer                        # noqa: F401
+from .remote import (RemoteReplicaHandle, RemoteSpec,  # noqa: F401
+                     ReplicaAgent, spawn_agent_process)
+from .transport import (Connection, LeaseExpiredError,  # noqa: F401
+                        ProtocolError, TransportError,
+                        open_connection)
 
 __all__ = ["FleetRouter", "ReplicaHandle", "FleetServer",
-           "REPLICA_STATES"]
+           "REPLICA_STATES", "RemoteSpec", "RemoteReplicaHandle",
+           "ReplicaAgent", "spawn_agent_process", "Connection",
+           "open_connection", "TransportError", "ProtocolError",
+           "LeaseExpiredError"]

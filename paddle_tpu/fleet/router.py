@@ -75,6 +75,8 @@ class ReplicaHandle:
     rid map.  All access runs under the router's lock — the handle
     itself carries no synchronization."""
 
+    remote = False      # RemoteReplicaHandle (fleet/remote.py) = True
+
     def __init__(self, idx: int, factory: Callable, *,
                  max_restarts: int = 3, window_s: float = 60.0,
                  backoff_s: float = 0.0, role: str = "unified"):
@@ -248,11 +250,41 @@ class FleetRouter:
         self.tracer = tracer
         self.prefix_routing = bool(prefix_routing)
         self.auto_replace = bool(auto_replace)
-        self._replicas: List[ReplicaHandle] = [
-            ReplicaHandle(i, f, max_restarts=max_restarts,
-                          window_s=restart_window_s,
-                          backoff_s=restart_backoff_s, role=role)
-            for i, (f, role) in enumerate(zip(factories, roles))]
+        # a factories entry may be a fleet.remote.RemoteSpec instead
+        # of an engine factory: that replica lives behind a socket
+        # (its own thread, process or host) and is driven through a
+        # RemoteReplicaHandle — same lifecycle states, same routing,
+        # same failover semantics as the in-process handles
+        self._replicas: List[ReplicaHandle] = []
+        try:
+            for i, (f, role) in enumerate(zip(factories, roles)):
+                if getattr(f, "is_remote_spec", False):
+                    from .remote import RemoteReplicaHandle
+                    self._replicas.append(
+                        RemoteReplicaHandle(i, f, role=role))
+                else:
+                    self._replicas.append(
+                        ReplicaHandle(i, f,
+                                      max_restarts=max_restarts,
+                                      window_s=restart_window_s,
+                                      backoff_s=restart_backoff_s,
+                                      role=role))
+        except BaseException:
+            # a failed replica construction must not leak the agent
+            # processes/threads the earlier remote handles already
+            # started (each holds a port + an OS process or threads)
+            for h in self._replicas:
+                if getattr(h, "remote", False):
+                    try:
+                        h.kill("fleet construction failed")
+                    except Exception:
+                        pass
+            raise
+        self._has_remote = any(h.remote for h in self._replicas)
+        if self._has_remote:
+            for h in self._replicas:
+                if h.remote:
+                    roles[h.idx] = h.role   # agent hello wins
         self._has_prefill_lane = "prefill" in roles
         for h in self._replicas:
             eng = h.engine
@@ -327,6 +359,18 @@ class FleetRouter:
                 self.metrics.registry, ring=self.metrics.ring)
         else:
             self.disagg_metrics = None
+        # sockets-transport instruments (reconnects/retries/lease
+        # misses/wire volume): only built when a remote replica
+        # exists, so in-process fleets keep their exposition unchanged
+        if self._has_remote and self.metrics is not None:
+            from ..observability import TransportMetrics
+            self.transport_metrics = TransportMetrics(
+                self.metrics.registry, ring=self.metrics.ring)
+            for h in self._replicas:
+                if h.remote:
+                    h.set_transport_metrics(self.transport_metrics)
+        else:
+            self.transport_metrics = None
         self._update_gauges_locked()
 
     # -- client side ------------------------------------------------------
@@ -615,10 +659,16 @@ class FleetRouter:
                 continue
             try:
                 faults.fire("route_dispatch")
+                extra = {}
+                if h.remote:
+                    # idempotency key for the wire: a retried submit
+                    # after an ambiguous timeout dedups on the agent
+                    # by (client id, fleet rid)
+                    extra["fleet_rid"] = freq.rid
                 local = h.supervisor.submit(
                     freq.prompt, max_new_tokens=freq.max_new_tokens,
                     stop_sequences=freq.stop_sequences,
-                    deadline_s=deadline_s, trace=freq.trace)
+                    deadline_s=deadline_s, trace=freq.trace, **extra)
             except ValueError:
                 # the request itself is malformed/oversized — every
                 # replica would refuse identically; the client's fault
@@ -774,6 +824,17 @@ class FleetRouter:
                     # submission, not the re-placement
                     req.t_submit = freq.t_submit
                     if freq.trace is not None:
+                        if h.remote:
+                            # the agent accrued the phase clocks with
+                            # no tracer attached (the TraceContext is
+                            # not a wire object — only its id rode
+                            # the control header), so the phase spans
+                            # materialize HERE, clock-re-anchored
+                            try:
+                                freq.trace.report_request(
+                                    req, replica=h.idx, remote=True)
+                            except Exception:
+                                pass
                         try:
                             freq.trace.close(
                                 status=req.status, error=req.error,
@@ -1117,6 +1178,8 @@ class FleetRouter:
                 "drains": h.drains, "slow_ticks": h.slow_ticks,
                 "error": h.error,
             })
+            if h.remote:
+                reps[-1]["transport"] = h.transport_snapshot()
         doc = {"replicas": reps,
                "states": self._states_locked(),
                "roles": self._roles_locked(),
@@ -1137,6 +1200,19 @@ class FleetRouter:
                 "handoffs_inflight":
                     self._inflight_handoffs_locked(),
                 "colocated_fallbacks": self.colocated_fallbacks}
+        if self._has_remote:
+            agg = {"reconnects": 0, "retries": 0,
+                   "heartbeat_misses": 0, "frames": 0, "bytes": 0}
+            for h in self._replicas:
+                c = getattr(h, "conn", None)
+                if not h.remote or c is None:
+                    continue
+                agg["reconnects"] += c.reconnects
+                agg["retries"] += c.retries
+                agg["heartbeat_misses"] += c.heartbeat_misses
+                agg["frames"] += c.frames
+                agg["bytes"] += c.bytes_sent + c.bytes_recv
+            doc["transport"] = agg
         return doc
 
     def _roles_locked(self) -> dict:

@@ -1,0 +1,444 @@
+"""TCP transport for multi-process fleets: framing, RPC, leases.
+
+PR 8's router and PR 9's KV handoff pinned the fleet SEMANTICS with
+every replica in one process; this module is the wire those semantics
+ride when replicas live in separate processes (or hosts).  Design
+goals, in order: no silent drops, deterministic chaos, zero-copy KV
+blobs.
+
+Frame format (little-endian, one frame per RPC message)::
+
+    magic   4s   b"PTF1"
+    hlen    u32  JSON header length in bytes
+    nblobs  u32  number of binary payloads
+    blen[]  u64 * nblobs
+    header  hlen bytes of UTF-8 JSON (the control header)
+    blobs   concatenated raw payloads
+
+The header is the CONTROL side (op, seq, rids, trace id, error
+envelope); blobs are the DATA side — numpy KV pools and int8 scale
+planes ship as their raw C-contiguous buffers via :func:`pack_array`
+/ :func:`unpack_array`, so a handoff round-trips the wire bitwise
+with no base64/pickle detour.
+
+:class:`Connection` is the client half (the router side):
+
+* **deadline-aware timeouts** — every RPC carries a per-attempt
+  socket timeout and an optional absolute deadline; past either, the
+  attempt fails instead of hanging on a stalled peer;
+* **retry with exponential backoff + jitter** — only for
+  ``idempotent=True`` ops (sync/ping carry a cursor, submit carries
+  an idempotency key, so a retried frame can never double-apply); a
+  non-idempotent op surfaces the ambiguity to the caller;
+* **bounded reconnect** — a lost connection re-dials at most
+  ``max_retries`` times per call; the lease clock (`last_ok`) only
+  advances on a successful round-trip, so a peer that stops
+  answering expires its lease (:meth:`lease_expired`) and the fleet
+  treats it as dead (:class:`LeaseExpiredError` from the handle);
+* **fault sites** — ``conn_drop`` (connection resets mid-RPC),
+  ``frame_truncate`` (a partial frame hits the peer, which must
+  recover), ``net_delay`` (a stalled link trips the RPC timeout) are
+  consulted per frame, so every degradation is a seeded, replayable
+  test (``paddle_tpu/testing/faults.py``).
+
+Thread safety: ``call()``/``close()`` serialize on ``_lock``
+(registered in analysis/annotations.py SHARED_STATE) — the fleet
+router drives a connection from its own lock, but cancel-from-a-
+handler-thread must not interleave frames with a sync in flight.
+
+Wire compatibility is versioned by the magic; a mismatched peer fails
+the handshake loudly.  Everything here is stdlib + numpy.  See
+docs/TRANSPORT.md for the full protocol contract.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.serving_engine import QueueFullError
+from ..testing import faults
+
+__all__ = ["MAGIC", "TransportError", "ProtocolError", "RpcTimeout",
+           "LeaseExpiredError", "RemoteCallError", "send_frame",
+           "recv_frame", "pack_array", "unpack_array", "Connection",
+           "open_connection"]
+
+MAGIC = b"PTF1"
+_PRE = struct.Struct("<4sII")          # magic, header len, nblobs
+_BLEN = struct.Struct("<Q")
+
+# how long an armed ``net_delay`` condition stalls one frame —
+# comfortably above the aggressive RPC timeouts chaos tests run with,
+# comfortably below anything that would slow the suite
+NET_DELAY_S = 0.05
+
+# blobs above this many bytes are sent as separate buffers
+# (zero-copy path); smaller ones coalesce into one send
+_COALESCE_MAX = 1 << 16
+
+
+class TransportError(RuntimeError):
+    """Connection-level failure (reset, refused, injected drop): the
+    op may or may not have reached the peer — AMBIGUOUS unless the op
+    is idempotent."""
+
+
+class ProtocolError(TransportError):
+    """The peer sent bytes that are not a valid frame (bad magic,
+    truncated payload, oversized header): drop the connection."""
+
+
+class RpcTimeout(TransportError):
+    """The peer did not answer within the deadline: ambiguous like
+    any transport failure."""
+
+
+class LeaseExpiredError(TransportError):
+    """No successful round-trip for longer than the lease: the peer
+    is DEAD from the fleet's point of view (raised by the replica
+    handle, triaged by the router's existing death path)."""
+
+
+class RemoteCallError(RuntimeError):
+    """The peer executed the op and reported an application error it
+    could not map to a canonical type (the canonical ones —
+    ``QueueFullError``, ``ValueError``, ``RuntimeError`` — re-raise
+    as themselves)."""
+
+
+def pack_array(a: Optional[np.ndarray]) -> Tuple[dict, bytes]:
+    """``(meta, buffer)`` for one optional ndarray: the raw
+    C-contiguous bytes plus the dtype/shape needed to rebuild it
+    bitwise.  ``None`` (an fp pool's absent scale plane) packs as an
+    empty buffer with ``{"none": true}``."""
+    if a is None:
+        return {"none": True}, b""
+    a = np.ascontiguousarray(a)
+    return ({"dtype": a.dtype.str, "shape": list(a.shape)},
+            a.data if a.flags["C_CONTIGUOUS"] else a.tobytes())
+
+
+def unpack_array(meta: dict, buf) -> Optional[np.ndarray]:
+    """Inverse of :func:`pack_array`; the array COPIES out of the
+    receive buffer (the buffer is reused per frame)."""
+    if meta.get("none"):
+        return None
+    a = np.frombuffer(buf, dtype=np.dtype(meta["dtype"]))
+    return a.reshape(meta["shape"]).copy()
+
+
+def send_frame(sock: socket.socket, header: dict,
+               blobs: Sequence = ()) -> int:
+    """Serialize + send one frame; returns bytes written.  Raises
+    :class:`TransportError` on a failed send."""
+    hbytes = json.dumps(header).encode()
+    # normalize to BYTE views: a typed memoryview (an int64 array's
+    # .data) answers len() in ELEMENTS, which would corrupt the frame
+    blobs = [memoryview(b).cast("B") if not isinstance(b, bytes)
+             else b for b in blobs]
+    pre = _PRE.pack(MAGIC, len(hbytes), len(blobs))
+    lens = b"".join(_BLEN.pack(len(b)) for b in blobs)
+    head = pre + lens + hbytes
+    total = len(head) + sum(len(b) for b in blobs)
+    try:
+        # small blobs coalesce with the head into one send (one
+        # syscall, one TCP segment under NODELAY); big ones flush
+        # whatever is pending and go out zero-copy on their own
+        pend = [head]
+        for b in blobs:
+            if len(b) > _COALESCE_MAX:
+                if pend:
+                    sock.sendall(b"".join(pend))
+                    pend = []
+                sock.sendall(b)            # zero-copy: no join
+            elif len(b):
+                pend.append(bytes(b))
+        if pend:
+            sock.sendall(b"".join(pend))
+    except OSError as e:
+        raise TransportError(f"send failed: {e}") from e
+    return total
+
+
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            k = sock.recv_into(view[got:], n - got)
+        except OSError as e:
+            raise TransportError(f"recv failed: {e}") from e
+        if k == 0:
+            raise ProtocolError(
+                f"connection closed mid-frame ({got}/{n} bytes)")
+        got += k
+    return view
+
+
+def recv_frame(sock: socket.socket,
+               max_header: int = 1 << 24) -> Tuple[dict, list, int]:
+    """Receive one frame → ``(header, blobs, bytes_read)``.  Bad
+    magic / truncation raise :class:`ProtocolError` — the caller
+    drops the connection (never guesses at a resync point)."""
+    pre = _recv_exact(sock, _PRE.size)
+    magic, hlen, nblobs = _PRE.unpack(pre)
+    if magic != MAGIC:
+        raise ProtocolError(
+            f"bad frame magic {bytes(magic)!r} (wire-protocol "
+            f"mismatch or stream corruption)")
+    if hlen > max_header or nblobs > 4096:
+        raise ProtocolError(
+            f"unreasonable frame: header {hlen} bytes, "
+            f"{nblobs} blobs")
+    lens = [_BLEN.unpack(_recv_exact(sock, _BLEN.size))[0]
+            for _ in range(nblobs)]
+    total = _PRE.size + _BLEN.size * nblobs + hlen + sum(lens)
+    try:
+        header = json.loads(bytes(_recv_exact(sock, hlen)))
+    except ValueError as e:
+        raise ProtocolError(f"undecodable frame header: {e}") from e
+    blobs = [_recv_exact(sock, n) for n in lens]
+    return header, blobs, total
+
+
+# application errors the agent maps to canonical exception types so
+# the router's routing/backpressure semantics survive the wire
+_ETYPES = {"QueueFullError": None,     # rebuilt with retry_after below
+           "ValueError": ValueError,
+           "RuntimeError": RuntimeError}
+
+
+def raise_remote(header: dict) -> None:
+    """Re-raise the error envelope of a response header (no-op for
+    ok responses)."""
+    if header.get("ok", True):
+        return
+    etype = header.get("etype", "")
+    msg = header.get("error", "remote error")
+    if etype == "QueueFullError":
+        raise QueueFullError(msg,
+                             retry_after=header.get("retry_after", 1.0))
+    exc = _ETYPES.get(etype)
+    if exc is not None:
+        raise exc(msg)
+    raise RemoteCallError(f"{etype}: {msg}")
+
+
+class Connection:
+    """One client connection to a :class:`~paddle_tpu.fleet.remote.
+    ReplicaAgent`, with retries, reconnect and lease accounting.
+
+    Built through :func:`open_connection` (the ``connection-lease``
+    claim's acquire site): every path that opens one must
+    :meth:`close` it or hand it to an owner that will — including
+    the exception edges, which the claim-lifecycle rule now checks
+    over the CFG."""
+
+    def __init__(self, addr: Tuple[str, int], *,
+                 timeout_s: float = 5.0, lease_s: float = 2.0,
+                 max_retries: int = 3, backoff_s: float = 0.01,
+                 jitter_seed: int = 0, metrics=None):
+        self.addr = tuple(addr)
+        self.timeout_s = float(timeout_s)
+        self.lease_s = float(lease_s)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._closed = False
+        self._dialed = False           # first dial is not a reconnect
+        # lease clock: monotonic instant of the last SUCCESSFUL
+        # round-trip (never advanced by a send that got no answer)
+        self.last_ok = time.monotonic()
+        self.reconnects = 0
+        self.retries = 0
+        self.heartbeat_misses = 0
+        self.frames = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        # jitter stream is PRIVATE and seeded: a chaos schedule
+        # replays the same backoff sequence run to run
+        self._rng = random.Random(jitter_seed)
+
+    # -- lease ------------------------------------------------------------
+    def lease_age(self) -> float:
+        return time.monotonic() - self.last_ok
+
+    def lease_expired(self) -> bool:
+        """True once no RPC has succeeded for a full lease term.
+        Callers must only consult this after a FAILED attempt — an
+        idle-but-healthy peer is not expired, it is unpolled (the
+        replica handle heartbeats on the fleet tick cadence)."""
+        return self.lease_age() > self.lease_s
+
+    def lease_expire(self) -> None:
+        """Terminal release for an expired lease: drop the socket
+        and mark the connection closed (the ``connection-lease``
+        claim's abnormal release edge; :meth:`close` is the normal
+        one)."""
+        with self._lock:
+            self._drop_locked()
+            self._closed = True
+
+    # -- rpc --------------------------------------------------------------
+    def call(self, op: str, header: Optional[dict] = None,
+             blobs: Sequence = (), *, idempotent: bool = False,
+             timeout: Optional[float] = None,
+             deadline: Optional[float] = None) -> Tuple[dict, list]:
+        """One request/response RPC.  ``idempotent=True`` ops retry
+        through reconnects with exponential backoff + seeded jitter;
+        non-idempotent ops raise on the FIRST transport failure —
+        the outcome is ambiguous and only the caller knows whether a
+        replay is safe (submit makes itself idempotent with a key
+        instead).  ``deadline`` (absolute monotonic) caps the whole
+        call including backoff sleeps."""
+        req = dict(header or ())
+        attempts = (self.max_retries + 1) if idempotent else 1
+        last: Optional[Exception] = None
+        with self._lock:
+            if self._closed:
+                raise TransportError(
+                    f"connection to {self.addr} closed")
+            req["op"] = op
+            self._seq += 1
+            req["seq"] = self._seq
+            for attempt in range(attempts):
+                if attempt:
+                    self.retries += 1
+                    if self.metrics is not None:
+                        self.metrics.retries.inc()
+                    pause = (self.backoff_s * (2 ** (attempt - 1))
+                             * (1.0 + self._rng.random()))
+                    if deadline is not None and \
+                            time.monotonic() + pause >= deadline:
+                        break
+                    time.sleep(pause)
+                try:
+                    return self._call_once_locked(req, blobs, timeout,
+                                                  deadline)
+                except (TransportError, OSError,
+                        socket.timeout) as e:
+                    last = e
+                    self.heartbeat_misses += 1
+                    if self.metrics is not None:
+                        self.metrics.heartbeat_misses.inc()
+                    self._drop_locked()
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        break
+        if isinstance(last, socket.timeout):
+            raise RpcTimeout(
+                f"{op} to {self.addr} timed out after "
+                f"{attempts} attempt(s)") from last
+        raise TransportError(
+            f"{op} to {self.addr} failed after {attempts} "
+            f"attempt(s): {type(last).__name__}: {last}") from last
+
+    # -- locked internals (CONTRACT: caller holds _lock; registered
+    #    in analysis/annotations.py locked_methods) -----------------------
+    def _call_once_locked(self, req: dict, blobs,
+                          timeout: Optional[float],
+                          deadline: Optional[float]) -> Tuple[dict, list]:
+        sock = self._ensure_locked()
+        per = self.timeout_s if timeout is None else float(timeout)
+        if deadline is not None:
+            per = min(per, max(deadline - time.monotonic(), 1e-3))
+        sock.settimeout(per)
+        t0 = time.perf_counter()
+        if faults.active("net_delay"):
+            # a stalled link: the stall consumes the attempt's
+            # timeout budget, so an RPC timeout tighter than
+            # NET_DELAY_S trips DETERMINISTICALLY (a generous one
+            # just runs late) — seeded, replayable
+            time.sleep(min(NET_DELAY_S, per))
+            if per <= NET_DELAY_S:
+                raise socket.timeout(
+                    f"injected net_delay: link stalled past the "
+                    f"{per:.3f}s attempt timeout")
+        try:
+            faults.fire("conn_drop")
+        except Exception as e:
+            self._drop_locked()
+            raise TransportError(f"injected conn_drop: {e}") from e
+        if faults.active("frame_truncate"):
+            # ship a deliberately cut frame so the PEER exercises its
+            # ProtocolError path, then drop our side
+            self._send_truncated_locked(sock, req, blobs)
+            raise TransportError("injected frame_truncate")
+        n = send_frame(sock, req, blobs)
+        self.bytes_sent += n
+        resp, rblobs, m = recv_frame(sock)
+        self.bytes_recv += m
+        self.frames += 1
+        if resp.get("seq") != req["seq"]:
+            raise ProtocolError(
+                f"response seq {resp.get('seq')} != request seq "
+                f"{req['seq']} (desynchronized stream)")
+        self.last_ok = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.frames.inc()
+            self.metrics.bytes.inc(n + m)
+            self.metrics.rtt_seconds.observe(time.perf_counter() - t0)
+        raise_remote(resp)
+        return resp, rblobs
+
+    def _send_truncated_locked(self, sock, req: dict, blobs) -> None:
+        hbytes = json.dumps(req).encode()
+        pre = _PRE.pack(MAGIC, len(hbytes), len(blobs))
+        lens = b"".join(_BLEN.pack(len(b)) for b in blobs)
+        frame = pre + lens + hbytes
+        try:
+            sock.sendall(frame[:max(len(frame) // 2, 1)])
+        except OSError:
+            pass
+        self._drop_locked()
+
+    def _ensure_locked(self) -> socket.socket:
+        if self._sock is None:
+            try:
+                self._sock = socket.create_connection(
+                    self.addr, timeout=self.timeout_s)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+            except OSError as e:
+                raise TransportError(
+                    f"connect to {self.addr} failed: {e}") from e
+            if self._dialed:
+                self.reconnects += 1
+                if self.metrics is not None:
+                    self.metrics.reconnects.inc()
+            self._dialed = True
+        return self._sock
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_locked()
+            self._closed = True
+
+
+def open_connection(addr: Tuple[str, int], **kw) -> Connection:
+    """Acquire a client connection (the ``connection-lease`` claim's
+    acquire site — see analysis/annotations.py CLAIMS): the returned
+    object must reach :meth:`Connection.close` /
+    :meth:`Connection.lease_expire` (or an owning attribute) on
+    every path, exception edges included."""
+    return Connection(addr, **kw)

@@ -32,6 +32,7 @@ from .engine_metrics import (EngineMetrics,            # noqa: F401
                              bind_engine_gauges)
 from .fleet_metrics import FleetMetrics                # noqa: F401
 from .disagg_metrics import DisaggMetrics              # noqa: F401
+from .transport_metrics import TransportMetrics        # noqa: F401
 from .tracing import (PHASES, TraceContext, Tracer,    # noqa: F401
                       TraceStore, advance_phase, default_tracer,
                       finalize_request_trace, phase_clocks)
@@ -39,6 +40,7 @@ from .tracing import (PHASES, TraceContext, Tracer,    # noqa: F401
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "EventRing", "default_ring",
            "EngineMetrics", "bind_engine_gauges", "FleetMetrics",
-           "DisaggMetrics", "PHASES", "TraceContext", "Tracer",
+           "DisaggMetrics", "TransportMetrics", "PHASES",
+           "TraceContext", "Tracer",
            "TraceStore", "advance_phase", "default_tracer",
            "finalize_request_trace", "phase_clocks"]

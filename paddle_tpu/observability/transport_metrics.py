@@ -1,0 +1,59 @@
+"""Instrument bundle for the fleet's sockets transport tier.
+
+One :class:`TransportMetrics` per fleet router that owns remote
+replica connections (``paddle_tpu/fleet/transport.py``): connection
+churn, retry pressure, lease health, and wire volume, created against
+the SAME registry the replicas/router publish to so ``GET /metrics``
+on a :class:`~paddle_tpu.fleet.FleetServer` stays the one aggregated
+exposition.  Catalogued in docs/OBSERVABILITY.md ("Sockets
+transport"); the naming lint in tests/test_observability.py covers
+every name here.
+
+Counters are incremented from inside :class:`~paddle_tpu.fleet.
+transport.Connection` under its own lock (never from scrape-thread
+closures — the same no-scrape-closures rule the fleet gauges follow).
+"""
+
+from __future__ import annotations
+
+from .events import EventRing
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["TransportMetrics"]
+
+
+class TransportMetrics:
+    """All instruments the sockets transport records into."""
+
+    def __init__(self, registry: MetricsRegistry = None, ring=None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        self.ring = ring if ring is not None else EventRing()
+
+        self.reconnects = r.counter(
+            "paddle_tpu_transport_reconnects_total",
+            "Re-dials of a replica agent connection after a drop "
+            "(the first dial of a fresh connection is not counted)")
+        self.retries = r.counter(
+            "paddle_tpu_transport_retries_total",
+            "Idempotent RPC attempts re-sent after a transport "
+            "failure (exponential backoff + seeded jitter between "
+            "attempts)")
+        self.heartbeat_misses = r.counter(
+            "paddle_tpu_transport_heartbeat_misses_total",
+            "RPC attempts that failed to complete a round-trip "
+            "(timeout, reset, injected fault) — each one ages the "
+            "replica's lease toward expiry")
+        self.frames = r.counter(
+            "paddle_tpu_transport_frames_total",
+            "Completed request/response frame round-trips")
+        self.bytes = r.counter(
+            "paddle_tpu_transport_bytes_total",
+            "Wire bytes moved (request + response frames, KV blob "
+            "payloads included)")
+        self.rtt_seconds = r.histogram(
+            "paddle_tpu_transport_rtt_seconds",
+            "Round-trip time of completed RPCs (send first byte to "
+            "response fully parsed)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))

@@ -46,6 +46,32 @@ site                   consulted by
                        this tick) and is marked DEGRADED so routing
                        steers around it; it recovers to READY when the
                        rule stops matching
+``conn_drop``          the sockets transport's client connection
+                       (``fleet/transport.py``), once per RPC frame —
+                       an exception rule resets the connection
+                       mid-call: idempotent ops reconnect and retry
+                       with backoff, others surface the ambiguity
+``frame_truncate``     the same per-frame seam, condition-style:
+                       while matched the client sends a deliberately
+                       CUT frame and drops — the agent exercises its
+                       ``ProtocolError`` recovery (drop that
+                       connection, keep serving) and the client
+                       retries over a fresh dial
+``net_delay``          the same per-frame seam, condition-style: a
+                       matched frame leaves ``NET_DELAY_S`` late, so
+                       deadline-aware RPC timeouts trip
+                       deterministically (stalled-link simulation)
+``agent_kill``         ``RemoteReplicaHandle``'s per-tick sync seam
+                       (``fleet/remote.py``): while matched the
+                       handle SIGKILLs its agent process (or tears
+                       down the in-thread agent) before syncing —
+                       the lease expires and the router's existing
+                       death/failover path takes over.  For faults
+                       INSIDE a remote agent process, arm the
+                       agent's own plane via ``fault_spec`` in its
+                       spawn config (this module is process-global —
+                       see docs/FAULT_TOLERANCE.md, "Remote-agent
+                       fault injection")
 ``kv_handoff``         the disaggregated prefill/decode handoff, TWO
                        halves per handoff: the SHIP half fires in
                        ``HandoffRecord.materialize`` (the staging

@@ -557,9 +557,11 @@ def test_metric_names_lint():
     reg = MetricsRegistry()
     EngineMetrics(reg)                        # engine + cache + spec
     from paddle_tpu.observability import (DisaggMetrics, FleetMetrics,
-                                          TraceStore)
+                                          TraceStore,
+                                          TransportMetrics)
     FleetMetrics(reg)                         # fleet router tier
     DisaggMetrics(reg)                        # disagg handoff tier
+    TransportMetrics(reg)                     # sockets transport tier
     TraceStore(metrics_registry=reg)          # tail-sampled traces
     mgr = W.CommTaskManager(scan_interval=60)
     mgr.bind_metrics(reg, EventRing())

@@ -648,3 +648,60 @@ def test_supervisor_restart_faults_close_traces(cfg, params):
     assert doc is not None and doc["status"] == "error"
     assert "decode_active" in [s["name"] for s in doc["spans"]]
     assert tr.index(status="live") == []
+
+
+def test_remote_replica_trace_rides_the_wire(cfg, params):
+    """THE remote pin (sockets transport, ISSUE 14): a request served
+    by a `RemoteReplicaHandle` still yields one span tree — ingress →
+    route → the REMOTE replica's phase spans (tagged remote=True, the
+    trace id rode the control header, the phase clocks came back in
+    the finished wire Request) → stream."""
+    from paddle_tpu.fleet import FleetServer, ReplicaAgent, RemoteSpec
+    from paddle_tpu.inference.serving import generate_http
+    tr = _keep_all_tracer()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            cfg, params, _cache(cfg), metrics_registry=False)
+
+    spec = RemoteSpec(
+        agent=lambda: ReplicaAgent(factory, lease_s=5.0))
+    router = FleetRouter([spec], tracer=tr, metrics_registry=False)
+    srv = FleetServer(router)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        toks = generate_http(url, [int(t) for t in _PROMPTS[0]],
+                             max_new_tokens=6)
+        assert len(toks) == 6
+        idx = json.loads(urllib.request.urlopen(
+            url + "/traces").read())["traces"]
+        assert idx and idx[0]["status"] == "ok"
+        rid = idx[0]["trace_id"]
+        doc = json.loads(urllib.request.urlopen(
+            url + f"/trace/{rid}").read())
+        names = [s["name"] for s in doc["spans"]]
+        for must in ("request", "http_ingress", "queued", "prefill",
+                     "decode_active", "stream"):
+            assert must in names, (must, names)
+        # the engine phases were accrued ON THE AGENT and reported
+        # at the fleet merge, tagged with the remote replica
+        remote_phases = [s for s in _phase_spans(doc)
+                         if s["attrs"].get("remote")]
+        assert {"queued", "prefill", "decode_active"} <= \
+            {s["name"] for s in remote_phases}
+        assert all(s["attrs"].get("replica") == 0
+                   for s in remote_phases)
+        # route decision recorded under the same trace
+        route = [s for s in doc["spans"] if s["name"] == "route"]
+        assert route and route[0]["attrs"]["reason"] in (
+            "least_loaded", "prefix")
+        # phase spans cover the request wall time (same discipline
+        # the in-process lanes pin): total phase duration ≈ root
+        covered = sum(s["dur_s"] for s in remote_phases)
+        assert covered <= doc["duration_ms"] / 1000.0 + 0.05
+    finally:
+        srv.stop()
+        for h in router._replicas:
+            if h._agent is not None:
+                h._agent.die()

@@ -1,0 +1,44 @@
+"""Engine factory for spawned :class:`ReplicaAgent` processes.
+
+``spawn_agent_process`` resolves its factory by import path — a
+closure over device arrays cannot cross a process boundary — so the
+real-SIGKILL transport tests point their ``RemoteSpec.spawn`` spec at
+``remote_agent_worker:make_engine`` (this file's directory rides into
+the child via the inherited ``sys.path``).  The config mirrors the
+tiny fleet-test model so reference outputs computed in the parent
+match the agent's engine token-exactly.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def make_engine(vocab=128, num_pages=64, batch=2, page=16,
+                kv_quant=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+
+    cfg = LlamaPretrainConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    cache_kw = dict(num_pages=num_pages, pages_max=8, batch=batch,
+                    page=page)
+    if kv_quant is not None:
+        cache_kw["kv_quant"] = kv_quant
+    cache = PagedKVCache(cfg, **cache_kw)
+    return ContinuousBatchingEngine(cfg, params, cache,
+                                    metrics_registry=False)

@@ -8,6 +8,7 @@ Usage:
   python tools/metrics_dump.py events  http://127.0.0.1:8000 [-n 50] [--follow]
   python tools/metrics_dump.py fleet   http://127.0.0.1:8000
   python tools/metrics_dump.py disagg  http://127.0.0.1:8000
+  python tools/metrics_dump.py transport http://127.0.0.1:8000
   python tools/metrics_dump.py traces  http://127.0.0.1:8000 [--min-ms N] [--status S]
   python tools/metrics_dump.py trace   http://127.0.0.1:8000 <rid>
   python tools/metrics_dump.py snapshot BENCH_r05.json
@@ -20,7 +21,11 @@ renders a FleetServer's aggregated ``GET /fleet`` snapshot (replica
 lifecycle states, per-replica load, routing/failover counters);
 ``disagg`` renders the disaggregated prefill/decode slice of
 ``GET /stats`` (handoff traffic, in-flight depth, routing decisions,
-fallbacks, handoff ms/request); ``traces`` lists the serving front's
+fallbacks, handoff ms/request); ``transport`` renders a socket
+fleet's wire health — per-replica connection mode/address, lease
+age, reconnect/retry/heartbeat-miss counters and wire volume from
+``GET /fleet``, plus the ``paddle_tpu_transport_*`` registry slice
+(RTT histogram included) from ``GET /stats``; ``traces`` lists the serving front's
 retained trace index (``GET /traces`` — tail-sampled: slow/abnormal
 traces always kept) and ``trace`` renders one request's span tree
 (``GET /trace/<rid>``) with its phase-clock latency breakdown;
@@ -272,6 +277,65 @@ def cmd_traces(args) -> int:
     return 0
 
 
+def _render_transport(fleet_doc: dict, snap: dict = None) -> str:
+    """A socket fleet's wire health: the aggregate counter line and
+    a per-replica connection table from ``/fleet``, then the
+    ``paddle_tpu_transport_*`` registry slice (RTT histogram) from
+    ``/stats`` when the server exposes one."""
+    agg = fleet_doc.get("transport")
+    if agg is None:
+        return ("no transport section in /fleet (in-process fleet? "
+                "remote replicas are RemoteSpec entries)")
+    lines = ["transport: " + "  ".join(
+        f"{k}={agg.get(k, 0)}"
+        for k in ("reconnects", "retries", "heartbeat_misses",
+                  "frames", "bytes"))]
+    cols = ("idx", "mode", "addr", "lease_s", "lease_age_s",
+            "reconnects", "retries", "heartbeat_misses", "frames",
+            "bytes_sent", "bytes_recv", "agent_pid")
+    rows = []
+    for r in fleet_doc.get("replicas", []):
+        t = r.get("transport")
+        if t is None:
+            continue
+        vals = dict(t, idx=r.get("idx"),
+                    addr=":".join(str(x) for x in t.get("addr", []))
+                    or "-")
+        rows.append([str(vals.get(c, "-")) for c in cols])
+    if rows:
+        widths = [max(len(c), *(len(row[i]) for row in rows))
+                  for i, c in enumerate(cols)]
+        lines.append("  ".join(c.ljust(w)
+                               for c, w in zip(cols, widths)))
+        for row in rows:
+            lines.append("  ".join(v.ljust(w)
+                                   for v, w in zip(row, widths)))
+    if snap:
+        tr = {n: m for n, m in snap.items()
+              if n.startswith("paddle_tpu_transport_")}
+        if tr:
+            lines.append(_render_snapshot(tr))
+            rtt = tr.get("paddle_tpu_transport_rtt_seconds") or {}
+            if rtt.get("count"):
+                lines.append(
+                    f"rtt ms/rpc = "
+                    f"{1000.0 * rtt['sum'] / rtt['count']:.3f}")
+    return "\n".join(lines)
+
+
+def cmd_transport(args) -> int:
+    base = args.url.rstrip("/")
+    fleet_doc = json.loads(_get(base + "/fleet"))
+    snap = None
+    try:
+        body = json.loads(_get(base + "/stats"))
+        snap = body.get("metrics", body)
+    except (urllib.error.URLError, ValueError):
+        pass                     # router-only fronts have no /stats
+    print(_render_transport(fleet_doc, snap))
+    return 0
+
+
 def cmd_snapshot(args) -> int:
     with open(args.path) as f:
         text = f.read()
@@ -320,7 +384,13 @@ def cmd_snapshot(args) -> int:
                 "disagg_colocated_fallback_total",
                 # tail-sampled trace store (the serving_trace_overhead
                 # bench line's tracer publishes process-wide)
-                "trace_retained_total", "trace_sampled_out_total")
+                "trace_retained_total", "trace_sampled_out_total",
+                # sockets transport (the serving_remote_ab bench
+                # line's socket-fleet arm publishes process-wide)
+                "transport_reconnects_total",
+                "transport_retries_total",
+                "transport_heartbeat_misses_total",
+                "transport_frames_total", "transport_bytes_total")
     derived = {}
     trace_ids = None
     for key in ("extra", "snapshot", "metrics"):
@@ -382,6 +452,11 @@ def main(argv=None) -> int:
                             "prefill/decode slice of GET /stats")
     s.add_argument("url")
     s.set_defaults(fn=cmd_disagg)
+    s = sub.add_parser("transport",
+                       help="pretty-print a socket fleet's wire "
+                            "health (GET /fleet + /stats)")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_transport)
     s = sub.add_parser("traces",
                        help="list the retained trace index "
                             "(GET /traces)")
