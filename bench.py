@@ -415,7 +415,18 @@ _SERVING_ENGINE = None      # keeps weakref-backed gauges readable
 _SERVING_SYNC_TPS = None    # sync tok/s, for the overlap A/B speedup
 
 
-def _serving_run(overlap: bool) -> dict:
+def _hb_sums():
+    """(host_bookkeeping.sum, decode_step.sum) from the process-wide
+    registry — deltas over a timed window give that window's
+    host_overhead_frac."""
+    from paddle_tpu.observability import default_registry
+    snap = default_registry().snapshot()
+    h = snap.get("paddle_tpu_engine_host_bookkeeping_seconds") or {}
+    d = snap.get("paddle_tpu_engine_decode_step_seconds") or {}
+    return h.get("sum", 0.0), d.get("sum", 0.0)
+
+
+def _serving_run(overlap: bool, decode_horizon: int = 1) -> dict:
     """Continuous-batching serving decode throughput — requests
     streamed through the paged-KV engine with observability ON (the
     engine publishes to the process-wide registry, so the final
@@ -423,7 +434,11 @@ def _serving_run(overlap: bool) -> dict:
     counters alongside this number).  Called twice for the
     sync-vs-overlap A/B: ``overlap=False`` is the blocking
     dispatch-per-token loop, ``overlap=True`` the dispatch-ahead
-    pipeline (same workload, fresh engine + cache)."""
+    pipeline (same workload, fresh engine + cache).
+    ``decode_horizon=H`` fuses H micro-steps per dispatch in either
+    lane; the reported ``host_overhead_frac`` (host bookkeeping
+    seconds / decode-step seconds over the timed window) is what the
+    horizon amortizes."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -467,7 +482,8 @@ def _serving_run(overlap: bool) -> dict:
                          pages_max=pages_max, batch=batch, page=page)
     eng = ContinuousBatchingEngine(
         cfg, params, cache, metrics_registry=default_registry(),
-        metrics_ring=default_ring(), overlap=overlap)
+        metrics_ring=default_ring(), overlap=overlap,
+        decode_horizon=decode_horizon)
     # pin the engine so the final metrics_snapshot line reads LIVE
     # gauge values (the scrape callbacks hold weakrefs and would read
     # 0 once the engine is collected)
@@ -490,12 +506,14 @@ def _serving_run(overlap: bool) -> dict:
     steps0, prefills0 = eng.decode_steps, eng.prefill_calls
     syncs0, flushes0 = eng.host_syncs, eng.pipeline_flushes
     preempt0 = eng.preemptions
+    hb0, dec0 = _hb_sums()
     t0 = time.perf_counter()
     for _ in range(n_req):
         eng.submit(rng.randint(1, cfg.vocab_size, (prompt_len,)),
                    max_new_tokens=new)
     done = eng.run_to_completion()
     dt = time.perf_counter() - t0
+    hb1, dec1 = _hb_sums()
     steps = eng.decode_steps - steps0
     tokens = sum(len(r.generated) for r in done)
     tps = tokens / dt
@@ -505,8 +523,11 @@ def _serving_run(overlap: bool) -> dict:
              "prefill_dispatches": eng.prefill_calls - prefills0,
              "preemptions": eng.preemptions - preempt0,
              "overlap": "on" if overlap else "off",
+             "decode_horizon": decode_horizon,
              "host_syncs": eng.host_syncs - syncs0,
              "pipeline_flushes": eng.pipeline_flushes - flushes0,
+             "host_overhead_frac": round(
+                 (hb1 - hb0) / max(dec1 - dec0, 1e-12), 4),
              "step_ms": round(dt / max(steps, 1) * 1000, 2)}
     if overlap:
         if _SERVING_SYNC_TPS:
@@ -2130,6 +2151,129 @@ def _serving_overlap_line() -> dict:
     return _serving_run(overlap=True)
 
 
+_HORIZON_ENGINE = None  # LAST arm pinned so weakref gauges stay
+#                         readable (counters live in the registry and
+#                         survive the earlier arms' collection — only
+#                         the last-constructed engine feeds callback
+#                         gauges, so pinning all three would just hold
+#                         their KV pools device-resident under every
+#                         later bench line)
+
+
+def _horizon_line() -> dict:
+    """Multi-token decode horizon A/B: the SAME offered load served
+    at ``decode_horizon`` 1 vs 4 vs 8 (fresh engine + cache per arm,
+    budget-bound requests so every row runs full blocks).  Per arm:
+    decode tok/s, host_overhead_frac (host bookkeeping / decode-step
+    seconds — the cost the horizon amortizes H x), dispatches/token
+    (expect ~1/H; the acceptance bar is <= 1.1/H), TTFT p50.  The
+    trim caveat — aggressive stop-sequence traffic burns up to H-1
+    trimmed tokens per stop — is PERF.md's; this workload has no
+    stops, so ``horizon_trimmed_tokens`` stays 0."""
+    import statistics
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+    from paddle_tpu.observability import default_registry, default_ring
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, n_req, prompt_len, new, page = 8, 16, 128, 33, 64
+        num_pages, pages_max = 96, 8
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        # wider batch than the overlap A/B's smoke: per-tick host
+        # bookkeeping must be REAL work (8 live rows) for the
+        # amortization to be measurable over the dispatch wait
+        batch, n_req, prompt_len, new, page = 8, 16, 12, 17, 16
+        num_pages, pages_max = 128, 8
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    arms = {}
+    for H in (1, 4, 8):
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page)
+        eng = ContinuousBatchingEngine(
+            cfg, params, cache, metrics_registry=default_registry(),
+            metrics_ring=default_ring(), decode_horizon=H)
+        global _HORIZON_ENGINE
+        _HORIZON_ENGINE = eng
+        rng = np.random.RandomState(0)
+        # warm/compile with the timed window's admission + block shape
+        for _ in range(batch):
+            eng.submit(rng.randint(1, cfg.vocab_size, (prompt_len,)),
+                       max_new_tokens=new)
+        eng.run_to_completion()
+        steps0, syncs0 = eng.decode_steps, eng.host_syncs
+        hb0, dec0 = _hb_sums()
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            eng.submit(rng.randint(1, cfg.vocab_size, (prompt_len,)),
+                       max_new_tokens=new)
+        done = eng.run_to_completion()
+        dt = time.perf_counter() - t0
+        hb1, dec1 = _hb_sums()
+        steps = eng.decode_steps - steps0
+        # dispatches/token over DECODE tokens (admission first tokens
+        # ride the prefill tail, not a decode dispatch)
+        dec_tokens = sum(len(r.generated) - 1 for r in done)
+        ttfts = sorted(r.t_first_token - r.t_submit for r in done)
+        arms[H] = {
+            "decode_tok_per_s": round(
+                sum(len(r.generated) for r in done) / dt, 1),
+            "host_overhead_frac": round(
+                (hb1 - hb0) / max(dec1 - dec0, 1e-12), 4),
+            "dispatches_per_token": round(
+                steps / max(dec_tokens, 1), 4),
+            "ttft_p50_ms": round(
+                statistics.median(ttfts) * 1000, 2),
+            "decode_dispatches": steps,
+            "host_syncs": eng.host_syncs - syncs0,
+            "trimmed_tokens": eng.horizon_trimmed_tokens,
+        }
+    frac1 = arms[1]["host_overhead_frac"]
+    frac8 = arms[8]["host_overhead_frac"]
+    return {
+        "metric": "serving_horizon_ab",
+        # the headline: how much of the per-token host overhead the
+        # H=8 horizon deleted (frac_H1 / frac_H8, higher is better)
+        "value": round(frac1 / max(frac8, 1e-9), 3),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {
+            "platform": platform, "requests": n_req,
+            "batch_slots": batch, "max_new_tokens": new,
+            "arms": {f"H={k}": v for k, v in arms.items()},
+            "note": "budget-bound load, no stop sequences (trim "
+                    "waste 0 here; the stop-heavy caveat is "
+                    "PERF.md's).  dispatches/token ~ 1/H is the "
+                    "acceptance pin; host_overhead_frac is the cost "
+                    "ROADMAP item 5 names.",
+        },
+    }
+
+
 def _snapshot_line() -> dict:
     """Final line: the process-wide registry snapshot + recent events,
     so BENCH_r*.json carries the engine/serving counters (occupancy,
@@ -2198,6 +2342,19 @@ def _snapshot_line() -> dict:
                       "mixed_piggybacked_prefill_tokens_total": _cval(
                           "paddle_tpu_engine_mixed_piggybacked_"
                           "prefill_tokens_total"),
+                      # multi-token decode horizon (the
+                      # serving_horizon_ab line's engines publish
+                      # process-wide): stop-seq trim waste + the
+                      # aggregate dispatch amortization
+                      "horizon_trimmed_tokens_total": _cval(
+                          "paddle_tpu_engine_horizon_trimmed_tokens"
+                          "_total"),
+                      "dispatches_per_token": round(
+                          _cval("paddle_tpu_engine_decode_steps"
+                                "_total")
+                          / max(_cval(
+                              "paddle_tpu_engine_tokens_generated"
+                              "_total"), 1.0), 4),
                       # disaggregated prefill/decode (the
                       # serving_disagg_ab line's coordinator
                       # publishes process-wide)
@@ -2246,6 +2403,7 @@ def main() -> None:
          _serving_line),
         ("serving_engine_overlap_decode_tokens_per_sec", "tokens/s",
          _serving_overlap_line),
+        ("serving_horizon_ab", "x", _horizon_line),
         ("serving_admission_packed_vs_batched", "x", _admission_line),
         ("serving_tp_ab", "ratio", _serving_tp_line),
         ("serving_preemption_offload_resume_ab", "x",

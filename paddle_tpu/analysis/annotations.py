@@ -92,6 +92,15 @@ SYNC_HOT_ROOTS: List[str] = [
     "ContinuousBatchingEngine._mixed_carve",
     "ContinuousBatchingEngine._mixed_plan",
     "ContinuousBatchingEngine._decode_mixed",
+    # the multi-token decode horizon (ISSUE 15): one dispatch / one
+    # fetch / one bookkeeping pass per H tokens — the horizon drain
+    # and the batched page pre-claim are the amortized hot path and
+    # must stay sync-clean; the sync horizon lane's single fetch per
+    # tick is its sanctioned drain
+    "ContinuousBatchingEngine._decode_sync_multi",
+    "ContinuousBatchingEngine._drain_horizon_entry",
+    "ContinuousBatchingEngine._drain_horizon_block",
+    "make_paged_decode_step_multi",
     # per-request tracing (ISSUE 13): phase clocks accrue and
     # materialize as spans ONLY at scheduler mutation / retirement
     # points — the decode hot loop never touches the tracer, and the
@@ -117,7 +126,8 @@ DEVICE_PRODUCER_NAMES: FrozenSet[str] = frozenset({
     "_last_logits",
 })
 DEVICE_PRODUCER_ATTRS: FrozenSet[str] = frozenset({
-    "_step", "_step_async", "_step_mixed", "_dstep", "_verify",
+    "_step", "_step_async", "_step_mixed", "_step_multi", "_dstep",
+    "_verify",
 })
 
 # The engine's DESIGNATED blocking drain: every hot-path call to it is
@@ -152,6 +162,9 @@ EXTRA_TRACED: List[str] = [
     "paged_decode._packed_prefill_body",
     "paged_decode._packed_prefill_body_tp",
     "paged_decode.make_mixed_step",
+    # ISSUE-15 horizon: the H-micro-step scan stages fn closures (and
+    # the micro bodies) inside its own jit
+    "paged_decode.make_paged_decode_step_multi",
 ]
 
 
@@ -190,6 +203,14 @@ FLUSH_SAFE: Dict[str, str] = {
         "_step_inner flush",
     "ContinuousBatchingEngine._decode_sync":
         "synchronous lane: overlap=False, there is no pipeline",
+    "ContinuousBatchingEngine._decode_sync_multi":
+        "synchronous horizon lane: overlap=False, there is no "
+        "pipeline — the block fetch precedes every retirement",
+    "ContinuousBatchingEngine._drain_horizon_block":
+        "the horizon drain IS the pipeline: a whole [H, B] block's "
+        "tokens are attributed against the dispatch-time active "
+        "mask, and host-only stop retirements schedule _needs_flush "
+        "exactly like _drain_one",
     "SpeculativeEngine._decode_once":
         "speculative rounds never populate _inflight — each round "
         "fetches its own outputs before bookkeeping",
@@ -483,12 +504,18 @@ CLAIMS: Dict[str, ClaimSpec] = {
         value_bearing=False,
         leak="slot pages off the free list forever (admission "
              "faults, PR 5's stranded-slot class; partially-prefilled "
-             "mixed rows parked in _mixed_pref)",
+             "mixed rows parked in _mixed_pref; horizon pre-claims "
+             "stranded past a trim/retire)",
         note="swap_in_row acquires row pages AND releases the swap "
              "record it consumes; the mixed lane's carve transfers "
              "its claim into _mixed_pref, whose rows the sweep/"
              "quarantine/restart paths release (audit-pinned by "
-             "test_serving_mixed)"),
+             "test_serving_mixed).  ensure_capacity[_batch] GROWS an "
+             "existing row claim (the decode-horizon H-token "
+             "pre-claim rides it): the grown pages belong to the row "
+             "and release through the same release_row seam on "
+             "retire/trim/cancel/quarantine — audit-pinned by "
+             "test_serving_horizon"),
     # host-tier swap record: parked preempted rows + adopted handoff
     # blobs.  The handle MUST land in an audited registry
     # (_swap_handles) or be discarded — a dropped handle pins host

@@ -800,6 +800,9 @@ class GenerationServer:
                      / max(getattr(eng, "mixed_ticks", 0)
                            * getattr(eng, "mixed_token_budget", 0),
                            1), 4),
+                 "decode_horizon": getattr(eng, "decode_horizon", 1),
+                 "horizon_trimmed_tokens":
+                     getattr(eng, "horizon_trimmed_tokens", 0),
                  "requests_finished": eng.requests_finished}
             if hasattr(eng, "spec_rounds"):
                 h["spec_rounds"] = eng.spec_rounds
@@ -813,12 +816,14 @@ class GenerationServer:
             live, ready, self._fatal, self.restarts,
             self.registry, eng.step_faults,
             eng.gamma if hasattr(eng, "spec_rounds") else None,
-            getattr(eng, "mixed_token_budget", 0))
+            getattr(eng, "mixed_token_budget", 0),
+            getattr(eng, "decode_horizon", 1))
 
     @staticmethod
     def _health_from_registry(live, ready, fatal, restarts, registry,
                               step_faults, gamma,
-                              mixed_budget=0) -> dict:
+                              mixed_budget=0,
+                              decode_horizon=1) -> dict:
         # /health is a VIEW over the metrics registry (single source
         # of truth is the instrumentation, not ad-hoc attribute
         # reads); snapshot() outside the lock — set-value metrics are
@@ -885,6 +890,10 @@ class GenerationServer:
                    "tokens_total")
                  / max(v(snap, "paddle_tpu_engine_mixed_ticks_total")
                        * mixed_budget, 1), 4),
+             "decode_horizon": decode_horizon,
+             "horizon_trimmed_tokens": int(v(
+                 snap,
+                 "paddle_tpu_engine_horizon_trimmed_tokens_total")),
              "requests_finished": int(v(
                  snap,
                  "paddle_tpu_engine_requests_finished_total"))}

@@ -190,6 +190,12 @@ class PrefillEngine(ContinuousBatchingEngine):
                 "(mixed=True deletes the stall a COLOCATED engine "
                 "pays; a disaggregated prefill engine has no stall "
                 "to delete — see handoff_wins)")
+        if int(kw.get("decode_horizon", 1) or 1) > 1:
+            raise ValueError(
+                "PrefillEngine has no decode cadence to fuse "
+                "(decode_horizon amortizes per-token decode "
+                "dispatches; set it on the DecodeEngine of a "
+                "disaggregated pair, or on a colocated engine)")
         super().__init__(*args, **kw)
         self.max_inflight_handoffs = int(max_inflight_handoffs)
         self._handoff_ready: List[HandoffRecord] = []

@@ -38,7 +38,8 @@ from .llama_pretrain import (LlamaPretrainConfig, _block_post_attn, _mm,
                              _rms_norm)
 
 __all__ = ["PagedKVCache", "make_paged_decode_step",
-           "make_paged_decode_step_async", "make_mixed_step",
+           "make_paged_decode_step_async",
+           "make_paged_decode_step_multi", "make_mixed_step",
            "generate_paged", "generate_auto"]
 
 
@@ -542,26 +543,41 @@ class PagedKVCache:
     def ensure_capacity(self, b: int, new_tokens: int = 1) -> None:
         """Grow row ``b`` so the next ``new_tokens`` writes (slots
         ``lens[b] .. lens[b]+new_tokens-1``) have pages."""
-        need = (int(self.lens[b]) + new_tokens - 1) // self.page + 1
-        if need > self.pages_max:
-            raise ValueError(
-                f"row {b}: {int(self.lens[b])} + {new_tokens} tokens "
-                f"needs {need} pages > pages_max {self.pages_max}")
+        self.ensure_capacity_batch([(b, new_tokens)])
+
+    def ensure_capacity_batch(self, needs) -> None:
+        """Grow EVERY ``(row, new_tokens)`` in ``needs`` as one
+        coalesced claim: however many rows grow (and whatever the
+        per-row horizon pre-claim depth), ``tables_version`` bumps at
+        most ONCE — each bump invalidates the overlap loop's
+        device-resident tables copy and forces a re-upload, so the
+        old per-slot ``ensure_capacity`` loop paid one re-upload per
+        growing row per tick.  On pool exhaustion mid-claim the rows
+        already grown keep their pages (they are owned and accounted;
+        the caller's preemption fallback reclaims space and retries)
+        and ``RuntimeError`` propagates; the version still bumps so a
+        device-resident tables copy can never miss the partial
+        growth."""
         grew = False
         try:
-            while len(self._owned[b]) < need:
-                pid = self._page_alloc()
-                self.refs[pid] += 1
-                self.tables[b, len(self._owned[b])] = pid
-                self._owned[b].append(pid)
-                grew = True
+            for b, new_tokens in needs:
+                need = (int(self.lens[b]) + new_tokens - 1) \
+                    // self.page + 1
+                if need > self.pages_max:
+                    raise ValueError(
+                        f"row {b}: {int(self.lens[b])} + {new_tokens} "
+                        f"tokens needs {need} pages > pages_max "
+                        f"{self.pages_max}")
+                while len(self._owned[b]) < need:
+                    pid = self._page_alloc()
+                    self.refs[pid] += 1
+                    self.tables[b, len(self._owned[b])] = pid
+                    self._owned[b].append(pid)
+                    grew = True
         finally:
             self._flush_demotions()
-        if grew:
-            # ONE bump per call, not per page: every bump invalidates
-            # the overlap loop's device-resident tables copy, forcing
-            # a re-upload — per-page bumps bought nothing
-            self.tables_version += 1
+            if grew:
+                self.tables_version += 1
 
     def write_row_pages(self, slot: int, ks, vs, L: int,
                         first_page: int = 0) -> None:
@@ -1574,6 +1590,121 @@ def make_paged_decode_step_tp(cfg: LlamaPretrainConfig, mesh,
     _step_tp_cache[(_cfg_key(cfg), temperature, kv_quant, mesh,
                     top_k, top_p, tp_allreduce)] = fn
     return fn
+
+
+_step_multi_cache: dict = {}
+
+
+def make_paged_decode_step_multi(cfg: LlamaPretrainConfig,
+                                 horizon: int,
+                                 temperature: float = 0.0,
+                                 kv_quant: Optional[str] = None,
+                                 top_k: int = 0, top_p: float = 1.0,
+                                 mesh=None,
+                                 tp_allreduce: str = "fp32"):
+    """MULTI-TOKEN DECODE HORIZON: one jitted program advancing every
+    active row by up to ``horizon`` tokens — an H-iteration
+    ``lax.scan`` of the async decode body, so the serving engine pays
+    ONE dispatch (and, downstream, one blocking fetch and one pass of
+    host bookkeeping) per H tokens instead of per token.  This is the
+    serving-loop form of :func:`make_paged_generate_fused`'s
+    fuse-the-loop move: the block tables stay CONSTANT across the
+    horizon (the engine pre-claims H tokens of pages per slot before
+    dispatching), and the per-slot done mask folds on-device each
+    micro-step so a row that hits ``eos`` or exhausts its budget
+    mid-horizon stops advancing — its remaining micro-steps write
+    junk at a dead position exactly like the async step's inactive
+    rows.
+
+    ``fn(params, kpool, vpool, [kscale, vscale,] tables, lens, tok,
+    active, remaining, eos, key) -> (kpool, vpool, [kscale, vscale,]
+    toks [H, B], dones [H, B], tok', lens', remaining', active')``
+
+    * ``toks[h]`` is micro-step h's next-token vector, ``dones[h]``
+      the rows that just hit eos/budget at micro-step h (each row
+      fires at most once; after it the row is inactive and its
+      ``toks[h']`` entries repeat its last token);
+    * the trailing ``tok'/lens'/remaining'/active'`` are the CHAINED
+      loop state after the whole horizon — the overlap pipeline feeds
+      them straight into the next block's dispatch with zero host
+      round-trips (``tok'`` equals ``toks[-1]`` but returns from
+      inside the jit so chaining costs no extra slice dispatch);
+    * multi-token stop SEQUENCES stay host knowledge: the engine
+      detects them at the drain and TRIMS the row's at-most-H-1
+      over-generated trailing tokens before emission (the
+      chained-dispatch extra-token discipline, generalized).
+
+    With ``mesh`` (mp>1) each micro-step is the TP shard_map step
+    through the :func:`_build_tp_inner` seam (``tp_allreduce="int8"``
+    included) and the state advance rides replicated — one dispatch
+    per horizon on the mesh.  ``kv_quant="int8"`` threads the scale
+    pools through the scan carry.
+    """
+    H = int(horizon)
+    if H < 1:
+        raise ValueError(f"horizon must be >= 1, got {horizon}")
+    q8 = kv_quant == "int8"
+    mesh_key = mesh if (mesh is not None
+                        and mesh.shape.get("mp", 1) > 1) else None
+    ckey = (_cfg_key(cfg), H, temperature, kv_quant, top_k, top_p,
+            mesh_key, tp_allreduce if mesh_key is not None else "fp32")
+    hit = _step_multi_cache.get(ckey)
+    if hit is not None:
+        return hit
+
+    if mesh_key is not None:
+        base = _build_tp_inner(cfg, mesh, temperature, kv_quant,
+                               top_k, top_p,
+                               tp_allreduce=tp_allreduce)
+    else:
+        step, step_q8 = _build_step_fns(cfg, temperature, False,
+                                        top_k, top_p)
+        base = step_q8 if q8 else step
+
+    advance = _advance_loop_state   # the async lane's exact advance
+
+    if q8:
+        def fn(params, kpool, vpool, kscale, vscale, tables, lens,
+               tok, active, remaining, eos, key):
+            def micro(carry, sub):
+                (kp, vp, ks, vs, tok, lens, active, remaining) = carry
+                kp, vp, ks, vs, nxt = base(
+                    params, kp, vp, ks, vs, tables, lens, tok, sub)
+                nxt, lens2, rem2, act2, done = advance(
+                    nxt, tok, lens, active, remaining, eos)
+                return ((kp, vp, ks, vs, nxt, lens2, act2, rem2),
+                        (nxt, done))
+
+            subs = jax.random.split(key, H)
+            carry0 = (kpool, vpool, kscale, vscale, tok, lens,
+                      active, remaining)
+            (kpool, vpool, kscale, vscale, tok_f, lens_f, act_f,
+             rem_f), (toks, dones) = jax.lax.scan(micro, carry0, subs)
+            return (kpool, vpool, kscale, vscale, toks, dones, tok_f,
+                    lens_f, rem_f, act_f)
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+    else:
+        def fn(params, kpool, vpool, tables, lens, tok, active,
+               remaining, eos, key):
+            def micro(carry, sub):
+                kp, vp, tok, lens, active, remaining = carry
+                kp, vp, nxt = base(params, kp, vp, tables, lens, tok,
+                                   sub)
+                nxt, lens2, rem2, act2, done = advance(
+                    nxt, tok, lens, active, remaining, eos)
+                return (kp, vp, nxt, lens2, act2, rem2), (nxt, done)
+
+            subs = jax.random.split(key, H)
+            carry0 = (kpool, vpool, tok, lens, active, remaining)
+            (kpool, vpool, tok_f, lens_f, act_f, rem_f), \
+                (toks, dones) = jax.lax.scan(micro, carry0, subs)
+            return (kpool, vpool, toks, dones, tok_f, lens_f, rem_f,
+                    act_f)
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+    _step_multi_cache[ckey] = jitted
+    return jitted
 
 
 def make_paged_generate_fused(cfg: LlamaPretrainConfig,

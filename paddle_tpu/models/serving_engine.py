@@ -50,6 +50,7 @@ from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            _pick_token, make_mixed_step,
                            make_paged_decode_step,
                            make_paged_decode_step_async,
+                           make_paged_decode_step_multi,
                            make_paged_decode_step_tp,
                            tp_collective_bytes_per_step)
 
@@ -238,6 +239,7 @@ class ContinuousBatchingEngine:
                  mixed: bool = False,
                  mixed_token_budget: int = 256,
                  mixed_ctx_cap: Optional[int] = None,
+                 decode_horizon: int = 1,
                  tracer=None):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
@@ -274,6 +276,23 @@ class ContinuousBatchingEngine:
         retirement).  ``lookahead`` is the number of dispatches the
         device may run ahead of the host (1 = classic double
         buffering).
+
+        ``decode_horizon=H`` (H > 1) fuses H micro-steps of the
+        decode loop into ONE jitted ``lax.scan`` program per tick
+        (sync and overlap lanes alike): one dispatch, one blocking
+        fetch and one host-bookkeeping pass per H tokens.  Tables
+        stay constant across the block (the tick pre-claims H tokens
+        of pages per slot in one batched claim), per-slot eos/budget
+        stops fold on-device so rows halt mid-horizon, and
+        host-detected stop sequences trim the device's
+        over-generated tail (at most H-1 tokens, counted in
+        ``horizon_trimmed_tokens``) before emission — streams stay
+        token-exact vs ``decode_horizon=1``.  Helps
+        dispatch-overhead-bound regimes; hurts under aggressive
+        stop-sequence traffic (trim waste).  Does not compose with
+        ``mixed=True`` (raises — the mixed tick re-plans its prefill
+        stream on the host between dispatches); speculative engines
+        reject it in favour of their own gamma cadence.
 
         ``packed=True`` (default) admits through the PACKED VARLEN
         prefill lane: every waiting context — any length mix,
@@ -371,6 +390,44 @@ class ContinuousBatchingEngine:
                 cfg, temperature, kv_quant=cache.kv_quant,
                 top_k=top_k, top_p=top_p, mesh=mesh,
                 tp_allreduce=tp_allreduce)
+        # -- MULTI-TOKEN DECODE HORIZON (decode_horizon=H > 1): every
+        # decode tick is ONE jitted H-micro-step lax.scan program —
+        # one dispatch, one blocking fetch and one host-bookkeeping
+        # pass per H tokens instead of per token.  Tables stay
+        # constant across the horizon (H-token page pre-claim per
+        # slot); per-slot eos/budget stops fold on-device; host-only
+        # stop sequences trim the row's over-generated tail at the
+        # drain (at most H-1 tokens, counted).
+        if int(decode_horizon) < 1:
+            raise ValueError(
+                f"decode_horizon must be >= 1, got {decode_horizon}")
+        self.decode_horizon = int(decode_horizon)
+        if self.decode_horizon > 1 and self._mixed:
+            # The real constraint: the mixed tick's admission cadence
+            # is host-scheduled BETWEEN dispatches — chunk carving,
+            # progressive prefix registration and activation
+            # bookkeeping are per-tick host decisions an H-deep
+            # on-device scan would have to replay blind (its prefill
+            # stream/scatter layout is fixed at dispatch).  The mixed
+            # fusion already amortizes dispatch overhead across the
+            # prefill budget; run one knob or the other.
+            raise ValueError(
+                "decode_horizon > 1 does not compose with mixed=True: "
+                "the mixed tick re-plans its prefill stream on the "
+                "host between consecutive dispatches, which an "
+                "on-device multi-step scan cannot replay — use "
+                "mixed=True (fused admission) OR decode_horizon "
+                "(fused decode cadence), not both")
+        self._step_multi = None
+        if self.decode_horizon > 1:
+            self._step_multi = make_paged_decode_step_multi(
+                cfg, self.decode_horizon, temperature,
+                kv_quant=cache.kv_quant, top_k=top_k, top_p=top_p,
+                mesh=mesh, tp_allreduce=tp_allreduce)
+        # host-detected stop sequences fire mid-horizon: tokens the
+        # device over-generated past the stop point are discarded
+        # before emission (streams stay token-exact vs horizon=1)
+        self.horizon_trimmed_tokens = 0
         # padding-waste accounting across ALL prefill lanes: dispatched
         # token slots vs slots that carried no real context token
         # (bucket/page padding) — bench.py's admission A/B reads these
@@ -1999,25 +2056,75 @@ class ContinuousBatchingEngine:
         if self.metrics is not None:
             self.metrics.tp_allreduce_bytes.inc(b)
 
+    def _grow_tokens(self, slot: int, new_tokens: int) -> int:
+        """How many tokens of pages THIS dispatch's growth must claim
+        for ``slot``.  HORIZON claims (``_step_multi`` built) clamp
+        ``new_tokens`` to the row's remaining budget (the horizon
+        scan stops advancing at remaining==0, so claiming the full H
+        past it would spuriously exceed the row cap for near-done
+        rows; the host mirror only over-estimates remaining, never
+        under, so the clamp always covers what the device will write)
+        and to the row's table capacity (an over-advanced lens mirror
+        of a row that already retired on-device must not spuriously
+        ValueError).  NON-horizon claims pass through unclamped — the
+        speculative lane's gamma+1 claim deliberately covers verify
+        K/V written PAST the remaining budget, so a remaining clamp
+        there would push real writes onto the junk page.  ``<= 0``
+        means nothing to claim — skip the row."""
+        if self._step_multi is None:
+            if self._inflight and int(self.cache.lens[slot]) \
+                    // self.cache.page >= self.cache.pages_max:
+                # lens MIRROR past the row's table capacity: a live
+                # row can never get here (submit bounds its worst
+                # case) — this is a row that already retired
+                # on-device and whose undrained dispatches
+                # over-advanced the mirror
+                return 0
+            return new_tokens
+        lens_m = int(self.cache.lens[slot])
+        cap = self.cache.pages_max * self.cache.page - lens_m
+        return min(new_tokens, max(int(self._remaining[slot]), 1),
+                   cap)
+
     def _ensure_or_preempt(self, new_tokens: int = 1,
                            aux_cache=None, aux_new: int = 0) -> None:
         """Grow every active row's pages (and optionally an auxiliary
         cache's), preempting the youngest other request on pool
-        exhaustion instead of crashing the engine."""
+        exhaustion instead of crashing the engine.
+
+        Fast path: the whole tick's growth is ONE coalesced
+        ``ensure_capacity_batch`` claim — at most one
+        ``tables_version`` bump, hence at most one device tables
+        re-upload per tick, however many rows grew (the old per-slot
+        loop re-uploaded once per growing row; with H-token horizon
+        pre-claims that multiplied).  Pool pressure falls back to the
+        per-slot grow-or-preempt loop."""
+        needs = []
+        for slot in self._active:
+            n = self._grow_tokens(slot, new_tokens)
+            if n > 0:
+                needs.append((slot, n))
+        if not needs:
+            return
+        try:
+            self.cache.ensure_capacity_batch(needs)
+            if aux_cache is not None:
+                aux_cache.ensure_capacity_batch(
+                    [(slot, aux_new) for slot, _ in needs])
+            return
+        except RuntimeError:
+            pass                   # pool pressure: per-slot fallback
         for slot in list(self._active):
             if slot not in self._active:     # evicted by an earlier turn
                 continue
-            if self._inflight and int(self.cache.lens[slot]) \
-                    // self.cache.page >= self.cache.pages_max:
-                # lens MIRROR past the row's table capacity: a live row
-                # can never get here (submit bounds its worst case), so
-                # this is a row that already retired on-device and
-                # whose undrained dispatches over-advanced the mirror —
-                # growing it would spuriously ValueError
+            n = self._grow_tokens(slot, new_tokens)
+            if n <= 0:
+                # nothing to claim (over-advanced mirror of a row
+                # retired on-device, or a full table)
                 continue
             while True:
                 try:
-                    self.cache.ensure_capacity(slot, new_tokens)
+                    self.cache.ensure_capacity(slot, n)
                     if aux_cache is not None:
                         aux_cache.ensure_capacity(slot, aux_new)
                     break
@@ -2030,6 +2137,11 @@ class ContinuousBatchingEngine:
                         # successor while stale writes are still queued)
                         self._pipeline_flush()
                         if slot not in self._active:
+                            break
+                        # the flush made the mirrors exact: re-clamp
+                        # (the row may now need fewer tokens of pages)
+                        n = self._grow_tokens(slot, new_tokens)
+                        if n <= 0:
                             break
                         continue
                     # pool exhausted mid-flight: preempt the youngest
@@ -2051,9 +2163,14 @@ class ContinuousBatchingEngine:
         """One decode round advancing every active slot (the
         speculative subclass overrides this with a draft+verify
         round): the synchronous dispatch-then-sync loop, or — with
-        ``overlap=True`` — one turn of the dispatch-ahead pipeline."""
+        ``overlap=True`` — one turn of the dispatch-ahead pipeline.
+        With ``decode_horizon > 1`` both lanes advance by horizon
+        BLOCKS — one multi-step dispatch (and one fetch) per H
+        tokens."""
         if self.overlap:
             self._decode_overlap()
+        elif self._step_multi is not None:
+            self._decode_sync_multi()
         else:
             self._decode_sync()
 
@@ -2107,11 +2224,12 @@ class ContinuousBatchingEngine:
         if self._needs_flush:
             self._pipeline_flush()
         if self._active:
-            # grow rows for the next write position.  The host lens
-            # mirror is exact for live rows; a row that already
-            # retired on-device but is not yet drained may
-            # over-allocate one page, released at retirement.
-            self._ensure_or_preempt()
+            # grow rows for the next write positions — the whole
+            # horizon's worth, so tables stay constant across the
+            # block.  The host lens mirror is exact for live rows; a
+            # row that already retired on-device but is not yet
+            # drained may over-allocate (released at retirement).
+            self._ensure_or_preempt(self.decode_horizon)
             if self._needs_flush:          # a preemption landed
                 self._pipeline_flush()
             if self._active:
@@ -2155,36 +2273,66 @@ class ContinuousBatchingEngine:
         return self._dev
 
     def _dispatch_async(self) -> None:
-        """Issue one decode step chained off the device-resident loop
-        state.  Zero blocking host work: uploads happen only when the
-        state was invalidated by a flush (or the block tables grew)."""
+        """Issue one decode step — or, with ``decode_horizon > 1``,
+        one H-micro-step horizon BLOCK — chained off the
+        device-resident loop state.  Zero blocking host work: uploads
+        happen only when the state was invalidated by a flush (or the
+        block tables grew)."""
         cache = self.cache
         d = self._seed_or_refresh_dev()
         self._key, sub = jax.random.split(self._key)
         faults.fire("step_dispatch")
-        if cache.kv_quant == "int8":
-            (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
-             nxt, lens2, rem2, act2, done) = self._step_async(
-                self.params, cache.kpool, cache.vpool, cache.kscale,
-                cache.vscale, d["tables"], d["lens"], d["tok"],
-                d["active"], d["remaining"], self._eos_dev, sub)
+        if self._step_multi is not None:
+            if cache.kv_quant == "int8":
+                (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
+                 toks, dones, tok_f, lens_f, rem_f,
+                 act_f) = self._step_multi(
+                    self.params, cache.kpool, cache.vpool,
+                    cache.kscale, cache.vscale, d["tables"], d["lens"],
+                    d["tok"], d["active"], d["remaining"],
+                    self._eos_dev, sub)
+            else:
+                (cache.kpool, cache.vpool, toks, dones, tok_f, lens_f,
+                 rem_f, act_f) = self._step_multi(
+                    self.params, cache.kpool, cache.vpool, d["tables"],
+                    d["lens"], d["tok"], d["active"], d["remaining"],
+                    self._eos_dev, sub)
+            d["lens"], d["tok"] = lens_f, tok_f
+            d["active"], d["remaining"] = act_f, rem_f
+            self._inflight.append({"toks": toks, "dones": dones})
+            # one horizon block carries H micro-steps of collectives
+            self._count_tp_dispatch(self.decode_horizon)
+            # mirror advances the FULL horizon: exact for rows that
+            # stay live through the block (they advanced H on-device),
+            # over for rows retiring mid-horizon — those retire at the
+            # drain and their release zeroes the entry (self-healing,
+            # same discipline as the single-step lane)
+            cache.lens = cache.lens + (self.decode_horizon
+                                       * self._active_mask)
         else:
-            (cache.kpool, cache.vpool, nxt, lens2, rem2, act2,
-             done) = self._step_async(
-                self.params, cache.kpool, cache.vpool, d["tables"],
-                d["lens"], d["tok"], d["active"], d["remaining"],
-                self._eos_dev, sub)
-        d["lens"], d["tok"] = lens2, nxt
-        d["active"], d["remaining"] = act2, rem2
-        self._inflight.append({"nxt": nxt, "done": done})
+            if cache.kv_quant == "int8":
+                (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
+                 nxt, lens2, rem2, act2, done) = self._step_async(
+                    self.params, cache.kpool, cache.vpool, cache.kscale,
+                    cache.vscale, d["tables"], d["lens"], d["tok"],
+                    d["active"], d["remaining"], self._eos_dev, sub)
+            else:
+                (cache.kpool, cache.vpool, nxt, lens2, rem2, act2,
+                 done) = self._step_async(
+                    self.params, cache.kpool, cache.vpool, d["tables"],
+                    d["lens"], d["tok"], d["active"], d["remaining"],
+                    self._eos_dev, sub)
+            d["lens"], d["tok"] = lens2, nxt
+            d["active"], d["remaining"] = act2, rem2
+            self._inflight.append({"nxt": nxt, "done": done})
+            self._count_tp_dispatch()
+            # advance the host lens mirror for the NEXT dispatch's
+            # capacity check (exact for live rows; self-healing for
+            # device-retired rows — their release zeroes the entry)
+            cache.lens = cache.lens + self._active_mask
         self.decode_steps += 1
-        self._count_tp_dispatch()
         if self.metrics is not None:
             self.metrics.decode_steps.inc()
-        # advance the host lens mirror for the NEXT dispatch's
-        # capacity check (exact for live rows; self-healing for
-        # device-retired rows — their release zeroes the entry)
-        cache.lens = cache.lens + self._active_mask
 
     def _fetch(self, *arrs):
         """Blocking device->host fetch — the pipeline's ONLY sync
@@ -2201,6 +2349,9 @@ class ContinuousBatchingEngine:
         retires the request and schedules a pipeline flush, since the
         device-side active chain cannot know about it."""
         e = self._inflight.pop(0)
+        if "toks" in e:                      # multi-token horizon block
+            self._drain_horizon_entry(e)
+            return
         has_first = "ftok" in e
         arrs = ([e["nxt"], e["done"], e["ftok"]] if has_first
                 else [e["nxt"], e["done"]])
@@ -2260,6 +2411,143 @@ class ContinuousBatchingEngine:
             self.metrics.tokens_generated.inc(advanced)
             self.metrics.host_bookkeeping.observe(
                 time.perf_counter() - t0)
+
+    def _drain_horizon_entry(self, e: Dict) -> None:
+        """Drain one in-flight HORIZON block: ONE blocking fetch for
+        the whole ``[H, B]`` token/done block (the pipeline's
+        one-fetch-per-H-tokens amortization), then the shared
+        per-micro-step bookkeeping."""
+        # analysis: ignore[sync-in-hot-path] reason=the pipeline's one sanctioned sync point, horizon form: ONE fetch drains a whole [H, B] block while a newer dispatch is already in flight
+        toks, dones = self._fetch(e["toks"], e["dones"])
+        self._drain_active = self._drain_horizon_block(
+            toks, dones, self._drain_active)
+
+    def _drain_horizon_block(self, toks, dones, mask):
+        """Per-token host bookkeeping for one fetched horizon block —
+        shared by the overlap drain and the synchronous horizon lane
+        so their emission/retirement/trim behaviour can never fork.
+        ``mask`` is the device-active mask at the block's dispatch;
+        returns the mask after the block (device chain: rows drop at
+        their on-device done, host-only stop retirements stay in the
+        mask exactly like the single-step lane — the scheduled flush
+        keeps their slots from being reused under the pipeline).
+
+        Host-only stop sequences fire mid-block: the row retires at
+        the stop and the tokens the device over-generated past it
+        (at most H-1, fewer when its on-device eos/budget done fired
+        first) are DISCARDED before emission and counted in
+        ``horizon_trimmed_tokens`` — the chained-dispatch extra-token
+        discipline, generalized from one token to the tail of the
+        block."""
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
+        H = toks.shape[0]
+        advanced = 0
+        trimmed = 0
+        out_mask = mask.copy()
+        for slot in np.nonzero(mask)[0]:
+            slot = int(slot)
+            dcol = dones[:, slot]
+            nd = np.nonzero(dcol)[0]
+            # the row generated up to and including its first
+            # on-device done (eos/budget); after it the column repeats
+            # the last token (the advance holds inactive rows)
+            n_gen = (int(nd[0]) + 1) if nd.size else H
+            device_done = nd.size > 0
+            if device_done:
+                out_mask[slot] = False   # the device chain dropped it
+            req = self._active.get(slot)
+            if req is None:
+                # host-retired (stop sequence / cancel sweep) before
+                # this block drained: its tokens are dead; the
+                # scheduled flush keeps the slot from being reused
+                # under the in-flight pipeline
+                continue
+            col = toks[:, slot]
+            if req.stop_sequences:
+                # stop-sequence rows deliver token-by-token so a stop
+                # retires the row exactly where the H=1 lane would,
+                # discarding (and counting) the device's
+                # over-generated tail
+                for h in range(n_gen):
+                    t = int(col[h])
+                    self._deliver_token(slot, req, t)
+                    advanced += 1
+                    self._remaining[slot] -= 1
+                    if h == n_gen - 1 and device_done:
+                        self._retire(slot)   # eos/budget (on-device)
+                    elif self._hit_stop(req, t):
+                        self._retire(slot)   # stop seq (host-only)
+                        if self.overlap:
+                            self._needs_flush = True
+                        trimmed += n_gen - 1 - h
+                        break
+                continue
+            # FAST PATH (no stop sequences): the whole column delivers
+            # as one bulk append/extend — per-token Python machinery
+            # (call into _deliver_token, tail scans, mask rebuilds) is
+            # exactly the host overhead the horizon exists to
+            # amortize, so the common case must not pay it per token
+            toks_list = col[:n_gen].tolist()
+            req.generated.extend(toks_list)
+            self.tokens_generated += n_gen
+            advanced += n_gen
+            self._note_first_token(req)
+            rid = req.rid
+            self._stream.extend((rid, t) for t in toks_list)
+            self._next_tok[slot] = toks_list[-1]
+            self._remaining[slot] -= n_gen
+            if device_done:
+                self._retire(slot)           # eos/budget (on-device)
+        mask = out_mask
+        if trimmed:
+            self.horizon_trimmed_tokens += trimmed
+            if self.metrics is not None:
+                self.metrics.horizon_trimmed_tokens.inc(trimmed)
+        if self.metrics is not None:
+            self.metrics.tokens_generated.inc(advanced)
+            self.metrics.decode_horizon_tokens.observe(advanced)
+            self.metrics.host_bookkeeping.observe(
+                time.perf_counter() - t0)
+        return mask
+
+    def _decode_sync_multi(self) -> None:
+        """The synchronous horizon lane: one H-micro-step dispatch +
+        ONE blocking fetch per tick — H tokens per blocking host
+        round-trip instead of one (``overlap=False``,
+        ``decode_horizon > 1``)."""
+        cache = self.cache
+        self._ensure_or_preempt(self.decode_horizon)
+        tables = jnp.asarray(cache.tables.copy())
+        lens = jnp.asarray(cache.lens.copy())
+        tok = jnp.asarray(self._next_tok.copy())
+        active = jnp.asarray(self._active_mask.astype(bool))
+        remaining = jnp.asarray(self._remaining.copy())
+        self._key, sub = jax.random.split(self._key)
+        faults.fire("step_dispatch")
+        if cache.kv_quant == "int8":
+            (cache.kpool, cache.vpool, cache.kscale, cache.vscale,
+             toks, dones, _, _, _, _) = self._step_multi(
+                self.params, cache.kpool, cache.vpool, cache.kscale,
+                cache.vscale, tables, lens, tok, active, remaining,
+                self._eos_dev, sub)
+        else:
+            (cache.kpool, cache.vpool, toks, dones, _, _, _,
+             _) = self._step_multi(
+                self.params, cache.kpool, cache.vpool, tables, lens,
+                tok, active, remaining, self._eos_dev, sub)
+        # mirror the full horizon; retirements below zero the rows
+        # that stopped mid-block (same self-healing as the overlap
+        # mirror — here the very next lines heal it)
+        cache.lens = cache.lens + (self.decode_horizon
+                                   * self._active_mask)
+        self.decode_steps += 1
+        self._count_tp_dispatch(self.decode_horizon)
+        if self.metrics is not None:
+            self.metrics.decode_steps.inc()
+        mask = self._active_mask.astype(bool)
+        # analysis: ignore[sync-in-hot-path] reason=the synchronous horizon lane's ONE blocking fetch per H-token tick (overlap=False) — the amortized counterpart of _decode_sync's per-token round-trip
+        toks, dones = self._fetch(toks, dones)
+        self._drain_horizon_block(toks, dones, mask)
 
     def _pipeline_flush(self) -> None:
         """Drain every in-flight dispatch and invalidate the

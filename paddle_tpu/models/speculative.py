@@ -223,6 +223,13 @@ class SpeculativeEngine(ContinuousBatchingEngine):
                 "mixed=True is a plain-decode-lane knob: the "
                 "speculative round has its own draft+verify dispatch "
                 "structure the mixed program does not reproduce")
+        if int(kw.get("decode_horizon", 1) or 1) > 1:
+            raise ValueError(
+                "decode_horizon is a plain-decode-lane knob: a "
+                "speculative round already amortizes dispatch "
+                "overhead over gamma drafted tokens per draft+verify "
+                "round and keeps its own cadence — tune gamma "
+                "instead")
         if cache.kv_quant or draft_cache.kv_quant:
             raise NotImplementedError(
                 "speculative serving over int8 pools: dequant in "

@@ -37,6 +37,10 @@ _PACKED_BUCKETS = (64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
 # (token counts; utilization = sum/count over the configured budget)
 _BUDGET_BUCKETS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
                    1024.0, 2048.0, 4096.0)
+# tokens delivered per multi-token horizon block: one row's single
+# token .. a full H=32 block over a wide batch
+_HORIZON_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                    256.0, 512.0)
 # host bookkeeping per decode step: 10us .. 1s (pure Python work —
 # far below the dispatch buckets; the overlap ratio
 # host_bookkeeping.sum / decode_step.sum needs resolution down here)
@@ -159,6 +163,21 @@ class EngineMetrics:
             "by mixed_token_budget; sum/count against the configured "
             "budget is the budget utilization)",
             buckets=_BUDGET_BUCKETS)
+        # -- multi-token decode horizon (decode_horizon=H) ---------------
+        self.decode_horizon_tokens = r.histogram(
+            "paddle_tpu_engine_decode_horizon_tokens",
+            "Tokens delivered per multi-token horizon block (one "
+            "sample per drained H-micro-step dispatch; sum/count "
+            "against H x active slots is the horizon utilization — "
+            "rows retiring mid-block deliver less)",
+            buckets=_HORIZON_BUCKETS)
+        self.horizon_trimmed_tokens = r.counter(
+            "paddle_tpu_engine_horizon_trimmed_tokens_total",
+            "Tokens the device over-generated past a host-detected "
+            "stop sequence inside a horizon block and the drain "
+            "discarded before emission (at most H-1 per stop; the "
+            "token cost of fusing H micro-steps into one dispatch "
+            "under aggressive stop-sequence traffic)")
         self.host_bookkeeping = r.histogram(
             "paddle_tpu_engine_host_bookkeeping_seconds",
             "Host-side scheduling/streaming bookkeeping per decode "

@@ -54,6 +54,7 @@ def test_gpt_forward_and_causality():
                            np.asarray(out2.numpy())[:, 10:])
 
 
+@pytest.mark.slow
 def test_gpt_pretrain_step_reduces_loss():
     paddle.seed(51)
     model = GPTForCausalLM(_tiny_gpt())
@@ -71,6 +72,7 @@ def test_gpt_pretrain_step_reduces_loss():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+@pytest.mark.slow
 def test_gpt_sharding_stage2_parity():
     """BASELINE config 4 flavor: ZeRO-2 wrapped GPT step matches the
     unwrapped model's loss on the virtual mesh."""
@@ -172,6 +174,7 @@ def test_bert_token_type_changes_output():
     assert not np.allclose(a, b)
 
 
+@pytest.mark.slow
 def test_bert_squad_amp_gradscaler_step():
     """BASELINE config 3 flavor: QA fine-tune with auto_cast + GradScaler
     reduces loss and keeps weights finite."""

@@ -247,6 +247,7 @@ def test_jit_train_step_tuple_inputs_and_labels():
     assert l1 < l0
 
 
+@pytest.mark.slow
 def test_jit_train_step_bert_qa_finetune_compiled():
     """BASELINE config 3 lane: BERT (tiny dims, real dropout) SQuAD-style
     QA fine-tune runs entirely through the compiled step with AMP O1 and

@@ -39,6 +39,7 @@ def test_llama_generate():
     assert out.shape == [1, 8]
 
 
+@pytest.mark.slow
 def test_llama_train_converges():
     from paddle_tpu.models import LlamaForCausalLM
     paddle.seed(0)

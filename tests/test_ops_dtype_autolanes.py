@@ -152,8 +152,14 @@ def surfaces():
     return out
 
 
+# the "stat" sweep is the priciest lane (reduction probes compile
+# per shape) and carries the tier-1-excluding slow mark; the other
+# five spaces keep full low-precision coverage in the lane
 @pytest.mark.parametrize("space", ["math", "nn", "manipulation",
-                                   "linalg", "creation", "stat"])
+                                   "linalg", "creation",
+                                   pytest.param(
+                                       "stat",
+                                       marks=pytest.mark.slow)])
 @pytest.mark.parametrize("dt", LOW)
 def test_surface_low_precision_sweep(surfaces, space, dt):
     ops, _ = surfaces[space]

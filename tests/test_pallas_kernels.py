@@ -116,6 +116,7 @@ def test_rmsnorm_matmul_parity():
                                ref(x2, wl2, w2), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flagship_trunk_rmsnorm_matmul_flag_parity(_interpret_mode):
     """FLAGS_pallas_rmsnorm_matmul routes the flagship block entry and
     FFN entry through the fused kernel; the train-step loss must match

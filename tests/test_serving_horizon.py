@@ -1,0 +1,498 @@
+"""Multi-token decode horizon (``decode_horizon=H``): one jitted
+H-micro-step ``lax.scan`` program per decode tick, so the engine pays
+one dispatch, one blocking fetch and one host-bookkeeping pass per H
+tokens instead of per token.
+
+Contract under test:
+* GREEDY TOKEN-EXACTNESS vs ``decode_horizon=1`` across every nasty
+  path — eos mid-block, multi-token stop sequences (host-only
+  knowledge → tail trim + flush), preemption (recompute AND swap
+  resume), prefix caching, int8 KV, ``overlap=True``, TP mp=4;
+* ONE dispatch and ONE fetch per H tokens, pinned through counting
+  wrappers on the step/``_fetch`` seams;
+* the tick's page growth is ONE coalesced claim — at most one
+  ``tables_version`` bump per tick however many rows grew (the
+  batched ``ensure_capacity_batch`` satellite);
+* H-token page pre-claims release audit-clean on every abnormal path
+  (stop-trim, cancel, deadline, quarantined wave);
+* ``mixed=True`` and speculative/prefill engines REJECT the knob with
+  real-constraint messages.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              build_mesh, init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import ContinuousBatchingEngine
+from paddle_tpu.testing import faults
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+    base.update(kw)
+    return LlamaPretrainConfig(**base)
+
+
+_PARAMS = {}
+
+
+def _params(cfg):
+    key = cfg.num_key_value_heads
+    if key not in _PARAMS:
+        mesh = build_mesh(devices=jax.devices()[:1])
+        _PARAMS[key] = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    return _PARAMS[key]
+
+
+def _engine(cfg, params, H, overlap=False, kv_quant=None,
+            num_pages=64, batch=2, host_pages=0, **kw):
+    cache = PagedKVCache(cfg, num_pages=num_pages, pages_max=8,
+                         batch=batch, page=16, kv_quant=kv_quant,
+                         host_pages=host_pages)
+    return ContinuousBatchingEngine(cfg, params, cache,
+                                    decode_horizon=H,
+                                    overlap=overlap, **kw), cache
+
+
+def _drain_map(eng):
+    done = eng.run_to_completion()
+    return {r.rid: list(r.generated) for r in done}
+
+
+# ---------------------------------------------------------------------------
+# token-exactness vs decode_horizon=1
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_horizon_token_exact_vs_h1_churn(kv_quant):
+    """Mixed-length requests streamed through a 2-slot batch (forced
+    queueing + slot reuse): per-request generations at H in {2, 4},
+    sync and overlap, equal the H=1 engine's token-for-token, and the
+    pool drains clean (H=8 rides the eos/stop tests — same programs,
+    kept off this matrix to bound compile count)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(0)
+    specs = [(rng.randint(1, 128, (int(rng.randint(3, 20)),)),
+              int(rng.randint(2, 9))) for _ in range(5)]
+
+    def run(H, overlap):
+        eng, cache = _engine(cfg, params, H, overlap=overlap,
+                             kv_quant=kv_quant)
+        for p, n in specs:
+            eng.submit(p, max_new_tokens=n)
+        got = _drain_map(eng)
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        return got
+
+    ref = run(1, False)
+    combos = ((2, False), (4, True)) if kv_quant else \
+        ((2, False), (2, True), (4, False), (4, True))
+    for H, overlap in combos:
+        assert run(H, overlap) == ref, f"H={H} ov={overlap} diverged"
+
+
+def test_horizon_eos_mid_block():
+    """A row hitting eos mid-horizon stops advancing ON-DEVICE (the
+    folded done mask) and retires with exactly the H=1 generation."""
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = np.random.RandomState(3).randint(1, 128, (8,))
+    eng, _ = _engine(cfg, params, 1, batch=1)
+    eng.submit(prompt, max_new_tokens=12)
+    ref = eng.run_to_completion()[0].generated
+    eos = int(ref[4])                 # fires mid-block at H=4/8
+
+    def run(H, overlap):
+        eng, cache = _engine(cfg, params, H, overlap=overlap,
+                             batch=1, eos_id=eos)
+        eng.submit(prompt, max_new_tokens=12)
+        got = eng.run_to_completion()[0].generated
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        return got
+
+    ref_eos = run(1, False)
+    assert run(4, True) == ref_eos
+    assert run(8, False) == ref_eos
+
+
+def test_horizon_stop_sequence_trims_and_counts():
+    """A host-detected stop sequence mid-block retires the row
+    token-exactly vs H=1 and the device's over-generated tail (at
+    most H-1 tokens) is discarded AND counted in
+    ``horizon_trimmed_tokens`` — the trim-waste observability the
+    A/B's caveat rests on."""
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = np.random.RandomState(3).randint(1, 128, (8,))
+    eng, _ = _engine(cfg, params, 1, batch=1)
+    eng.submit(prompt, max_new_tokens=12)
+    ref = eng.run_to_completion()[0].generated
+    stop = [int(ref[2]), int(ref[3])]
+
+    def run(H, overlap):
+        eng, cache = _engine(cfg, params, H, overlap=overlap, batch=1)
+        eng.submit(prompt, max_new_tokens=12, stop_sequences=[stop])
+        got = eng.run_to_completion()[0].generated
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        return got, eng
+
+    got1, eng1 = run(1, False)
+    assert got1 == ref[:4]
+    assert eng1.horizon_trimmed_tokens == 0
+    for H, overlap in ((4, False), (4, True), (8, True)):
+        got, engh = run(H, overlap)
+        assert got == got1
+        # EXACT trim arithmetic: the stop completes at generated
+        # index 3 = decode-token 3 = in-block micro-step h=2 of the
+        # first block, so the device over-generated the block's
+        # remaining H-3 micro-steps (budget 12 never fires first)
+        assert engh.horizon_trimmed_tokens == H - 3
+        assert engh.horizon_trimmed_tokens == \
+            engh.metrics.horizon_trimmed_tokens.value
+
+
+@pytest.mark.parametrize("host_pages", [0, 16])
+def test_horizon_preemption_token_exact(host_pages):
+    """Pool pressure mid-horizon preempts (recompute at host_pages=0,
+    swap resume with a host tier): generations stay token-exact vs
+    H=1 and the pool drains audit-clean."""
+    cfg = _cfg()
+    params = _params(cfg)
+
+    def run(H, overlap):
+        eng, cache = _engine(cfg, params, H, overlap=overlap,
+                             num_pages=9, host_pages=host_pages)
+        if host_pages:
+            eng.offload_swap_gbps = 1e9      # swap always wins
+        rng = np.random.RandomState(9)
+        for L in (40, 44):
+            eng.submit(rng.randint(1, 128, (L,)), max_new_tokens=30)
+        got = _drain_map(eng)
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        return got, eng
+
+    ref, eref = run(1, False)
+    got, eh = run(4, True)
+    assert got == ref
+    assert eh.preemptions > 0
+    if host_pages:
+        assert eh.resumes_swapped > 0
+
+
+def test_horizon_prefix_cache_token_exact():
+    """Prefix-cache admissions (shared pages + suffix prefill)
+    compose with the horizon: reused pages stay shared across the
+    pre-claimed block, outputs match H=1."""
+    cfg = _cfg()
+    params = _params(cfg)
+
+    def run(H):
+        eng, cache = _engine(cfg, params, H, overlap=True,
+                             enable_prefix_caching=True,
+                             prefill_chunk=32)
+        rng = np.random.RandomState(5)
+        base = rng.randint(1, 128, (34,))
+        eng.submit(base, max_new_tokens=6)
+        eng.submit(np.concatenate([base[:32],
+                                   rng.randint(1, 128, (4,))]),
+                   max_new_tokens=6)
+        got = _drain_map(eng)
+        cache.audit()
+        return got, cache
+
+    ref, _ = run(1)
+    got, cache = run(4)
+    assert got == ref
+    assert cache.prefix_hits > 0
+
+
+@pytest.mark.tp
+def test_horizon_tp_mp4_token_exact():
+    """The horizon scan composed through the ``_build_tp_inner``
+    shard_map seam: one dispatch per H-block on a 4-way mesh,
+    token-exact vs the single-device H=1 engine; the int8-KV TP form
+    matches its own single-device H=1 self."""
+    cfg = _cfg(num_key_value_heads=4)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 128, (int(rng.randint(4, 20)),))
+               for _ in range(4)]
+
+    def run(mp, H, overlap, kv_quant=None, tp_allreduce="fp32"):
+        mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=mp,
+                          devices=jax.devices()[:mp])
+        m = mesh if mp > 1 else None
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+        cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                             page=16, mesh=m, kv_quant=kv_quant)
+        eng = ContinuousBatchingEngine(
+            cfg, params, cache, mesh=m, decode_horizon=H,
+            overlap=overlap, tp_allreduce=tp_allreduce)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=6)
+        got = _drain_map(eng)
+        cache.audit()
+        return got, eng
+
+    ref, _ = run(1, 1, False)
+    got, eng = run(4, 4, True)
+    assert got == ref
+    got_q8, _ = run(4, 4, True, kv_quant="int8")
+    ref_q8, _ = run(1, 1, False, kv_quant="int8")
+    assert got_q8 == ref_q8
+    # the quantized-collective lane runs (statistical bar is pinned
+    # by test_serving_tp; here: the composition dispatches + counts
+    # H micro-steps of collective bytes per block)
+    got_ar, eng_ar = run(4, 2, True, tp_allreduce="int8")
+    assert eng_ar.tp_allreduce_bytes == \
+        eng_ar._tp_bytes_step * 2 * eng_ar.decode_steps
+
+
+# ---------------------------------------------------------------------------
+# dispatch / fetch / capacity-claim counting pins
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("overlap", [False, True])
+def test_horizon_one_dispatch_and_fetch_per_block(overlap):
+    """Budget-bound request (no eos, no stops): H=4 serves the whole
+    decode tail in ceil((max_new-1)/4) dispatches (the overlap lane
+    pays its usual ONE chained lookahead extra, exactly like the
+    single-step pipeline's extra token) with exactly ONE ``_fetch``
+    drain per block — the 1/H amortization the A/B measures, pinned
+    by counting, not timing."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, cache = _engine(cfg, params, 4, overlap=overlap, batch=1)
+    fetches = []
+    orig = eng._fetch
+    eng._fetch = lambda *a: fetches.append(len(a)) or orig(*a)
+    prompt = np.random.RandomState(1).randint(1, 128, (10,))
+    eng.submit(prompt, max_new_tokens=9)     # 8 decode tokens
+    done = eng.run_to_completion()
+    assert len(done[0].generated) == 9
+    # sync: exactly ceil(8/4) blocks; overlap: + the one chained
+    # lookahead block in flight when the on-device done drained
+    blocks = 2 if not overlap else 3
+    assert eng.decode_steps == blocks
+    # one _fetch per horizon block, each draining the [H, B] token +
+    # done arrays together
+    assert fetches == [2] * blocks
+    assert eng.host_syncs == blocks
+
+
+def test_horizon_batched_capacity_one_version_bump():
+    """The satellite pin: a tick growing BOTH active rows claims
+    pages as ONE ``ensure_capacity_batch`` call — ``tables_version``
+    bumps at most once per tick (each bump forces a device tables
+    re-upload; the old per-slot loop paid one per growing row)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, cache = _engine(cfg, params, 8, overlap=True)
+    calls = {"batch": 0, "single": 0, "multi_bump": 0}
+    orig_batch = cache.ensure_capacity_batch
+    orig_single = cache.ensure_capacity
+
+    def counting_batch(needs):
+        calls["batch"] += 1
+        v0 = cache.tables_version
+        orig_batch(needs)
+        if cache.tables_version - v0 > 1:
+            calls["multi_bump"] += 1
+
+    def counting_single(b, new_tokens=1):
+        calls["single"] += 1
+        orig_single(b, new_tokens)
+
+    cache.ensure_capacity_batch = counting_batch
+    cache.ensure_capacity = counting_single
+    rng = np.random.RandomState(2)
+    # equal-length prompts: both rows cross page boundaries on the
+    # same ticks, which under per-slot claims cost one version bump
+    # (= one device tables re-upload) PER ROW
+    for _ in range(2):
+        eng.submit(rng.randint(1, 128, (14,)), max_new_tokens=20)
+    eng.run_to_completion()
+    assert cache.free_pages() == cache.num_pages - 1
+    assert calls["batch"] > 0
+    assert calls["multi_bump"] == 0, \
+        "one coalesced claim must bump tables_version at most once"
+    assert calls["single"] == 0, \
+        "pressure-free growth must take the batched fast path"
+
+    # the batch claim grows BOTH rows in one call with ONE bump
+    cache2 = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                          page=16)
+    cache2.alloc_row(0, 10)
+    cache2.alloc_row(1, 12)
+    v0 = cache2.tables_version
+    cache2.ensure_capacity_batch([(0, 16), (1, 16)])
+    assert cache2.tables_version == v0 + 1
+    assert len(cache2._owned[0]) == 2 and len(cache2._owned[1]) == 2
+    # idempotent re-claim: no growth, no bump
+    cache2.ensure_capacity_batch([(0, 16), (1, 16)])
+    assert cache2.tables_version == v0 + 1
+
+
+def test_horizon_preclaim_clamped_by_remaining():
+    """A row with fewer remaining tokens than H near its table cap
+    must not spuriously ValueError: the pre-claim clamps to the
+    remaining budget (and the generation completes exactly)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, cache = _engine(cfg, params, 8, overlap=True, batch=1)
+    # row capacity is 8 pages x 16 = 128 tokens; prompt 100 + 28 new
+    # tokens = the exact cap, with remaining < H at the tail
+    prompt = np.random.RandomState(4).randint(1, 128, (100,))
+    eng.submit(prompt, max_new_tokens=28)
+    done = eng.run_to_completion()
+    assert done[0].status == "ok"
+    assert len(done[0].generated) == 28
+    cache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# abnormal paths: pre-claims release audit-clean
+# ---------------------------------------------------------------------------
+def test_horizon_cancel_and_deadline_audit_clean():
+    """cancel() and an expired deadline mid-horizon release the
+    victims' H-token pre-claims through the ordinary flush-then-free
+    discipline — audit clean, pool fully drained."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, cache = _engine(cfg, params, 4, overlap=True)
+    now = [1000.0]
+    eng._now = lambda: now[0]
+    rng = np.random.RandomState(6)
+    r1 = eng.submit(rng.randint(1, 128, (10,)), max_new_tokens=40)
+    r2 = eng.submit(rng.randint(1, 128, (12,)), max_new_tokens=40,
+                    deadline_s=5.0)
+    eng.step()
+    eng.step()
+    eng.cancel(r1)
+    now[0] += 10.0                    # r2's deadline passes
+    done = eng.run_to_completion()
+    by = {r.rid: r for r in done}
+    assert by[r1].status == "cancelled"
+    assert by[r2].status == "expired"
+    cache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+
+
+def test_horizon_quarantine_audit_clean():
+    """A step fault mid-horizon quarantines the wave: the poisoned
+    blocks drop undrained, the riders fail loudly, the pre-claimed
+    pages reclaim, and the engine keeps serving (token-exact for the
+    post-fault request)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(1, 128, (10,))
+    eng, _ = _engine(cfg, params, 1, batch=1)
+    eng.submit(prompt, max_new_tokens=6)
+    ref = eng.run_to_completion()[0].generated
+
+    eng, cache = _engine(cfg, params, 4, overlap=True, batch=1)
+    plane = faults.install()
+    try:
+        plane.inject("step_dispatch", RuntimeError("injected"), nth=3)
+        eng.submit(rng.randint(1, 128, (8,)), max_new_tokens=30)
+        done = eng.run_to_completion()
+        assert done[0].status == "error"
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        # the engine survived the quarantine and still serves exactly
+        eng.submit(prompt, max_new_tokens=6)
+        done2 = eng.run_to_completion()
+        assert done2[0].status == "ok"
+        assert done2[0].generated == ref
+        cache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+    finally:
+        faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# knob composition / rejection
+# ---------------------------------------------------------------------------
+def test_horizon_mixed_rejected_with_real_constraint():
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
+                         page=16)
+    with pytest.raises(ValueError, match="mixed tick re-plans"):
+        ContinuousBatchingEngine(cfg, params, cache, mixed=True,
+                                 decode_horizon=4)
+
+
+def test_horizon_speculative_rejected():
+    from paddle_tpu.models.speculative import SpeculativeEngine
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
+                         page=16)
+    dcache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
+                          page=16)
+    with pytest.raises(ValueError, match="plain-decode-lane"):
+        SpeculativeEngine(cfg, params, cache, cfg, params, dcache,
+                          decode_horizon=4)
+
+
+def test_horizon_prefill_engine_rejected():
+    from paddle_tpu.models.disagg import PrefillEngine
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
+                         page=16, host_pages=8)
+    with pytest.raises(ValueError, match="no decode cadence"):
+        PrefillEngine(cfg, params, cache, decode_horizon=4)
+
+
+def test_horizon_invalid_value_rejected():
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
+                         page=16)
+    with pytest.raises(ValueError, match="decode_horizon"):
+        ContinuousBatchingEngine(cfg, params, cache, decode_horizon=0)
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+def test_horizon_metrics_and_health_surfaces():
+    """The horizon instruments exist under their catalogued names,
+    the tokens-per-block histogram records one sample per drained
+    block, and /health carries ``decode_horizon`` +
+    ``horizon_trimmed_tokens``."""
+    from paddle_tpu.inference.serving import GenerationServer
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                         page=16)
+    srv = GenerationServer(cfg, params, cache, decode_horizon=4)
+    eng = srv.engine
+    prompt = np.random.RandomState(1).randint(1, 128, (10,))
+    eng.submit(prompt, max_new_tokens=9)
+    eng.run_to_completion()
+    snap = eng.metrics.registry.snapshot()
+    hist = snap["paddle_tpu_engine_decode_horizon_tokens"]
+    assert hist["count"] == eng.decode_steps == 2
+    assert hist["sum"] == 8.0                # 8 decode tokens
+    assert snap["paddle_tpu_engine_horizon_trimmed_tokens_total"][
+        "value"] == 0
+    h = srv.health_snapshot()
+    assert h["decode_horizon"] == 4
+    assert h["horizon_trimmed_tokens"] == 0

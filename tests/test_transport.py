@@ -346,6 +346,7 @@ def test_agent_survives_garbage_frames(cfg, params):
         agent.die()
 
 
+@pytest.mark.slow
 def test_graceful_shutdown_finishes_inflight_streams(cfg, params):
     ref = _ref(cfg, params, _PROMPTS[:2])
     agent = ReplicaAgent(_factory(cfg, params), lease_s=5.0)

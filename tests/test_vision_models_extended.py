@@ -15,9 +15,17 @@ def _img(n=1, c=3, s=224):
                             .astype("float32"))
 
 
+# the heaviest 224px forwards (deep stacks compiling ~30-60s each on
+# the CPU lane) carry the tier-1-excluding `slow` mark: the cheap
+# members keep the family's forward-shape contract in tier-1, the
+# full matrix runs with `pytest -m slow`
 @pytest.mark.parametrize("ctor", [
-    M.alexnet, M.squeezenet1_0, M.squeezenet1_1, M.mobilenet_v3_small,
-    M.mobilenet_v3_large, M.shufflenet_v2_x0_25, M.shufflenet_v2_swish,
+    pytest.param(M.alexnet, marks=pytest.mark.slow),
+    M.squeezenet1_0, M.squeezenet1_1,
+    pytest.param(M.mobilenet_v3_small, marks=pytest.mark.slow),
+    pytest.param(M.mobilenet_v3_large, marks=pytest.mark.slow),
+    pytest.param(M.shufflenet_v2_x0_25, marks=pytest.mark.slow),
+    pytest.param(M.shufflenet_v2_swish, marks=pytest.mark.slow),
 ])
 def test_forward_shapes_224(ctor):
     m = ctor(num_classes=10)
@@ -26,12 +34,14 @@ def test_forward_shapes_224(ctor):
     assert tuple(out.shape) == (1, 10)
 
 
+@pytest.mark.slow
 def test_densenet121():
     m = M.densenet121(num_classes=7)
     m.eval()
     assert tuple(m(_img()).shape) == (1, 7)
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads():
     m = M.googlenet(num_classes=5)
     m.eval()
@@ -39,6 +49,7 @@ def test_googlenet_aux_heads():
     assert tuple(out.shape) == tuple(a1.shape) == tuple(a2.shape) == (1, 5)
 
 
+@pytest.mark.slow
 def test_inception_v3():
     m = M.inception_v3(num_classes=4)
     m.eval()
@@ -53,6 +64,7 @@ def test_channel_shuffle():
     np.testing.assert_allclose(y, [0, 4, 1, 5, 2, 6, 3, 7])
 
 
+@pytest.mark.slow
 def test_vision_model_trains():
     # one SGD step decreases loss on a tiny batch — exercises BN/depthwise
     # conv/SE gradients through a real model

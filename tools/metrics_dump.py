@@ -377,6 +377,12 @@ def cmd_snapshot(args) -> int:
                 # bench line's engine publishes process-wide)
                 "mixed_ticks_total",
                 "mixed_piggybacked_prefill_tokens_total",
+                # multi-token decode horizon (serving_horizon_ab):
+                # aggregate decode dispatches per generated token
+                # (~1/H when horizon engines dominate the window) +
+                # stop-sequence trim waste
+                "dispatches_per_token",
+                "horizon_trimmed_tokens_total",
                 # disaggregated prefill/decode (the serving_disagg_ab
                 # bench line's coordinator publishes process-wide)
                 "disagg_handoff_pages_total",
