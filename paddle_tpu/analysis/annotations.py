@@ -52,8 +52,18 @@ SYNC_HOT_ROOTS: List[str] = [
     "ContinuousBatchingEngine._admit_batch",
     "ContinuousBatchingEngine._admit_chunked",
     "ContinuousBatchingEngine._admit_swapped",
-    "SpeculativeEngine._decode_once",
-    "SpeculativeEngine._finish_admit",
+    # ISSUE-19 fused speculative lane: one draft+verify dispatch per
+    # round with ONE sanctioned fetch — every other hop (proposal
+    # building, mirror corrections, accept bookkeeping, n-gram table
+    # maintenance) must stay pure host work or the round serializes
+    "ContinuousBatchingEngine._decode_spec_sync",
+    "ContinuousBatchingEngine._decode_spec_overlap",
+    "ContinuousBatchingEngine._dispatch_spec_async",
+    "ContinuousBatchingEngine._drain_spec_entry",
+    "ContinuousBatchingEngine._drain_spec_block",
+    "ContinuousBatchingEngine._spec_admit",
+    "ContinuousBatchingEngine._propose_lookup",
+    "ContinuousBatchingEngine._spec_note_tokens",
     # the fleet routing decision path (PR 8): a routing choice runs on
     # the submit path under the router lock while replicas decode —
     # a blocking host sync here would stall every handler thread, so
@@ -165,6 +175,13 @@ EXTRA_TRACED: List[str] = [
     # ISSUE-15 horizon: the H-micro-step scan stages fn closures (and
     # the micro bodies) inside its own jit
     "paged_decode.make_paged_decode_step_multi",
+    # ISSUE-19 fused speculative: the round program (gamma-iteration
+    # draft scan + batched verify + on-device fold) is one jit built
+    # by a memoised factory; the verify bodies are factory-staged
+    # closures composed into it (and into the TP shard_map form)
+    "paged_decode.make_spec_step",
+    "paged_decode._spec_verify_body",
+    "paged_decode._spec_verify_body_tp",
 ]
 
 
@@ -211,9 +228,14 @@ FLUSH_SAFE: Dict[str, str] = {
         "tokens are attributed against the dispatch-time active "
         "mask, and host-only stop retirements schedule _needs_flush "
         "exactly like _drain_one",
-    "SpeculativeEngine._decode_once":
-        "speculative rounds never populate _inflight — each round "
-        "fetches its own outputs before bookkeeping",
+    "ContinuousBatchingEngine._decode_spec_sync":
+        "synchronous spec lane: overlap=False, there is no pipeline "
+        "— the round's ONE fetch precedes every retirement",
+    "ContinuousBatchingEngine._drain_spec_block":
+        "the spec drain IS the pipeline: a whole round's [C, B] "
+        "emit block is attributed against the DEVICE-CHAIN active "
+        "mask (phantom chained rounds excluded), and host-only stop "
+        "retirements schedule _needs_flush exactly like _drain_one",
     "PrefillEngine._decode_once":
         "prefill engines have no decode pipeline: overlap=True is "
         "rejected at construction, so no dispatch is ever in flight "
@@ -515,7 +537,14 @@ CLAIMS: Dict[str, ClaimSpec] = {
              "pre-claim rides it): the grown pages belong to the row "
              "and release through the same release_row seam on "
              "retire/trim/cancel/quarantine — audit-pinned by "
-             "test_serving_horizon"),
+             "test_serving_horizon.  The spec lane's DRAFT cache is "
+             "a second pool under the SAME claim: _spec_admit "
+             "acquires the draft row alongside the target row, "
+             "per-round growth claims C slots for spec-on rows only "
+             "(the aux-rows mask — off rows must not leak draft "
+             "pages), and _release_aux releases both pools through "
+             "every retire/preempt/cancel/quarantine path — "
+             "audit-pinned on both caches by test_serving_spec"),
     # host-tier swap record: parked preempted rows + adopted handoff
     # blobs.  The handle MUST land in an audited registry
     # (_swap_handles) or be discarded — a dropped handle pins host

@@ -92,6 +92,7 @@ def wire_request(req: Request, trace_id=None) -> dict:
             "t_admit": float(req.t_admit),
             "t_first_token": float(req.t_first_token),
             "t_finish": float(req.t_finish),
+            "spec": req.spec,
             "phase": req.phase, "t_phase": float(req.t_phase),
             "phase_log": [[p, float(a), float(b)]
                           for p, a, b in req.phase_log],
@@ -113,7 +114,8 @@ def request_from_wire(d: dict, prompt: np.ndarray) -> Request:
                   t_admit=shift(d["t_admit"]),
                   t_first_token=shift(d["t_first_token"]),
                   t_finish=shift(d["t_finish"]),
-                  deadline=shift(d["deadline"]))
+                  deadline=shift(d["deadline"]),
+                  spec=d.get("spec"))
     req.done = bool(d["done"])
     req.status = d["status"]
     req.error = d["error"]
@@ -474,7 +476,8 @@ class ReplicaAgent:
         rid = self._sup.submit(
             prompt, max_new_tokens=header["max_new_tokens"],
             stop_sequences=header.get("stop_sequences"),
-            deadline_s=header.get("deadline_s"))
+            deadline_s=header.get("deadline_s"),
+            spec=header.get("spec"))
         self._mut += 1
         self._remember_key_locked(key, rid)
         if header.get("trace_id") is not None:
@@ -963,7 +966,7 @@ class _RemoteSupervisor:
     # -- placement --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None, deadline_s=None, trace=None,
-               fleet_rid=None) -> int:
+               fleet_rid=None, spec=None) -> int:
         h = self._h
         prompt = np.ascontiguousarray(np.asarray(prompt, np.int64))
         self._nsub += 1
@@ -972,6 +975,7 @@ class _RemoteSupervisor:
         header = {"max_new_tokens": int(max_new_tokens),
                   "stop_sequences": stop_sequences,
                   "deadline_s": deadline_s,
+                  "spec": spec,
                   "key": f"{h.client_id}:{key_part}",
                   "trace_id": trace.trace_id
                   if trace is not None else None}

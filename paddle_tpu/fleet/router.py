@@ -180,6 +180,10 @@ class _FleetRequest:
     # (the failover_gap span's start; 0.0 = not orphaned)
     trace: Optional[object] = None
     t_orphan: float = 0.0
+    # per-request speculative toggle (None inherits the replica
+    # engine's SpecConfig.default_on); rides every placement,
+    # including failover re-placements
+    spec: Optional[bool] = None
 
 
 class FleetRouter:
@@ -376,7 +380,8 @@ class FleetRouter:
     # -- client side ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               spec: Optional[bool] = None) -> int:
         """Route + queue a request; returns the FLEET rid (stable
         across failovers).  Raises ``ValueError`` for a request no
         replica could ever hold (same validation as the engine) and
@@ -386,7 +391,8 @@ class FleetRouter:
         router lock)."""
         with self._lock:
             return self._submit_locked(prompt, max_new_tokens,
-                                       stop_sequences, deadline_s)
+                                       stop_sequences, deadline_s,
+                                       spec)
 
     def cancel(self, rid: int) -> bool:
         """Cancel a fleet request wherever it lives — on a replica
@@ -487,14 +493,14 @@ class FleetRouter:
     # -- locked internals (CONTRACT: caller holds _lock; registered in
     #    analysis/annotations.py locked_methods) --------------------------
     def _submit_locked(self, prompt, max_new_tokens, stop_sequences,
-                       deadline_s) -> int:
+                       deadline_s, spec=None) -> int:
         prompt = np.asarray(prompt, np.int64)
         now = self._now()
         deadline = 0.0 if deadline_s is None \
             else now + float(deadline_s)
         freq = _FleetRequest(self._next_rid, prompt,
                              int(max_new_tokens), stop_sequences,
-                             deadline, now)
+                             deadline, now, spec=spec)
         if self.tracer is not None:
             # the router OWNS the trace (managed=True): replicas
             # report phase spans into it, and the close lands at the
@@ -665,6 +671,11 @@ class FleetRouter:
                     # after an ambiguous timeout dedups on the agent
                     # by (client id, fleet rid)
                     extra["fleet_rid"] = freq.rid
+                if freq.spec is not None:
+                    # only forward an explicit override: replicas
+                    # without a spec lane must keep accepting
+                    # default (None) traffic
+                    extra["spec"] = freq.spec
                 local = h.supervisor.submit(
                     freq.prompt, max_new_tokens=freq.max_new_tokens,
                     stop_sequences=freq.stop_sequences,

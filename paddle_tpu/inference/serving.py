@@ -806,7 +806,10 @@ class GenerationServer:
                  "requests_finished": eng.requests_finished}
             if hasattr(eng, "spec_rounds"):
                 h["spec_rounds"] = eng.spec_rounds
+                h["spec_drafted"] = eng.spec_drafted
                 h["spec_accepted"] = eng.spec_accepted
+                h["spec_acceptance"] = round(
+                    eng.spec_accepted / max(eng.spec_drafted, 1), 4)
                 h["gamma"] = eng.gamma
             return h, None
         # metrics path: copy the handful of attrs the registry
@@ -899,9 +902,14 @@ class GenerationServer:
                  "paddle_tpu_engine_requests_finished_total"))}
         if gamma is not None:                       # speculative
             h["spec_rounds"] = int(v(
-                snap, "paddle_tpu_spec_rounds_total"))
+                snap, "paddle_tpu_engine_spec_rounds_total"))
+            h["spec_drafted"] = int(v(
+                snap, "paddle_tpu_engine_spec_drafted_tokens_total"))
             h["spec_accepted"] = int(v(
-                snap, "paddle_tpu_spec_accepted_tokens_total"))
+                snap,
+                "paddle_tpu_engine_spec_accepted_tokens_total"))
+            h["spec_acceptance"] = round(
+                h["spec_accepted"] / max(h["spec_drafted"], 1), 4)
             h["gamma"] = gamma
         if "paddle_tpu_disagg_handoff_pages_total" in snap:
             # disaggregated prefill/decode front (DisaggCoordinator /

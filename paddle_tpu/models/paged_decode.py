@@ -2493,6 +2493,489 @@ def make_mixed_step(cfg: LlamaPretrainConfig,
     return jitted
 
 
+_spec_verify_cache: dict = {}
+
+
+def _spec_verify_body(cfg: LlamaPretrainConfig, q8: bool):
+    """Memoised UNJITTED batched verify-with-history body — the
+    candidate-scoring math of :func:`make_spec_step` factored out so
+    the fused draft+verify program can compose it with the draft scan,
+    the page scatter and the accept fold inside ONE outer jit.
+
+    ``run(params, toks [B, C], kpool, vpool, kscale, vscale,
+    tables [B, P], ctx_len [B]) -> (x [B, C, H], ks, vs
+    [Lyr, B, C, nkv, d])`` — per-row tables, per-row positions,
+    per-row visibility, exactly :func:`_prefill_chunk_batched` PLUS
+    the int8 dequant gather (the same scale-plane indexing the packed
+    prefix-history lane uses), so speculative serving composes with
+    quantised pools instead of rejecting them.  ``kscale``/``vscale``
+    are ignored when ``q8`` is False (pass any placeholder)."""
+    hit = _spec_verify_cache.get((_cfg_key(cfg), q8))
+    if hit is not None:
+        return hit
+    from .decode import _grouped_attn
+    from ..ops.pallas.paged_attention import quantize_kv_token
+
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    dt = cfg.dtype
+
+    def _qdq(t):
+        # int8 parity: the per-token q8 decode step attends over its
+        # OWN token's K/V read back quantized from the pool, so the
+        # verify's within-block fresh K/V must round-trip through the
+        # same quantizer or multi-token rounds drift off the oracle
+        B, C = t.shape[0], t.shape[1]
+        tq, sc = quantize_kv_token(t.reshape(B * C, *t.shape[2:]))
+        return (tq.astype(jnp.float32) * sc[..., None]).reshape(
+            t.shape).astype(dt)
+
+    def run(params, toks, kpool, vpool, kscale, vscale, tables,
+            ctx_len):
+        B, C = toks.shape
+        P = tables.shape[1]
+        page = kpool.shape[3]
+        S_ctx = P * page
+        x = jnp.take(params["embed"], toks, axis=0).astype(dt)
+        pos = ctx_len[:, None] + jnp.arange(C, dtype=jnp.int32)
+        ctx_vis = (jnp.arange(S_ctx, dtype=jnp.int32)[None]
+                   < ctx_len[:, None])                 # [B, S_ctx]
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(ctx_vis[:, None], (B, C, S_ctx)),
+             jnp.broadcast_to(jnp.tril(jnp.ones((C, C), bool))[None],
+                              (B, C, C))], axis=2)
+        mask = mask[:, None, None]        # [B, 1, 1, C, S_ctx + C]
+
+        def gather_ctx(pool, scale):
+            # [num_pages, nkv, page, d] -> per-row pages [B, P, ...];
+            # int8 pools dequant through the gathered scale planes
+            pages = pool[tables]          # [B, P, nkv, page, d]
+            out = pages.transpose(0, 1, 3, 2, 4).reshape(
+                B, S_ctx, nkv, d)
+            if q8:
+                sc = scale[tables].transpose(0, 1, 3, 2).reshape(
+                    B, S_ctx, nkv)
+                out = out.astype(jnp.float32) * sc[..., None]
+            return out.astype(dt)
+
+        def layer(carry, inp):
+            if q8:
+                bp, kp_l, vp_l, ks_l, vs_l = inp
+            else:
+                bp, kp_l, vp_l = inp
+                ks_l = vs_l = None
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, C, n, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, C, nkv, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, C, nkv, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            ku, vu = (_qdq(k), _qdq(v)) if q8 else (k, v)
+            ck = jnp.concatenate([gather_ctx(kp_l, ks_l), ku], axis=1)
+            cv = jnp.concatenate([gather_ctx(vp_l, vs_l), vu], axis=1)
+            attn = _grouped_attn(q, ck, cv, mask)
+            out = _block_post_attn(bp, xc, attn, cfg)
+            return out, (k, v)
+
+        xs = (params["blocks"], kpool, vpool)
+        if q8:
+            xs = xs + (kscale, vscale)
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
+        return x, ks, vs
+
+    _spec_verify_cache[(_cfg_key(cfg), q8)] = run
+    return run
+
+
+_spec_verify_tp_cache: dict = {}
+
+
+def _spec_verify_body_tp(cfg: LlamaPretrainConfig, mesh, q8: bool):
+    """Memoised UNJITTED (but shard_map'd) TP verify-with-history body
+    — :func:`_spec_verify_body` on a mesh, same signature.  Per-row
+    tables/positions/visibility are replicated host state, the context
+    gather (int8 dequant via the LOCAL scale planes — page ids
+    replicated, heads sharded, nothing crosses the mp axis) and
+    attention run on local heads, and wo / w_down reduce with exact fp
+    psums: verification must stay exact, it is what makes speculative
+    output provably the target model's greedy sequence
+    (``tp_allreduce='int8'`` is a DRAFT-lane knob).  Returns
+    replicated ``x [B, C, H]`` and head-sharded ``ks``/``vs``."""
+    ckey = (_cfg_key(cfg), mesh, q8)
+    hit = _spec_verify_tp_cache.get(ckey)
+    if hit is not None:
+        return hit
+    from jax.sharding import PartitionSpec as P
+    from .llama_pretrain import param_specs
+    from .decode import _grouped_attn
+
+    shard_map = _shard_map_fn()
+    mp = mesh.shape["mp"]
+    n, d = cfg.num_attention_heads, cfg.head_dim
+    nkv = cfg.num_key_value_heads
+    if n % mp or nkv % mp:
+        raise ValueError(f"heads {n}/{nkv} must divide over mp={mp}")
+    n_l, nkv_l = n // mp, nkv // mp
+    dt = cfg.dtype
+    ax = "mp"
+    from ..ops.pallas.paged_attention import quantize_kv_token
+
+    def _qdq(t):
+        # same int8 read-back parity as the single-device verify body
+        B, C = t.shape[0], t.shape[1]
+        tq, sc = quantize_kv_token(t.reshape(B * C, *t.shape[2:]))
+        return (tq.astype(jnp.float32) * sc[..., None]).reshape(
+            t.shape).astype(dt)
+
+    def run_local(params, toks, kpool, vpool, kscale, vscale, tables,
+                  ctx_len):
+        B, C = toks.shape
+        Pg = tables.shape[1]
+        page = kpool.shape[3]
+        S_ctx = Pg * page
+        x = _embed_vocab_parallel(params["embed"], toks, ax, dt)
+        pos = ctx_len[:, None] + jnp.arange(C, dtype=jnp.int32)
+        ctx_vis = (jnp.arange(S_ctx, dtype=jnp.int32)[None]
+                   < ctx_len[:, None])
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(ctx_vis[:, None], (B, C, S_ctx)),
+             jnp.broadcast_to(jnp.tril(jnp.ones((C, C), bool))[None],
+                              (B, C, C))], axis=2)
+        mask = mask[:, None, None]
+
+        def gather_ctx(pool, scale):
+            pages = pool[tables]      # [B, P, nkv_l, page, d]
+            out = pages.transpose(0, 1, 3, 2, 4).reshape(
+                B, S_ctx, nkv_l, d)
+            if q8:
+                sc = scale[tables].transpose(0, 1, 3, 2).reshape(
+                    B, S_ctx, nkv_l)
+                out = out.astype(jnp.float32) * sc[..., None]
+            return out.astype(dt)
+
+        def layer(carry, inp):
+            if q8:
+                bp, kp_l, vp_l, ks_l, vs_l = inp
+            else:
+                bp, kp_l, vp_l = inp
+                ks_l = vs_l = None
+            xc = carry
+            y = _rms_norm(xc, bp["ln1"], cfg.rms_norm_eps)
+            q = _mm(y, bp["wq"], dt).reshape(B, C, n_l, d)
+            k = _mm(y, bp["wk"], dt).reshape(B, C, nkv_l, d)
+            v = _mm(y, bp["wv"], dt).reshape(B, C, nkv_l, d)
+            q = _rope_at(q, cfg.rope_theta, pos)
+            k = _rope_at(k, cfg.rope_theta, pos)
+            ku, vu = (_qdq(k), _qdq(v)) if q8 else (k, v)
+            ck = jnp.concatenate([gather_ctx(kp_l, ks_l), ku], axis=1)
+            cv = jnp.concatenate([gather_ctx(vp_l, vs_l), vu], axis=1)
+            attn = _grouped_attn(q, ck, cv, mask)
+            o = _mm(attn.reshape(B, C, n_l * d), bp["wo"], dt)
+            xc = xc + jax.lax.psum(o, ax)
+            res = xc
+            y2 = _rms_norm(xc, bp["ln2"], cfg.rms_norm_eps)
+            act = (jax.nn.silu(_mm(y2, bp["w_gate"], dt))
+                   * _mm(y2, bp["w_up"], dt))
+            ffn = _mm(act, bp["w_down"], dt)
+            return res + jax.lax.psum(ffn, ax), (k, v)
+
+        xs = (params["blocks"], kpool, vpool)
+        if q8:
+            xs = xs + (kscale, vscale)
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
+        return x, ks, vs
+
+    pool_spec = P(None, None, "mp", None, None)
+    scale_spec = P(None, None, "mp", None) if q8 else P()
+    run = shard_map(
+        run_local, mesh=mesh,
+        in_specs=(param_specs(cfg, pp=1), P(), pool_spec, pool_spec,
+                  scale_spec, scale_spec, P(), P()),
+        out_specs=(P(), P(None, None, None, "mp", None),
+                   P(None, None, None, "mp", None)),
+        check_vma=False)
+    _spec_verify_tp_cache[ckey] = run
+    return run
+
+
+_spec_step_cache: dict = {}
+
+
+def make_spec_step(cfg: LlamaPretrainConfig, gamma: int,
+                   draft_cfg: Optional[LlamaPretrainConfig] = None,
+                   kv_quant: Optional[str] = None,
+                   draft_kv_quant: Optional[str] = None,
+                   mesh=None, tp_allreduce: str = "fp32"):
+    """ONE jitted program per SPECULATIVE serving round: the
+    gamma-iteration draft scan (draft params + draft cache pages) AND
+    the batched target verify run in the SAME dispatch, with the
+    per-slot accept-count / done masks folded on-device — the
+    speculative form of :func:`make_paged_decode_step_multi`'s
+    fuse-the-loop move.  The engine pays one dispatch (and one
+    blocking fetch) per round of up to gamma+1 committed tokens, and
+    the chained loop state feeds round k+1's dispatch with zero host
+    round-trips.
+
+    Greedy-only by construction: verification accepts the longest
+    candidate prefix that MATCHES the target argmax, then commits the
+    target's own correction token — the committed stream is exactly
+    ``g[:, :k+1]``, the target model's greedy continuation, which is
+    what makes speculative output provably token-identical to plain
+    greedy decode (the engine rejects ``temperature > 0``).
+
+    With ``draft_cfg`` (draft-model drafting):
+
+    ``fn(params, dparams, kpool, vpool, [kscale, vscale,] dkpool,
+    dvpool, [dkscale, dvscale,] tables, dtables, lens, tok, prev,
+    active, remaining, spec_on, eos, key) -> (pools..., dpools...,
+    toks [C, B], dones [C, B], emits [C, B], accepts [B], tok',
+    prev', lens', remaining', active')`` with ``C = gamma + 1``.
+
+    * the draft scan runs gamma+1 micro-steps of the draft model's
+      decode body: micro-step 0 is a CATCH-UP feed of ``prev``
+      (= x[lens-1], the second-to-last committed token) at draft
+      position lens-1 — an idempotent rewrite when the draft cache is
+      already caught up, and exactly the write that realigns it after
+      a full-accept round left it one position behind; micro-steps
+      1..gamma chain ``tok``, d1, ..., producing the drafts.  Draft
+      writes for inactive / spec-off rows steer to junk page 0 via a
+      masked ``dtables`` view;
+    * the verify half scores all C candidates ``[tok, d1..dgamma]``
+      at per-row offsets over the cached target pages
+      (:func:`_spec_verify_body` — ctx-len masking keeps stale
+      beyond-lens K/V invisible) and scatters their fresh K/V into
+      the target pages INSIDE the program: destination pages come
+      from the on-device table gather, with inactive rows and
+      beyond-capacity positions steered to junk page 0 (the engine
+      pre-claims gamma+1 tokens of pages per active slot, so real
+      writes always land in claimed pages);
+    * the accept fold is a C-iteration scan mirroring the async
+      lane's :func:`_advance_loop_state` under a per-step emit window
+      ``j < accepts+1``: ``toks[j]``/``dones[j]``/``emits[j]`` are
+      micro-step j's committed token / just-retired mask / validity
+      mask, and rows with ``spec_on`` False commit exactly their
+      plain greedy token (the accept window collapses to 1) — per
+      request spec on/off composes in one batch with zero extra
+      dispatches;
+    * ``accepts`` is the raw per-row accepted-draft count (before
+      eos/budget truncation) for the acceptance-rate instruments.
+
+    Without ``draft_cfg`` (PROMPT-LOOKUP / any host draft source) the
+    draft scan, draft pools, ``dtables`` and ``prev`` drop out and
+    the candidates arrive as an input:
+
+    ``fn(params, kpool, vpool, [kscale, vscale,] tables, lens, tok,
+    drafts [B, gamma], active, remaining, spec_on, eos, key) ->
+    (pools..., toks, dones, emits, accepts, tok', lens', remaining',
+    active')``
+
+    With ``mesh`` (mp>1) the draft micro-steps run through the
+    :func:`_build_tp_inner` seam (``tp_allreduce="int8"`` allowed —
+    quantization noise only costs acceptance, never correctness) and
+    the verify through :func:`_spec_verify_body_tp` (exact-fp psums);
+    scatter and fold ride GSPMD at the outer-jit level like
+    :func:`make_mixed_step`.  ``kv_quant``/``draft_kv_quant`` select
+    int8 pool forms independently per cache.
+    """
+    G = int(gamma)
+    if G < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    C = G + 1
+    q8 = kv_quant == "int8"
+    dq8 = draft_kv_quant == "int8"
+    draft = draft_cfg is not None
+    mesh_key = mesh if (mesh is not None
+                        and mesh.shape.get("mp", 1) > 1) else None
+    ckey = (_cfg_key(cfg), _cfg_key(draft_cfg) if draft else None, G,
+            kv_quant, draft_kv_quant if draft else None, mesh_key,
+            tp_allreduce if mesh_key is not None else "fp32")
+    hit = _spec_step_cache.get(ckey)
+    if hit is not None:
+        return hit
+
+    from ..ops.pallas.paged_attention import quantize_kv_token
+    dt = cfg.dtype
+
+    if mesh_key is not None:
+        verify = _spec_verify_body_tp(cfg, mesh, q8)
+        dbase = _build_tp_inner(draft_cfg, mesh, 0.0, draft_kv_quant,
+                                0, 1.0, tp_allreduce=tp_allreduce) \
+            if draft else None
+    else:
+        verify = _spec_verify_body(cfg, q8)
+        if draft:
+            dstep, dstep_q8 = _build_step_fns(draft_cfg, 0.0, False,
+                                              0, 1.0)
+            dbase = dstep_q8 if dq8 else dstep
+        else:
+            dbase = None
+
+    def core(params, dparams, kpool, vpool, kscale, vscale, dkp, dvp,
+             dksc, dvsc, tables, dtables, lens, tok, prev, drafts_in,
+             active, remaining, spec_on, eos, key):
+        B = tok.shape[0]
+        page = kpool.shape[3]
+        S_ctx = tables.shape[1] * page
+
+        if draft:
+            # draft half: gamma+1 chained micro-steps (catch-up, tok,
+            # then the drafts feeding themselves); junk writes for
+            # inactive / spec-off rows land on draft page 0
+            dtab = jnp.where((active & spec_on)[:, None], dtables, 0)
+            subs = jax.random.split(key, G + 1)
+            idx = jnp.arange(G + 1, dtype=lens.dtype)
+
+            def micro(carry, inp):
+                i, sub = inp
+                if dq8:
+                    kp, vp, ks, vs, feed = carry
+                else:
+                    kp, vp, feed = carry
+                f = jnp.where(i == 0, prev,
+                              jnp.where(i == 1, tok, feed))
+                dl = jnp.maximum(lens - 1 + i, 0)
+                if dq8:
+                    kp, vp, ks, vs, out = dbase(
+                        dparams, kp, vp, ks, vs, dtab, dl, f, sub)
+                    out = out.astype(tok.dtype)
+                    return (kp, vp, ks, vs, out), out
+                kp, vp, out = dbase(dparams, kp, vp, dtab, dl, f,
+                                    sub)
+                out = out.astype(tok.dtype)
+                return (kp, vp, out), out
+
+            carry0 = (dkp, dvp, dksc, dvsc, tok) if dq8 \
+                else (dkp, dvp, tok)
+            carry, outs = jax.lax.scan(micro, carry0, (idx, subs))
+            if dq8:
+                dkp, dvp, dksc, dvsc = carry[:4]
+            else:
+                dkp, dvp = carry[:2]
+            d = jnp.transpose(outs[1:], (1, 0))     # [B, G]
+        else:
+            d = drafts_in                           # [B, G]
+
+        # verify half: score every candidate at its row's offset over
+        # the cached pages, then the shared logits tail (greedy)
+        cand = jnp.concatenate([tok[:, None], d], axis=1)  # [B, C]
+        sc_k = kscale if q8 else jnp.zeros((1,), jnp.float32)
+        sc_v = vscale if q8 else jnp.zeros((1,), jnp.float32)
+        x, ks, vs = verify(params, cand, kpool, vpool, sc_k, sc_v,
+                           tables, lens)
+        h = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = _mm(h, params["lm_head"], dt).astype(jnp.float32)
+        g = jnp.argmax(logits, axis=-1).astype(tok.dtype)  # [B, C]
+
+        # scatter the C fresh K/V per row into the target pages;
+        # inactive rows and beyond-capacity positions steer to junk
+        # page 0 (beyond-lens entries are masked stale until the next
+        # round overwrites them)
+        pos = lens[:, None] + jnp.arange(C, dtype=lens.dtype)
+        ok = active[:, None] & (pos < S_ctx)
+        pidx = jnp.where(ok, pos // page, 0)
+        dest_page = jnp.where(
+            ok, jnp.take_along_axis(tables, pidx, axis=1), 0)
+        dp = dest_page.reshape(-1)
+        ds = (pos % page).reshape(-1)
+        Lyr, nkv_o, d_o = ks.shape[0], ks.shape[3], ks.shape[4]
+        ksf = ks.reshape(Lyr, B * C, nkv_o, d_o)
+        vsf = vs.reshape(Lyr, B * C, nkv_o, d_o)
+        if q8:
+            ksf, ksc2 = quantize_kv_token(ksf)
+            vsf, vsc2 = quantize_kv_token(vsf)
+        kpool = kpool.at[:, dp, :, ds, :].set(
+            jnp.transpose(ksf, (1, 0, 2, 3)).astype(kpool.dtype))
+        vpool = vpool.at[:, dp, :, ds, :].set(
+            jnp.transpose(vsf, (1, 0, 2, 3)).astype(vpool.dtype))
+        if q8:
+            kscale = kscale.at[:, dp, :, ds].set(
+                jnp.transpose(ksc2, (1, 0, 2)))
+            vscale = vscale.at[:, dp, :, ds].set(
+                jnp.transpose(vsc2, (1, 0, 2)))
+
+        # accept fold: longest matching prefix + the correction token
+        # == commit g[:, :k+1]; spec-off rows collapse to 1 (their
+        # plain greedy token), so on/off mixes in one batch
+        match = ((d == g[:, :G]) & spec_on[:, None]
+                 & active[:, None])                 # [B, G]
+        k_acc = jnp.sum(
+            jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        n_acc = k_acc + 1
+
+        def fold(carry, inp):
+            j, gj = inp
+            tok_c, prev_c, lens_c, rem_c, alive_c = carry
+            em = alive_c & (j < n_acc)
+            nxt = jnp.where(em, gj, tok_c)
+            prev2 = jnp.where(em, tok_c, prev_c)
+            lens2 = lens_c + em.astype(lens_c.dtype)
+            rem2 = rem_c - em.astype(rem_c.dtype)
+            done = em & ((nxt == eos) | (rem2 <= 0))
+            return ((nxt, prev2, lens2, rem2, alive_c & ~done),
+                    (nxt, done, em))
+
+        jdx = jnp.arange(C, dtype=jnp.int32)
+        (tok_f, prev_f, lens_f, rem_f, act_f), (toks, dones, emits) \
+            = jax.lax.scan(fold, (tok, prev, lens, remaining, active),
+                           (jdx, jnp.transpose(g, (1, 0))))
+
+        outs = [kpool, vpool]
+        if q8:
+            outs += [kscale, vscale]
+        if draft:
+            outs += [dkp, dvp]
+            if dq8:
+                outs += [dksc, dvsc]
+        outs += [toks, dones, emits, k_acc, tok_f]
+        if draft:
+            outs.append(prev_f)
+        outs += [lens_f, rem_f, act_f]
+        return tuple(outs)
+
+    # positional layout varies with (draft, q8, dq8); unpack
+    # generically so one core serves every form
+    def fn(*args):
+        it = iter(args)
+        params = next(it)
+        dparams = next(it) if draft else None
+        kpool, vpool = next(it), next(it)
+        kscale = next(it) if q8 else None
+        vscale = next(it) if q8 else None
+        if draft:
+            dkp, dvp = next(it), next(it)
+            dksc = next(it) if dq8 else None
+            dvsc = next(it) if dq8 else None
+        else:
+            dkp = dvp = dksc = dvsc = None
+        tables = next(it)
+        dtables = next(it) if draft else None
+        lens, tok = next(it), next(it)
+        prev = next(it) if draft else tok
+        drafts_in = None if draft else next(it)
+        active, remaining = next(it), next(it)
+        spec_on, eos, key = next(it), next(it), next(it)
+        return core(params, dparams, kpool, vpool, kscale, vscale,
+                    dkp, dvp, dksc, dvsc, tables, dtables, lens, tok,
+                    prev, drafts_in, active, remaining, spec_on, eos,
+                    key)
+
+    i = 2 if draft else 1                  # index of kpool
+    don = [i, i + 1]
+    i += 2
+    if q8:
+        don += [i, i + 1]
+        i += 2
+    if draft:
+        don += [i, i + 1]
+        i += 2
+        if dq8:
+            don += [i, i + 1]
+    jitted = jax.jit(fn, donate_argnums=tuple(don))
+    _spec_step_cache[ckey] = jitted
+    return jitted
+
+
 def generate_paged(cfg: LlamaPretrainConfig, params, prompt,
                    max_new_tokens: int, cache: PagedKVCache,
                    temperature: float = 0.0, seed: int = 0,

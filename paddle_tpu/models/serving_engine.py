@@ -51,11 +51,12 @@ from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            make_paged_decode_step,
                            make_paged_decode_step_async,
                            make_paged_decode_step_multi,
-                           make_paged_decode_step_tp,
+                           make_paged_decode_step_tp, make_spec_step,
                            tp_collective_bytes_per_step)
 
 __all__ = ["ContinuousBatchingEngine", "EngineDeadError",
-           "EngineSupervisor", "QueueFullError", "Request"]
+           "EngineSupervisor", "QueueFullError", "Request",
+           "SpecConfig"]
 
 
 class QueueFullError(RuntimeError):
@@ -182,6 +183,12 @@ class Request:
     slot: Optional[int] = None
     done: bool = False
     stop_sequences: Optional[List[List[int]]] = None
+    # per-request speculative toggle: True/False overrides the
+    # engine SpecConfig's default_on; None inherits it.  Rows with
+    # spec off ride the SAME fused round (their accept window
+    # collapses to one plain greedy token) — on/off mixes in one
+    # batch with zero extra dispatches.
+    spec: Optional[bool] = None
     admit_seq: int = -1                   # admission order (preemption)
     preempted: int = 0                    # times evicted + requeued
     # lifecycle timestamps (time.monotonic; 0.0 = not reached).
@@ -209,6 +216,49 @@ class Request:
     t_phase: float = 0.0
     phase_log: List = field(default_factory=list)
     trace: Optional[object] = None
+
+
+@dataclass
+class SpecConfig:
+    """Speculative decoding as a first-class engine lane:
+    ``ContinuousBatchingEngine(spec=SpecConfig(...))`` replaces the
+    old SpeculativeEngine subclass — every decode round becomes ONE
+    fused draft+verify dispatch (:func:`make_spec_step`) committing
+    up to ``gamma + 1`` tokens per active row, token-exact vs plain
+    greedy decode (exact verification), composed with the sync and
+    overlap lanes, int8-KV pools, TP meshes, preemption and
+    prefix caching.
+
+    ``source``:
+
+    * ``"draft"`` — a small DRAFT MODEL proposes: ``draft_cfg`` /
+      ``draft_params`` / ``draft_cache`` are required; the
+      gamma-iteration draft scan runs inside the same dispatch as
+      the verify.  On a TP mesh the draft cache must be built on the
+      ENGINE's mesh (kv-head-sharded like the target pool).
+    * ``"prompt_lookup"`` — MODEL-FREE n-gram drafting: the host
+      matches the last ``ngram`` committed tokens against each
+      request's own history and proposes the continuation of the
+      previous occurrence (great for extractive/repetitive outputs;
+      zero extra model plumbing, so fleet and disagg decode
+      replicas get spec through a single knob).  Proposals feed the
+      verify-only fused form; a miss simply costs acceptance.
+
+    ``adaptive_gamma`` retunes gamma each round from the acceptance
+    EMA in ``[1, max_gamma]``; each distinct gamma compiles one
+    fused program (memoised — a bounded, one-time cost per value).
+
+    ``default_on`` is the per-request default; ``submit(spec=...)``
+    overrides per request."""
+    gamma: int = 4
+    source: str = "draft"
+    draft_cfg: Optional[LlamaPretrainConfig] = None
+    draft_params: object = None
+    draft_cache: Optional[PagedKVCache] = None
+    adaptive_gamma: bool = False
+    max_gamma: int = 8
+    ngram: int = 3
+    default_on: bool = True
 
 
 class ContinuousBatchingEngine:
@@ -240,6 +290,7 @@ class ContinuousBatchingEngine:
                  mixed_token_budget: int = 256,
                  mixed_ctx_cap: Optional[int] = None,
                  decode_horizon: int = 1,
+                 spec: Optional[SpecConfig] = None,
                  tracer=None):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
@@ -520,6 +571,112 @@ class ContinuousBatchingEngine:
             self._step = make_paged_decode_step(
                 cfg, temperature, kv_quant=cache.kv_quant,
                 top_k=top_k, top_p=top_p)
+        # -- SPECULATIVE LANE (spec=SpecConfig(...)) ------------------
+        # every decode round is ONE fused draft+verify dispatch
+        # (make_spec_step) committing up to gamma+1 tokens per row —
+        # token-exact vs plain greedy (exact verification), one
+        # _fetch per round, sync and overlap cadence alike.
+        self._spec = spec
+        if spec is not None:
+            if temperature != 0.0:
+                raise ValueError(
+                    "speculative serving is greedy-only (exact "
+                    "verification); temperature must be 0")
+            if self._mixed:
+                # the real constraint: the mixed tick re-plans its
+                # prefill stream on the host between dispatches,
+                # which the fused draft+verify scan cannot replay —
+                # the same reason decode_horizon rejects mixed
+                raise ValueError(
+                    "spec does not compose with mixed=True: the "
+                    "mixed tick re-plans its prefill stream on the "
+                    "host between consecutive dispatches, which the "
+                    "fused draft+verify program cannot replay — use "
+                    "mixed=True (fused admission) OR spec (fused "
+                    "speculative decode), not both")
+            if self.decode_horizon > 1:
+                # the real constraint: both knobs are the SAME fused
+                # multi-token-program pattern over the chained loop
+                # state — a speculative round already advances up to
+                # gamma+1 tokens per dispatch, so stacking an H-deep
+                # scan of rounds multiplies the worst-case page
+                # pre-claim (H*(gamma+1)) and the stop-sequence trim
+                # window for no additional dispatch amortization
+                raise ValueError(
+                    "decode_horizon > 1 does not compose with spec: "
+                    "a speculative round IS the multi-token fused "
+                    "program (up to gamma+1 committed tokens per "
+                    "dispatch) — tune spec.gamma instead of stacking "
+                    "a second horizon scan on top")
+            if spec.source not in ("draft", "prompt_lookup"):
+                raise ValueError(
+                    "SpecConfig.source must be 'draft' or "
+                    f"'prompt_lookup', got {spec.source!r}")
+            if int(spec.gamma) < 1:
+                raise ValueError(
+                    f"spec.gamma must be >= 1, got {spec.gamma}")
+            if spec.source == "draft":
+                if spec.draft_cfg is None or spec.draft_params is None \
+                        or spec.draft_cache is None:
+                    raise ValueError(
+                        "SpecConfig(source='draft') needs draft_cfg, "
+                        "draft_params and draft_cache (use "
+                        "source='prompt_lookup' for model-free "
+                        "n-gram drafting)")
+                if spec.draft_cache.tables.shape[0] != self.B:
+                    raise ValueError(
+                        "draft_cache batch "
+                        f"{spec.draft_cache.tables.shape[0]} != "
+                        f"target cache batch {self.B}")
+                if self._tp and spec.draft_cache.mesh != mesh:
+                    # the one REAL constraint of TP speculative
+                    # serving: draft and verify run the same mesh, so
+                    # the draft pool must be kv-head-sharded over it
+                    # exactly like the target pool (a single-device
+                    # draft pool would make every fused dispatch
+                    # reshard the pools across chips)
+                    raise ValueError(
+                        "TP speculative serving runs draft and "
+                        "verify on the SAME mesh: build the draft "
+                        "PagedKVCache with mesh=<the engine's mesh> "
+                        "(and init draft_params on it).  Workaround "
+                        "if the draft model cannot shard (e.g. "
+                        "indivisible heads): serve with "
+                        "SpecConfig(source='prompt_lookup') — "
+                        "model-free drafting needs no draft pool — "
+                        "or through the plain "
+                        "ContinuousBatchingEngine(mesh=...) without "
+                        "a draft.")
+            self.gamma = int(spec.gamma)
+            self.adaptive_gamma = bool(spec.adaptive_gamma)
+            self.max_gamma = max(int(spec.max_gamma), self.gamma)
+            self._accept_ema = float(self.gamma)
+            self.spec_rounds = 0
+            self.spec_accepted = 0
+            self.spec_drafted = 0      # draft tokens proposed
+            self._spec_dcfg = spec.draft_cfg
+            self._spec_dparams = spec.draft_params
+            self._spec_dcache = spec.draft_cache   # None for lookup
+            self._spec_on = np.zeros((self.B,), bool)
+            self._prev_tok = np.zeros((self.B,), np.int64)
+            self._spec_seq: Dict[int, list] = {}   # lookup history
+            self._spec_ngrams: Dict[int, dict] = {}
+            self._dev_dtables_version = -1
+            if self._tp:
+                # analytic per-round collective bytes: C verify
+                # tokens reduce exact-fp, C draft micro-steps reduce
+                # in the engine's tp_allreduce mode (int8 drafts only
+                # cost acceptance, never correctness)
+                mp_ = mesh.shape["mp"]
+                self._tp_bytes_spec_verify = \
+                    tp_collective_bytes_per_step(
+                        cfg, mp_, "fp32", self.B)
+                self._tp_bytes_spec_draft = \
+                    tp_collective_bytes_per_step(
+                        spec.draft_cfg, mp_, tp_allreduce, self.B) \
+                    if spec.source == "draft" else 0
+            if self.metrics is not None:
+                self.metrics.spec_gamma.set(self.gamma)
         self._next_tok = np.zeros((self.B,), np.int64)
         self._remaining = np.zeros((self.B,), np.int64)
         # incremental ACTIVE-SLOT mask: maintained at admit / retire /
@@ -550,7 +707,7 @@ class ContinuousBatchingEngine:
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None,
                deadline_s: Optional[float] = None,
-               trace=None) -> int:
+               trace=None, spec: Optional[bool] = None) -> int:
         """Queue a request.  Oversized requests fail HERE with
         ``ValueError`` — one bad request must never surface mid
         ``step()`` and kill every in-flight generation (a row's
@@ -569,6 +726,15 @@ class ContinuousBatchingEngine:
         mid-decode, resources freed, surfaced in ``finished()`` with
         ``status == "expired"`` (a request whose client stopped
         waiting must stop burning decode slots).
+
+        ``spec``: per-request speculative toggle — ``True``/``False``
+        override the engine ``SpecConfig``'s ``default_on``;
+        ``None`` inherits it.  Spec-off rows ride the same fused
+        round (their accept window collapses to one plain greedy
+        token), so on/off requests mix in one batch with zero extra
+        dispatches.  ``spec=True`` on an engine built without
+        ``spec=SpecConfig(...)`` raises — the fused draft+verify
+        program is compiled at engine construction.
 
         ``trace``: an externally-minted
         :class:`~paddle_tpu.observability.TraceContext` (fleet
@@ -622,6 +788,11 @@ class ContinuousBatchingEngine:
                         "each stop sequence must be a NON-EMPTY list "
                         f"of token ids, got {q!r}")
                 stops.append([int(t) for t in q])
+        if spec and self._spec is None:
+            raise ValueError(
+                "spec=True needs an engine built with "
+                "spec=SpecConfig(...): the fused draft+verify "
+                "program is compiled at engine construction")
         why = self.queue_capacity_reason(len(prompt))
         if why is not None:
             self._reject(why)
@@ -634,7 +805,7 @@ class ContinuousBatchingEngine:
         req = Request(rid, prompt, max_new_tokens,
                       stop_sequences=stops,
                       t_submit=time.monotonic(),
-                      deadline=deadline)
+                      deadline=deadline, spec=spec)
         # phase accounting starts at the queue; ``trace`` (a
         # TraceContext a fleet router / disagg coordinator minted
         # under ITS rid space) wins over the engine's own tracer
@@ -772,11 +943,18 @@ class ContinuousBatchingEngine:
         self._release_aux(slot)
 
     def _release_aux(self, slot: int) -> None:
-        """Hook: subclasses with auxiliary caches (the speculative
-        engine's draft cache) release them here.  Split from
+        """Release a slot's auxiliary state: the speculative lane's
+        draft cache row and prompt-lookup history.  Split from
         :meth:`_release_slot` because a swap-out preemption keeps the
         MAIN cache row (parked in the host tier) while auxiliary state
         is always rebuilt at re-admission."""
+        if self._spec is None:
+            return
+        if self._spec_dcache is not None and self._spec_on[slot]:
+            self._spec_dcache.release_row(slot)
+        self._spec_on[slot] = False
+        self._spec_seq.pop(slot, None)
+        self._spec_ngrams.pop(slot, None)
 
     def _hit_stop(self, req: Request, t: int) -> bool:
         """eos or a completed stop sequence at the generated tail."""
@@ -798,8 +976,48 @@ class ContinuousBatchingEngine:
                     req.t_first_token - req.t_submit,
                     exemplar=_tid(req))
 
+    def _spec_admit(self, req: Request, slot: int, tok: int) -> None:
+        """Speculative admission tail: resolve the row's on/off
+        toggle, seed the prev-token mirror, and build the row's draft
+        source — a dense draft-model prefill of the committed context
+        (``source='draft'``) or the per-request n-gram table
+        (``source='prompt_lookup'``).  Runs for fresh admissions,
+        recompute resumes and swap-ins alike (every lane ends in
+        :meth:`_finish_admit`)."""
+        on = req.spec if req.spec is not None \
+            else self._spec.default_on
+        self._spec_on[slot] = bool(on)
+        ctx = self._ctx_of(req)
+        self._prev_tok[slot] = int(ctx[-1])
+        if not on:
+            return
+        if self._spec.source == "draft":
+            dcache = self._spec_dcache
+            L = len(ctx)
+            # analysis: ignore[claim-lifecycle] reason=draft-row transfer: a draft prefill fault quarantines, and _retire_abnormal releases the slot through _release_slot -> _release_aux -> dcache.release_row (audit-clean)
+            dcache.alloc_row(slot, L)
+            page = dcache.page
+            Lp = ((L + page - 1) // page) * page
+            padded = np.zeros((1, Lp), np.int64)
+            padded[0, :L] = ctx
+            x, ks, vs = _prefill(self._spec_dcfg)(
+                self._spec_dparams, jnp.asarray(padded))
+            dcache.write_row_pages(slot, ks[:, 0], vs[:, 0], L)
+        else:
+            seq = [int(t) for t in ctx] + [int(tok)]
+            self._spec_seq[slot] = seq
+            n = self._spec.ngram
+            tab: dict = {}
+            # first occurrence wins (setdefault): a proposal should
+            # continue the EARLIEST prior match, not the tail itself
+            for i in range(n, len(seq)):
+                tab.setdefault(tuple(seq[i - n:i]), i)
+            self._spec_ngrams[slot] = tab
+
     def _finish_admit(self, req: Request, slot: int, tok: int) -> None:
         """Shared bookkeeping tail of every admission path."""
+        if self._spec is not None:
+            self._spec_admit(req, slot, tok)
         if req.t_admit == 0.0:
             req.t_admit = time.monotonic()
             if self.metrics is not None:
@@ -2071,6 +2289,18 @@ class ContinuousBatchingEngine:
         K/V written PAST the remaining budget, so a remaining clamp
         there would push real writes onto the junk page.  ``<= 0``
         means nothing to claim — skip the row."""
+        if self._spec is not None:
+            # SPECULATIVE claim: gamma+1 candidate K/V scatter, which
+            # deliberately writes PAST the remaining budget (the round
+            # commits at most ``remaining`` tokens but scores every
+            # candidate) — so NO remaining clamp; the table-capacity
+            # clamp still guards rows whose mirror over-advanced
+            # (retired on-device, not yet drained) and keeps the tail
+            # of a near-cap row's candidates on the junk page, where
+            # the fused scatter steers unclaimed positions anyway
+            lens_m = int(self.cache.lens[slot])
+            return min(new_tokens,
+                       self.cache.pages_max * self.cache.page - lens_m)
         if self._step_multi is None:
             if self._inflight and int(self.cache.lens[slot]) \
                     // self.cache.page >= self.cache.pages_max:
@@ -2087,7 +2317,8 @@ class ContinuousBatchingEngine:
                    cap)
 
     def _ensure_or_preempt(self, new_tokens: int = 1,
-                           aux_cache=None, aux_new: int = 0) -> None:
+                           aux_cache=None, aux_new: int = 0,
+                           aux_rows=None) -> None:
         """Grow every active row's pages (and optionally an auxiliary
         cache's), preempting the youngest other request on pool
         exhaustion instead of crashing the engine.
@@ -2098,7 +2329,12 @@ class ContinuousBatchingEngine:
         re-upload per tick, however many rows grew (the old per-slot
         loop re-uploaded once per growing row; with H-token horizon
         pre-claims that multiplied).  Pool pressure falls back to the
-        per-slot grow-or-preempt loop."""
+        per-slot grow-or-preempt loop.
+
+        ``aux_rows`` (bool mask over slots) restricts the auxiliary
+        claim to rows that actually own an aux row — the speculative
+        lane's spec-off rows never allocate a draft row, so claiming
+        for them would leak draft pages."""
         needs = []
         for slot in self._active:
             n = self._grow_tokens(slot, new_tokens)
@@ -2109,8 +2345,10 @@ class ContinuousBatchingEngine:
         try:
             self.cache.ensure_capacity_batch(needs)
             if aux_cache is not None:
-                aux_cache.ensure_capacity_batch(
-                    [(slot, aux_new) for slot, _ in needs])
+                aux_needs = [(slot, aux_new) for slot, _ in needs
+                             if aux_rows is None or aux_rows[slot]]
+                if aux_needs:
+                    aux_cache.ensure_capacity_batch(aux_needs)
             return
         except RuntimeError:
             pass                   # pool pressure: per-slot fallback
@@ -2125,7 +2363,8 @@ class ContinuousBatchingEngine:
             while True:
                 try:
                     self.cache.ensure_capacity(slot, n)
-                    if aux_cache is not None:
+                    if aux_cache is not None and \
+                            (aux_rows is None or aux_rows[slot]):
                         aux_cache.ensure_capacity(slot, aux_new)
                     break
                 except RuntimeError:
@@ -2160,14 +2399,23 @@ class ContinuousBatchingEngine:
                             "a single request of this length")
 
     def _decode_once(self) -> None:
-        """One decode round advancing every active slot (the
-        speculative subclass overrides this with a draft+verify
-        round): the synchronous dispatch-then-sync loop, or — with
+        """One decode round advancing every active slot: the
+        synchronous dispatch-then-sync loop, or — with
         ``overlap=True`` — one turn of the dispatch-ahead pipeline.
         With ``decode_horizon > 1`` both lanes advance by horizon
         BLOCKS — one multi-step dispatch (and one fetch) per H
-        tokens."""
-        if self.overlap:
+        tokens.  With ``spec=SpecConfig(...)`` every round is one
+        fused draft+verify dispatch committing up to gamma+1 tokens
+        per row (draft-model spec overlaps like the plain pipeline;
+        prompt-lookup runs the sync cadence even under
+        ``overlap=True`` — the host proposer needs the round's
+        committed tokens before it can draft the next)."""
+        if self._spec is not None:
+            if self.overlap and self._spec.source == "draft":
+                self._decode_spec_overlap()
+            else:
+                self._decode_spec_sync()
+        elif self.overlap:
             self._decode_overlap()
         elif self._step_multi is not None:
             self._decode_sync_multi()
@@ -2262,6 +2510,17 @@ class ContinuousBatchingEngine:
                 "active": jnp.asarray(self._active_mask.astype(bool)),
                 "remaining": jnp.asarray(self._remaining.copy()),
             }
+            if self._spec is not None:
+                # the speculative chain additionally carries the
+                # prev-token feed (draft catch-up) and the per-row
+                # on/off mask (constant between flushes — admission
+                # and retirement both flush)
+                self._dev["prev"] = jnp.asarray(self._prev_tok.copy())
+                self._dev["spec_on"] = jnp.asarray(
+                    self._spec_on.copy())
+                # force the draft-table upload into the fresh dict
+                # (its version may not have bumped since the flush)
+                self._dev_dtables_version = -1
             self._dev_tables_version = cache.tables_version
             self._drain_active = self._active_mask.astype(bool)
         elif self._dev_tables_version != cache.tables_version:
@@ -2270,6 +2529,12 @@ class ContinuousBatchingEngine:
             # device-resident
             self._dev["tables"] = jnp.asarray(cache.tables.copy())
             self._dev_tables_version = cache.tables_version
+        if self._spec is not None and self._spec_dcache is not None:
+            dcache = self._spec_dcache
+            if self._dev_dtables_version != dcache.tables_version:
+                self._dev["dtables"] = jnp.asarray(
+                    dcache.tables.copy())
+                self._dev_dtables_version = dcache.tables_version
         return self._dev
 
     def _dispatch_async(self) -> None:
@@ -2349,6 +2614,9 @@ class ContinuousBatchingEngine:
         retires the request and schedules a pipeline flush, since the
         device-side active chain cannot know about it."""
         e = self._inflight.pop(0)
+        if "emits" in e:                     # fused speculative round
+            self._drain_spec_entry(e)
+            return
         if "toks" in e:                      # multi-token horizon block
             self._drain_horizon_entry(e)
             return
@@ -2548,6 +2816,370 @@ class ContinuousBatchingEngine:
         # analysis: ignore[sync-in-hot-path] reason=the synchronous horizon lane's ONE blocking fetch per H-token tick (overlap=False) — the amortized counterpart of _decode_sync's per-token round-trip
         toks, dones = self._fetch(toks, dones)
         self._drain_horizon_block(toks, dones, mask)
+
+    # -- fused speculative lane (spec=SpecConfig(...)) --------------------
+    def _spec_fused(self):
+        """The fused draft+verify program for the CURRENT gamma.
+        :func:`make_spec_step` memoises per (cfg, gamma, quant, mesh)
+        — adaptive retunes pay one compile per distinct gamma, then
+        hit the cache."""
+        spec = self._spec
+        return make_spec_step(
+            self.cfg, self.gamma,
+            draft_cfg=self._spec_dcfg if spec.source == "draft"
+            else None,
+            kv_quant=self.cache.kv_quant,
+            draft_kv_quant=(self._spec_dcache.kv_quant
+                            if self._spec_dcache is not None
+                            else None),
+            mesh=self.mesh, tp_allreduce=self.tp_allreduce)
+
+    def _count_spec_tp(self, C: int) -> None:
+        """Collective-traffic accounting for one fused speculative
+        round: C verify tokens reduce exact-fp, C draft micro-steps
+        reduce in the engine's ``tp_allreduce`` mode (prompt-lookup
+        rounds have no draft half).  No-op off-mesh."""
+        if not self._tp:
+            return
+        self._count_tp_dispatch(
+            1, self._tp_bytes_spec_verify * C
+            + self._tp_bytes_spec_draft * C)
+
+    def _propose_lookup(self) -> np.ndarray:
+        """PROMPT-LOOKUP drafting: match each spec-on row's last
+        ``ngram`` committed tokens against its own history and
+        propose the continuation of the EARLIEST prior occurrence.
+        A miss proposes nothing (zeros) — the verify rejects them and
+        the row still commits its one exact greedy token, so a bad
+        proposal only ever costs acceptance."""
+        G = self.gamma
+        n = self._spec.ngram
+        out = np.zeros((self.B, G), np.int64)
+        for slot in self._active:
+            if not self._spec_on[slot]:
+                continue
+            seq = self._spec_seq.get(slot)
+            if seq is None or len(seq) <= n:
+                continue
+            idx = self._spec_ngrams[slot].get(tuple(seq[-n:]))
+            if idx is None:
+                continue
+            cand = seq[idx:idx + G]
+            out[slot, :len(cand)] = cand
+        return out
+
+    def _spec_note_tokens(self, slot: int, toks_list) -> None:
+        """Extend a prompt-lookup row's history + n-gram table with
+        the round's committed tokens (first occurrence wins, matching
+        the admission-time build)."""
+        seq = self._spec_seq.get(slot)
+        if seq is None:
+            return
+        tab = self._spec_ngrams[slot]
+        n = self._spec.ngram
+        start = max(len(seq), n)
+        seq.extend(int(t) for t in toks_list)
+        for i in range(start, len(seq)):
+            tab.setdefault(tuple(seq[i - n:i]), i)
+
+    def _spec_dispatch_args(self, fused_inputs: Dict):
+        """Assemble the fused step's positional args from a dict of
+        device inputs — ONE place owns the (draft, q8, dq8) layout
+        for the sync and overlap lanes alike."""
+        cache, dcache = self.cache, self._spec_dcache
+        q8 = cache.kv_quant == "int8"
+        args = [self.params]
+        if self._spec.source == "draft":
+            args.append(self._spec_dparams)
+        args += [cache.kpool, cache.vpool]
+        if q8:
+            args += [cache.kscale, cache.vscale]
+        if self._spec.source == "draft":
+            args += [dcache.kpool, dcache.vpool]
+            if dcache.kv_quant == "int8":
+                args += [dcache.kscale, dcache.vscale]
+        args.append(fused_inputs["tables"])
+        if self._spec.source == "draft":
+            args.append(fused_inputs["dtables"])
+        args += [fused_inputs["lens"], fused_inputs["tok"]]
+        if self._spec.source == "draft":
+            args.append(fused_inputs["prev"])
+        else:
+            args.append(fused_inputs["drafts"])
+        args += [fused_inputs["active"], fused_inputs["remaining"],
+                 fused_inputs["spec_on"], self._eos_dev,
+                 fused_inputs["key"]]
+        return args
+
+    def _spec_unpack(self, rets):
+        """Split the fused step's outputs: reassign the donated pools
+        (+scales), return (toks, dones, emits, accepts, chain) where
+        ``chain`` is the on-device loop state (tok', [prev',] lens',
+        remaining', active') for the overlap lane to feed the next
+        dispatch."""
+        cache, dcache = self.cache, self._spec_dcache
+        q8 = cache.kv_quant == "int8"
+        cache.kpool, cache.vpool = rets[0], rets[1]
+        i = 2
+        if q8:
+            cache.kscale, cache.vscale = rets[2], rets[3]
+            i = 4
+        if self._spec.source == "draft":
+            dcache.kpool, dcache.vpool = rets[i], rets[i + 1]
+            i += 2
+            if dcache.kv_quant == "int8":
+                dcache.kscale, dcache.vscale = rets[i], rets[i + 1]
+                i += 2
+        toks, dones, emits, accs = rets[i:i + 4]
+        return toks, dones, emits, accs, rets[i + 4:]
+
+    def _decode_spec_sync(self) -> None:
+        """One fused speculative round, synchronous cadence: ONE
+        dispatch runs the gamma-iteration draft scan (or takes the
+        host's prompt-lookup proposals) AND the batched target
+        verify, ONE blocking fetch drains up to gamma+1 committed
+        tokens per row.  Also the overlap engine's prompt-lookup
+        cadence — the host proposer needs the round's committed
+        tokens before it can draft the next, so lookup rounds cannot
+        run ahead of the drain."""
+        if self._needs_flush:    # lookup-on-overlap-engine stop/preempt
+            self._pipeline_flush()
+        cache, dcache = self.cache, self._spec_dcache
+        G = self.gamma
+        C = G + 1
+        self._ensure_or_preempt(C, aux_cache=dcache, aux_new=C,
+                                aux_rows=self._spec_on)
+        fused = self._spec_fused()
+        self._key, sub = jax.random.split(self._key)
+        mask = self._active_mask.astype(bool)
+        spec_rows = mask & self._spec_on
+        inputs = {
+            "tables": jnp.asarray(cache.tables.copy()),
+            "lens": jnp.asarray(cache.lens.copy()),
+            "tok": jnp.asarray(self._next_tok.copy()),
+            "active": jnp.asarray(mask),
+            "remaining": jnp.asarray(self._remaining.copy()),
+            "spec_on": jnp.asarray(self._spec_on.copy()),
+            "key": sub,
+        }
+        if self._spec.source == "draft":
+            inputs["dtables"] = jnp.asarray(dcache.tables.copy())
+            inputs["prev"] = jnp.asarray(self._prev_tok.copy())
+        else:
+            inputs["drafts"] = jnp.asarray(self._propose_lookup())
+        faults.fire("step_dispatch")
+        rets = fused(*self._spec_dispatch_args(inputs))
+        toks, dones, emits, accs, _ = self._spec_unpack(rets)
+        # mirror the worst case (C per live row, draft rows too); the
+        # drain corrects each row to its actual commit count
+        cache.lens = cache.lens + C * self._active_mask
+        if dcache is not None:
+            dcache.lens = dcache.lens + C * spec_rows.astype(
+                dcache.lens.dtype)
+        self.decode_steps += 1
+        self._count_spec_tp(C)
+        if self.metrics is not None:
+            self.metrics.decode_steps.inc()
+        # analysis: ignore[sync-in-hot-path] reason=the synchronous speculative lane's ONE blocking fetch per round — the fused-round counterpart of _decode_sync's per-token round-trip
+        toks, dones, emits, accs = self._fetch(toks, dones, emits,
+                                               accs)
+        self._drain_spec_block(toks, dones, emits, accs, mask)
+
+    def _decode_spec_overlap(self) -> None:
+        """One turn of the dispatch-ahead pipeline in speculative
+        form (``source='draft'`` only): round k+1's dispatch chains
+        round k's ON-DEVICE accepted-token state (tok'/prev'/lens'/
+        remaining'/active') with zero host round-trips, and the host
+        drains round k's committed block while k+1 runs."""
+        if self._needs_flush:
+            self._pipeline_flush()
+        if self._active:
+            self._ensure_or_preempt(self.gamma + 1,
+                                    aux_cache=self._spec_dcache,
+                                    aux_new=self.gamma + 1,
+                                    aux_rows=self._spec_on)
+            if self._needs_flush:          # a preemption landed
+                self._pipeline_flush()
+            if self._active:
+                self._dispatch_spec_async()
+        if self._active and len(self._inflight) > self.lookahead:
+            self._drain_one()
+        if not self._active and self._inflight:
+            while self._inflight:
+                self._drain_one()
+            self._dev = None
+
+    def _dispatch_spec_async(self) -> None:
+        """Issue one fused speculative round chained off the
+        device-resident loop state (zero blocking host work — same
+        discipline as :meth:`_dispatch_async`)."""
+        cache, dcache = self.cache, self._spec_dcache
+        C = self.gamma + 1
+        fused = self._spec_fused()
+        d = self._seed_or_refresh_dev()
+        self._key, sub = jax.random.split(self._key)
+        spec_rows = self._active_mask.astype(bool) & self._spec_on
+        inputs = {
+            "tables": d["tables"], "dtables": d["dtables"],
+            "lens": d["lens"], "tok": d["tok"], "prev": d["prev"],
+            "active": d["active"], "remaining": d["remaining"],
+            "spec_on": d["spec_on"], "key": sub,
+        }
+        faults.fire("step_dispatch")
+        rets = fused(*self._spec_dispatch_args(inputs))
+        toks, dones, emits, accs, chain = self._spec_unpack(rets)
+        tok_f, prev_f, lens_f, rem_f, act_f = chain
+        d["tok"], d["prev"] = tok_f, prev_f
+        d["lens"], d["remaining"], d["active"] = lens_f, rem_f, act_f
+        self._inflight.append({"toks": toks, "dones": dones,
+                               "emits": emits, "accepts": accs})
+        # mirror the worst case; each drain corrects its round's rows
+        cache.lens = cache.lens + C * self._active_mask
+        dcache.lens = dcache.lens + C * spec_rows.astype(
+            dcache.lens.dtype)
+        self.decode_steps += 1
+        self._count_spec_tp(C)
+        if self.metrics is not None:
+            self.metrics.decode_steps.inc()
+
+    def _drain_spec_entry(self, e: Dict) -> None:
+        """Drain one in-flight speculative round: ONE blocking fetch
+        for the whole committed block + accept counts."""
+        # analysis: ignore[sync-in-hot-path] reason=the pipeline's one sanctioned sync point, speculative form: ONE fetch drains a whole [gamma+1, B] committed block while a newer round is already in flight
+        toks, dones, emits, accs = self._fetch(
+            e["toks"], e["dones"], e["emits"], e["accepts"])
+        self._drain_active = self._drain_spec_block(
+            toks, dones, emits, accs, self._drain_active)
+
+    def _drain_spec_block(self, toks, dones, emits, accs, mask):
+        """Host bookkeeping for one fetched speculative round —
+        shared by the sync lane and the overlap drain so emission /
+        retirement / trim behaviour can never fork.  ``toks`` /
+        ``dones`` / ``emits`` are ``[C, B]`` micro-step arrays
+        (committed token, just-retired mask, validity window) and
+        ``accs`` the raw per-row accepted-draft counts; ``mask`` is
+        the device-active mask at dispatch.  Per row, the round
+        committed ``n_emit = emits[:, slot].sum()`` tokens; the
+        worst-case lens mirror advance (gamma+1 at dispatch) is
+        corrected here to the actual count.  Host-only stop
+        sequences trim the over-committed tail exactly like the
+        horizon drain (counted in ``horizon_trimmed_tokens``)."""
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
+        cache, dcache = self.cache, self._spec_dcache
+        C = toks.shape[0]
+        G = C - 1
+        lookup = self._spec.source == "prompt_lookup"
+        # drafted accounting from the DEVICE-chain mask, not the
+        # dispatch-time host mask: the overlap pipeline's last rounds
+        # chain past every row's on-device done (phantom rounds whose
+        # drafts are masked to junk) and must not inflate the
+        # denominator of the acceptance ratio
+        n_spec = int((mask & self._spec_on).sum())
+        advanced = 0
+        trimmed = 0
+        acc_round = 0
+        out_mask = mask.copy()
+        for slot in np.nonzero(mask)[0]:
+            slot = int(slot)
+            ecol = emits[:, slot]
+            n_emit = int(ecol.sum())
+            device_done = bool(dones[:n_emit, slot].any())
+            if device_done:
+                out_mask[slot] = False   # the device chain dropped it
+            req = self._active.get(slot)
+            if req is not None and n_emit > 0:
+                # worst-case mirror (C at dispatch) -> actual commit
+                cache.lens[slot] -= C - n_emit
+                if dcache is not None and self._spec_on[slot]:
+                    dcache.lens[slot] = cache.lens[slot]
+            if req is None or n_emit == 0:
+                # host-retired (stop sequence / cancel sweep) before
+                # this round drained: its tokens are dead; the
+                # scheduled flush keeps the slot from being reused
+                # under the in-flight pipeline
+                continue
+            if self._spec_on[slot]:
+                k = int(accs[slot])
+                acc_round += k
+                self._accept_ema = 0.8 * self._accept_ema + 0.2 * k
+                if self.metrics is not None:
+                    self.metrics.spec_accept_len.observe(k)
+            col = toks[:, slot]
+            # prev mirror BEFORE _next_tok moves: the second-to-last
+            # committed token overall (the draft catch-up feed)
+            if n_emit >= 2:
+                self._prev_tok[slot] = int(col[n_emit - 2])
+            else:
+                self._prev_tok[slot] = int(self._next_tok[slot])
+            if lookup:
+                self._spec_note_tokens(slot, col[:n_emit])
+            if req.stop_sequences:
+                # stop-sequence rows deliver token-by-token so a stop
+                # retires the row exactly where the plain lane would,
+                # discarding (and counting) the over-committed tail
+                for h in range(n_emit):
+                    t = int(col[h])
+                    self._deliver_token(slot, req, t)
+                    advanced += 1
+                    self._remaining[slot] -= 1
+                    if h == n_emit - 1 and device_done:
+                        self._retire(slot)   # eos/budget (on-device)
+                    elif self._hit_stop(req, t):
+                        self._retire(slot)   # stop seq (host-only)
+                        if self._inflight or self._dev is not None:
+                            self._needs_flush = True
+                        trimmed += n_emit - 1 - h
+                        break
+                continue
+            # FAST PATH (no stop sequences): bulk append/extend —
+            # per-token Python machinery is exactly the host overhead
+            # the fused round exists to amortize
+            toks_list = col[:n_emit].tolist()
+            req.generated.extend(toks_list)
+            self.tokens_generated += n_emit
+            advanced += n_emit
+            self._note_first_token(req)
+            rid = req.rid
+            self._stream.extend((rid, t) for t in toks_list)
+            self._next_tok[slot] = toks_list[-1]
+            self._remaining[slot] -= n_emit
+            if device_done:
+                self._retire(slot)           # eos/budget (on-device)
+        if n_spec:
+            self.spec_rounds += 1
+            self.spec_drafted += G * n_spec
+            self.spec_accepted += acc_round
+            if self.adaptive_gamma:
+                self._spec_retune()
+            if self.metrics is not None:
+                m = self.metrics
+                m.spec_rounds.inc()
+                m.spec_drafted_tokens.inc(G * n_spec)
+                m.spec_accepted_tokens.inc(acc_round)
+                m.spec_gamma.set(self.gamma)  # post-retune = next
+                m.spec_acceptance.set(
+                    self.spec_accepted / max(self.spec_drafted, 1))
+        if trimmed:
+            self.horizon_trimmed_tokens += trimmed
+            if self.metrics is not None:
+                self.metrics.horizon_trimmed_tokens.inc(trimmed)
+        if self.metrics is not None:
+            self.metrics.tokens_generated.inc(advanced)
+            self.metrics.host_bookkeeping.observe(
+                time.perf_counter() - t0)
+        return out_mask
+
+    def _spec_retune(self) -> None:
+        """Adaptive gamma for the NEXT round, from the acceptance
+        EMA: shrink when drafts keep missing, grow when they keep
+        landing.  Each distinct gamma compiles one fused program
+        (make_spec_step memoises) — a bounded one-time cost per
+        value, amortized across every later round at that gamma."""
+        if self._accept_ema < 0.4 * self.gamma and self.gamma > 1:
+            self.gamma -= 1
+        elif self._accept_ema > 0.85 * self.gamma and \
+                self.gamma < self.max_gamma:
+            self.gamma += 1
 
     def _pipeline_flush(self) -> None:
         """Drain every in-flight dispatch and invalidate the

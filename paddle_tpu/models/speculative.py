@@ -36,11 +36,7 @@ import numpy as np
 
 from .llama_pretrain import LlamaPretrainConfig, _mm, _rms_norm
 from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
-                           _prefill_chunk_batched,
-                           _prefill_chunk_batched_tp,
-                           make_paged_decode_step,
-                           make_paged_decode_step_tp,
-                           tp_collective_bytes_per_step)
+                           make_paged_decode_step)
 
 __all__ = ["generate_speculative", "SpeculativeEngine"]
 
@@ -173,325 +169,57 @@ def generate_speculative(cfg: LlamaPretrainConfig, params,
     return np.asarray(out, np.int64), stats
 
 
-from .serving_engine import ContinuousBatchingEngine  # noqa: E402
+from .serving_engine import (ContinuousBatchingEngine,  # noqa: E402
+                             SpecConfig)
 
 
 class SpeculativeEngine(ContinuousBatchingEngine):
-    """CONTINUOUS-BATCHING SPECULATIVE SERVING: the engine's decode
-    round becomes draft-gamma + one batched verify — every active
-    request advances by UP TO gamma+1 tokens per round, exactly
-    reproducing greedy outputs (exact verification), while
-    admission/retirement/preemption/streaming/prefix-caching keep
-    working unchanged.
+    """COMPAT SHIM over the engine's fused speculative lane.
 
-    Per round: (gamma+1) draft-model dispatches over the whole
-    batch (2 sync feeds realign each row's draft cache — rows
-    needing only 1 redundantly rewrite one position, which is
-    idempotent) and ONE target verify over each row's candidate
-    block via the batched prefill-with-history program.  Rollback
-    of rejected drafts is per-row ``lens`` bookkeeping — the paged
-    design's row independence doing the work.
+    Speculative serving is now a first-class lane of
+    :class:`ContinuousBatchingEngine` — build it directly with
+    ``ContinuousBatchingEngine(cfg, params, cache,
+    spec=SpecConfig(gamma=..., draft_cfg=..., draft_params=...,
+    draft_cache=...))`` (or ``source="prompt_lookup"`` for model-free
+    drafting).  One jitted program per round runs the gamma-iteration
+    draft scan AND the batched target verify in the SAME dispatch,
+    with ONE fetch per round; the overlap lane chains each round's
+    on-device accepted-token state into the next dispatch.
 
-    Greedy only (``temperature`` must stay 0 — exact-match
-    verification).
-
-    ``overlap=True`` (inherited) applies dispatch-ahead to the draft
-    phase: draft i's on-device token feeds draft i+1's dispatch and
-    the draft matrix is fetched once — 2 blocking host syncs per
-    round (drafts, verify logits) instead of gamma+2.  Token-exact
-    either way.
-
-    ``mesh`` (mp>1, inherited) runs draft AND verify on the same
-    sharded mesh: the draft cache must be built with the same
-    ``mesh`` (kv-head-sharded draft pool), drafting rides the TP
-    shard_map step, and verification rides the shard_map batched
-    prefill-with-history with exact fp reductions — so the committed
-    output remains provably the target model's greedy sequence even
-    when ``tp_allreduce="int8"`` quantizes the draft collectives.
+    This subclass survives only as a constructor adapter for the old
+    positional signature, preserving the public surface old call
+    sites rely on: ``gamma`` / ``spec_rounds`` / ``spec_accepted`` /
+    ``spec_drafted`` / adaptive retuning, the ``dcfg`` / ``dparams``
+    / ``dcache`` attributes, and token-exactness vs the target's
+    plain greedy decode.  Two historical restrictions are GONE
+    because the fused lane composes where the forked scheduler could
+    not: int8-KV target/draft pools verify exactly (the fused step
+    carries the quantized-pool forms), and gamma is no longer bounded
+    by the page size (the verify scatter is per-position, not a
+    2-page realigned chunk).
     """
 
     def __init__(self, cfg, params, cache, draft_cfg, draft_params,
                  draft_cache, gamma: int = 4,
                  adaptive_gamma: bool = False, max_gamma: int = 8,
                  **kw):
-        if kw.get("temperature", 0.0) != 0.0:
-            raise ValueError(
-                "speculative serving is greedy-only (exact "
-                "verification); temperature must be 0")
-        if kw.get("mixed"):
-            raise ValueError(
-                "mixed=True is a plain-decode-lane knob: the "
-                "speculative round has its own draft+verify dispatch "
-                "structure the mixed program does not reproduce")
-        if int(kw.get("decode_horizon", 1) or 1) > 1:
-            raise ValueError(
-                "decode_horizon is a plain-decode-lane knob: a "
-                "speculative round already amortizes dispatch "
-                "overhead over gamma drafted tokens per draft+verify "
-                "round and keeps its own cadence — tune gamma "
-                "instead")
-        if cache.kv_quant or draft_cache.kv_quant:
-            raise NotImplementedError(
-                "speculative serving over int8 pools: dequant in "
-                "the batched verify gather is not wired")
-        if gamma < 1 or gamma >= cache.page:
-            raise ValueError(
-                f"gamma must be in [1, page-1], got {gamma}")
-        mesh = kw.get("mesh")
-        tp = mesh is not None and mesh.shape.get("mp", 1) > 1
-        if tp and draft_cache.mesh != mesh:
-            # the one REAL constraint of TP speculative serving:
-            # draft and target run the same mesh, so the draft pool
-            # must be kv-head-sharded over it exactly like the target
-            # pool (a single-device draft pool would make every draft
-            # dispatch reshard the pools across chips)
-            raise ValueError(
-                "TP speculative serving runs draft and verify on the "
-                "SAME mesh: build the draft PagedKVCache with "
-                "mesh=<the engine's mesh> (and init draft_params on "
-                "it).  Workaround if the draft model cannot shard "
-                "(e.g. indivisible heads): serve the target through "
-                "the plain ContinuousBatchingEngine(mesh=...) "
-                "without a draft.")
-        super().__init__(cfg, params, cache, **kw)
-        self.dcfg, self.dparams = draft_cfg, draft_params
-        self.dcache = draft_cache
-        self.gamma = gamma
-        # ADAPTIVE gamma: gamma is HOST-side (the draft loop is a host
-        # loop; the verify chunk shape is gamma-independent), so it can
-        # retune every round from the measured acceptance EMA with
-        # zero recompilation — shrink when drafts keep missing, grow
-        # when they keep landing
-        self.adaptive_gamma = adaptive_gamma
-        self.max_gamma = min(max_gamma, cache.page - 1)
-        self._accept_ema = float(gamma)
-        if tp:
-            # draft and verify on the SAME mesh: drafting rides the
-            # sharded per-token step (the draft inherits the engine's
-            # tp_allreduce — quantized draft collectives change only
-            # which tokens get PROPOSED; exact verification keeps the
-            # committed output the target's greedy sequence), and
-            # verify is the shard_map batched prefill-with-history
-            # (exact fp reductions — the acceptance rule must score
-            # with the target's true logits)
-            self._dstep = make_paged_decode_step_tp(
-                draft_cfg, mesh, temperature=0.0,
-                tp_allreduce=self.tp_allreduce)
-            self._verify = _prefill_chunk_batched_tp(cfg, mesh)
-            mp = mesh.shape["mp"]
-            self._tp_bytes_draft = tp_collective_bytes_per_step(
-                draft_cfg, mp, self.tp_allreduce, self.B)
-            self._tp_bytes_verify = tp_collective_bytes_per_step(
-                cfg, mp, "fp32", self.B * 2 * cache.page)
-        else:
-            self._dstep = make_paged_decode_step(draft_cfg,
-                                                 temperature=0.0)
-            self._verify = _prefill_chunk_batched(cfg)
-        self._seq: Dict[int, list] = {}     # slot -> committed toks
-        self._d_len = np.zeros(self.B, np.int64)
-        self.spec_rounds = 0
-        self.spec_accepted = 0
-        self.spec_drafted = 0       # draft tokens proposed (gamma/row)
-        if self.metrics is not None:
-            self.metrics.spec_gamma.set(self.gamma)
+        spec = SpecConfig(gamma=gamma, source="draft",
+                          draft_cfg=draft_cfg,
+                          draft_params=draft_params,
+                          draft_cache=draft_cache,
+                          adaptive_gamma=adaptive_gamma,
+                          max_gamma=max_gamma)
+        super().__init__(cfg, params, cache, spec=spec, **kw)
 
-    # -- hooks ---------------------------------------------------------
-    def _release_aux(self, slot):
-        # called by _release_slot AND by swap-out preemption (which
-        # parks the TARGET cache row in the host tier but always
-        # rebuilds draft state at re-admission)
-        self.dcache.release_row(slot)
-        self._seq.pop(slot, None)
+    # old attribute names for the draft triple
+    @property
+    def dcfg(self):
+        return self._spec_dcfg
 
-    def _finish_admit(self, req, slot, tok):
-        # mirror the target admission into the DRAFT cache (dense
-        # prefill of the same committed context) and record the
-        # committed sequence for this slot
-        ctx = self._ctx_of(req)
-        L = len(ctx)
-        # analysis: ignore[claim-lifecycle] reason=draft-row transfer: a draft prefill fault quarantines, and _retire_abnormal releases the slot through _release_slot -> _release_aux -> dcache.release_row (audit-clean)
-        self.dcache.alloc_row(slot, L)
-        page = self.dcache.page
-        Lp = ((L + page - 1) // page) * page
-        padded = np.zeros((1, Lp), np.int64)
-        padded[0, :L] = ctx
-        x, ks, vs = _prefill(self.dcfg)(self.dparams,
-                                        jnp.asarray(padded))
-        self.dcache.write_row_pages(slot, ks[:, 0], vs[:, 0], L)
-        self._seq[slot] = list(ctx) + [tok]
-        self._d_len[slot] = L
-        super()._finish_admit(req, slot, tok)
+    @property
+    def dparams(self):
+        return self._spec_dparams
 
-    # -- the speculative round -----------------------------------------
-    def _decode_once(self):
-        gamma = self.gamma
-        page = self.cache.page
-        B = self.B
-        # capacity: target through len(seq)+gamma, draft one less
-        self._ensure_or_preempt(new_tokens=gamma + 1,
-                                aux_cache=self.dcache,
-                                aux_new=gamma + 1)
-        active = sorted(self._active)
-        if not active:
-            return
-        N = {s: len(self._seq[s]) for s in active}
-
-        # ---- draft phase: 2 batched sync feeds + gamma-1 drafts
-        drafts = np.zeros((B, gamma), np.int64)
-        feeds = []
-        for j in (2, 1):                   # positions N-2, N-1
-            pos = np.zeros(B, np.int32)
-            tokv = np.zeros(B, np.int64)
-            for s in active:
-                pos[s] = N[s] - j
-                tokv[s] = self._seq[s][N[s] - j]
-            feeds.append((pos, tokv))
-        out = None
-        for i, (pos, tokv) in enumerate(feeds):
-            self.dcache.kpool, self.dcache.vpool, out = self._dstep(
-                self.dparams, self.dcache.kpool, self.dcache.vpool,
-                jnp.asarray(self.dcache.tables.copy()),
-                jnp.asarray(pos), jnp.asarray(tokv),
-                jax.random.PRNGKey(0))
-        if self.overlap:
-            # DISPATCH-AHEAD drafting: feed draft i's ON-DEVICE token
-            # straight into draft i+1's dispatch (positions are
-            # host-known, tokens never round-trip) and fetch the whole
-            # draft matrix once — 2 blocking syncs per round (drafts,
-            # verify logits) instead of gamma+2.  Inactive rows chain
-            # their own garbage token instead of 0; both write only
-            # the junk page.
-            outs = [out]
-            for i in range(1, gamma):
-                pos = np.zeros(B, np.int32)
-                for s in active:
-                    pos[s] = N[s] - 1 + i
-                self.dcache.kpool, self.dcache.vpool, out = \
-                    self._dstep(
-                        self.dparams, self.dcache.kpool,
-                        self.dcache.vpool,
-                        jnp.asarray(self.dcache.tables.copy()),
-                        jnp.asarray(pos), out, jax.random.PRNGKey(0))
-                outs.append(out)
-            # analysis: ignore[sync-in-hot-path] reason=one draft-matrix drain per speculative round — the round boundary is the sanctioned sync point
-            alld = self._fetch(jnp.stack(outs, axis=1))[0]  # [B, gamma]
-            for s in active:
-                drafts[s] = alld[s]
-        else:
-            # analysis: ignore[sync-in-hot-path] reason=sync draft lane (overlap=False): one accounted drain per draft step through the audited seam
-            out = self._fetch(out)[0]
-            for s in active:
-                drafts[s, 0] = out[s]
-            for i in range(1, gamma):
-                pos = np.zeros(B, np.int32)
-                tokv = np.zeros(B, np.int64)
-                for s in active:
-                    pos[s] = N[s] - 1 + i
-                    tokv[s] = drafts[s, i - 1]
-                self.dcache.kpool, self.dcache.vpool, out = \
-                    self._dstep(
-                        self.dparams, self.dcache.kpool,
-                        self.dcache.vpool,
-                        jnp.asarray(self.dcache.tables.copy()),
-                        jnp.asarray(pos), jnp.asarray(tokv),
-                        jax.random.PRNGKey(0))
-                # analysis: ignore[sync-in-hot-path] reason=sync draft lane (overlap=False): one accounted drain per draft step through the audited seam
-                out = self._fetch(out)[0]
-                for s in active:
-                    drafts[s, i] = out[s]
-
-        # ---- verify: ONE batched target forward over candidate
-        # blocks re-aligned to each row's last page boundary
-        Cp = 2 * page
-        toks = np.zeros((B, Cp), np.int64)
-        starts = np.zeros(B, np.int32)
-        lbs = np.zeros(B, np.int64)
-        for s in active:
-            start = ((N[s] - 1) // page) * page
-            block = self._seq[s][start:] + list(drafts[s])
-            starts[s] = start
-            lbs[s] = len(block)
-            toks[s, :len(block)] = block
-        x, ks, vs = self._verify(
-            self.params, jnp.asarray(toks), self.cache.kpool,
-            self.cache.vpool, jnp.asarray(self.cache.tables.copy()),
-            jnp.asarray(starts))
-        for s in active:
-            self.cache.write_row_pages(
-                s, ks[:, s], vs[:, s], int(lbs[s]),
-                first_page=int(starts[s]) // page)
-        # greedy target predictions after each candidate position
-        offs = np.zeros(B, np.int64)
-        for s in active:
-            offs[s] = (N[s] - 1) - starts[s]
-        idx = offs[:, None] + np.arange(gamma + 1)[None]
-        xg = x[jnp.arange(B)[:, None], jnp.asarray(idx)]
-        h = _rms_norm(xg, self.params["final_norm"],
-                      self.cfg.rms_norm_eps)
-        logits = _mm(h, self.params["lm_head"],
-                     self.cfg.dtype).astype(jnp.float32)
-        # analysis: ignore[sync-in-hot-path] reason=verify-logits drain: the acceptance decision is host bookkeeping by design, one drain per round
-        greedy = self._fetch(jnp.argmax(logits, -1))[0]  # [B, gamma+1]
-
-        # ---- per-row acceptance + commit (host bookkeeping)
-        self.decode_steps += 1
-        self.spec_rounds += 1
-        if self._tp:
-            # collective-traffic accounting: gamma+1 draft dispatches
-            # (2 sync feeds + gamma-1 chained) in the engine's
-            # tp_allreduce mode, one exact-fp verify forward
-            self._count_tp_dispatch(gamma + 1, self._tp_bytes_draft)
-            self._count_tp_dispatch(1, self._tp_bytes_verify)
-        self.spec_drafted += gamma * len(active)
-        round_accepted = 0
-        round_tokens = 0
-        for s in active:
-            req = self._active[s]
-            k = 0
-            while k < gamma and drafts[s, k] == greedy[s, k]:
-                k += 1
-            self.spec_accepted += k
-            round_accepted += k
-            new_toks = [int(t) for t in drafts[s, :k]] + \
-                [int(greedy[s, k])]
-            n_old = N[s]
-            retire = False
-            committed = 0
-            for t in new_toks:
-                req.generated.append(t)
-                self.tokens_generated += 1
-                round_tokens += 1
-                self._note_first_token(req)
-                self._stream.append((req.rid, t))
-                self._remaining[s] -= 1
-                committed += 1
-                if self._hit_stop(req, t) or self._remaining[s] <= 0:
-                    retire = True
-                    break
-            self._seq[s] = self._seq[s] + new_toks[:committed]
-            self.cache.lens[s] = len(self._seq[s]) - 1
-            self._d_len[s] = n_old + min(committed - 1, gamma - 1)
-            self.dcache.lens[s] = self._d_len[s]
-            self._next_tok[s] = self._seq[s][-1]
-            if self.adaptive_gamma:
-                self._accept_ema = 0.8 * self._accept_ema + 0.2 * k
-            if retire:
-                self._retire(s)
-        if self.adaptive_gamma:
-            # retune for the NEXT round: gamma is a host-loop count and
-            # the verify chunk shape is gamma-independent, so this
-            # costs zero recompilation
-            if self._accept_ema < 0.4 * self.gamma and self.gamma > 1:
-                self.gamma -= 1
-            elif self._accept_ema > 0.85 * self.gamma and \
-                    self.gamma < self.max_gamma:
-                self.gamma += 1
-        if self.metrics is not None:
-            m = self.metrics
-            m.decode_steps.inc()
-            m.tokens_generated.inc(round_tokens)
-            m.spec_rounds.inc()
-            m.spec_accepted_tokens.inc(round_accepted)
-            m.spec_gamma.set(self.gamma)     # post-retune = next round
-            m.spec_acceptance.set(
-                self.spec_accepted / max(self.spec_drafted, 1))
+    @property
+    def dcache(self):
+        return self._spec_dcache

@@ -41,6 +41,10 @@ _BUDGET_BUCKETS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
 # token .. a full H=32 block over a wide batch
 _HORIZON_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                     256.0, 512.0)
+# accepted-draft run length per row per speculative round: 0 (all
+# rejected) .. a large adaptive gamma landing in full
+_SPEC_ACCEPT_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
+                        16.0)
 # host bookkeeping per decode step: 10us .. 1s (pure Python work —
 # far below the dispatch buckets; the overlap ratio
 # host_bookkeeping.sum / decode_step.sum needs resolution down here)
@@ -275,18 +279,27 @@ class EngineMetrics:
             "Context tokens restored from the host tier instead of "
             "being re-prefilled")
 
-        # -- speculative decoding ---------------------------------------
+        # -- speculative decoding (fused draft+verify lane) -------------
         self.spec_rounds = r.counter(
-            "paddle_tpu_spec_rounds_total",
-            "Speculative draft+verify rounds")
+            "paddle_tpu_engine_spec_rounds_total",
+            "Fused speculative draft+verify rounds (one dispatch "
+            "each)")
+        self.spec_drafted_tokens = r.counter(
+            "paddle_tpu_engine_spec_drafted_tokens_total",
+            "Draft tokens proposed (gamma per spec-on row per round)")
         self.spec_accepted_tokens = r.counter(
-            "paddle_tpu_spec_accepted_tokens_total",
-            "Draft tokens accepted by exact verification")
+            "paddle_tpu_engine_spec_accepted_tokens_total",
+            "Draft tokens accepted by exact greedy verification")
+        self.spec_accept_len = r.histogram(
+            "paddle_tpu_engine_spec_accept_len_tokens",
+            "Accepted-draft run length per row per round (0..gamma; "
+            "the row always commits one extra exact token on top)",
+            buckets=_SPEC_ACCEPT_BUCKETS)
         self.spec_gamma = r.gauge(
-            "paddle_tpu_spec_gamma_tokens",
+            "paddle_tpu_engine_spec_gamma_tokens",
             "Current draft length (adaptive gamma retunes it)")
         self.spec_acceptance = r.gauge(
-            "paddle_tpu_spec_acceptance_ratio",
+            "paddle_tpu_engine_spec_acceptance_ratio",
             "Accepted draft tokens / drafted tokens, lifetime")
 
 

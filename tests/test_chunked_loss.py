@@ -45,6 +45,7 @@ def test_indivisible_chunks_raises():
         chunked_softmax_cross_entropy(x, w, t, 4, jnp.float32)
 
 
+@pytest.mark.slow
 def test_flagship_loss_chunks_parity():
     from paddle_tpu.models.llama_pretrain import (
         LlamaPretrainConfig, build_mesh, init_params, make_forward)

@@ -98,6 +98,7 @@ def test_decode_over_tp_sharded_mesh():
     np.testing.assert_array_equal(toks_tp, toks_1)
 
 
+@pytest.mark.slow
 def test_layer_model_cached_generate_matches_recompute():
     """Round-3 regression: the eager cached generate previously (a)
     applied RoPE at position 0 for every appended token and (b) ran the
@@ -157,6 +158,7 @@ def test_layer_model_generate_compiled_bridge():
     assert o2.shape == [2, 10]
 
 
+@pytest.mark.slow
 def test_generate_beam_k1_equals_greedy_and_oracle_k3():
     """Compiled beam search: num_beams=1 degenerates to greedy
     (token-exact vs make_generate), and num_beams=3 matches an eager

@@ -140,6 +140,7 @@ def test_segmented_kernel_batched_rows():
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_varlen_qkvpacked_matches_per_sequence_dense():
     """The fused segmented program == per-sequence dense attention,
     forward and backward through the tape, including an odd total that

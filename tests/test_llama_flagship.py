@@ -124,6 +124,7 @@ def test_graft_entry():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_adafactor_and_bf16_moment_lanes():
     """Round-3 bench optimizers: Adafactor (factored second moment) and
     AdamW with quantized (bf16) moments both train the tiny flagship.

@@ -411,12 +411,21 @@ def test_speculative_engine_metrics():
     eng.submit(rng.randint(1, 128, (9,)), max_new_tokens=6)
     eng.run_to_completion()
     assert eng.spec_rounds >= 1
-    assert _val(reg, "paddle_tpu_spec_rounds_total") == eng.spec_rounds
-    assert _val(reg, "paddle_tpu_spec_accepted_tokens_total") \
+    assert _val(reg, "paddle_tpu_engine_spec_rounds_total") \
+        == eng.spec_rounds
+    assert _val(reg, "paddle_tpu_engine_spec_drafted_tokens_total") \
+        == eng.spec_drafted
+    assert _val(reg, "paddle_tpu_engine_spec_accepted_tokens_total") \
         == eng.spec_accepted
-    assert _val(reg, "paddle_tpu_spec_gamma_tokens") == eng.gamma
+    assert _val(reg, "paddle_tpu_engine_spec_gamma_tokens") \
+        == eng.gamma
+    # accept-length histogram: one observation per spec-on row per
+    # round, each in [0, gamma]
+    h = reg.get("paddle_tpu_engine_spec_accept_len_tokens")
+    assert h.count == eng.spec_rounds
+    assert h.sum == eng.spec_accepted
     # same-model draft: every draft accepted -> lifetime ratio 1.0
-    acc = _val(reg, "paddle_tpu_spec_acceptance_ratio")
+    acc = _val(reg, "paddle_tpu_engine_spec_acceptance_ratio")
     assert acc == pytest.approx(
         eng.spec_accepted / max(eng.spec_drafted, 1))
 

@@ -445,7 +445,11 @@ def test_horizon_speculative_rejected():
                          page=16)
     dcache = PagedKVCache(cfg, num_pages=32, pages_max=8, batch=2,
                           page=16)
-    with pytest.raises(ValueError, match="plain-decode-lane"):
+    # the fused speculative round IS the multi-token program: the
+    # rejection names that (gamma subsumes the horizon), not a lane
+    # turf claim
+    with pytest.raises(ValueError,
+                       match="tune spec.gamma instead"):
         SpeculativeEngine(cfg, params, cache, cfg, params, dcache,
                           decode_horizon=4)
 

@@ -1,0 +1,485 @@
+"""Fused speculative decoding as an engine lane
+(``ContinuousBatchingEngine(spec=SpecConfig(...))``): one jitted
+draft+verify program per round, ONE dispatch and ONE fetch per round,
+composed with the production lanes instead of forking the scheduler.
+
+Contract under test:
+* GREEDY TOKEN-EXACTNESS vs the plain engine across the nasty paths —
+  packed/chunked admission, int8 KV (target AND draft pools),
+  ``overlap=True`` (round k's on-device accepted state chains into
+  round k+1), TP mp=4 (fp32 and int8-quantized draft collectives),
+  prefix caching, preemption (recompute and swap resume);
+* ONE dispatch and ONE ``_fetch`` per round, pinned by counting —
+  with draft == target every round accepts all gamma drafts, so the
+  round count itself is deterministic;
+* per-request ``spec=on|off`` mixing inside one fused round, and
+  ``default_on`` as the submit() default;
+* cancel / deadline mid-round release target AND draft page claims
+  audit-clean;
+* fleet (router) and disagg (DecodeEngine) pass the knob through —
+  prompt-lookup spec needs zero draft-model plumbing on replicas;
+* composition rejections carry the REAL constraint, not a scheduler
+  limitation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              build_mesh, init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import (ContinuousBatchingEngine,
+                                              SpecConfig)
+
+pytestmark = pytest.mark.spec
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+    base.update(kw)
+    return LlamaPretrainConfig(**base)
+
+
+_PARAMS = {}
+
+
+def _params(cfg, seed=0):
+    key = (cfg.num_key_value_heads, cfg.num_hidden_layers,
+           cfg.hidden_size, seed)
+    if key not in _PARAMS:
+        mesh = build_mesh(devices=jax.devices()[:1])
+        _PARAMS[key] = init_params(cfg, jax.random.PRNGKey(seed), mesh)
+    return _PARAMS[key]
+
+
+def _cache(cfg, num_pages=64, batch=2, kv_quant=None, host_pages=0,
+           pages_max=8):
+    return PagedKVCache(cfg, num_pages=num_pages, pages_max=pages_max,
+                        batch=batch, page=16, kv_quant=kv_quant,
+                        host_pages=host_pages)
+
+
+def _spec_engine(cfg, params, source="draft", gamma=3, dcfg=None,
+                 dseed=9, kv_quant=None, num_pages=64, batch=2,
+                 host_pages=0, pages_max=8, identical=False, **kw):
+    """Engine + its caches; ``identical=True`` uses draft == target
+    (acceptance 1.0 by construction — deterministic round counts)."""
+    cache = _cache(cfg, num_pages=num_pages, batch=batch,
+                   kv_quant=kv_quant, host_pages=host_pages,
+                   pages_max=pages_max)
+    if source == "draft":
+        if identical:
+            dcfg, dparams = cfg, params
+        else:
+            dcfg = dcfg or _cfg(num_hidden_layers=1, hidden_size=32)
+            dparams = _params(dcfg, seed=dseed)
+        dcache = _cache(dcfg, num_pages=max(num_pages, 12),
+                        batch=batch, kv_quant=kv_quant,
+                        pages_max=pages_max)
+        spec = SpecConfig(gamma=gamma, source="draft", draft_cfg=dcfg,
+                          draft_params=dparams, draft_cache=dcache)
+    else:
+        dcache = None
+        spec = SpecConfig(gamma=gamma, source="prompt_lookup")
+    eng = ContinuousBatchingEngine(cfg, params, cache, spec=spec, **kw)
+    return eng, cache, dcache
+
+
+def _drain_map(eng):
+    done = eng.run_to_completion()
+    return {r.rid: list(r.generated) for r in done}
+
+
+def _plain_ref(cfg, params, specs, kv_quant=None, **kw):
+    eng = ContinuousBatchingEngine(
+        cfg, params, _cache(cfg, kv_quant=kv_quant), **kw)
+    rids = [eng.submit(p, max_new_tokens=n) for p, n in specs]
+    done = _drain_map(eng)
+    return [done[r] for r in rids]
+
+
+# ---------------------------------------------------------------------------
+# token-exactness vs the plain greedy engine
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_spec_token_exact_vs_greedy_churn(kv_quant):
+    """Mixed-length requests streamed through a 2-slot batch (forced
+    queueing + slot reuse): a WEAK unrelated draft, sync and overlap,
+    fp32 and int8-KV pools — outputs equal the plain engine's
+    token-for-token (acceptance shapes speed, never content) and both
+    pools drain clean."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(0)
+    specs = [(rng.randint(1, 128, (int(rng.randint(3, 20)),)),
+              int(rng.randint(2, 9))) for _ in range(5)]
+    ref = _plain_ref(cfg, params, specs, kv_quant=kv_quant)
+
+    for overlap in (False, True):
+        eng, cache, dcache = _spec_engine(cfg, params, gamma=3,
+                                          kv_quant=kv_quant,
+                                          overlap=overlap)
+        rids = [eng.submit(p, max_new_tokens=n, spec=True)
+                for p, n in specs]
+        done = _drain_map(eng)
+        assert [done[r] for r in rids] == ref, f"overlap={overlap}"
+        assert eng.spec_rounds > 0 and eng.spec_drafted > 0
+        cache.audit()
+        dcache.audit()
+        assert cache.free_pages() == cache.num_pages - 1
+        assert dcache.free_pages() == dcache.num_pages - 1
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_spec_admission_lanes_token_exact(packed):
+    """Packed-varlen and batched (chunked rides the same seam via
+    prefill_chunk) admission both feed the fused rounds: token-exact,
+    and prompt-lookup needs no draft model on either lane."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(2)
+    specs = [(rng.randint(1, 128, (L,)), 6) for L in (5, 18, 11)]
+    ref = _plain_ref(cfg, params, specs, packed=packed)
+
+    eng, cache, _ = _spec_engine(cfg, params, source="prompt_lookup",
+                                 gamma=4, packed=packed)
+    rids = [eng.submit(p, max_new_tokens=n, spec=True)
+            for p, n in specs]
+    done = _drain_map(eng)
+    assert [done[r] for r in rids] == ref
+    assert eng.spec_drafted > 0
+    cache.audit()
+
+
+def test_spec_chunked_prefill_token_exact():
+    """A long prompt admitted through the chunked-prefill lane, then
+    decoded speculatively."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(3)
+    specs = [(rng.randint(1, 128, (70,)), 7)]
+    ref = _plain_ref(cfg, params, specs, prefill_chunk=32)
+    eng, cache, _ = _spec_engine(cfg, params, source="prompt_lookup",
+                                 gamma=3, prefill_chunk=32)
+    rid = eng.submit(specs[0][0], max_new_tokens=7, spec=True)
+    assert _drain_map(eng)[rid] == ref[0]
+    cache.audit()
+
+
+def test_spec_prefix_cache_token_exact():
+    """Shared-prefix traffic through ``enable_prefix_caching=True``:
+    the spec lane decodes on top of prefix-hit admissions exactly."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(4)
+    stem = rng.randint(1, 128, (32,))
+    specs = [(np.concatenate([stem, rng.randint(1, 128, (k,))]), 6)
+             for k in (3, 5)]
+    ref = _plain_ref(cfg, params, specs)
+
+    eng, cache, _ = _spec_engine(cfg, params, source="prompt_lookup",
+                                 gamma=3, num_pages=96,
+                                 enable_prefix_caching=True)
+    got = {}
+    for p, n in specs:                  # sequential: second admission
+        rid = eng.submit(p, max_new_tokens=n, spec=True)
+        for r in eng.run_to_completion():   # hits the cached prefix
+            got[r.rid] = list(r.generated)
+    assert [got[i] for i in sorted(got)] == ref
+    assert cache.prefix_hits >= 1
+    cache.audit()
+
+
+@pytest.mark.parametrize("host_pages", [0, 32])
+def test_spec_preemption_token_exact(host_pages):
+    """A pool too small for both rows forces preemption mid-spec:
+    recompute resume (host_pages=0) and swap resume (host tier) both
+    stay token-exact, and the DRAFT cache's per-round claims release
+    with the victim (the aux-rows discipline)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(5)
+    # tight target pool: both rows fit at admission (2 pages each of
+    # 5 usable) but cannot BOTH grow to their 16+20-token worst case
+    # (3 pages each) — growth mid-spec preempts a victim
+    specs = [(rng.randint(1, 128, (16,)), 20),
+             (rng.randint(1, 128, (16,)), 20)]
+    ref = _plain_ref(cfg, params, specs)
+
+    eng, cache, dcache = _spec_engine(cfg, params, gamma=3,
+                                      num_pages=6, pages_max=5,
+                                      host_pages=host_pages)
+    rids = [eng.submit(p, max_new_tokens=n, spec=True)
+            for p, n in specs]
+    done = _drain_map(eng)
+    assert [done[r] for r in rids] == ref
+    assert eng.preemptions > 0
+    cache.audit()
+    dcache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+    assert dcache.free_pages() == dcache.num_pages - 1
+
+
+def test_spec_tp_mp4_token_exact():
+    """The fused draft+verify through the shard_map seam on a 4-way
+    mesh: token-exact vs the single-device plain engine, with the
+    draft cache built on the SAME mesh; ``tp_allreduce='int8'``
+    (quantized DRAFT collectives — verify stays exact-fp) must still
+    be token-exact, acceptance merely shifts."""
+    cfg = _cfg(num_key_value_heads=4)
+    rng = np.random.RandomState(7)
+    specs = [(rng.randint(1, 128, (int(rng.randint(4, 20)),)), 6)
+             for _ in range(3)]
+
+    def run(mp, spec_on, overlap=False, tp_allreduce="fp32"):
+        mesh = build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=mp,
+                          devices=jax.devices()[:mp])
+        m = mesh if mp > 1 else None
+        params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+        cache = PagedKVCache(cfg, num_pages=64, pages_max=8, batch=2,
+                             page=16, mesh=m)
+        kw = {}
+        if spec_on:
+            dcache = PagedKVCache(cfg, num_pages=64, pages_max=8,
+                                  batch=2, page=16, mesh=m)
+            kw["spec"] = SpecConfig(gamma=3, source="draft",
+                                    draft_cfg=cfg,
+                                    draft_params=params,
+                                    draft_cache=dcache)
+        eng = ContinuousBatchingEngine(cfg, params, cache, mesh=m,
+                                       overlap=overlap,
+                                       tp_allreduce=tp_allreduce,
+                                       **kw)
+        rids = [eng.submit(p, max_new_tokens=n,
+                           spec=True if spec_on else None)
+                for p, n in specs]
+        done = _drain_map(eng)
+        cache.audit()
+        return [done[r] for r in rids], eng
+
+    ref, _ = run(1, False)
+    got, eng = run(4, True, overlap=True)
+    assert got == ref
+    assert eng.spec_rounds > 0
+    # draft == target on the SAME mesh: acceptance stays total even
+    # through the collective seam (exact fp32 allreduce)
+    assert eng.spec_accepted == eng.spec_drafted
+    got_q, eng_q = run(4, True, tp_allreduce="int8")
+    assert got_q == ref
+    assert eng_q.tp_allreduce_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch / fetch counting pins
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("overlap", [False, True])
+def test_spec_one_dispatch_and_fetch_per_round(overlap):
+    """draft == target accepts all gamma drafts every round, so a
+    budget-bound request commits exactly gamma+1 tokens per round:
+    ceil((max_new-1)/(gamma+1)) rounds, each ONE dispatch and ONE
+    4-array ``_fetch`` (the overlap lane pays its usual single
+    chained lookahead round extra) — the per-round amortization the
+    A/B bench measures, pinned by counting, not timing."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, cache, _ = _spec_engine(cfg, params, gamma=3, identical=True,
+                                 batch=1, overlap=overlap)
+    fetches = []
+    orig = eng._fetch
+    eng._fetch = lambda *a: fetches.append(len(a)) or orig(*a)
+    prompt = np.random.RandomState(1).randint(1, 128, (10,))
+    eng.submit(prompt, max_new_tokens=9, spec=True)  # 8 decode tokens
+    done = eng.run_to_completion()
+    assert len(done[0].generated) == 9
+    rounds = 2 if not overlap else 3     # ceil(8/4) (+1 chained)
+    assert eng.decode_steps == rounds
+    assert fetches == [4] * rounds       # toks/dones/emits/accepts
+    assert eng.host_syncs == rounds
+    # phantom chained rounds never inflate the accounting: the
+    # device-chain mask excludes them, so the identity holds exactly
+    assert eng.spec_rounds == 2
+    assert eng.spec_drafted == eng.spec_rounds * 3
+    assert eng.spec_accepted == eng.spec_drafted
+    cache.audit()
+
+
+def test_spec_per_request_mix_and_default():
+    """spec-on and spec-off rows ride the SAME fused round (the off
+    row's accept window is just 1); ``default_on=False`` makes plain
+    ``submit()`` non-speculative and ``spec=True`` opts in."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(11)
+    specs = [(rng.randint(1, 128, (9,)), 8),
+             (rng.randint(1, 128, (14,)), 8)]
+    ref = _plain_ref(cfg, params, specs)
+
+    cache = _cache(cfg)
+    dcache = _cache(cfg)
+    eng = ContinuousBatchingEngine(
+        cfg, params, cache,
+        spec=SpecConfig(gamma=3, source="draft", draft_cfg=cfg,
+                        draft_params=params, draft_cache=dcache,
+                        default_on=False))
+    r_on = eng.submit(specs[0][0], max_new_tokens=8, spec=True)
+    r_off = eng.submit(specs[1][0], max_new_tokens=8)  # default: off
+    done = _drain_map(eng)
+    assert done[r_on] == ref[0]
+    assert done[r_off] == ref[1]
+    # only the opted-in row drafted (identical draft: full accept),
+    # and the off row emitted exactly one token per fused round
+    assert eng.spec_drafted == eng.spec_rounds * 3
+    assert eng.spec_accepted == eng.spec_drafted
+    cache.audit()
+    dcache.audit()
+    assert dcache.free_pages() == dcache.num_pages - 1
+
+
+def test_spec_cancel_and_deadline_audit_clean():
+    """cancel() and an expired deadline mid-round release BOTH the
+    target rows' pages and the spec rows' draft-cache claims through
+    the ordinary flush-then-free discipline — audit clean on both
+    pools, fully drained."""
+    cfg = _cfg()
+    params = _params(cfg)
+    eng, cache, dcache = _spec_engine(cfg, params, gamma=3,
+                                      overlap=True)
+    now = [1000.0]
+    eng._now = lambda: now[0]
+    rng = np.random.RandomState(6)
+    r1 = eng.submit(rng.randint(1, 128, (10,)), max_new_tokens=40,
+                    spec=True)
+    r2 = eng.submit(rng.randint(1, 128, (12,)), max_new_tokens=40,
+                    deadline_s=5.0, spec=True)
+    eng.step()
+    eng.step()
+    eng.cancel(r1)
+    now[0] += 10.0
+    done = eng.run_to_completion()
+    by = {r.rid: r for r in done}
+    assert by[r1].status == "cancelled"
+    assert by[r2].status == "expired"
+    cache.audit()
+    dcache.audit()
+    assert cache.free_pages() == cache.num_pages - 1
+    assert dcache.free_pages() == dcache.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# fleet / disagg pass-through
+# ---------------------------------------------------------------------------
+def test_spec_fleet_pass_through():
+    """Prompt-lookup spec on fleet replicas through ONE constructor
+    knob: the router forwards the per-request toggle over the
+    submit path, outputs stay token-exact, and the replicas really
+    drafted."""
+    from paddle_tpu.fleet import FleetRouter
+
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(21)
+    specs = [(rng.randint(1, 128, (L,)), 6) for L in (8, 15, 11, 19)]
+    ref = _plain_ref(cfg, params, specs)
+
+    def mk():
+        cache = _cache(cfg)
+        return ContinuousBatchingEngine(
+            cfg, params, cache, metrics_registry=False,
+            spec=SpecConfig(gamma=3, source="prompt_lookup"))
+
+    router = FleetRouter([mk, mk])
+    rids = [router.submit(p, max_new_tokens=n, spec=True)
+            for p, n in specs]
+    done = {}
+    steps = 0
+    while router.has_work():
+        router.step()
+        for r in router.finished():
+            done[r.rid] = list(r.generated)
+        steps += 1
+        assert steps < 2000
+    for r in router.finished():
+        done[r.rid] = list(r.generated)
+    assert [done[r] for r in rids] == ref
+    assert sum(h.engine.spec_drafted for h in router._replicas) > 0
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_spec_disagg_pass_through():
+    """A disagg DecodeEngine built with the spec knob serves its
+    handoff traffic speculatively (prompt-lookup: zero draft-model
+    plumbing on the decode replica) — token-exact vs the unified
+    plain engine, zero prefill dispatches for handoff traffic."""
+    from paddle_tpu.models.disagg import (DecodeEngine,
+                                          DisaggCoordinator,
+                                          PrefillEngine)
+
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.RandomState(31)
+    specs = [(rng.randint(1, 128, (L,)), 8) for L in (10, 33, 21)]
+    ref = _plain_ref(cfg, params, specs)
+
+    pe = PrefillEngine(cfg, params, _cache(cfg, host_pages=32),
+                       metrics_registry=False)
+    de = DecodeEngine(cfg, params, _cache(cfg, host_pages=32),
+                      metrics_registry=False,
+                      spec=SpecConfig(gamma=3,
+                                      source="prompt_lookup"))
+    co = DisaggCoordinator(pe, de, metrics_registry=False,
+                           force_route="prefill")
+    # the coordinator has no per-request toggle: the DecodeEngine's
+    # SpecConfig(default_on=True) speculates its decode traffic —
+    # handoff admissions included — through the constructor knob alone
+    rids = [co.submit(p, max_new_tokens=n) for p, n in specs]
+    done = {}
+    steps = 0
+    while co.has_work():
+        co.step()
+        for r in co.finished():
+            done[r.rid] = list(r.generated)
+        steps += 1
+        assert steps < 2000
+    for r in co.finished():
+        done[r.rid] = list(r.generated)
+    assert [done[r] for r in rids] == ref
+    assert de.spec_drafted > 0
+    assert de.handoff_admits == len(specs)
+    pe.cache.audit()
+    de.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# composition rejections carry the real constraint
+# ---------------------------------------------------------------------------
+def test_spec_rejections_name_real_constraints():
+    cfg = _cfg()
+    params = _params(cfg)
+    cache = _cache(cfg)
+    lookup = SpecConfig(gamma=3, source="prompt_lookup")
+    with pytest.raises(ValueError, match="tune spec.gamma instead"):
+        ContinuousBatchingEngine(cfg, params, cache, spec=lookup,
+                                 decode_horizon=4)
+    with pytest.raises(ValueError, match="mixed"):
+        ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                 spec=lookup, mixed=True)
+    with pytest.raises(ValueError, match="gamma"):
+        ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                 spec=SpecConfig(gamma=0,
+                                                 source="prompt_lookup"))
+    with pytest.raises(ValueError, match="draft_cfg"):
+        ContinuousBatchingEngine(cfg, params, _cache(cfg),
+                                 spec=SpecConfig(gamma=3,
+                                                 source="draft"))
+    eng = ContinuousBatchingEngine(cfg, params, _cache(cfg))
+    with pytest.raises(ValueError, match="SpecConfig"):
+        eng.submit(np.arange(1, 6), max_new_tokens=4, spec=True)

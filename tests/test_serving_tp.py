@@ -302,6 +302,7 @@ def _run_spec(mp, prompts, new=8, overlap=False):
     return {r.rid: list(r.generated) for r in done}, eng
 
 
+@pytest.mark.slow
 def test_tp_speculative_token_exact():
     """SpeculativeEngine on a 4-way mesh: draft + verify both run
     sharded and the committed output is token-exact vs the

@@ -33,6 +33,7 @@ def _params(cfg, seed=0):
     return init_params(cfg, jax.random.PRNGKey(seed), mesh)
 
 
+@pytest.mark.slow
 def test_speculative_matches_target_greedy_any_draft():
     """A WEAK draft (1 layer, unrelated random weights) still yields
     the target's exact greedy sequence — acceptance only shapes the
@@ -88,6 +89,7 @@ def test_speculative_validates_gamma():
                              np.arange(1, 6), 4, gamma=0)
 
 
+@pytest.mark.slow
 def test_speculative_engine_serves_batch_token_exact():
     """Continuous-batching SPECULATIVE serving: mixed-length requests
     decode in draft+verify rounds, every output token-exact vs its
@@ -152,6 +154,7 @@ def test_speculative_engine_serves_batch_token_exact():
     assert eng2.spec_accepted == eng2.spec_rounds * 3   # full gamma
 
 
+@pytest.mark.slow
 def test_speculative_engine_composes_with_prefix_caching():
     """Prefix caching on the TARGET cache under speculative serving:
     the second same-prefix request reuses cached pages and both
@@ -215,6 +218,7 @@ def test_speculative_engine_survives_preemption():
     assert dcache.free_pages() == dcache.num_pages - 1
 
 
+@pytest.mark.slow
 def test_speculative_engine_adaptive_gamma():
     """Adaptive gamma (host-side, zero recompilation): an identical
     draft's full acceptance grows gamma toward max_gamma; a useless
@@ -253,6 +257,7 @@ def test_speculative_engine_adaptive_gamma():
     assert gamma_bad <= 2, gamma_bad               # shrank or held
 
 
+@pytest.mark.slow
 def test_speculative_engine_churn_property_parity():
     """CHURN stress for the speculative engine: randomized staggered
     requests through 2 slots with preemption pressure and a weak

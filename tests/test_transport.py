@@ -689,6 +689,7 @@ def test_chaos_mixed_schedule_soak_no_silent_drops(cfg, params):
             _teardown(router)
 
 
+@pytest.mark.slow
 def test_sigkill_process_agent_mid_decode(cfg, params):
     """THE real-wire acceptance pin: an agent in its own OS process
     is SIGKILLed mid-decode — no Python exception, no FIN beyond the

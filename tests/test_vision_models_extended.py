@@ -21,7 +21,8 @@ def _img(n=1, c=3, s=224):
 # full matrix runs with `pytest -m slow`
 @pytest.mark.parametrize("ctor", [
     pytest.param(M.alexnet, marks=pytest.mark.slow),
-    M.squeezenet1_0, M.squeezenet1_1,
+    pytest.param(M.squeezenet1_0, marks=pytest.mark.slow),
+    M.squeezenet1_1,
     pytest.param(M.mobilenet_v3_small, marks=pytest.mark.slow),
     pytest.param(M.mobilenet_v3_large, marks=pytest.mark.slow),
     pytest.param(M.shufflenet_v2_x0_25, marks=pytest.mark.slow),

@@ -8,6 +8,7 @@ Usage:
   python tools/metrics_dump.py events  http://127.0.0.1:8000 [-n 50] [--follow]
   python tools/metrics_dump.py fleet   http://127.0.0.1:8000
   python tools/metrics_dump.py disagg  http://127.0.0.1:8000
+  python tools/metrics_dump.py spec    http://127.0.0.1:8000
   python tools/metrics_dump.py transport http://127.0.0.1:8000
   python tools/metrics_dump.py traces  http://127.0.0.1:8000 [--min-ms N] [--status S]
   python tools/metrics_dump.py trace   http://127.0.0.1:8000 <rid>
@@ -21,7 +22,10 @@ renders a FleetServer's aggregated ``GET /fleet`` snapshot (replica
 lifecycle states, per-replica load, routing/failover counters);
 ``disagg`` renders the disaggregated prefill/decode slice of
 ``GET /stats`` (handoff traffic, in-flight depth, routing decisions,
-fallbacks, handoff ms/request); ``transport`` renders a socket
+fallbacks, handoff ms/request); ``spec`` renders the fused
+speculative-decoding slice (rounds/drafted/accepted counters, live
+gamma, accept-length histogram, derived acceptance ratio);
+``transport`` renders a socket
 fleet's wire health — per-replica connection mode/address, lease
 age, reconnect/retry/heartbeat-miss counters and wire volume from
 ``GET /fleet``, plus the ``paddle_tpu_transport_*`` registry slice
@@ -200,6 +204,39 @@ def _render_disagg(snap: dict) -> str:
 def cmd_disagg(args) -> int:
     body = json.loads(_get(args.url.rstrip("/") + "/stats"))
     print(_render_disagg(body.get("metrics", body)))
+    return 0
+
+
+def _render_spec(snap: dict) -> str:
+    """The fused speculative-decoding slice of a registry snapshot:
+    round/draft/accept counters, the live gamma, and the per-round
+    accept-length histogram with the derived acceptance ratio."""
+    spec = {n: m for n, m in snap.items()
+            if n.startswith("paddle_tpu_engine_spec_")}
+    if not spec:
+        return ("no paddle_tpu_engine_spec_* metrics in this "
+                "snapshot (engine built without spec=SpecConfig?)")
+    lines = [_render_snapshot(spec)]
+    drafted = (spec.get(
+        "paddle_tpu_engine_spec_drafted_tokens_total")
+        or {}).get("value") or 0
+    accepted = (spec.get(
+        "paddle_tpu_engine_spec_accepted_tokens_total")
+        or {}).get("value") or 0
+    rounds = (spec.get("paddle_tpu_engine_spec_rounds_total")
+              or {}).get("value") or 0
+    if drafted:
+        lines.append(
+            f"acceptance = {accepted / drafted:.4f}  "
+            f"accepted tokens/round = "
+            f"{accepted / max(rounds, 1):.2f}  "
+            f"(committed/round adds the +1 correction token)")
+    return "\n".join(lines)
+
+
+def cmd_spec(args) -> int:
+    body = json.loads(_get(args.url.rstrip("/") + "/stats"))
+    print(_render_spec(body.get("metrics", body)))
     return 0
 
 
@@ -458,6 +495,11 @@ def main(argv=None) -> int:
                             "prefill/decode slice of GET /stats")
     s.add_argument("url")
     s.set_defaults(fn=cmd_disagg)
+    s = sub.add_parser("spec",
+                       help="pretty-print the fused speculative-"
+                            "decoding slice of GET /stats")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_spec)
     s = sub.add_parser("transport",
                        help="pretty-print a socket fleet's wire "
                             "health (GET /fleet + /stats)")
