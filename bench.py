@@ -1138,6 +1138,183 @@ def _fleet_line() -> dict:
     }
 
 
+def _serving_qos_line() -> dict:
+    """SLO-GUARDRAIL serving A/B (ISSUE 20 tentpole): the same RAMPED
+    mixed-class load (high/normal/low interleaved, offered waves
+    growing past a single replica's queue capacity) runs through a
+    fixed 1-replica fleet and the same fleet under a
+    ``FleetAutoscaler`` — per-class TTFT p99, shed/degrade/reject
+    counts, and the replica-count trajectory the controller walked.
+    A third arm re-runs the autoscaled ramp with ``replica_death``
+    injected MID-RAMP: the settle guard must hand the dead replica to
+    the router's auto-replace (exactly one replacement, no controller
+    oscillation).  ``value`` is the autoscaled/fixed aggregate decode
+    throughput ratio."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.fleet import FleetAutoscaler, FleetRouter
+    from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                                  init_params)
+    from paddle_tpu.models.paged_decode import PagedKVCache
+    from paddle_tpu.models.serving_engine import (
+        ContinuousBatchingEngine, QueueFullError)
+    from paddle_tpu.testing import faults
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaPretrainConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_seq_len=2048,
+            use_pallas_attention=True, remat=False,
+            dtype=jnp.bfloat16)
+        batch, page, new = 8, 64, 32
+        num_pages, pages_max = 96, 8
+        queue_cap, max_replicas = 8, 3
+        wave_sizes = (4, 6, 8, 10, 10, 8)
+        steps_per_wave, prompt_lens = 3, (48, 96, 160, 220)
+        high_qt, low_qt = 512.0, 64.0
+        metric = "serving_qos_ab"
+    else:
+        cfg = LlamaPretrainConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False, loss_chunks=1,
+            use_pallas_attention=False)
+        batch, page, new = 2, 16, 8
+        num_pages, pages_max = 64, 8
+        queue_cap, max_replicas = 4, 3
+        wave_sizes = (2, 3, 4, 5, 5, 4)
+        steps_per_wave, prompt_lens = 2, (6, 11, 15, 19)
+        high_qt, low_qt = 24.0, 4.0
+        metric = "serving_qos_tiny_cpu_smoke_ab"
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    params = init_params(cfg, jax.random.PRNGKey(0), mesh)
+    rng = np.random.RandomState(0)
+    # ramped offered load: wave w submits wave_sizes[w] requests, the
+    # class mix fixed (1 high : 2 normal : 2 low) so the shed/degrade
+    # split is attributable, lengths cycled so compiles are shared
+    classes = ("high", "normal", "normal", "low", "low")
+    load = [[(rng.randint(1, cfg.vocab_size,
+                          (prompt_lens[j % len(prompt_lens)],)),
+              classes[j % len(classes)])
+             for j in range(nw)] for nw in wave_sizes]
+    warm = [rng.randint(1, cfg.vocab_size, (L,)) for L in prompt_lens]
+
+    def factory():
+        cache = PagedKVCache(cfg, num_pages=num_pages,
+                             pages_max=pages_max, batch=batch,
+                             page=page)
+        return ContinuousBatchingEngine(
+            cfg, params, cache, max_queue_len=queue_cap,
+            metrics_registry=False)
+
+    def live_count(router):
+        return sum(1 for h in router._replicas
+                   if h.state in ("READY", "DEGRADED")
+                   and not h.retiring)
+
+    def run(autoscale, kill_wave=None):
+        router = FleetRouter([factory], metrics_registry=False)
+        for p in warm:                              # warm compiles
+            router.submit(p, max_new_tokens=2)
+        router.run_to_completion()
+        asc = FleetAutoscaler(
+            router, factory, min_replicas=1,
+            max_replicas=max_replicas, high_queued_tokens=high_qt,
+            low_queued_tokens=low_qt, up_consecutive=1,
+            down_consecutive=2, cooldown_s=0.0) if autoscale else None
+        cls_of, rejected, degraded = {}, {}, 0
+        trajectory, done = [], []
+        fp = faults.install() if kill_wave is not None else None
+        t0 = time.perf_counter()
+        try:
+            for w, wave in enumerate(load):
+                for p, c in wave:
+                    try:
+                        rid = router.submit(p, max_new_tokens=new,
+                                            priority=c)
+                        cls_of[rid] = c
+                    except QueueFullError:
+                        rejected[c] = rejected.get(c, 0) + 1
+                if w == kill_wave:
+                    # nth matches the site's CUMULATIVE consult
+                    # counter — arm relative to it so the very next
+                    # replica step is the one that dies
+                    fp.inject("replica_death",
+                              RuntimeError("bench mid-ramp kill"),
+                              nth=fp.counts.get("replica_death",
+                                                0) + 1)
+                for _ in range(steps_per_wave):
+                    router.step()
+                if asc:
+                    asc.tick()
+                trajectory.append(live_count(router))
+                done.extend(router.finished())
+            done.extend(router.run_to_completion())
+            if asc:                    # drained: walk back to min
+                for _ in range(4):
+                    asc.tick()
+                    router.step()
+                    trajectory.append(live_count(router))
+        finally:
+            if fp is not None:
+                faults.uninstall()
+        wall = time.perf_counter() - t0
+        for h in router._replicas:
+            if h.state not in ("DEAD",):
+                h.engine.cache.audit()
+        ok = [r for r in done if r.status == "ok"]
+        degraded = sum(1 for r in done if r.degraded)
+        by_cls = {c: [(r.t_first_token - r.t_submit) * 1000
+                      for r in ok if cls_of.get(r.rid) == c
+                      and r.t_first_token]
+                  for c in ("high", "normal", "low")}
+        out = {
+            "requests_offered": sum(wave_sizes),
+            "ok": len(ok),
+            "rejected_by_class": rejected,
+            "degraded": degraded,
+            "tok_per_s": round(
+                sum(len(r.generated) for r in ok) / wall, 1),
+            "ttft_p99_ms_by_class": {
+                c: _ab_pct(v, 0.99) for c, v in by_cls.items()},
+            "replica_trajectory": trajectory,
+            "deaths": router.deaths, "replaces": router.replaces,
+        }
+        if asc:
+            out.update(scale_ups=asc.scale_ups,
+                       scale_downs=asc.scale_downs,
+                       skipped_settling=asc.skipped_settling)
+        return out
+
+    fixed = run(autoscale=False)
+    scaled = run(autoscale=True)
+    killed = run(autoscale=True, kill_wave=len(wave_sizes) // 2)
+    return {
+        "metric": metric,
+        "value": round(scaled["tok_per_s"]
+                       / max(fixed["tok_per_s"], 1e-9), 4),
+        "unit": "x",
+        "vs_baseline": 0,
+        "extra": {"platform": platform, "batch_slots": batch,
+                  "queue_cap_per_replica": queue_cap,
+                  "max_replicas": max_replicas,
+                  "wave_sizes": list(wave_sizes),
+                  "class_mix": "1 high : 2 normal : 2 low",
+                  "fixed_1_replica": fixed,
+                  "autoscaled": scaled,
+                  "autoscaled_midramp_kill": killed},
+    }
+
+
 def _remote_line() -> dict:
     """SOCKETS-TRANSPORT serving A/B (ISSUE 14 tentpole): the same
     offered load runs through an in-process ``FleetRouter`` and a
@@ -2618,6 +2795,7 @@ def main() -> None:
          _preemption_line),
         ("serving_fault_recovery", "ratio", _fault_recovery_line),
         ("serving_fleet_ab", "x", _fleet_line),
+        ("serving_qos_ab", "x", _serving_qos_line),
         ("serving_disagg_ab", "x", _disagg_line),
         ("serving_mixed_ab", "x", _serving_mixed_line),
         ("serving_trace_overhead", "ratio", _trace_overhead_line),

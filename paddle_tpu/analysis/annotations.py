@@ -71,6 +71,16 @@ SYNC_HOT_ROOTS: List[str] = [
     "FleetRouter._submit_locked",
     "FleetRouter._candidates_locked",
     "FleetRouter._place_locked",
+    # QoS scheduler-policy seam (ISSUE 20): class-ordered admission,
+    # priority-preemption victim selection and the shed verdict all
+    # run inside the admission wave / submit path — policy decisions
+    # must stay pure host bookkeeping (a device sync inside victim
+    # selection would stall every admission)
+    "ContinuousBatchingEngine._collect_admissions",
+    "ContinuousBatchingEngine._priority_preempt",
+    "SchedulerPolicy.order_queue",
+    "SchedulerPolicy.select_victim",
+    "SchedulerPolicy.preemptable_for",
     # disaggregated prefill/decode (PR 9): the restore-side admission
     # path (adopt + zero-prefill re-admission) and the coordinator/
     # router handoff-ship paths run under the pipeline lock while
@@ -356,7 +366,8 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
                          "route_errors", "_handoffs",
                          "disagg_decisions", "handoffs_shipped",
                          "handoff_pages", "handoff_bytes",
-                         "colocated_fallbacks"}),
+                         "colocated_fallbacks", "quota_rejected",
+                         "scale_ups", "scale_downs"}),
         locked_methods=frozenset({
             "_submit_locked", "_candidates_locked", "_place_locked",
             "_step_locked", "_on_death_locked", "_replace_locked",
@@ -367,11 +378,32 @@ SHARED_STATE: Dict[str, SharedStateSpec] = {
             "_transport_default", "_disagg_wins_locked",
             "_count_disagg_placement_locked",
             "_inflight_handoffs_locked", "_roles_locked",
-            "_harvest_dead_traces_locked"}),
+            "_harvest_dead_traces_locked",
+            "_add_replica_locked", "_retire_locked"}),
         note="public API takes _lock; every *_locked helper is a "
              "documented called-with-lock-held contract "
              "(handoff_transport, _transport_default included: ship "
-             "runs inside the router step)"),
+             "runs inside the router step).  quotas (TenantQuotas) "
+             "is internally locked — charged under the router lock "
+             "in _submit_locked but safe standalone"),
+    # fleet autoscaler (ISSUE 20): a periodic controller thread ticks
+    # while HTTP/dashboard threads read snapshot(); streaks, cooldown
+    # clock and decision counters serialize on the autoscaler lock.
+    # LOCK ORDER: autoscaler lock -> router lock (tick calls only the
+    # router's PUBLIC verbs: fleet_snapshot/add_replica/
+    # retire_replica); the router never calls into the autoscaler, so
+    # no ABBA pairing exists.
+    "fleet.autoscaler.FleetAutoscaler": SharedStateSpec(
+        lock="_lock",
+        attrs=frozenset({"_up_streak", "_down_streak", "_last_scale",
+                         "scale_ups", "scale_downs", "ticks",
+                         "skipped_settling", "skipped_cooldown",
+                         "desired"}),
+        locked_methods=frozenset({"_tick_locked",
+                                  "_publish_desired"}),
+        note="tick()/snapshot() take _lock; the router lock is only "
+             "ever acquired INSIDE (autoscaler -> router, never "
+             "reverse)"),
     # disaggregation coordinator (PR 9): HTTP handler threads
     # submit/cancel while the serving front's drive thread ticks the
     # pipeline; the request table, handoff queues and pipeline
@@ -619,6 +651,25 @@ CLAIMS: Dict[str, ClaimSpec] = {
              "death (reclaimed through _release_engine_claims)",
         note="owned by coordinator/router deques across ticks; "
              "every triage branch discards or ships — chaos-tested"),
+    # a scaled-up replica slot: add_replica appends a live handle
+    # (engine threads, sockets, device pages behind it) that only the
+    # router's replica table reaches — it must park RETIRED through
+    # retire_replica's drain (or the DEAD->retire edge) before its
+    # resources are truly free.  Registry-scope: the lifecycle pass
+    # in _step_locked audits every slot each tick.
+    "replica-handle": ClaimSpec(
+        kind="replica-handle",
+        acquires=frozenset({"add_replica"}),
+        releases=frozenset({"retire_replica", "retire"}),
+        value_bearing=True,
+        scope="registry",
+        leak="a live replica no controller retires: engine threads + "
+             "device pages held past the fleet's need, autoscaler "
+             "bounds silently violated",
+        note="RETIRED slots stay in _replicas (fleet rids index the "
+             "table) but hold no engine claims — retire() runs "
+             "_release_engine_claims / closes the agent connection; "
+             "pinned by the autoscaler chaos tests"),
     # a live trace entry: begun at submit, it must reach
     # finish_trace on EVERY request ending (retire / synth finish /
     # rejected placement) or it squats in Tracer._live — bounded by

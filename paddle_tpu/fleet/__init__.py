@@ -52,6 +52,7 @@ prefill/decode failure-mode matrix"; metric catalogue:
 docs/OBSERVABILITY.md.
 """
 
+from .autoscaler import FleetAutoscaler                # noqa: F401
 from .router import (FleetRouter, ReplicaHandle,       # noqa: F401
                      REPLICA_STATES)
 from .server import FleetServer                        # noqa: F401
@@ -61,7 +62,8 @@ from .transport import (Connection, LeaseExpiredError,  # noqa: F401
                         ProtocolError, TransportError,
                         open_connection)
 
-__all__ = ["FleetRouter", "ReplicaHandle", "FleetServer",
+__all__ = ["FleetRouter", "FleetAutoscaler", "ReplicaHandle",
+           "FleetServer",
            "REPLICA_STATES", "RemoteSpec", "RemoteReplicaHandle",
            "ReplicaAgent", "spawn_agent_process", "Connection",
            "open_connection", "TransportError", "ProtocolError",

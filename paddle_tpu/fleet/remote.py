@@ -93,6 +93,8 @@ def wire_request(req: Request, trace_id=None) -> dict:
             "t_first_token": float(req.t_first_token),
             "t_finish": float(req.t_finish),
             "spec": req.spec,
+            "priority": req.priority, "tenant": req.tenant,
+            "degraded": bool(req.degraded),
             "phase": req.phase, "t_phase": float(req.t_phase),
             "phase_log": [[p, float(a), float(b)]
                           for p, a, b in req.phase_log],
@@ -120,6 +122,9 @@ def request_from_wire(d: dict, prompt: np.ndarray) -> Request:
     req.status = d["status"]
     req.error = d["error"]
     req.preempted = int(d.get("preempted", 0))
+    req.priority = d.get("priority", "normal")
+    req.tenant = d.get("tenant")
+    req.degraded = bool(d.get("degraded", False))
     req.phase = d["phase"]
     req.t_phase = shift(d["t_phase"])
     req.phase_log = [(p, shift(a), shift(b))
@@ -477,7 +482,9 @@ class ReplicaAgent:
             prompt, max_new_tokens=header["max_new_tokens"],
             stop_sequences=header.get("stop_sequences"),
             deadline_s=header.get("deadline_s"),
-            spec=header.get("spec"))
+            spec=header.get("spec"),
+            priority=header.get("priority", "normal"),
+            tenant=header.get("tenant"))
         self._mut += 1
         self._remember_key_locked(key, rid)
         if header.get("trace_id") is not None:
@@ -635,6 +642,11 @@ class ReplicaAgent:
                 "queued_tokens": eng.queued_tokens(),
                 "max_queue_len": eng.max_queue_len,
                 "max_queued_tokens": eng.max_queued_tokens,
+                "overload_factor": float(getattr(
+                    getattr(eng, "policy", None),
+                    "overload_factor", 2.0)),
+                "has_priorities": bool(getattr(
+                    eng, "_has_priorities", False)),
                 "retry_after_s": eng.retry_after_s(),
                 "decode_steps": eng.decode_steps,
                 "tokens_generated": eng.tokens_generated,
@@ -842,24 +854,35 @@ class _RemoteEngine:
     def retry_after_s(self) -> float:
         return float(self._h.snap.get("retry_after_s", 1.0))
 
-    def queue_capacity_reason(self,
-                              prompt_len: int = 0) -> Optional[str]:
+    def queue_capacity_reason(self, prompt_len: int = 0,
+                              factor: float = 1.0,
+                              priority: Optional[str] = None,
+                              ) -> Optional[str]:
         """The engine's backpressure predicate over the mirrored
         counters — same arithmetic, ≤ one tick stale; ``submit()``
         re-checks on the agent, so a stale None costs one steered
-        retry, never an over-admission."""
+        retry, never an over-admission.  Mirrors the class-aware
+        form: a non-shed class probes against the agent's hard bound
+        (``overload_factor`` rides the snapshot; the agent-side shed
+        policy stays authoritative)."""
         snap = self._h.snap
+        if priority is not None and priority != "low" and \
+                (snap.get("has_priorities") or priority != "normal"):
+            factor = max(factor,
+                         float(snap.get("overload_factor", 2.0)))
         mql = snap.get("max_queue_len")
-        if mql is not None and snap.get("queued", 0) >= mql:
+        if mql is not None and \
+                snap.get("queued", 0) >= int(mql * factor):
             return (f"admission queue full: {snap.get('queued')} "
-                    f"waiting >= max_queue_len {mql}")
+                    f"waiting >= max_queue_len {int(mql * factor)}")
         mqt = snap.get("max_queued_tokens")
         if mqt is not None:
+            bound = int(mqt * factor)
             waiting = snap.get("queued_tokens", 0)
             need = max(int(prompt_len), 1)
-            if waiting + need > mqt:
+            if waiting + need > bound:
                 return (f"queued tokens {waiting} + prompt {need} "
-                        f"> max_queued_tokens {mqt}")
+                        f"> max_queued_tokens {bound}")
         return None
 
 
@@ -966,7 +989,8 @@ class _RemoteSupervisor:
     # -- placement --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None, deadline_s=None, trace=None,
-               fleet_rid=None, spec=None) -> int:
+               fleet_rid=None, spec=None, priority="normal",
+               tenant=None) -> int:
         h = self._h
         prompt = np.ascontiguousarray(np.asarray(prompt, np.int64))
         self._nsub += 1
@@ -976,6 +1000,8 @@ class _RemoteSupervisor:
                   "stop_sequences": stop_sequences,
                   "deadline_s": deadline_s,
                   "spec": spec,
+                  "priority": priority,
+                  "tenant": tenant,
                   "key": f"{h.client_id}:{key_part}",
                   "trace_id": trace.trace_id
                   if trace is not None else None}
@@ -1108,6 +1134,7 @@ class RemoteReplicaHandle:
     lock, like the in-process handle."""
 
     remote = True
+    retiring = False    # scale-down mark (see ReplicaHandle.retiring)
 
     def __init__(self, idx: int, spec: RemoteSpec, *,
                  role: Optional[str] = None, metrics=None):
@@ -1389,6 +1416,26 @@ class RemoteReplicaHandle:
     @property
     def drained(self) -> bool:
         return self.state == "DRAINING" and self.supervisor.drained
+
+    def retire(self) -> None:
+        """Terminal scale-down for a socket replica: shut the
+        (already drained) agent down, close the connection, park the
+        handle in RETIRED.  Teardown is best-effort — a retiring
+        replica that died first has nothing left to shut down."""
+        self.state = "RETIRED"
+        self.retiring = False
+        try:
+            self.shutdown_agent(graceful=True)
+        except Exception:
+            pass
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:
+                pass
+            self.conn = None
+        self._halt_backend()
+        self.local_rids.clear()
 
     def shutdown_agent(self, graceful: bool = True) -> None:
         """Ask the agent to exit — gracefully (finish in-flight

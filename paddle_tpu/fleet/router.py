@@ -56,9 +56,10 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..models.serving_engine import (EngineDeadError, EngineSupervisor,
-                                     QueueFullError, Request,
-                                     _drive_to_completion,
+from ..models.serving_engine import (PRIORITIES, EngineDeadError,
+                                     EngineSupervisor, QueueFullError,
+                                     QuotaExceededError, Request,
+                                     TenantQuotas, _drive_to_completion,
                                      _release_engine_claims)
 from ..observability import (FleetMetrics, advance_phase,
                              finalize_request_trace, phase_clocks)
@@ -66,7 +67,8 @@ from ..testing import faults
 
 __all__ = ["FleetRouter", "ReplicaHandle", "REPLICA_STATES"]
 
-REPLICA_STATES = ("STARTING", "READY", "DEGRADED", "DRAINING", "DEAD")
+REPLICA_STATES = ("STARTING", "READY", "DEGRADED", "DRAINING", "DEAD",
+                  "RETIRED")
 
 
 class ReplicaHandle:
@@ -76,6 +78,9 @@ class ReplicaHandle:
     itself carries no synchronization."""
 
     remote = False      # RemoteReplicaHandle (fleet/remote.py) = True
+    # scale-down mark: a retiring replica's drain (or death) ends in
+    # RETIRED — permanently out of rotation — instead of a replace
+    retiring = False
 
     def __init__(self, idx: int, factory: Callable, *,
                  max_restarts: int = 3, window_s: float = 60.0,
@@ -154,6 +159,16 @@ class ReplicaHandle:
     def drained(self) -> bool:
         return self.state == "DRAINING" and self.supervisor.drained
 
+    def retire(self) -> None:
+        """Terminal scale-down: release the engine's page/swap claims
+        and leave the handle parked in its slot (fleet rids index the
+        replica table — the slot never shifts).  A RETIRED replica is
+        never routed to, stepped, or auto-replaced."""
+        self.state = "RETIRED"
+        self.retiring = False
+        _release_engine_claims(self.supervisor.engine)
+        self.local_rids.clear()
+
 
 @dataclass
 class _FleetRequest:
@@ -184,6 +199,10 @@ class _FleetRequest:
     # engine's SpecConfig.default_on); rides every placement,
     # including failover re-placements
     spec: Optional[bool] = None
+    # QoS: scheduling class + quota tenant — both ride failover
+    # re-placements too (a crash must not launder a request's class)
+    priority: str = "normal"
+    tenant: Optional[str] = None
 
 
 class FleetRouter:
@@ -214,6 +233,7 @@ class FleetRouter:
                  handoff_gbps: float = 10.0,
                  handoff_chip_flops: Optional[float] = None,
                  max_inflight_handoffs: int = 8,
+                 tenant_quotas: Optional[TenantQuotas] = None,
                  metrics_registry=None, metrics_ring=None,
                  tracer=None):
         """``roles`` (one per factory, default all ``"unified"``)
@@ -246,6 +266,15 @@ class FleetRouter:
                 f"unknown replica role(s) {bad}: expected 'unified', "
                 f"'prefill' or 'decode'")
         self._lock = threading.Lock()
+        # per-tenant token-rate quotas enforced at the ROUTER (fleet
+        # deployments meter here, once — build the replica engines
+        # WITHOUT tenant_quotas or a request pays twice)
+        self.quotas = tenant_quotas
+        # replica-construction kwargs, reused by add_replica() so a
+        # scaled-up replica carries the same restart budget
+        self._restart_kw = dict(max_restarts=max_restarts,
+                                window_s=restart_window_s,
+                                backoff_s=restart_backoff_s)
         # per-request tracing: the router mints one MANAGED
         # TraceContext per accepted request (trace id = FLEET rid) and
         # propagates it into every engine that ever owns the request —
@@ -330,8 +359,11 @@ class FleetRouter:
         self.disagg_decisions = {"disagg": 0, "colocated": 0}
         self.failovers = 0
         self.rejected = 0
+        self.quota_rejected = 0           # tenant over its token bucket
         self.deaths = 0
         self.replaces = 0
+        self.scale_ups = 0                # add_replica() joins
+        self.scale_downs = 0              # retire_replica() completions
         self.route_errors = 0             # route_dispatch candidate fails
         self.handoffs_shipped = 0
         self.handoff_pages = 0
@@ -381,18 +413,26 @@ class FleetRouter:
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None,
                deadline_s: Optional[float] = None,
-               spec: Optional[bool] = None) -> int:
+               spec: Optional[bool] = None,
+               priority: str = "normal",
+               tenant: Optional[str] = None) -> int:
         """Route + queue a request; returns the FLEET rid (stable
         across failovers).  Raises ``ValueError`` for a request no
-        replica could ever hold (same validation as the engine) and
+        replica could ever hold (same validation as the engine),
+        ``QuotaExceededError`` when ``tenant`` is over its token-rate
+        bucket (``retry_after`` = the bucket's refill time), and
         ``QueueFullError`` only when EVERY admitting replica refused —
         carrying the aggregate ``retry_after`` (min over READY
-        replicas).  Thread safety: ``any-thread`` (serializes on the
+        replicas).  ``priority`` rides to the replica engine, whose
+        class-aware shed/preemption policy applies unchanged (the
+        router's capacity probe asks the class-aware form, so a
+        high/normal request is still routed while only low is being
+        shed).  Thread safety: ``any-thread`` (serializes on the
         router lock)."""
         with self._lock:
             return self._submit_locked(prompt, max_new_tokens,
                                        stop_sequences, deadline_s,
-                                       spec)
+                                       spec, priority, tenant)
 
     def cancel(self, rid: int) -> bool:
         """Cancel a fleet request wherever it lives — on a replica
@@ -475,7 +515,130 @@ class FleetRouter:
         """Rebuild replica ``idx`` from its factory immediately (the
         manual form of ``auto_replace``)."""
         with self._lock:
-            self._replace_locked(self._replicas[idx])
+            h = self._replicas[idx]
+            if h.state == "RETIRED":
+                raise ValueError(
+                    f"replica {idx} is RETIRED (scaled down) — "
+                    f"grow through add_replica() instead")
+            self._replace_locked(h)
+
+    # -- scaling verbs (the FleetAutoscaler's grow/shrink seam) -----------
+    def add_replica(self, factory: Callable, *,
+                    role: str = "unified") -> int:
+        """GROW the fleet by one replica built from ``factory`` (an
+        engine factory, or a :class:`~paddle_tpu.fleet.remote
+        .RemoteSpec` for a socket-backed agent).  The replica joins
+        through the same STARTING→READY lifecycle as construction and
+        is routable from the next ``submit``/``step``.  Returns the
+        new replica's index (stable for its lifetime)."""
+        with self._lock:
+            return self._add_replica_locked(factory, role)
+
+    def retire_replica(self, idx: int) -> None:
+        """SHRINK the fleet by one replica: drains it (admission
+        stops, in-flight work finishes token-exact), then the next
+        ``step()`` parks it in terminal state RETIRED instead of
+        rebuilding it.  Idempotent on an already-retiring/RETIRED
+        replica.  The last admitting replica cannot be retired — a
+        fleet must keep serving."""
+        with self._lock:
+            h = self._replicas[idx]
+            if h.state == "RETIRED" or h.retiring:
+                return
+            survivors = [r for r in self._replicas
+                         if r.idx != idx and
+                         r.state not in ("DEAD", "RETIRED") and
+                         not r.retiring]
+            if not survivors:
+                raise ValueError(
+                    f"cannot retire replica {idx}: it is the last "
+                    f"live replica ({self._states_locked()})")
+            h.retiring = True
+            if h.state == "DEAD":
+                # already down: nothing to drain — the next step's
+                # lifecycle pass retires it instead of auto-replacing
+                self._update_gauges_locked()
+                return
+            if h.state != "DRAINING":
+                h.drain()
+                if self.metrics is not None:
+                    self.metrics.replica_drains.inc()
+                    self.metrics.ring.emit("replica_drain",
+                                           replica=idx, retiring=True)
+            self._update_gauges_locked()
+
+    def _add_replica_locked(self, factory: Callable,
+                            role: str) -> int:
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"unknown replica role {role!r}: expected 'unified', "
+                f"'prefill' or 'decode'")
+        idx = len(self._replicas)
+        if getattr(factory, "is_remote_spec", False):
+            from .remote import RemoteReplicaHandle
+            h = RemoteReplicaHandle(idx, factory, role=role)
+        else:
+            h = ReplicaHandle(idx, factory, role=role,
+                              **self._restart_kw)
+        try:
+            eng = h.engine
+            if h.role == "prefill" and \
+                    not hasattr(eng, "take_handoffs"):
+                raise ValueError(
+                    f"replica {idx} has role='prefill' but its "
+                    f"factory built {type(eng).__name__}")
+            if h.role == "decode" and \
+                    not hasattr(eng, "admit_handoff"):
+                raise ValueError(
+                    f"replica {idx} has role='decode' but its "
+                    f"factory built {type(eng).__name__}")
+        except BaseException:
+            # same leak discipline as construction: a rejected remote
+            # handle already started an agent/connection
+            if h.remote:
+                try:
+                    h.kill("add_replica validation failed")
+                except Exception:
+                    pass
+            raise
+        self._replicas.append(h)
+        if h.remote:
+            self._has_remote = True
+            if self.metrics is not None \
+                    and self.transport_metrics is None:
+                from ..observability import TransportMetrics
+                self.transport_metrics = TransportMetrics(
+                    self.metrics.registry, ring=self.metrics.ring)
+            if self.transport_metrics is not None:
+                h.set_transport_metrics(self.transport_metrics)
+        if h.role == "prefill":
+            self._has_prefill_lane = True
+            if self.metrics is not None \
+                    and self.disagg_metrics is None:
+                from ..observability import DisaggMetrics
+                self.disagg_metrics = DisaggMetrics(
+                    self.metrics.registry, ring=self.metrics.ring)
+        self.scale_ups += 1
+        if self.metrics is not None:
+            self.metrics.scale_up.inc()
+            self.metrics.ring.emit("fleet_scale_up", replica=idx,
+                                   role=role, remote=h.remote)
+        self._update_gauges_locked()
+        return idx
+
+    def _retire_locked(self, h: ReplicaHandle) -> None:
+        """Complete a scale-down: the drained (or dead) retiring
+        replica parks in RETIRED.  CONTRACT: caller holds ``_lock``."""
+        h.retire()
+        # its cache is gone for good — stop steering prefix traffic
+        self._prefix_owner = {k: v for k, v
+                              in self._prefix_owner.items()
+                              if v != h.idx}
+        self.scale_downs += 1
+        if self.metrics is not None:
+            self.metrics.scale_down.inc()
+            self.metrics.ring.emit("fleet_scale_down",
+                                   replica=h.idx)
 
     # -- engine-compatible drive loop -------------------------------------
     def step(self) -> int:
@@ -493,14 +656,36 @@ class FleetRouter:
     # -- locked internals (CONTRACT: caller holds _lock; registered in
     #    analysis/annotations.py locked_methods) --------------------------
     def _submit_locked(self, prompt, max_new_tokens, stop_sequences,
-                       deadline_s, spec=None) -> int:
+                       deadline_s, spec=None, priority="normal",
+                       tenant=None) -> int:
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r}: expected one of "
+                f"{PRIORITIES}")
         prompt = np.asarray(prompt, np.int64)
         now = self._now()
+        if self.quotas is not None:
+            # quota verdict BEFORE any placement attempt: an
+            # over-budget tenant must not consume routing work or
+            # charge replica counters, and the 429 it gets carries the
+            # bucket's own refill hint, not a fleet-capacity one
+            try:
+                self.quotas.charge(
+                    tenant, len(prompt) + int(max_new_tokens),
+                    now=now)
+            except QuotaExceededError:
+                self.quota_rejected += 1
+                if self.metrics is not None:
+                    self.metrics.quota_rejected.inc()
+                    self.metrics.ring.emit("fleet_quota_rejected",
+                                           tenant=tenant)
+                raise
         deadline = 0.0 if deadline_s is None \
             else now + float(deadline_s)
         freq = _FleetRequest(self._next_rid, prompt,
                              int(max_new_tokens), stop_sequences,
-                             deadline, now, spec=spec)
+                             deadline, now, spec=spec,
+                             priority=priority, tenant=tenant)
         if self.tracer is not None:
             # the router OWNS the trace (managed=True): replicas
             # report phase spans into it, and the close lands at the
@@ -590,7 +775,7 @@ class FleetRouter:
         decode replicas."""
         n = len(self._handoffs)
         for h in self._replicas:
-            if h.state == "DEAD":
+            if h.state in ("DEAD", "RETIRED"):
                 continue
             eng = h.engine
             if h.role == "prefill":
@@ -655,12 +840,16 @@ class FleetRouter:
         last_exc: Optional[BaseException] = None
         for h in cands:
             if h.engine.queue_capacity_reason(
-                    len(freq.prompt)) is not None:
+                    len(freq.prompt),
+                    priority=freq.priority) is not None:
                 # side-effect-free capacity probe: a full replica is
                 # a ROUTING event, and charging its engine's
                 # requests_rejected counter (what submit()'s reject
                 # path does) would pollute the aggregated /metrics
-                # with rejections no client ever saw
+                # with rejections no client ever saw.  The probe is
+                # CLASS-AWARE: a replica over its soft bound still
+                # takes high/normal traffic (degrade-not-drop), so
+                # only low-class requests skip it here
                 queue_full = True
                 continue
             try:
@@ -679,7 +868,9 @@ class FleetRouter:
                 local = h.supervisor.submit(
                     freq.prompt, max_new_tokens=freq.max_new_tokens,
                     stop_sequences=freq.stop_sequences,
-                    deadline_s=deadline_s, trace=freq.trace, **extra)
+                    deadline_s=deadline_s, trace=freq.trace,
+                    priority=freq.priority, tenant=freq.tenant,
+                    **extra)
             except ValueError:
                 # the request itself is malformed/oversized — every
                 # replica would refuse identically; the client's fault
@@ -738,8 +929,14 @@ class FleetRouter:
             # client backs off no longer than the healthiest replica
             # needs (a single saturated replica never dictates it).
             ready = [h for h in self._replicas if h.state == "READY"]
-            agg = min((h.engine.retry_after_s()
-                       for h in (ready or cands)), default=1.0)
+            # a full-fleet restart/drain can leave ZERO READY replicas
+            # while DEGRADED candidates still probed full: the hint
+            # must stay a finite float on every path (a bare min()
+            # over an empty sequence would surface as a 500), so the
+            # guard is explicit rather than relying on cands being
+            # non-empty
+            hints = [h.engine.retry_after_s() for h in (ready or cands)]
+            agg = min(hints) if hints else 1.0
             if not failover:
                 # rejection accounting counts CLIENT-visible 429s
                 # only — a failover re-placement retry swallows this
@@ -753,18 +950,30 @@ class FleetRouter:
                         retry_after=agg)
             raise QueueFullError(
                 f"fleet saturated: all {len(cands)} admitting "
-                f"replicas rejected", retry_after=agg)
+                f"replicas rejected class {freq.priority!r}",
+                retry_after=agg)
         raise last_exc if last_exc is not None else EngineDeadError(
             f"no replica accepted: {self._states_locked()}")
 
     def _step_locked(self) -> int:
         now = self._now()
-        # 1. lifecycle: revive the dead, finish completed drains
+        # 1. lifecycle: revive the dead, finish completed drains.  A
+        # RETIRING replica's drain (or death) ends in RETIRED instead
+        # of a replace — the scale-down completes here, never at the
+        # verb (in-flight work finishes first)
         for h in self._replicas:
-            if h.state == "DEAD" and self.auto_replace:
-                self._replace_locked(h)
+            if h.state == "RETIRED":
+                continue
+            if h.state == "DEAD":
+                if h.retiring:
+                    self._retire_locked(h)
+                elif self.auto_replace:
+                    self._replace_locked(h)
             elif h.drained:
-                self._replace_locked(h)
+                if h.retiring:
+                    self._retire_locked(h)
+                else:
+                    self._replace_locked(h)
         # 2. re-place orphans (failover) before stepping: they re-enter
         # FIFO so a crash costs one tick of queue position, not more
         self._flush_pending_locked(now)
@@ -776,7 +985,7 @@ class FleetRouter:
         # 3. step every serving replica, then merge its outputs
         active = 0
         for h in self._replicas:
-            if h.state == "DEAD":
+            if h.state in ("DEAD", "RETIRED"):
                 continue
             if faults.active("replica_slow"):
                 # the replica stalls this tick (no step) and routing
@@ -856,12 +1065,15 @@ class FleetRouter:
                             pass
                 self._finished.append(req)
             active += len(h.engine._active)
-        # a drain that completed THIS tick replaces immediately — the
-        # fleet may go idle right here, and an idle fleet is never
-        # stepped again until new work arrives
+        # a drain that completed THIS tick replaces (or retires)
+        # immediately — the fleet may go idle right here, and an idle
+        # fleet is never stepped again until new work arrives
         for h in self._replicas:
             if h.drained:
-                self._replace_locked(h)
+                if h.retiring:
+                    self._retire_locked(h)
+                else:
+                    self._replace_locked(h)
         self._update_gauges_locked()
         return active
 
@@ -1187,6 +1399,7 @@ class FleetRouter:
                 "restarts": h.supervisor.restarts,
                 "deaths": h.deaths, "replaces": h.replaces,
                 "drains": h.drains, "slow_ticks": h.slow_ticks,
+                "retiring": h.retiring,
                 "error": h.error,
             })
             if h.remote:
@@ -1197,8 +1410,11 @@ class FleetRouter:
                "routed": dict(self.routed),
                "failovers": self.failovers,
                "rejected": self.rejected,
+               "quota_rejected": self.quota_rejected,
                "deaths": self.deaths,
                "replaces": self.replaces,
+               "scale_ups": self.scale_ups,
+               "scale_downs": self.scale_downs,
                "route_errors": self.route_errors,
                "pending_failovers": len(self._pending),
                "requests_live": len(self._requests)}
@@ -1242,6 +1458,7 @@ class FleetRouter:
         m.replicas_degraded.set(states["DEGRADED"])
         m.replicas_draining.set(states["DRAINING"])
         m.replicas_dead.set(states["DEAD"])
+        m.replicas_retired.set(states["RETIRED"])
         m.pending_failovers.set(len(self._pending))
         roles = self._roles_locked()
         m.role_prefill.set(roles["prefill"])

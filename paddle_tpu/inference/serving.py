@@ -411,12 +411,16 @@ class _GenHandler(BaseHTTPRequestHandler):
             max_new = int(req.get("max_new_tokens", 64))
             deadline = req.get("deadline_s")
             deadline = None if deadline is None else float(deadline)
+            priority = str(req.get("priority", "normal"))
+            tenant = req.get("tenant")
+            tenant = None if tenant is None else str(tenant)
         except Exception as e:
             self._reply(400, f"bad payload: {type(e).__name__}".encode(),
                         "text/plain")
             return
         try:
-            rid, q = srv.submit(prompt, max_new, deadline_s=deadline)
+            rid, q = srv.submit(prompt, max_new, deadline_s=deadline,
+                                priority=priority, tenant=tenant)
         except ValueError as e:           # oversized for the pool
             self._reply(400, f"rejected: {e}".encode(), "text/plain")
             return
@@ -448,8 +452,12 @@ class _GenHandler(BaseHTTPRequestHandler):
                     self._reply(code, text.encode(), "text/plain")
                     return
                 else:
-                    self._reply(200, json.dumps(
-                        {"rid": rid, "tokens": payload}).encode())
+                    doc = {"rid": rid, "tokens": payload}
+                    if kind == "done_degraded":
+                        # overload shed degraded this request (budget
+                        # halved / spec off) — an honest reply says so
+                        doc["degraded"] = True
+                    self._reply(200, json.dumps(doc).encode())
                     return
         # STREAMING: one JSON line per token as the engine produces it
         # (chunked transfer — the client reads lines incrementally)
@@ -483,9 +491,11 @@ class _GenHandler(BaseHTTPRequestHandler):
                     chunk(b"")
                     return
                 else:
-                    chunk(json.dumps({"rid": rid, "done": True,
-                                      "tokens": payload})
-                          .encode() + b"\n")
+                    doc = {"rid": rid, "done": True,
+                           "tokens": payload}
+                    if kind == "done_degraded":
+                        doc["degraded"] = True
+                    chunk(json.dumps(doc).encode() + b"\n")
                     chunk(b"")                  # terminal chunk: 0\r\n\r\n
                     return
         except (BrokenPipeError, ConnectionResetError):
@@ -922,9 +932,18 @@ class GenerationServer:
                 snap, "paddle_tpu_disagg_colocated_fallback_total"))
         return h
 
-    def submit(self, prompt, max_new_tokens, deadline_s=None):
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               priority="normal", tenant=None):
         import queue as _queue
         t0 = time.monotonic()
+        # QoS kwargs forward only when non-default: drive targets
+        # predating the priority/tenant surface (DisaggPipeline, bare
+        # custom engines) keep serving default-class traffic unchanged
+        kw = {}
+        if priority != "normal":
+            kw["priority"] = priority
+        if tenant is not None:
+            kw["tenant"] = tenant
         with self._lock:
             if self._fatal is not None:
                 raise RuntimeError(f"engine died: {self._fatal}")
@@ -935,7 +954,7 @@ class GenerationServer:
             q = _queue.Queue()
             rid = self._driver.submit(prompt,
                                       max_new_tokens=max_new_tokens,
-                                      deadline_s=deadline_s)
+                                      deadline_s=deadline_s, **kw)
             self._queues[rid] = q
         self._http_counters["generate"].inc()
         if self.tracer is not None:
@@ -996,7 +1015,11 @@ class GenerationServer:
                             if q is None:
                                 continue
                             if req.status == "ok":
-                                q.put(("done", list(req.generated)))
+                                q.put(("done_degraded"
+                                       if getattr(req, "degraded",
+                                                  False)
+                                       else "done",
+                                       list(req.generated)))
                             elif req.status == "expired":
                                 q.put(("err",
                                        (504, "deadline exceeded")))

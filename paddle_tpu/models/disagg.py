@@ -693,18 +693,22 @@ class DisaggCoordinator:
     # -- serving-front compatibility (GenerationServer /health reads
     #    these; each is a host-int read under the server's lock) ----------
     def queue_capacity_reason(
-            self, prompt_len: int = 0) -> Optional[str]:
+            self, prompt_len: int = 0, factor: float = 1.0,
+            priority: Optional[str] = None) -> Optional[str]:
         """Readiness form of the routing decision — readiness can
         never disagree with what ``submit()`` accepts: a disagg-routed
         prompt is accepted while EITHER lane has room (a full prefill
         queue falls back to colocated admission), a colocated one
-        answers for the decode engine alone."""
+        answers for the decode engine alone.  ``factor``/``priority``
+        forward to the lanes' class-aware forms unchanged."""
         with self._lock:
             if self._route_prefill_locked(prompt_len):
-                if self.prefill.queue_capacity_reason(prompt_len) \
-                        is None:
+                if self.prefill.queue_capacity_reason(
+                        prompt_len, factor=factor,
+                        priority=priority) is None:
                     return None
-            return self.decode.queue_capacity_reason(prompt_len)
+            return self.decode.queue_capacity_reason(
+                prompt_len, factor=factor, priority=priority)
 
     def queued_tokens(self) -> int:
         return (self.prefill.queued_tokens()

@@ -55,8 +55,23 @@ from .paged_decode import (PagedKVCache, _prefill, _prefill_chunk,
                            tp_collective_bytes_per_step)
 
 __all__ = ["ContinuousBatchingEngine", "EngineDeadError",
-           "EngineSupervisor", "QueueFullError", "Request",
-           "SpecConfig"]
+           "EngineSupervisor", "PRIORITIES", "QueueFullError",
+           "QuotaExceededError", "Request", "SchedulerPolicy",
+           "SpecConfig", "TenantQuotas", "priority_rank"]
+
+# Priority classes, best first.  The admission queue orders by
+# (class, arrival), preemption-victim selection prefers the lowest
+# class, and overload shedding is class-aware (reject low, degrade
+# normal, protect high) — see SchedulerPolicy.
+PRIORITIES = ("high", "normal", "low")
+_PRIO_RANK = {"high": 0, "normal": 1, "low": 2}
+
+
+def priority_rank(priority: str) -> int:
+    """Sort key for a priority class: 0 is best ("high").  Unknown
+    strings rank as "normal" — rank is an ORDERING helper; validation
+    happens once, at ``submit()``."""
+    return _PRIO_RANK.get(priority, 1)
 
 
 class QueueFullError(RuntimeError):
@@ -69,6 +84,146 @@ class QueueFullError(RuntimeError):
     def __init__(self, why: str, retry_after: float = 1.0):
         super().__init__(why)
         self.retry_after = float(retry_after)
+
+
+class QuotaExceededError(QueueFullError):
+    """``submit()`` refused because the request's TENANT is over its
+    token-rate budget (:class:`TenantQuotas`) — distinct from pool
+    backpressure so clients and dashboards can tell "you are over
+    YOUR budget" from "the engine is full".  Subclasses
+    :class:`QueueFullError` so every HTTP front maps it to ``429`` +
+    ``Retry-After`` for free; ``retry_after`` is derived from the
+    bucket refill rate (how long until the bucket holds this
+    request's cost again), not from engine throughput."""
+
+    def __init__(self, why: str, retry_after: float = 1.0,
+                 tenant: Optional[str] = None):
+        super().__init__(why, retry_after=retry_after)
+        self.tenant = tenant
+
+
+class TenantQuotas:
+    """Per-tenant token-rate buckets enforced at admission: each
+    tenant accrues ``rate_tokens_per_s`` up to ``burst_tokens`` and a
+    submission charges its WORST-CASE token cost (prompt +
+    max_new_tokens) up front, so one tenant's burst can never consume
+    another tenant's capacity — isolation holds even when the pool
+    itself still has room.  ``overrides`` maps tenant name ->
+    ``(rate_tokens_per_s, burst_tokens)`` for per-tenant contracts;
+    requests with ``tenant=None`` are UNMETERED (quota is an opt-in
+    contract, not a default tax).
+
+    Thread safety: ``external-lock``, like ``submit()`` — the engine
+    and the fleet router both consult it behind their own serving
+    lock (see ``analysis/annotations.py THREAD_SAFETY``)."""
+
+    def __init__(self, rate_tokens_per_s: float,
+                 burst_tokens: Optional[float] = None,
+                 overrides: Optional[Dict[str, tuple]] = None):
+        if rate_tokens_per_s <= 0:
+            raise ValueError("rate_tokens_per_s must be > 0, got "
+                             f"{rate_tokens_per_s}")
+        self.rate = float(rate_tokens_per_s)
+        self.burst = float(burst_tokens if burst_tokens is not None
+                           else rate_tokens_per_s)
+        self.overrides = dict(overrides or {})
+        # tenant -> [level, last_refill_t]; buckets start FULL so a
+        # cold tenant gets its burst immediately
+        self._buckets: Dict[str, list] = {}
+
+    def _limits(self, tenant: str) -> tuple:
+        if tenant in self.overrides:
+            rate, burst = self.overrides[tenant]
+            return float(rate), float(burst)
+        return self.rate, self.burst
+
+    def charge(self, tenant: Optional[str], cost: float,
+               now: float) -> None:
+        """Deduct ``cost`` tokens from ``tenant``'s bucket or raise
+        :class:`QuotaExceededError` with a refill-derived
+        ``Retry-After``.  All-or-nothing: a refused charge leaves the
+        bucket untouched (the rejected request must not erode the
+        tenant's budget)."""
+        if tenant is None:
+            return
+        rate, burst = self._limits(tenant)
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            bucket = self._buckets[tenant] = [burst, now]
+        level, last = bucket
+        level = min(burst, level + (now - last) * rate)
+        bucket[1] = now
+        if cost > level:
+            # a cost the bucket can NEVER hold (> burst) still answers
+            # finitely: time to refill the whole burst — the client's
+            # real fix is a smaller request, and the hint says so
+            deficit = min(cost, burst) - level
+            bucket[0] = level
+            raise QuotaExceededError(
+                f"tenant {tenant!r} over token-rate quota: cost "
+                f"{cost:.0f} > bucket {level:.0f} (rate {rate:.0f} "
+                f"tok/s, burst {burst:.0f})",
+                retry_after=float(min(max(deficit / rate, 0.1), 60.0)),
+                tenant=tenant)
+        bucket[0] = level - cost
+
+
+class SchedulerPolicy:
+    """The scheduler-policy seam extracted from the engine's
+    admission/preemption paths: WHICH queued request admits next,
+    WHICH active request is evicted under pool pressure, and HOW
+    overload sheds by class.  The default implements the SLO
+    guardrails contract — admission orders by (class, arrival),
+    preemption evicts the lowest class first (LIFO by ``admit_seq``
+    within a class), and ``queue_capacity_reason()`` tripping sheds
+    class-aware: reject low with 429, degrade normal (halve
+    ``max_new_tokens``, disable spec), protect high up to
+    ``overload_factor`` times the configured bounds.  Subclass and
+    pass ``ContinuousBatchingEngine(policy=...)`` to change any of
+    the three decisions without touching the admission machinery."""
+
+    # hard-bound multiplier protected classes may overflow the soft
+    # queue bounds by under overload (beyond it even "high" rejects:
+    # truly unbounded admission is a worse failure than a 429)
+    overload_factor = 2.0
+
+    def order_queue(self, queue: deque) -> deque:
+        """Class-order the admission queue.  The sort is STABLE by
+        rank only, so arrival order — including a preempted request's
+        requeue-at-the-head position — is preserved within a class."""
+        return deque(sorted(queue,
+                            key=lambda r: priority_rank(r.priority)))
+
+    def select_victim(self, victims: List[int],
+                      active: Dict[int, "Request"]) -> int:
+        """Preemption victim among ``victims`` (slot ids): lowest
+        class first, most recently admitted within a class —
+        high-priority work survives pool pressure at the expense of
+        low, and within a class the old LIFO-by-``admit_seq`` rule
+        still minimizes wasted prefill."""
+        return max(victims,
+                   key=lambda s: (priority_rank(active[s].priority),
+                                  active[s].admit_seq))
+
+    def preemptable_for(self, head: "Request",
+                        active: Dict[int, "Request"]) -> List[int]:
+        """Slots the queue head may evict to get a seat: every active
+        request of a STRICTLY lower class.  Empty list = no priority
+        preemption (equal-class work is never churned)."""
+        hr = priority_rank(head.priority)
+        return [s for s, r in active.items()
+                if priority_rank(r.priority) > hr]
+
+    def shed(self, priority: str) -> str:
+        """Overload verdict for a class when the soft capacity bound
+        trips: ``"reject"`` (429 now), ``"degrade"`` (admit with
+        halved ``max_new_tokens`` + spec off, up to the hard bound)
+        or ``"admit"`` (untouched, up to the hard bound)."""
+        if priority == "low":
+            return "reject"
+        if priority == "normal":
+            return "degrade"
+        return "admit"
 
 
 class EngineDeadError(RuntimeError):
@@ -191,6 +346,14 @@ class Request:
     spec: Optional[bool] = None
     admit_seq: int = -1                   # admission order (preemption)
     preempted: int = 0                    # times evicted + requeued
+    # QoS: priority class ("high"/"normal"/"low") orders admission and
+    # picks preemption victims (SchedulerPolicy); ``tenant`` keys the
+    # token-rate quota buckets; ``degraded`` marks a request admitted
+    # under overload with a halved budget + spec off — surfaced in the
+    # done message so the client knows it got the degraded tier
+    priority: str = "normal"
+    tenant: Optional[str] = None
+    degraded: bool = False
     # lifecycle timestamps (time.monotonic; 0.0 = not reached).
     # t_admit/t_first_token survive preemption — a re-admission must
     # not re-observe queue-wait/TTFT.
@@ -291,6 +454,8 @@ class ContinuousBatchingEngine:
                  mixed_ctx_cap: Optional[int] = None,
                  decode_horizon: int = 1,
                  spec: Optional[SpecConfig] = None,
+                 policy: Optional[SchedulerPolicy] = None,
+                 tenant_quotas: Optional[TenantQuotas] = None,
                  tracer=None):
         """``mesh`` (an mp>1 device mesh, with ``params`` initialised
         on it and ``cache`` built with the same mesh) serves a
@@ -496,6 +661,16 @@ class ContinuousBatchingEngine:
         # instead of growing host memory without limit
         self.max_queue_len = max_queue_len
         self.max_queued_tokens = max_queued_tokens
+        # -- QoS (SLO guardrails, docs/FAULT_TOLERANCE.md) ------------
+        # scheduler policy seam: class-ordered admission, class-aware
+        # preemption victims and overload shedding; tenant token-rate
+        # buckets charged at submit().  _has_priorities stays False on
+        # all-default traffic so the legacy FIFO path pays zero cost.
+        self.policy = policy if policy is not None else SchedulerPolicy()
+        self.quotas = tenant_quotas
+        self._has_priorities = False
+        self.requests_degraded = 0
+        self.quota_rejected = 0
         # per-step exception handling: quarantine the poisoned wave
         # (retire its slots with an error done-message, stay alive) up
         # to max_consecutive_faults faults in a row, then escalate —
@@ -707,7 +882,9 @@ class ContinuousBatchingEngine:
     def submit(self, prompt, max_new_tokens: int = 64,
                stop_sequences=None,
                deadline_s: Optional[float] = None,
-               trace=None, spec: Optional[bool] = None) -> int:
+               trace=None, spec: Optional[bool] = None,
+               priority: str = "normal",
+               tenant: Optional[str] = None) -> int:
         """Queue a request.  Oversized requests fail HERE with
         ``ValueError`` — one bad request must never surface mid
         ``step()`` and kill every in-flight generation (a row's
@@ -741,6 +918,22 @@ class ContinuousBatchingEngine:
         routers / disagg coordinators propagate their fleet-rid
         trace this way); ``None`` mints one from the engine's own
         ``tracer`` when attached.
+
+        ``priority``: QoS class (``"high"``/``"normal"``/``"low"``).
+        The admission queue orders by (class, arrival), preemption
+        evicts the lowest class first, and overload sheds class-aware
+        — when ``queue_capacity_reason()`` trips, low rejects with
+        :class:`QueueFullError`, normal admits DEGRADED (halved
+        ``max_new_tokens``, spec off, ``degraded`` flagged in the
+        done message) and high admits untouched, both up to
+        ``policy.overload_factor`` times the configured bounds.
+
+        ``tenant``: token-rate quota key.  With
+        ``tenant_quotas=TenantQuotas(...)`` configured, the request's
+        worst-case token cost charges the tenant's bucket here;
+        over-budget raises :class:`QuotaExceededError` (a 429 with a
+        refill-derived ``Retry-After``).  ``tenant=None`` is
+        unmetered.
 
         Thread safety: ``external-lock`` — NOT internally
         synchronized; safe from non-engine threads only when every
@@ -793,9 +986,64 @@ class ContinuousBatchingEngine:
                 "spec=True needs an engine built with "
                 "spec=SpecConfig(...): the fused draft+verify "
                 "program is compiled at engine construction")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got "
+                f"{priority!r}")
+        degraded = False
         why = self.queue_capacity_reason(len(prompt))
         if why is not None:
-            self._reject(why)
+            # CLASS-AWARE SHEDDING: the soft bound tripped.  Low
+            # rejects (429 absorbs the burst); normal degrades (halved
+            # budget, spec off) and high admits untouched — both only
+            # up to the HARD bound (overload_factor x the soft bounds:
+            # protecting a class must not mean unbounded host memory).
+            # A pure default-class workload (no request ever carried a
+            # non-normal priority) keeps the legacy FIFO refusal: the
+            # soft bound stays the one clients were tuned against, and
+            # degradation only buys anything when there is a class
+            # hierarchy to protect.
+            if self._has_priorities or priority != "normal":
+                verdict = self.policy.shed(priority)
+            else:
+                verdict = "reject"
+            if verdict == "reject":
+                self._reject(why)
+            hard = self.queue_capacity_reason(
+                len(prompt), factor=self.policy.overload_factor)
+            if hard is not None:
+                self._reject(f"{hard} [hard bound, class "
+                             f"{priority!r}]")
+            if verdict == "degrade":
+                max_new_tokens = max(1, int(max_new_tokens) // 2)
+                spec = False if self._spec is not None else spec
+                degraded = True
+                self.requests_degraded += 1
+                if self.metrics is not None:
+                    self.metrics.requests_degraded.inc()
+                    self.metrics.ring.emit(
+                        "request_degraded", reason=why,
+                        priority=priority, tenant=tenant,
+                        max_new_tokens=int(max_new_tokens))
+        if self.quotas is not None:
+            # worst-case token cost (prompt + remaining budget), so an
+            # aggressive tenant is priced for the capacity it can
+            # consume, not just what it happened to generate.  Charged
+            # AFTER the shed decision: a rejected request must not
+            # erode the tenant's budget, and a degraded one charges
+            # its halved budget.
+            try:
+                self.quotas.charge(
+                    tenant, len(prompt) + int(max_new_tokens),
+                    now=self._now())
+            except QuotaExceededError:
+                self.quota_rejected += 1
+                if self.metrics is not None:
+                    self.metrics.quota_rejected.inc()
+                    self.metrics.ring.emit("quota_rejected",
+                                           tenant=tenant,
+                                           priority=priority)
+                raise
         deadline = 0.0
         if deadline_s is not None:
             deadline = self._now() + float(deadline_s)
@@ -805,7 +1053,11 @@ class ContinuousBatchingEngine:
         req = Request(rid, prompt, max_new_tokens,
                       stop_sequences=stops,
                       t_submit=time.monotonic(),
-                      deadline=deadline, spec=spec)
+                      deadline=deadline, spec=spec,
+                      priority=priority, tenant=tenant,
+                      degraded=degraded)
+        if priority != "normal":
+            self._has_priorities = True
         # phase accounting starts at the queue; ``trace`` (a
         # TraceContext a fleet router / disagg coordinator minted
         # under ITS rid space) wins over the engine's own tracer
@@ -820,7 +1072,8 @@ class ContinuousBatchingEngine:
             self.metrics.requests_submitted.inc()
             self.metrics.ring.emit("request_submitted", rid=rid,
                                    prompt_len=len(prompt),
-                                   max_new_tokens=max_new_tokens)
+                                   max_new_tokens=max_new_tokens,
+                                   priority=priority, tenant=tenant)
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -868,7 +1121,9 @@ class ContinuousBatchingEngine:
                           for r in tuple(self._queue))
 
     def queue_capacity_reason(
-            self, prompt_len: int = 0) -> Optional[str]:
+            self, prompt_len: int = 0,
+            factor: float = 1.0,
+            priority: Optional[str] = None) -> Optional[str]:
         """Why the bounded admission queue would refuse a submission
         right now, or ``None`` while capacity remains — the ONE
         predicate behind ``submit()``'s backpressure, the serving
@@ -876,21 +1131,55 @@ class ContinuousBatchingEngine:
         ``accepting()``, so readiness can never disagree with what
         ``submit()`` actually accepts.  ``prompt_len=0`` asks the
         readiness form: would a minimal (1-token) prompt risk
-        refusal.  Thread safety: ``external-lock``, like
+        refusal.
+
+        ``factor`` scales both bounds (the class-aware shed path asks
+        the HARD bound with ``policy.overload_factor``); ``priority``
+        asks the class-aware form directly — "would ``submit()``
+        REJECT this class right now" (None for a protected/degraded
+        class while the soft bound trips but the hard bound holds) —
+        which is what the fleet router's placement probe needs to stay
+        side-effect-free without guessing the shed verdict.
+
+        Thread safety: ``external-lock``, like
         :meth:`submit` (see ``analysis/annotations.py
         THREAD_SAFETY``)."""
-        if self.max_queue_len is not None and \
-                len(self._queue) >= self.max_queue_len:
-            return (f"admission queue full: {len(self._queue)} "
-                    f"waiting >= max_queue_len {self.max_queue_len}")
+        if priority is not None and \
+                (self._has_priorities or priority != "normal") and \
+                self.policy.shed(priority) != "reject":
+            # mirror submit(): a pure default-class workload keeps the
+            # legacy soft-bound refusal, so the probe must not promise
+            # hard-bound capacity submit() would then reject
+            factor = max(factor, self.policy.overload_factor)
+        if self.max_queue_len is not None:
+            bound = int(self.max_queue_len * factor)
+            if len(self._queue) >= bound:
+                return (f"admission queue full: {len(self._queue)} "
+                        f"waiting >= max_queue_len {bound}")
         if self.max_queued_tokens is not None:
+            bound = int(self.max_queued_tokens * factor)
             waiting = self.queued_tokens()
             need = max(int(prompt_len), 1)
-            if waiting + need > self.max_queued_tokens:
+            if waiting + need > bound:
                 return (f"queued tokens {waiting} + prompt {need} "
-                        f"> max_queued_tokens "
-                        f"{self.max_queued_tokens}")
+                        f"> max_queued_tokens {bound}")
         return None
+
+    def queued_by_class(self) -> Dict[str, int]:
+        """Waiting requests per priority class (mixed-lane parked rows
+        included — their prefill is still owed).  Thread safety:
+        ``any-thread``, like :meth:`queued_tokens` — iterates atomic
+        ``tuple()`` snapshots, so the per-class gauges scrape
+        lock-free."""
+        out = {p: 0 for p in PRIORITIES}
+        for r in tuple(self._queue):
+            out[r.priority if r.priority in out else "normal"] += 1
+        parked = getattr(self, "_mixed_pref", None)
+        if parked:
+            for e in tuple(parked.values()):
+                p = e["req"].priority
+                out[p if p in out else "normal"] += 1
+        return out
 
     def retry_after_s(self) -> float:
         """Finite back-off hint for a rejected client: the queue's
@@ -1411,11 +1700,16 @@ class ContinuousBatchingEngine:
         self.cache.discard_swap(self._swap_handles.pop(rid))
         return True
 
-    def _preempt(self, keep: int) -> bool:
-        """Evict the most recently admitted active request (except slot
-        ``keep``) and requeue it at the FRONT of the queue.  With a
-        host tier and a favourable cost model the victim's pages SWAP
-        OUT (resume = restore, zero prefill); otherwise they release
+    def _preempt(self, keep: Optional[int],
+                 only: Optional[List[int]] = None) -> bool:
+        """Evict one active request (except slot ``keep``) and requeue
+        it at the FRONT of the queue — the victim is chosen by the
+        scheduler policy: lowest priority class first, most recently
+        admitted (``admit_seq`` LIFO) within a class.  ``only``
+        restricts the candidate slots (the priority-preemption path
+        passes the strictly-lower-class set).  With a host tier and a
+        favourable cost model the victim's pages SWAP OUT (resume =
+        restore, zero prefill); otherwise they release
         (recompute-style resumption).  Returns False when there is no
         eligible victim (pool genuinely too small).
 
@@ -1429,7 +1723,7 @@ class ContinuousBatchingEngine:
         at the head (its partial prefill recomputes at the next
         carve); the pipeline is already drained when ``_preempt``
         runs, so its half-written pages are safe to free."""
-        if self._mixed_pref:
+        if self._mixed_pref and only is None:
             slot = next(reversed(self._mixed_pref))
             ent = self._mixed_pref.pop(slot)
             req = ent["req"]
@@ -1452,10 +1746,11 @@ class ContinuousBatchingEngine:
                     mode="mixed-parked",
                     generated=len(req.generated))
             return True
-        victims = [s for s in self._active if s != keep]
+        victims = [s for s in (self._active if only is None else only)
+                   if s != keep and s in self._active]
         if not victims:
             return False
-        slot = max(victims, key=lambda s: self._active[s].admit_seq)
+        slot = self.policy.select_victim(victims, self._active)
         mode = self._preempt_mode(slot)
         req = self._active.pop(slot)
         req.slot = None
@@ -1665,17 +1960,34 @@ class ContinuousBatchingEngine:
 
     def _collect_admissions(self):
         """Pop every queued request that fits (slots + pool pages).
-        Head-of-line FIFO: stop at the first that doesn't fit — a
-        failed alloc mid-loop would crash the engine.  Swapped-out
-        requests gate on the device pages their restore must claim
-        (their on-device shared pages are already held) and bypass the
+        Head-of-line FIFO within a class: the queue is class-ordered
+        first (``policy.order_queue``, stable — arrival order and a
+        preempted request's head position survive within a class;
+        skipped entirely on all-"normal" traffic), then we stop at
+        the first that doesn't fit — a failed alloc mid-loop would
+        crash the engine.  Already-EXPIRED queued requests prune
+        EAGERLY here, before any fit check: they release queue budget
+        and 504 immediately instead of occupying a prefill slot (an
+        expired request must never dispatch).  Swapped-out requests
+        gate on the device pages their restore must claim (their
+        on-device shared pages are already held) and bypass the
         prefill lanes entirely."""
+        if self._has_priorities and len(self._queue) > 1:
+            self._queue = self.policy.order_queue(self._queue)
         admits: List = []                    # (request, context) pairs
         swap_ins: List = []                  # swapped-row restores
         reserved = 0
+        now = self._now() if self._has_deadlines else 0.0
         while self._queue and \
                 len(self._free_slots) > len(admits) + len(swap_ins):
             head = self._queue[0]
+            if head.deadline and now >= head.deadline:
+                # eager prune: the deadline passed while waiting —
+                # release queue budget (and any parked swap record,
+                # via _finish_queued_abnormal) and 504 now
+                self._queue.popleft()
+                self._finish_queued_abnormal(head, "expired")
+                continue
             handle = self._swap_handles.get(head.rid)
             if handle is not None:
                 need = self.cache.swap_pages_needed(handle)
@@ -1849,6 +2161,14 @@ class ContinuousBatchingEngine:
             # are the only thing still pinning pages — degrade them to
             # recompute resumes until the head of the queue fits
             admits, swap_ins = self._collect_admissions()
+        while self._has_priorities and not admits and not swap_ins \
+                and self._queue and self._priority_preempt():
+            # PRIORITY PREEMPTION: the (class-ordered) queue head
+            # cannot get a seat while strictly lower-class work holds
+            # slots/pages — evict one victim per turn through the
+            # existing swap/recompute machinery (token-exact resume)
+            # until the head fits or no lower-class victim remains
+            admits, swap_ins = self._collect_admissions()
         if (admits or swap_ins) and self.overlap:
             # admission is a scheduler mutation: drain the lookahead
             # pipeline before slots/pages move under it
@@ -1883,6 +2203,26 @@ class ContinuousBatchingEngine:
             if self.metrics is not None:
                 self.metrics.preempt_resume_seconds.observe(
                     dt / len(admits))
+
+    def _priority_preempt(self) -> bool:
+        """Evict ONE active request of a class strictly below the
+        queue head's so the head can admit (the policy picks the
+        victim: lowest class, ``admit_seq`` LIFO within it).  Runs at
+        a scheduler mutation point — the lookahead pipeline drains
+        first, same flush discipline as every other preemption.
+        Returns False when no lower-class victim exists (equal-class
+        work is never churned by arrival order alone)."""
+        victims = self.policy.preemptable_for(self._queue[0],
+                                              self._active)
+        if not victims:
+            return False
+        if self.overlap:
+            self._pipeline_flush()
+            # the flush may have retired rows — re-derive the set
+            victims = [s for s in victims if s in self._active]
+            if not victims:
+                return True     # pages freed without a preemption
+        return self._preempt(keep=None, only=victims)
 
     def _admit_sequential(self, admits: List) -> None:
         """Lane choice for one popped admission wave — shared by the
@@ -3386,6 +3726,8 @@ class EngineSupervisor:
                 new._queue.append(req)
                 if req.deadline:
                     new._has_deadlines = True
+                if req.priority != "normal":
+                    new._has_priorities = True
         old._queue.clear()
         new._next_rid = max(new._next_rid, old._next_rid)
         # engines carrying cross-engine state (the disagg DecodeEngine's

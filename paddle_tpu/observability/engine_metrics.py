@@ -99,6 +99,26 @@ class EngineMetrics:
             "submit() calls refused by the bounded admission queue "
             "(max_queue_len / max_queued_tokens backpressure; HTTP "
             "maps these to 429)")
+        # -- QoS / SLO guardrails (class-aware shedding + quotas) -------
+        self.requests_degraded = r.counter(
+            "paddle_tpu_engine_requests_degraded_total",
+            "Requests admitted DEGRADED under overload (normal class "
+            "past the soft queue bound: halved max_new_tokens, spec "
+            "off; the done message carries the flag)")
+        self.quota_rejected = r.counter(
+            "paddle_tpu_engine_quota_rejected_total",
+            "submit() calls refused because the request's tenant was "
+            "over its token-rate quota (QuotaExceededError; HTTP 429 "
+            "with a refill-derived Retry-After)")
+        self.queued_high = r.gauge(
+            "paddle_tpu_engine_queued_high_count",
+            "Waiting requests of priority class 'high'")
+        self.queued_normal = r.gauge(
+            "paddle_tpu_engine_queued_normal_count",
+            "Waiting requests of priority class 'normal'")
+        self.queued_low = r.gauge(
+            "paddle_tpu_engine_queued_low_count",
+            "Waiting requests of priority class 'low'")
         self.requests_faulted = r.counter(
             "paddle_tpu_engine_requests_faulted_total",
             "Requests retired with an error done-message because the "
@@ -333,6 +353,15 @@ def bind_engine_gauges(m: EngineMetrics, engine) -> None:
         _weak_fn(engine, lambda e: float(len(e._queue))))
     m.queued_tokens.set_function(
         _weak_fn(engine, lambda e: float(e.queued_tokens())))
+    m.queued_high.set_function(
+        _weak_fn(engine,
+                 lambda e: float(e.queued_by_class()["high"])))
+    m.queued_normal.set_function(
+        _weak_fn(engine,
+                 lambda e: float(e.queued_by_class()["normal"])))
+    m.queued_low.set_function(
+        _weak_fn(engine,
+                 lambda e: float(e.queued_by_class()["low"])))
     m.batch_occupancy.set_function(
         _weak_fn(engine,
                  lambda e: (len(e._active)

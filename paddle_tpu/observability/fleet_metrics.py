@@ -127,3 +127,30 @@ class FleetMetrics:
             "paddle_tpu_fleet_replica_drains_total",
             "drain() calls: replicas taken out of rotation to finish "
             "in-flight work before a restart/replace")
+
+        # -- QoS + autoscaling (SLO guardrails) -------------------------
+        self.quota_rejected = r.counter(
+            "paddle_tpu_fleet_quota_rejected_total",
+            "Submissions rejected at the ROUTER because the tenant "
+            "was over its token-rate quota (QuotaExceededError; "
+            "never charged against any replica)")
+        self.scale_up = r.counter(
+            "paddle_tpu_fleet_scale_up_total",
+            "Replicas ADDED to the fleet through "
+            "FleetRouter.add_replica() (the autoscaler's grow verb)")
+        self.scale_down = r.counter(
+            "paddle_tpu_fleet_scale_down_total",
+            "Replicas RETIRED from the fleet through "
+            "FleetRouter.retire_replica() — drained first, then "
+            "removed from rotation permanently (the autoscaler's "
+            "shrink verb)")
+        self.replicas_retired = r.gauge(
+            "paddle_tpu_fleet_replicas_retired_count",
+            "Replicas in terminal state RETIRED (scaled down; their "
+            "slot in the replica table is kept for stable indexing "
+            "but they own no engine)")
+        self.autoscaler_desired = r.gauge(
+            "paddle_tpu_fleet_autoscaler_desired_replicas_count",
+            "The FleetAutoscaler's current desired replica count "
+            "(bounded by min/max_replicas; 0 when no autoscaler is "
+            "attached)")

@@ -1,0 +1,540 @@
+"""SLO guardrails (ISSUE 20): priority classes, per-tenant quotas,
+class-aware overload shedding, and the chaos-hardened fleet
+autoscaler.
+
+Contract under test:
+* `submit(priority=..., tenant=...)` orders admission by (class,
+  arrival) and preempts strictly-lower-class actives under pool
+  pressure — token-exact vs the unloaded oracle (preemption resumes
+  through the PR-6 swap/recompute machinery);
+* the soft capacity bound tripping sheds CLASS-AWARE: low rejects
+  with 429, normal admits DEGRADED (halved budget, spec off,
+  surfaced in the done message), high admits untouched — all only up
+  to the hard bound (`overload_factor` x soft).  A pure
+  default-class workload keeps the legacy FIFO 429 at the soft bound
+  (pre-QoS deployments observe identical admission);
+* `TenantQuotas` token buckets isolate tenants: one tenant's burst
+  exhausts ITS budget only (429 + bucket-refill Retry-After), the
+  siblings and unmetered traffic keep admitting;
+* eager pruning: a queued request whose deadline passed retires at
+  the top of the admission wave, never spending a prefill dispatch;
+* the router's fleet-wide 429 carries a FINITE aggregate Retry-After
+  even with zero READY replicas (regression: a bare min() over the
+  empty READY set was a ValueError -> HTTP 500);
+* a 2x overload burst never rejects high (token-exact completions),
+  low absorbs the 429s — including under seeded replica-death chaos;
+* `FleetAutoscaler` closes the loop on queued-tokens pressure with
+  hysteresis + streaks + cooldown, and its settle guard makes a
+  replica death mid-ramp the router's auto_replace to fix — exactly
+  ONE replacement, never a controller oscillation.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fleet import FleetAutoscaler, FleetRouter, FleetServer
+from paddle_tpu.models.llama_pretrain import (LlamaPretrainConfig,
+                                              init_params)
+from paddle_tpu.models.paged_decode import PagedKVCache
+from paddle_tpu.models.serving_engine import (ContinuousBatchingEngine,
+                                              QueueFullError,
+                                              QuotaExceededError,
+                                              TenantQuotas)
+from paddle_tpu.testing import faults
+
+from test_fleet import _factory, _http_err
+
+pytestmark = pytest.mark.qos
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaPretrainConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False, loss_chunks=1,
+        use_pallas_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    return init_params(cfg, jax.random.PRNGKey(0), mesh)
+
+
+_RNG = np.random.RandomState(20)
+_PROMPTS = [_RNG.randint(1, 128, (L,))
+            for L in (10, 21, 33, 8, 17, 26, 12, 19)]
+
+_REF = {}
+
+
+def _ref_outputs(cfg, params, prompts, new=8):
+    """Unloaded greedy oracle, cached by prompt CONTENT (test_fleet's
+    `_ref_outputs` keys on (new, len) — fine for its fixed prompt
+    set, a collision for this module's seeded bursts)."""
+    key = (new, tuple(bytes(np.asarray(p)) for p in prompts))
+    if key not in _REF:
+        eng = _factory(cfg, params)()
+        rids = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        done = {r.rid: list(r.generated)
+                for r in eng.run_to_completion()}
+        _REF[key] = [done[rid] for rid in rids]
+    return _REF[key]
+
+
+# ---------------------------------------------------------------------------
+# priority classes: validation, admission ordering, preemption
+# ---------------------------------------------------------------------------
+def test_priority_validates_and_orders_admission(cfg, params):
+    eng = _factory(cfg, params)()
+    with pytest.raises(ValueError, match="priority"):
+        eng.submit(_PROMPTS[0], max_new_tokens=4, priority="urgent")
+    # 2 seats, 4 waiting: the first admission wave must seat the
+    # high + normal pair, not the two lows that arrived first
+    l1 = eng.submit(_PROMPTS[0], max_new_tokens=4, priority="low")
+    l2 = eng.submit(_PROMPTS[1], max_new_tokens=4, priority="low")
+    h = eng.submit(_PROMPTS[2], max_new_tokens=4, priority="high")
+    n = eng.submit(_PROMPTS[3], max_new_tokens=4)
+    eng.step()
+    active = {r.rid for r in eng._active.values()}
+    assert active == {h, n}, \
+        f"class order violated: seated {active}, not {{{h}, {n}}}"
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert set(done) == {l1, l2, h, n}
+    assert all(r.status == "ok" for r in done.values())
+    ref = _ref_outputs(cfg, params, _PROMPTS[:4], new=4)
+    for i, rid in enumerate((l1, l2, h, n)):
+        assert list(done[rid].generated) == ref[i]
+    eng.cache.audit()
+
+
+def test_priority_preemption_token_exact_vs_oracle(cfg, params):
+    """A high arrival with no free seat evicts a LOW active (never an
+    equal-class one); the victim resumes and both finish exactly the
+    unloaded oracle's outputs."""
+    eng = _factory(cfg, params)()
+    lows = [eng.submit(p, max_new_tokens=8, priority="low")
+            for p in _PROMPTS[:2]]
+    eng.step()                         # both lows hold the 2 seats
+    assert len(eng._active) == 2
+    h = eng.submit(_PROMPTS[2], max_new_tokens=8, priority="high")
+    eng.step()                         # head=high -> preempt one low
+    assert h in {r.rid for r in eng._active.values()}, \
+        "high never got a seat: priority preemption did not fire"
+    assert eng.preemptions >= 1
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert set(done) == set(lows) | {h}
+    assert all(r.status == "ok" for r in done.values())
+    assert done[h].preempted == 0, "the protected class was churned"
+    assert any(done[rid].preempted > 0 for rid in lows)
+    ref = _ref_outputs(cfg, params, _PROMPTS[:3])
+    for i, rid in enumerate(lows + [h]):
+        assert list(done[rid].generated) == ref[i], \
+            f"preemption broke token-exactness for rid {rid}"
+    eng.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# class-aware overload shedding
+# ---------------------------------------------------------------------------
+def test_shed_low_rejects_normal_degrades_high_admits(cfg, params):
+    eng = _factory(cfg, params, max_queue_len=3)()
+    base = [eng.submit(p, max_new_tokens=8) for p in _PROMPTS[:3]]
+    # soft bound reached: low sheds with the standard finite hint
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(_PROMPTS[3], max_new_tokens=8, priority="low")
+    assert ei.value.retry_after > 0
+    assert eng.requests_rejected == 1
+    # high admits untouched through the overload band
+    h = eng.submit(_PROMPTS[3], max_new_tokens=8, priority="high")
+    # normal now degrades: budget halved, flagged in the done message
+    n1 = eng.submit(_PROMPTS[4], max_new_tokens=8)
+    n2 = eng.submit(_PROMPTS[5], max_new_tokens=8)
+    assert eng.requests_degraded == 2
+    # hard bound (overload_factor x soft): even high rejects, loudly
+    with pytest.raises(QueueFullError, match="hard bound"):
+        eng.submit(_PROMPTS[6], max_new_tokens=8, priority="high")
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert set(done) == set(base) | {h, n1, n2}
+    assert all(r.status == "ok" for r in done.values())
+    ref = _ref_outputs(cfg, params, _PROMPTS)
+    assert not done[h].degraded
+    assert list(done[h].generated) == ref[3]
+    for i, rid in ((4, n1), (5, n2)):
+        assert done[rid].degraded, "degraded flag lost"
+        # halved budget, still the oracle's greedy prefix
+        assert list(done[rid].generated) == ref[i][:4]
+    eng.cache.audit()
+
+
+def test_default_class_workload_keeps_legacy_429(cfg, params):
+    """A workload that never names a priority sheds EXACTLY like the
+    pre-QoS engine: FIFO 429 at the soft bound, nothing degraded."""
+    eng = _factory(cfg, params, max_queue_len=2)()
+    for p in _PROMPTS[:2]:
+        eng.submit(p, max_new_tokens=4)
+    with pytest.raises(QueueFullError):
+        eng.submit(_PROMPTS[2], max_new_tokens=4)
+    assert eng.requests_degraded == 0
+    assert eng.requests_rejected == 1
+    done = eng.run_to_completion()
+    assert all(not r.degraded for r in done)
+
+
+# ---------------------------------------------------------------------------
+# tenant quotas
+# ---------------------------------------------------------------------------
+def test_tenant_quota_isolates_and_refills(cfg, params):
+    eng = _factory(cfg, params)()
+    eng.quotas = TenantQuotas(rate_tokens_per_s=10.0,
+                              burst_tokens=40.0)
+    t = [1000.0]
+    eng._now = lambda: t[0]            # pin the bucket clock
+    p = _PROMPTS[0]                    # cost/submit = 10 + 8 = 18
+    eng.submit(p, max_new_tokens=8, tenant="a")
+    eng.submit(p, max_new_tokens=8, tenant="a")   # bucket a: 40 -> 4
+    with pytest.raises(QuotaExceededError) as ei:
+        eng.submit(p, max_new_tokens=8, tenant="a")
+    assert ei.value.tenant == "a"
+    # Retry-After = exact refill time for the deficit: (18-4)/10
+    assert ei.value.retry_after == pytest.approx(1.4)
+    assert eng.quota_rejected == 1
+    # isolation: tenant b's bucket is untouched; unmetered traffic
+    # never consults the ledger
+    eng.submit(p, max_new_tokens=8, tenant="b")
+    eng.submit(p, max_new_tokens=8)
+    # all-or-nothing: the refused charge did not erode a's level —
+    # after the hinted wait (plus an fp-rounding hair), the deficit
+    # has refilled
+    t[0] += 1.41
+    eng.submit(p, max_new_tokens=8, tenant="a")
+    assert eng.quota_rejected == 1
+    done = eng.run_to_completion()
+    assert len(done) == 5 and all(r.status == "ok" for r in done)
+    eng.cache.audit()
+
+
+def test_quota_http_429_carries_bucket_retry_after(cfg, params):
+    """The HTTP front maps QuotaExceededError to 429 + the bucket's
+    refill hint (riding the QueueFullError path), while sibling
+    tenants keep getting 200s."""
+    from paddle_tpu.inference.serving import GenerationServer
+    mk = _factory(cfg, params,
+                  tenant_quotas=TenantQuotas(rate_tokens_per_s=1.0,
+                                             burst_tokens=20.0))
+    srv = GenerationServer(engine=mk())
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        body = lambda ten: json.dumps(          # noqa: E731
+            {"prompt": [int(x) for x in _PROMPTS[0]],
+             "max_new_tokens": 4, "tenant": ten}).encode()
+        code, _, _ = _http_err(url + "/generate", body("a"))
+        assert code == 200                       # bucket a: 20 -> 6
+        code, text, headers = _http_err(url + "/generate", body("a"))
+        assert code == 429
+        assert b"quota" in text
+        # deficit (14-6)/1 = 8 s, minus at most ~2 s of wall refill
+        assert 6 <= int(headers["Retry-After"]) <= 9
+        code, _, _ = _http_err(url + "/generate", body("b"))
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# eager expired-queued pruning
+# ---------------------------------------------------------------------------
+def test_expired_queued_request_never_spends_a_prefill(cfg, params):
+    """A queued request whose deadline passed prunes at the TOP of
+    the admission wave — it retires 'expired' without ever riding a
+    prefill dispatch (before the eager prune it was prefilled first
+    and expired only at the decode-side deadline check)."""
+    eng = _factory(cfg, params)()
+    t = [1000.0]
+    eng._now = lambda: t[0]
+    doomed = eng.submit(_PROMPTS[0], max_new_tokens=4, deadline_s=5.0)
+    live = eng.submit(_PROMPTS[1], max_new_tokens=4)
+    t[0] += 10.0                       # deadline passes while queued
+    done = {r.rid: r for r in eng.run_to_completion()}
+    assert done[doomed].status == "expired"
+    assert done[live].status == "ok"
+    assert len(done[doomed].generated) == 0
+    # exactly one packed admission wave: the expired request never
+    # reached a prefill lane
+    assert eng.prefill_calls == 1
+    eng.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# fleet: aggregate Retry-After with zero READY replicas (regression)
+# ---------------------------------------------------------------------------
+def test_fleet_429_finite_retry_after_with_zero_ready(cfg, params):
+    """REGRESSION: every candidate DEGRADED and saturated -> the
+    aggregate hint used to min() over the EMPTY READY set (ValueError
+    -> HTTP 500).  It must stay a finite float on every path."""
+    mk = _factory(cfg, params, max_queue_len=1)
+    router = FleetRouter([mk] * 2, metrics_registry=False)
+    for p in _PROMPTS[:2]:             # saturate both queues
+        router.submit(p, max_new_tokens=4)
+    for h in router._replicas:         # full-fleet degradation
+        h.state = "DEGRADED"
+    with pytest.raises(QueueFullError) as ei:
+        router.submit(_PROMPTS[2], max_new_tokens=4)
+    assert "fleet saturated" in str(ei.value)
+    assert math.isfinite(ei.value.retry_after)
+    assert ei.value.retry_after > 0
+    assert router.rejected == 1
+    router.run_to_completion()
+
+
+def test_fleet_routes_by_class_and_charges_quota_once(cfg, params):
+    """Router-level quotas charge at the FLEET boundary (before
+    placement) — replica engines run unmetered, so a fleet request is
+    billed exactly once; the router's 429 names the rejected class."""
+    mk = _factory(cfg, params, max_queue_len=1)
+    router = FleetRouter([mk] * 2, metrics_registry=False,
+                         tenant_quotas=TenantQuotas(
+                             rate_tokens_per_s=10.0,
+                             burst_tokens=30.0))
+    p = _PROMPTS[0]                    # cost 14/submit
+    router.submit(p, max_new_tokens=4, tenant="a")
+    router.submit(p, max_new_tokens=4, tenant="a")  # a: 30 -> 2
+    with pytest.raises(QuotaExceededError):
+        router.submit(p, max_new_tokens=4, tenant="a")
+    assert router.quota_rejected == 1
+    assert all(h.engine.quota_rejected == 0
+               for h in router._replicas), "double-billed at replica"
+    # the quota 429 is not a capacity 429
+    assert router.rejected == 0
+    # saturated fleet + low class: the aggregate message is honest
+    # about WHO was shed
+    with pytest.raises(QueueFullError,
+                       match=r"rejected class 'low'"):
+        router.submit(p, max_new_tokens=4, priority="low",
+                      tenant="b")
+    done = router.run_to_completion()
+    assert all(r.status == "ok" for r in done)
+
+
+# ---------------------------------------------------------------------------
+# 2x overload burst: high token-exact with zero rejections, low
+# absorbs the 429s — plain and under seeded replica-death chaos
+# ---------------------------------------------------------------------------
+def _burst(router, prompts, classes, new=8):
+    """Submit a mixed-class burst; returns (accepted rid->(i, cls),
+    rejected list of (i, cls))."""
+    accepted, rejected = {}, []
+    for i, (p, c) in enumerate(zip(prompts, classes)):
+        try:
+            rid = router.submit(p, max_new_tokens=new, priority=c)
+            accepted[rid] = (i, c)
+        except QueueFullError:
+            rejected.append((i, c))
+    return accepted, rejected
+
+
+def test_overload_burst_protects_high_sheds_low(cfg, params):
+    mk = _factory(cfg, params, max_queue_len=2)
+    router = FleetRouter([mk] * 2, metrics_registry=False)
+    # 3x the fleet's soft queue capacity (2 replicas x 2 = 4), with
+    # the protected traffic sized INSIDE the hard band (2x soft = 8):
+    # the guardrail contract is "shed low first", not "admit beyond
+    # the hard bound"
+    rng = np.random.RandomState(7)
+    classes = ["high"] * 2 + ["normal"] * 2 + ["low"] * 8
+    rng.shuffle(classes)
+    prompts = [rng.randint(1, 128, (int(rng.randint(6, 20)),))
+               for _ in classes]
+    accepted, rejected = _burst(router, prompts, classes)
+    assert not any(c == "high" for _, c in rejected), \
+        f"high-class request rejected under overload: {rejected}"
+    assert any(c == "low" for _, c in rejected), \
+        "burst was sized to shed low traffic"
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(accepted), "accepted request lost"
+    assert all(r.status == "ok" for r in done.values())
+    ref = _ref_outputs(cfg, params, prompts)
+    for rid, (i, c) in accepted.items():
+        got = list(done[rid].generated)
+        if c == "high":
+            assert not done[rid].degraded
+            assert got == ref[i], "high not token-exact"
+        else:
+            # a degraded normal is the oracle's greedy PREFIX
+            assert got == ref[i][:len(got)]
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_chaos_burst_zero_high_rejections(cfg, params):
+    """Seeded chaos on top of the overload burst: replica deaths
+    during the drain must not break the QoS admission contract —
+    zero high rejections, every accepted request reaches a terminal
+    status, caches audit clean."""
+    mk = _factory(cfg, params, max_queue_len=2)
+    router = FleetRouter([mk] * 2, metrics_registry=False)
+    rng = np.random.RandomState(11)
+    classes = ["high"] * 2 + ["normal"] * 2 + ["low"] * 8
+    rng.shuffle(classes)
+    prompts = [rng.randint(1, 128, (int(rng.randint(6, 20)),))
+               for _ in classes]
+    accepted, rejected = _burst(router, prompts, classes)
+    with faults.plane() as fp:
+        fp.inject("replica_death", RuntimeError("chaos kill"),
+                  every=13, times=2)
+        fp.inject("replica_slow", p=0.10, seed=11)
+        done = {r.rid: r for r in router.run_to_completion()}
+    assert not any(c == "high" for _, c in rejected)
+    assert set(done) == set(accepted), "accepted request dropped"
+    ref = _ref_outputs(cfg, params, prompts)
+    for rid, (i, c) in accepted.items():
+        r = done[rid]
+        assert r.status in ("ok", "error")
+        if r.status == "ok" and c == "high":
+            assert list(r.generated) == ref[i]
+    assert router.deaths >= 1, "chaos was armed to kill"
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+# ---------------------------------------------------------------------------
+# fleet autoscaler
+# ---------------------------------------------------------------------------
+def test_autoscaler_validates_band(cfg, params):
+    mk = _factory(cfg, params)
+    router = FleetRouter([mk], metrics_registry=False)
+    with pytest.raises(ValueError, match="min_replicas"):
+        FleetAutoscaler(router, mk, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        FleetAutoscaler(router, mk, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        FleetAutoscaler(router, mk, low_queued_tokens=64,
+                        high_queued_tokens=64)
+
+
+def test_autoscaler_closed_loop_up_then_down(cfg, params):
+    """Queue pressure grows the fleet (streak + watermark gated);
+    the drained fleet shrinks it back — the retired slot parks in
+    terminal RETIRED and the bounds hold."""
+    mk = _factory(cfg, params, max_queue_len=16)
+    router = FleetRouter([mk], metrics_registry=False)
+    asc = FleetAutoscaler(router, mk, min_replicas=1, max_replicas=2,
+                          high_queued_tokens=20.0,
+                          low_queued_tokens=4.0,
+                          up_consecutive=2, down_consecutive=2,
+                          cooldown_s=5.0)
+    t = [0.0]
+    asc._now = lambda: t[0]
+    for p in _PROMPTS[:6]:             # ~100 queued tokens, 1 replica
+        router.submit(p, max_new_tokens=6)
+    assert asc.tick() is None          # hot, streak 1 of 2
+    assert asc.tick() == "up:1"        # streak satisfied -> grow
+    assert router._replicas[1].state == "READY"
+    assert router.scale_ups == 1
+    # cooldown: even a still-hot fleet holds after an action
+    assert asc.tick() is None
+    assert asc.skipped_cooldown == 1
+    done = router.run_to_completion()
+    assert len(done) == 6 and all(r.status == "ok" for r in done)
+    t[0] += 10.0                       # past cooldown; queue empty
+    assert asc.tick() is None          # cold, streak 1 of 2
+    out = asc.tick()
+    assert out is not None and out.startswith("down:")
+    victim = int(out.split(":")[1])
+    assert router._replicas[victim].retiring
+    router.step()                      # drain completes -> RETIRED
+    assert router._replicas[victim].state == "RETIRED"
+    assert router.scale_downs == 1
+    snap = router.fleet_snapshot()
+    assert snap["states"]["RETIRED"] == 1
+    # min bound: the last live replica is never retired
+    t[0] += 10.0
+    assert asc.tick() is None
+    assert asc.tick() is None
+    assert asc.snapshot()["scale_downs"] == 1
+    # the survivor still serves, and the retired slot is terminal
+    rid = router.submit(_PROMPTS[0], max_new_tokens=4)
+    assert any(r.rid == rid and r.status == "ok"
+               for r in router.run_to_completion())
+    with pytest.raises(ValueError, match="RETIRED"):
+        router.replace(victim)
+
+
+def test_autoscaler_midramp_death_exactly_one_replacement(
+        cfg, params):
+    """CHAOS PIN: a replica dying right after a scale-up is the
+    router's auto_replace to fix — the settle guard skips the dying
+    ticks (streaks reset), so the fleet sees exactly ONE replacement
+    and ZERO extra scale actions, even while the pressure signal
+    still reads hot."""
+    mk = _factory(cfg, params, max_queue_len=16)
+    router = FleetRouter([mk], metrics_registry=False)
+    asc = FleetAutoscaler(router, mk, min_replicas=1, max_replicas=3,
+                          high_queued_tokens=8.0,
+                          low_queued_tokens=1.0,
+                          up_consecutive=2, down_consecutive=4,
+                          cooldown_s=0.0)
+    t = [0.0]
+    asc._now = lambda: t[0]
+    rids = [router.submit(p, max_new_tokens=6) for p in _PROMPTS[:6]]
+    assert asc.tick() is None
+    assert asc.tick() == "up:1"        # the ramp: 1 -> 2 replicas
+    with faults.plane() as fp:
+        # first replica step after the ramp kills a replica
+        fp.inject("replica_death", RuntimeError("mid-ramp kill"),
+                  nth=1)
+        router.step()
+    assert router.deaths == 1
+    dead = [h for h in router._replicas if h.state == "DEAD"]
+    assert len(dead) == 1
+    t[0] += 1.0
+    # the controller sees a mid-transition fleet: skip + streak reset
+    assert asc.tick() is None
+    assert asc.skipped_settling >= 1
+    assert asc.scale_ups == 1, "controller scaled on a death"
+    router.step()                      # router auto-replaces the dead
+    assert router.replaces == 1, "not exactly one replacement"
+    assert sum(1 for h in router._replicas
+               if h.state == "READY") == 2
+    done = {r.rid: r for r in router.run_to_completion()}
+    assert set(done) == set(rids), "request lost across the death"
+    assert router.replaces == 1        # still exactly one
+    assert router.scale_ups == 1
+    for h in router._replicas:
+        h.engine.cache.audit()
+
+
+def test_fleet_http_surfaces_qos_counters(cfg, params):
+    """/fleet carries the scale/quota counters and per-replica
+    retiring marks the dashboards (tools/metrics_dump.py qos) read."""
+    mk = _factory(cfg, params)
+    router = FleetRouter([mk] * 2, metrics_registry=False)
+    srv = FleetServer(router)
+    port = srv.start()
+    try:
+        router.add_replica(mk)
+        router.retire_replica(2)
+        router.step()
+        code, body, _ = _http_err(
+            f"http://127.0.0.1:{port}/fleet")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["scale_ups"] == 1
+        assert doc["scale_downs"] == 1
+        assert doc["quota_rejected"] == 0
+        assert doc["states"]["RETIRED"] == 1
+        assert [r["retiring"] for r in doc["replicas"]] \
+            == [False, False, False]
+    finally:
+        srv.stop()

@@ -9,6 +9,7 @@ Usage:
   python tools/metrics_dump.py fleet   http://127.0.0.1:8000
   python tools/metrics_dump.py disagg  http://127.0.0.1:8000
   python tools/metrics_dump.py spec    http://127.0.0.1:8000
+  python tools/metrics_dump.py qos     http://127.0.0.1:8000
   python tools/metrics_dump.py transport http://127.0.0.1:8000
   python tools/metrics_dump.py traces  http://127.0.0.1:8000 [--min-ms N] [--status S]
   python tools/metrics_dump.py trace   http://127.0.0.1:8000 <rid>
@@ -24,7 +25,12 @@ lifecycle states, per-replica load, routing/failover counters);
 ``GET /stats`` (handoff traffic, in-flight depth, routing decisions,
 fallbacks, handoff ms/request); ``spec`` renders the fused
 speculative-decoding slice (rounds/drafted/accepted counters, live
-gamma, accept-length histogram, derived acceptance ratio);
+gamma, accept-length histogram, derived acceptance ratio); ``qos``
+renders the SLO-guardrail slice as a dashboard — per-class queue
+depths, shed/degrade/quota-reject counts, and the fleet's scale
+trajectory (``scale_up/down``, retired slots, the autoscaler's
+desired-replica gauge), from ``GET /stats`` with ``GET /fleet``
+folded in when the front is a FleetServer;
 ``transport`` renders a socket
 fleet's wire health — per-replica connection mode/address, lease
 age, reconnect/retry/heartbeat-miss counters and wire volume from
@@ -237,6 +243,71 @@ def _render_spec(snap: dict) -> str:
 def cmd_spec(args) -> int:
     body = json.loads(_get(args.url.rstrip("/") + "/stats"))
     print(_render_spec(body.get("metrics", body)))
+    return 0
+
+
+def _render_qos(snap: dict, fleet_doc: dict = None) -> str:
+    """The SLO-guardrail slice of a registry snapshot: per-class
+    queue depths, shed/degrade/quota counters, and the fleet scale
+    trajectory (docs/FAULT_TOLERANCE.md "Overload & degradation")."""
+    def val(name):
+        m = snap.get(name) or {}
+        v = m.get("value")
+        return 0 if v is None else v
+
+    lines = []
+    q = {c: val(f"paddle_tpu_engine_queued_{c}_count")
+         for c in ("high", "normal", "low")}
+    lines.append("queued by class: " + "  ".join(
+        f"{c}={int(q[c])}" for c in ("high", "normal", "low")))
+    lines.append(
+        f"shed: rejected={int(val('paddle_tpu_engine_requests_rejected_total'))}  "
+        f"degraded={int(val('paddle_tpu_engine_requests_degraded_total'))}  "
+        f"quota_rejected={int(val('paddle_tpu_engine_quota_rejected_total'))}")
+    fleet_qr = val("paddle_tpu_fleet_quota_rejected_total")
+    ups = val("paddle_tpu_fleet_scale_up_total")
+    downs = val("paddle_tpu_fleet_scale_down_total")
+    retired = val("paddle_tpu_fleet_replicas_retired_count")
+    desired = val(
+        "paddle_tpu_fleet_autoscaler_desired_replicas_count")
+    if any((fleet_qr, ups, downs, retired, desired)) or \
+            "paddle_tpu_fleet_replicas_count" in snap:
+        lines.append(
+            f"fleet: quota_rejected={int(fleet_qr)}  "
+            f"scale_ups={int(ups)}  scale_downs={int(downs)}  "
+            f"retired={int(retired)}  desired={int(desired)}  "
+            f"rejected={int(val('paddle_tpu_fleet_rejected_total'))}")
+    if fleet_doc:
+        states = fleet_doc.get("states", {})
+        lines.append("replicas: " + "  ".join(
+            f"{s.lower()}={states.get(s, 0)}" for s in
+            ("READY", "DEGRADED", "DRAINING", "STARTING", "DEAD",
+             "RETIRED")))
+    qos = {n: m for n, m in snap.items() if n in (
+        "paddle_tpu_engine_requests_degraded_total",
+        "paddle_tpu_engine_quota_rejected_total",
+        "paddle_tpu_engine_queued_high_count",
+        "paddle_tpu_engine_queued_normal_count",
+        "paddle_tpu_engine_queued_low_count",
+        "paddle_tpu_fleet_quota_rejected_total",
+        "paddle_tpu_fleet_scale_up_total",
+        "paddle_tpu_fleet_scale_down_total",
+        "paddle_tpu_fleet_replicas_retired_count",
+        "paddle_tpu_fleet_autoscaler_desired_replicas_count")}
+    if qos:
+        lines.append(_render_snapshot(qos))
+    return "\n".join(lines)
+
+
+def cmd_qos(args) -> int:
+    base = args.url.rstrip("/")
+    body = json.loads(_get(base + "/stats"))
+    fleet_doc = None
+    try:
+        fleet_doc = json.loads(_get(base + "/fleet"))
+    except (urllib.error.URLError, ValueError):
+        pass                     # single-engine fronts have no /fleet
+    print(_render_qos(body.get("metrics", body), fleet_doc))
     return 0
 
 
@@ -500,6 +571,12 @@ def main(argv=None) -> int:
                             "decoding slice of GET /stats")
     s.add_argument("url")
     s.set_defaults(fn=cmd_spec)
+    s = sub.add_parser("qos",
+                       help="pretty-print the SLO-guardrail slice "
+                            "(per-class queues, shed/quota counts, "
+                            "scale trajectory)")
+    s.add_argument("url")
+    s.set_defaults(fn=cmd_qos)
     s = sub.add_parser("transport",
                        help="pretty-print a socket fleet's wire "
                             "health (GET /fleet + /stats)")
